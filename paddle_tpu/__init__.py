@@ -1,0 +1,95 @@
+"""paddle_tpu: a TPU-native deep learning framework.
+
+A ground-up rebuild of the capabilities of the reference framework
+(feifei-111/Paddle, i.e. PaddlePaddle ~2.6) designed TPU-first on
+JAX/XLA/Pallas: eager mode is a thin autograd tape over XLA-compiled ops,
+static mode is `jax.jit` tracing, distribution is GSPMD mesh-and-sharding
+over ICI, and hot kernels are Pallas. See SURVEY.md at the repo root for the
+full component mapping to the reference.
+
+Public API mirrors `import paddle` (reference: python/paddle/__init__.py).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# core types
+from paddle_tpu.core.tensor import Tensor, Parameter, to_tensor, is_tensor
+from paddle_tpu.core.tape import no_grad, enable_grad, set_grad_enabled, grad
+from paddle_tpu.core import dtype as _dtype_mod
+from paddle_tpu.core.dtype import (
+    float16, bfloat16, float32, float64, int8, int16, int32, int64,
+    uint8, bool_, complex64, complex128, float8_e4m3fn, float8_e5m2,
+)
+from paddle_tpu.core.random import seed, get_rng_state, set_rng_state
+from paddle_tpu.core.flags import set_flags, get_flags
+
+bool = bool_  # paddle.bool
+
+# functional tensor API (creation/math/manipulation/linalg/...)
+from paddle_tpu.tensor import *  # noqa: F401,F403
+from paddle_tpu.tensor import einsum  # noqa: F401
+
+# subpackages (paddle.nn, paddle.optimizer, ...)
+from paddle_tpu import nn  # noqa: F401
+from paddle_tpu import optimizer  # noqa: F401
+from paddle_tpu import amp  # noqa: F401
+from paddle_tpu import autograd  # noqa: F401
+from paddle_tpu import io  # noqa: F401
+from paddle_tpu import jit  # noqa: F401
+from paddle_tpu import metric  # noqa: F401
+from paddle_tpu import device  # noqa: F401
+from paddle_tpu.framework.io_utils import save, load  # noqa: F401
+from paddle_tpu.jit.api import to_static  # noqa: F401
+from paddle_tpu.device import (  # noqa: F401
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_xpu,
+    is_compiled_with_rocm, is_compiled_with_custom_device,
+)
+
+
+def __getattr__(name):
+    # heavy subpackages loaded lazily to keep import fast
+    import importlib
+    if name in ("distributed", "vision", "distribution", "profiler",
+                "incubate", "sparse", "static", "hapi", "models", "fft",
+                "signal", "linalg_mod", "quantization", "geometric", "text",
+                "audio", "onnx", "utils"):
+        mod = importlib.import_module(f"paddle_tpu.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def in_dynamic_mode():
+    from paddle_tpu.jit.api import _in_tracing
+    return not _in_tracing()
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no separate static graph mode: use paddle_tpu.jit."
+        "to_static / paddle_tpu.static for program-capture workflows.")
+
+
+def get_default_dtype():
+    from paddle_tpu.framework import _default_dtype
+    return _default_dtype[0]
+
+
+def set_default_dtype(d):
+    from paddle_tpu.framework import _default_dtype
+    from paddle_tpu.core.dtype import convert_dtype
+    _default_dtype[0] = convert_dtype(d)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from paddle_tpu.hapi.summary import summary as _summary
+    return _summary(net, input_size, dtypes, input)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    return 0

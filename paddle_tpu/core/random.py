@@ -1,0 +1,114 @@
+"""RNG state management.
+
+TPU-native rebuild of the reference's Generator (reference:
+paddle/phi/core/generator.h:32; python/paddle/framework/random.py). Instead of
+stateful Philox engines per device, we keep a counter-advanced root
+`jax.random` key: every random op folds a fresh subkey out of the global (or a
+local) Generator. This is deterministic, replayable, and safe under jit
+(keys are explicit values, never hidden state inside a traced program).
+
+RNGStatesTracker mirrors fleet/layers/mpu/random.py:34 — named parallel RNG
+streams so e.g. tensor-parallel ranks can draw identical ("global") or
+distinct ("local") dropout masks.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+
+class Generator:
+    """Counter-based key generator over a root jax PRNG key."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        with getattr(self, "_lock", contextlib.nullcontext()):
+            self._seed = int(seed)
+            self._key = jax.random.key(int(seed))
+            self._counter = 0
+        return self
+
+    def seed(self, seed: int):
+        return self.manual_seed(seed)
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            c = self._counter
+            self._counter += 1
+        return jax.random.fold_in(self._key, c)
+
+    def get_state(self):
+        return (self._seed, self._counter)
+
+    def set_state(self, state):
+        self._seed, self._counter = int(state[0]), int(state[1])
+        self._key = jax.random.key(self._seed)
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed equivalent: reseed the global generator."""
+    _default_generator.manual_seed(s)
+    return _default_generator
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)
+
+
+def next_key(generator: Generator | None = None):
+    return (generator or _default_generator).next_key()
+
+
+class RNGStatesTracker:
+    """Named RNG streams for parallel-consistent randomness
+    (reference: fleet/layers/mpu/random.py:34)."""
+
+    def __init__(self):
+        self._states: dict[str, Generator] = {}
+
+    def add(self, name: str, seed: int):
+        if name in self._states:
+            raise ValueError(f"RNG state {name!r} already exists")
+        self._states[name] = Generator(seed)
+
+    def reset(self):
+        self._states.clear()
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str):
+        global _default_generator
+        if name not in self._states:
+            raise ValueError(f"RNG state {name!r} not registered")
+        prev = _default_generator
+        _default_generator = self._states[name]
+        try:
+            yield
+        finally:
+            _default_generator = prev
+
+
+_rng_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _rng_tracker

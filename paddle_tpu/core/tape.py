@@ -1,0 +1,276 @@
+"""Eager autograd tape.
+
+TPU-native replacement for the reference's eager autograd engine
+(reference: paddle/fluid/eager/grad_node_info.h:197 GradNodeBase,
+paddle/fluid/eager/backward.cc:429 egr::Backward, grad_tensor_holder.h:27).
+
+Design (SURVEY.md §3.1-3.2 "TPU lesson"): instead of generated per-op
+GradNodes, each eager op that needs grad is run through `jax.vjp`, and the
+returned vjp closure IS the grad node. The tape is an append-only list; eager
+execution order is a topological order of the graph, so backward is simply a
+reverse sweep — no in-degree bookkeeping needed (the reference's queue +
+DuplicateCheckedGraphInfo exists because its graph is built from C++ nodes
+with multi-threaded hooks; ours is single-threaded by construction).
+
+Gradient accumulation across fan-out (the reference's GradTensorHolder) is a
+dict keyed by tensor id, summed with jnp.add.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+import weakref
+
+
+class TapeNode:
+    """One recorded differentiable op: inputs -> vjp_fn -> outputs.
+
+    Outputs are held weakly (keyed by tensor uid): when every output of a
+    node is garbage-collected, no future backward can reach it, so the tape
+    prunes it — the analog of the reference freeing GradNodes when their
+    forward tensors die (eager autograd_meta shared_ptr ownership)."""
+
+    __slots__ = ("inputs", "out_refs", "out_uids", "vjp_fn", "out_avals",
+                 "name")
+
+    def __init__(self, name, inputs, outputs, vjp_fn, out_avals):
+        self.name = name
+        self.inputs = inputs      # list[Tensor] (only those requiring grad)
+        self.out_refs = [weakref.ref(o) for o in outputs]
+        self.out_uids = [o._uid for o in outputs]
+        self.vjp_fn = vjp_fn      # callable(cotangents tuple) -> input grads
+        self.out_avals = out_avals  # [(shape, dtype)] to build zero cotangents
+
+    def alive(self):
+        return any(r() is not None for r in self.out_refs)
+
+
+_PRUNE_EVERY = 256
+
+
+class Tape:
+    def __init__(self):
+        self.nodes: list[TapeNode] = []
+        self._since_prune = 0
+
+    def record(self, node: TapeNode):
+        self.nodes.append(node)
+        self._since_prune += 1
+        if self._since_prune >= _PRUNE_EVERY:
+            self.prune()
+
+    def prune(self):
+        """Drop nodes whose outputs are all dead — unreachable for any
+        future backward (downstream nodes hold their inputs strongly, so a
+        node with live consumers always has a live output)."""
+        self.nodes = [n for n in self.nodes if n.alive()]
+        self._since_prune = 0
+
+    def remove(self, visited: set):
+        self.nodes = [n for n in self.nodes if id(n) not in visited]
+
+    def clear(self):
+        self.nodes.clear()
+        self._since_prune = 0
+
+
+_state = threading.local()
+
+
+def _get_state():
+    if not hasattr(_state, "tape"):
+        _state.tape = Tape()
+        _state.grad_enabled = True
+    return _state
+
+
+def current_tape() -> Tape:
+    return _get_state().tape
+
+
+def push_tape() -> Tape:
+    """Install a fresh tape (used while jit-tracing so tracer-valued nodes
+    never leak onto the eager tape); returns the previous tape."""
+    st = _get_state()
+    prev = st.tape
+    st.tape = Tape()
+    return prev
+
+
+def pop_tape(prev: Tape):
+    _get_state().tape = prev
+
+
+def grad_enabled() -> bool:
+    return _get_state().grad_enabled
+
+
+def set_grad_enabled(flag: bool) -> bool:
+    st = _get_state()
+    prev = st.grad_enabled
+    st.grad_enabled = flag
+    return prev
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording
+    (reference: python/paddle/base/dygraph/base.py no_grad_)."""
+
+    def __enter__(self):
+        self._prev = set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+def _zero_cotangent(shape, dtype):
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    # integer/bool outputs take float0 cotangents in jax
+    return np.zeros(shape, dtype=jax.dtypes.float0)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Run reverse-mode accumulation from `tensors`; set .grad on leaves.
+
+    Mirrors egr::Backward (reference: paddle/fluid/eager/backward.cc:429):
+    seeds output grads (default ones), sweeps the graph in reverse
+    topological order, sums fan-in, applies registered tensor hooks, and
+    accumulates into `.grad` of leaf tensors (reference:
+    accumulation/accumulation_node.h:24).
+    """
+    grads = _seed_grads(tensors, grad_tensors)
+    tape = current_tape()
+    visited = set()
+    _sweep(tape, grads, accumulate_leaves=True, visited=visited)
+    if not retain_graph:
+        # free only the swept subgraph; other live graphs (e.g. a second
+        # loss over shared inputs) keep their nodes
+        tape.remove(visited)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
+    """paddle.grad equivalent (reference: backward.cc:440 egr::Grad /
+    GeneralGrad subgraph). Returns grads of `inputs` without touching .grad."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported on the eager "
+            "tape; use paddle_tpu.jit-traced functions with jax.grad "
+            "composition for higher-order derivatives.")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    grads = _seed_grads(outputs, grad_outputs)
+    tape = current_tape()
+    wanted = {t._uid for t in inputs}
+    visited = set()
+    result_map = _sweep(tape, grads, accumulate_leaves=False, wanted=wanted,
+                        visited=visited)
+    if not retain_graph:
+        tape.remove(visited)
+    out = []
+    for t in inputs:
+        g = result_map.get(t._uid)
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                "One of the differentiated tensors appears to not have been "
+                "used in the graph (set allow_unused=True to allow this).")
+        out.append(None if g is None else _wrap(g))
+    return out
+
+
+def _seed_grads(tensors, grad_tensors):
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    grads: dict[int, jax.Array] = {}
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            g_arr = jnp.ones(t.shape, t._value.dtype)
+        else:
+            g_arr = g._value if hasattr(g, "_value") else jnp.asarray(g)
+        grads[t._uid] = grads.get(t._uid, 0) + g_arr
+    return grads
+
+
+def _sweep(tape, grads, accumulate_leaves, wanted=None, visited=None):
+    """Reverse sweep over tape nodes, returning the final grad map.
+    Grad bookkeeping is keyed by tensor uid (monotonic, never reused — id()
+    can be recycled by the allocator mid-training-loop)."""
+    from paddle_tpu.core.tensor import Tensor
+
+    produced = {uid: n for n in tape.nodes for uid in n.out_uids}
+    result: dict[int, jax.Array] = {}
+    for node in reversed(tape.nodes):
+        if not any(uid in grads for uid in node.out_uids):
+            continue
+        if visited is not None:
+            visited.add(id(node))
+        cotangents = []
+        for uid, (shape, dtype) in zip(node.out_uids, node.out_avals):
+            g = grads.get(uid)
+            if g is None:
+                g = _zero_cotangent(shape, dtype)
+            else:
+                g = jnp.asarray(g, dtype) if jnp.issubdtype(
+                    dtype, jnp.inexact) else g
+            cotangents.append(g)
+        in_grads = node.vjp_fn(tuple(cotangents))
+        for t, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            for hook in getattr(t, "_grad_hooks", ()):
+                res = hook(_wrap(g))
+                if res is not None:
+                    g = res._value if isinstance(res, Tensor) else jnp.asarray(res)
+            if t._uid in grads:
+                grads[t._uid] = grads[t._uid] + g
+            else:
+                grads[t._uid] = g
+            is_leaf = t._uid not in produced
+            if wanted is not None and t._uid in wanted:
+                result[t._uid] = grads[t._uid]
+            if accumulate_leaves and is_leaf and not t.stop_gradient:
+                if t.grad is None:
+                    t._grad = _wrap(grads[t._uid])
+                else:
+                    t._grad._value = t._grad._value + g
+    if wanted is None:
+        return grads
+    return result
+
+
+def _wrap(arr):
+    from paddle_tpu.core.tensor import Tensor
+    t = Tensor(arr, stop_gradient=True)
+    return t

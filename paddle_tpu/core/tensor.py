@@ -1,0 +1,319 @@
+"""The paddle_tpu Tensor: a paddle-flavoured eager handle over jax.Array.
+
+TPU-native rebuild of the reference's eager Tensor (reference:
+paddle/fluid/pybind/eager.cc Tensor type + eager_method.cc tensor methods;
+phi::DenseTensor paddle/phi/core/dense_tensor.h:37). Instead of a C++ tensor
+with allocations and a pybind bridge, this wraps an immutable `jax.Array`
+(device memory managed by PjRt) plus the eager-mode bookkeeping the array
+itself cannot carry: stop_gradient, accumulated .grad, hooks, name, and an
+inplace version counter (reference: tensor_wrapper.h inplace version checks).
+
+Tensor is registered as a jax pytree node so `jax.jit`-traced functions can
+take and return Tensors directly (the to_static bridge, SURVEY.md §3.3).
+
+Most numeric methods (reshape/sum/matmul/...) are monkey-patched onto this
+class by paddle_tpu.tensor (mirroring the reference's monkey_patch_math_tensor
+pattern in python/paddle/tensor/__init__.py) to keep this module cycle-free.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtypes
+from paddle_tpu.core.tape import backward as _tape_backward
+
+_tensor_counter = [0]
+
+
+class Tensor:
+    __slots__ = ("_value", "_stop_gradient", "_grad", "_grad_hooks", "name",
+                 "_version", "persistable", "_uid", "__weakref__")
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None):
+        dt = dtypes.convert_dtype(dtype)
+        if isinstance(data, Tensor):
+            arr = data._value
+            if dt is not None and arr.dtype != dt:
+                arr = arr.astype(dt)
+        elif isinstance(data, jax.Array) or isinstance(data, jax.core.Tracer):
+            arr = data if dt is None or data.dtype == dt else data.astype(dt)
+        else:
+            arr = jnp.asarray(data, dtype=dt)
+        self._value = arr
+        self._stop_gradient = bool(stop_gradient)
+        self._grad = None
+        self._grad_hooks = []
+        self._version = 0
+        self.persistable = False
+        _tensor_counter[0] += 1
+        self._uid = _tensor_counter[0]
+        if name is None:
+            name = f"generated_tensor_{self._uid}"
+        self.name = name
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    # paddle calls this .rank in places
+    @property
+    def rank(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def place(self):
+        try:
+            devs = self._value.devices()
+            return next(iter(devs))
+        except Exception:
+            return None
+
+    @property
+    def stop_gradient(self):
+        return self._stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._stop_gradient = bool(v)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g
+
+    @property
+    def is_leaf(self):
+        return True  # refined by tape bookkeeping; leaves are the common case
+
+    @property
+    def T(self):
+        from paddle_tpu import tensor as T
+        return T.transpose(self, list(range(self.ndim))[::-1])
+
+    # -- conversion --------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return np.asarray(self._value).item(*args)
+        return np.asarray(self._value).item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def astype(self, dtype):
+        from paddle_tpu.tensor.manipulation import cast
+        return cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def __dlpack__(self, *a, **k):
+        return self._value.__dlpack__(*a, **k)
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        _tape_backward([self], [grad_tensor] if grad_tensor is not None else None,
+                       retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            self._grad._value = jnp.zeros_like(self._grad._value)
+        else:
+            self._grad = None
+
+    def register_hook(self, hook):
+        self._grad_hooks.append(hook)
+
+        class _Removable:
+            def remove(_s):
+                try:
+                    self._grad_hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Removable()
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name + "_detached")
+        return t
+
+    def detach_(self):
+        self._stop_gradient = True
+        return self
+
+    def clone(self):
+        from paddle_tpu.tensor.manipulation import clone
+        return clone(self)
+
+    # -- mutation (eager-only; bumps version counter) ----------------------
+    def set_value(self, value):
+        """Replace the underlying buffer in place (reference:
+        eager_method.cc set_value). Allowed on leaves / under no_grad."""
+        arr = value._value if isinstance(value, Tensor) else jnp.asarray(
+            value, dtype=self._value.dtype)
+        if tuple(arr.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {arr.shape} vs {self._value.shape}")
+        self._value = arr.astype(self._value.dtype)
+        self._version += 1
+
+    def _inplace_assign(self, new_value_tensor):
+        from paddle_tpu.core.tape import grad_enabled
+        if grad_enabled() and (not self._stop_gradient
+                               or not new_value_tensor.stop_gradient):
+            # Rebinding the buffer would detach this tensor from the tape
+            # node that produced new_value, silently dropping gradients
+            # (reference guards this with inplace version checks,
+            # tensor_wrapper.h). Fail loudly instead.
+            raise RuntimeError(
+                "in-place operation on a tensor that requires grad is not "
+                "supported on the eager tape; use the out-of-place variant "
+                "or wrap the mutation in paddle_tpu.no_grad()")
+        self._value = new_value_tensor._value
+        self._version += 1
+        return self
+
+    def copy_(self, other):
+        self.set_value(other)
+        return self
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        self._version += 1
+        return self
+
+    def fill_(self, v):
+        self._value = jnp.full_like(self._value, v)
+        self._version += 1
+        return self
+
+    # -- python protocol ---------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __float__(self):
+        return float(np.asarray(self._value))
+
+    def __int__(self):
+        return int(np.asarray(self._value))
+
+    def __bool__(self):
+        return bool(np.asarray(self._value))
+
+    def __index__(self):
+        return int(np.asarray(self._value))
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return format(str(self), spec)
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        sg = self._stop_gradient
+        try:
+            data = np.asarray(self._value)
+            body = np.array2string(data, precision=6, separator=", ")
+        except Exception:
+            body = f"<traced {self._value}>"
+        return (f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}, "
+                f"stop_gradient={sg},\n       {body})")
+
+    __str__ = __repr__
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: python/paddle/base/framework.py
+    EagerParamBase). stop_gradient defaults to False; `trainable` mirrors it."""
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.persistable = True
+
+    @property
+    def trainable(self):
+        return not self._stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self._stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def _tensor_flatten(t: Tensor):
+    return (t._value,), (t._stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children, cls=None):
+    sg, name = aux
+    t = (cls or Tensor).__new__(cls or Tensor)
+    t._value = children[0]
+    t._stop_gradient = sg
+    t._grad = None
+    t._grad_hooks = []
+    t._version = 0
+    t.persistable = cls is Parameter
+    _tensor_counter[0] += 1
+    t._uid = _tensor_counter[0]
+    t.name = name
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda p: ((p._value,), (p._stop_gradient, p.name)),
+    lambda aux, ch: _tensor_unflatten(aux, ch, cls=Parameter),
+)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor equivalent (reference:
+    python/paddle/tensor/creation.py to_tensor)."""
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,103 @@
+"""Device management (reference: python/paddle/device/__init__.py).
+
+Placement is owned by PjRt/XLA; these APIs report the TPU topology instead
+of steering allocations. CUDA/XPU/custom-device predicates exist for API
+parity and report False — there is exactly one backend family here: XLA
+(tpu on hardware, cpu for tests).
+"""
+from __future__ import annotations
+
+import jax
+
+_current_device = [None]
+
+
+def get_all_devices():
+    return jax.devices()
+
+
+def device_count():
+    return jax.device_count()
+
+
+def local_device_count():
+    return jax.local_device_count()
+
+
+def set_device(device):
+    _current_device[0] = device
+    return device
+
+
+def get_device():
+    if _current_device[0] is not None:
+        return _current_device[0]
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_custom_device(name=None):
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def is_compiled_with_tpu():
+    return any(d.platform in ("tpu", "axon") for d in jax.devices())
+
+
+class cuda:
+    """Namespace shim for paddle.device.cuda."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def is_available():
+        return False
+
+
+def synchronize(device=None):
+    import jax
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+class Stream:
+    """No-op stream shim: XLA orders execution itself; exposed for API
+    parity with paddle.device.Stream."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+
+class Event:
+    def __init__(self, enable_timing=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def synchronize(self):
+        synchronize()

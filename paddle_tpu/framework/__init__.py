@@ -1,0 +1,15 @@
+"""Framework-level state (reference: python/paddle/framework/)."""
+from __future__ import annotations
+
+import numpy as np
+
+_default_dtype = [np.dtype("float32")]
+
+
+def get_default_dtype():
+    return _default_dtype[0]
+
+
+def set_default_dtype(d):
+    from paddle_tpu.core.dtype import convert_dtype
+    _default_dtype[0] = convert_dtype(d)

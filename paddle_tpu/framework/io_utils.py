@@ -1,0 +1,88 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:721,960).
+
+Same contract as the reference: pickle container structure, tensors
+serialized as numpy arrays, nested state_dicts supported. bfloat16 arrays
+round-trip via ml_dtypes (numpy can't natively serialize bf16 through
+pickle's dtype descr, so we tag and reconstruct).
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, Parameter
+
+
+class _TensorPayload:
+    """Pickle-stable tensor representation."""
+
+    def __init__(self, t: Tensor):
+        arr = np.asarray(t._value)
+        self.dtype_name = str(t._value.dtype)
+        if arr.dtype.kind == "V" or "bfloat16" in self.dtype_name or \
+                "float8" in self.dtype_name:
+            self.data = arr.astype(np.float32)
+        else:
+            self.data = arr
+        self.stop_gradient = t.stop_gradient
+        self.is_parameter = isinstance(t, Parameter)
+        self.name = t.name
+
+    def restore(self):
+        from paddle_tpu.core.dtype import convert_dtype
+        arr = jnp.asarray(self.data)
+        target = convert_dtype(self.dtype_name)
+        if arr.dtype != target:
+            arr = arr.astype(target)
+        if self.is_parameter:
+            t = Parameter(arr, name=self.name,
+                          trainable=not self.stop_gradient)
+        else:
+            t = Tensor(arr, stop_gradient=self.stop_gradient, name=self.name)
+        return t
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        t = obj.restore()
+        return t.numpy() if return_numpy else t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    if hasattr(path, "write"):
+        pickle.dump(_pack(obj), path, protocol=protocol)
+        return
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    return_numpy = configs.get("return_numpy", False)
+    if hasattr(path, "read"):
+        return _unpack(pickle.load(path), return_numpy)
+    with open(path, "rb") as f:
+        return _unpack(pickle.load(f), return_numpy)

@@ -1,0 +1,97 @@
+"""paddle_tpu.jit (reference: python/paddle/jit/__init__.py).
+
+to_static -> jax.jit tracing (jit/api.py); save/load -> StableHLO export
+(replacing the reference's translated_layer.py + paddle/fluid/jit/ C++
+deployment engine — a serialized StableHLO module is directly loadable by
+any XLA runtime, which is the TPU-native deployment story, SURVEY.md §2.7
+"Inference engine").
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+from paddle_tpu.jit.api import (to_static, not_to_static, StaticFunction,
+                                InputSpec, enable_to_static, ignore_module)
+from paddle_tpu.jit.functional import functional_call, state_arrays, state_tensors
+from paddle_tpu.core.tensor import Tensor
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export a Layer (or StaticFunction) for deployment.
+
+    Produces `path.pdmodel` (serialized StableHLO via jax.export) and
+    `path.pdiparams` (state dict pickle) — same two-artifact layout as the
+    reference (reference: python/paddle/jit/api.py save), different format.
+    """
+    from paddle_tpu.nn.layer.layers import Layer
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec on paddle_tpu")
+    specs = [s if isinstance(s, InputSpec) else InputSpec(s.shape, s.dtype)
+             for s in input_spec]
+
+    fn = layer.forward if isinstance(layer, Layer) else layer
+    target = layer if isinstance(layer, Layer) else None
+    state = state_arrays(target) if target is not None else {}
+
+    def pure(state_, *xs):
+        ts = [Tensor(x) for x in xs]
+        if target is not None:
+            from paddle_tpu.jit.functional import _swapped
+            from paddle_tpu.core.tape import no_grad
+            with no_grad(), _swapped(target, state_):
+                out = target.forward(*ts) if not isinstance(fn, StaticFunction) \
+                    else fn._fn(*ts)
+        else:
+            out = fn(*ts)
+        return jax.tree.map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    from paddle_tpu.core.dtype import convert_dtype
+    shaped = [jax.ShapeDtypeStruct(
+        tuple(d if d != -1 else 1 for d in s.shape),
+        convert_dtype(s.dtype)) for s in specs]
+    exported = jax.export.export(jax.jit(pure))(state, *shaped)
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    from paddle_tpu.framework.io_utils import save as _save
+    if target is not None:
+        _save(target.state_dict(), path + ".pdiparams")
+
+
+class TranslatedLayer:
+    """Loaded deployable program (reference: translated_layer.py)."""
+
+    def __init__(self, exported, state):
+        self._exported = exported
+        self._state = state
+
+    def __call__(self, *args):
+        arrays = [a._value if isinstance(a, Tensor) else np.asarray(a)
+                  for a in args]
+        out = self._exported.call(self._state, *arrays)
+        return jax.tree.map(Tensor, out)
+
+    def forward(self, *args):
+        return self(*args)
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    state = {}
+    if os.path.exists(path + ".pdiparams"):
+        from paddle_tpu.framework.io_utils import load as _load
+        sd = _load(path + ".pdiparams")
+        state = {k: v._value if isinstance(v, Tensor) else np.asarray(v)
+                 for k, v in sd.items()}
+    return TranslatedLayer(exported, state)

@@ -1,0 +1,238 @@
+"""@to_static: compile imperative code into one XLA program.
+
+TPU-native replacement for the reference's entire dy2static stack
+(reference: python/paddle/jit/api.py:171 to_static; the SOT bytecode tracer
+sot/opcode_translator/executor/opcode_executor.py:303 with its CPython
+frame-eval hook pybind/eval_frame.c:38; the AST transpiler
+dy2static/program_translator.py:325; PIR program construction and the
+PirInterpreter). Per SURVEY.md §3.3 all of that collapses to `jax.jit`
+tracing: guards == jit's shape/dtype cache keys, graph breaks don't exist
+(tracing is complete), and the executor is XLA.
+
+Autograd contract: calling a StaticFunction in a grad-enabled context
+records the WHOLE traced program as a single tape op whose vjp is the
+XLA-compiled backward (jax.vjp of the pure function). loss.backward()
+through a to_static model is therefore one fused forward + one fused
+backward executable — the reference's interpreter replays op-by-op instead.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+import numpy as np
+import jax
+
+from paddle_tpu.core.tape import no_grad, push_tape, pop_tape
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.functional import _swapped, state_tensors
+
+_tracing = threading.local()
+
+
+def _in_tracing() -> bool:
+    return getattr(_tracing, "depth", 0) > 0
+
+
+class InputSpec:
+    """Shape/dtype declaration (reference: python/paddle/static/input_spec.py).
+    Dims of -1 ("dynamic") are accepted; jit simply retraces per concrete
+    shape (XLA wants static shapes — SURVEY.md §7 design stance)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _find_layer(fn):
+    from paddle_tpu.nn.layer.layers import Layer
+    if isinstance(fn, Layer):
+        return fn, fn.forward
+    owner = getattr(fn, "__self__", None)
+    if owner is not None and isinstance(owner, Layer):
+        return owner, fn
+    return None, fn
+
+
+def _isdiff(dtype):
+    import jax.numpy as jnp
+    return jnp.issubdtype(dtype, jnp.inexact)
+
+
+def _is_arr(x):
+    return isinstance(x, (jax.Array, np.ndarray))
+
+
+class StaticFunction:
+    """The compiled callable returned by @to_static."""
+
+    def __init__(self, fn, input_spec=None, build_strategy=None,
+                 backend=None, full_graph=True):
+        self._layer, self._fn = _find_layer(fn)
+        self._input_spec = input_spec
+        self._jit_cache = {}
+        self._out_treedefs = {}
+        functools.update_wrapper(self, self._fn)
+
+    # ---- tracing body ----------------------------------------------------
+    def _run_traced(self, state, dyn_arrays, key):
+        """Body executed under jax.jit: rebuild Tensor args, run the python
+        function, return flat output arrays."""
+        treedef, static_leaves, dyn_idx, sg_flags = key
+        leaves = dict(static_leaves)
+        for i, a in zip(dyn_idx, dyn_arrays):
+            leaves[i] = a
+        sg = dict(sg_flags)
+        ordered = []
+        for i in sorted(leaves):
+            l = leaves[i]
+            if _is_arr(l) or hasattr(l, "aval"):
+                ordered.append(Tensor(l, stop_gradient=sg.get(i, True)))
+            else:
+                ordered.append(l)
+        args, kwargs = jax.tree.unflatten(treedef, ordered)
+
+        _tracing.depth = getattr(_tracing, "depth", 0) + 1
+        prev = push_tape()
+        try:
+            with no_grad():
+                if self._layer is not None:
+                    with _swapped(self._layer, state):
+                        out = self._fn(*args, **kwargs)
+                else:
+                    out = self._fn(*args, **kwargs)
+        finally:
+            pop_tape(prev)
+            _tracing.depth -= 1
+
+        flat, out_treedef = jax.tree.flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+        arrays = [f._value if isinstance(f, Tensor) else f for f in flat]
+        self._out_treedefs[key] = out_treedef
+        return tuple(arrays)
+
+    def _get_jitted(self, key):
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(
+                lambda state, dyn: self._run_traced(state, dyn, key))
+        return self._jit_cache[key]
+
+    # ---- call ------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        from paddle_tpu.core.tape import grad_enabled, TapeNode, current_tape
+
+        leaves, treedef = jax.tree.flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        arr_leaves = []
+        sg_flags = []
+        for i, l in enumerate(leaves):
+            if isinstance(l, Tensor):
+                arr_leaves.append(l._value)
+                sg_flags.append((i, l.stop_gradient))
+            else:
+                arr_leaves.append(l)
+
+        dyn_idx = tuple(i for i, a in enumerate(arr_leaves) if _is_arr(a))
+        static_leaves = tuple((i, a) for i, a in enumerate(arr_leaves)
+                              if i not in set(dyn_idx))
+        key = (treedef, static_leaves, dyn_idx, tuple(sg_flags))
+        jitted = self._get_jitted(key)
+        dyn_vals = [arr_leaves[i] for i in dyn_idx]
+
+        state_t = state_tensors(self._layer) if self._layer is not None else {}
+        state = {k: t._value for k, t in state_t.items()}
+
+        # which inputs require grad
+        tensor_by_leaf = {i: l for i, l in enumerate(leaves)
+                          if isinstance(l, Tensor)}
+        diff_dyn_pos = [p for p, i in enumerate(dyn_idx)
+                        if i in tensor_by_leaf
+                        and not tensor_by_leaf[i].stop_gradient
+                        and _isdiff(arr_leaves[i].dtype)]
+        diff_in = [tensor_by_leaf[dyn_idx[p]] for p in diff_dyn_pos]
+        diff_names = [k for k, t in state_t.items()
+                      if not t.stop_gradient and _isdiff(t._value.dtype)]
+        need_grad = grad_enabled() and (diff_in or diff_names)
+
+        if not need_grad:
+            out_arrays = jitted(state, dyn_vals)
+            return self._unflatten_out(key, out_arrays)
+
+        def g(diff_state, diff_arrs):
+            full_state = dict(state)
+            full_state.update(diff_state)
+            dv = list(dyn_vals)
+            for p, a in zip(diff_dyn_pos, diff_arrs):
+                dv[p] = a
+            return jitted(full_state, dv)
+
+        out_arrays, vjp_fn = jax.vjp(
+            g, {k: state[k] for k in diff_names},
+            [t._value for t in diff_in])
+
+        out = self._unflatten_out(key, out_arrays, stop_gradient=False)
+        out_tensors = [o for o in jax.tree.leaves(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+            if isinstance(o, Tensor)]
+
+        def tape_vjp(cotangents):
+            gs, gi = vjp_fn(tuple(cotangents))
+            return [gs[k] for k in diff_names] + list(gi)
+
+        node = TapeNode(
+            "to_static",
+            inputs=[state_t[k] for k in diff_names] + diff_in,
+            outputs=out_tensors, vjp_fn=tape_vjp,
+            out_avals=[(a.shape, a.dtype) for a in out_arrays])
+        current_tape().record(node)
+        return out
+
+    def _unflatten_out(self, key, out_arrays, stop_gradient=True):
+        td = self._out_treedefs.get(key)
+        wrapped = [Tensor(a, stop_gradient=stop_gradient)
+                   if _is_arr(a) else a for a in out_arrays]
+        if td is None:
+            return wrapped[0] if len(wrapped) == 1 else tuple(wrapped)
+        return jax.tree.unflatten(td, wrapped)
+
+    # paddle API parity helpers
+    @property
+    def function(self):
+        return self._fn
+
+    def rollback(self):
+        return self._fn
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Reference: python/paddle/jit/api.py:171."""
+
+    def deco(fn):
+        from paddle_tpu.nn.layer.layers import Layer
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn, input_spec=input_spec)
+            fn.forward = sf
+            return fn
+        return StaticFunction(fn, input_spec=input_spec)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+def enable_to_static(flag=True):
+    return None

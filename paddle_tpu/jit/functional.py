@@ -1,0 +1,62 @@
+"""Functional bridge: stateful Layers <-> pure functions.
+
+This is the load-bearing piece of the TPU design (SURVEY.md §3.3): the
+reference needs an AST/bytecode translator (SOT, reference:
+python/paddle/jit/sot/ + paddle/fluid/pybind/eval_frame.c:127) to capture
+imperative programs into its IR. Here capture is jax tracing; the only
+machinery needed is swapping a Layer's Parameters for traced values for the
+duration of the trace — ~60 lines instead of a symbolic bytecode interpreter.
+
+`functional_call(layer, state, *args)` runs layer.forward with parameters
+and buffers taken from `state` (a flat dict name -> array), recording
+nothing on the eager tape. It is the foundation of to_static, of the jitted
+train step, and of every parallel transform (shard_map/pjit see only pure
+functions).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+import jax
+
+from paddle_tpu.core.tape import no_grad, push_tape, pop_tape
+from paddle_tpu.core.tensor import Tensor
+
+
+def state_tensors(layer) -> dict[str, Tensor]:
+    """Flat dict of all parameters and buffers, keyed by qualified name."""
+    out = dict(layer.named_parameters())
+    for name, buf in layer.named_buffers():
+        out[name] = buf
+    return out
+
+
+def state_arrays(layer) -> dict[str, jax.Array]:
+    return {k: t._value for k, t in state_tensors(layer).items()}
+
+
+@contextlib.contextmanager
+def _swapped(layer, arrays: dict[str, Any]):
+    tensors = state_tensors(layer)
+    saved = {}
+    try:
+        for name, arr in arrays.items():
+            t = tensors[name]
+            saved[name] = t._value
+            t._value = arr
+        yield
+    finally:
+        for name, arr in saved.items():
+            tensors[name]._value = arr
+
+
+def functional_call(layer, state: dict[str, Any], *args, **kwargs):
+    """Pure-functional forward: returns raw outputs with `state` in place of
+    the layer's own parameter values. Safe under jax tracing."""
+    prev = push_tape()
+    try:
+        with no_grad(), _swapped(layer, state):
+            return layer(*args, **kwargs)
+    finally:
+        pop_tape(prev)

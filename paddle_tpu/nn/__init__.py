@@ -1,0 +1,44 @@
+"""paddle_tpu.nn (reference: python/paddle/nn/__init__.py)."""
+from paddle_tpu.nn.layer.layers import Layer, ParamAttr  # noqa: F401
+from paddle_tpu.nn.layer.common import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.conv_pool import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.norm import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.activation import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.loss import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.container import (  # noqa: F401
+    Sequential, LayerList, LayerDict, ParameterList)
+from paddle_tpu.nn.layer.transformer import (  # noqa: F401
+    MultiHeadAttention, Transformer, TransformerEncoder,
+    TransformerEncoderLayer, TransformerDecoder, TransformerDecoderLayer)
+from paddle_tpu.nn.layer.rnn import (  # noqa: F401
+    SimpleRNNCell, LSTMCell, GRUCell, SimpleRNN, LSTM, GRU, RNN, BiRNN,
+    RNNCellBase)
+from paddle_tpu.nn import functional  # noqa: F401
+from paddle_tpu.nn import initializer  # noqa: F401
+from paddle_tpu.core.tensor import Parameter  # noqa: F401
+
+
+class ClipGradByNorm:
+    """Reference: python/paddle/nn/clip.py ClipGradByNorm."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+
+class ClipGradByValue:
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = min if min is not None else -max
+
+
+class ClipGradByGlobalNorm:
+    """Reference: python/paddle/nn/clip.py ClipGradByGlobalNorm:
+    scale all grads by clip_norm/global_norm when exceeded. The actual
+    clipping happens inside Optimizer.step (like the reference's
+    _dygraph_clip), and inside the fused jit train step for the compiled
+    path. Under hybrid parallel, the global norm is computed across all
+    shards (GSPMD reduces automatically for sharded grads)."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = clip_norm

@@ -1,0 +1,10 @@
+"""paddle_tpu.nn.functional (reference: python/paddle/nn/functional/__init__.py)."""
+from paddle_tpu.nn.functional.activation import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.common import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.conv import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.pooling import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.norm import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.loss import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.attention import (  # noqa: F401
+    scaled_dot_product_attention, flash_attention)
+from paddle_tpu.tensor.manipulation import pad  # noqa: F401

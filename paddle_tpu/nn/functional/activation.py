@@ -1,0 +1,221 @@
+"""Activation functions (reference: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+
+
+@defop("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@defop("relu6")
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+@defop("relu_")
+def _relu_inplace(x):
+    return jax.nn.relu(x)
+
+
+def relu_(x):
+    return x._inplace_assign(_relu_inplace(x))
+
+
+@defop("gelu")
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=bool(approximate))
+
+
+@defop("silu")
+def silu(x):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+@defop("sigmoid_act")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@defop("hardsigmoid")
+def hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@defop("hardswish")
+def hardswish(x):
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+@defop("hardtanh")
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@defop("hardshrink")
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@defop("softshrink")
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@defop("tanhshrink")
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@defop("leaky_relu")
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@defop("elu")
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def elu_(x, alpha=1.0):
+    return x._inplace_assign(elu(x, alpha))
+
+
+@defop("selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@defop("celu")
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+@defop("prelu_op")
+def _prelu(x, weight, data_format="NCHW"):
+    if weight.size == 1:
+        w = weight.reshape(())
+    else:
+        shape = [1] * x.ndim
+        ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+        shape[ch_axis] = weight.size
+        w = weight.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    return _prelu(x, weight, data_format=data_format)
+
+
+@defop("rrelu", differentiable=True)
+def rrelu(x, lower=0.125, upper=0.3333333, training=True):
+    slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@defop("softmax", amp_policy="black")
+def _softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from paddle_tpu.tensor.manipulation import cast
+        x = cast(x, dtype)
+    return _softmax(x, axis=axis)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._inplace_assign(softmax(x, axis, dtype))
+
+
+@defop("log_softmax", amp_policy="black")
+def _log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from paddle_tpu.tensor.manipulation import cast
+        x = cast(x, dtype)
+    return _log_softmax(x, axis=axis)
+
+
+@defop("softplus")
+def softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(x * beta > threshold, x,
+                     jax.nn.softplus(x * beta) / beta)
+
+
+@defop("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@defop("mish")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@defop("maxout")
+def maxout(x, groups, axis=1):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+@defop("glu")
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@defop("tanh_act")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@defop("thresholded_relu")
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+@defop("log_sigmoid", amp_policy="black")
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@defop("gumbel_softmax_impl")
+def _gumbel_softmax(x, key, temperature=1.0, hard=False, axis=-1):
+    g = jax.random.gumbel(key, x.shape,
+                          x.dtype if x.dtype in (jnp.float32, jnp.bfloat16,
+                                                 jnp.float16) else jnp.float32)
+    y = jax.nn.softmax((x + g.astype(x.dtype)) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        dims = [jnp.broadcast_to(
+            jnp.arange(y.shape[d]).reshape(
+                [-1 if i == d else 1 for i in range(y.ndim)]), idx.shape)
+            for d in range(y.ndim)]
+        dims[axis % y.ndim] = idx
+        y_hard = y_hard.at[tuple(dims)].set(1.0)
+        # straight-through estimator
+        y = jax.lax.stop_gradient(y_hard - y) + y
+    return y
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from paddle_tpu.core.random import next_key
+    return _gumbel_softmax(x, next_key(), temperature=temperature, hard=hard,
+                           axis=axis)

@@ -1,0 +1,94 @@
+"""Attention ops.
+
+Reference surface: paddle.nn.functional.scaled_dot_product_attention +
+flash_attention (reference: python/paddle/nn/functional/flash_attention.py,
+kernels at phi/kernels/gpu/flash_attn_kernel.cu wrapping the vendored FA2
+library). TPU-native: the default path is an XLA-fused SDPA; the Pallas
+flash kernel (paddle_tpu.kernels.flash_attention) is used for long
+sequences, where materializing the (S, S) score matrix would blow HBM.
+
+Layout note: paddle flash_attention takes (batch, seqlen, num_heads,
+head_dim) — kept here.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+
+
+def _sdpa_ref(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+              scale=None):
+    # q,k,v: (B, S, H, D) -> compute in (B, H, S, D)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    # GQA: repeat kv heads if fewer than q heads
+    hq, hk = qt.shape[1], kt.shape[1]
+    if hk != hq:
+        rep = hq // hk
+        kt = jnp.repeat(kt, rep, axis=1)
+        vt = jnp.repeat(vt, rep, axis=1)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt,
+                        preferred_element_type=jnp.float32) * s
+    if is_causal:
+        ql, kl = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            scores = jnp.where(attn_mask, scores, -jnp.inf)
+        else:
+            scores = scores + attn_mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1).astype(qt.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+@defop("scaled_dot_product_attention", amp_policy="white",
+       spmd_note="heads shard over 'mp'; seq shards need ring attention "
+                 "(paddle_tpu.distributed.ring_attention)")
+def _sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+          scale=None):
+    return _sdpa_ref(q, k, v, attn_mask, dropout_p, is_causal, scale)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    return _sdpa(query, key, value, attn_mask, dropout_p=dropout_p,
+                 is_causal=is_causal)
+
+
+@defop("flash_attention_op", amp_policy="white")
+def _flash_attention(q, k, v, dropout=0.0, causal=False):
+    from paddle_tpu.kernels import flash_attention as fa
+    return fa.flash_attention_bshd(q, k, v, causal=causal)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """Reference: python/paddle/nn/functional/flash_attention.py
+    flash_attention. Returns (out, softmax_lse-placeholder) like the
+    reference's (out, softmax) pair."""
+    try:
+        out = _flash_attention(query, key, value, dropout=dropout,
+                               causal=causal)
+    except Exception:
+        out = _sdpa(query, key, value, None, dropout_p=dropout,
+                    is_causal=causal)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(*args, **kwargs):
+    raise NotImplementedError(
+        "varlen flash attention: use dense batches + masks on TPU (static "
+        "shapes); ragged support arrives with the Pallas splash kernel")

@@ -1,0 +1,345 @@
+"""Common NN functional ops (reference: python/paddle/nn/functional/common.py,
+input.py, extension.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core.random import next_key
+from paddle_tpu.core import dtype as dtypes
+
+
+@defop("linear", amp_policy="white",
+       spmd_note="weight (in,out): shard out over 'mp' for column-parallel, "
+                 "in for row-parallel (reference: fleet/layers/mpu/mp_layers.py)")
+def _linear(x, weight, bias=None):
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def linear(x, weight, bias=None, name=None):
+    return _linear(x, weight, bias)
+
+
+@defop("embedding_op",
+       spmd_note="vocab-sharded embedding = gather + psum over 'mp' "
+                 "(reference: c_embedding_kernel)")
+def _embedding(x, weight, padding_idx=None):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return _embedding(x, weight, padding_idx=padding_idx)
+
+
+@defop("one_hot_op", differentiable=False)
+def _one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+def one_hot(x, num_classes, name=None):
+    return _one_hot(x, num_classes=int(num_classes))
+
+
+@defop("dropout_op")
+def _dropout(x, key, p=0.5, training=True, mode="upscale_in_train"):
+    if not training or p == 0.0:
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x
+    if axis is not None:
+        return _dropout_axis(x, next_key(), p=p,
+                             axis=tuple(axis) if isinstance(axis, (list, tuple))
+                             else (axis,), mode=mode)
+    return _dropout(x, next_key(), p=p, training=training, mode=mode)
+
+
+@defop("dropout_axis")
+def _dropout_axis(x, key, p=0.5, axis=(0,), mode="upscale_in_train"):
+    keep = 1.0 - p
+    mask_shape = tuple(s if i in axis else 1 for i, s in enumerate(x.shape))
+    mask = jax.random.bernoulli(key, keep, mask_shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    if not training or p == 0.0:
+        return x
+    ax = (0, 1) if data_format == "NCHW" else (0, 3)
+    return _dropout_axis(x, next_key(), p=p, axis=ax)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    if not training or p == 0.0:
+        return x
+    ax = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return _dropout_axis(x, next_key(), p=p, axis=ax)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    return _alpha_dropout(x, next_key(), p=p)
+
+
+@defop("alpha_dropout_op")
+def _alpha_dropout(x, key, p=0.5):
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = 1.0 - p
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+
+
+@defop("normalize_op")
+def _normalize(x, p=2, axis=1, epsilon=1e-12):
+    norm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                             keepdims=True), 1.0 / p)
+    return x / jnp.maximum(norm, epsilon)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _normalize(x, p=p, axis=axis, epsilon=epsilon)
+
+
+@defop("cosine_similarity")
+def _cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return _cosine_similarity(x1, x2, axis=axis, eps=eps)
+
+
+@defop("bilinear_op", amp_policy="white")
+def _bilinear(x1, x2, weight, bias=None):
+    # weight: (out_features, in1, in2)
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    return _bilinear(x1, x2, weight, bias)
+
+
+# ---------------------------------------------------------------------------
+# interpolate / upsample
+# ---------------------------------------------------------------------------
+@defop("interpolate_op")
+def _interpolate(x, size, mode="nearest", align_corners=False,
+                 data_format="NCHW"):
+    # normalize to channel-last for jax.image, then back
+    if data_format in ("NCHW", "NCDHW", "NCW"):
+        spatial = x.shape[2:]
+        perm_in = (0,) + tuple(range(2, x.ndim)) + (1,)
+        xi = jnp.transpose(x, perm_in)
+    else:
+        spatial = x.shape[1:-1]
+        xi = x
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    out_shape = (xi.shape[0],) + tuple(size) + (xi.shape[-1],)
+    out = jax.image.resize(xi.astype(jnp.float32), out_shape, method=jmode
+                           ).astype(x.dtype)
+    if data_format in ("NCHW", "NCDHW", "NCW"):
+        nd = out.ndim
+        perm_out = (0, nd - 1) + tuple(range(1, nd - 1))
+        out = jnp.transpose(out, perm_out)
+    return out
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    nd = x.ndim - 2
+    if size is None:
+        if scale_factor is None:
+            raise ValueError("one of size / scale_factor required")
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * nd
+        spatial = x.shape[2:] if data_format.startswith("NC") else x.shape[1:-1]
+        size = [int(s * f) for s, f in zip(spatial, sf)]
+    size = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in
+            (size if isinstance(size, (list, tuple)) else [size] * nd)]
+    return _interpolate(x, size=tuple(size), mode=mode,
+                        align_corners=align_corners, data_format=data_format)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+@defop("pixel_shuffle")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        oc = c // (r * r)
+        x = x.reshape(n, oc, r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, oc, h * r, w * r)
+    n, h, w, c = x.shape
+    oc = c // (r * r)
+    x = x.reshape(n, h, w, r, r, oc)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h * r, w * r, oc)
+
+
+@defop("pixel_unshuffle")
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return x.reshape(n, c * r * r, h // r, w // r)
+    raise NotImplementedError
+
+
+@defop("channel_shuffle")
+def channel_shuffle(x, groups, data_format="NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, groups, c // groups, h, w)
+        x = jnp.swapaxes(x, 1, 2)
+        return x.reshape(n, c, h, w)
+    raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# unfold / fold (im2col)
+# ---------------------------------------------------------------------------
+@defop("unfold_op")
+def _unfold(x, kernel_sizes, strides, paddings, dilations):
+    n, c, h, w = x.shape
+    kh, kw = kernel_sizes
+    sh, sw = strides
+    ph, pw = paddings[0], paddings[1]
+    dh, dw = dilations
+    x = jnp.pad(x, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            sl = x[:, :, i * dh:i * dh + oh * sh:sh, j * dw:j * dw + ow * sw:sw]
+            patches.append(sl)
+    out = jnp.stack(patches, axis=2)  # n, c, kh*kw, oh, ow
+    return out.reshape(n, c * kh * kw, oh * ow)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+    return _unfold(x, kernel_sizes=_pair(kernel_sizes),
+                   strides=_pair(strides), paddings=_pair(paddings),
+                   dilations=_pair(dilations))
+
+
+@defop("fold_op")
+def _fold(x, output_sizes, kernel_sizes, strides, paddings, dilations):
+    n, ckk, l = x.shape
+    kh, kw = kernel_sizes
+    c = ckk // (kh * kw)
+    oh_t, ow_t = output_sizes
+    sh, sw = strides
+    ph, pw = paddings
+    dh, dw = dilations
+    oh = (oh_t + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (ow_t + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    xr = x.reshape(n, c, kh, kw, oh, ow)
+    out = jnp.zeros((n, c, oh_t + 2 * ph, ow_t + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, :, i * dh:i * dh + oh * sh:sh,
+                         j * dw:j * dw + ow * sw:sw].add(xr[:, :, i, j])
+    return out[:, :, ph:ph + oh_t, pw:pw + ow_t]
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def _pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+    return _fold(x, output_sizes=_pair(output_sizes),
+                 kernel_sizes=_pair(kernel_sizes), strides=_pair(strides),
+                 paddings=_pair(paddings), dilations=_pair(dilations))
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+@defop("label_smooth_op")
+def _label_smooth(label, epsilon=0.1, prior_dist=None):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    return _label_smooth(label, epsilon=epsilon, prior_dist=prior_dist)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    lv = lengths._value if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+    m = int(maxlen) if maxlen is not None else int(jnp.max(lv))
+    mask = jnp.arange(m)[None, :] < lv[..., None]
+    return Tensor(mask.astype(dtypes.convert_dtype(dtype)))
+
+
+@defop("temporal_shift")
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = x.reshape(n, seg_num, c, h, w)
+    fold_c = int(c * shift_ratio)
+    left = jnp.concatenate([xr[:, 1:, :fold_c],
+                            jnp.zeros_like(xr[:, :1, :fold_c])], axis=1)
+    right = jnp.concatenate([jnp.zeros_like(xr[:, :1, fold_c:2 * fold_c]),
+                             xr[:, :-1, fold_c:2 * fold_c]], axis=1)
+    rest = xr[:, :, 2 * fold_c:]
+    return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    n = xv.shape[-1]
+    base = jnp.zeros(xv.shape[:-1] + (n + abs(offset), n + abs(offset)), xv.dtype)
+    idx = jnp.arange(n)
+    if offset >= 0:
+        out = base.at[..., idx, idx + offset].set(xv)
+    else:
+        out = base.at[..., idx - offset, idx].set(xv)
+    if (dim1, dim2) != (-2, -1):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return Tensor(out)

@@ -1,0 +1,199 @@
+"""Convolutions (reference: python/paddle/nn/functional/conv.py).
+
+All convs lower to jax.lax.conv_general_dilated — XLA tiles them onto the
+MXU directly (the reference needs cuDNN algorithm search + autotune cache,
+paddle/phi/kernels/autotune/; XLA picks layouts/tilings at compile time).
+Paddle's NCHW/OIHW conventions are kept at the API boundary; XLA is free to
+re-layout internally for TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _padding(padding, n, stride, kernel, dilation):
+    """paddle padding: int, list, pairs, or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        flat = list(padding)
+        if len(flat) == n:
+            return [(int(p), int(p)) for p in flat]
+        if len(flat) == 2 * n:
+            return [(int(flat[2 * i]), int(flat[2 * i + 1])) for i in range(n)]
+        if all(isinstance(p, (list, tuple)) for p in flat):
+            # NCHW-style per-dim pairs incl batch/channel: take spatial
+            sp = flat[-n:]
+            return [(int(a), int(b)) for a, b in sp]
+    return [(int(padding), int(padding))] * n
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n,
+             channel_last=False):
+    lhs_spec = "N" + ("HWD"[:n] if n <= 3 else "") + "C" if channel_last \
+        else "NC" + "HWD"[:n]
+    # build dimension spec strings like NCHW / OIHW
+    sp = "DHW"[-n:] if n == 3 else ("HW" if n == 2 else "W")
+    lhs = ("N" + sp + "C") if channel_last else ("NC" + sp)
+    rhs = "OI" + sp
+    out = lhs
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape, (lhs, rhs, out))
+    y = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=padding,
+        lhs_dilation=(1,) * n, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=None)
+    if bias is not None:
+        if channel_last:
+            y = y + bias.reshape((1,) * (y.ndim - 1) + (-1,))
+        else:
+            y = y + bias.reshape((1, -1) + (1,) * n)
+    return y
+
+
+@defop("conv1d", amp_policy="white")
+def _conv1d(x, weight, bias=None, stride=(1,), padding=((0, 0),),
+            dilation=(1,), groups=1, channel_last=False):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    channel_last)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv1d(x, weight, bias, stride=_tuple(stride, 1),
+                   padding=_padding(padding, 1, stride, None, dilation),
+                   dilation=_tuple(dilation, 1), groups=groups,
+                   channel_last=(data_format == "NLC"))
+
+
+@defop("conv2d", amp_policy="white",
+       spmd_note="batch->dp, out-channels->mp shardable")
+def _conv2d(x, weight, bias=None, stride=(1, 1), padding=((0, 0), (0, 0)),
+            dilation=(1, 1), groups=1, channel_last=False):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    channel_last)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv2d(x, weight, bias, stride=_tuple(stride, 2),
+                   padding=_padding(padding, 2, stride, None, dilation),
+                   dilation=_tuple(dilation, 2), groups=groups,
+                   channel_last=(data_format == "NHWC"))
+
+
+@defop("conv3d", amp_policy="white")
+def _conv3d(x, weight, bias=None, stride=(1, 1, 1),
+            padding=((0, 0), (0, 0), (0, 0)), dilation=(1, 1, 1), groups=1,
+            channel_last=False):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    channel_last)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv3d(x, weight, bias, stride=_tuple(stride, 3),
+                   padding=_padding(padding, 3, stride, None, dilation),
+                   dilation=_tuple(dilation, 3), groups=groups,
+                   channel_last=(data_format == "NDHWC"))
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, n):
+    # weight layout (paddle): (in_channels, out_channels/groups, *k)
+    sp = "HW" if n == 2 else ("W" if n == 1 else "DHW")
+    lhs = "NC" + sp
+    rhs = "IO" + sp
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape, (lhs, rhs, lhs))
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        # transpose conv padding: effective padding = dilation*(k-1) - pad
+        k = weight.shape[2:]
+        pad = [(dilation[i] * (k[i] - 1) - padding[i][0],
+                dilation[i] * (k[i] - 1) - padding[i][1] + output_padding[i])
+               for i in range(n)]
+    def one_group(xi, wi):
+        # wi: (in/g, out/g, *k) -> (out/g, in/g, *k), spatially flipped
+        w = jnp.flip(jnp.swapaxes(wi, 0, 1), axis=tuple(range(2, 2 + n)))
+        dn2 = jax.lax.conv_dimension_numbers(
+            xi.shape, w.shape, ("NC" + sp, "OI" + sp, "NC" + sp))
+        return jax.lax.conv_general_dilated(
+            xi, w, window_strides=(1,) * n, padding=pad,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn2)
+
+    if groups > 1:
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(weight, groups, axis=0)
+        y = jnp.concatenate([one_group(xi, wi) for xi, wi in zip(xs, ws)],
+                            axis=1)
+    else:
+        y = one_group(x, weight)
+    if bias is not None:
+        y = y + bias.reshape((1, -1) + (1,) * n)
+    return y
+
+
+@defop("conv2d_transpose", amp_policy="white")
+def _conv2d_transpose(x, weight, bias=None, stride=(1, 1),
+                      padding=((0, 0), (0, 0)), output_padding=(0, 0),
+                      dilation=(1, 1), groups=1):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 2)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv2d_transpose(
+        x, weight, bias, stride=_tuple(stride, 2),
+        padding=_padding(padding, 2, stride, None, dilation),
+        output_padding=_tuple(output_padding, 2),
+        dilation=_tuple(dilation, 2), groups=groups)
+
+
+@defop("conv1d_transpose", amp_policy="white")
+def _conv1d_transpose(x, weight, bias=None, stride=(1,), padding=((0, 0),),
+                      output_padding=(0,), dilation=(1,), groups=1):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 1)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv1d_transpose(
+        x, weight, bias, stride=_tuple(stride, 1),
+        padding=_padding(padding, 1, stride, None, dilation),
+        output_padding=_tuple(output_padding, 1),
+        dilation=_tuple(dilation, 1), groups=groups)
+
+
+@defop("conv3d_transpose", amp_policy="white")
+def _conv3d_transpose(x, weight, bias=None, stride=(1, 1, 1),
+                      padding=((0, 0),) * 3, output_padding=(0, 0, 0),
+                      dilation=(1, 1, 1), groups=1):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, 3)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv3d_transpose(
+        x, weight, bias, stride=_tuple(stride, 3),
+        padding=_padding(padding, 3, stride, None, dilation),
+        output_padding=_tuple(output_padding, 3),
+        dilation=_tuple(dilation, 3), groups=groups)

@@ -1,0 +1,297 @@
+"""Loss functions (reference: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+@defop("cross_entropy", amp_policy="black",
+       spmd_note="vocab-sharded logits -> ParallelCrossEntropy "
+                 "(reference: mp_layers.py:743); here sharded softmax is "
+                 "GSPMD-automatic")
+def _cross_entropy(input, label, weight=None, ignore_index=-100,
+                   reduction="mean", soft_label=False, axis=-1,
+                   use_softmax=True, label_smoothing=0.0):
+    logits = input.astype(jnp.float32)
+    if soft_label:
+        lab = label.astype(jnp.float32)
+        if label_smoothing > 0.0:
+            k = logits.shape[axis]
+            lab = (1 - label_smoothing) * lab + label_smoothing / k
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
+            else jnp.log(jnp.clip(logits, 1e-15))
+        loss = -jnp.sum(lab * logp, axis=axis)
+        return _reduce(loss, reduction)
+    lab = label
+    if lab.ndim == logits.ndim and lab.shape[axis] == 1:
+        lab = jnp.squeeze(lab, axis)
+    lab = lab.astype(jnp.int32)
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(logits, 1e-15))
+    if label_smoothing > 0.0:
+        k = logits.shape[axis]
+        nll = -jnp.take_along_axis(
+            logp, lab[..., None] if axis in (-1, logits.ndim - 1)
+            else jnp.expand_dims(lab, axis), axis=axis).squeeze(axis)
+        smooth = -jnp.mean(logp, axis=axis)
+        loss = (1 - label_smoothing) * nll + label_smoothing * smooth
+    else:
+        loss = -jnp.take_along_axis(
+            logp, jnp.expand_dims(lab, axis), axis=axis).squeeze(axis)
+    valid = (lab != ignore_index)
+    loss = jnp.where(valid, loss, 0.0)
+    if weight is not None:
+        w = jnp.take(weight, jnp.clip(lab, 0), axis=0)
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(
+            jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return _reduce(loss, reduction)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    return _cross_entropy(input, label, weight=weight,
+                          ignore_index=ignore_index, reduction=reduction,
+                          soft_label=soft_label, axis=axis,
+                          use_softmax=use_softmax,
+                          label_smoothing=label_smoothing)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = _cross_entropy(logits, label, reduction="none",
+                          soft_label=soft_label, ignore_index=ignore_index,
+                          axis=axis)
+    from paddle_tpu.nn.functional.activation import softmax as _softmax
+    from paddle_tpu.tensor.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+@defop("mse_loss")
+def _mse_loss(input, label, reduction="mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _mse_loss(input, label, reduction=reduction)
+
+
+@defop("l1_loss")
+def _l1_loss(input, label, reduction="mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _l1_loss(input, label, reduction=reduction)
+
+
+@defop("smooth_l1_loss")
+def _smooth_l1(input, label, reduction="mean", delta=1.0):
+    d = input - label
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return _smooth_l1(input, label, reduction=reduction, delta=delta)
+
+
+@defop("nll_loss_op", amp_policy="black")
+def _nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    lab = label.astype(jnp.int32)
+    loss = -jnp.take_along_axis(input, lab[:, None] if input.ndim == 2
+                                else jnp.expand_dims(lab, 1), axis=1)
+    loss = loss.squeeze(1)
+    valid = lab != ignore_index
+    loss = jnp.where(valid, loss, 0.0)
+    if weight is not None:
+        w = jnp.take(weight, jnp.clip(lab, 0), axis=0)
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.sum(jnp.where(valid, w, 0.0))
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
+    return _reduce(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    return _nll_loss(input, label, weight=weight, ignore_index=ignore_index,
+                     reduction=reduction)
+
+
+@defop("binary_cross_entropy", amp_policy="black")
+def _bce(input, label, weight=None, reduction="mean"):
+    x = jnp.clip(input.astype(jnp.float32), 1e-12, 1.0 - 1e-12)
+    loss = -(label * jnp.log(x) + (1 - label) * jnp.log(1 - x))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    return _bce(input, label, weight=weight, reduction=reduction)
+
+
+@defop("bce_with_logits", amp_policy="black")
+def _bce_logits(logit, label, weight=None, pos_weight=None, reduction="mean"):
+    x = logit.astype(jnp.float32)
+    lab = label.astype(jnp.float32)
+    max_val = jnp.clip(-x, 0, None)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * lab + 1
+        loss = (1 - lab) * x + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(x))) + max_val)
+    else:
+        loss = (1 - lab) * x + jnp.log1p(jnp.exp(-jnp.abs(x))) + max_val
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    return _bce_logits(logit, label, weight=weight, pos_weight=pos_weight,
+                       reduction=reduction)
+
+
+@defop("kl_div_op", amp_policy="black")
+def _kl_div(input, label, reduction="mean", log_target=False):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        loss = label * (jnp.log(jnp.clip(label, 1e-12)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    return _kl_div(input, label, reduction=reduction, log_target=log_target)
+
+
+@defop("margin_ranking")
+def _margin_ranking(input, other, label, margin=0.0, reduction="mean"):
+    loss = jnp.clip(-label * (input - other) + margin, 0, None)
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return _margin_ranking(input, other, label, margin=margin,
+                           reduction=reduction)
+
+
+@defop("hinge_embedding")
+def _hinge_embedding(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1.0, input,
+                     jnp.clip(margin - input, 0, None))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    return _hinge_embedding(input, label, margin=margin, reduction=reduction)
+
+
+@defop("cosine_embedding")
+def _cosine_embedding(input1, input2, label, margin=0.0, reduction="mean"):
+    cos = jnp.sum(input1 * input2, -1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1),
+        1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.clip(cos - margin, 0, None))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    return _cosine_embedding(input1, input2, label, margin=margin,
+                             reduction=reduction)
+
+
+@defop("triplet_margin")
+def _triplet_margin(input, positive, negative, margin=1.0, p=2.0,
+                    epsilon=1e-6, swap=False, reduction="mean"):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), -1),
+                         1.0 / p)
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    return _reduce(jnp.clip(dp - dn + margin, 0, None), reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    return _triplet_margin(input, positive, negative, margin=margin, p=p,
+                           epsilon=epsilon, swap=swap, reduction=reduction)
+
+
+@defop("log_loss_op", amp_policy="black")
+def _log_loss(input, label, epsilon=1e-4):
+    x = jnp.clip(input, epsilon, 1 - epsilon)
+    return -label * jnp.log(x) - (1 - label) * jnp.log(1 - x)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _log_loss(input, label, epsilon=epsilon)
+
+
+def square_error_cost(input, label):
+    from paddle_tpu.tensor import math as M
+    return M.square(input - label)
+
+
+@defop("sigmoid_focal_loss_op", amp_policy="black")
+def _sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                        reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = (1 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) + \
+        jnp.clip(-logit, 0, None)
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = ce * jnp.power(1 - p_t, gamma)
+    if alpha >= 0:
+        a_t = alpha * label + (1 - alpha) * (1 - label)
+        loss = a_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    return _sigmoid_focal_loss(logit, label, normalizer=normalizer,
+                               alpha=alpha, gamma=gamma, reduction=reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    raise NotImplementedError(
+        "ctc_loss pending: needs a lax.scan alpha-recursion implementation")

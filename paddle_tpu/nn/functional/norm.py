@@ -1,0 +1,177 @@
+"""Normalization (reference: python/paddle/nn/functional/norm.py).
+
+Stat math is done in float32 regardless of input dtype (bf16-safe), matching
+the reference's fp32 accumulation in its CUDA kernels
+(phi/kernels/gpu/layer_norm_kernel.cu).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor
+
+
+@defop("layer_norm", amp_policy="black")
+def _layer_norm(x, weight=None, bias=None, normalized_ndim=1, epsilon=1e-5):
+    axes = tuple(range(x.ndim - normalized_ndim, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) \
+        else [normalized_shape]
+    return _layer_norm(x, weight, bias, normalized_ndim=len(ns),
+                       epsilon=epsilon)
+
+
+@defop("rms_norm_ref", amp_policy="black",
+       spmd_note="replicated scale; seq/batch dims freely shardable")
+def _rms_norm(x, weight=None, epsilon=1e-6):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(ms + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (reference: python/paddle/incubate/nn/functional/fused_rms_norm.py
+    — there a fused CUDA kernel; here XLA fuses the jnp chain, with a Pallas
+    fused kernel in paddle_tpu.kernels for long rows)."""
+    return _rms_norm(x, weight, epsilon=epsilon)
+
+
+@defop("batch_norm_infer", amp_policy="black")
+def _batch_norm_infer(x, running_mean, running_var, weight, bias,
+                      epsilon=1e-5, channel_axis=1):
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+    xf = x.astype(jnp.float32)
+    out = (xf - running_mean.reshape(shape)) * \
+        jax.lax.rsqrt(running_var.reshape(shape).astype(jnp.float32) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype)
+
+
+@defop("batch_norm_train", amp_policy="black")
+def _batch_norm_train(x, weight, bias, epsilon=1e-5, channel_axis=1):
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    out = (xf - mean.reshape(shape)) * \
+        jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype), mean, var
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    ch = 1 if data_format.startswith("NC") else x.ndim - 1
+    if use_global_stats is None:
+        use_global_stats = not training
+    if use_global_stats:
+        return _batch_norm_infer(x, running_mean, running_var, weight, bias,
+                                 epsilon=epsilon, channel_axis=ch)
+    out, mean, var = _batch_norm_train(x, weight, bias, epsilon=epsilon,
+                                       channel_axis=ch)
+    # eager running-stat update (buffers are mutable handles)
+    if isinstance(running_mean, Tensor) and not isinstance(
+            mean._value, jax.core.Tracer):
+        running_mean._value = (momentum * running_mean._value +
+                               (1 - momentum) * mean._value).astype(
+            running_mean._value.dtype)
+        running_var._value = (momentum * running_var._value +
+                              (1 - momentum) * var._value).astype(
+            running_var._value.dtype)
+    return out
+
+
+@defop("group_norm_op", amp_policy="black")
+def _group_norm(x, weight=None, bias=None, num_groups=1, epsilon=1e-5,
+                channel_axis=1):
+    c = x.shape[channel_axis]
+    if channel_axis != 1:
+        x_m = jnp.moveaxis(x, channel_axis, 1)
+    else:
+        x_m = x
+    n = x_m.shape[0]
+    xf = x_m.astype(jnp.float32).reshape(n, num_groups, c // num_groups, -1)
+    mean = jnp.mean(xf, axis=(2, 3), keepdims=True)
+    var = jnp.var(xf, axis=(2, 3), keepdims=True)
+    out = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x_m.shape)
+    shape = [1, c] + [1] * (x_m.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    out = out.astype(x.dtype)
+    if channel_axis != 1:
+        out = jnp.moveaxis(out, 1, channel_axis)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    ch = 1 if data_format.startswith("NC") else x.ndim - 1
+    return _group_norm(x, weight, bias, num_groups=num_groups,
+                       epsilon=epsilon, channel_axis=ch)
+
+
+@defop("instance_norm_op", amp_policy="black")
+def _instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    return _instance_norm(x, weight, bias, epsilon=eps)
+
+
+@defop("local_response_norm_op", amp_policy="black")
+def _local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x.astype(jnp.float32))
+    c = x.shape[1]
+    half = size // 2
+    padded = jnp.pad(sq, [(0, 0), (half, size - 1 - half)] +
+                     [(0, 0)] * (x.ndim - 2))
+    window = sum(padded[:, i:i + c] for i in range(size))
+    return (x.astype(jnp.float32) /
+            jnp.power(k + alpha * window, beta)).astype(x.dtype)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    return _local_response_norm(x, size=size, alpha=alpha, beta=beta, k=k)

@@ -1,0 +1,265 @@
+"""Pooling (reference: python/paddle/nn/functional/pooling.py).
+
+reduce_window is XLA's native pooling primitive — direct MXU-adjacent VPU
+work, no cuDNN descriptor plumbing needed.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _pool_pad(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        flat = list(padding)
+        if len(flat) == n:
+            return [(int(p), int(p)) for p in flat]
+        if len(flat) == 2 * n:
+            return [(int(flat[2 * i]), int(flat[2 * i + 1])) for i in range(n)]
+    return [(int(padding), int(padding))] * n
+
+
+def _reduce_window(x, init, op, window, strides, padding, n):
+    dims = (1, 1) + window
+    strd = (1, 1) + strides
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        pad = [(0, 0), (0, 0)] + list(padding)
+    return jax.lax.reduce_window(x, init, op, dims, strd, pad)
+
+
+@defop("max_pool2d")
+def _max_pool2d(x, kernel_size, stride, padding, ceil_mode=False):
+    return _reduce_window(x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                          else jnp.iinfo(x.dtype).min,
+                          jax.lax.max, kernel_size, stride, padding, 2)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    ks = _tuple(kernel_size, 2)
+    st = _tuple(stride, 2) if stride is not None else ks
+    out = _max_pool2d(x, kernel_size=ks, stride=st,
+                      padding=_pool_pad(padding, 2), ceil_mode=ceil_mode)
+    if return_mask:
+        idx = _max_pool2d_indices(x, kernel_size=ks, stride=st,
+                                  padding=_pool_pad(padding, 2))
+        return out, idx
+    return out
+
+
+@defop("max_pool2d_indices", differentiable=False)
+def _max_pool2d_indices(x, kernel_size, stride, padding):
+    n, c, h, w = x.shape
+    flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+    # select index of max via reduce_window over (value, index) pairs
+    def sel(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+    init = (-jnp.inf, jnp.float32(-1))
+    vals, idxs = jax.lax.reduce_window(
+        (x.astype(jnp.float32), flat_idx), init, sel,
+        (1, 1) + kernel_size, (1, 1) + stride,
+        [(0, 0), (0, 0)] + list(padding))
+    return idxs.astype(jnp.int64)
+
+
+@defop("avg_pool2d")
+def _avg_pool2d(x, kernel_size, stride, padding, exclusive=True):
+    summed = _reduce_window(x, 0.0, jax.lax.add, kernel_size, stride,
+                            padding, 2)
+    if exclusive and padding != "VALID" and any(
+            p != (0, 0) for p in (padding if isinstance(padding, list) else [])):
+        ones = jnp.ones_like(x)
+        counts = _reduce_window(ones, 0.0, jax.lax.add, kernel_size, stride,
+                                padding, 2)
+        return summed / counts
+    return summed / float(np.prod(kernel_size))
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    ks = _tuple(kernel_size, 2)
+    st = _tuple(stride, 2) if stride is not None else ks
+    out = _avg_pool2d(x, kernel_size=ks, stride=st,
+                      padding=_pool_pad(padding, 2), exclusive=exclusive)
+    if divisor_override:
+        out = out * (float(np.prod(ks)) / divisor_override)
+    return out
+
+
+@defop("max_pool1d")
+def _max_pool1d(x, kernel_size, stride, padding):
+    return _reduce_window(x, -jnp.inf, jax.lax.max, kernel_size, stride,
+                          padding, 1)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    ks = _tuple(kernel_size, 1)
+    st = _tuple(stride, 1) if stride is not None else ks
+    return _max_pool1d(x, kernel_size=ks, stride=st,
+                       padding=_pool_pad(padding, 1))
+
+
+@defop("avg_pool1d")
+def _avg_pool1d(x, kernel_size, stride, padding, exclusive=True):
+    s = _reduce_window(x, 0.0, jax.lax.add, kernel_size, stride, padding, 1)
+    return s / float(np.prod(kernel_size))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    ks = _tuple(kernel_size, 1)
+    st = _tuple(stride, 1) if stride is not None else ks
+    return _avg_pool1d(x, kernel_size=ks, stride=st,
+                       padding=_pool_pad(padding, 1), exclusive=exclusive)
+
+
+@defop("max_pool3d")
+def _max_pool3d(x, kernel_size, stride, padding):
+    return _reduce_window(x, -jnp.inf, jax.lax.max, kernel_size, stride,
+                          padding, 3)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    ks = _tuple(kernel_size, 3)
+    st = _tuple(stride, 3) if stride is not None else ks
+    return _max_pool3d(x, kernel_size=ks, stride=st,
+                       padding=_pool_pad(padding, 3))
+
+
+@defop("avg_pool3d")
+def _avg_pool3d(x, kernel_size, stride, padding):
+    s = _reduce_window(x, 0.0, jax.lax.add, kernel_size, stride, padding, 3)
+    return s / float(np.prod(kernel_size))
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    ks = _tuple(kernel_size, 3)
+    st = _tuple(stride, 3) if stride is not None else ks
+    return _avg_pool3d(x, kernel_size=ks, stride=st,
+                       padding=_pool_pad(padding, 3))
+
+
+# ---- adaptive pooling --------------------------------------------------
+@defop("adaptive_avg_pool2d")
+def _adaptive_avg_pool2d(x, output_size):
+    n, c, h, w = x.shape
+    oh, ow = output_size
+    if h % oh == 0 and w % ow == 0:
+        x4 = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x4.mean(axis=(3, 5))
+    # general case: mean over variable windows
+    out = jnp.zeros((n, c, oh, ow), x.dtype)
+    hs = [(i * h) // oh for i in range(oh)] + [h]
+    ws = [(j * w) // ow for j in range(ow)] + [w]
+    rows = []
+    for i in range(oh):
+        cols = []
+        for j in range(ow):
+            cols.append(x[:, :, hs[i]:hs[i + 1], ws[j]:ws[j + 1]]
+                        .mean(axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_avg_pool2d(x, output_size=_tuple(output_size, 2))
+
+
+@defop("adaptive_max_pool2d")
+def _adaptive_max_pool2d(x, output_size):
+    n, c, h, w = x.shape
+    oh, ow = output_size
+    if h % oh == 0 and w % ow == 0:
+        x4 = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x4.max(axis=(3, 5))
+    hs = [(i * h) // oh for i in range(oh)] + [h]
+    ws = [(j * w) // ow for j in range(ow)] + [w]
+    rows = []
+    for i in range(oh):
+        cols = []
+        for j in range(ow):
+            cols.append(x[:, :, hs[i]:hs[i + 1], ws[j]:ws[j + 1]]
+                        .max(axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool2d(x, output_size=_tuple(output_size, 2))
+
+
+@defop("adaptive_avg_pool1d")
+def _adaptive_avg_pool1d(x, output_size):
+    n, c, l = x.shape
+    o = output_size
+    if l % o == 0:
+        return x.reshape(n, c, o, l // o).mean(axis=3)
+    bounds = [(i * l) // o for i in range(o)] + [l]
+    return jnp.stack([x[:, :, bounds[i]:bounds[i + 1]].mean(axis=2)
+                      for i in range(o)], axis=-1)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_avg_pool1d(x, output_size=int(output_size))
+
+
+@defop("adaptive_max_pool1d")
+def _adaptive_max_pool1d(x, output_size):
+    n, c, l = x.shape
+    o = output_size
+    if l % o == 0:
+        return x.reshape(n, c, o, l // o).max(axis=3)
+    bounds = [(i * l) // o for i in range(o)] + [l]
+    return jnp.stack([x[:, :, bounds[i]:bounds[i + 1]].max(axis=2)
+                      for i in range(o)], axis=-1)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool1d(x, output_size=int(output_size))
+
+
+@defop("adaptive_avg_pool3d")
+def _adaptive_avg_pool3d(x, output_size):
+    n, c, d, h, w = x.shape
+    od, oh, ow = output_size
+    if d % od == 0 and h % oh == 0 and w % ow == 0:
+        x6 = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+        return x6.mean(axis=(3, 5, 7))
+    raise NotImplementedError("adaptive_avg_pool3d with non-divisible sizes")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_avg_pool3d(x, output_size=_tuple(output_size, 3))
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    from paddle_tpu.tensor import math as M
+    p = float(norm_type)
+    xp = M.pow(M.abs(x), p)
+    pooled = avg_pool2d(xp, kernel_size, stride, padding,
+                        ceil_mode=ceil_mode, exclusive=False)
+    ks = _tuple(kernel_size, 2)
+    return M.pow(pooled * float(np.prod(ks)), 1.0 / p)

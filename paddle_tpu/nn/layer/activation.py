@@ -1,0 +1,86 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer.layers import Layer
+
+
+def _simple(fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = dict(fixed)
+            sig_args = _SIGS.get(fn_name, [])
+            for name, val in zip(sig_args, args):
+                self._kwargs[name] = val
+            for k, v in kwargs.items():
+                if k != "name":
+                    self._kwargs[k] = v
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kwargs)
+
+    _Act.__name__ = fn_name
+    return _Act
+
+
+_SIGS = {
+    "leaky_relu": ["negative_slope"],
+    "elu": ["alpha"],
+    "celu": ["alpha"],
+    "hardtanh": ["min", "max"],
+    "hardshrink": ["threshold"],
+    "softshrink": ["threshold"],
+    "softplus": ["beta", "threshold"],
+    "softmax": ["axis"],
+    "log_softmax": ["axis"],
+    "gelu": ["approximate"],
+    "maxout": ["groups", "axis"],
+    "glu": ["axis"],
+    "thresholded_relu": ["threshold", "value"],
+    "rrelu": ["lower", "upper"],
+}
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+GELU = _simple("gelu")
+SiLU = _simple("silu")
+Silu = SiLU
+Swish = _simple("swish")
+Sigmoid = _simple("sigmoid")
+LogSigmoid = _simple("log_sigmoid")
+Tanh = _simple("tanh")
+Tanhshrink = _simple("tanhshrink")
+Hardsigmoid = _simple("hardsigmoid")
+Hardswish = _simple("hardswish")
+Hardtanh = _simple("hardtanh")
+Hardshrink = _simple("hardshrink")
+Softshrink = _simple("softshrink")
+LeakyReLU = _simple("leaky_relu")
+ELU = _simple("elu")
+CELU = _simple("celu")
+SELU = _simple("selu")
+Softplus = _simple("softplus")
+Softsign = _simple("softsign")
+Mish = _simple("mish")
+Softmax = _simple("softmax")
+LogSoftmax = _simple("log_softmax")
+Maxout = _simple("maxout")
+GLU = _simple("glu")
+ThresholdedReLU = _simple("thresholded_relu")
+RReLU = _simple("rrelu")
+Softmax2D = _simple("softmax", axis=-3)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self._data_format)

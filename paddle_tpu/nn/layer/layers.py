@@ -1,0 +1,320 @@
+"""nn.Layer base class.
+
+TPU-native rebuild of the reference Layer (reference:
+python/paddle/nn/layer/layers.py:334 — parameters/buffers registration via
+__setattr__, forward pre/post hooks, state_dict/set_state_dict, train/eval,
+apply, to). Parameters are paddle_tpu Parameters (mutable handles over
+jax.Array) so the same Layer object serves eager training, jit tracing
+(via jit.functional state swapping), and GSPMD sharding (parameters are
+device_put with NamedSharding in place).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Iterator
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtypes
+from paddle_tpu.core.tensor import Tensor, Parameter
+from paddle_tpu.nn import initializer as init_mod
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        object.__setattr__(self, "_parameters", collections.OrderedDict())
+        object.__setattr__(self, "_sub_layers", collections.OrderedDict())
+        object.__setattr__(self, "_buffers", collections.OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffer_names", set())
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self.training = True
+        self._dtype = dtypes.convert_dtype(dtype)
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- attribute magic ---------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() first")
+            params[name] = value
+            layers.pop(name, None)
+            buffers.pop(name, None) if buffers else None
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() first")
+            layers[name] = value
+            params.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                object.__setattr__(self, name, value)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    del params[name]
+                    object.__setattr__(self, name, None)
+                    return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._sub_layers) + list(self._buffers)
+
+    # -- construction helpers ----------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """Reference: layers.py create_parameter — ParamAttr-driven."""
+        dt = dtypes.convert_dtype(dtype) or self._dtype
+        initializer = None
+        name = None
+        trainable = True
+        if attr is not None and attr is not False:
+            initializer = getattr(attr, "initializer", None)
+            name = getattr(attr, "name", None)
+            trainable = getattr(attr, "trainable", True)
+        if attr is False:
+            return None
+        if initializer is None:
+            initializer = default_initializer
+        if initializer is None:
+            initializer = (init_mod.Constant(0.0) if is_bias
+                           else init_mod.XavierUniform())
+        arr = initializer(tuple(int(s) for s in shape), dt)
+        return Parameter(arr, name=name, trainable=trainable)
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        """Reference: layers.py register_buffer."""
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- iteration ---------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self._walk(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is not None and id(p) not in seen:
+                    seen.add(id(p))
+                    yield (f"{name}.{pname}" if name else pname), p
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self._walk(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is not None and id(b) not in seen:
+                    seen.add(id(b))
+                    yield (f"{name}.{bname}" if name else bname), b
+
+    def _walk(self, prefix="", include_sublayers=True):
+        yield prefix, self
+        if include_sublayers:
+            for lname, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from sub._walk(sub_prefix, True)
+
+    def sublayers(self, include_self=False):
+        out = [l for _, l in self._walk()] if include_self else \
+            [l for n, l in self._walk() if n != ""]
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        for n, l in self._walk(prefix):
+            if n == prefix and not include_self:
+                continue
+            yield n, l
+
+    def children(self):
+        return iter(self._sub_layers.values())
+
+    def named_children(self):
+        return iter(self._sub_layers.items())
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # -- mode --------------------------------------------------------------
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        out = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(
+                prefix=structured_name_prefix,
+                include_sublayers=include_sublayers):
+            out[name] = p
+        for name, layer in self._walk(structured_name_prefix,
+                                      include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is not None and bname not in \
+                        layer._non_persistable_buffer_names:
+                    out[(f"{name}.{bname}" if name else bname)] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Reference: layers.py set_state_dict — copy by name, cast dtype."""
+        own = self.state_dict()
+        missing = []
+        for name, t in own.items():
+            if name not in state_dict:
+                missing.append(name)
+                continue
+            src = state_dict[name]
+            arr = src._value if isinstance(src, Tensor) else jnp.asarray(src)
+            if tuple(arr.shape) != tuple(t.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: loaded {tuple(arr.shape)} "
+                    f"vs expected {tuple(t.shape)}")
+            t._value = arr.astype(t._value.dtype)
+        unexpected = [k for k in state_dict if k not in own]
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # -- dtype / placement -------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            dt = dtypes.convert_dtype(dtype)
+            for t in list(self.parameters()) + list(self.buffers()):
+                if dtypes.is_floating_point(t.dtype):
+                    t._value = t._value.astype(dt)
+            for l in self.sublayers(include_self=True):
+                l._dtype = dt
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        handle = _HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle._id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[handle._id] = hook
+        return handle
+
+    # -- call --------------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            res = hook(self, args)
+            if res is not None:
+                args = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, args, out)
+            if res is not None:
+                out = res
+        return out
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = [sub_repr[0]] + ["  " + l for l in sub_repr[1:]]
+            lines.append(f"  ({name}): " + "\n".join(sub_repr))
+        main = f"{self.__class__.__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    def full_name(self):
+        return self._name_scope
+
+
+class _HookRemoveHelper:
+    _next_id = [0]
+
+    def __init__(self, hooks_dict):
+        self._hooks = hooks_dict
+        self._id = _HookRemoveHelper._next_id[0]
+        _HookRemoveHelper._next_id[0] += 1
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+class ParamAttr:
+    """Reference: python/paddle/base/param_attr.py ParamAttr."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip

@@ -1,0 +1,350 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+Cells carry the math; the time loop is jax.lax.scan — XLA compiles one
+fused step and loops it on-device (the reference dispatches per-timestep
+kernels from a Python/C++ loop, or uses cuDNN's fused RNN; scan is the TPU
+idiom for both).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer.container import LayerList
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        return Tensor(jnp.full((b, self.hidden_size), init_value,
+                               jnp.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        k = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-k, k)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = _rnn_cell_step(inputs, states, self.weight_ih, self.weight_hh,
+                           self.bias_ih, self.bias_hh,
+                           activation=self.activation)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+@defop("simple_rnn_cell")
+def _rnn_cell_step(x, h, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+    z = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    return jnp.tanh(z) if activation == "tanh" else jax.nn.relu(z)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        k = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-k, k)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = (self.get_initial_states(inputs),
+                      self.get_initial_states(inputs))
+        h, c = states
+        h2, c2 = _lstm_cell_step(inputs, h, c, self.weight_ih,
+                                 self.weight_hh, self.bias_ih, self.bias_hh)
+        return h2, (h2, c2)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+@defop("lstm_cell")
+def _lstm_cell_step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    gates = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c2 = f * c + i * g
+    h2 = o * jnp.tanh(c2)
+    return h2, c2
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        k = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-k, k)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = _gru_cell_step(inputs, states, self.weight_ih, self.weight_hh,
+                           self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+@defop("gru_cell")
+def _gru_cell_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    gi = x @ w_ih.T + b_ih
+    gh = h @ w_hh.T + b_hh
+    ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+    hr, hz, hn = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(in_ + r * hn)
+    return (1 - z) * n + z * h
+
+
+@defop("rnn_scan")
+def _rnn_scan(x_tbf, init_states, params, mode="LSTM"):
+    """One direction over time with lax.scan. x: (T, B, F)."""
+    if mode == "LSTM":
+        w_ih, w_hh, b_ih, b_hh = params
+
+        def step(carry, xt):
+            h, c = carry
+            h2, c2 = _lstm_cell_step.raw_fn(xt, h, c, w_ih, w_hh, b_ih, b_hh)
+            return (h2, c2), h2
+
+        carry, ys = jax.lax.scan(step, init_states, x_tbf)
+        return ys, carry
+    if mode == "GRU":
+        w_ih, w_hh, b_ih, b_hh = params
+
+        def step(h, xt):
+            h2 = _gru_cell_step.raw_fn(xt, h, w_ih, w_hh, b_ih, b_hh)
+            return h2, h2
+
+        carry, ys = jax.lax.scan(step, init_states, x_tbf)
+        return ys, carry
+    w_ih, w_hh, b_ih, b_hh, act = params
+
+    def step(h, xt):
+        h2 = _rnn_cell_step.raw_fn(xt, h, w_ih, w_hh, b_ih, b_hh,
+                                   activation=act)
+        return h2, h2
+
+    carry, ys = jax.lax.scan(step, init_states, x_tbf)
+    return ys, carry
+
+
+class RNNBase(Layer):
+    """Multi-layer (bi)directional RNN driver (reference:
+    nn/layer/rnn.py:RNNBase)."""
+
+    MODE = "LSTM"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN": 1}[self.MODE]
+        k = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-k, k)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(self.bidirect):
+                in_sz = input_size if layer == 0 \
+                    else hidden_size * self.bidirect
+                suffix = f"_reverse" if d else ""
+                w_ih = self.create_parameter(
+                    [gate_mult * hidden_size, in_sz], weight_ih_attr,
+                    default_initializer=u)
+                w_hh = self.create_parameter(
+                    [gate_mult * hidden_size, hidden_size], weight_hh_attr,
+                    default_initializer=u)
+                b_ih = self.create_parameter(
+                    [gate_mult * hidden_size], bias_ih_attr, is_bias=True,
+                    default_initializer=u)
+                b_hh = self.create_parameter(
+                    [gate_mult * hidden_size], bias_hh_attr, is_bias=True,
+                    default_initializer=u)
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", w_ih)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", w_hh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", b_ih)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", b_hh)
+                self._all_weights.append((w_ih, w_hh, b_ih, b_hh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from paddle_tpu.tensor import manipulation as M
+        x = inputs
+        if not self.time_major:
+            x = M.transpose(x, [1, 0, 2])  # -> (T, B, F)
+        t, b = x.shape[0], x.shape[1]
+        n_dir = self.num_layers * self.bidirect
+        if initial_states is None:
+            z = Tensor(jnp.zeros((n_dir, b, self.hidden_size)))
+            initial_states = (z, z.clone()) if self.MODE == "LSTM" else z
+        final_h = []
+        final_c = []
+        out = x
+        for layer in range(self.num_layers):
+            dir_outs = []
+            for d in range(self.bidirect):
+                idx = layer * self.bidirect + d
+                w = self._all_weights[idx]
+                params = w if self.MODE in ("LSTM", "GRU") else \
+                    (*w, self.activation)
+                seq = out if d == 0 else M.flip(out, [0])
+                if self.MODE == "LSTM":
+                    h0 = initial_states[0][idx]
+                    c0 = initial_states[1][idx]
+                    ys, (hT, cT) = _rnn_scan(seq, (h0, c0), params,
+                                             mode=self.MODE)
+                    final_c.append(cT)
+                else:
+                    h0 = initial_states[idx]
+                    ys, hT = _rnn_scan(seq, h0, params, mode=self.MODE)
+                final_h.append(hT)
+                if d == 1:
+                    ys = M.flip(ys, [0])
+                dir_outs.append(ys)
+            out = dir_outs[0] if self.bidirect == 1 else \
+                M.concat(dir_outs, axis=-1)
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        if not self.time_major:
+            out = M.transpose(out, [1, 0, 2])
+        h_stack = M.stack(final_h, axis=0)
+        if self.MODE == "LSTM":
+            c_stack = M.stack(final_c, axis=0)
+            return out, (h_stack, c_stack)
+        return out, h_stack
+
+
+class SimpleRNN(RNNBase):
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation, **kwargs)
+
+
+class LSTM(RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(RNNBase):
+    MODE = "GRU"
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (reference: nn/layer/rnn.py:RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from paddle_tpu.tensor import manipulation as M
+        x = inputs
+        if not self.time_major:
+            x = M.transpose(x, [1, 0, 2])
+        if self.is_reverse:
+            x = M.flip(x, [0])
+        states = initial_states
+        outs = []
+        for tstep in range(x.shape[0]):
+            y, states = self.cell(x[tstep], states)
+            outs.append(y)
+        out = M.stack(outs, axis=0)
+        if self.is_reverse:
+            out = M.flip(out, [0])
+        if not self.time_major:
+            out = M.transpose(out, [1, 0, 2])
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from paddle_tpu.tensor import manipulation as M
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        o_fw, f_fw = self.rnn_fw(inputs, s_fw)
+        o_bw, f_bw = self.rnn_bw(inputs, s_bw)
+        return M.concat([o_fw, o_bw], axis=-1), (f_fw, f_bw)

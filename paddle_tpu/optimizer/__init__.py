@@ -1,0 +1,454 @@
+"""Optimizers (reference: python/paddle/optimizer/optimizer.py + adam.py,
+adamw.py, sgd.py, momentum.py, rmsprop.py, adagrad.py, lamb.py).
+
+Two execution paths share ONE update rule per optimizer:
+- eager `opt.step()`: per-parameter jitted rule application (the reference's
+  C++ adam kernels become one XLA executable per shape, cached);
+- functional `opt.init_state_arrays()` / `opt.apply_gradients_arrays()`:
+  pure pytree->pytree update used inside fused jit train steps and under
+  shard_map for ZeRO-style sharded updates (SURVEY.md §2.5 sharding).
+
+Master weights (`multi_precision`) follow the reference's AMP-O2 contract:
+state keeps an fp32 copy for low-precision params.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, Parameter
+from paddle_tpu.core.tape import no_grad
+from paddle_tpu.optimizer import lr as lr_mod
+from paddle_tpu.optimizer.lr import LRScheduler
+
+
+def _global_norm_clip(grads, clip_norm):
+    flat = [g for g in grads if g is not None]
+    if not flat:
+        return grads
+    gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                      for g in flat))
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gn, 1e-12))
+    return [None if g is None else (g * scale).astype(g.dtype)
+            for g in grads]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        if parameters is None:
+            raise ValueError(
+                "paddle_tpu optimizers require an explicit parameter list")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, (int, float)) or weight_decay is None:
+            self._weight_decay = float(weight_decay or 0.0)
+            self._decay_mode = "l2"
+        else:  # L1Decay/L2Decay objects
+            self._weight_decay = float(getattr(weight_decay, "_coeff",
+                                               getattr(weight_decay,
+                                                       "coeff", 0.0)))
+            self._decay_mode = "l1" if type(weight_decay).__name__ == \
+                "L1Decay" else "l2"
+        self._states: dict[int, dict] = {}
+        self._step_count = 0
+        self._rule_jit = jax.jit(self._rule_with_state)
+
+    # ---- subclass API ----------------------------------------------------
+    def _init_state(self, p_arr) -> dict:
+        return {}
+
+    def _rule(self, p, g, state, lr, wd):
+        """Return (new_p, new_state). `wd` is the weight-decay coefficient
+        for THIS parameter (0.0 when excluded by apply_decay_param_fun)."""
+        raise NotImplementedError
+
+    def _decay_term(self, p, wd):
+        """L2 adds wd*p to the grad; L1 adds wd*sign(p) (reference:
+        python/paddle/regularizer.py L1Decay/L2Decay)."""
+        if self._decay_mode == "l1":
+            return wd * jnp.sign(p)
+        return wd * p
+
+    def _wd_for(self, p):
+        fn = getattr(self, "_apply_decay_fun", None)
+        if fn is not None and not fn(p.name):
+            return 0.0
+        return self._weight_decay
+
+    # ---- helpers ---------------------------------------------------------
+    def _rule_with_state(self, p, g, state, lr, wd):
+        master = state.get("master") if self._multi_precision else None
+        new_p, new_state = self._rule(
+            master if master is not None else p, g, state, lr, wd)
+        if master is not None:
+            new_state = dict(new_state)
+            new_state["master"] = new_p
+            new_p = new_p.astype(p.dtype)
+        return new_p, new_state
+
+    def _lr_value(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def get_lr(self):
+        return self._lr_value()
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("optimizer's learning rate is a scheduler; "
+                               "call scheduler.step() instead")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # ---- eager step ------------------------------------------------------
+    @no_grad()
+    def step(self):
+        self._step_count += 1
+        lr = jnp.asarray(self._lr_value(), jnp.float32)
+        params = [p for p in self._parameter_list
+                  if (not p.stop_gradient) and p.grad is not None]
+        grads = [p.grad._value for p in params]
+        if self._grad_clip is not None:
+            cn = getattr(self._grad_clip, "clip_norm", None)
+            if cn is not None and type(self._grad_clip).__name__ == \
+                    "ClipGradByGlobalNorm":
+                grads = _global_norm_clip(grads, cn)
+            elif type(self._grad_clip).__name__ == "ClipGradByNorm":
+                grads = [g if g is None else _global_norm_clip([g], cn)[0]
+                         for g in grads]
+            elif type(self._grad_clip).__name__ == "ClipGradByValue":
+                grads = [jnp.clip(g, self._grad_clip.min,
+                                  self._grad_clip.max) for g in grads]
+        for p, g in zip(params, grads):
+            sid = id(p)
+            if sid not in self._states:
+                st = self._init_state(p._value)
+                if self._multi_precision and p._value.dtype != jnp.float32:
+                    st["master"] = p._value.astype(jnp.float32)
+                self._states[sid] = st
+            new_p, new_state = self._rule_jit(
+                p._value, g, self._states[sid], lr,
+                jnp.asarray(self._wd_for(p), jnp.float32))
+            p._value = new_p
+            self._states[sid] = new_state
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # ---- state dict ------------------------------------------------------
+    def state_dict(self):
+        out = {"_step_count": self._step_count}
+        for i, p in enumerate(self._parameter_list):
+            st = self._states.get(id(p))
+            if st:
+                for k, v in st.items():
+                    out[f"param{i}.{k}"] = Tensor(v) if isinstance(
+                        v, jax.Array) else v
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, sd):
+        self._step_count = sd.get("_step_count", 0)
+        if "LR_Scheduler" in sd and isinstance(self._learning_rate,
+                                               LRScheduler):
+            self._learning_rate.set_state_dict(sd["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list):
+            st = {}
+            prefix = f"param{i}."
+            for k, v in sd.items():
+                if isinstance(k, str) and k.startswith(prefix):
+                    st[k[len(prefix):]] = v._value if isinstance(
+                        v, Tensor) else v
+            if st:
+                self._states[id(p)] = st
+
+    # ---- functional path (for jit train steps / sharded updates) --------
+    def init_state_arrays(self, params: dict):
+        state = {}
+        for name, arr in params.items():
+            st = self._init_state(arr)
+            if self._multi_precision and arr.dtype != jnp.float32:
+                st["master"] = arr.astype(jnp.float32)
+            state[name] = st
+        return state
+
+    def apply_gradients_arrays(self, params: dict, grads: dict, state: dict,
+                               lr):
+        """Pure: returns (new_params, new_state). Used inside jit."""
+        if self._grad_clip is not None and type(
+                self._grad_clip).__name__ == "ClipGradByGlobalNorm":
+            names = list(grads)
+            clipped = _global_norm_clip([grads[n] for n in names],
+                                        self._grad_clip.clip_norm)
+            grads = dict(zip(names, clipped))
+        new_params, new_state = {}, {}
+        for name, p in params.items():
+            g = grads.get(name)
+            if g is None:
+                new_params[name] = p
+                new_state[name] = state[name]
+                continue
+            wd = self._weight_decay
+            fn = getattr(self, "_apply_decay_fun", None)
+            if fn is not None and not fn(name):
+                wd = 0.0
+            np_, ns = self._rule_with_state(p, g, state[name], lr, wd)
+            new_params[name] = np_
+            new_state[name] = ns
+        return new_params, new_state
+
+
+class SGD(Optimizer):
+    """Reference: python/paddle/optimizer/sgd.py."""
+
+    def _rule(self, p, g, state, lr, wd):
+        g = g.astype(p.dtype)
+        g = g + self._decay_term(p, wd).astype(p.dtype)
+        return p - lr.astype(p.dtype) * g, {k: v for k, v in state.items()
+                                            if k == "master"}
+
+
+class Momentum(Optimizer):
+    """Reference: python/paddle/optimizer/momentum.py."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros(p.shape, jnp.float32)}
+
+    def _rule(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        g = g + self._decay_term(p.astype(jnp.float32), wd)
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            upd = g + self._momentum * v
+        else:
+            upd = v
+        new_p = p - (lr * upd).astype(p.dtype)
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """Reference: python/paddle/optimizer/adam.py."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 amsgrad=False, name=None):
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+        self._amsgrad = amsgrad
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        st = {"moment1": jnp.zeros(p.shape, jnp.float32),
+              "moment2": jnp.zeros(p.shape, jnp.float32),
+              "beta1_pow": jnp.ones((), jnp.float32),
+              "beta2_pow": jnp.ones((), jnp.float32)}
+        if self._amsgrad:
+            st["moment2_max"] = jnp.zeros(p.shape, jnp.float32)
+        return st
+
+    def _decoupled(self):
+        return False
+
+    def _rule(self, p, g, state, lr, wd):
+        pf = p.astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        if not self._decoupled():
+            g = g + self._decay_term(pf, wd)
+        b1p = state["beta1_pow"] * self._beta1
+        b2p = state["beta2_pow"] * self._beta2
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        new_state = {"moment1": m1, "moment2": m2, "beta1_pow": b1p,
+                     "beta2_pow": b2p}
+        if self._amsgrad:
+            m2h = jnp.maximum(state["moment2_max"], m2)
+            new_state["moment2_max"] = m2h
+        else:
+            m2h = m2
+        m1_hat = m1 / (1 - b1p)
+        m2_hat = m2h / (1 - b2p)
+        upd = m1_hat / (jnp.sqrt(m2_hat) + self._eps)
+        if self._decoupled():
+            upd = upd + wd * pf
+        new_p = (pf - lr * upd).astype(p.dtype)
+        return new_p, new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        self._apply_decay_fun = apply_decay_param_fun
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         amsgrad, name)
+
+    def _decoupled(self):
+        return True
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, multi_precision=False,
+                 name=None):
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        return {"moment": jnp.full(p.shape, self._init_acc, jnp.float32)}
+
+    def _rule(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        g = g + self._decay_term(p.astype(jnp.float32), wd)
+        acc = state["moment"] + g * g
+        new_p = (p.astype(jnp.float32) -
+                 lr * g / (jnp.sqrt(acc) + self._eps)).astype(p.dtype)
+        return new_p, {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._rho = rho
+        self._eps = epsilon
+        self._momentum = momentum
+        self._centered = centered
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        return {"mean_square": jnp.zeros(p.shape, jnp.float32),
+                "mean_grad": jnp.zeros(p.shape, jnp.float32),
+                "momentum_acc": jnp.zeros(p.shape, jnp.float32)}
+
+    def _rule(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        g = g + self._decay_term(p.astype(jnp.float32), wd)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum_acc"] + lr * g / denom
+        new_p = (p.astype(jnp.float32) - mom).astype(p.dtype)
+        return new_p, {"mean_square": ms, "mean_grad": mg,
+                       "momentum_acc": mom}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros(p.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p.shape, jnp.float32),
+                "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def _rule(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        g = g + self._decay_term(p.astype(jnp.float32), wd)
+        b1p = state["beta1_pow"] * self._beta1
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        new_p = (p.astype(jnp.float32) -
+                 lr / (1 - b1p) * m / (u + self._eps)).astype(p.dtype)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Lamb(Optimizer):
+    """Reference: python/paddle/optimizer/lamb.py."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, multi_precision, name)
+
+    def _wd_for(self, p):
+        # Lamb's exclude hook receives the parameter itself (reference:
+        # optimizer/lamb.py exclude_from_weight_decay_fn)
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return self._weight_decay
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros(p.shape, jnp.float32),
+                "moment2": jnp.zeros(p.shape, jnp.float32),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _rule(self, p, g, state, lr, wd):
+        pf = p.astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        b1p = state["beta1_pow"] * self._beta1
+        b2p = state["beta2_pow"] * self._beta2
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        r = m1 / (1 - b1p) / (jnp.sqrt(m2 / (1 - b2p)) + self._eps)
+        r = r + wd * pf
+        w_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = (pf - lr * trust * r).astype(p.dtype)
+        return new_p, {"moment1": m1, "moment2": m2, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff

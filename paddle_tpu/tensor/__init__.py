@@ -1,0 +1,305 @@
+"""Functional tensor API + Tensor method patching.
+
+Reference: python/paddle/tensor/__init__.py, which monkey-patches the
+generated pybind Tensor with python methods (monkey_patch_tensor). We do the
+same: every functional op in the submodules is also attached as a Tensor
+method, and the arithmetic dunders route to the defop'd functions so that
+`x + y` records on the autograd tape exactly like paddle's `add` ad_func.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, Parameter, to_tensor, is_tensor
+from paddle_tpu.core.dispatch import defop
+
+from paddle_tpu.tensor.creation import *  # noqa: F401,F403
+from paddle_tpu.tensor.math import *  # noqa: F401,F403
+from paddle_tpu.tensor.manipulation import *  # noqa: F401,F403
+from paddle_tpu.tensor.linalg import *  # noqa: F401,F403
+from paddle_tpu.tensor.logic import *  # noqa: F401,F403
+from paddle_tpu.tensor.search import *  # noqa: F401,F403
+from paddle_tpu.tensor.stat import *  # noqa: F401,F403
+from paddle_tpu.tensor.random import *  # noqa: F401,F403
+from paddle_tpu.tensor.einsum import einsum  # noqa: F401
+from paddle_tpu.tensor import attribute  # noqa: F401
+from paddle_tpu.tensor.attribute import shape as shape_op  # noqa: F401
+from paddle_tpu.tensor.attribute import numel, rank  # noqa: F401
+
+from paddle_tpu.tensor import (creation, math, manipulation, linalg, logic,
+                               search, stat)
+from paddle_tpu.tensor import random as random_mod
+
+
+# ---------------------------------------------------------------------------
+# Indexing
+# ---------------------------------------------------------------------------
+@defop("getitem")
+def _getitem(x, idx):
+    return x[idx]
+
+
+@defop("setitem_value")
+def _set_value_at(x, idx, value):
+    v = value
+    return x.at[idx].set(v)
+
+
+def _normalize_index(idx):
+    """Convert Tensor indices to arrays; detect bool-mask (dynamic shape)."""
+    has_bool = [False]
+
+    def conv(i):
+        if isinstance(i, Tensor):
+            if i.dtype == np.dtype(bool):
+                has_bool[0] = True
+            return i
+        if isinstance(i, np.ndarray) and i.dtype == bool:
+            has_bool[0] = True
+        return i
+
+    if isinstance(idx, tuple):
+        out = tuple(conv(i) for i in idx)
+    else:
+        out = conv(idx)
+    return out, has_bool[0]
+
+
+def _tensor_getitem(self, idx):
+    idx, has_bool = _normalize_index(idx)
+    if has_bool:
+        # dynamic output shape: host fallback, non-differentiable
+        np_idx = jax.tree.map(
+            lambda i: np.asarray(i._value) if isinstance(i, Tensor) else i,
+            idx, is_leaf=lambda i: isinstance(i, Tensor))
+        return Tensor(jnp.asarray(np.asarray(self._value)[np_idx]))
+    return _getitem(self, idx)
+
+
+def _tensor_setitem(self, idx, value):
+    idx, has_bool = _normalize_index(idx)
+    if not isinstance(value, Tensor):
+        value = Tensor(jnp.asarray(value, dtype=self._value.dtype))
+    if value.dtype != self.dtype:
+        value = value.astype(self.dtype)
+    if has_bool:
+        # numpy semantics (compacted value arrays, masks inside tuples)
+        # need dynamic shapes -> host fallback; non-differentiable
+        np_x = np.asarray(self._value).copy()
+        np_idx = jax.tree.map(
+            lambda i: np.asarray(i._value) if isinstance(i, Tensor) else i,
+            idx, is_leaf=lambda i: isinstance(i, Tensor))
+        np_x[np_idx] = np.asarray(value._value)
+        new = Tensor(jnp.asarray(np_x))
+    else:
+        new = _set_value_at(self, idx, value)
+    self._inplace_assign(new)
+
+
+# ---------------------------------------------------------------------------
+# Operator dunders
+# ---------------------------------------------------------------------------
+def _patch():
+    T = Tensor
+    T.__getitem__ = _tensor_getitem
+    T.__setitem__ = _tensor_setitem
+
+    T.__add__ = lambda s, o: math.add(s, o)
+    T.__radd__ = lambda s, o: math.add(s, o)
+    T.__sub__ = lambda s, o: math.subtract(s, o)
+    T.__rsub__ = lambda s, o: math.subtract(_as_t(o, s), s)
+    T.__mul__ = lambda s, o: math.multiply(s, o)
+    T.__rmul__ = lambda s, o: math.multiply(s, o)
+    T.__truediv__ = lambda s, o: math.divide(s, o)
+    T.__rtruediv__ = lambda s, o: math.divide(_as_t(o, s), s)
+    T.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    T.__rfloordiv__ = lambda s, o: math.floor_divide(_as_t(o, s), s)
+    T.__mod__ = lambda s, o: math.mod(s, o)
+    T.__rmod__ = lambda s, o: math.mod(_as_t(o, s), s)
+    T.__pow__ = lambda s, o: math.pow(s, o)
+    T.__rpow__ = lambda s, o: math.pow(_as_t(o, s), s)
+    T.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: linalg.matmul(_as_t(o, s), s)
+    T.__neg__ = lambda s: math.neg(s)
+    T.__abs__ = lambda s: math.abs(s)
+    T.__pos__ = lambda s: s
+
+    T.__eq__ = lambda s, o: logic.equal(s, o)
+    T.__ne__ = lambda s, o: logic.not_equal(s, o)
+    T.__lt__ = lambda s, o: logic.less_than(s, o)
+    T.__le__ = lambda s, o: logic.less_equal(s, o)
+    T.__gt__ = lambda s, o: logic.greater_than(s, o)
+    T.__ge__ = lambda s, o: logic.greater_equal(s, o)
+
+    T.__and__ = lambda s, o: logic.logical_and(s, o) \
+        if s.dtype == np.dtype(bool) else logic.bitwise_and(s, o)
+    T.__or__ = lambda s, o: logic.logical_or(s, o) \
+        if s.dtype == np.dtype(bool) else logic.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: logic.logical_xor(s, o) \
+        if s.dtype == np.dtype(bool) else logic.bitwise_xor(s, o)
+    T.__invert__ = lambda s: logic.logical_not(s) \
+        if s.dtype == np.dtype(bool) else logic.bitwise_not(s)
+    T.__lshift__ = lambda s, o: logic.bitwise_left_shift(s, o)
+    T.__rshift__ = lambda s, o: logic.bitwise_right_shift(s, o)
+
+    # in-place arithmetic (paddle: add_, etc.)
+    def _inplace(fn):
+        def m(self, *a, **k):
+            return self._inplace_assign(fn(self, *a, **k))
+        return m
+
+    methods = {
+        # math
+        "add": math.add, "subtract": math.subtract, "multiply": math.multiply,
+        "divide": math.divide, "floor_divide": math.floor_divide,
+        "mod": math.mod, "remainder": math.mod, "pow": math.pow,
+        "maximum": math.maximum, "minimum": math.minimum,
+        "fmax": math.fmax, "fmin": math.fmin,
+        "abs": math.abs, "neg": math.neg, "sign": math.sign,
+        "exp": math.exp, "expm1": math.expm1, "log": math.log,
+        "log2": math.log2, "log10": math.log10, "log1p": math.log1p,
+        "sqrt": math.sqrt, "rsqrt": math.rsqrt, "square": math.square,
+        "reciprocal": math.reciprocal, "sin": math.sin, "cos": math.cos,
+        "tan": math.tan, "asin": math.asin, "acos": math.acos,
+        "atan": math.atan, "sinh": math.sinh, "cosh": math.cosh,
+        "tanh": math.tanh, "asinh": math.asinh, "acosh": math.acosh,
+        "atanh": math.atanh, "erf": math.erf, "erfinv": math.erfinv,
+        "sigmoid": math.sigmoid, "floor": math.floor, "ceil": math.ceil,
+        "round": math.round, "trunc": math.trunc, "frac": math.frac,
+        "conj": math.conj, "real": math.real, "imag": math.imag,
+        "angle": math.angle, "lgamma": math.lgamma, "digamma": math.digamma,
+        "isfinite": math.isfinite, "isinf": math.isinf, "isnan": math.isnan,
+        "sum": math.sum, "mean": math.mean, "max": math.max, "min": math.min,
+        "amax": math.amax, "amin": math.amin, "prod": math.prod,
+        "logsumexp": math.logsumexp, "all": math.all, "any": math.any,
+        "cumsum": math.cumsum, "cumprod": math.cumprod,
+        "clip": math.clip, "scale": math.scale, "lerp": math.lerp,
+        "trace": math.trace, "diagonal": math.diagonal, "diff": math.diff,
+        "nan_to_num": math.nan_to_num, "count_nonzero": math.count_nonzero,
+        "atan2": math.atan2, "outer": math.outer, "inner": math.inner,
+        "addmm": math.addmm, "logit": math.logit, "heaviside": math.heaviside,
+        # stat
+        "std": stat.std, "var": stat.var, "median": stat.median,
+        "quantile": stat.quantile, "nanquantile": stat.nanquantile,
+        "nanmedian": stat.nanmedian, "histogram": stat.histogram,
+        "bincount": stat.bincount,
+        # manipulation
+        "reshape": manipulation.reshape, "reshape_": manipulation.reshape_,
+        "transpose": manipulation.transpose, "squeeze": manipulation.squeeze,
+        "squeeze_": manipulation.squeeze_, "unsqueeze": manipulation.unsqueeze,
+        "unsqueeze_": manipulation.unsqueeze_, "flatten": manipulation.flatten,
+        "flatten_": manipulation.flatten_, "tile": manipulation.tile,
+        "expand": manipulation.expand, "expand_as": manipulation.expand_as,
+        "broadcast_to": manipulation.broadcast_to, "flip": manipulation.flip,
+        "roll": manipulation.roll, "gather": manipulation.gather,
+        "gather_nd": manipulation.gather_nd, "scatter": manipulation.scatter,
+        "scatter_": manipulation.scatter_,
+        "scatter_nd_add": manipulation.scatter_nd_add,
+        "index_select": manipulation.index_select,
+        "index_sample": manipulation.index_sample,
+        "index_add": manipulation.index_add,
+        "masked_select": manipulation.masked_select,
+        "masked_fill": manipulation.masked_fill,
+        "take_along_axis": manipulation.take_along_axis,
+        "put_along_axis": manipulation.put_along_axis,
+        "split": manipulation.split, "chunk": manipulation.chunk,
+        "unbind": manipulation.unbind, "repeat_interleave":
+            manipulation.repeat_interleave, "where": None,
+        "moveaxis": manipulation.moveaxis, "swapaxes": manipulation.swapaxes,
+        "unique": manipulation.unique, "pad": manipulation.pad,
+        "slice": manipulation.slice, "unfold": manipulation.unfold,
+        "view": manipulation.view, "view_as": manipulation.view_as,
+        "as_strided": manipulation.as_strided,
+        "tensor_split": manipulation.tensor_split,
+        # linalg
+        "matmul": linalg.matmul, "mm": linalg.mm, "bmm": linalg.bmm,
+        "dot": linalg.dot, "mv": linalg.mv, "t": linalg.t,
+        "norm": linalg.norm, "dist": linalg.dist, "cross": linalg.cross,
+        "cholesky": linalg.cholesky, "inverse": linalg.inverse,
+        "matrix_power": linalg.matrix_power, "det": linalg.det,
+        "tensordot": linalg.tensordot, "kron": math.kron,
+        # logic
+        "equal": logic.equal, "not_equal": logic.not_equal,
+        "greater_than": logic.greater_than, "greater_equal":
+            logic.greater_equal, "less_than": logic.less_than,
+        "less_equal": logic.less_equal, "logical_and": logic.logical_and,
+        "logical_or": logic.logical_or, "logical_xor": logic.logical_xor,
+        "logical_not": logic.logical_not, "bitwise_and": logic.bitwise_and,
+        "bitwise_or": logic.bitwise_or, "bitwise_xor": logic.bitwise_xor,
+        "bitwise_not": logic.bitwise_not, "isclose": logic.isclose,
+        "allclose": logic.allclose, "equal_all": logic.equal_all,
+        "is_empty": logic.is_empty,
+        # search
+        "argmax": search.argmax, "argmin": search.argmin,
+        "argsort": search.argsort, "sort": search.sort, "topk": search.topk,
+        "kthvalue": search.kthvalue, "mode": search.mode,
+        "nonzero": search.nonzero, "searchsorted": search.searchsorted,
+        "bucketize": search.bucketize,
+        # creation-ish
+        "diag": creation.diag, "tril": creation.tril, "triu": creation.triu,
+        # random
+        "normal_": random_mod.normal_, "uniform_": random_mod.uniform_,
+        "exponential_": random_mod.exponential_,
+        "bernoulli_": random_mod.bernoulli_,
+        # attribute
+        "numel": numel, "rank_fn": rank,
+    }
+    for name, fn in methods.items():
+        if fn is None:
+            continue
+        setattr(T, name, _method(fn))
+
+    T.where = lambda s, x=None, y=None, name=None: manipulation.where(s, x, y)
+    # inplace arithmetic variants
+    for nm, fn in [("add_", math.add), ("subtract_", math.subtract),
+                   ("multiply_", math.multiply), ("divide_", math.divide),
+                   ("scale_", math.scale), ("clip_", math.clip),
+                   ("floor_", math.floor), ("ceil_", math.ceil),
+                   ("exp_", math.exp), ("sqrt_", math.sqrt),
+                   ("rsqrt_", math.rsqrt), ("reciprocal_", math.reciprocal),
+                   ("round_", math.round), ("abs_", math.abs),
+                   ("tanh_", math.tanh), ("pow_", math.pow),
+                   ("remainder_", math.mod), ("lerp_", math.lerp),
+                   ("masked_fill_", manipulation.masked_fill)]:
+        setattr(T, nm, _inplace(fn))
+
+    # paddle: x.cuda()/cpu()/to() are placement ops; PjRt owns placement.
+    T.cuda = lambda s, *a, **k: s
+    T.cpu = lambda s: Tensor(np.asarray(s._value), stop_gradient=s.stop_gradient)
+    T.pin_memory = lambda s: s
+    T.to = _tensor_to
+
+
+def _tensor_to(self, *args, **kwargs):
+    dtype = kwargs.get("dtype")
+    for a in args:
+        if isinstance(a, (str, np.dtype)) and str(a) not in ("cpu", "gpu", "tpu"):
+            try:
+                from paddle_tpu.core.dtype import convert_dtype
+                dtype = convert_dtype(a)
+            except (ValueError, TypeError):
+                pass
+        elif isinstance(a, Tensor):
+            dtype = a.dtype
+    if dtype is not None and np.dtype(dtype) != self.dtype:
+        return self.astype(dtype)
+    return self
+
+
+def _as_t(o, like):
+    if isinstance(o, Tensor):
+        return o
+    return Tensor(jnp.asarray(o, dtype=like._value.dtype
+                              if isinstance(o, (int, float, bool)) else None))
+
+
+def _method(fn):
+    def m(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+    m.__name__ = getattr(fn, "__name__", "method")
+    return m
+
+
+_patch()

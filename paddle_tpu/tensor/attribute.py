@@ -1,0 +1,43 @@
+"""Tensor attribute helpers (reference: python/paddle/tensor/attribute.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+
+def shape(x):
+    """Returns the shape as an int32 tensor (paddle.shape semantics)."""
+    return Tensor(jnp.asarray(x.shape, dtype=jnp.int32))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(x.ndim, dtype=jnp.int32))
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def is_complex(x):
+    return np.issubdtype(x.dtype, np.complexfloating)
+
+
+def is_integer(x):
+    return np.issubdtype(x.dtype, np.integer)
+
+
+def is_floating_point(x):
+    return np.issubdtype(np.dtype(x.dtype), np.floating) or \
+        str(x.dtype) == "bfloat16"
+
+
+def real(x, name=None):
+    from paddle_tpu.tensor.math import real as _real
+    return _real(x)
+
+
+def imag(x, name=None):
+    from paddle_tpu.tensor.math import imag as _imag
+    return _imag(x)

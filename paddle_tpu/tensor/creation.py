@@ -1,0 +1,177 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtypes
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor, to_tensor  # re-export to_tensor
+
+
+def _mk(arr, dtype=None) -> Tensor:
+    return Tensor(arr if dtype is None else arr.astype(dtypes.convert_dtype(dtype)))
+
+
+def zeros(shape, dtype="float32", name=None):
+    return Tensor(jnp.zeros(_shape(shape), dtypes.convert_dtype(dtype)))
+
+
+def ones(shape, dtype="float32", name=None):
+    return Tensor(jnp.ones(_shape(shape), dtypes.convert_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype="float32", name=None):
+    fv = fill_value.item() if isinstance(fill_value, Tensor) else fill_value
+    return Tensor(jnp.full(_shape(shape), fv, dtypes.convert_dtype(dtype)))
+
+
+def empty(shape, dtype="float32", name=None):
+    return zeros(shape, dtype)
+
+
+@defop("zeros_like", differentiable=False)
+def _zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtypes.convert_dtype(dtype))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return _zeros_like(x, dtype=dtype)
+
+
+@defop("ones_like", differentiable=False)
+def _ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=dtypes.convert_dtype(dtype))
+
+
+def ones_like(x, dtype=None, name=None):
+    return _ones_like(x, dtype=dtype)
+
+
+@defop("full_like", differentiable=False)
+def _full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=dtypes.convert_dtype(dtype))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    fv = fill_value.item() if isinstance(fill_value, Tensor) else fill_value
+    return _full_like(x, fv, dtype=dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    dt = dtypes.convert_dtype(dtype)
+    if dt is None:
+        dt = (np.dtype("int64") if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else np.dtype("float32"))
+    return Tensor(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item() if isinstance(num, Tensor) else num)
+    return Tensor(jnp.linspace(start, stop, num,
+                               dtype=dtypes.convert_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype="float32", name=None):
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=base,
+                               dtype=dtypes.convert_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype="float32", name=None):
+    return Tensor(jnp.eye(num_rows, num_columns,
+                          dtype=dtypes.convert_dtype(dtype)))
+
+
+@defop("diag")
+def _diag(x, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    if padding_value != 0:
+        base = _diag(x, offset=offset)
+        from paddle_tpu.tensor.logic import equal
+        mask = Tensor(jnp.eye(*base._value.shape, k=offset, dtype=bool)
+                      if base.ndim == 2 else jnp.ones_like(base._value, bool))
+        return Tensor(jnp.where(mask._value, base._value, padding_value))
+    return _diag(x, offset=offset)
+
+
+@defop("diagflat")
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@defop("tril")
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@defop("triu")
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtypes.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtypes.convert_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    arrays = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+              for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return [Tensor(g) for g in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+@defop("assign")
+def _assign(x):
+    return jnp.asarray(x)
+
+
+def assign(x, output=None):
+    x = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    out = _assign(x)
+    if output is not None:
+        output._inplace_assign(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    from paddle_tpu.tensor.manipulation import clone as _clone
+    return _clone(x)
+
+
+def complex(real, imag, name=None):
+    return Tensor(jax.lax.complex(real._value, imag._value))
+
+
+def polar(abs, angle, name=None):
+    return Tensor(jax.lax.complex(abs._value * jnp.cos(angle._value),
+                                  abs._value * jnp.sin(angle._value)))
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)

@@ -1,0 +1,19 @@
+"""Einsum (reference: python/paddle/tensor/einsum.py — 1k-LoC planner).
+
+The reference hand-builds a contraction plan over matmul/transpose ops; on
+TPU we delegate straight to jnp.einsum, which lowers to XLA dot_general and
+rides the MXU with optimal contraction ordering from opt_einsum.
+"""
+from __future__ import annotations
+
+from paddle_tpu.core.dispatch import defop
+import jax.numpy as jnp
+
+
+@defop("einsum", amp_policy="white")
+def _einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    return _einsum(equation, *operands)

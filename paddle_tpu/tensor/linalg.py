@@ -1,0 +1,291 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py).
+
+matmul rides the MXU — it is the single most important op for TPU perf;
+everything here lowers to XLA dot_general / LAPACK-on-host fallbacks.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor
+
+
+@defop("matmul", amp_policy="white",
+       spmd_note="contracting dims reduce over mesh axes; see MatmulInferSpmd "
+                 "(reference: phi/infermeta/spmd_rules/matmul.cc)")
+def _matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim >= 2 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim >= 2 else y
+    return jnp.matmul(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+
+
+@defop("mm", amp_policy="white")
+def mm(input, mat2):
+    return jnp.matmul(input, mat2)
+
+
+@defop("bmm", amp_policy="white")
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@defop("dot")
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@defop("mv", amp_policy="white")
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@defop("t_op")
+def _t(x):
+    return x.T if x.ndim >= 2 else x
+
+
+def t(x, name=None):
+    return _t(x)
+
+
+@defop("cross")
+def cross(x, y, axis=9):
+    ax = axis if axis != 9 else next(
+        (i for i, s in enumerate(x.shape) if s == 3), -1)
+    return jnp.cross(x, y, axis=ax)
+
+
+@defop("norm", amp_policy="black")
+def _norm(x, p=2.0, axis=None, keepdim=False):
+    if p == "fro" or (p == 2.0 and axis is None):
+        return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=axis, keepdims=keepdim))
+    if p == "nuc":
+        s = jnp.linalg.svd(x, compute_uv=False)
+        return jnp.sum(s, axis=-1, keepdims=keepdim)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                             keepdims=keepdim), 1.0 / p)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+        if p is None:
+            p = "fro"
+    if p is None:
+        p = 2.0
+    return _norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def p_norm(x, p=2.0, axis=None, keepdim=False):
+    return _norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+@defop("dist", amp_policy="black")
+def dist(x, y, p=2.0):
+    d = x - y
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+
+@defop("cholesky")
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@defop("cholesky_solve")
+def cholesky_solve(x, y, upper=False):
+    L = jnp.swapaxes(y, -1, -2) if upper else y
+    z = jax.scipy.linalg.solve_triangular(L, x, lower=True)
+    return jax.scipy.linalg.solve_triangular(
+        jnp.swapaxes(L, -1, -2), z, lower=False)
+
+
+@defop("triangular_solve")
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@defop("inverse")
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+inv = inverse
+
+
+@defop("pinv")
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@defop("solve")
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@defop("lstsq", differentiable=False)
+def _lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    return _lstsq(x, y, rcond=rcond)
+
+
+@defop("det")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@defop("slogdet")
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet]) if sign.ndim == 0 else (sign, logdet)
+
+
+@defop("matrix_power")
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@defop("matrix_rank", differentiable=False)
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@defop("svd", differentiable=False)
+def _svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = _svd(x, full_matrices=full_matrices)
+    from paddle_tpu.tensor.manipulation import swapaxes
+    return u, s, swapaxes(vh, -1, -2)  # paddle returns V not V^H
+
+
+@defop("qr", differentiable=False)
+def _qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def qr(x, mode="reduced", name=None):
+    return _qr(x, mode=mode)
+
+
+@defop("eig", differentiable=False)
+def eig(x):
+    # jax.numpy.linalg.eig is CPU-only; pull to host
+    w, v = np.linalg.eig(np.asarray(x))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+@defop("eigh", differentiable=False)
+def _eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigh(x, UPLO="L", name=None):
+    return _eigh(x, UPLO=UPLO)
+
+
+@defop("eigvals", differentiable=False)
+def eigvals(x):
+    return jnp.asarray(np.linalg.eigvals(np.asarray(x)))
+
+
+@defop("eigvalsh", differentiable=False)
+def _eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return _eigvalsh(x, UPLO=UPLO)
+
+
+@defop("lu", differentiable=False)
+def _lu(x):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv + 1  # paddle pivots are 1-based
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = _lu(x)
+    from paddle_tpu.tensor.creation import zeros
+    if get_infos:
+        return lu_, piv, zeros([1], dtype="int32")
+    return lu_, piv
+
+
+@defop("matrix_exp")
+def matrix_exp(x):
+    return jax.scipy.linalg.expm(x)
+
+
+@defop("cond_op", differentiable=False)
+def _cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+def cond(x, p=None, name=None):
+    return _cond(x, p=p)
+
+
+@defop("householder_product")
+def householder_product(x, tau):
+    m, n = x.shape[-2], x.shape[-1]
+    Q = jnp.eye(m, dtype=x.dtype)
+    for i in range(n):
+        v = jnp.where(jnp.arange(m) == i, 1.0,
+                      jnp.where(jnp.arange(m) > i, x[..., :, i], 0.0))
+        H = jnp.eye(m, dtype=x.dtype) - tau[..., i] * jnp.outer(v, v)
+        Q = Q @ H
+    return Q[..., :, :n]
+
+
+def tensordot(x, y, axes=2, name=None):
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    if isinstance(axes, Tensor):
+        axes = np.asarray(axes._value).tolist()
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return Tensor(jnp.tensordot(xv, yv, axes=axes))
+
+
+def multi_dot(x, name=None):
+    return Tensor(jnp.linalg.multi_dot([t._value for t in x]))
+
+
+@defop("corrcoef")
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@defop("cov")
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)

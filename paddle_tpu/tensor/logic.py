@@ -1,0 +1,127 @@
+"""Comparison & logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor
+
+
+@defop("equal", differentiable=False)
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+@defop("not_equal", differentiable=False)
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+@defop("greater_than", differentiable=False)
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+@defop("greater_equal", differentiable=False)
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@defop("less_than", differentiable=False)
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+@defop("less_equal", differentiable=False)
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+@defop("logical_and", differentiable=False)
+def logical_and(x, y, out=None):
+    return jnp.logical_and(x, y)
+
+
+@defop("logical_or", differentiable=False)
+def logical_or(x, y, out=None):
+    return jnp.logical_or(x, y)
+
+
+@defop("logical_xor", differentiable=False)
+def logical_xor(x, y, out=None):
+    return jnp.logical_xor(x, y)
+
+
+@defop("logical_not", differentiable=False)
+def logical_not(x, out=None):
+    return jnp.logical_not(x)
+
+
+@defop("bitwise_and", differentiable=False)
+def bitwise_and(x, y, out=None):
+    return jnp.bitwise_and(x, y)
+
+
+@defop("bitwise_or", differentiable=False)
+def bitwise_or(x, y, out=None):
+    return jnp.bitwise_or(x, y)
+
+
+@defop("bitwise_xor", differentiable=False)
+def bitwise_xor(x, y, out=None):
+    return jnp.bitwise_xor(x, y)
+
+
+@defop("bitwise_not", differentiable=False)
+def bitwise_not(x, out=None):
+    return jnp.bitwise_not(x)
+
+
+@defop("bitwise_left_shift", differentiable=False)
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None):
+    return jnp.left_shift(x, y)
+
+
+@defop("bitwise_right_shift", differentiable=False)
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None):
+    return jnp.right_shift(x, y)
+
+
+@defop("isclose", differentiable=False)
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@defop("allclose", differentiable=False)
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def equal_all(x, y, name=None):
+    if tuple(x.shape) != tuple(y.shape):
+        return Tensor(jnp.asarray(False))
+    return Tensor(jnp.all(x._value == y._value))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+@defop("isreal", differentiable=False)
+def isreal(x):
+    return jnp.isreal(x)
+
+
+def is_complex(x):
+    import numpy as np
+    return np.issubdtype(x.dtype, np.complexfloating)
+
+
+def is_integer(x):
+    import numpy as np
+    return np.issubdtype(x.dtype, np.integer)
+
+
+def is_floating_point(x):
+    import numpy as np
+    return np.issubdtype(x.dtype, np.floating) or str(x.dtype) == "bfloat16"

@@ -1,0 +1,592 @@
+"""Shape / layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtypes
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        return tuple(int(i) for i in np.asarray(v._value))
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    return tuple(int(i.item()) if isinstance(i, Tensor) else int(i) for i in v)
+
+
+@defop("cast")
+def _cast(x, dtype):
+    return x.astype(dtypes.convert_dtype(dtype))
+
+
+def cast(x, dtype):
+    return _cast(x, dtype=dtypes.convert_dtype(dtype))
+
+
+@defop("clone")
+def clone(x):
+    return jnp.asarray(x).copy() if isinstance(x, np.ndarray) else x + 0
+
+
+@defop("reshape")
+def _reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    shape = tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                  for s in (shape if isinstance(shape, (list, tuple)) else _ints(shape)))
+    return _reshape(x, shape=shape)
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace_assign(reshape(x, shape))
+
+
+@defop("transpose")
+def _transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm, name=None):
+    return _transpose(x, perm=tuple(int(p) for p in perm))
+
+
+@defop("moveaxis")
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@defop("swapaxes")
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+transpose_ = None  # not supported (layout is XLA's concern)
+
+
+@defop("squeeze")
+def _squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    axes = tuple(a for a in axes if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+def squeeze(x, axis=None, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    return _squeeze(x, axis=axis)
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._inplace_assign(squeeze(x, axis))
+
+
+@defop("unsqueeze")
+def _unsqueeze(x, axis):
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    out = x
+    for a in sorted(a if a >= 0 else a + out.ndim + 1 for a in axes):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = _ints(axis)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    return _unsqueeze(x, axis=axis)
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._inplace_assign(unsqueeze(x, axis))
+
+
+@defop("concat")
+def _concat(xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _concat(list(x), axis=axis)
+
+
+@defop("stack")
+def _stack(xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return _stack(list(x), axis=axis)
+
+
+@defop("split_op")
+def _split(x, sections, axis):
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis=axis))
+    # list of sizes, possibly with one -1
+    sizes = list(sections)
+    if -1 in sizes:
+        known = sum(s for s in sizes if s != -1)
+        sizes[sizes.index(-1)] = x.shape[axis] - known
+    offsets = np.cumsum(sizes)[:-1].tolist()
+    return tuple(jnp.split(x, offsets, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if isinstance(num_or_sections, (list, tuple)):
+        num_or_sections = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                           for s in num_or_sections]
+    return list(_split(x, sections=num_or_sections, axis=int(axis)))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+@defop("unbind")
+def _unbind(x, axis=0):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def unbind(x, axis=0):
+    return list(_unbind(x, axis=axis))
+
+
+@defop("flatten_op")
+def _flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    shape = x.shape[:s] + (-1,) + x.shape[e + 1:]
+    return jnp.reshape(x, shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _flatten(x, start_axis=start_axis, stop_axis=stop_axis)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._inplace_assign(flatten(x, start_axis, stop_axis))
+
+
+@defop("tile")
+def _tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times, name=None):
+    return _tile(x, repeat_times=_ints(repeat_times))
+
+
+@defop("expand")
+def _expand(x, shape):
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s in (-1,) else s
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+def expand(x, shape, name=None):
+    return _expand(x, shape=_ints(shape))
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    arrays = jnp.broadcast_arrays(*[t._value for t in inputs])
+    return [Tensor(a) for a in arrays]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@defop("flip")
+def _flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    return _flip(x, axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return Tensor(jnp.rot90(x._value, k=k, axes=tuple(axes)))
+
+
+@defop("roll")
+def _roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, (list, tuple)):
+        shifts = tuple(int(s) for s in shifts)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    return _roll(x, shifts=shifts, axis=axis)
+
+
+@defop("pad_op")
+def _pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    if len(pad) == x.ndim * 2:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # paddle semantics: pad applies to the last len(pad)//2 dims,
+        # innermost dim first in the pad list (NCHW pad=[l,r,t,b] -> W gets
+        # (l,r), H gets (t,b)).
+        n = len(pad) // 2
+        cfg = [(0, 0)] * (x.ndim - n) + \
+            [(pad[2 * i], pad[2 * i + 1]) for i in range(n - 1, -1, -1)]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, mode=jmode, constant_values=value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    return _pad(x, pad=_ints(pad), mode=mode, value=value,
+                data_format=data_format)
+
+
+@defop("slice_op")
+def _slice(x, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = slice(s, e)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends):
+    return _slice(x, axes=_ints(axes), starts=_ints(starts), ends=_ints(ends))
+
+
+@defop("strided_slice_op")
+def _strided_slice(x, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return _strided_slice(x, axes=_ints(axes), starts=_ints(starts),
+                          ends=_ints(ends), strides=_ints(strides))
+
+
+@defop("gather")
+def _gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    idx = index
+    if isinstance(index, Tensor) and index.ndim == 2 and index.shape[1] == 1:
+        idx = index.reshape([-1])
+    return _gather(x, idx, axis=axis)
+
+
+@defop("gather_nd")
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@defop("take_along_axis")
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+
+@defop("put_along_axis")
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True):
+    values = jnp.broadcast_to(values, indices.shape) \
+        if not hasattr(values, "shape") or values.shape != indices.shape else values
+    dims = list(range(arr.ndim))
+    idx = [jnp.broadcast_to(
+        jnp.arange(indices.shape[d]).reshape(
+            [-1 if i == d else 1 for i in range(arr.ndim)]), indices.shape)
+        for d in dims]
+    idx[axis] = indices
+    at = arr.at[tuple(idx)]
+    if reduce == "assign":
+        return at.set(values)
+    if reduce in ("add", "sum"):
+        return at.add(values)
+    if reduce in ("mul", "multiply"):
+        return at.multiply(values)
+    if reduce == "amax":
+        return at.max(values)
+    if reduce == "amin":
+        return at.min(values)
+    raise ValueError(f"Unsupported reduce: {reduce}")
+
+
+@defop("scatter")
+def _scatter(x, index, updates, overwrite=True):
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index.reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _scatter(x, index, updates, overwrite=overwrite)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._inplace_assign(scatter(x, index, updates, overwrite))
+
+
+@defop("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from paddle_tpu.tensor.creation import zeros
+    z = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+@defop("index_select")
+def _index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0, name=None):
+    return _index_select(x, index, axis=axis)
+
+
+@defop("index_sample")
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@defop("index_add")
+def index_add(x, index, axis, value):
+    x_m = jnp.moveaxis(x, axis, 0)
+    v_m = jnp.moveaxis(value, axis, 0)
+    out = x_m.at[index].add(v_m)
+    return jnp.moveaxis(out, 0, axis)
+
+
+@defop("index_put")
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(indices)
+    return x.at[idx].add(value) if accumulate else x.at[idx].set(value)
+
+
+@defop("masked_select", differentiable=False)
+def masked_select(x, mask):
+    # dynamic-shape op: falls back to host (XLA needs static shapes)
+    xv = np.asarray(x)
+    mv = np.asarray(mask)
+    return jnp.asarray(xv[np.broadcast_to(mv, xv.shape)])
+
+
+@defop("masked_fill")
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+@defop("masked_scatter")
+def masked_scatter(x, mask, value):
+    mask_b = jnp.broadcast_to(mask, x.shape)
+    flat_mask = mask_b.reshape(-1)
+    pos = jnp.cumsum(flat_mask.astype(jnp.int32)) - 1
+    vals = value.reshape(-1)[jnp.clip(pos, 0, value.size - 1)]
+    return jnp.where(flat_mask, vals, x.reshape(-1)).reshape(x.shape)
+
+
+@defop("where")
+def _where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from paddle_tpu.tensor.search import nonzero
+        return nonzero(condition, as_tuple=True)
+    return _where(condition, x, y)
+
+
+@defop("repeat_interleave")
+def _repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = np.asarray(repeats._value)
+        total = int(repeats.sum())
+        return Tensor(jnp.repeat(x._value, jnp.asarray(repeats), axis=axis,
+                                 total_repeat_length=total))
+    return _repeat_interleave(x, repeats, axis=axis)
+
+
+@defop("as_strided")
+def as_strided(x, shape, stride, offset=0):
+    flat = x.reshape(-1)
+    idx = jnp.full(tuple(shape), offset)
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        r = jnp.arange(s).reshape([-1 if i == d else 1 for i in range(len(shape))])
+        idx = idx + r * st
+    return flat[idx]
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return Tensor(x._value.view(dtypes.convert_dtype(shape_or_dtype)))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def as_real(x, name=None):
+    v = x._value
+    return Tensor(jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1))
+
+
+def as_complex(x, name=None):
+    v = x._value
+    return Tensor(jax.lax.complex(v[..., 0], v[..., 1]))
+
+
+@defop("unfold")
+def unfold(x, axis, size, step):
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    idx = starts[:, None] + jnp.arange(size)[None, :]
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved[idx]  # (n, size, ...)
+    return jnp.moveaxis(out, (0, 1), (axis, x.ndim))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    xv = np.asarray(x._value)
+    res = np.unique(xv, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    out = [Tensor(jnp.asarray(r)) for r in res]
+    # paddle's return order: out, index, inverse, counts
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    xv = np.asarray(x._value)
+    if axis is None:
+        xv = xv.reshape(-1)
+        change = np.concatenate([[True], xv[1:] != xv[:-1]])
+    else:
+        raise NotImplementedError("unique_consecutive with axis")
+    out = xv[change]
+    rets = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        rets.append(Tensor(jnp.asarray(np.cumsum(change) - 1)))
+    if return_counts:
+        idx = np.flatnonzero(change)
+        counts = np.diff(np.append(idx, len(xv)))
+        rets.append(Tensor(jnp.asarray(counts)))
+    return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+@defop("crop")
+def crop(x, shape=None, offsets=None):
+    offsets = offsets or [0] * x.ndim
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    v = input._value
+    in_shard = (v // size) == shard_id
+    return Tensor(jnp.where(in_shard, v % size, ignore_value))
+
+
+def tensordot(x, y, axes=2, name=None):
+    from paddle_tpu.tensor.linalg import tensordot as _td
+    return _td(x, y, axes)
+
+
+@defop("atleast_1d")
+def atleast_1d(x):
+    return jnp.atleast_1d(x)
+
+
+@defop("atleast_2d")
+def atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+
+@defop("atleast_3d")
+def atleast_3d(x):
+    return jnp.atleast_3d(x)
+
+
+def vstack(x, name=None):
+    return Tensor(jnp.vstack([t._value for t in x]))
+
+
+def hstack(x, name=None):
+    return Tensor(jnp.hstack([t._value for t in x]))
+
+
+def dstack(x, name=None):
+    return Tensor(jnp.dstack([t._value for t in x]))
+
+
+def column_stack(x, name=None):
+    return Tensor(jnp.column_stack([t._value for t in x]))
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return [Tensor(a) for a in jnp.dsplit(x._value, num_or_indices)]
+
+
+def hsplit(x, num_or_indices, name=None):
+    return [Tensor(a) for a in jnp.hsplit(x._value, num_or_indices)]
+
+
+def vsplit(x, num_or_indices, name=None):
+    return [Tensor(a) for a in jnp.vsplit(x._value, num_or_indices)]
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return [Tensor(a) for a in jnp.array_split(
+        x._value, num_or_indices, axis=axis)]

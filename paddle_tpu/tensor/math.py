@@ -1,0 +1,593 @@
+"""Elementwise & reduction math ops (reference: python/paddle/tensor/math.py).
+
+Every op is a pure jax.numpy composition registered via defop — XLA fuses the
+elementwise chains; there is no per-op kernel to write (reference analog: the
+~950 CPU/GPU kernel files under paddle/phi/kernels/).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core import dtype as dtypes
+from paddle_tpu.core.tensor import Tensor
+
+
+def _unary(name, fn, amp="promote", diff=True):
+    op = defop(name, differentiable=diff, amp_policy=amp)(fn)
+    return op
+
+
+# ---- binary arithmetic -------------------------------------------------
+@defop("add")
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@defop("subtract")
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@defop("multiply")
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@defop("divide")
+def divide(x, y):
+    return jnp.true_divide(x, y)
+
+
+@defop("floor_divide", differentiable=False)
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@defop("mod", differentiable=False)
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+floor_mod = mod
+
+
+@defop("pow", amp_policy="black")
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+@defop("maximum")
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@defop("minimum")
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@defop("fmax")
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@defop("fmin")
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@defop("atan2")
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@defop("hypot")
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+@defop("logaddexp", amp_policy="black")
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+@defop("nextafter", differentiable=False)
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+@defop("copysign")
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+@defop("heaviside", differentiable=False)
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@defop("gcd", differentiable=False)
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@defop("lcm", differentiable=False)
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+@defop("inner")
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@defop("outer")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@defop("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+# ---- scalar-arg ops ----------------------------------------------------
+@defop("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return out
+
+
+@defop("clip")
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@defop("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@defop("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+# ---- unary -------------------------------------------------------------
+@defop("abs")
+def abs(x):
+    return jnp.abs(x)
+
+
+@defop("neg")
+def neg(x):
+    return jnp.negative(x)
+
+
+@defop("sign", differentiable=False)
+def sign(x):
+    return jnp.sign(x)
+
+
+@defop("sgn", differentiable=False)
+def sgn(x):
+    return jnp.sign(x)
+
+
+@defop("exp", amp_policy="black")
+def exp(x):
+    return jnp.exp(x)
+
+
+@defop("expm1", amp_policy="black")
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@defop("log", amp_policy="black")
+def log(x):
+    return jnp.log(x)
+
+
+@defop("log2", amp_policy="black")
+def log2(x):
+    return jnp.log2(x)
+
+
+@defop("log10", amp_policy="black")
+def log10(x):
+    return jnp.log10(x)
+
+
+@defop("log1p", amp_policy="black")
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@defop("sqrt")
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@defop("rsqrt")
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+@defop("square")
+def square(x):
+    return jnp.square(x)
+
+
+@defop("reciprocal")
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+@defop("sin")
+def sin(x):
+    return jnp.sin(x)
+
+
+@defop("cos")
+def cos(x):
+    return jnp.cos(x)
+
+
+@defop("tan")
+def tan(x):
+    return jnp.tan(x)
+
+
+@defop("asin")
+def asin(x):
+    return jnp.arcsin(x)
+
+
+@defop("acos")
+def acos(x):
+    return jnp.arccos(x)
+
+
+@defop("atan")
+def atan(x):
+    return jnp.arctan(x)
+
+
+@defop("sinh")
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@defop("cosh")
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@defop("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@defop("asinh")
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+@defop("acosh")
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+@defop("atanh")
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+@defop("erf")
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+@defop("erfinv")
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+@defop("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@defop("logit", amp_policy="black")
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@defop("floor", differentiable=False)
+def floor(x):
+    return jnp.floor(x)
+
+
+@defop("ceil", differentiable=False)
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@defop("round", differentiable=False)
+def round(x, decimals=0):
+    return jnp.round(x, decimals)
+
+
+@defop("trunc", differentiable=False)
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@defop("frac")
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@defop("angle")
+def angle(x):
+    return jnp.angle(x)
+
+
+@defop("conj")
+def conj(x):
+    return jnp.conj(x)
+
+
+@defop("real")
+def real(x):
+    return jnp.real(x)
+
+
+@defop("imag")
+def imag(x):
+    return jnp.imag(x)
+
+
+@defop("deg2rad")
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+@defop("rad2deg")
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+@defop("digamma")
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@defop("lgamma")
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@defop("gammaln")
+def gammaln(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@defop("polygamma")
+def polygamma(x, n=0):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@defop("i0")
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+@defop("i0e")
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+@defop("i1")
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+@defop("i1e")
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+@defop("isfinite", differentiable=False)
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@defop("isinf", differentiable=False)
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@defop("isnan", differentiable=False)
+def isnan(x):
+    return jnp.isnan(x)
+
+
+# ---- reductions --------------------------------------------------------
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@defop("sum", amp_policy="black")
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=_axis(axis), dtype=dtypes.convert_dtype(dtype),
+                   keepdims=keepdim)
+
+
+@defop("mean", amp_policy="black")
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("max")
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("min")
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("amax")
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("amin")
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("prod")
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_axis(axis), keepdims=keepdim,
+                    dtype=dtypes.convert_dtype(dtype))
+
+
+@defop("logsumexp", amp_policy="black")
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("all", differentiable=False)
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("any", differentiable=False)
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("count_nonzero", differentiable=False)
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("nansum", amp_policy="black")
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=_axis(axis), dtype=dtypes.convert_dtype(dtype),
+                      keepdims=keepdim)
+
+
+@defop("nanmean", amp_policy="black")
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+# ---- cumulative --------------------------------------------------------
+@defop("cumsum", amp_policy="black")
+def cumsum(x, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis, dtype=dtypes.convert_dtype(dtype))
+
+
+@defop("cumprod")
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=dim, dtype=dtypes.convert_dtype(dtype))
+
+
+@defop("cummax", differentiable=False)
+def cummax(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.cummax(x, axis=axis)
+    n = x.shape[axis]
+    idx = jnp.arange(n).reshape([-1 if i == axis % x.ndim else 1
+                                 for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+    eq = x == vals
+    ids = jnp.where(eq, idx, -1)
+    return vals, jax.lax.cummax(ids, axis=axis).astype(jnp.int32)
+
+
+@defop("cummin", differentiable=False)
+def cummin(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.cummin(x, axis=axis)
+    n = x.shape[axis]
+    idx = jnp.arange(n).reshape([-1 if i == axis % x.ndim else 1
+                                 for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+    eq = x == vals
+    ids = jnp.where(eq, idx, -1)
+    return vals, jax.lax.cummax(ids, axis=axis).astype(jnp.int32)
+
+
+@defop("logcumsumexp", amp_policy="black")
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+# ---- misc --------------------------------------------------------------
+@defop("trace")
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop("diff")
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+@defop("multiply_add")
+def multiply_add(x, y, z):
+    return x * y + z
+
+
+@defop("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+@defop("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@defop("broadcast_add")
+def broadcast_add(x, y):
+    return x + y
+
+
+def increment(x, value=1.0):
+    x._value = x._value + value
+    x._version += 1
+    return x
+
+
+def accuracy_op(pred, label, k=1):
+    from paddle_tpu.metric import accuracy as _acc
+    return _acc(pred, label, k)

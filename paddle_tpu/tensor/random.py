@@ -1,0 +1,147 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py).
+
+All ops draw explicit subkeys from the global Generator
+(paddle_tpu.core.random) — deterministic and jit-safe, unlike the reference's
+stateful Philox offset bookkeeping (paddle/phi/core/generator.h).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtypes
+from paddle_tpu.core.random import next_key
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.tensor.creation import _shape
+
+
+def rand(shape, dtype="float32", name=None):
+    return Tensor(jax.random.uniform(next_key(), _shape(shape),
+                                     dtypes.convert_dtype(dtype) or jnp.float32))
+
+
+def randn(shape, dtype="float32", name=None):
+    return Tensor(jax.random.normal(next_key(), _shape(shape),
+                                    dtypes.convert_dtype(dtype) or jnp.float32))
+
+
+def standard_normal(shape, dtype="float32", name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            jnp.shape(m), jnp.shape(s)) if shape is None else _shape(shape)
+        return Tensor(jax.random.normal(next_key(), shp) * s + m)
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(next_key(), shp) * std + mean)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._value = jax.random.normal(next_key(), tuple(x.shape),
+                                 x._value.dtype) * std + mean
+    x._version += 1
+    return x
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(
+        key, _shape(shape), dtypes.convert_dtype(dtype) or jnp.float32,
+        minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else next_key()
+    x._value = jax.random.uniform(key, tuple(x.shape), x._value.dtype,
+                                  minval=min, maxval=max)
+    x._version += 1
+    return x
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(
+        next_key(), _shape(shape), low, high,
+        dtypes.convert_dtype(dtype) or jnp.int64))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = dtypes.convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.randint(next_key(), tuple(x.shape), low, high)
+                  .astype(dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), n)
+                  .astype(dtypes.convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    return Tensor(jax.random.bernoulli(
+        next_key(), x._value.astype(jnp.float32)).astype(x._value.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._value = jax.random.bernoulli(next_key(), p, tuple(x.shape)) \
+        .astype(x._value.dtype)
+    x._version += 1
+    return x
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(
+        next_key(), x._value).astype(x._value.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    v = x._value
+    if v.ndim == 1:
+        v = v[None]
+        squeeze = True
+    else:
+        squeeze = False
+    p = v / jnp.sum(v, -1, keepdims=True)
+    outs = []
+    for row in range(p.shape[0]):
+        outs.append(jax.random.choice(
+            next_key(), p.shape[1], (num_samples,), replace=replacement,
+            p=p[row]))
+    out = jnp.stack(outs).astype(jnp.int64)
+    return Tensor(out[0] if squeeze else out)
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._value = (jax.random.exponential(next_key(), tuple(x.shape),
+                                       x._value.dtype) / lam)
+    x._version += 1
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype) or x._value.dtype
+    return Tensor(jax.random.uniform(next_key(), tuple(x.shape), dt))
+
+
+def randn_like(x, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype) or x._value.dtype
+    return Tensor(jax.random.normal(next_key(), tuple(x.shape), dt))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype="float32", name=None):
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.normal(
+        key, _shape(shape), dtypes.convert_dtype(dtype)) * std + mean)
+
+
+def binomial(count, prob, name=None):
+    c = count._value if isinstance(count, Tensor) else count
+    p = prob._value if isinstance(prob, Tensor) else prob
+    return Tensor(jax.random.binomial(next_key(), c, p).astype(jnp.int64))

@@ -1,0 +1,133 @@
+"""Search / sort / index ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core import dtype as dtypes
+
+
+@defop("argmax", differentiable=False)
+def _argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtypes.convert_dtype(dtype))
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _argmax(x, axis=axis, keepdim=keepdim, dtype=dtype)
+
+
+@defop("argmin", differentiable=False)
+def _argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(dtypes.convert_dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _argmin(x, axis=axis, keepdim=keepdim, dtype=dtype)
+
+
+@defop("argsort", differentiable=False)
+def argsort(x, axis=-1, descending=False, stable=True):
+    out = jnp.argsort(x, axis=axis, stable=stable,
+                      descending=descending)
+    return out
+
+
+@defop("sort_op")
+def _sort(x, axis=-1, descending=False, stable=True):
+    out = jnp.sort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    return _sort(x, axis=axis, descending=descending, stable=stable)
+
+
+@defop("topk")
+def _topk(x, k, axis=-1, largest=True, sorted=True):
+    if largest:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    else:
+        vals, idx = jax.lax.top_k(-jnp.moveaxis(x, axis, -1), k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(jnp.int64)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    k = int(k.item()) if isinstance(k, Tensor) else int(k)
+    return _topk(x, k=k, axis=axis, largest=largest, sorted=sorted)
+
+
+@defop("kthvalue")
+def kthvalue(x, k, axis=-1, keepdim=False):
+    sorted_v = jnp.sort(x, axis=axis)
+    idx_v = jnp.argsort(x, axis=axis)
+    vals = jnp.take(sorted_v, k - 1, axis=axis)
+    idxs = jnp.take(idx_v, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idxs = jnp.expand_dims(idxs, axis)
+    return vals, idxs.astype(jnp.int64)
+
+
+@defop("mode_op", differentiable=False)
+def _mode(x, axis=-1, keepdim=False):
+    n = x.shape[axis]
+    moved = jnp.moveaxis(jnp.sort(x, axis=axis), axis, -1)
+    # run lengths over the sorted axis; the position with the longest run
+    # ending there holds the mode
+    lens = jnp.ones_like(moved, jnp.int32)
+
+    def body(i, l):
+        prev = jnp.where(moved[..., i] == moved[..., i - 1], l[..., i - 1], 0)
+        return l.at[..., i].set(prev + 1)
+
+    lens = jax.lax.fori_loop(1, n, body, lens)
+    best = jnp.argmax(lens, axis=-1)
+    vals = jnp.take_along_axis(moved, best[..., None], axis=-1)[..., 0]
+    orig_idx = jnp.argsort(jnp.moveaxis(x, axis, -1), axis=-1)
+    mode_idx = jnp.take_along_axis(orig_idx, best[..., None], axis=-1)[..., 0]
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        mode_idx = jnp.expand_dims(mode_idx, axis)
+    return vals, mode_idx.astype(jnp.int64)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    return _mode(x, axis=axis, keepdim=keepdim)
+
+
+def nonzero(x, as_tuple=False):
+    xv = np.asarray(x._value)
+    nz = np.nonzero(xv)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(a.reshape(-1, 1))) for a in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+@defop("searchsorted", differentiable=False)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, values,
+                           side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@defop("bucketize", differentiable=False)
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, x,
+                           side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def masked_select(x, mask, name=None):
+    from paddle_tpu.tensor.manipulation import masked_select as _ms
+    return _ms(x, mask)
+
+
+def index_sample(x, index):
+    from paddle_tpu.tensor.manipulation import index_sample as _is
+    return _is(x, index)

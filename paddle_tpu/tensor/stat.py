@@ -1,0 +1,107 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor
+
+
+def _axis(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis
+
+
+@defop("std", amp_policy="black")
+def _std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _std(x, axis=_axis(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+@defop("var", amp_policy="black")
+def _var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _var(x, axis=_axis(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+@defop("median")
+def _median(x, axis=None, keepdim=False, mode="avg"):
+    if mode == "avg":
+        return jnp.median(x, axis=axis, keepdims=keepdim)
+    # 'min' mode: lower of the two middle values
+    n = x.size if axis is None else x.shape[axis]
+    s = jnp.sort(x.reshape(-1) if axis is None else x, axis=0 if axis is None else axis)
+    k = (n - 1) // 2
+    out = jnp.take(s, k, axis=0 if axis is None else axis)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return _median(x, axis=axis, keepdim=keepdim, mode=mode)
+
+
+@defop("nanmedian")
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop("quantile")
+def _quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                        method=interpolation)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return _quantile(x, q, axis=_axis(axis), keepdim=keepdim,
+                     interpolation=interpolation)
+
+
+@defop("nanquantile")
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=_axis(axis),
+                           keepdims=keepdim, method=interpolation)
+
+
+@defop("histogram", differentiable=False)
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False):
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(input), jnp.max(input)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(input.reshape(-1), bins=bins, range=(lo, hi),
+                            weights=None if weight is None else weight.reshape(-1),
+                            density=density)
+    return hist if density or weight is not None else hist.astype(jnp.int64)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    xv = np.asarray(x._value)
+    hist, edges = np.histogramdd(
+        xv, bins=bins, range=ranges, density=density,
+        weights=None if weights is None else np.asarray(weights._value))
+    return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+@defop("bincount", differentiable=False)
+def _bincount(x, weights=None, minlength=0):
+    length = max(int(minlength), int(np.asarray(x).max(initial=-1)) + 1) \
+        if not hasattr(x, "aval") else minlength
+    return jnp.bincount(x, weights=weights, minlength=length)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    xv = np.asarray(x._value)
+    length = max(int(minlength), (int(xv.max()) + 1) if xv.size else 0)
+    out = jnp.bincount(x._value, length=length,
+                       weights=None if weights is None else weights._value)
+    return Tensor(out if weights is not None else out.astype(jnp.int64))

@@ -1,0 +1,25 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+This replaces the reference's multi-process distributed test harness
+(reference: test/legacy_test/test_dist_base.py:959 subprocess forking) with
+XLA host-device virtualization — single process, deterministic
+(SURVEY.md §4 'fake backends').
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import paddle_tpu
+    paddle_tpu.seed(1234)
+    np.random.seed(1234)
+    yield

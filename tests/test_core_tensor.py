@@ -1,0 +1,212 @@
+"""Core Tensor + autograd tape tests (reference analog:
+test/legacy_test/test_var_base.py, test_imperative_basic.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basics():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == np.dtype("float32")
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+    assert t.stop_gradient
+
+
+def test_dtype_conversion():
+    t = paddle.to_tensor([1, 2, 3], dtype="float32")
+    u = t.astype("bfloat16")
+    assert str(u.dtype) == "bfloat16" or u.dtype == paddle.bfloat16
+    v = u.astype("int32")
+    assert v.dtype == np.dtype("int32")
+
+
+def test_arithmetic_and_broadcast():
+    a = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    b = paddle.to_tensor([10.0, 20.0])
+    np.testing.assert_allclose((a + b).numpy(), [[11, 22], [13, 24]])
+    np.testing.assert_allclose((a * 2 + 1).numpy(), [[3, 5], [7, 9]])
+    np.testing.assert_allclose((1.0 / a).numpy(), 1.0 / a.numpy())
+    np.testing.assert_allclose((a @ a).numpy(), a.numpy() @ a.numpy(),
+                               rtol=1e-6)
+
+
+def test_indexing():
+    a = paddle.arange(12, dtype="float32").reshape([3, 4])
+    np.testing.assert_allclose(a[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(a[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(a[1:, 2:].numpy(), [[6, 7], [10, 11]])
+    a[0] = paddle.zeros([4])
+    np.testing.assert_allclose(a[0].numpy(), [0, 0, 0, 0])
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_backward_chain_and_fanout():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x        # 4
+    z = y + x        # used twice
+    w = z * y
+    w.backward()
+    # w = (x^2 + x) * x^2 = x^4 + x^3 -> dw/dx = 4x^3 + 3x^2 = 44
+    np.testing.assert_allclose(float(x.grad.numpy()), 44.0, rtol=1e-6)
+
+
+def test_grad_api():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = paddle.to_tensor(4.0, stop_gradient=False)
+    z = x * x * y
+    gx, gy = paddle.grad(z, [x, y])
+    np.testing.assert_allclose(float(gx.numpy()), 24.0)
+    np.testing.assert_allclose(float(gy.numpy()), 9.0)
+    assert x.grad is None  # grad() must not touch .grad
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_no_grad():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * 3
+    y_d = y.detach()
+    z = y_d * x
+    z.backward()
+    np.testing.assert_allclose(float(x.grad.numpy()), 6.0)
+
+
+def test_tensor_hook():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    seen = []
+    x.register_hook(lambda g: seen.append(float(g.numpy())))
+    (x * 5).backward()
+    assert seen == [5.0]
+
+
+def test_inplace_add_():
+    x = paddle.to_tensor([1.0, 2.0])
+    x.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+    assert x._version == 1
+
+
+def test_reduction_ops():
+    a = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    np.testing.assert_allclose(a.sum(axis=1).numpy(),
+                               a.numpy().sum(axis=1))
+    np.testing.assert_allclose(a.mean().numpy(), a.numpy().mean())
+    np.testing.assert_allclose(a.max(axis=[0, 2]).numpy(),
+                               a.numpy().max(axis=(0, 2)))
+    np.testing.assert_allclose(
+        paddle.logsumexp(a, axis=-1).numpy(),
+        np.log(np.exp(a.numpy()).sum(-1)), rtol=1e-5)
+
+
+def test_manipulation_ops():
+    a = paddle.arange(6, dtype="float32").reshape([2, 3])
+    np.testing.assert_allclose(paddle.transpose(a, [1, 0]).numpy(),
+                               a.numpy().T)
+    np.testing.assert_allclose(
+        paddle.concat([a, a], axis=0).numpy(),
+        np.concatenate([a.numpy()] * 2, 0))
+    parts = paddle.split(a, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1]
+    np.testing.assert_allclose(paddle.flip(a, [1]).numpy(),
+                               a.numpy()[:, ::-1])
+    st = paddle.stack([a, a], axis=0)
+    assert st.shape == [2, 2, 3]
+
+
+def test_where_topk_sort():
+    a = paddle.to_tensor([3.0, 1.0, 2.0])
+    v, i = paddle.topk(a, 2)
+    np.testing.assert_allclose(v.numpy(), [3.0, 2.0])
+    np.testing.assert_allclose(i.numpy(), [0, 2])
+    np.testing.assert_allclose(paddle.sort(a).numpy(), [1.0, 2.0, 3.0])
+    c = paddle.where(a > 1.5, a, paddle.zeros_like(a))
+    np.testing.assert_allclose(c.numpy(), [3.0, 0.0, 2.0])
+
+
+def test_matmul_grad():
+    x = paddle.to_tensor(np.random.randn(3, 4).astype(np.float32),
+                         stop_gradient=False)
+    w = paddle.to_tensor(np.random.randn(4, 5).astype(np.float32),
+                         stop_gradient=False)
+    y = paddle.matmul(x, w)
+    loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               np.ones((3, 5)) @ w.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(w.grad.numpy(),
+                               x.numpy().T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_cast_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x.astype("bfloat16").astype("float32")
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+
+def test_einsum():
+    a = np.random.randn(2, 3).astype(np.float32)
+    b = np.random.randn(3, 4).astype(np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                        paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_save_load(tmp_path):
+    d = {"w": paddle.to_tensor([1.0, 2.0]),
+         "nested": {"b": paddle.to_tensor([3])}}
+    p = str(tmp_path / "ckpt.pdparams")
+    paddle.save(d, p)
+    loaded = paddle.load(p)
+    np.testing.assert_allclose(loaded["w"].numpy(), [1.0, 2.0])
+    np.testing.assert_allclose(loaded["nested"]["b"].numpy(), [3])
+
+
+def test_random_determinism():
+    paddle.seed(42)
+    a = paddle.randn([4])
+    paddle.seed(42)
+    b = paddle.randn([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy * 2
+
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = Double.apply(x)
+    y.backward()
+    np.testing.assert_allclose(float(y.numpy()), 6.0)
+    np.testing.assert_allclose(float(x.grad.numpy()), 2.0)

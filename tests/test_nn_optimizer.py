@@ -1,0 +1,276 @@
+"""nn.Layer / functional / optimizer tests (reference analog:
+test/legacy_test/test_layers.py, test_adam_op.py, test_sgd_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def test_linear_forward_backward():
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    y = layer(x)
+    assert y.shape == [2, 3]
+    loss = y.sum()
+    loss.backward()
+    assert layer.weight.grad is not None
+    assert layer.weight.grad.shape == [4, 3]
+    assert layer.bias.grad.shape == [3]
+
+
+def test_layer_registration_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.act = nn.ReLU()
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert "fc1.weight" in names and "fc2.bias" in names
+    sd = net.state_dict()
+    assert set(sd) == set(names)
+
+    net2 = Net()
+    net2.set_state_dict(sd)
+    np.testing.assert_allclose(net2.fc1.weight.numpy(),
+                               net.fc1.weight.numpy())
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.randn([3, 4])
+    assert seq(x).shape == [3, 2]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    assert len(list(ll.parameters())) == 6
+
+
+def test_conv2d_shapes_and_grad():
+    conv = nn.Conv2D(3, 8, 3, stride=1, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    y = conv(x)
+    assert y.shape == [2, 8, 16, 16]
+    y.mean().backward()
+    assert conv.weight.grad.shape == [8, 3, 3, 3]
+
+
+def test_conv2d_vs_torch_semantics():
+    # numeric check against explicit im2col
+    np.random.seed(0)
+    x = np.random.randn(1, 2, 5, 5).astype(np.float32)
+    w = np.random.randn(4, 2, 3, 3).astype(np.float32)
+    y = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1)
+    import torch
+    ref = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w),
+                                     padding=1).numpy()
+    np.testing.assert_allclose(y.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pool_and_norms():
+    x = paddle.randn([2, 4, 8, 8])
+    assert F.max_pool2d(x, 2, 2).shape == [2, 4, 4, 4]
+    assert F.avg_pool2d(x, 2, 2).shape == [2, 4, 4, 4]
+    assert F.adaptive_avg_pool2d(x, 1).shape == [2, 4, 1, 1]
+
+    bn = nn.BatchNorm2D(4)
+    y = bn(x)
+    assert y.shape == [2, 4, 8, 8]
+    m = y.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(4), atol=1e-4)
+
+    ln = nn.LayerNorm(8)
+    y2 = ln(paddle.randn([2, 3, 8]))
+    np.testing.assert_allclose(y2.numpy().mean(-1), np.zeros((2, 3)),
+                               atol=1e-5)
+
+    rms = nn.RMSNorm(8)
+    assert rms(paddle.randn([2, 8])).shape == [2, 8]
+
+
+def test_batchnorm_running_stats_update():
+    bn = nn.BatchNorm2D(2, momentum=0.5)
+    x = paddle.ones([4, 2, 3, 3]) * 2.0
+    bn.train()
+    bn(x)
+    np.testing.assert_allclose(bn._mean.numpy(), [1.0, 1.0], rtol=1e-6)
+    bn.eval()
+    y = bn(x)
+    assert y.shape == [4, 2, 3, 3]
+
+
+def test_embedding_and_crossentropy():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor([[1, 2], [3, 4]], dtype="int32")
+    out = emb(idx)
+    assert out.shape == [2, 2, 4]
+
+    logits = paddle.randn([5, 7])
+    logits.stop_gradient = False
+    labels = paddle.to_tensor([0, 1, 2, 3, 4], dtype="int64")
+    loss = F.cross_entropy(logits, labels)
+    loss.backward()
+    assert logits.grad is not None
+    # numeric check vs torch
+    import torch
+    tl = torch.tensor(logits.numpy(), requires_grad=True)
+    ref = torch.nn.functional.cross_entropy(tl, torch.tensor(
+        labels.numpy().astype(np.int64)))
+    np.testing.assert_allclose(float(loss.numpy()), float(ref), rtol=1e-5)
+
+
+def test_activations_match_torch():
+    import torch
+    x = np.random.randn(4, 5).astype(np.float32)
+    tx = torch.tensor(x)
+    px = paddle.to_tensor(x)
+    for ours, theirs in [
+        (F.relu, torch.nn.functional.relu),
+        (F.gelu, lambda t: torch.nn.functional.gelu(t)),
+        (F.silu, torch.nn.functional.silu),
+        (F.softmax, lambda t: torch.softmax(t, -1)),
+        (F.sigmoid, torch.sigmoid),
+        (F.softplus, torch.nn.functional.softplus),
+        (F.mish, torch.nn.functional.mish),
+    ]:
+        np.testing.assert_allclose(ours(px).numpy(), theirs(tx).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    d.train()
+    y = d(x)
+    frac = float((y.numpy() == 0).mean())
+    assert 0.3 < frac < 0.7
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_sgd_converges():
+    # fit y = 2x + 1
+    w_true = np.array([[2.0]], dtype=np.float32)
+    layer = nn.Linear(1, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=layer.parameters())
+    for _ in range(200):
+        x = paddle.randn([8, 1])
+        y_t = x * 2.0 + 1.0
+        loss = F.mse_loss(layer(x), y_t)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_allclose(layer.weight.numpy(), w_true, atol=0.05)
+    np.testing.assert_allclose(layer.bias.numpy(), [1.0], atol=0.05)
+
+
+def test_adam_and_adamw_step_math():
+    import torch
+    x0 = np.random.randn(3, 3).astype(np.float32)
+    g = np.random.randn(3, 3).astype(np.float32)
+
+    p = paddle.Parameter(paddle.to_tensor(x0))
+    p._grad = paddle.to_tensor(g)
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=[p],
+                                 weight_decay=0.1)
+    opt.step()
+
+    tp = torch.tensor(x0, requires_grad=True)
+    tp.grad = torch.tensor(g)
+    topt = torch.optim.AdamW([tp], lr=0.01, weight_decay=0.1, eps=1e-8)
+    topt.step()
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_grad_clip_global_norm():
+    p = paddle.Parameter(paddle.to_tensor([[1.0, 1.0]]))
+    p._grad = paddle.to_tensor([[30.0, 40.0]])  # norm 50
+    clip = nn.ClipGradByGlobalNorm(5.0)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p],
+                               grad_clip=clip)
+    opt.step()
+    # effective grad = [3,4]
+    np.testing.assert_allclose(p.numpy(), [[-2.0, -3.0]], rtol=1e-5)
+
+
+def test_lr_schedulers():
+    sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(5):
+        lrs.append(round(sched(), 6))
+        sched.step()
+    assert lrs == [0.1, 0.1, 0.05, 0.05, 0.025]
+
+    warm = paddle.optimizer.lr.LinearWarmup(
+        learning_rate=0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+    vals = []
+    for _ in range(5):
+        vals.append(round(warm(), 6))
+        warm.step()
+    assert vals[0] == 0.0 and vals[-1] == 0.1
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    y = mha(x)
+    assert y.shape == [2, 6, 16]
+    y.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_sdpa_vs_torch():
+    import torch
+    q = np.random.randn(2, 5, 2, 8).astype(np.float32)
+    k = np.random.randn(2, 5, 2, 8).astype(np.float32)
+    v = np.random.randn(2, 5, 2, 8).astype(np.float32)
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        is_causal=True)
+    ref = torch.nn.functional.scaled_dot_product_attention(
+        torch.tensor(q).permute(0, 2, 1, 3), torch.tensor(k).permute(0, 2, 1, 3),
+        torch.tensor(v).permute(0, 2, 1, 3), is_causal=True
+    ).permute(0, 2, 1, 3).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm():
+    lstm = nn.LSTM(input_size=4, hidden_size=8, num_layers=2)
+    x = paddle.randn([3, 5, 4])  # batch, time, feat
+    out, (h, c) = lstm(x)
+    assert out.shape == [3, 5, 8]
+    assert h.shape == [2, 3, 8]
+    out.sum().backward()
+
+
+def test_amp_autocast():
+    layer = nn.Linear(8, 8)
+    x = paddle.randn([2, 8])
+    with paddle.amp.auto_cast(level="O1"):
+        y = layer(x)
+    assert str(y.dtype) == "bfloat16"
+    loss = y.astype("float32").sum()
+    loss.backward()
+    assert layer.weight.grad is not None
+    assert layer.weight.grad.dtype == np.dtype("float32") or \
+        str(layer.weight.grad.dtype) == "bfloat16"
+
+
+def test_grad_scaler():
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    p = paddle.Parameter(paddle.to_tensor([1.0]))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    loss = p * 2
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(p.numpy(), [0.8], rtol=1e-5)
