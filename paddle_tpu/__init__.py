@@ -13,6 +13,13 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# f32 matmuls default to full precision so eager/grad numerics match the
+# reference's CUDA fp32 path; training runs in bf16 where this has no cost.
+import jax as _jax  # noqa: E402
+from paddle_tpu.core.flags import get_flag as _get_flag  # noqa: E402
+_jax.config.update("jax_default_matmul_precision",
+                   _get_flag("FLAGS_matmul_precision", "highest"))
+
 # core types
 from paddle_tpu.core.tensor import Tensor, Parameter, to_tensor, is_tensor
 from paddle_tpu.core.tape import no_grad, enable_grad, set_grad_enabled, grad

@@ -50,6 +50,8 @@ class _AmpState(threading.local):
         import jax
 
         name = op.name
+        if name in ("cast", "astype"):
+            return args, kwargs
         white = (name in WHITE_LIST or name in self.custom_white)
         black = (name in BLACK_LIST or name in self.custom_black) and \
             name not in self.custom_white
@@ -67,6 +69,8 @@ class _AmpState(threading.local):
             if isinstance(x, Tensor) and dtypes.is_floating_point(x.dtype) \
                     and x.dtype in (dtypes.float32, dtypes.float16,
                                     dtypes.bfloat16) and x.dtype != target:
+                # goes through the 'cast' op so the tape links grads back to
+                # the fp32 source; 'cast' itself is AMP-exempt above
                 return x.astype(target)
             return x
 

@@ -78,6 +78,11 @@ def set_flags(flags: dict):
 
 # Core flags (mirroring the reference's most-used runtime toggles).
 define_flag("FLAGS_check_nan_inf", False, "Check every op output for NaN/Inf")
+define_flag("FLAGS_matmul_precision", "highest",
+            "XLA matmul precision for f32 operands: 'default' allows the "
+            "MXU's bf16 passes (fast, ~1e-2 rel err), 'highest' gives true "
+            "f32 accumulation. bf16 inputs are unaffected. Mirrors the "
+            "reference's TF32 toggle (FLAGS_allow_tf32_cublas semantics).")
 define_flag("FLAGS_check_nan_inf_level", 0, "0: fail on nan/inf; >0: log only")
 define_flag("FLAGS_eager_op_jit", True, "Cache-jit eager per-op executables")
 define_flag("FLAGS_log_level", 0, "VLOG-style verbosity (0=off)")

@@ -1,0 +1,40 @@
+"""paddle_tpu.distributed: GSPMD mesh-and-sharding distribution.
+
+TPU-native rebuild of the reference's distributed stack (SURVEY.md §2.5):
+ProcessGroup/NCCL/TCPStore become XLA collectives over ICI compiled from
+shardings; DistTensor+SPMD rules+reshard become NamedSharding + device_put;
+the hybrid fleet topology becomes one named mesh.
+"""
+from paddle_tpu.distributed.placement import (  # noqa: F401
+    Placement, Replicate, Shard, Partial, placements_to_spec,
+    spec_to_placements,
+)
+from paddle_tpu.distributed.mesh import (  # noqa: F401
+    ProcessMesh, init_mesh, auto_mesh, get_mesh, set_mesh,
+)
+from paddle_tpu.distributed.api import (  # noqa: F401
+    shard_tensor, reshard, shard_layer, shard_optimizer, dtensor_from_fn,
+    unshard_dtensor,
+)
+from paddle_tpu.distributed.collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
+    broadcast, reduce, reduce_scatter, alltoall, scatter, barrier, send,
+    recv, psum, pmean, ppermute,
+)
+from paddle_tpu.distributed.env import (  # noqa: F401
+    init_parallel_env, is_initialized, get_rank, get_world_size,
+    ParallelEnv,
+)
+
+all_to_all = alltoall  # torch-style alias the reference also exposes
+
+
+def __getattr__(name):
+    import importlib
+    if name in ("fleet", "checkpoint", "pipeline", "launch", "parallel",
+                "sharding"):
+        mod = importlib.import_module(f"paddle_tpu.distributed.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(
+        f"module 'paddle_tpu.distributed' has no attribute {name!r}")

@@ -1,0 +1,351 @@
+"""Eager collective communication facade.
+
+TPU-native rebuild of the reference's eager collectives
+(reference: python/paddle/distributed/communication/*.py over C++
+ProcessGroup, paddle/fluid/distributed/collective/process_group.h:47, NCCL
+backend process_group_nccl.h:37, TCPStore rendezvous store/tcp_store.h:121).
+
+Design: there is no NCCL and no per-rank process group object to program
+against — collectives on TPU are XLA programs over ICI. A `Group` owns a 1-D
+device mesh over its ranks; each collective jit-compiles a `shard_map` whose
+body is the corresponding `lax` collective (psum / all_gather / ppermute /
+all_to_all), which XLA lowers onto the interconnect directly.
+
+Rank-major convention: the eager facade represents "each rank's local
+tensor" as a global array of shape ``(nranks, *local_shape)`` sharded along
+axis 0 over the group. A replicated / single-device input is lifted by
+treating every rank's local value as that same tensor (matching what N
+identical processes calling the reference API would contribute). Results
+follow the reference's per-rank semantics, expressed as the same rank-major
+global array.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from paddle_tpu.core.tensor import Tensor
+
+_AXIS = "_pg"
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group ≈ the reference's ProcessGroup: an ordered set
+    of ranks with a private 1-D mesh used to compile its collectives."""
+
+    _next_gid = [0]
+
+    def __init__(self, ranks=None):
+        devs = jax.devices()
+        if ranks is None:
+            ranks = list(range(len(devs)))
+        self.ranks = [int(r) for r in ranks]
+        self.nranks = len(self.ranks)
+        self.mesh = Mesh(np.asarray([devs[r] for r in self.ranks]), (_AXIS,))
+        self.id = Group._next_gid[0]
+        Group._next_gid[0] += 1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, global_rank):
+        return self.ranks.index(global_rank)
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+_default_group: list[Group | None] = [None]
+_group_registry: dict[int, Group] = {}
+
+
+def _get_group(group=None) -> Group:
+    if group is not None:
+        return group
+    if _default_group[0] is None:
+        _default_group[0] = Group()
+        _group_registry[_default_group[0].id] = _default_group[0]
+    return _default_group[0]
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    """reference: paddle.distributed.new_group (communication/group.py)."""
+    g = Group(ranks)
+    _group_registry[g.id] = g
+    return g
+
+
+def get_group(gid: int) -> Group:
+    return _group_registry[gid]
+
+
+def _as_rank_major(t, g: Group):
+    """Lift a tensor to the rank-major (nranks, *local) global array."""
+    arr = t._value if isinstance(t, Tensor) else jnp.asarray(t)
+    sh = getattr(arr, "sharding", None)
+    if (isinstance(sh, NamedSharding) and sh.mesh.shape.get(_AXIS)
+            == g.nranks and tuple(sh.spec)[:1] == (_AXIS,)
+            and arr.shape[0] == g.nranks):
+        return arr
+    if arr.shape and arr.shape[0] == g.nranks and isinstance(
+            sh, NamedSharding) and sh.mesh == g.mesh:
+        return jax.device_put(arr, NamedSharding(g.mesh, P(_AXIS)))
+    # replicated local value: every rank contributes the same tensor
+    stacked = jnp.broadcast_to(arr[None], (g.nranks,) + arr.shape)
+    return jax.device_put(stacked, NamedSharding(g.mesh, P(_AXIS)))
+
+
+def _wrap(arr):
+    return Tensor(arr, stop_gradient=True)
+
+
+# Module-level bodies + a cache keyed on (mesh, kind, param) so repeated
+# eager collectives reuse one compiled executable per (mesh, shape) instead
+# of retracing a fresh closure every call.
+def _body_reduce_sum(x):
+    return jax.lax.psum(x, _AXIS)
+
+
+def _body_reduce_max(x):
+    return jax.lax.pmax(x, _AXIS)
+
+
+def _body_reduce_min(x):
+    return jax.lax.pmin(x, _AXIS)
+
+
+def _body_reduce_avg(x):
+    return jax.lax.pmean(x, _AXIS)
+
+
+def _body_reduce_prod(x):
+    return jnp.exp(jax.lax.psum(jnp.log(x), _AXIS))
+
+
+def _body_all_gather(x):
+    return jax.lax.all_gather(x[0], _AXIS)[None]
+
+
+def _body_select_rank(x, src_local):
+    full = jax.lax.all_gather(x[0], _AXIS)
+    return full[src_local][None]
+
+
+def _body_reduce_scatter(x):
+    # x: (1, nranks, *el) — this rank's list of chunks
+    summed = jax.lax.psum(x[0], _AXIS)
+    idx = jax.lax.axis_index(_AXIS)
+    return jax.lax.dynamic_index_in_dim(summed, idx, keepdims=True)
+
+
+def _body_all_to_all(x):
+    return jax.lax.all_to_all(x, _AXIS, split_axis=1,
+                              concat_axis=0).reshape(x.shape)
+
+
+_REDUCE_BODIES = {
+    ReduceOp.SUM: _body_reduce_sum, ReduceOp.MAX: _body_reduce_max,
+    ReduceOp.MIN: _body_reduce_min, ReduceOp.AVG: _body_reduce_avg,
+    ReduceOp.PROD: _body_reduce_prod,
+}
+
+
+@functools.lru_cache(maxsize=512)
+def _jit_collective(mesh, body, static_arg=None):
+    if static_arg is None:
+        fn = body
+    else:
+        fn = functools.partial(body, src_local=static_arg)
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=P(_AXIS),
+                             out_specs=P(_AXIS)))
+
+
+def _reduce_body(op):
+    try:
+        return _REDUCE_BODIES[op]
+    except KeyError:
+        raise ValueError(f"unknown reduce op {op!r}") from None
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Sum (or max/min/…) every rank's local tensor; all ranks receive the
+    result (reference: communication/all_reduce.py)."""
+    g = _get_group(group)
+    x = _as_rank_major(tensor, g)
+    out = _jit_collective(g.mesh, _reduce_body(op))(x)
+    res = _wrap(out)
+    if isinstance(tensor, Tensor):
+        tensor._value = out[0] if tensor._value.ndim == out.ndim - 1 else out
+    return res
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True):
+    """Gather every rank's local tensor, concatenated along axis 0 on every
+    rank (reference: communication/all_gather.py). Supports both the
+    list-out signature and a functional `all_gather(tensor)` form."""
+    if tensor is None:
+        tensor, tensor_list = tensor_list, None
+    g = _get_group(group)
+    x = _as_rank_major(tensor, g)
+    out = _jit_collective(g.mesh, _body_all_gather)(x)
+    per_rank = [_wrap(out[0, r]) for r in range(g.nranks)]
+    if tensor_list is not None:
+        tensor_list.clear()
+        tensor_list.extend(per_rank)
+    return per_rank
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Every rank receives rank `src`'s tensor
+    (reference: communication/broadcast.py)."""
+    g = _get_group(group)
+    x = _as_rank_major(tensor, g)
+    if src not in g.ranks:
+        raise ValueError(f"src rank {src} is not in group ranks {g.ranks}")
+    src_local = g.get_group_rank(src)
+    out = _jit_collective(g.mesh, _body_select_rank, src_local)(x)
+    res = _wrap(out[0])
+    if isinstance(tensor, Tensor):
+        tensor._value = out[0] if tensor._value.ndim == out.ndim - 1 else out
+    return res
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reduce to rank `dst` (others get their input back; on TPU the psum is
+    global anyway — matching semantics, not cost)."""
+    g = _get_group(group)
+    x = _as_rank_major(tensor, g)
+    if dst not in g.ranks:
+        raise ValueError(f"dst rank {dst} is not in group ranks {g.ranks}")
+    dst_local = g.get_group_rank(dst)
+    red = _jit_collective(g.mesh, _reduce_body(op))(x)
+    out = x.at[dst_local].set(red[dst_local])
+    res = _wrap(out)
+    if isinstance(tensor, Tensor):
+        tensor._value = out[dst_local] if tensor._value.ndim == out.ndim - 1 \
+            else out
+    return res
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """Sum across ranks then scatter chunks: rank r gets chunk r of the sum
+    (reference: communication/reduce_scatter.py)."""
+    g = _get_group(group)
+    if tensor_list is not None:
+        if len(tensor_list) != g.nranks:
+            raise ValueError(
+                f"tensor_list has {len(tensor_list)} entries for a "
+                f"{g.nranks}-rank group")
+        local = jnp.stack([t._value if isinstance(t, Tensor)
+                           else jnp.asarray(t) for t in tensor_list])
+    else:
+        arr = tensor._value if isinstance(tensor, Tensor) else \
+            jnp.asarray(tensor)
+        if arr.shape[0] % g.nranks:
+            raise ValueError(
+                f"dim0 ({arr.shape[0]}) not divisible by nranks {g.nranks}")
+        local = arr.reshape((g.nranks, arr.shape[0] // g.nranks)
+                            + arr.shape[1:])
+    # local: this rank's nranks chunks; lift to rank-major (ranks, ranks, *el)
+    x = _as_rank_major(_wrap(local), g)
+    out = _jit_collective(g.mesh, _body_reduce_scatter)(x)
+    return _wrap(out)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """Rank r sends chunk c to rank c; receives chunk r from everyone
+    (reference: communication/all_to_all.py)."""
+    g = _get_group(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        x = jnp.stack([t._value if isinstance(t, Tensor) else jnp.asarray(t)
+                       for t in in_tensor_list])
+        x = jnp.broadcast_to(x[None], (g.nranks,) + x.shape)
+        x = jax.device_put(x, NamedSharding(g.mesh, P(_AXIS)))
+        out = _jit_collective(g.mesh, _body_all_to_all)(x)
+        received = [_wrap(out[0, j]) for j in range(g.nranks)]
+        if out_tensor_list is not None:
+            out_tensor_list.clear()
+            out_tensor_list.extend(received)
+        return received
+    # rank-major array form: (nranks, nranks, *chunk)
+    x = _as_rank_major(in_tensor_list, g)
+    out = _jit_collective(g.mesh, _body_all_to_all)(x)
+    return _wrap(out)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _get_group(group)
+    if tensor_list is not None:
+        full = jnp.stack([t._value if isinstance(t, Tensor) else
+                          jnp.asarray(t) for t in tensor_list])
+    else:
+        full = tensor._value if isinstance(tensor, Tensor) else \
+            jnp.asarray(tensor)
+    out = jax.device_put(full, NamedSharding(g.mesh, P(_AXIS)))
+    return _wrap(out)
+
+
+def barrier(group=None):
+    g = _get_group(group)
+    x = _as_rank_major(_wrap(jnp.zeros((1,))), g)
+    _jit_collective(g.mesh, _reduce_body(ReduceOp.SUM))(x).block_until_ready()
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point send: staged through the group as a ppermute
+    (reference: communication/send.py). Paired with `recv` by the caller."""
+    g = _get_group(group)
+    _p2p_buffer.append((g, tensor, dst))
+
+
+_p2p_buffer: list = []
+
+
+def recv(tensor=None, src=0, group=None, sync_op=True):
+    """Receive the oldest outstanding `send` in this group (FIFO pairing).
+
+    Single-controller eager p2p has no per-rank identity, so send/recv pair
+    strictly in program order; with more than one outstanding send the
+    pairing is the caller's responsibility. Real pipeline communication is
+    the compiled path (paddle_tpu.distributed.pipeline: ppermute in one XLA
+    program) — this facade exists only for reference API parity."""
+    g = _get_group(group)
+    for i, (gg, t, dst) in enumerate(_p2p_buffer):
+        if gg is g:
+            _p2p_buffer.pop(i)
+            val = t._value if isinstance(t, Tensor) else jnp.asarray(t)
+            out = _wrap(val)
+            if tensor is not None and isinstance(tensor, Tensor):
+                tensor._value = val
+            return out
+    raise RuntimeError("recv() without a matching send() in this process — "
+                       "eager p2p is single-controller; use "
+                       "paddle_tpu.distributed.pipeline for compiled PP")
+
+
+# In-jit collective helpers (for use inside shard_map'd user functions):
+def psum(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name):
+    return jax.lax.pmean(x, axis_name)
+
+
+def ppermute(x, axis_name, perm):
+    return jax.lax.ppermute(x, axis_name, perm)

@@ -1,0 +1,93 @@
+"""Multi-process environment bootstrap.
+
+TPU-native rebuild of the reference's parallel environment + launcher glue
+(reference: python/paddle/distributed/parallel.py init_parallel_env,
+ParallelEnv; rendezvous via TCPStore store/tcp_store.h:121 and
+launch/controllers/master.py). JAX's coordination service
+(`jax.distributed.initialize`) plays the TCPStore/master role over DCN; ICI
+collectives need no bootstrap at all (they're compiled).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = [False]
+
+
+def _env_int(*names, default=0):
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            return int(v)
+    return default
+
+
+def get_rank(group=None):
+    if group is not None:
+        return 0 if not hasattr(group, "ranks") else group.ranks.index(
+            get_rank())
+    return _env_int("PADDLE_TRAINER_ID", "RANK",
+                    default=jax.process_index() if _initialized[0] else 0)
+
+
+def get_world_size(group=None):
+    if group is not None and hasattr(group, "nranks"):
+        return group.nranks
+    return _env_int("PADDLE_TRAINERS_NUM", "WORLD_SIZE",
+                    default=jax.process_count() if _initialized[0] else 1)
+
+
+def init_parallel_env():
+    """Initialise multi-process JAX (reference: parallel.py:init_parallel_env
+    → ProcessGroup + TCPStore; here → jax.distributed coordination service).
+
+    Single-process (incl. single-host multi-chip) needs no init — returns
+    immediately, mirroring the reference's is_initialized short-circuit."""
+    if _initialized[0]:
+        return
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "MASTER_ADDR")
+    nprocs = _env_int("PADDLE_TRAINERS_NUM", "WORLD_SIZE", default=1)
+    if nprocs > 1:
+        port = os.environ.get("MASTER_PORT", "8476")
+        addr = coord if coord and ":" in str(coord) else f"{coord}:{port}"
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=nprocs,
+            process_id=_env_int("PADDLE_TRAINER_ID", "RANK", default=0))
+    _initialized[0] = True
+
+
+def is_initialized():
+    return _initialized[0]
+
+
+def parallel_device_count():
+    return jax.device_count()
+
+
+class ParallelEnv:
+    """reference: python/paddle/distributed/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else ["127.0.0.1:0"]

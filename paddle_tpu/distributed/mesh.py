@@ -1,0 +1,155 @@
+"""ProcessMesh: named device meshes.
+
+TPU-native rebuild of the reference's ProcessMesh
+(reference: paddle/phi/core/distributed/auto_parallel/process_mesh.h:34;
+python/paddle/distributed/auto_parallel/process_mesh.py:72). Instead of a
+metadata object that the reshard engine interprets, our ProcessMesh wraps a
+real `jax.sharding.Mesh`; XLA GSPMD compiles collectives over ICI directly
+from shardings expressed against it (SURVEY.md §3.5 mapping table).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+_current_mesh: list["ProcessMesh"] = []
+
+
+def _default_dim_names(ndim):
+    return [f"d{i}" for i in range(ndim)]
+
+
+class ProcessMesh:
+    """An n-D logical mesh of devices with named axes.
+
+    `mesh` is a (nested) list/ndarray of *process/device ids* (global device
+    indices into jax.devices()), `dim_names` the axis names — identical
+    surface to the reference's paddle.distributed.ProcessMesh.
+    """
+
+    def __init__(self, mesh, dim_names=None, process_ids=None):
+        arr = np.asarray(mesh, dtype=np.int64)
+        if dim_names is None:
+            dim_names = _default_dim_names(arr.ndim)
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"{len(dim_names)} dim_names for mesh of rank {arr.ndim}")
+        self._ids = arr
+        self._dim_names = [str(n) for n in dim_names]
+        devices = np.asarray(jax.devices(), dtype=object)
+        if arr.size > devices.size:
+            raise ValueError(
+                f"mesh references {arr.size} devices but only "
+                f"{devices.size} present")
+        dev_grid = devices[arr.reshape(-1)].reshape(arr.shape)
+        self._jax_mesh = Mesh(dev_grid, axis_names=tuple(self._dim_names))
+
+    # -- reference-parity surface -----------------------------------------
+    @property
+    def shape(self):
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self):
+        return self._ids.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    @property
+    def process_ids(self):
+        return [int(i) for i in self._ids.reshape(-1)]
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, name):
+        return self._ids.shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        """Project out one mesh axis (reference: process_mesh.py
+        get_mesh_with_dim): returns the sub-mesh with `dim_name` first, or
+        the slice at `index` along it."""
+        axis = self._dim_names.index(dim_name)
+        perm = [axis] + [i for i in range(self.ndim) if i != axis]
+        moved = np.transpose(self._ids, perm)
+        names = [self._dim_names[i] for i in perm]
+        if index is None:
+            return ProcessMesh(moved, names)
+        sub = moved[index]
+        return ProcessMesh(sub, names[1:]) if sub.ndim else ProcessMesh(
+            sub.reshape(1), names[:1])
+
+    def __getitem__(self, idx):
+        # track which axes survive basic indexing so names stay aligned
+        idx_tuple = idx if isinstance(idx, tuple) else (idx,)
+        if any(i is Ellipsis for i in idx_tuple):
+            n_explicit = len([i for i in idx_tuple if i is not Ellipsis])
+            expanded = []
+            for i in idx_tuple:
+                if i is Ellipsis:
+                    expanded.extend([slice(None)] * (self.ndim - n_explicit))
+                else:
+                    expanded.append(i)
+            idx_tuple = tuple(expanded)
+        kept = [self._dim_names[d] for d in range(self.ndim)
+                if d >= len(idx_tuple)
+                or not isinstance(idx_tuple[d], (int, np.integer))]
+        sub = self._ids[idx]
+        if sub.ndim == 0:
+            return ProcessMesh(sub.reshape(1), [self._dim_names[-1]])
+        return ProcessMesh(sub, kept)
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._ids, other._ids)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._ids.tobytes(), tuple(self._dim_names)))
+
+    def __enter__(self):
+        _current_mesh.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _current_mesh.pop()
+        return False
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")
+
+
+def init_mesh(shape_by_name: dict) -> ProcessMesh:
+    """Build a mesh from `{'dp': 2, 'mp': 4}`-style dims over all devices,
+    ICI-friendly order (outermost = slowest-varying = furthest devices)."""
+    names = list(shape_by_name)
+    dims = [int(shape_by_name[n]) for n in names]
+    n = int(np.prod(dims))
+    ids = np.arange(n).reshape(dims)
+    return ProcessMesh(ids, names)
+
+
+def auto_mesh(*dim_names) -> ProcessMesh:
+    """1-D (or evenly-factored) mesh over every visible device."""
+    n = len(jax.devices())
+    if len(dim_names) == 1:
+        return ProcessMesh(np.arange(n), list(dim_names))
+    raise ValueError("auto_mesh supports a single axis; use init_mesh")
+
+
+def set_mesh(mesh: ProcessMesh):
+    _current_mesh.clear()
+    _current_mesh.append(mesh)
+
+
+def get_mesh() -> ProcessMesh | None:
+    return _current_mesh[-1] if _current_mesh else None

@@ -7,11 +7,18 @@ XLA host-device virtualization — single process, deterministic
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# XLA_FLAGS is read from the environment when the backend is created, but
+# JAX_PLATFORMS is captured by jax's config at *import* time — and jax._src
+# is pre-imported in this image — so the platform must go through
+# jax.config.update, not the environment.
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
