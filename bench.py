@@ -1,0 +1,100 @@
+"""Benchmark: Llama pretraining step throughput on the available chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric is tokens/sec/chip on a Llama-1B-class pretrain step (fwd+bwd+Adam,
+bf16 compute, fp32 master weights, recompute on) — the single-chip proxy
+for BASELINE.json's north star (Llama-3-8B >=40% MFU on v5p-64).
+vs_baseline = measured MFU / 0.40 (the north-star MFU target; the reference
+repo publishes no absolute numbers — BASELINE.md).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+# bf16 peak FLOP/s per chip by device kind (public TPU specs)
+_PEAK = {
+    "TPU v4": 275e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+    "TPU v6e": 918e12,
+}
+
+
+def _peak_flops(dev) -> float:
+    kind = getattr(dev, "device_kind", "") or ""
+    for k, v in _PEAK.items():
+        if kind.startswith(k) or k in kind:
+            return v
+    return 459e12  # assume v5p (the north-star part)
+
+
+def main():
+    import jax
+    import paddle_tpu
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models import LlamaForCausalLM, LlamaConfig
+    from paddle_tpu.models.llama import flops_per_token, tiny_llama_config
+    from paddle_tpu.parallel import Trainer, TrainStepConfig
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        # TinyLlama-1.1B-class: fits one chip with Adam fp32 state
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=22, num_attention_heads=32,
+            num_key_value_heads=4, max_position_embeddings=2048,
+            rope_theta=10000.0, seq_length=2048, recompute=True,
+            use_flash_attention=True)
+        batch, seq, steps = 8, 2048, 10
+    else:
+        cfg = tiny_llama_config(recompute=True)
+        batch, seq, steps = 4, 32, 3
+
+    paddle_tpu.seed(0)
+    model = LlamaForCausalLM(cfg)
+    optimizer = opt.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(), weight_decay=0.01)
+    trainer = Trainer(model, optimizer,
+                      config=TrainStepConfig(compute_dtype="bfloat16"))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    data = {"input_ids": ids, "labels": ids}
+
+    trainer.step(data)  # compile + warmup
+    jax.block_until_ready(trainer.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(data)
+    jax.block_until_ready(trainer.params)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    ftok = flops_per_token(cfg, seq)
+    # recompute replays each layer's forward once: ~8N/token instead of 6N
+    if cfg.recompute:
+        ftok = ftok * 8.0 / 6.0
+    mfu = tokens_per_sec * ftok / _peak_flops(dev) if on_tpu else 0.0
+
+    print(json.dumps({
+        "metric": "llama1b_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
+        "extra": {"mfu": round(mfu, 4), "loss": round(float(loss), 4),
+                  "device": getattr(dev, "device_kind", str(dev)),
+                  "batch": batch, "seq": seq, "steps": steps},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,196 @@
+"""Context parallelism: ring attention + Ulysses (all-to-all) attention.
+
+The reference has NO ring/Ulysses attention (SURVEY.md §5 long-context:
+"No ring attention, no blockwise attention, no Ulysses all-to-all attention
+exists in this tree" — verified); it only ships the 'sep' mesh axis +
+Megatron-SP scatter/gather utils and leaves attention-side handling to
+model code. This module ADDS the capability the north star needs:
+
+- Ulysses: activations arrive seq-sharded over the 'sp' axis; one
+  all-to-all turns seq-sharding into head-sharding, full-sequence flash
+  attention runs per local head group, a second all-to-all restores
+  seq-sharding. Collective volume: 2 x activations over ICI.
+- Ring: K/V shards rotate around the 'sp' ring via `ppermute` while each
+  device's Q shard accumulates online-softmax partial results — attention
+  memory O(S_local^2) never materialises; comm overlaps compute steps.
+
+Both are expressed with `jax.shard_map` over ONLY the 'sp' axis
+(axis_names={'sp'}): dp/fsdp/mp stay in GSPMD-auto mode, so these compose
+with the rest of the 4D plan inside one jit program.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.kernels.flash_attention import (
+    _NEG_INF, _chunked_attention, flash_attention_bhsd)
+
+
+# ---------------------------------------------------------------------------
+# ring attention core (operates on LOCAL shards inside shard_map)
+# ---------------------------------------------------------------------------
+
+def _merge_block(q, kj, vj, m, l, acc, sm_scale, causal, row_off, col_off):
+    """Online-softmax merge of one K/V block into the running (m, l, acc).
+    q: (B,H,Sq,D); kj/vj: (B,H,Sk,D); offsets are global positions."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * sm_scale,
+                   kj.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    if causal:
+        sq, sk = q.shape[2], kj.shape[2]
+        row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + row_off
+        col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1) + col_off
+        s = jnp.where(col <= row, s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, vj.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
+    """Local view: q,k,v (B, H, S_local, D), seq dim sharded over
+    `axis_name`. Returns local (B, H, S_local, D)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_loc = q.shape[2]
+    b, h, _, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        m, l, acc, k_cur, v_cur = carry
+        src = (idx - i) % n          # whose shard we hold this step
+        m, l, acc = _merge_block(
+            q, k_cur, v_cur, m, l, acc, sm_scale, causal,
+            row_off=idx * s_loc, col_off=src * s_loc)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m, l, acc, k_nxt, v_nxt), None
+
+    m0 = jnp.full((b, h, s_loc, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    a0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        step, (m0, l0, a0, k, v), jnp.arange(n))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ulysses_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
+    """Local view: q (B, S_local, H, D) seq-sharded. All-to-all to
+    head-sharding, full-seq attention, all-to-all back (DeepSpeed-Ulysses;
+    the reference's 'sep' axis ambition, topology.py:184, realised)."""
+    n = jax.lax.axis_size(axis_name)
+    hq, hk = q.shape[2], k.shape[2]
+    if hk != hq:                      # GQA: repeat kv to q heads first
+        k = jnp.repeat(k, hq // hk, axis=2)
+        v = jnp.repeat(v, hq // hk, axis=2)
+    # (B, S/n, H, D) -> (B, S, H/n, D)
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            split_axis=2, concat_axis=1, tiled=True)
+    qg, kg, vg = a2a(q), a2a(k), a2a(v)
+    out = flash_attention_bhsd(
+        jnp.swapaxes(qg, 1, 2), jnp.swapaxes(kg, 1, 2),
+        jnp.swapaxes(vg, 1, 2), causal=causal, sm_scale=sm_scale)
+    out = jnp.swapaxes(out, 1, 2)     # (B, S, H/n, D)
+    return jax.lax.all_to_all(out, axis_name=axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# global-array wrappers (shard_map over the sp axis only)
+# ---------------------------------------------------------------------------
+
+def _attn_specs(mesh, axis):
+    """Specs for (B, S, H, D) attention inputs in a full-manual shard_map:
+    batch over dp/fsdp, seq over the cp axis, heads over mp. Attention is
+    embarrassingly parallel over batch and heads, so full-manual over these
+    axes is exact; only `axis` carries collectives."""
+    names = mesh.axis_names
+    batch = tuple(a for a in ("dp", "fsdp") if a in names)
+    heads = "mp" if "mp" in names else None
+    return P(batch if batch else None, axis, heads, None)
+
+
+def ring_attention(q, k, v, mesh=None, axis="sp", causal=True,
+                   sm_scale=None):
+    """Global arrays (B, S, H, D); seq dim sharded over mesh axis `axis`.
+    GQA handled by head repeat."""
+    from paddle_tpu.distributed.mesh import ProcessMesh
+    if isinstance(mesh, ProcessMesh):
+        mesh = mesh.jax_mesh
+    hq, hk = q.shape[2], k.shape[2]
+    if hk != hq:
+        k = jnp.repeat(k, hq // hk, axis=2)
+        v = jnp.repeat(v, hq // hk, axis=2)
+
+    def local(ql, kl, vl):
+        out = ring_attention_local(
+            jnp.swapaxes(ql, 1, 2), jnp.swapaxes(kl, 1, 2),
+            jnp.swapaxes(vl, 1, 2), axis, causal=causal,
+            sm_scale=sm_scale)
+        return jnp.swapaxes(out, 1, 2)
+
+    spec = _attn_specs(mesh, axis)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=True,
+                      sm_scale=None):
+    """Global arrays (B, S, H, D); seq dim sharded over mesh axis `axis`."""
+    from paddle_tpu.distributed.mesh import ProcessMesh
+    if isinstance(mesh, ProcessMesh):
+        mesh = mesh.jax_mesh
+    hq, hk = q.shape[2], k.shape[2]
+    if hk != hq:
+        k = jnp.repeat(k, hq // hk, axis=2)
+        v = jnp.repeat(v, hq // hk, axis=2)
+    local = functools.partial(ulysses_attention_local, axis_name=axis,
+                              causal=causal, sm_scale=sm_scale)
+    spec = _attn_specs(mesh, axis)
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# model integration: a context that reroutes sdpa to ring/ulysses
+# ---------------------------------------------------------------------------
+
+_cp_state = {"mode": None, "mesh": None, "axis": "sp"}
+
+
+@contextmanager
+def context_parallel_guard(mesh, axis="sp", mode="ring"):
+    """Inside this context, nn.functional.scaled_dot_product_attention /
+    flash_attention route through ring or Ulysses attention over `axis`."""
+    prev = dict(_cp_state)
+    _cp_state.update(mode=mode, mesh=mesh, axis=axis)
+    try:
+        yield
+    finally:
+        _cp_state.update(prev)
+
+
+def current_context_parallel():
+    return dict(_cp_state) if _cp_state["mode"] else None
+
+
+def dispatch_context_parallel(q, k, v, causal):
+    """Called by the attention ops when a guard is active; q,k,v are raw
+    arrays (B, S, H, D)."""
+    st = _cp_state
+    f = ring_attention if st["mode"] == "ring" else ulysses_attention
+    return f(q, k, v, mesh=st["mesh"], axis=st["axis"], causal=causal)

@@ -1,0 +1,104 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference: paddle.distributed.fleet.utils.recompute (python/paddle/
+distributed/fleet/recompute/recompute.py) — a PyLayer that reruns the
+forward during backward instead of storing activations.
+
+TPU-native: `jax.checkpoint` IS this feature, applied to the pure function
+of (params, inputs). In eager mode we record ONE tape node for the whole
+wrapped call whose vjp is the rematerialising `jax.vjp(jax.checkpoint(f))`;
+under jit tracing the checkpoint annotation lands in the jaxpr and XLA's
+rematerialisation pass honours it. Either way, residuals for the wrapped
+region collapse to its inputs.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from paddle_tpu.core.tape import (TapeNode, current_tape, grad_enabled,
+                                  no_grad, push_tape, pop_tape)
+from paddle_tpu.core.tensor import Tensor
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              **kwargs):
+    """Run `function(*args, **kwargs)` without saving its internal
+    activations; recompute them during backward.
+
+    `function` may be a Layer (its parameters join the differentiable
+    inputs) or any callable over Tensors.
+    """
+    from paddle_tpu.jit.functional import state_tensors, _swapped
+
+    layer_state = {}
+    if hasattr(function, "forward") and hasattr(function, "named_parameters"):
+        layer_state = state_tensors(function)
+
+    leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
+    tensor_idx = [i for i, l in enumerate(leaves) if _is_tensor(l)]
+    state_names = list(layer_state)
+
+    out_info = {}
+
+    def pure(state_arrays, arg_arrays):
+        lv = list(leaves)
+        for i, a in zip(tensor_idx, arg_arrays):
+            lv[i] = Tensor(a, stop_gradient=False)
+        a2, k2 = jax.tree.unflatten(treedef, lv)
+        prev = push_tape()
+        try:
+            with no_grad():
+                if state_names:
+                    with _swapped(function, dict(zip(state_names,
+                                                     state_arrays))):
+                        out = function(*a2, **k2)
+                else:
+                    out = function(*a2, **k2)
+        finally:
+            pop_tape(prev)
+        flat, out_treedef = jax.tree.flatten(
+            out, is_leaf=_is_tensor)
+        out_info["treedef"] = out_treedef
+        return tuple(f._value if _is_tensor(f) else f for f in flat)
+
+    ckpt = jax.checkpoint(pure)
+    state_arrays = [layer_state[k]._value for k in state_names]
+    arg_arrays = [leaves[i]._value for i in tensor_idx]
+
+    diff_inputs = [layer_state[k] for k in state_names
+                   if not layer_state[k].stop_gradient]
+    diff_inputs += [leaves[i] for i in tensor_idx
+                    if not leaves[i].stop_gradient]
+
+    if not (grad_enabled() and diff_inputs):
+        # Even without the eager tape (e.g. under functional_call tracing
+        # inside a jitted train step) the checkpoint annotation must land
+        # in the jaxpr so a later jax.grad over the traced program remats.
+        out_flat = ckpt(state_arrays, arg_arrays)
+        wrapped = [Tensor(a, stop_gradient=True) for a in out_flat]
+        return jax.tree.unflatten(out_info["treedef"], wrapped)
+
+    out_flat, vjp_fn = jax.vjp(ckpt, state_arrays, arg_arrays)
+    wrapped = [Tensor(a, stop_gradient=False) for a in out_flat]
+
+    diff_state_pos = [p for p, k in enumerate(state_names)
+                      if not layer_state[k].stop_gradient]
+    diff_arg_pos = [p for p, i in enumerate(tensor_idx)
+                    if not leaves[i].stop_gradient]
+
+    def tape_vjp(cotangents):
+        gs, ga = vjp_fn(tuple(cotangents))
+        return ([gs[p] for p in diff_state_pos]
+                + [ga[p] for p in diff_arg_pos])
+
+    node = TapeNode(
+        "recompute", inputs=diff_inputs, outputs=wrapped, vjp_fn=tape_vjp,
+        out_avals=[(a.shape, a.dtype) for a in out_flat])
+    current_tape().record(node)
+    return jax.tree.unflatten(out_info["treedef"], wrapped)

@@ -1,0 +1,8 @@
+"""paddle.incubate parity namespace (reference: python/paddle/incubate/).
+
+Hosts the fused-op functional API the reference's LLM recipes call
+(fused_rms_norm, fused_rotary_position_embedding, swiglu, ...). On TPU
+"fused" means: expressed so XLA fuses it into one kernel, or routed to a
+Pallas kernel where XLA's fusion is insufficient (paddle_tpu.kernels).
+"""
+from paddle_tpu.incubate import nn  # noqa: F401

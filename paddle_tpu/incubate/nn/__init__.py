@@ -1,0 +1,1 @@
+from paddle_tpu.incubate.nn import functional  # noqa: F401

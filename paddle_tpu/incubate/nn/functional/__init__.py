@@ -1,0 +1,195 @@
+"""Fused-op functional API (reference: python/paddle/incubate/nn/functional/
+fused_rms_norm.py, fused_rotary_position_embedding.py, swiglu.py,
+fused_layer_norm.py — CUDA kernels under paddle/phi/kernels/fusion/gpu/).
+
+TPU-native: each op is ONE traced jax expression, so XLA's fusion pass emits
+a single kernel — the hand-written CUDA fusion the reference needs is the
+compiler's job here. Ops that XLA fuses poorly (blockwise attention) live in
+paddle_tpu.kernels as Pallas kernels instead.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+
+
+# ---------------------------------------------------------------------------
+# rms / layer norm
+# ---------------------------------------------------------------------------
+
+def _rms_norm_raw(x, weight, bias, epsilon, begin_norm_axis):
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=axes, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + epsilon)
+    y = y.astype(x.dtype) * weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@defop("fused_rms_norm", amp_policy="black",
+       spmd_note="norm axis must be replicated; batch/seq axes free")
+def _fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                    begin_norm_axis=-1):
+    ax = begin_norm_axis if begin_norm_axis >= 0 else x.ndim + begin_norm_axis
+    return _rms_norm_raw(x, norm_weight, norm_bias, epsilon, ax)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kwargs):
+    """Reference: incubate/nn/functional/fused_rms_norm.py (kernel
+    phi/kernels/fusion/gpu/fused_layernorm_kernel.cu rmsnorm branch).
+    Returns (out, invvar-placeholder) pair like the reference."""
+    out = _fused_rms_norm(x, norm_weight, norm_bias, epsilon=epsilon,
+                          begin_norm_axis=begin_norm_axis)
+    return out, None
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, **kwargs):
+    from paddle_tpu.nn import functional as F
+    return F.layer_norm(x, x.shape[begin_norm_axis:], norm_weight,
+                        norm_bias, epsilon), None
+
+
+# ---------------------------------------------------------------------------
+# rotary position embedding
+# ---------------------------------------------------------------------------
+
+def _rope_cos_sin(seq_len, head_dim, theta, dtype, position_ids=None):
+    inv_freq = 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if position_ids is None:
+        t = jnp.arange(seq_len, dtype=jnp.float32)
+        freqs = jnp.outer(t, inv_freq)            # (S, D/2)
+    else:
+        freqs = position_ids[..., None].astype(jnp.float32) * inv_freq
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def _apply_rope_neox(x, cos, sin):
+    """NeoX/Llama style: rotate [first half | second half]. x: (B,S,H,D);
+    cos/sin broadcastable (S, D/2) or (B,S,D/2)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    if cos.ndim == 2:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    else:
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    cos = cos.astype(jnp.float32)
+    sin = sin.astype(jnp.float32)
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    o1 = xf1 * cos - xf2 * sin
+    o2 = xf2 * cos + xf1 * sin
+    return jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
+
+
+def _apply_rope_interleaved(x, cos, sin):
+    """GPT-J style: rotate even/odd interleaved pairs."""
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    if cos.ndim == 2:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    else:
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    o1 = x1 * cos - x2 * sin
+    o2 = x2 * cos + x1 * sin
+    out = jnp.stack([o1, o2], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+@defop("fused_rope", amp_policy="white",
+       spmd_note="heads axis shards over 'mp'; seq sharding composes with "
+                 "position_ids offsets (context parallel)")
+def _fused_rope(q, k, v, sin, cos, position_ids, use_neox_rotary_style,
+                theta):
+    seq_len, head_dim = q.shape[1], q.shape[-1]
+    if cos is None or sin is None:
+        cos, sin = _rope_cos_sin(seq_len, head_dim, theta, q.dtype,
+                                 position_ids)
+    else:
+        # reference passes (1, S, 1, D) duplicated-half tables; reduce to D/2
+        cos = jnp.squeeze(cos)[..., : head_dim // 2]
+        sin = jnp.squeeze(sin)[..., : head_dim // 2]
+        if position_ids is not None:
+            cos = jnp.take(cos, position_ids, axis=0)
+            sin = jnp.take(sin, position_ids, axis=0)
+    apply = (_apply_rope_neox if use_neox_rotary_style
+             else _apply_rope_interleaved)
+    outs = tuple(apply(t, cos, sin) if t is not None else None
+                 for t in (q, k, v))
+    return tuple(o for o in outs if o is not None)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    rotary_emb_base=10000.0, **kwargs):
+    """Reference: incubate/nn/functional/fused_rotary_position_embedding.py
+    (kernel phi/kernels/fusion/gpu/fused_rope). Layout (B, S, H, D)."""
+    outs = _fused_rope(q, k, v, sin, cos, position_ids,
+                       use_neox_rotary_style=use_neox_rotary_style,
+                       theta=rotary_emb_base)
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    res = list(outs) + [None] * (3 - len(outs))
+    return tuple(res[:3])
+
+
+# ---------------------------------------------------------------------------
+# activations / gemm epilogues
+# ---------------------------------------------------------------------------
+
+@defop("swiglu", amp_policy="white")
+def _swiglu(x, y):
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x.astype(jnp.float32)).astype(x.dtype) * y
+
+
+def swiglu(x, y=None, name=None):
+    """Reference: incubate/nn/functional/swiglu.py — silu(x) * y, or split
+    x in half when y is None (phi SwiGLU kernel)."""
+    return _swiglu(x, y)
+
+
+@defop("fused_bias_act", amp_policy="white")
+def _fused_bias_act(x, bias, act_method):
+    if bias is not None:
+        x = x + bias
+    if act_method in ("gelu", "geglu"):
+        return jax.nn.gelu(x)
+    if act_method in ("swiglu",):
+        a, b = jnp.split(x, 2, axis=-1)
+        return jax.nn.silu(a) * b
+    if act_method == "relu":
+        return jax.nn.relu(x)
+    return x
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kwargs):
+    """Reference: fused_bias_act_kernel.cu — bias + activation in one pass;
+    one XLA fusion here."""
+    return _fused_bias_act(x, bias, act_method)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """Reference: incubate/nn/functional/fused_linear (cublasLt gemm
+    epilogue). XLA fuses bias-add into the MXU matmul."""
+    from paddle_tpu.nn import functional as F
+    if transpose_weight:
+        from paddle_tpu import tensor as T
+        weight = T.transpose(weight, [1, 0])
+    return F.linear(x, weight, bias)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    out = fused_linear(x, y, bias, transpose_weight=trans_y)
+    return fused_bias_act(out, None, act_method=activation)

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — the rebuild's equivalent of the reference's
+hand-written CUDA fusion library (reference: paddle/phi/kernels/fusion/gpu/,
+third_party/flashattn, paddle/cinn codegen). Only ops XLA cannot fuse well
+live here; everything else rides XLA fusion (SURVEY.md §2.4 "TPU
+equivalent: XLA itself").
+"""
+from paddle_tpu.kernels import flash_attention  # noqa: F401

@@ -1,0 +1,235 @@
+"""FlashAttention for TPU (Pallas).
+
+Replaces the reference's vendored FA2 CUDA library (reference:
+third_party/flashattn + paddle/phi/kernels/gpu/flash_attn_kernel.cu,
+python surface python/paddle/nn/functional/flash_attention.py) with a
+TPU-native pair:
+
+- forward: a Pallas kernel — one grid cell per (batch, head, q-block),
+  online-softmax accumulation over k/v blocks streamed through VMEM, MXU
+  matmuls in f32 accumulation. Causal cells whose k-block lies entirely
+  above the diagonal are skipped via the loop bound.
+- backward: rematerialising chunked attention (lax.scan over k/v blocks
+  with jax.checkpoint per block) differentiated by JAX AD. Exact same math
+  as the forward, O(S·D) residual memory — the FA2 recompute strategy
+  expressed as a program transform instead of a second handwritten kernel.
+
+Layouts: public entry takes paddle's (batch, seq, heads, head_dim).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _pick_block(seq, target):
+    """Largest power-of-two block <= target that divides/covers seq."""
+    b = min(target, max(8, 1 << (seq - 1).bit_length()))
+    return min(b, target)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_k,
+                kv_valid):
+    bq, d = q_ref.shape[2], q_ref.shape[3]
+    kv_pad = k_ref.shape[2]
+    iq = pl.program_id(2)
+
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
+
+    nk_total = kv_pad // block_k
+    if causal:
+        # number of k-blocks touching rows [iq*bq, (iq+1)*bq)
+        nk = jnp.minimum(((iq + 1) * bq + block_k - 1) // block_k, nk_total)
+    else:
+        nk = nk_total
+
+    def body(j, carry):
+        m, l, acc = carry
+        kj = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vj = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bq, bk)
+        col = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1) \
+            + j * block_k
+        valid = col < kv_valid
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0) \
+                + iq * bq
+            valid = jnp.logical_and(valid, col <= row)
+        s = jnp.where(valid, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, vj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=512, block_k=512,
+                      interpret=False):
+    """q,k,v: (B, H, S, D) with equal head counts. Returns (B, H, Sq, D)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    # pad seqs to block multiples
+    sq_p = (sq + bq - 1) // bq * bq
+    sk_p = (sk + bk - 1) // bk * bk
+    if sq_p != sq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+    if sk_p != sk:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
+                               causal=causal, block_k=bk, kv_valid=sk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h, sq_p // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, sk_p, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sk_p, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :, :sq, :]
+
+
+# ---------------------------------------------------------------------------
+# Chunked (blockwise) attention in pure jax — backward path + CPU fallback
+# ---------------------------------------------------------------------------
+
+def _chunked_attention(q, k, v, causal, sm_scale, block_q=512, block_k=512):
+    """(B,H,S,D) exact attention via online softmax over k/v blocks.
+    jax.checkpoint per block => O(S·D) residuals under AD."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    sq_p = (sq + bq - 1) // bq * bq
+    sk_p = (sk + bk - 1) // bk * bk
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, sq_p - sq), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+    nq, nk = sq_p // bq, sk_p // bk
+
+    qb = qp.reshape(b, h, nq, bq, d)
+    kb = kp.reshape(b, h, nk, bk, d)
+    vb = vp.reshape(b, h, nk, bk, d)
+
+    @jax.checkpoint
+    def block(qi, kj, vj, iq, jk):
+        qf = qi.astype(jnp.float32) * sm_scale
+        s = jnp.einsum("...qd,...kd->...qk", qf, kj.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + jk * bk
+        valid = col < sk
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
+            valid = jnp.logical_and(valid, col <= row)
+        s = jnp.where(valid, s, _NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        o = jnp.einsum("...qk,...kd->...qd", p, vj.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        return m, l, o
+
+    def q_block(iq, qi):
+        def kv_step(carry, jk):
+            m, l, acc = carry
+            mj, lj, oj = block(qi, kb[:, :, jk], vb[:, :, jk], iq, jk)
+            m_new = jnp.maximum(m, mj)
+            alpha = jnp.exp(m - m_new)
+            beta = jnp.exp(mj - m_new)
+            l_new = l * alpha + lj * beta
+            acc_new = acc * alpha + oj * beta
+            return (m_new, l_new, acc_new), None
+
+        m0 = jnp.full((b, h, bq, 1), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, bq, 1), jnp.float32)
+        a0 = jnp.zeros((b, h, bq, d), jnp.float32)
+        (m, l, acc), _ = jax.lax.scan(kv_step, (m0, l0, a0),
+                                      jnp.arange(nk))
+        return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+    outs = jax.lax.map(lambda i: q_block(i, qb[:, :, i]), jnp.arange(nq))
+    out = jnp.moveaxis(outs, 0, 2).reshape(b, h, sq_p, d)
+    return out[:, :, :sq, :]
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp glue
+# ---------------------------------------------------------------------------
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, sm_scale):
+    if _on_tpu():
+        return _flash_fwd_pallas(q, k, v, causal, sm_scale)
+    return _chunked_attention(q, k, v, causal, sm_scale)
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale):
+    return _flash(q, k, v, causal, sm_scale), (q, k, v)
+
+
+def _flash_bwd_rule(causal, sm_scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _chunked_attention(q_, k_, v_, causal, sm_scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None):
+    """(B, H, S, D) entry. GQA: kv head count may divide q head count."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    hq, hk = q.shape[1], k.shape[1]
+    if hk != hq:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    return _flash(q, k, v, causal, sm_scale)
+
+
+def flash_attention_bshd(q, k, v, causal=False, sm_scale=None):
+    """Paddle layout (B, S, H, D) (reference flash_attention surface)."""
+    out = flash_attention_bhsd(
+        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+        jnp.swapaxes(v, 1, 2), causal=causal, sm_scale=sm_scale)
+    return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,13 @@
+"""Model zoo: the flagship model families the reference's ecosystem trains
+(PaddleNLP llm/ recipes — Llama-3, Qwen2/Qwen2-MoE; PaddleMIX — DiT), built
+natively on paddle_tpu layers.
+
+The reference keeps models out-of-tree (PaddleNLP/PaddleMIX); we ship them
+in-tree because BASELINE.json's north-star configs are model-level
+(Llama-3-8B pretrain, Qwen2-MoE, DiT) and the parallel plans in
+paddle_tpu.parallel are keyed to these architectures.
+"""
+from paddle_tpu.models.llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel, RMSNorm,
+    llama3_8b_config, tiny_llama_config,
+)

@@ -1,0 +1,235 @@
+"""Llama-3 model family, TPU-native.
+
+The reference trains Llama via PaddleNLP's llm/ recipes on top of
+paddle.nn + incubate fused ops (fused_rms_norm, fused_rotary_position_
+embedding, swiglu, fused attention) and fleet hybrid parallel; this module
+is the in-tree equivalent the BASELINE.json north-star config
+("Llama-3-8B pretrain, DP+TP, >=40% MFU on v5p") trains.
+
+Design notes (TPU-first):
+- All matmuls are (B*S, D) x (D, F) shaped — large, static, bf16-friendly —
+  so XLA tiles them onto the MXU.
+- Attention goes through nn.functional.scaled_dot_product_attention, which
+  routes to the Pallas flash kernel for long sequences.
+- The decoder stack iterates Python-side (unrolled under jit). The parallel
+  trainer (paddle_tpu.parallel) optionally rewrites the stack into a
+  lax.scan over stacked layer params for fast compiles + pipeline parallel.
+- Sharding is NOT baked into the model: paddle_tpu.parallel.plan attaches a
+  GSPMD sharding plan (param-name -> PartitionSpec) for dp/fsdp/mp/sp axes,
+  replacing the reference's ColumnParallelLinear/RowParallelLinear split
+  classes (fleet/layers/mpu/mp_layers.py:335,542) with plain Linears +
+  shardings.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu import tensor as T
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer.norm import RMSNorm
+from paddle_tpu.incubate.nn.functional import (
+    fused_rotary_position_embedding, swiglu,
+)
+
+
+@dataclass
+class LlamaConfig:
+    """Mirror of PaddleNLP's LlamaConfig fields that matter for pretrain."""
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    tie_word_embeddings: bool = False
+    initializer_range: float = 0.02
+    use_flash_attention: bool = False
+    # rerun each decoder layer's forward during backward instead of saving
+    # activations (fleet.utils.recompute equivalent -> jax.checkpoint)
+    recompute: bool = False
+    # sequence length used by helpers that need one (bench, example inputs)
+    seq_length: int = 4096
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama3_8b_config(**overrides) -> LlamaConfig:
+    return LlamaConfig(**overrides)
+
+
+def tiny_llama_config(**overrides) -> LlamaConfig:
+    """4-layer toy config for tests / CPU dryruns."""
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=4, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=256,
+                rope_theta=10000.0, seq_length=32)
+    base.update(overrides)
+    return LlamaConfig(**base)
+
+
+class LlamaAttention(nn.Layer):
+    """GQA attention with RoPE (PaddleNLP LlamaAttention equivalent;
+    reference fused path: incubate fused_rope + flash_attention kernels
+    phi/kernels/gpu/flash_attn_kernel.cu)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        d, hd = config.hidden_size, config.head_dim
+        kv_out = config.num_key_value_heads * hd
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        attr = paddle_tpu.nn.ParamAttr(initializer=init)
+        self.q_proj = nn.Linear(d, d, weight_attr=attr, bias_attr=False)
+        self.k_proj = nn.Linear(d, kv_out, weight_attr=attr, bias_attr=False)
+        self.v_proj = nn.Linear(d, kv_out, weight_attr=attr, bias_attr=False)
+        self.o_proj = nn.Linear(d, d, weight_attr=attr, bias_attr=False)
+
+    def forward(self, hidden_states, position_ids=None, attn_mask=None):
+        cfg = self.config
+        b, s = hidden_states.shape[0], hidden_states.shape[1]
+        q = self.q_proj(hidden_states)
+        k = self.k_proj(hidden_states)
+        v = self.v_proj(hidden_states)
+        q = T.reshape(q, [b, s, cfg.num_attention_heads, cfg.head_dim])
+        k = T.reshape(k, [b, s, cfg.num_key_value_heads, cfg.head_dim])
+        v = T.reshape(v, [b, s, cfg.num_key_value_heads, cfg.head_dim])
+        q, k, _ = fused_rotary_position_embedding(
+            q, k, None, position_ids=position_ids,
+            rotary_emb_base=cfg.rope_theta)
+        if cfg.use_flash_attention and attn_mask is None:
+            out, _ = F.flash_attention(q, k, v, causal=True)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
+        out = T.reshape(out, [b, s, cfg.hidden_size])
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU MLP (PaddleNLP LlamaMLP; fused path incubate swiglu)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        d, f = config.hidden_size, config.intermediate_size
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        attr = paddle_tpu.nn.ParamAttr(initializer=init)
+        self.gate_proj = nn.Linear(d, f, weight_attr=attr, bias_attr=False)
+        self.up_proj = nn.Linear(d, f, weight_attr=attr, bias_attr=False)
+        self.down_proj = nn.Linear(f, d, weight_attr=attr, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       epsilon=config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                epsilon=config.rms_norm_eps)
+
+    def forward(self, hidden_states, position_ids=None, attn_mask=None):
+        residual = hidden_states
+        h = self.input_layernorm(hidden_states)
+        h = self.self_attn(h, position_ids=position_ids, attn_mask=attn_mask)
+        h = residual + h
+        residual = h
+        h2 = self.post_attention_layernorm(h)
+        h2 = self.mlp(h2)
+        return residual + h2
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.embed_tokens = nn.Embedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=paddle_tpu.nn.ParamAttr(initializer=init))
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, position_ids=None, attn_mask=None):
+        from paddle_tpu.distributed.recompute import recompute
+        h = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            if self.config.recompute and self.training:
+                h = recompute(layer, h, position_ids=position_ids,
+                              attn_mask=attn_mask)
+            else:
+                h = layer(h, position_ids=position_ids, attn_mask=attn_mask)
+        return self.norm(h)
+
+
+class LlamaForCausalLM(nn.Layer):
+    """Causal LM head + shifted cross-entropy loss (PaddleNLP
+    LlamaForCausalLM + LlamaPretrainingCriterion equivalent)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            init = nn.initializer.Normal(0.0, config.initializer_range)
+            self.lm_head = nn.Linear(
+                config.hidden_size, config.vocab_size,
+                weight_attr=paddle_tpu.nn.ParamAttr(initializer=init),
+                bias_attr=False)
+
+    def logits(self, hidden):
+        if self.lm_head is None:
+            w = self.model.embed_tokens.weight
+            return T.matmul(hidden, T.transpose(w, [1, 0]))
+        return self.lm_head(hidden)
+
+    def forward(self, input_ids, labels=None, position_ids=None,
+                attn_mask=None):
+        h = self.model(input_ids, position_ids=position_ids,
+                       attn_mask=attn_mask)
+        logits = self.logits(h)
+        if labels is None:
+            return logits
+        # next-token prediction: logits[:, :-1] vs labels[:, 1:]
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        loss = F.cross_entropy(
+            T.reshape(shift_logits, [-1, self.config.vocab_size]),
+            T.reshape(shift_labels, [-1]),
+            reduction="mean")
+        return loss, logits
+
+
+def param_count(config: LlamaConfig) -> int:
+    """Analytic parameter count (for MFU math in bench.py)."""
+    d, f, v = config.hidden_size, config.intermediate_size, config.vocab_size
+    hd = config.head_dim
+    per_layer = (d * d + 2 * d * config.num_key_value_heads * hd + d * d
+                 + 3 * d * f + 2 * d)
+    head = 0 if config.tie_word_embeddings else d * v
+    return v * d + config.num_hidden_layers * per_layer + d + head
+
+
+def flops_per_token(config: LlamaConfig, seq_len: int) -> float:
+    """Training FLOPs/token ~= 6*N + attention term (for MFU)."""
+    n = param_count(config) - config.vocab_size * config.hidden_size * (
+        1 if config.tie_word_embeddings else 2)
+    attn = (12 * config.num_hidden_layers * config.hidden_size * seq_len)
+    return 6.0 * n + attn

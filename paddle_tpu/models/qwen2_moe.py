@@ -1,0 +1,161 @@
+"""Qwen2-MoE-class model family (BASELINE.json config #5:
+"Qwen2-MoE / DeepSeekMoE with fleet expert-parallel").
+
+The reference trains this through PaddleNLP with
+incubate.distributed.models.moe.MoELayer + fleet's expert-parallel groups;
+here the decoder reuses the Llama attention stack with the expert-parallel
+MoEMLP (paddle_tpu.nn.layer.moe), plus the Qwen2-MoE shared expert with a
+sigmoid gate. Expert weights shard over the mesh's 'ep' axis via
+paddle_tpu.parallel.plan.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu import tensor as T
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer.norm import RMSNorm
+from paddle_tpu.nn.layer.moe import MoEMLP
+from paddle_tpu.models.llama import (LlamaAttention, LlamaMLP, LlamaConfig)
+
+
+@dataclass
+class Qwen2MoeConfig(LlamaConfig):
+    num_experts: int = 60
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: int = 1408
+    shared_expert_intermediate_size: int = 5632
+    capacity_factor: float = 1.25
+    router_aux_loss_coef: float = 0.001
+
+
+def tiny_qwen2_moe_config(**overrides) -> Qwen2MoeConfig:
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=256,
+                rope_theta=10000.0, seq_length=32, num_experts=4,
+                num_experts_per_tok=2, moe_intermediate_size=32,
+                shared_expert_intermediate_size=64)
+    base.update(overrides)
+    return Qwen2MoeConfig(**base)
+
+
+class Qwen2MoeSparseBlock(nn.Layer):
+    """MoE experts + always-on shared expert with sigmoid gate
+    (Qwen2-MoE architecture)."""
+
+    def __init__(self, config: Qwen2MoeConfig):
+        super().__init__()
+        self.moe = MoEMLP(
+            config.hidden_size, config.moe_intermediate_size,
+            config.num_experts, top_k=config.num_experts_per_tok,
+            capacity_factor=config.capacity_factor,
+            initializer_range=config.initializer_range)
+        shared_cfg = LlamaConfig(
+            hidden_size=config.hidden_size,
+            intermediate_size=config.shared_expert_intermediate_size,
+            initializer_range=config.initializer_range)
+        self.shared_expert = LlamaMLP(shared_cfg)
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.shared_expert_gate = nn.Linear(
+            config.hidden_size, 1,
+            weight_attr=paddle_tpu.nn.ParamAttr(initializer=init),
+            bias_attr=False)
+
+    def forward(self, x):
+        moe_out = self.moe(x)
+        shared = self.shared_expert(x)
+        g = F.sigmoid(self.shared_expert_gate(x))
+        return moe_out + g * shared
+
+    @property
+    def aux_loss(self):
+        return self.moe.aux_loss
+
+
+class Qwen2MoeDecoderLayer(nn.Layer):
+    def __init__(self, config: Qwen2MoeConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = Qwen2MoeSparseBlock(config)
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       epsilon=config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                epsilon=config.rms_norm_eps)
+
+    def forward(self, h, position_ids=None, attn_mask=None):
+        res = h
+        h = self.input_layernorm(h)
+        h = self.self_attn(h, position_ids=position_ids,
+                           attn_mask=attn_mask)
+        h = res + h
+        res = h
+        h2 = self.post_attention_layernorm(h)
+        h2 = self.mlp(h2)
+        return res + h2
+
+
+class Qwen2MoeModel(nn.Layer):
+    def __init__(self, config: Qwen2MoeConfig):
+        super().__init__()
+        self.config = config
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.embed_tokens = nn.Embedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=paddle_tpu.nn.ParamAttr(initializer=init))
+        self.layers = nn.LayerList(
+            [Qwen2MoeDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, position_ids=None, attn_mask=None):
+        from paddle_tpu.distributed.recompute import recompute
+        h = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            if self.config.recompute and self.training:
+                h = recompute(layer, h, position_ids=position_ids,
+                              attn_mask=attn_mask)
+            else:
+                h = layer(h, position_ids=position_ids,
+                          attn_mask=attn_mask)
+        return self.norm(h)
+
+    def aux_losses(self):
+        return [l.mlp.aux_loss for l in self.layers
+                if l.mlp.aux_loss is not None]
+
+
+class Qwen2MoeForCausalLM(nn.Layer):
+    def __init__(self, config: Qwen2MoeConfig):
+        super().__init__()
+        self.config = config
+        self.model = Qwen2MoeModel(config)
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.lm_head = nn.Linear(
+            config.hidden_size, config.vocab_size,
+            weight_attr=paddle_tpu.nn.ParamAttr(initializer=init),
+            bias_attr=False)
+
+    def forward(self, input_ids, labels=None, position_ids=None,
+                attn_mask=None):
+        h = self.model(input_ids, position_ids=position_ids,
+                       attn_mask=attn_mask)
+        logits = self.lm_head(h)
+        if labels is None:
+            return logits
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        loss = F.cross_entropy(
+            T.reshape(shift_logits, [-1, self.config.vocab_size]),
+            T.reshape(shift_labels, [-1]), reduction="mean")
+        auxes = self.model.aux_losses()
+        if auxes:
+            total_aux = auxes[0]
+            for a in auxes[1:]:
+                total_aux = total_aux + a
+            loss = loss + self.config.router_aux_loss_coef * total_aux
+        return loss, logits

@@ -55,6 +55,11 @@ def _sdpa_ref(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
                  "(paddle_tpu.distributed.ring_attention)")
 def _sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
           scale=None):
+    from paddle_tpu.distributed.context_parallel import (
+        current_context_parallel, dispatch_context_parallel)
+    if (current_context_parallel() and attn_mask is None and is_causal
+            and scale is None):
+        return dispatch_context_parallel(q, k, v, True)
     return _sdpa_ref(q, k, v, attn_mask, dropout_p, is_causal, scale)
 
 
@@ -67,7 +72,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
 @defop("flash_attention_op", amp_policy="white")
 def _flash_attention(q, k, v, dropout=0.0, causal=False):
+    from paddle_tpu.distributed.context_parallel import (
+        current_context_parallel, dispatch_context_parallel)
     from paddle_tpu.kernels import flash_attention as fa
+    if current_context_parallel() and causal:
+        return dispatch_context_parallel(q, k, v, True)
     return fa.flash_attention_bshd(q, k, v, causal=causal)
 
 
@@ -77,12 +86,8 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     """Reference: python/paddle/nn/functional/flash_attention.py
     flash_attention. Returns (out, softmax_lse-placeholder) like the
     reference's (out, softmax) pair."""
-    try:
-        out = _flash_attention(query, key, value, dropout=dropout,
-                               causal=causal)
-    except Exception:
-        out = _sdpa(query, key, value, None, dropout_p=dropout,
-                    is_causal=causal)
+    out = _flash_attention(query, key, value, dropout=dropout,
+                           causal=causal)
     if return_softmax:
         return out, None
     return out, None

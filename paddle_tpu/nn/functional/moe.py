@@ -1,0 +1,113 @@
+"""MoE gating + dispatch, TPU-native.
+
+Reference: python/paddle/incubate/distributed/models/moe/ — MoELayer with
+gshard/switch/naive gates (gate/gshard_gate.py, switch_gate.py) dispatching
+tokens through MoEScatter/MoEGather PyLayers over the global_scatter /
+global_gather all-to-all collective ops
+(paddle/fluid/operators/collective/global_scatter_op.cc).
+
+TPU-native: the GShard dense-einsum formulation. Gating produces a combine
+tensor (T, E, C) and a boolean dispatch mask; dispatch/return are einsums.
+When expert weights are sharded over the mesh's 'ep' axis, XLA partitions
+the (E, C, D) expert batch over 'ep' and emits the token all-to-all over
+ICI itself — the reference's global_scatter/global_gather pair compiled
+from shardings instead of hand-written. Capacity is static (XLA needs
+static shapes); overflow tokens are dropped (GShard semantics), which the
+aux load-balancing loss drives towards zero.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _one_hot(x, n, dtype=jnp.float32):
+    return jax.nn.one_hot(x, n, dtype=dtype)
+
+
+def top2_gating(logits, capacity_factor=1.25, train=True, rng_key=None):
+    """GShard top-2 gating (reference: moe/gate/gshard_gate.py).
+
+    logits: (T, E). Returns (combine (T,E,C), dispatch bool (T,E,C),
+    aux_loss scalar)."""
+    t, e = logits.shape
+    c = max(4, int(math.ceil(2 * t * capacity_factor / e)))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    idx1 = jnp.argmax(probs, axis=-1)                       # (T,)
+    mask1 = _one_hot(idx1, e)
+    probs2 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    mask2 = _one_hot(idx2, e)
+
+    # load-balancing aux loss (GShard eq.: E * sum(me * ce))
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux_loss = e * jnp.sum(me * ce)
+
+    # positions within each expert's capacity buffer
+    pos1 = (jnp.cumsum(mask1, axis=0) - 1.0) * mask1        # (T,E)
+    pos2 = ((jnp.cumsum(mask2, axis=0) - 1.0)
+            + jnp.sum(mask1, axis=0, keepdims=True)) * mask2
+    keep1 = (pos1 < c) & (mask1 > 0)
+    keep2 = (pos2 < c) & (mask2 > 0)
+    mask1 = mask1 * keep1
+    mask2 = mask2 * keep2
+
+    g1 = jnp.sum(probs * mask1, axis=-1)                    # (T,)
+    g2 = jnp.sum(probs * mask2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    p1 = jnp.sum(pos1 * mask1, axis=-1).astype(jnp.int32)   # (T,)
+    p2 = jnp.sum(pos2 * mask2, axis=-1).astype(jnp.int32)
+    in1 = jnp.sum(mask1, axis=-1) > 0
+    in2 = jnp.sum(mask2, axis=-1) > 0
+
+    cap1 = _one_hot(p1, c) * in1[:, None]                   # (T,C)
+    cap2 = _one_hot(p2, c) * in2[:, None]
+    combine = (g1[:, None, None] * mask1[:, :, None] * cap1[:, None, :]
+               + g2[:, None, None] * mask2[:, :, None] * cap2[:, None, :])
+    dispatch = combine > 0
+    return combine, dispatch, aux_loss
+
+
+def switch_gating(logits, capacity_factor=1.25, train=True, rng_key=None):
+    """Switch-Transformer top-1 gating (reference: moe/gate/switch_gate.py),
+    with optional multiplicative jitter during training."""
+    t, e = logits.shape
+    c = max(4, int(math.ceil(t * capacity_factor / e)))
+    if train and rng_key is not None:
+        noise = jax.random.uniform(rng_key, logits.shape, jnp.float32,
+                                   0.98, 1.02)
+        logits = logits * noise
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    mask = _one_hot(idx, e)
+
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(mask, axis=0)
+    aux_loss = e * jnp.sum(me * ce)
+
+    pos = (jnp.cumsum(mask, axis=0) - 1.0) * mask
+    keep = (pos < c) & (mask > 0)
+    mask = mask * keep
+    gate = jnp.sum(probs * mask, axis=-1)
+    p = jnp.sum(pos * mask, axis=-1).astype(jnp.int32)
+    inc = jnp.sum(mask, axis=-1) > 0
+    cap = _one_hot(p, c) * inc[:, None]
+    combine = gate[:, None, None] * mask[:, :, None] * cap[:, None, :]
+    return combine, combine > 0, aux_loss
+
+
+def moe_dispatch(x, dispatch):
+    """x (T,D), dispatch (T,E,C) -> expert inputs (E,C,D)."""
+    return jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+
+
+def moe_combine(expert_out, combine):
+    """expert_out (E,C,D), combine (T,E,C) -> (T,D)."""
+    return jnp.einsum("tec,ecd->td", combine.astype(expert_out.dtype),
+                      expert_out)

@@ -1,0 +1,80 @@
+"""MoE layer with stacked expert weights (expert-parallel ready).
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:263
+MoELayer — per-rank expert sublayers + all-to-all scatter/gather. Here the
+experts are ONE set of stacked (E, ...) parameters so the 'ep' mesh axis
+shards them declaratively (paddle_tpu.parallel.plan) and a vmap over the
+expert dim runs them batched on the MXU; XLA inserts the token all-to-all
+from the shardings.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.functional import moe as FM
+
+
+@defop("moe_mlp", amp_policy="white",
+       spmd_note="expert dim shards over 'ep'; token dims over dp/sp")
+def _moe_mlp(x, router_w, wg, wu, wd, k, capacity_factor):
+    """x (..., D) -> (..., D); router_w (D,E); wg/wu (E,D,F); wd (E,F,D).
+    Returns (out, aux_loss)."""
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    xt = x.reshape(-1, d)
+    logits = jnp.einsum("td,de->te", xt.astype(jnp.float32),
+                        router_w.astype(jnp.float32))
+    gate = FM.top2_gating if k == 2 else FM.switch_gating
+    combine, dispatch, aux = gate(logits, capacity_factor=capacity_factor)
+
+    expert_in = FM.moe_dispatch(xt, dispatch)            # (E,C,D)
+
+    def expert(w_g, w_u, w_d, h):
+        a = jnp.einsum("cd,df->cf", h, w_g)
+        b = jnp.einsum("cd,df->cf", h, w_u)
+        act = jax.nn.silu(a.astype(jnp.float32)).astype(h.dtype) * b
+        return jnp.einsum("cf,fd->cd", act, w_d)
+
+    expert_out = jax.vmap(expert)(wg, wu, wd, expert_in)  # (E,C,D)
+    out = FM.moe_combine(expert_out, combine)
+    return out.reshape(*lead, d), aux
+
+
+class MoEMLP(Layer):
+    """Drop-in replacement for a dense SwiGLU MLP. Stores the router plus
+    stacked expert weights; `aux_loss` is set on every forward and must be
+    added to the training loss (Qwen2-MoE/DeepSeekMoE convention)."""
+
+    def __init__(self, hidden_size, intermediate_size, num_experts,
+                 top_k=2, capacity_factor=1.25, initializer_range=0.02):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        init = I.Normal(0.0, initializer_range)
+        d, f, e = hidden_size, intermediate_size, num_experts
+        self.router_weight = self.create_parameter(
+            [d, e], default_initializer=init)
+        self.experts_gate_weight = self.create_parameter(
+            [e, d, f], default_initializer=init)
+        self.experts_up_weight = self.create_parameter(
+            [e, d, f], default_initializer=init)
+        self.experts_down_weight = self.create_parameter(
+            [e, f, d], default_initializer=init)
+        self.aux_loss = None
+
+    def forward(self, x):
+        out, aux = _moe_mlp(x, self.router_weight,
+                            self.experts_gate_weight,
+                            self.experts_up_weight,
+                            self.experts_down_weight,
+                            k=self.top_k,
+                            capacity_factor=self.capacity_factor)
+        self.aux_loss = aux
+        return out

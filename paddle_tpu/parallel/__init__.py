@@ -1,0 +1,15 @@
+"""paddle_tpu.parallel: TPU-native hybrid-parallel training.
+
+Replaces the reference's fleet hybrid-parallel machinery (reference:
+python/paddle/distributed/fleet/ — HybridCommunicateGroup topology over
+NCCL process groups, ColumnParallelLinear/RowParallelLinear weight-split
+layer classes, DygraphShardingOptimizer ZeRO stages, PipelineParallel 1F1B
+actors) with the GSPMD recipe: ONE `jax.sharding.Mesh` with named axes
+('dp','fsdp','mp','pp','sp','ep'), a declarative param-name -> PartitionSpec
+sharding plan, and a single jitted train step whose collectives XLA derives
+and schedules over ICI.
+"""
+from paddle_tpu.parallel.plan import (  # noqa: F401
+    ShardingPlan, llama_sharding_plan, apply_plan,
+)
+from paddle_tpu.parallel.trainer import Trainer, TrainStepConfig  # noqa: F401

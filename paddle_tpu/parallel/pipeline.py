@@ -1,0 +1,250 @@
+"""Pipeline parallelism, compiled (GPipe schedule inside one XLA program).
+
+The reference implements PP as a Python runtime: PipelineLayer stage
+partitioning + 1F1B/interleave schedulers exchanging activations over NCCL
+p2p (reference: .../meta_parallel/pipeline_parallel.py:440
+forward_backward_pipeline, pp_layers.py:92 SegmentLayers,
+pp_utils/p2p_communication.py:313), plus an actor-based static-mode runtime
+(fleet_executor Carrier/Interceptor, SURVEY.md §2.5).
+
+TPU-native replacement (SURVEY.md §7 "hardest parts" #2): the schedule is
+DATA, not control flow. The decoder stack's per-layer params are stacked
+with a leading layer dim, reshaped to (stages, layers_per_stage, ...) with
+the stage dim sharded over the mesh's 'pp' axis. One `lax.scan` over
+pipeline ticks runs `vmap(stage_fn)` — XLA partitions the stage dim so each
+pp device computes its own stage — and `jnp.roll` on the stage-sharded
+buffer hands activations to the next stage as an ICI collective-permute.
+Backward is just jax.grad through the scan: XLA schedules the reverse
+pipeline (the 1F1B memory trick is subsumed by per-stage remat).
+
+Bubble fraction is (S-1)/(M+S-1) like GPipe; interleaved/virtual stages
+(reference PipelineParallelWithInterleave) map to circular repeats of the
+same machinery and can cut it further.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.functional import functional_call, state_tensors
+from paddle_tpu.parallel.plan import ShardingPlan
+from paddle_tpu.parallel.trainer import Trainer, TrainStepConfig, _cast_tree
+
+STACK_PREFIX = "pipeline.layers::"
+
+
+def _layer_param_names(model):
+    """Group `model.model.layers.<i>.<local>` param names by local name."""
+    pat = re.compile(r"^(.*\.layers)\.(\d+)\.(.+)$")
+    groups: dict[str, dict[int, str]] = {}
+    base = None
+    for name in state_tensors(model):
+        m = pat.match(name)
+        if m:
+            base = m.group(1)
+            groups.setdefault(m.group(3), {})[int(m.group(2))] = name
+    return base, groups
+
+
+class PipelinePlan(ShardingPlan):
+    """Wraps a base plan: stacked layer params get 'pp' prepended on the
+    layer/stage dim; everything else falls through."""
+
+    def __init__(self, base: ShardingPlan):
+        self.base = base
+        self.rules = base.rules
+        self.default = base.default
+
+    def spec_for(self, name: str, ndim: int | None = None) -> P:
+        if name.startswith(STACK_PREFIX):
+            local = name[len(STACK_PREFIX):]
+            sub = self.base.spec_for(local)
+            return P("pp", *tuple(sub))
+        return self.base.spec_for(name)
+
+
+@dataclass
+class PipelineConfig(TrainStepConfig):
+    num_microbatches: int = 4
+
+
+class PipelineTrainer(Trainer):
+    """Trainer whose decoder stack runs under the compiled GPipe schedule.
+
+    Assumes the model has `model.model.layers` (a list of identical
+    decoder layers, e.g. LlamaForCausalLM), an embedding + final norm +
+    head reachable through the remaining params — which is exactly the
+    split PipelineLayer's SegmentLayers computes for the reference.
+    """
+
+    def __init__(self, model, optimizer, mesh, plan,
+                 config: PipelineConfig | None = None):
+        self._tpl_layer = model.model.layers[0]
+        base_names, groups = _layer_param_names(model)
+        self._layers_base = base_names
+        self._layer_groups = groups
+        self._num_layers = len(model.model.layers)
+        cfg = config or PipelineConfig()
+        super().__init__(model, optimizer, mesh=mesh,
+                         plan=PipelinePlan(plan), config=cfg)
+
+    # -- stacked state ----------------------------------------------------
+    def _init_state(self):
+        tensors = state_tensors(self.model)
+        stacked = {}
+        consumed = set()
+        for local, by_idx in self._layer_groups.items():
+            names = [by_idx[i] for i in range(self._num_layers)]
+            stacked[STACK_PREFIX + local] = jnp.stack(
+                [tensors[n]._value for n in names])
+            consumed.update(names)
+        self.params = {n: t._value for n, t in tensors.items()
+                       if n not in consumed}
+        self.params.update(stacked)
+        trainable = {n for n, t in tensors.items() if not t.stop_gradient}
+        self.param_names = [n for n in self.params
+                            if n.startswith(STACK_PREFIX)
+                            or n in trainable]
+        self.opt_state = self.optimizer.init_state_arrays(
+            {n: self.params[n] for n in self.param_names})
+        if self.mesh is not None and self.plan is not None:
+            self._shard_state()
+
+    def sync_to_model(self):
+        tensors = state_tensors(self.model)
+        for n, arr in self.params.items():
+            if n.startswith(STACK_PREFIX):
+                local = n[len(STACK_PREFIX):]
+                for i, name in sorted(
+                        self._layer_groups[local].items()):
+                    tensors[name]._value = arr[i]
+            else:
+                tensors[n]._value = arr
+        return self.model
+
+    # -- pipelined loss ----------------------------------------------------
+    def _layer_apply(self, layer_params: dict, h):
+        """One decoder layer, functional (template-layer swap)."""
+        out = functional_call(self._tpl_layer, layer_params,
+                              Tensor(h, stop_gradient=False))
+        return out._value if isinstance(out, Tensor) else out
+
+    def _loss_from_batch(self, params_c, batch):
+        cfg_m = self.model.config
+        mesh = self.mesh
+        n_pp = mesh.shape["pp"]
+        M = self.config.num_microbatches
+        L = self._num_layers
+        assert L % n_pp == 0, f"{L} layers not divisible by pp={n_pp}"
+        k = L // n_pp
+
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels")
+        B = input_ids.shape[0]
+        assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+
+        other = {n: v for n, v in params_c.items()
+                 if not n.startswith(STACK_PREFIX)}
+        stacked = {n[len(STACK_PREFIX):]: v
+                   for n, v in params_c.items()
+                   if n.startswith(STACK_PREFIX)}
+        # (L, ...) -> (S, k, ...), stage dim sharded over 'pp'
+        staged = {
+            n: jax.lax.with_sharding_constraint(
+                v.reshape((n_pp, k) + v.shape[1:]),
+                NamedSharding(mesh, P("pp")))
+            for n, v in stacked.items()}
+
+        # embedding (cheap; ordinary GSPMD)
+        emb = functional_call(
+            self.model.model.embed_tokens,
+            {"weight": other[
+                f"{self._embed_prefix()}.weight"]},
+            Tensor(input_ids, stop_gradient=True))._value
+        D = emb.shape[-1]
+        S_len = emb.shape[1]
+        mb = B // M
+        x_mb = emb.reshape(M, mb, S_len, D)
+
+        dp_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+        state_spec = P("pp", dp_axes if dp_axes else None)
+
+        def stage_fn(stage_params, h):
+            def body(hh, one_layer):
+                return self._layer_apply(one_layer, hh), None
+            out, _ = jax.lax.scan(body, h, stage_params)
+            return out
+
+        stage_fn = jax.checkpoint(stage_fn)
+
+        def tick(carry, t):
+            state, outputs = carry
+            inject = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+            state = state.at[0].set(
+                jnp.where(t < M, inject, state[0]))
+            state = jax.lax.with_sharding_constraint(
+                state, NamedSharding(mesh, state_spec))
+            y = jax.vmap(stage_fn)(staged_stacked, state)
+            y = jax.lax.with_sharding_constraint(
+                y, NamedSharding(mesh, state_spec))
+            out_t = y[-1]
+            oidx = jnp.clip(t - (n_pp - 1), 0, M - 1)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(t >= n_pp - 1,
+                          out_t,
+                          jax.lax.dynamic_index_in_dim(
+                              outputs, oidx, 0, keepdims=False)),
+                oidx, 0)
+            state = jnp.roll(y, 1, axis=0)
+            return (state, outputs), None
+
+        staged_stacked = staged
+        T = M + n_pp - 1
+        state0 = jnp.zeros((n_pp, mb, S_len, D), emb.dtype)
+        outputs0 = jnp.zeros((M, mb, S_len, D), emb.dtype)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (state0, outputs0), jnp.arange(T))
+
+        h = outputs.reshape(B, S_len, D)
+        # final norm + head + shifted CE via the model's own tail
+        norm_w = other[f"{self._norm_prefix()}.weight"]
+        h = functional_call(self.model.model.norm, {"weight": norm_w},
+                            Tensor(h, stop_gradient=False))._value
+        logits = self._head_logits(other, h)
+        if labels is None:
+            return jnp.zeros((), jnp.float32)
+        shift_logits = logits[:, :-1, :].astype(jnp.float32)
+        shift_labels = labels[:, 1:]
+        logz = jax.nn.logsumexp(shift_logits, axis=-1)
+        tgt = jnp.take_along_axis(
+            shift_logits, shift_labels[..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+        return jnp.mean(logz - tgt).astype(jnp.float32)
+
+    def _embed_prefix(self):
+        for n in self.params:
+            if n.endswith("embed_tokens.weight"):
+                return n[: -len(".weight")]
+        raise KeyError("embed_tokens.weight not found")
+
+    def _norm_prefix(self):
+        cands = [n for n in self.params
+                 if n.endswith(".norm.weight")
+                 and not n.startswith(STACK_PREFIX)]
+        return cands[0][: -len(".weight")]
+
+    def _head_logits(self, other, h):
+        name = next((n for n in other if n.endswith("lm_head.weight")),
+                    None)
+        if name is not None:
+            return jnp.einsum("bsd,dv->bsv", h, other[name])
+        w = other[f"{self._embed_prefix()}.weight"]
+        return jnp.einsum("bsd,vd->bsv", h, w)

@@ -1,0 +1,89 @@
+"""Context-parallel attention tests (capability ADDED beyond the
+reference — SURVEY.md §5 long-context: the reference has no ring/Ulysses
+attention; these validate ours against full attention)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+from paddle_tpu.distributed.mesh import init_mesh
+from paddle_tpu.distributed.context_parallel import (
+    ring_attention, ulysses_attention)
+from paddle_tpu.nn.functional.attention import _sdpa_ref
+
+
+def _qkv(b=2, s=64, hq=4, hk=2, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randn(b, s, hq, d), jnp.float32),
+            jnp.asarray(rng.randn(b, s, hk, d), jnp.float32),
+            jnp.asarray(rng.randn(b, s, hk, d), jnp.float32))
+
+
+@pytest.fixture
+def mesh():
+    return init_mesh({"dp": 2, "sp": 4})
+
+
+def test_ring_matches_full(mesh):
+    q, k, v = _qkv()
+    ref = _sdpa_ref(q, k, v, is_causal=True)
+    out = ring_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_non_causal(mesh):
+    q, k, v = _qkv()
+    ref = _sdpa_ref(q, k, v, is_causal=False)
+    out = ring_attention(q, k, v, mesh=mesh, causal=False)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_matches_full(mesh):
+    q, k, v = _qkv()
+    ref = _sdpa_ref(q, k, v, is_causal=True)
+    out = ulysses_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_gradients(mesh):
+    q, k, v = _qkv()
+
+    def l_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh=mesh, causal=True) ** 2)
+
+    def l_ref(q, k, v):
+        return jnp.sum(_sdpa_ref(q, k, v, is_causal=True) ** 2)
+
+    g1 = jax.grad(l_ring, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(l_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_trainer_with_ring_cp_matches_eager():
+    from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+    from paddle_tpu.parallel import (Trainer, TrainStepConfig,
+                                     llama_sharding_plan)
+    import paddle_tpu.optimizer as opt
+
+    paddle_tpu.seed(7)
+    cfg = tiny_llama_config()
+    m = LlamaForCausalLM(cfg)
+    ids = np.random.RandomState(3).randint(
+        0, cfg.vocab_size, (4, 32)).astype(np.int32)
+    t = paddle_tpu.to_tensor(ids)
+    ref, _ = m(t, labels=t)
+
+    mesh = init_mesh({"dp": 2, "sp": 4})
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    tr = Trainer(m, o, mesh=mesh,
+                 plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
+                 config=TrainStepConfig(compute_dtype=None,
+                                        context_parallel="ring"))
+    loss = tr.step({"input_ids": ids, "labels": ids})
+    np.testing.assert_allclose(float(ref.numpy()), loss, rtol=1e-5)

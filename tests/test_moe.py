@@ -1,0 +1,80 @@
+"""MoE tests (reference: test/collective/test_moe_api.py + the MoELayer
+gates under python/paddle/incubate/distributed/models/moe/)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu
+from paddle_tpu.nn.functional import moe as FM
+
+
+def test_top2_gating_capacity_and_combine():
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(64, 4), jnp.float32)
+    combine, dispatch, aux = FM.top2_gating(logits, capacity_factor=2.0)
+    t, e = logits.shape
+    assert combine.shape[0] == t and combine.shape[1] == e
+    # each token contributes weight <= 1 (normalised top-2 gates)
+    per_tok = np.asarray(jnp.sum(combine, axis=(1, 2)))
+    assert (per_tok <= 1.0 + 1e-5).all()
+    # dispatched tokens have positive combine weight
+    assert bool(jnp.all((combine > 0) == dispatch))
+    # capacity respected: at most C tokens per expert slot
+    slot_occupancy = np.asarray(jnp.sum(dispatch.astype(jnp.int32), axis=0))
+    assert (slot_occupancy <= 1).all()
+    assert float(aux) > 0
+
+
+def test_switch_gating_top1():
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(64, 4), jnp.float32)
+    combine, dispatch, aux = FM.switch_gating(logits, capacity_factor=2.0)
+    # top-1: each token goes to at most one expert
+    per_tok_slots = np.asarray(
+        jnp.sum(dispatch.astype(jnp.int32), axis=(1, 2)))
+    assert (per_tok_slots <= 1).all()
+
+
+def test_moe_dispatch_roundtrip():
+    """With capacity ample and k=1, combine(dispatch(x)) recovers gated x."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(32, 8), jnp.float32)
+    logits = jnp.asarray(rng.randn(32, 4), jnp.float32)
+    combine, dispatch, _ = FM.switch_gating(logits, capacity_factor=8.0)
+    expert_in = FM.moe_dispatch(x, dispatch)
+    back = FM.moe_combine(expert_in, combine)
+    gate_weight = np.asarray(jnp.sum(combine, axis=(1, 2)))[:, None]
+    np.testing.assert_allclose(np.asarray(back),
+                               np.asarray(x) * gate_weight, rtol=1e-5)
+
+
+def test_qwen2_moe_model_trains_sharded():
+    from paddle_tpu.models.qwen2_moe import (Qwen2MoeForCausalLM,
+                                             tiny_qwen2_moe_config)
+    from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.parallel import (Trainer, TrainStepConfig,
+                                     llama_sharding_plan)
+    import paddle_tpu.optimizer as opt
+
+    paddle_tpu.seed(0)
+    cfg = tiny_qwen2_moe_config()
+    m = Qwen2MoeForCausalLM(cfg)
+    ids = np.random.RandomState(1).randint(
+        0, cfg.vocab_size, (4, 32)).astype(np.int32)
+
+    t = paddle_tpu.to_tensor(ids)
+    eager_loss, _ = m(t, labels=t)
+
+    mesh = init_mesh({"dp": 2, "ep": 2, "mp": 2})
+    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    tr = Trainer(m, o, mesh=mesh,
+                 plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
+                 config=TrainStepConfig(compute_dtype=None))
+    losses = [tr.step({"input_ids": ids, "labels": ids}) for _ in range(3)]
+    np.testing.assert_allclose(losses[0], float(eager_loss.numpy()),
+                               rtol=1e-4)
+    assert losses[-1] < losses[0]
+    spec = tr.params[
+        "model.layers.0.mlp.moe.experts_gate_weight"].sharding.spec
+    assert spec[0] == "ep"
