@@ -1,0 +1,152 @@
+"""Native (C++) core loader.
+
+The reference keeps its systems layer in C++ (TCPStore rendezvous —
+paddle/phi/core/distributed/store/tcp_store.h:121; host profiler recorder —
+paddle/fluid/platform/profiler/host_tracer.h:26; collective watchdog —
+paddle/phi/core/distributed/comm_task_manager.h:37). paddle_tpu does the
+same: `src/native.cc` is compiled once into a shared library and bound via
+ctypes (pybind11 is not in this image). The build is cached next to the
+source keyed on a content hash; if no C++ toolchain is available the
+`available()` probe returns False and pure-Python fallbacks take over
+(paddle_tpu.distributed.store / paddle_tpu.profiler handle that).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "native.cc")
+_BUILD_DIR = os.path.join(_DIR, "_build")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _lib_path() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_BUILD_DIR, f"libpaddle_tpu_native_{digest}.so")
+
+
+def _build(path: str) -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = path + f".tmp{os.getpid()}"
+    cmd = [
+        "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+        "-fvisibility=hidden", _SRC, "-o", tmp,
+    ]
+    try:
+        res = subprocess.run(cmd, capture_output=True, timeout=240)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if res.returncode != 0:
+        sys.stderr.write(
+            "paddle_tpu: native build failed, using Python fallbacks:\n"
+            + res.stderr.decode(errors="replace")[-2000:] + "\n")
+        return False
+    os.replace(tmp, path)  # atomic: concurrent builders race benignly
+    return True
+
+
+def load():
+    """Return the ctypes CDLL for the native core, or None."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PADDLE_TPU_DISABLE_NATIVE"):
+            return None
+        path = _lib_path()
+        if not os.path.exists(path) and not _build(path):
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        _declare(lib)
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _declare(lib):
+    c = ctypes
+    u8p = c.POINTER(c.c_uint8)
+
+    lib.pt_store_server_start.restype = c.c_void_p
+    lib.pt_store_server_start.argtypes = [c.c_int]
+    lib.pt_store_server_port.restype = c.c_int
+    lib.pt_store_server_port.argtypes = [c.c_void_p]
+    lib.pt_store_server_stop.restype = None
+    lib.pt_store_server_stop.argtypes = [c.c_void_p]
+
+    lib.pt_store_client_new.restype = c.c_void_p
+    lib.pt_store_client_new.argtypes = [c.c_char_p, c.c_int, c.c_int64]
+    lib.pt_store_client_free.restype = None
+    lib.pt_store_client_free.argtypes = [c.c_void_p]
+    lib.pt_store_set.restype = c.c_int
+    lib.pt_store_set.argtypes = [c.c_void_p, c.c_char_p, u8p, c.c_int64]
+    lib.pt_store_get.restype = c.c_int
+    lib.pt_store_get.argtypes = [c.c_void_p, c.c_char_p, c.c_int64,
+                                 c.POINTER(u8p), c.POINTER(c.c_int64)]
+    lib.pt_store_add.restype = c.c_int
+    lib.pt_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64,
+                                 c.POINTER(c.c_int64)]
+    lib.pt_store_wait.restype = c.c_int
+    lib.pt_store_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    lib.pt_store_check.restype = c.c_int
+    lib.pt_store_check.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_store_delete.restype = c.c_int
+    lib.pt_store_delete.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_store_compare_set.restype = c.c_int
+    lib.pt_store_compare_set.argtypes = [
+        c.c_void_p, c.c_char_p, u8p, c.c_int64, u8p, c.c_int64,
+        c.POINTER(u8p), c.POINTER(c.c_int64)]
+    lib.pt_free.restype = None
+    lib.pt_free.argtypes = [c.c_void_p]
+
+    lib.pt_tracer_enable.restype = None
+    lib.pt_tracer_enable.argtypes = [c.c_int]
+    lib.pt_tracer_enabled.restype = c.c_int
+    lib.pt_tracer_push.restype = None
+    lib.pt_tracer_push.argtypes = [c.c_char_p]
+    lib.pt_tracer_pop.restype = None
+    lib.pt_tracer_instant.restype = None
+    lib.pt_tracer_instant.argtypes = [c.c_char_p]
+    lib.pt_tracer_counter.restype = None
+    lib.pt_tracer_counter.argtypes = [c.c_char_p, c.c_double]
+    lib.pt_tracer_clear.restype = None
+    lib.pt_tracer_event_count.restype = c.c_int64
+    lib.pt_tracer_export_chrome.restype = c.c_int
+    lib.pt_tracer_export_chrome.argtypes = [c.POINTER(u8p),
+                                            c.POINTER(c.c_int64)]
+
+    lib.pt_watchdog_start.restype = None
+    lib.pt_watchdog_start.argtypes = [c.c_int64]
+    lib.pt_watchdog_stop.restype = None
+    lib.pt_watchdog_register.restype = c.c_uint64
+    lib.pt_watchdog_register.argtypes = [c.c_char_p, c.c_int64]
+    lib.pt_watchdog_complete.restype = None
+    lib.pt_watchdog_complete.argtypes = [c.c_uint64]
+    lib.pt_watchdog_expired_count.restype = c.c_int64
+
+
+def _take_bytes(lib, out_p, out_len):
+    """Copy a (ptr,len) result into bytes and free the native buffer."""
+    try:
+        if not out_p or out_len.value < 0:
+            return b""
+        return ctypes.string_at(out_p, out_len.value)
+    finally:
+        if out_p:
+            lib.pt_free(out_p)

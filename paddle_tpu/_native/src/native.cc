@@ -1,0 +1,891 @@
+// paddle_tpu native core: the systems-side components that the reference
+// implements in C++ and that stay native in the TPU rebuild.
+//
+//  1. TCPStore  — master-based key-value rendezvous for multi-host bootstrap
+//     (reference: paddle/phi/core/distributed/store/tcp_store.h:121,
+//      store/store.h:24, socket.h). Used by paddle_tpu.distributed to
+//     coordinate process groups / barriers the way the reference bootstraps
+//     NCCL communicators; on TPU it complements jax.distributed's
+//     coordination service with a user-level store (set/get/add/wait/barrier).
+//
+//  2. HostTracer — lock-minimal host event recorder behind RecordEvent
+//     (reference: paddle/fluid/platform/profiler/host_tracer.h:26 and the
+//      HostEventRecorder ring buffers). Thread-local buffers, steady-clock
+//     nanoseconds, chrome-trace JSON export.
+//
+//  3. CommWatchdog — async collective timeout watchdog (reference:
+//     paddle/phi/core/distributed/comm_task_manager.h:37,
+//      nccl_comm_task.cc:129-186). Background thread polls registered
+//     operations for deadline expiry and surfaces diagnostics instead of
+//     hanging silently.
+//
+// Exposed via a plain C ABI (bound from Python with ctypes — no pybind11 in
+// this image). All functions return 0 on success, negative errno-style codes
+// on failure unless documented otherwise.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#define PT_EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+// wire helpers: every message field is length-prefixed; all ints little-endian
+// ---------------------------------------------------------------------------
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_u32(int fd, uint32_t v) { return send_all(fd, &v, 4); }
+bool recv_u32(int fd, uint32_t* v) { return recv_all(fd, v, 4); }
+bool send_i64(int fd, int64_t v) { return send_all(fd, &v, 8); }
+bool recv_i64(int fd, int64_t* v) { return recv_all(fd, v, 8); }
+
+bool send_bytes(int fd, const std::string& s) {
+  return send_u32(fd, static_cast<uint32_t>(s.size())) &&
+         (s.empty() || send_all(fd, s.data(), s.size()));
+}
+
+bool recv_bytes(int fd, std::string* out, uint32_t max = 1u << 30) {
+  uint32_t n;
+  if (!recv_u32(fd, &n) || n > max) return false;
+  out->resize(n);
+  return n == 0 || recv_all(fd, &(*out)[0], n);
+}
+
+enum Cmd : uint8_t {
+  kSet = 0,
+  kGet = 1,      // blocking until key exists (server parks the connection)
+  kAdd = 2,
+  kWait = 3,     // blocking until key exists
+  kCheck = 4,    // non-blocking existence probe
+  kDelete = 5,
+  kCompareSet = 6,
+  kList = 7,
+};
+
+enum Status : uint8_t { kOk = 0, kTimeout = 1, kNotFound = 2, kError = 3 };
+
+// ---------------------------------------------------------------------------
+// TCPStore server
+// ---------------------------------------------------------------------------
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) throw std::runtime_error("socket() failed");
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+        0) {
+      ::close(listen_fd_);
+      throw std::runtime_error("bind() failed");
+    }
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+    ::listen(listen_fd_, 128);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~StoreServer() { Stop(); }
+
+  int port() const { return port_; }
+
+  void Stop() {
+    bool expected = false;
+    if (!stopping_.compare_exchange_strong(expected, true)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    {
+      // synchronize with WaitFor's predicate check so the notify can't be
+      // lost between a waiter's pred evaluation and its block
+      std::lock_guard<std::mutex> g(mu_);
+    }
+    cv_.notify_all();
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::vector<std::thread> workers;
+    {
+      std::lock_guard<std::mutex> g(threads_mu_);
+      // unblock connection threads parked in recv()
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+      workers.swap(conn_threads_);
+    }
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+  }
+
+ private:
+  void AcceptLoop() {
+    while (!stopping_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (stopping_.load()) break;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(threads_mu_);
+      conn_fds_.push_back(fd);
+      conn_threads_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (!stopping_.load()) {
+      uint8_t cmd;
+      if (!recv_all(fd, &cmd, 1)) break;
+      bool ok = true;
+      switch (cmd) {
+        case kSet: {
+          std::string key, val;
+          ok = recv_bytes(fd, &key) && recv_bytes(fd, &val);
+          if (!ok) break;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            data_[key] = std::move(val);
+          }
+          cv_.notify_all();
+          uint8_t st = kOk;
+          ok = send_all(fd, &st, 1);
+          break;
+        }
+        case kGet:
+        case kWait: {
+          std::string key;
+          int64_t timeout_ms;
+          ok = recv_bytes(fd, &key) && recv_i64(fd, &timeout_ms);
+          if (!ok) break;
+          std::string val;
+          uint8_t st = WaitFor(key, timeout_ms, &val);
+          ok = send_all(fd, &st, 1);
+          if (ok && cmd == kGet && st == kOk) ok = send_bytes(fd, val);
+          break;
+        }
+        case kAdd: {
+          std::string key;
+          int64_t delta;
+          ok = recv_bytes(fd, &key) && recv_i64(fd, &delta);
+          if (!ok) break;
+          int64_t result;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            int64_t cur = 0;
+            auto it = data_.find(key);
+            if (it != data_.end() && !it->second.empty())
+              cur = std::strtoll(it->second.c_str(), nullptr, 10);
+            result = cur + delta;
+            data_[key] = std::to_string(result);
+          }
+          cv_.notify_all();
+          uint8_t st = kOk;
+          ok = send_all(fd, &st, 1) && send_i64(fd, result);
+          break;
+        }
+        case kCheck: {
+          std::string key;
+          ok = recv_bytes(fd, &key);
+          if (!ok) break;
+          uint8_t st;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            st = data_.count(key) ? kOk : kNotFound;
+          }
+          ok = send_all(fd, &st, 1);
+          break;
+        }
+        case kDelete: {
+          std::string key;
+          ok = recv_bytes(fd, &key);
+          if (!ok) break;
+          uint8_t st;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            st = data_.erase(key) ? kOk : kNotFound;
+          }
+          ok = send_all(fd, &st, 1);
+          break;
+        }
+        case kCompareSet: {
+          std::string key, expect, desired;
+          ok = recv_bytes(fd, &key) && recv_bytes(fd, &expect) &&
+               recv_bytes(fd, &desired);
+          if (!ok) break;
+          std::string current;
+          uint8_t st;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            auto it = data_.find(key);
+            if (it == data_.end()) {
+              if (expect.empty()) {
+                data_[key] = desired;
+                current = desired;
+                st = kOk;
+              } else {
+                st = kNotFound;
+              }
+            } else if (it->second == expect) {
+              it->second = desired;
+              current = desired;
+              st = kOk;
+            } else {
+              current = it->second;
+              st = kError;
+            }
+          }
+          cv_.notify_all();
+          ok = send_all(fd, &st, 1) && send_bytes(fd, current);
+          break;
+        }
+        case kList: {
+          std::string joined;
+          {
+            std::lock_guard<std::mutex> g(mu_);
+            for (auto& kv : data_) {
+              joined += kv.first;
+              joined.push_back('\n');
+            }
+          }
+          uint8_t st = kOk;
+          ok = send_all(fd, &st, 1) && send_bytes(fd, joined);
+          break;
+        }
+        default:
+          ok = false;
+      }
+      if (!ok) break;
+    }
+    {
+      // deregister before close so Stop() never shuts down a reused fd
+      std::lock_guard<std::mutex> g(threads_mu_);
+      conn_fds_.erase(std::remove(conn_fds_.begin(), conn_fds_.end(), fd),
+                      conn_fds_.end());
+    }
+    ::close(fd);
+  }
+
+  uint8_t WaitFor(const std::string& key, int64_t timeout_ms,
+                  std::string* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [&] { return stopping_.load() || data_.count(key) > 0; };
+    if (timeout_ms < 0) {
+      cv_.wait(lk, pred);
+    } else if (!cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                             pred)) {
+      return kTimeout;
+    }
+    if (stopping_.load() && !data_.count(key)) return kError;
+    *out = data_[key];
+    return kOk;
+  }
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::mutex threads_mu_;
+  std::vector<std::thread> conn_threads_;
+  std::vector<int> conn_fds_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::unordered_map<std::string, std::string> data_;
+};
+
+// ---------------------------------------------------------------------------
+// TCPStore client
+// ---------------------------------------------------------------------------
+
+class StoreClient {
+ public:
+  StoreClient(const std::string& host, int port, int64_t timeout_ms) {
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                      &res) != 0 ||
+        res == nullptr)
+      throw std::runtime_error("getaddrinfo failed for " + host);
+    int64_t deadline = now_ns() + timeout_ms * 1000000;
+    int fd = -1;
+    // retry-connect until the server side comes up (rendezvous semantics)
+    while (true) {
+      fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) break;
+      if (fd >= 0) ::close(fd);
+      fd = -1;
+      if (now_ns() > deadline) break;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    ::freeaddrinfo(res);
+    if (fd < 0) throw std::runtime_error("connect to store timed out");
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    fd_ = fd;
+  }
+
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  int Set(const std::string& key, const std::string& val) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t cmd = kSet, st;
+    if (!send_all(fd_, &cmd, 1) || !send_bytes(fd_, key) ||
+        !send_bytes(fd_, val) || !recv_all(fd_, &st, 1))
+      return -100;  // comm error
+    return st == kOk ? 0 : -static_cast<int>(st);
+  }
+
+  int Get(const std::string& key, int64_t timeout_ms, std::string* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t cmd = kGet, st;
+    if (!send_all(fd_, &cmd, 1) || !send_bytes(fd_, key) ||
+        !send_i64(fd_, timeout_ms) || !recv_all(fd_, &st, 1))
+      return -100;  // comm error
+    if (st != kOk) return -static_cast<int>(st);
+    return recv_bytes(fd_, out) ? 0 : -1;
+  }
+
+  int Add(const std::string& key, int64_t delta, int64_t* out) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t cmd = kAdd, st;
+    if (!send_all(fd_, &cmd, 1) || !send_bytes(fd_, key) ||
+        !send_i64(fd_, delta) || !recv_all(fd_, &st, 1) ||
+        !recv_i64(fd_, out))
+      return -100;  // comm error
+    return 0;
+  }
+
+  int Wait(const std::string& key, int64_t timeout_ms) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t cmd = kWait, st;
+    if (!send_all(fd_, &cmd, 1) || !send_bytes(fd_, key) ||
+        !send_i64(fd_, timeout_ms) || !recv_all(fd_, &st, 1))
+      return -100;  // comm error
+    return st == kOk ? 0 : -static_cast<int>(st);
+  }
+
+  int Check(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t cmd = kCheck, st;
+    if (!send_all(fd_, &cmd, 1) || !send_bytes(fd_, key) ||
+        !recv_all(fd_, &st, 1))
+      return -100;  // comm error
+    return st == kOk ? 1 : 0;
+  }
+
+  int Delete(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t cmd = kDelete, st;
+    if (!send_all(fd_, &cmd, 1) || !send_bytes(fd_, key) ||
+        !recv_all(fd_, &st, 1))
+      return -100;  // comm error
+    return st == kOk ? 1 : 0;
+  }
+
+  int CompareSet(const std::string& key, const std::string& expect,
+                 const std::string& desired, std::string* current) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t cmd = kCompareSet, st;
+    if (!send_all(fd_, &cmd, 1) || !send_bytes(fd_, key) ||
+        !send_bytes(fd_, expect) || !send_bytes(fd_, desired) ||
+        !recv_all(fd_, &st, 1) || !recv_bytes(fd_, current))
+      return -100;  // comm error
+    return st == kOk ? 0 : -static_cast<int>(st);
+  }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;  // one request in flight per client
+};
+
+// ---------------------------------------------------------------------------
+// HostTracer: thread-local event buffers + chrome trace export
+// ---------------------------------------------------------------------------
+
+struct TraceEvent {
+  std::string name;
+  int64_t t_begin_ns;
+  int64_t t_end_ns;  // -1 => counter event, value in t_begin? no: see kind
+  uint64_t tid;
+  int kind;  // 0 = duration, 1 = instant, 2 = counter
+  double value;
+};
+
+class HostTracer {
+ public:
+  static HostTracer& Get() {
+    static HostTracer t;
+    return t;
+  }
+
+  void set_enabled(bool e) { enabled_.store(e); }
+  bool enabled() const { return enabled_.load(std::memory_order_relaxed); }
+
+  void Push(const char* name) {
+    if (!enabled()) return;
+    auto& tl = Local();
+    tl.stack.emplace_back(name, now_ns());
+  }
+
+  void Pop() {
+    if (!enabled()) return;
+    auto& tl = Local();
+    if (tl.stack.empty()) return;
+    auto [name, begin] = std::move(tl.stack.back());
+    tl.stack.pop_back();
+    {
+      std::lock_guard<std::mutex> g(tl.mu);
+      tl.events.push_back(
+          TraceEvent{std::move(name), begin, now_ns(), tl.tid, 0, 0.0});
+    }
+    MaybeFlush(tl);
+  }
+
+  void Instant(const char* name) {
+    if (!enabled()) return;
+    auto& tl = Local();
+    int64_t t = now_ns();
+    {
+      std::lock_guard<std::mutex> g(tl.mu);
+      tl.events.push_back(TraceEvent{name, t, t, tl.tid, 1, 0.0});
+    }
+    MaybeFlush(tl);
+  }
+
+  void Counter(const char* name, double value) {
+    if (!enabled()) return;
+    auto& tl = Local();
+    int64_t t = now_ns();
+    {
+      std::lock_guard<std::mutex> g(tl.mu);
+      tl.events.push_back(TraceEvent{name, t, t, tl.tid, 2, value});
+    }
+    MaybeFlush(tl);
+  }
+
+  void Clear() {
+    std::lock_guard<std::mutex> g(mu_);
+    global_.clear();
+  }
+
+  // chrome trace JSON (the "traceEvents" array content)
+  std::string ExportChrome() {
+    FlushAllRegistered();
+    std::lock_guard<std::mutex> g(mu_);
+    std::string out = "[";
+    bool first = true;
+    char buf[256];
+    for (auto& e : global_) {
+      if (!first) out += ",";
+      first = false;
+      const char* ph = e.kind == 0 ? "X" : (e.kind == 1 ? "i" : "C");
+      out += "{\"name\":\"";
+      AppendEscaped(&out, e.name);
+      out += "\",\"ph\":\"";
+      out += ph;
+      out += "\",\"pid\":0,";
+      snprintf(buf, sizeof(buf), "\"tid\":%llu,\"ts\":%.3f",
+               static_cast<unsigned long long>(e.tid),
+               static_cast<double>(e.t_begin_ns) / 1000.0);
+      out += buf;
+      if (e.kind == 0) {
+        snprintf(buf, sizeof(buf), ",\"dur\":%.3f",
+                 static_cast<double>(e.t_end_ns - e.t_begin_ns) / 1000.0);
+        out += buf;
+      } else if (e.kind == 2) {
+        snprintf(buf, sizeof(buf), ",\"args\":{\"value\":%g}", e.value);
+        out += buf;
+      }
+      out += "}";
+    }
+    out += "]";
+    return out;
+  }
+
+  int64_t EventCount() {
+    FlushAllRegistered();
+    std::lock_guard<std::mutex> g(mu_);
+    return static_cast<int64_t>(global_.size());
+  }
+
+ private:
+  struct ThreadLocalBuf {
+    std::mutex mu;  // guards events against cross-thread flush
+    std::vector<std::pair<std::string, int64_t>> stack;
+    std::vector<TraceEvent> events;
+    uint64_t tid;
+    HostTracer* owner = nullptr;
+    ~ThreadLocalBuf() {
+      if (owner) {
+        owner->FlushThread(this);
+        owner->Deregister(this);
+      }
+    }
+  };
+
+  ThreadLocalBuf& Local() {
+    thread_local ThreadLocalBuf tl;
+    if (!tl.owner) {
+      tl.owner = this;
+      static std::atomic<uint64_t> next_tid{1};
+      tl.tid = next_tid.fetch_add(1);
+      std::lock_guard<std::mutex> g(reg_mu_);
+      registered_.push_back(&tl);
+    }
+    return tl;
+  }
+
+  void Deregister(ThreadLocalBuf* tl) {
+    std::lock_guard<std::mutex> g(reg_mu_);
+    registered_.erase(
+        std::remove(registered_.begin(), registered_.end(), tl),
+        registered_.end());
+  }
+
+  void MaybeFlush(ThreadLocalBuf& tl) {
+    bool full;
+    {
+      std::lock_guard<std::mutex> g(tl.mu);
+      full = tl.events.size() >= 4096;
+    }
+    if (full) FlushThread(&tl);
+  }
+
+  void FlushThread(ThreadLocalBuf* tl) {
+    std::vector<TraceEvent> batch;
+    {
+      std::lock_guard<std::mutex> g(tl->mu);
+      batch.swap(tl->events);
+    }
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& e : batch) global_.push_back(std::move(e));
+  }
+
+  void FlushAllRegistered() {
+    std::lock_guard<std::mutex> g(reg_mu_);
+    for (auto* tl : registered_) FlushThread(tl);
+  }
+
+  static void AppendEscaped(std::string* out, const std::string& s) {
+    for (char c : s) {
+      if (c == '"' || c == '\\') {
+        out->push_back('\\');
+        out->push_back(c);
+      } else if (static_cast<unsigned char>(c) >= 0x20) {
+        out->push_back(c);
+      }
+    }
+  }
+
+  std::atomic<bool> enabled_{false};
+  std::mutex mu_;
+  std::deque<TraceEvent> global_;
+  std::mutex reg_mu_;
+  std::vector<ThreadLocalBuf*> registered_;
+};
+
+// ---------------------------------------------------------------------------
+// CommWatchdog: deadline registry + poller thread
+// ---------------------------------------------------------------------------
+
+class CommWatchdog {
+ public:
+  static CommWatchdog& Get() {
+    static CommWatchdog w;
+    return w;
+  }
+
+  void Start(int64_t poll_ms) {
+    std::lock_guard<std::mutex> g(mu_);
+    poll_ms_ = poll_ms;
+    if (running_) return;
+    running_ = true;
+    thread_ = std::thread([this] { Loop(); });
+  }
+
+  void Stop() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      if (!running_) return;
+      running_ = false;
+    }
+    cv_.notify_all();
+    if (thread_.joinable()) thread_.join();
+  }
+
+  uint64_t Register(const char* desc, int64_t timeout_ms) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint64_t id = next_id_++;
+    ops_[id] = Op{desc ? desc : "", now_ns() + timeout_ms * 1000000, false};
+    return id;
+  }
+
+  void Complete(uint64_t id) {
+    std::lock_guard<std::mutex> g(mu_);
+    ops_.erase(id);
+  }
+
+  int64_t ExpiredCount() {
+    std::lock_guard<std::mutex> g(mu_);
+    return expired_count_;
+  }
+
+  std::string LastExpired() {
+    std::lock_guard<std::mutex> g(mu_);
+    return last_expired_;
+  }
+
+ private:
+  struct Op {
+    std::string desc;
+    int64_t deadline_ns;
+    bool reported;
+  };
+
+  void Loop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (running_) {
+      cv_.wait_for(lk, std::chrono::milliseconds(poll_ms_),
+                   [this] { return !running_; });
+      if (!running_) break;
+      int64_t now = now_ns();
+      for (auto& kv : ops_) {
+        if (!kv.second.reported && now > kv.second.deadline_ns) {
+          kv.second.reported = true;
+          expired_count_++;
+          last_expired_ = kv.second.desc;
+          fprintf(stderr,
+                  "[paddle_tpu watchdog] collective op '%s' exceeded its "
+                  "timeout; the job may be hung (rank desync or network "
+                  "failure).\n",
+                  kv.second.desc.c_str());
+        }
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::thread thread_;
+  bool running_ = false;
+  int64_t poll_ms_ = 1000;
+  uint64_t next_id_ = 1;
+  std::unordered_map<uint64_t, Op> ops_;
+  int64_t expired_count_ = 0;
+  std::string last_expired_;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+PT_EXPORT void* pt_store_server_start(int port) {
+  try {
+    return new StoreServer(port);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+PT_EXPORT int pt_store_server_port(void* s) {
+  return s ? static_cast<StoreServer*>(s)->port() : -1;
+}
+
+PT_EXPORT void pt_store_server_stop(void* s) {
+  if (!s) return;
+  auto* srv = static_cast<StoreServer*>(s);
+  srv->Stop();
+  delete srv;
+}
+
+PT_EXPORT void* pt_store_client_new(const char* host, int port,
+                                    int64_t timeout_ms) {
+  try {
+    return new StoreClient(host ? host : "127.0.0.1", port, timeout_ms);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+PT_EXPORT void pt_store_client_free(void* c) {
+  delete static_cast<StoreClient*>(c);
+}
+
+PT_EXPORT int pt_store_set(void* c, const char* key, const uint8_t* data,
+                           int64_t len) {
+  if (!c) return -1;
+  return static_cast<StoreClient*>(c)->Set(
+      key, std::string(reinterpret_cast<const char*>(data),
+                       static_cast<size_t>(len)));
+}
+
+// caller frees *out with pt_free
+PT_EXPORT int pt_store_get(void* c, const char* key, int64_t timeout_ms,
+                           uint8_t** out, int64_t* out_len) {
+  if (!c) return -1;
+  std::string val;
+  int rc = static_cast<StoreClient*>(c)->Get(key, timeout_ms, &val);
+  if (rc != 0) return rc;
+  *out = static_cast<uint8_t*>(malloc(val.size() ? val.size() : 1));
+  memcpy(*out, val.data(), val.size());
+  *out_len = static_cast<int64_t>(val.size());
+  return 0;
+}
+
+PT_EXPORT int pt_store_add(void* c, const char* key, int64_t delta,
+                           int64_t* out) {
+  if (!c) return -1;
+  return static_cast<StoreClient*>(c)->Add(key, delta, out);
+}
+
+PT_EXPORT int pt_store_wait(void* c, const char* key, int64_t timeout_ms) {
+  if (!c) return -1;
+  return static_cast<StoreClient*>(c)->Wait(key, timeout_ms);
+}
+
+PT_EXPORT int pt_store_check(void* c, const char* key) {
+  if (!c) return -1;
+  return static_cast<StoreClient*>(c)->Check(key);
+}
+
+PT_EXPORT int pt_store_delete(void* c, const char* key) {
+  if (!c) return -1;
+  return static_cast<StoreClient*>(c)->Delete(key);
+}
+
+PT_EXPORT int pt_store_compare_set(void* c, const char* key,
+                                   const uint8_t* expect, int64_t expect_len,
+                                   const uint8_t* desired, int64_t desired_len,
+                                   uint8_t** out, int64_t* out_len) {
+  if (!c) return -1;
+  std::string current;
+  int rc = static_cast<StoreClient*>(c)->CompareSet(
+      key,
+      std::string(reinterpret_cast<const char*>(expect),
+                  static_cast<size_t>(expect_len)),
+      std::string(reinterpret_cast<const char*>(desired),
+                  static_cast<size_t>(desired_len)),
+      &current);
+  *out = static_cast<uint8_t*>(malloc(current.size() ? current.size() : 1));
+  memcpy(*out, current.data(), current.size());
+  *out_len = static_cast<int64_t>(current.size());
+  return rc;
+}
+
+PT_EXPORT void pt_free(void* p) { free(p); }
+
+PT_EXPORT void pt_tracer_enable(int enabled) {
+  HostTracer::Get().set_enabled(enabled != 0);
+}
+
+PT_EXPORT int pt_tracer_enabled() { return HostTracer::Get().enabled(); }
+
+PT_EXPORT void pt_tracer_push(const char* name) {
+  HostTracer::Get().Push(name);
+}
+
+PT_EXPORT void pt_tracer_pop() { HostTracer::Get().Pop(); }
+
+PT_EXPORT void pt_tracer_instant(const char* name) {
+  HostTracer::Get().Instant(name);
+}
+
+PT_EXPORT void pt_tracer_counter(const char* name, double value) {
+  HostTracer::Get().Counter(name, value);
+}
+
+PT_EXPORT void pt_tracer_clear() { HostTracer::Get().Clear(); }
+
+PT_EXPORT int64_t pt_tracer_event_count() {
+  return HostTracer::Get().EventCount();
+}
+
+// caller frees with pt_free
+PT_EXPORT int pt_tracer_export_chrome(uint8_t** out, int64_t* out_len) {
+  std::string s = HostTracer::Get().ExportChrome();
+  *out = static_cast<uint8_t*>(malloc(s.size() ? s.size() : 1));
+  memcpy(*out, s.data(), s.size());
+  *out_len = static_cast<int64_t>(s.size());
+  return 0;
+}
+
+PT_EXPORT void pt_watchdog_start(int64_t poll_ms) {
+  CommWatchdog::Get().Start(poll_ms);
+}
+
+PT_EXPORT void pt_watchdog_stop() { CommWatchdog::Get().Stop(); }
+
+PT_EXPORT uint64_t pt_watchdog_register(const char* desc,
+                                        int64_t timeout_ms) {
+  return CommWatchdog::Get().Register(desc, timeout_ms);
+}
+
+PT_EXPORT void pt_watchdog_complete(uint64_t id) {
+  CommWatchdog::Get().Complete(id);
+}
+
+PT_EXPORT int64_t pt_watchdog_expired_count() {
+  return CommWatchdog::Get().ExpiredCount();
+}
+
+PT_EXPORT int pt_version() { return 1; }

@@ -1,0 +1,423 @@
+"""Rendezvous key-value store (reference:
+paddle/phi/core/distributed/store/tcp_store.h:121 `TCPStore : Store`,
+store/store.h:24 abstract Store).
+
+The reference bootstraps every ProcessGroup's communicators through a
+master-hosted TCP store (set/get/add/wait). On TPU, jax.distributed has its
+own coordination service for device enumeration; this store is the
+user-level complement for application rendezvous, barriers, and elastic
+bookkeeping, backed by the native C++ implementation in
+paddle_tpu/_native/src/native.cc (ctypes-bound). A pure-Python server is
+the fallback when no C++ toolchain exists.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import threading
+import time
+
+from paddle_tpu import _native
+
+__all__ = ["Store", "TCPStore"]
+
+_MASTER_KEY_PREFIX = "/paddle_tpu/"
+
+
+class Store:
+    """Abstract KV store interface (mirrors the reference Store API)."""
+
+    def set(self, key: str, value) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def add(self, key: str, delta: int) -> int:
+        raise NotImplementedError
+
+    def wait(self, key: str, timeout: float | None = None) -> None:
+        raise NotImplementedError
+
+
+def _raise_rc(op: str, key: str, rc: int):
+    """Map native client return codes: -1=-kTimeout, -2=-kNotFound,
+    -3=-kError (server-reported); -100 = transport failure."""
+    if rc == -1:
+        raise TimeoutError(f"store {op}({key}) timed out")
+    if rc == -2:
+        raise KeyError(f"store {op}({key}): key not found")
+    if rc == -100:
+        raise ConnectionError(
+            f"store {op}({key}): lost connection to the store server")
+    raise RuntimeError(f"store {op}({key}) failed: rc={rc}")
+
+
+def _to_bytes(value) -> bytes:
+    if isinstance(value, bytes):
+        return value
+    if isinstance(value, str):
+        return value.encode()
+    if isinstance(value, int):
+        return str(value).encode()
+    raise TypeError(f"store values must be bytes/str/int, got {type(value)}")
+
+
+# ---------------------------------------------------------------------------
+# pure-Python fallback server (protocol-compatible subset)
+# ---------------------------------------------------------------------------
+
+
+class _PyStoreServer:
+    """Single-process fallback with the same blocking semantics."""
+
+    def __init__(self, port: int):
+        self._data: dict[str, bytes] = {}
+        self._cv = threading.Condition()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(128)
+        self._stop = False
+        self._threads: list[threading.Thread] = []
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._cv:
+            self._cv.notify_all()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _recv_all(self, conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("store connection closed")
+            buf += chunk
+        return buf
+
+    def _recv_bytes(self, conn):
+        (n,) = struct.unpack("<I", self._recv_all(conn, 4))
+        return self._recv_all(conn, n) if n else b""
+
+    def _serve(self, conn):
+        try:
+            while not self._stop:
+                cmd = self._recv_all(conn, 1)[0]
+                if cmd == 0:  # SET
+                    key = self._recv_bytes(conn).decode()
+                    val = self._recv_bytes(conn)
+                    with self._cv:
+                        self._data[key] = val
+                        self._cv.notify_all()
+                    conn.sendall(b"\x00")
+                elif cmd in (1, 3):  # GET / WAIT
+                    key = self._recv_bytes(conn).decode()
+                    (timeout_ms,) = struct.unpack("<q", self._recv_all(conn, 8))
+                    deadline = (None if timeout_ms < 0
+                                else time.monotonic() + timeout_ms / 1000)
+                    with self._cv:
+                        while key not in self._data and not self._stop:
+                            remain = (None if deadline is None
+                                      else deadline - time.monotonic())
+                            if remain is not None and remain <= 0:
+                                break
+                            self._cv.wait(remain)
+                        if key in self._data:
+                            conn.sendall(b"\x00")
+                            if cmd == 1:
+                                val = self._data[key]
+                                conn.sendall(struct.pack("<I", len(val)) + val)
+                        else:
+                            conn.sendall(b"\x01")  # timeout
+                elif cmd == 2:  # ADD
+                    key = self._recv_bytes(conn).decode()
+                    (delta,) = struct.unpack("<q", self._recv_all(conn, 8))
+                    with self._cv:
+                        cur = int(self._data.get(key, b"0") or b"0")
+                        cur += delta
+                        self._data[key] = str(cur).encode()
+                        self._cv.notify_all()
+                    conn.sendall(b"\x00" + struct.pack("<q", cur))
+                elif cmd == 4:  # CHECK
+                    key = self._recv_bytes(conn).decode()
+                    with self._cv:
+                        ok = key in self._data
+                    conn.sendall(b"\x00" if ok else b"\x02")
+                elif cmd == 5:  # DELETE
+                    key = self._recv_bytes(conn).decode()
+                    with self._cv:
+                        existed = self._data.pop(key, None) is not None
+                        self._cv.notify_all()
+                    conn.sendall(b"\x00" if existed else b"\x02")
+                else:
+                    break
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class _PyStoreClient:
+    def __init__(self, host, port, timeout):
+        deadline = time.monotonic() + timeout
+        last_err = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5)
+                break
+            except OSError as e:
+                last_err = e
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"connect to store {host}:{port} timed out") from e
+                time.sleep(0.05)
+        # blocking semantics from here on: waits are bounded by the
+        # server-side timeout in the protocol, not the connect timeout
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _send_bytes(self, b):
+        self._sock.sendall(struct.pack("<I", len(b)) + b)
+
+    def _recv_all(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("store connection closed")
+            buf += chunk
+        return buf
+
+    def _recv_bytes(self):
+        (n,) = struct.unpack("<I", self._recv_all(4))
+        return self._recv_all(n) if n else b""
+
+    def set(self, key, value):
+        with self._lock:
+            self._sock.sendall(b"\x00")
+            self._send_bytes(key.encode())
+            self._send_bytes(value)
+            st = self._recv_all(1)[0]
+            if st != 0:
+                raise RuntimeError(f"store set({key}) failed: {st}")
+
+    def get(self, key, timeout_ms):
+        with self._lock:
+            self._sock.sendall(b"\x01")
+            self._send_bytes(key.encode())
+            self._sock.sendall(struct.pack("<q", timeout_ms))
+            st = self._recv_all(1)[0]
+            if st == 1:
+                raise TimeoutError(f"store get({key}) timed out")
+            if st != 0:
+                raise RuntimeError(f"store get({key}) failed: {st}")
+            return self._recv_bytes()
+
+    def add(self, key, delta):
+        with self._lock:
+            self._sock.sendall(b"\x02")
+            self._send_bytes(key.encode())
+            self._sock.sendall(struct.pack("<q", delta))
+            st = self._recv_all(1)[0]
+            if st != 0:
+                raise RuntimeError(f"store add({key}) failed: {st}")
+            (v,) = struct.unpack("<q", self._recv_all(8))
+            return v
+
+    def wait(self, key, timeout_ms):
+        with self._lock:
+            self._sock.sendall(b"\x03")
+            self._send_bytes(key.encode())
+            self._sock.sendall(struct.pack("<q", timeout_ms))
+            st = self._recv_all(1)[0]
+            if st == 1:
+                raise TimeoutError(f"store wait({key}) timed out")
+            if st != 0:
+                raise RuntimeError(f"store wait({key}) failed: {st}")
+
+    def check(self, key):
+        with self._lock:
+            self._sock.sendall(b"\x04")
+            self._send_bytes(key.encode())
+            return self._recv_all(1)[0] == 0
+
+    def delete(self, key):
+        with self._lock:
+            self._sock.sendall(b"\x05")
+            self._send_bytes(key.encode())
+            return self._recv_all(1)[0] == 0
+
+
+# ---------------------------------------------------------------------------
+# public TCPStore
+# ---------------------------------------------------------------------------
+
+
+class TCPStore(Store):
+    """Master-hosted TCP KV store (reference tcp_store.h:121).
+
+    The process with ``is_master=True`` hosts the server in-process; all
+    processes (master included) talk to it through a client connection.
+    Backed by the native C++ server/client when available.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, timeout: float = 300.0,
+                 world_size: int | None = None, prefix: str = ""):
+        self._lib = _native.load()
+        self._timeout = timeout
+        self._prefix = prefix
+        self._server = None
+        self._native_server = None
+        self.host = host
+        if is_master:
+            if self._lib is not None:
+                self._native_server = self._lib.pt_store_server_start(port)
+                if not self._native_server:
+                    raise RuntimeError(f"failed to start store on port {port}")
+                port = self._lib.pt_store_server_port(self._native_server)
+            else:
+                self._server = _PyStoreServer(port)
+                port = self._server.port
+        self.port = port
+        if self._lib is not None:
+            self._client = self._lib.pt_store_client_new(
+                host.encode(), port, int(timeout * 1000))
+            if not self._client:
+                raise TimeoutError(f"connect to store {host}:{port} timed out")
+            self._native_client = True
+        else:
+            self._client = _PyStoreClient(host, port, timeout)
+            self._native_client = False
+        self.world_size = world_size
+
+    # -- core ops ----------------------------------------------------------
+    def _k(self, key: str) -> str:
+        return _MASTER_KEY_PREFIX + self._prefix + key
+
+    def set(self, key: str, value) -> None:
+        data = _to_bytes(value)
+        if self._native_client:
+            buf = (ctypes.c_uint8 * max(len(data), 1)).from_buffer_copy(
+                data or b"\x00")
+            rc = self._lib.pt_store_set(self._client, self._k(key).encode(),
+                                        buf, len(data))
+            if rc != 0:
+                _raise_rc("set", key, rc)
+        else:
+            self._client.set(self._k(key), data)
+
+    def get(self, key: str, timeout: float | None = None) -> bytes:
+        tmo = int((self._timeout if timeout is None else timeout) * 1000)
+        if self._native_client:
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            out_len = ctypes.c_int64()
+            rc = self._lib.pt_store_get(self._client, self._k(key).encode(),
+                                        tmo, ctypes.byref(out),
+                                        ctypes.byref(out_len))
+            if rc != 0:
+                _raise_rc("get", key, rc)
+            return _native._take_bytes(self._lib, out, out_len)
+        return self._client.get(self._k(key), tmo)
+
+    def add(self, key: str, delta: int = 1) -> int:
+        if self._native_client:
+            out = ctypes.c_int64()
+            rc = self._lib.pt_store_add(self._client, self._k(key).encode(),
+                                        delta, ctypes.byref(out))
+            if rc != 0:
+                _raise_rc("add", key, rc)
+            return out.value
+        return self._client.add(self._k(key), delta)
+
+    def wait(self, key: str, timeout: float | None = None) -> None:
+        tmo = int((self._timeout if timeout is None else timeout) * 1000)
+        if self._native_client:
+            rc = self._lib.pt_store_wait(self._client, self._k(key).encode(),
+                                         tmo)
+            if rc != 0:
+                _raise_rc("wait", key, rc)
+        else:
+            self._client.wait(self._k(key), tmo)
+
+    def check(self, key: str) -> bool:
+        if self._native_client:
+            return self._lib.pt_store_check(
+                self._client, self._k(key).encode()) == 1
+        return self._client.check(self._k(key))
+
+    def delete_key(self, key: str) -> bool:
+        if self._native_client:
+            return self._lib.pt_store_delete(
+                self._client, self._k(key).encode()) == 1
+        return self._client.delete(self._k(key))
+
+    # -- composite ops -----------------------------------------------------
+    def barrier(self, name: str, rank: int, world_size: int | None = None,
+                timeout: float | None = None) -> None:
+        """All `world_size` callers block until every one has arrived.
+
+        Reusable: arrival n belongs to round (n-1)//ws, and each round has
+        its own done-key, so calling barrier("epoch", ...) every epoch
+        re-synchronizes instead of falling through on the stale done flag.
+        """
+        ws = world_size or self.world_size
+        if not ws:
+            raise ValueError("barrier needs world_size")
+        n = self.add(f"barrier/{name}/count", 1)
+        round_idx = (n - 1) // ws
+        done_key = f"barrier/{name}/done/{round_idx}"
+        if n % ws == 0:
+            self.set(done_key, b"1")
+        self.wait(done_key, timeout)
+
+    def close(self):
+        if self._native_client and self._client:
+            self._lib.pt_store_client_free(self._client)
+            self._client = None
+        elif not self._native_client and self._client is not None:
+            self._client.close()
+            self._client = None
+        if self._native_server:
+            self._lib.pt_store_server_stop(self._native_server)
+            self._native_server = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,14 @@
+"""paddle.profiler equivalent (reference: python/paddle/profiler/).
+
+Host scopes → native C++ HostTracer (paddle_tpu/_native); device timeline →
+XLA profiler (xplane under logdir, viewable in xprof/tensorboard/perfetto);
+chrome-trace JSON export merges host events.
+"""
+from paddle_tpu.profiler.profiler import (  # noqa: F401
+    Profiler, ProfilerState, ProfilerTarget, make_scheduler,
+    export_chrome_tracing, export_protobuf,
+)
+from paddle_tpu.profiler.utils import (  # noqa: F401
+    RecordEvent, in_profiler_mode, wrap_optimizers,
+)
+from paddle_tpu.profiler.timer import Benchmark, benchmark  # noqa: F401

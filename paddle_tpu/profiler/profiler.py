@@ -1,0 +1,233 @@
+"""Profiler with scheduler states and chrome-trace export (reference:
+python/paddle/profiler/profiler.py:79 ProfilerState, :346 class Profiler;
+chrome export chrometracing_logger.cc).
+
+TPU-native split of responsibilities: device-side tracing is delegated to
+XLA's profiler (jax.profiler.start_trace → xplane/perfetto artifacts under
+`logdir`), host-side scopes come from the native HostTracer
+(paddle_tpu/_native) and are exported as a chrome-trace JSON that can be
+loaded in chrome://tracing or perfetto alongside the device trace.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import socket
+import time
+
+from paddle_tpu.profiler import utils as _utils
+
+__all__ = ["ProfilerState", "ProfilerTarget", "Profiler", "make_scheduler",
+           "export_chrome_tracing", "export_protobuf"]
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0):
+    """Step-indexed state machine (reference profiler.py make_scheduler)."""
+    cycle = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat and step >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = step % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str | None = None):
+    """on_trace_ready callback writing chrome trace json."""
+    seq = [0]
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        worker = worker_name or f"host_{socket.gethostname()}_pid{os.getpid()}"
+        seq[0] += 1
+        # monotonic sequence: repeated record cycles within one second must
+        # not clobber each other
+        path = os.path.join(
+            dir_name,
+            f"{worker}_time_{int(time.time())}_{seq[0]}.paddle_trace.json")
+        prof._export_chrome(path)
+        prof.last_export_path = path
+    return handler
+
+
+def export_protobuf(dir_name: str, worker_name: str | None = None):
+    # the XLA trace under logdir IS the protobuf artifact; host json besides
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+class Profiler:
+    """paddle.profiler.Profiler equivalent.
+
+    with Profiler(scheduler=(2, 5), on_trace_ready=...) as p:
+        for batch in loader:
+            train_step(batch)
+            p.step()
+    """
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 timer_only: bool = False, emit_nvtx: bool = False,
+                 custom_device_types=None, with_flops: bool = False,
+                 logdir: str | None = None):
+        if scheduler is None:
+            self._scheduler = _default_scheduler
+        elif isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(start, 0), ready=0, record=end - start, repeat=1)
+        else:
+            self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._logdir = logdir or os.environ.get(
+            "PADDLE_TPU_PROFILE_DIR", "profiler_log")
+        self.current_state = ProfilerState.CLOSED
+        self._step = 0
+        self._device_tracing = False
+        self.last_export_path = None
+        self._benchmark = None
+        if timer_only:
+            from paddle_tpu.profiler.timer import Benchmark
+            self._benchmark = Benchmark()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self.current_state = self._scheduler(self._step)
+        if self._benchmark is not None:
+            self._benchmark.begin()
+        if self._timer_only:
+            return
+        self._transit(ProfilerState.CLOSED, self.current_state)
+
+    def stop(self):
+        if self._benchmark is not None:
+            self._benchmark.end()
+        if self._timer_only:
+            return
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._stop_tracing()
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: int | None = None):
+        if self._benchmark is not None:
+            self._benchmark.step(num_samples)
+        self._step += 1
+        if self._timer_only:
+            return
+        old = self.current_state
+        new = self._scheduler(self._step)
+        self.current_state = new
+        self._transit(old, new)
+
+    def step_info(self, unit=None):
+        if self._benchmark is None:
+            return ""
+        return self._benchmark.step_info(unit)
+
+    def _transit(self, old: ProfilerState, new: ProfilerState):
+        was_rec = old in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        is_rec = new in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if not was_rec and is_rec:
+            self._start_tracing()
+        elif was_rec and (not is_rec or old == ProfilerState.RECORD_AND_RETURN):
+            self._stop_tracing()
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+            if is_rec:
+                self._start_tracing()
+
+    def _start_tracing(self):
+        _utils.clear_host_events()
+        _utils.enable_host_tracer(True)
+        try:
+            import jax.profiler
+            jax.profiler.start_trace(self._logdir)
+            self._device_tracing = True
+        except Exception:
+            self._device_tracing = False
+
+    def _stop_tracing(self):
+        _utils.enable_host_tracer(False)
+        if self._device_tracing:
+            try:
+                import jax.profiler
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+
+    # -- export / summary --------------------------------------------------
+    def _export_chrome(self, path: str):
+        events = _utils.host_chrome_events()
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms",
+                       "metadata": {"producer": "paddle_tpu.profiler",
+                                    "xla_trace_logdir": self._logdir}}, f)
+
+    def export(self, path: str, format: str = "json"):
+        self._export_chrome(path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        events = _utils.host_chrome_events()
+        stats = {}
+        for e in events:
+            if e.get("ph") != "X":
+                continue
+            s = stats.setdefault(e["name"], [0, 0.0, 0.0])
+            s[0] += 1
+            s[1] += e.get("dur", 0.0)
+            s[2] = max(s[2], e.get("dur", 0.0))
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Max(ms)':>12}"]
+        for name, (calls, total, mx) in sorted(
+                stats.items(), key=lambda kv: -kv[1][1]):
+            lines.append(
+                f"{name[:39]:<40}{calls:>8}{total / 1000:>12.3f}"
+                f"{mx / 1000:>12.3f}")
+        report = "\n".join(lines)
+        print(report)
+        return report
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

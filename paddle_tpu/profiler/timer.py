@@ -1,0 +1,66 @@
+"""Throughput timer (reference: python/paddle/profiler/timer.py — the
+Benchmark/TimerHook that feeds fleet "ips" logs)."""
+from __future__ import annotations
+
+import time
+
+__all__ = ["Benchmark", "benchmark"]
+
+
+class _Stat:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.count = 0
+        self.total = 0.0
+        self.samples = 0
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self, num_samples):
+        if self._t0 is None:
+            return
+        self.total += time.perf_counter() - self._t0
+        self.count += 1
+        self.samples += num_samples or 0
+        self._t0 = None
+
+    @property
+    def steps_per_sec(self):
+        return self.count / self.total if self.total else 0.0
+
+    @property
+    def ips(self):
+        return self.samples / self.total if self.total else 0.0
+
+
+class Benchmark:
+    def __init__(self):
+        self._stat = _Stat()
+        self.current_event = self._stat
+
+    def begin(self):
+        self._stat.reset()
+        self._stat.start()
+
+    def step(self, num_samples=None):
+        self._stat.stop(num_samples)
+        self._stat.start()
+
+    def end(self):
+        self._stat._t0 = None
+
+    def step_info(self, unit=None):
+        unit = unit or "samples"
+        return (f"avg_steps/sec: {self._stat.steps_per_sec:.3f}, "
+                f"ips: {self._stat.ips:.2f} {unit}/s")
+
+
+_global_benchmark = Benchmark()
+
+
+def benchmark() -> Benchmark:
+    return _global_benchmark

@@ -1,0 +1,163 @@
+"""RecordEvent instrumentation scopes (reference:
+python/paddle/profiler/utils.py:38 RecordEvent;
+paddle/fluid/platform/profiler/event_tracing.h RecordEvent;
+host_tracer.h:26 HostTracer).
+
+Events are recorded into the native C++ host tracer
+(paddle_tpu/_native/src/native.cc HostTracer — thread-local buffers,
+steady-clock ns) and additionally annotated into the XLA device trace via
+jax.profiler.TraceAnnotation so host scopes line up with device ops in
+xprof/perfetto. A pure-Python recorder is the fallback.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+
+from paddle_tpu import _native
+
+__all__ = ["RecordEvent", "in_profiler_mode", "wrap_optimizers"]
+
+_py_events = []  # fallback recorder: (name, t0_ns, t1_ns, tid, kind, value)
+_py_lock = threading.Lock()
+_py_enabled = [False]
+
+
+def _tracer_enabled() -> bool:
+    lib = _native.load()
+    if lib is not None:
+        return bool(lib.pt_tracer_enabled())
+    return _py_enabled[0]
+
+
+def in_profiler_mode() -> bool:
+    return _tracer_enabled()
+
+
+def enable_host_tracer(on: bool) -> None:
+    lib = _native.load()
+    if lib is not None:
+        lib.pt_tracer_enable(1 if on else 0)
+    else:
+        _py_enabled[0] = bool(on)
+
+
+def clear_host_events() -> None:
+    lib = _native.load()
+    if lib is not None:
+        lib.pt_tracer_clear()
+    else:
+        with _py_lock:
+            _py_events.clear()
+
+
+def host_chrome_events() -> list:
+    """Collected host events as chrome-trace event dicts."""
+    lib = _native.load()
+    if lib is not None:
+        import ctypes
+        import json
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_int64()
+        lib.pt_tracer_export_chrome(ctypes.byref(out), ctypes.byref(n))
+        return json.loads(_native._take_bytes(lib, out, n) or b"[]")
+    with _py_lock:
+        evs = []
+        for name, t0, t1, tid, kind, value in _py_events:
+            e = {"name": name, "ph": {0: "X", 1: "i", 2: "C"}[kind],
+                 "pid": 0, "tid": tid, "ts": t0 / 1000.0}
+            if kind == 0:
+                e["dur"] = (t1 - t0) / 1000.0
+            elif kind == 2:
+                e["args"] = {"value": value}
+            evs.append(e)
+        return evs
+
+
+def record_counter(name: str, value: float) -> None:
+    lib = _native.load()
+    if lib is not None:
+        lib.pt_tracer_counter(name.encode(), float(value))
+    elif _py_enabled[0]:
+        t = time.perf_counter_ns()
+        with _py_lock:
+            _py_events.append((name, t, t, threading.get_ident(), 2,
+                               float(value)))
+
+
+class RecordEvent:
+    """Context manager / decorator marking a named host scope.
+
+    Mirrors paddle.profiler.RecordEvent (reference utils.py:38): usable as
+    `with RecordEvent("forward"):` or `.begin()`/`.end()` pairs.
+    """
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self.event_type = event_type
+        self._annotation = None
+        self._t0 = None
+
+    def begin(self):
+        if not _tracer_enabled():
+            return
+        lib = _native.load()
+        if lib is not None:
+            lib.pt_tracer_push(self.name.encode())
+        else:
+            self._t0 = time.perf_counter_ns()
+        try:
+            import jax.profiler
+            self._annotation = jax.profiler.TraceAnnotation(self.name)
+            self._annotation.__enter__()
+        except Exception:
+            self._annotation = None
+
+    def end(self):
+        if self._annotation is not None:
+            self._annotation.__exit__(None, None, None)
+            self._annotation = None
+        lib = _native.load()
+        if lib is not None:
+            if _tracer_enabled():
+                lib.pt_tracer_pop()
+        elif self._t0 is not None:
+            t1 = time.perf_counter_ns()
+            with _py_lock:
+                _py_events.append((self.name, self._t0, t1,
+                                   threading.get_ident(), 0, 0.0))
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with RecordEvent(self.name):
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+def wrap_optimizers():
+    """Reference wraps Optimizer.step in RecordEvent scopes
+    (python/paddle/profiler/utils.py wrap_optimizers); ours instruments
+    paddle_tpu.optimizer.Optimizer.step once."""
+    from paddle_tpu.optimizer import Optimizer
+    if getattr(Optimizer.step, "_profiled", False):
+        return
+    orig = Optimizer.step
+
+    @functools.wraps(orig)
+    def step(self, *a, **k):
+        with RecordEvent(f"{type(self).__name__}.step"):
+            return orig(self, *a, **k)
+
+    step._profiled = True
+    Optimizer.step = step

@@ -1,0 +1,179 @@
+"""Native C++ core (TCPStore / host tracer / watchdog) + profiler facade.
+
+Mirrors the reference's store tests (test/cpp/phi/core/test_tcp_store? —
+the reference exercises TCPStore via collective bootstrap tests) and
+profiler tests (test/legacy_test/test_profiler.py pattern: record scopes,
+export, summarize).
+"""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from paddle_tpu import _native
+from paddle_tpu.distributed.store import TCPStore
+
+
+@pytest.mark.skipif(bool(os.environ.get("PADDLE_TPU_DISABLE_NATIVE")),
+                    reason="native explicitly disabled")
+def test_native_builds():
+    # the image ships g++; the native layer must actually build here
+    assert _native.available()
+
+
+def test_store_set_get_add():
+    master = TCPStore(is_master=True, world_size=2)
+    client = TCPStore(host="127.0.0.1", port=master.port, world_size=2)
+    try:
+        master.set("k", b"v1")
+        assert client.get("k") == b"v1"
+        client.set("k", b"v2")
+        assert master.get("k") == b"v2"
+        assert master.add("cnt", 3) == 3
+        assert client.add("cnt", -1) == 2
+        assert client.check("k") and not client.check("nope")
+        assert client.delete_key("k")
+        assert not master.check("k")
+    finally:
+        client.close()
+        master.close()
+
+
+def test_store_wait_timeout_and_barrier():
+    master = TCPStore(is_master=True, world_size=2)
+    client = TCPStore(host="127.0.0.1", port=master.port, world_size=2)
+    try:
+        with pytest.raises(TimeoutError):
+            client.get("missing", timeout=0.2)
+        with pytest.raises(TimeoutError):
+            client.wait("missing", timeout=0.2)
+
+        errs = []
+
+        def rank0():
+            try:
+                master.barrier("b", 0, timeout=10)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        t = threading.Thread(target=rank0)
+        t.start()
+        time.sleep(0.05)
+        client.barrier("b", 1, timeout=10)
+        t.join(timeout=10)
+        assert not t.is_alive() and not errs
+
+        # reusing a barrier name must re-synchronize, not fall through
+        t2 = threading.Thread(target=rank0)
+        t2.start()
+        client.barrier("b", 1, timeout=10)
+        t2.join(timeout=10)
+        assert not t2.is_alive() and not errs
+    finally:
+        client.close()
+        master.close()
+
+
+def test_store_late_client_connect_retries():
+    """Client created before the server exists must retry-connect
+    (rendezvous semantics, reference tcp_store bootstrap)."""
+    import socket as pysocket
+    s = pysocket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # free it; server will claim it shortly
+
+    result = {}
+
+    def late_master():
+        time.sleep(0.3)
+        result["master"] = TCPStore(is_master=True, port=port)
+        result["master"].set("ready", b"1")
+
+    t = threading.Thread(target=late_master)
+    t.start()
+    client = TCPStore(host="127.0.0.1", port=port, timeout=10)
+    assert client.get("ready", timeout=10) == b"1"
+    t.join()
+    client.close()
+    result["master"].close()
+
+
+def test_host_tracer_chrome_export():
+    from paddle_tpu.profiler import utils as u
+    u.clear_host_events()
+    u.enable_host_tracer(True)
+    try:
+        with u.RecordEvent("outer"):
+            with u.RecordEvent("inner"):
+                time.sleep(0.002)
+        u.record_counter("loss", 0.5)
+    finally:
+        u.enable_host_tracer(False)
+    events = u.host_chrome_events()
+    names = [e["name"] for e in events]
+    assert "outer" in names and "inner" in names and "loss" in names
+    outer = next(e for e in events if e["name"] == "outer")
+    inner = next(e for e in events if e["name"] == "inner")
+    assert outer["ph"] == "X" and outer["dur"] >= inner["dur"] > 0
+    loss = next(e for e in events if e["name"] == "loss")
+    assert loss["ph"] == "C" and loss["args"]["value"] == 0.5
+
+
+def test_profiler_scheduler_and_export(tmp_path):
+    from paddle_tpu.profiler import (Profiler, ProfilerState, make_scheduler,
+                                     export_chrome_tracing, RecordEvent)
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    assert sched(0) == ProfilerState.CLOSED
+    assert sched(1) == ProfilerState.READY
+    assert sched(2) == ProfilerState.RECORD
+    assert sched(3) == ProfilerState.RECORD_AND_RETURN
+    assert sched(4) == ProfilerState.CLOSED
+
+    out_dir = str(tmp_path / "prof")
+    p = Profiler(scheduler=lambda step: ProfilerState.RECORD_AND_RETURN
+                 if step == 1 else ProfilerState.RECORD,
+                 on_trace_ready=export_chrome_tracing(out_dir),
+                 logdir=str(tmp_path / "xla"))
+    p.start()
+    with RecordEvent("train_step"):
+        time.sleep(0.001)
+    p.step()
+    p.stop()
+    assert p.last_export_path and os.path.exists(p.last_export_path)
+    with open(p.last_export_path) as f:
+        trace = json.load(f)
+    assert any(e["name"] == "train_step" for e in trace["traceEvents"])
+    summary = p.summary()
+    assert "train_step" in summary
+
+
+def test_profiler_timer_only():
+    from paddle_tpu.profiler import Profiler
+    p = Profiler(timer_only=True)
+    p.start()
+    for _ in range(3):
+        time.sleep(0.001)
+        p.step(num_samples=4)
+    info = p.step_info()
+    p.stop()
+    assert "ips" in info
+
+
+def test_watchdog_detects_expiry():
+    lib = _native.load()
+    if lib is None:
+        pytest.skip("native unavailable")
+    base = lib.pt_watchdog_expired_count()
+    lib.pt_watchdog_start(20)
+    op = lib.pt_watchdog_register(b"test_allreduce", 40)
+    time.sleep(0.25)
+    assert lib.pt_watchdog_expired_count() == base + 1
+    lib.pt_watchdog_complete(op)
+    ok = lib.pt_watchdog_register(b"fast_op", 5000)
+    lib.pt_watchdog_complete(ok)
+    time.sleep(0.05)
+    assert lib.pt_watchdog_expired_count() == base + 1
+    lib.pt_watchdog_stop()
