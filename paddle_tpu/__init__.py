@@ -60,10 +60,18 @@ def __getattr__(name):
     if name in ("distributed", "vision", "distribution", "profiler",
                 "incubate", "sparse", "static", "hapi", "models", "fft",
                 "signal", "linalg_mod", "quantization", "geometric", "text",
-                "audio", "onnx", "utils"):
+                "audio", "onnx", "utils", "sysconfig", "version"):
         mod = importlib.import_module(f"paddle_tpu.{name}")
         globals()[name] = mod
         return mod
+    if name == "Model":
+        from paddle_tpu.hapi import Model
+        globals()["Model"] = Model
+        return Model
+    if name == "callbacks":
+        from paddle_tpu.hapi import callbacks
+        globals()["callbacks"] = callbacks
+        return callbacks
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 

@@ -1,0 +1,8 @@
+"""Keras-like high-level API (reference: python/paddle/hapi/)."""
+from paddle_tpu.hapi.model import Model  # noqa: F401
+from paddle_tpu.hapi import callbacks  # noqa: F401
+from paddle_tpu.hapi.callbacks import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler,
+    History,
+)
+from paddle_tpu.hapi.summary import summary  # noqa: F401

@@ -1,0 +1,283 @@
+"""Keras-like high-level Model API (reference: python/paddle/hapi/model.py
+:1051 fit, :1753 evaluate/predict; DynamicGraphAdapter train_batch).
+
+TPU-native: the train step is eager-tape by default; pass ``jit=True`` to
+``prepare`` to run the whole step as one XLA program via
+paddle_tpu.jit.to_static.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.hapi.callbacks import config_callbacks
+from paddle_tpu.metric import Metric
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+class Model:
+    """Wraps a Layer with train/eval/predict loops.
+
+    model = paddle.Model(net)
+    model.prepare(optimizer, loss, metrics)
+    model.fit(train_dataset, eval_dataset, epochs=2, batch_size=32)
+    """
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        self.save_dir = None
+
+    # -- configuration -----------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit: bool = False):
+        self._optimizer = optimizer
+        if loss is not None and not callable(loss):
+            raise TypeError("loss must be callable")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metrics must be paddle.metric.Metric, "
+                                f"got {type(m)}")
+        self._amp_level = (amp_configs or {}).get("level", "O0") \
+            if isinstance(amp_configs, dict) else (amp_configs or "O0")
+        self._jit = jit
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    # -- single-batch ops --------------------------------------------------
+    def _forward(self, inputs):
+        inputs = [_as_tensor(x) for x in _to_list(inputs)]
+        return self.network(*inputs)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        import paddle_tpu as paddle
+        self.network.train()
+        labels = [_as_tensor(x) for x in _to_list(labels)]
+
+        if self._amp_level in ("O1", "O2"):
+            ctx = paddle.amp.auto_cast(level=self._amp_level)
+        else:
+            import contextlib
+            ctx = contextlib.nullcontext()
+        with ctx:
+            outputs = self._forward(inputs)
+            losses = self._loss(*(_to_list(outputs) + labels))
+        total = losses if isinstance(losses, Tensor) else sum(_to_list(losses))
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        vals = [float(v) for v in _to_list(losses)]
+        return vals if len(vals) > 1 else vals[0]
+
+    def eval_batch(self, inputs, labels=None):
+        import paddle_tpu as paddle
+        self.network.eval()
+        labels = [_as_tensor(x) for x in _to_list(labels)]
+        with paddle.no_grad():
+            outputs = self._forward(inputs)
+            if self._loss:
+                losses = self._loss(*(_to_list(outputs) + labels))
+            else:
+                losses = None
+        metrics = []
+        for m in self._metrics:
+            res = m.compute(*(_to_list(outputs) + labels))
+            m.update(*[np.asarray(r) for r in _to_list(res)])
+            metrics.append(m.accumulate())
+        vals = [float(v) for v in _to_list(losses)] if losses is not None \
+            else []
+        return (vals if len(vals) != 1 else vals[0]), metrics
+
+    def predict_batch(self, inputs):
+        import paddle_tpu as paddle
+        self.network.eval()
+        with paddle.no_grad():
+            out = self._forward(inputs)
+        return [o.numpy() for o in _to_list(out)]
+
+    # -- loops -------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, num_workers, drop_last):
+        from paddle_tpu.io import DataLoader, Dataset, IterableDataset
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, (Dataset, IterableDataset)):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=drop_last)
+        return data  # any iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        assert train_data is not None
+        self.save_dir = save_dir
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers, drop_last)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, verbose=verbose, save_freq=save_freq,
+            save_dir=save_dir, metrics=[m.name() for m in self._metrics])
+
+        cbks.on_train_begin()
+        self.stop_training = False
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, labs = self._split_batch(batch)
+                loss = self.train_batch(ins, labs)
+                logs = {"loss": loss}
+                # train metrics (reference computes them on train outputs)
+                if self._metrics:
+                    _, mvals = self._eval_metrics_only(ins, labs)
+                    for m, v in zip(self._metrics, mvals):
+                        logs[m.name() if isinstance(m.name(), str)
+                             else str(m.name())] = v
+                cbks.on_train_batch_end(step, logs)
+            if eval_data is not None and (epoch % eval_freq == 0
+                                          or epoch == epochs - 1):
+                eval_logs = self.evaluate(
+                    eval_data, batch_size=batch_size, log_freq=log_freq,
+                    verbose=0, num_workers=num_workers, callbacks=cbks,
+                    _inner=True)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+        hist = [c for c in cbks.callbacks
+                if type(c).__name__ == "History"]
+        return hist[0].history if hist else None
+
+    def _eval_metrics_only(self, ins, labs):
+        # snapshot: compute metric on this batch without resetting state
+        import paddle_tpu as paddle
+        self.network.eval()
+        with paddle.no_grad():
+            out = self._forward(ins)
+        vals = []
+        for m in self._metrics:
+            res = m.compute(*(_to_list(out) +
+                              [_as_tensor(v) for v in _to_list(labs)]))
+            m.update(*[np.asarray(r) for r in _to_list(res)])
+            vals.append(m.accumulate())
+        self.network.train()
+        return out, vals
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _inner=False):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers,
+                                   False)
+        if _inner and callbacks is not None:
+            cbks = callbacks
+        else:
+            cbks = config_callbacks(callbacks, model=self, verbose=verbose,
+                                    metrics=[m.name() for m in self._metrics])
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        logs = {}
+        losses = []
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, labs = self._split_batch(batch)
+            loss, mvals = self.eval_batch(ins, labs)
+            if loss != []:
+                losses.append(loss)
+            logs = {}
+            if losses:
+                logs["loss"] = float(np.mean(losses))
+            for m, v in zip(self._metrics, mvals):
+                logs[m.name() if isinstance(m.name(), str)
+                     else str(m.name())] = v
+            cbks.on_eval_batch_end(step, logs)
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, num_workers,
+                                   False)
+        cbks = config_callbacks(callbacks, model=self, verbose=0)
+        cbks.on_predict_begin()
+        outputs = []
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step)
+            ins, _ = self._split_batch(batch, has_label=False)
+            out = self.predict_batch(ins)
+            outputs.append(out)
+            cbks.on_predict_batch_end(step)
+        cbks.on_predict_end()
+        # transpose: list-of-batches -> per-output list
+        n_out = len(outputs[0]) if outputs else 0
+        res = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            res = [np.concatenate(r, axis=0) for r in res]
+        return res
+
+    def _split_batch(self, batch, has_label=True):
+        if isinstance(batch, (list, tuple)):
+            batch = list(batch)
+            if not has_label:
+                # predict: keep only the declared input slots (trailing
+                # labels in the dataset are dropped, like the reference)
+                n_in = max(len(self._inputs), 1)
+                return batch[:n_in], []
+            if len(batch) == 1:
+                return batch, []
+            n_in = max(len(self._inputs), 1) if self._inputs else \
+                len(batch) - max(len(self._labels), 1)
+            n_in = max(n_in, 1)
+            return batch[:n_in], batch[n_in:]
+        return [batch], []
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        import paddle_tpu as paddle
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        paddle.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            paddle.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import paddle_tpu as paddle
+        state = paddle.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(paddle.load(opt_path))
+
+    def summary(self, input_size=None, dtype=None):
+        from paddle_tpu.hapi.summary import summary
+        return summary(self.network, input_size, dtype)

@@ -1,0 +1,77 @@
+"""paddle.summary (reference: python/paddle/hapi/model_summary.py) —
+layer-by-layer output shapes and parameter counts via forward hooks."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["summary"]
+
+
+def _num_params(layer):
+    own = list(layer._parameters.values()) if hasattr(layer, "_parameters") \
+        else []
+    return sum(int(np.prod(p.shape)) for p in own if p is not None)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Prints the table; returns {'total_params': N, 'trainable_params': N}."""
+    records = []
+    hooks = []
+
+    def make_hook(name):
+        def hook(layer, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else None
+            records.append((name, type(layer).__name__, shape,
+                            _num_params(layer)))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        if not list(sub.children()):  # leaves only, like the reference table
+            hooks.append(sub.register_forward_post_hook(make_hook(name)))
+
+    try:
+        if input is not None:
+            x = input if isinstance(input, (list, tuple)) else [input]
+            x = [v if isinstance(v, Tensor) else Tensor(np.asarray(v))
+                 for v in x]
+        else:
+            if input_size is None:
+                raise ValueError("summary needs input_size or input")
+            sizes = input_size if isinstance(input_size, list) else [input_size]
+            if sizes and isinstance(sizes[0], int):
+                sizes = [tuple(sizes)]
+            dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+                [dtypes] * len(sizes)
+            x = []
+            for s, dt in zip(sizes, dts):
+                s = tuple(1 if (d is None or d == -1) else d for d in s)
+                x.append(Tensor(np.zeros(s, dtype=dt or "float32")))
+        was_training = net.training
+        net.eval()
+        net(*x)
+        if was_training:
+            net.train()
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+
+    width = 76
+    print("-" * width)
+    print(f"{'Layer (type)':<32}{'Output Shape':<26}{'Param #':>12}")
+    print("=" * width)
+    for name, cls, shape, n in records:
+        print(f"{(name + ' (' + cls + ')')[:31]:<32}"
+              f"{str(shape)[:25]:<26}{n:>12,}")
+    print("=" * width)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print("-" * width)
+    return {"total_params": total, "trainable_params": trainable}

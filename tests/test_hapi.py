@@ -1,0 +1,115 @@
+"""hapi Model.fit/evaluate/predict + callbacks + summary
+(reference test pattern: test/legacy_test/test_model.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _toy_data(n=128, d=8, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype("float32")
+    W = rng.randn(d, classes).astype("float32")
+    y = np.argmax(X @ W, axis=1).astype("int64")
+    return X, y
+
+
+def _make_model(d=8, classes=3, metrics=True):
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(d, 32), paddle.nn.ReLU(),
+        paddle.nn.Linear(32, classes))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(),
+                  paddle.metric.Accuracy() if metrics else None)
+    return model
+
+
+def test_fit_evaluate_predict(tmp_path):
+    X, y = _toy_data()
+    ds = paddle.io.TensorDataset([paddle.to_tensor(X), paddle.to_tensor(y)])
+    model = _make_model()
+    history = model.fit(ds, epochs=3, batch_size=32, verbose=0)
+    assert history["loss"][-1] < history["loss"][0]
+
+    logs = model.evaluate(ds, batch_size=32, verbose=0)
+    assert logs["loss"] < 1.0
+    assert logs["acc"] > 0.8
+
+    preds = model.predict(ds, batch_size=32, stack_outputs=True)
+    assert preds[0].shape == (128, 3)
+    acc = (np.argmax(preds[0], -1) == y).mean()
+    assert acc > 0.8
+
+
+def test_fit_with_eval_and_early_stopping(tmp_path):
+    X, y = _toy_data()
+    ds = paddle.io.TensorDataset([paddle.to_tensor(X), paddle.to_tensor(y)])
+    model = _make_model()
+    es = paddle.callbacks.EarlyStopping(
+        monitor="acc", mode="max", patience=1, verbose=0,
+        save_best_model=False)
+    history = model.fit(ds, eval_data=ds, epochs=2, batch_size=32, verbose=0,
+                        callbacks=[es])
+    assert "eval_acc" in history
+
+
+def test_train_eval_batch():
+    X, y = _toy_data(64)
+    model = _make_model()
+    loss0 = model.train_batch([X[:32]], [y[:32]])
+    for _ in range(20):
+        loss = model.train_batch([X[:32]], [y[:32]])
+    assert loss < loss0
+    eval_loss, metrics = model.eval_batch([X[32:]], [y[32:]])
+    assert np.isfinite(eval_loss) and len(metrics) == 1
+
+
+def test_save_load_roundtrip(tmp_path):
+    X, y = _toy_data()
+    model = _make_model()
+    model.train_batch([X], [y])
+    path = str(tmp_path / "ckpt" / "m")
+    model.save(path)
+    model2 = _make_model()
+    model2.load(path)
+    p1 = model.predict_batch([X])[0]
+    p2 = model2.predict_batch([X])[0]
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+
+
+def test_checkpoint_callback(tmp_path):
+    import os
+    X, y = _toy_data(32)
+    ds = paddle.io.TensorDataset([paddle.to_tensor(X), paddle.to_tensor(y)])
+    model = _make_model(metrics=False)
+    model.fit(ds, epochs=2, batch_size=16, verbose=0,
+              save_dir=str(tmp_path / "sv"))
+    assert os.path.exists(tmp_path / "sv" / "final.pdparams")
+    assert os.path.exists(tmp_path / "sv" / "0.pdparams")
+
+
+def test_summary(capsys):
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 32), paddle.nn.ReLU(), paddle.nn.Linear(32, 3))
+    info = paddle.summary(net, (1, 8))
+    out = capsys.readouterr().out
+    assert info["total_params"] == 8 * 32 + 32 + 32 * 3 + 3
+    assert info["trainable_params"] == info["total_params"]
+    assert "Linear" in out and "Total params" in out
+
+
+def test_lr_scheduler_callback_steps():
+    X, y = _toy_data(64)
+    ds = paddle.io.TensorDataset([paddle.to_tensor(X), paddle.to_tensor(y)])
+    net = paddle.nn.Linear(8, 3)
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2,
+                                          gamma=0.5)
+    opt = paddle.optimizer.SGD(learning_rate=sched,
+                               parameters=net.parameters())
+    model = paddle.Model(net)
+    model.prepare(opt, paddle.nn.CrossEntropyLoss())
+    model.fit(ds, epochs=1, batch_size=16, verbose=0)
+    # 4 batches -> scheduler stepped 4 times -> lr decayed twice
+    assert sched.get_lr() == pytest.approx(0.1 * 0.5 ** 2)
