@@ -1,0 +1,21 @@
+"""paddle.vision equivalent (reference: python/paddle/vision/)."""
+from paddle_tpu.vision import transforms  # noqa: F401
+from paddle_tpu.vision import datasets  # noqa: F401
+from paddle_tpu.vision import models  # noqa: F401
+from paddle_tpu.vision import ops  # noqa: F401
+
+__all__ = ["transforms", "datasets", "models", "ops", "set_image_backend",
+           "get_image_backend"]
+
+_image_backend = "cv2"
+
+
+def set_image_backend(backend):
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"invalid backend {backend}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend

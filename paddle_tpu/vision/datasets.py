@@ -1,0 +1,212 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — MNIST,
+FashionMNIST, Cifar10/100, ImageFolder/DatasetFolder, Flowers).
+
+This environment has no network egress, so constructors take local files
+(standard idx/pickle formats) via ``image_path``/``data_file`` like the
+reference, and raise a clear error instead of downloading. ``FakeData``
+provides deterministic synthetic images for tests and smoke training.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data."""
+
+    def __init__(self, num_samples=256, image_shape=(3, 32, 32),
+                 num_classes=10, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.RandomState(seed)
+        # class-dependent mean so the task is learnable
+        self._means = self._rng.randn(num_classes, *self.image_shape) \
+            .astype("float32")
+        self._labels = self._rng.randint(0, num_classes, num_samples)
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        label = int(self._labels[idx])
+        rng = np.random.RandomState(1000 + idx)
+        img = (self._means[label]
+               + 0.3 * rng.randn(*self.image_shape).astype("float32"))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+def _require(path, what):
+    if path is None or not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{what} not found at {path!r}. This environment has no network "
+            f"egress — place the standard dataset files locally and pass "
+            f"their path, or use paddle_tpu.vision.datasets.FakeData for "
+            f"synthetic data.")
+
+
+class MNIST(Dataset):
+    """idx-format MNIST (reference datasets/mnist.py). Pass image_path/
+    label_path pointing at the standard *-ubyte.gz files."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, backend="cv2"):
+        self.mode = mode.lower()
+        self.transform = transform
+        _require(image_path, f"{self.NAME} images")
+        _require(label_path, f"{self.NAME} labels")
+        with gzip.open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            self.labels = np.frombuffer(f.read(), dtype=np.uint8)[:n]
+        with gzip.open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            self.images = np.frombuffer(f.read(), dtype=np.uint8) \
+                .reshape(n, rows, cols)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx][:, :, None]  # HWC
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """python-pickle CIFAR tarball (reference datasets/cifar.py)."""
+
+    _N_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 backend="cv2"):
+        self.mode = mode.lower()
+        self.transform = transform
+        _require(data_file, "cifar tar.gz")
+        datas, labels = [], []
+        want = "test_batch" if self.mode == "test" else "data_batch"
+        if self._N_CLASSES == 100:
+            want = "test" if self.mode == "test" else "train"
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                base = os.path.basename(member.name)
+                if not base.startswith(want):
+                    continue
+                batch = pickle.load(tf.extractfile(member), encoding="bytes")
+                datas.append(batch[b"data"])
+                key = b"labels" if b"labels" in batch else b"fine_labels"
+                labels.extend(batch[key])
+        self.data = np.concatenate(datas).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        img = np.transpose(self.data[idx], (1, 2, 0))  # HWC uint8
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar100(Cifar10):
+    _N_CLASSES = 100
+
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+
+
+def _load_image(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+        return np.asarray(Image.open(path).convert("RGB"))
+    except ImportError as e:
+        raise RuntimeError(
+            f"cannot decode {path}: PIL unavailable; use .npy images") from e
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory layout (reference datasets/folder.py)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        extensions = extensions or _IMG_EXTS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    path = os.path.join(dirpath, fn)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fn.lower().endswith(extensions))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid images under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(target)
+
+
+class ImageFolder(Dataset):
+    """flat folder of images, no labels (reference folder.py ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        extensions = extensions or _IMG_EXTS
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                path = os.path.join(dirpath, fn)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fn.lower().endswith(extensions))
+                if ok:
+                    self.samples.append(path)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]

@@ -1,0 +1,6 @@
+"""Vision model zoo (reference: python/paddle/vision/models/__init__.py)."""
+from paddle_tpu.vision.models.resnet import *  # noqa: F401,F403
+from paddle_tpu.vision.models.vgg import *  # noqa: F401,F403
+from paddle_tpu.vision.models.small import *  # noqa: F401,F403
+from paddle_tpu.vision.models.mobilenet import *  # noqa: F401,F403
+from paddle_tpu.vision.models.vit import *  # noqa: F401,F403

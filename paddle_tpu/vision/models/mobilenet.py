@@ -1,0 +1,250 @@
+"""MobileNet V1/V2/V3 (reference: python/paddle/vision/models/
+mobilenetv1.py, mobilenetv2.py, mobilenetv3.py)."""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+from paddle_tpu.tensor import flatten
+
+__all__ = ["MobileNetV1", "mobilenet_v1", "MobileNetV2", "mobilenet_v2",
+           "MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _no_pretrained(p):
+    from paddle_tpu.vision.models.resnet import _no_pretrained as f
+    f(p)
+
+
+class _ConvBNReLU(nn.Sequential):
+    def __init__(self, in_c, out_c, k=3, stride=1, groups=1,
+                 act=nn.ReLU, norm=nn.BatchNorm2D):
+        pad = (k - 1) // 2
+        layers = [nn.Conv2D(in_c, out_c, k, stride=stride, padding=pad,
+                            groups=groups, bias_attr=False), norm(out_c)]
+        if act is not None:
+            layers.append(act())
+        super().__init__(*layers)
+
+
+# -- V1 ---------------------------------------------------------------------
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        s = lambda c: max(8, int(c * scale))
+        cfg = [  # (out, stride) of depthwise-separable blocks
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+            (1024, 1)]
+        layers = [_ConvBNReLU(3, s(32), 3, stride=2)]
+        in_c = s(32)
+        for out, stride in cfg:
+            layers.append(_ConvBNReLU(in_c, in_c, 3, stride=stride,
+                                      groups=in_c))  # depthwise
+            layers.append(_ConvBNReLU(in_c, s(out), 1))  # pointwise
+            in_c = s(out)
+        self.features = nn.Sequential(*layers)
+        self._out_c = in_c
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(in_c, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+# -- V2 ---------------------------------------------------------------------
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        self.stride = stride
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_ConvBNReLU(inp, hidden, 1, act=nn.ReLU6))
+        layers += [
+            _ConvBNReLU(hidden, hidden, 3, stride=stride, groups=hidden,
+                        act=nn.ReLU6),
+            nn.Conv2D(hidden, oup, 1, bias_attr=False),
+            nn.BatchNorm2D(oup),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        return x + self.conv(x) if self.use_res else self.conv(x)
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = _make_divisible(32 * scale)
+        last = _make_divisible(1280 * max(1.0, scale))
+        layers = [_ConvBNReLU(3, in_c, 3, stride=2, act=nn.ReLU6)]
+        for t, c, n, s in cfg:
+            out = _make_divisible(c * scale)
+            for i in range(n):
+                layers.append(_InvertedResidual(in_c, out,
+                                                s if i == 0 else 1, t))
+                in_c = out
+        layers.append(_ConvBNReLU(in_c, last, 1, act=nn.ReLU6))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.2), nn.Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+# -- V3 ---------------------------------------------------------------------
+
+
+class _SEBlock(nn.Layer):
+    def __init__(self, c, r=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(c, _make_divisible(c // r), 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(_make_divisible(c // r), c, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _V3Block(nn.Layer):
+    def __init__(self, inp, hidden, out, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == out
+        layers = []
+        if hidden != inp:
+            layers.append(_ConvBNReLU(inp, hidden, 1, act=act))
+        layers.append(_ConvBNReLU(hidden, hidden, k, stride=stride,
+                                  groups=hidden, act=act))
+        if use_se:
+            layers.append(_SEBlock(hidden))
+        layers += [nn.Conv2D(hidden, out, 1, bias_attr=False),
+                   nn.BatchNorm2D(out)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        return x + self.block(x) if self.use_res else self.block(x)
+
+
+_V3_SMALL = [  # k, exp, out, se, act, stride
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hard", 2),
+    (5, 240, 40, True, "hard", 1), (5, 240, 40, True, "hard", 1),
+    (5, 120, 48, True, "hard", 1), (5, 144, 48, True, "hard", 1),
+    (5, 288, 96, True, "hard", 2), (5, 576, 96, True, "hard", 1),
+    (5, 576, 96, True, "hard", 1)]
+
+_V3_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hard", 2), (3, 200, 80, False, "hard", 1),
+    (3, 184, 80, False, "hard", 1), (3, 184, 80, False, "hard", 1),
+    (3, 480, 112, True, "hard", 1), (3, 672, 112, True, "hard", 1),
+    (5, 672, 160, True, "hard", 2), (5, 960, 160, True, "hard", 1),
+    (5, 960, 160, True, "hard", 1)]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_c, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        layers = [_ConvBNReLU(3, in_c, 3, stride=2, act=nn.Hardswish)]
+        for k, exp, out, se, act, stride in cfg:
+            a = nn.ReLU if act == "relu" else nn.Hardswish
+            layers.append(_V3Block(in_c, _make_divisible(exp * scale),
+                                   _make_divisible(out * scale), k, stride,
+                                   se, a))
+            in_c = _make_divisible(out * scale)
+        last_exp = _make_divisible(cfg[-1][1] * scale)
+        layers.append(_ConvBNReLU(in_c, last_exp, 1, act=nn.Hardswish))
+        self.features = nn.Sequential(*layers)
+        self.lastconv_c = last_exp
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_exp, last_c), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 1024, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 1280, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Large(scale=scale, **kwargs)

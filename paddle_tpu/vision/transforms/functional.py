@@ -1,0 +1,252 @@
+"""Functional image transforms (reference:
+python/paddle/vision/transforms/functional.py + functional_cv2.py).
+
+Images are numpy arrays HWC uint8/float (the "cv2 backend" of the
+reference) or paddle Tensors CHW after `to_tensor`. PIL images are accepted
+and converted if PIL happens to be importable; no hard dependency.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "to_tensor", "normalize", "resize", "pad", "crop", "center_crop",
+    "hflip", "vflip", "rotate", "to_grayscale", "adjust_brightness",
+    "adjust_contrast", "adjust_saturation", "adjust_hue", "erase",
+]
+
+
+def _as_hwc(img) -> np.ndarray:
+    if isinstance(img, Tensor):
+        arr = img.numpy()
+        if arr.ndim == 3 and arr.shape[0] in (1, 3, 4):
+            arr = np.transpose(arr, (1, 2, 0))
+        return arr
+    if "PIL" in str(type(img)):
+        return np.asarray(img)
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def to_tensor(pic, data_format="CHW") -> Tensor:
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference to_tensor)."""
+    arr = _as_hwc(pic)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.dtype == np.uint8:
+        arr = arr.astype("float32") / 255.0
+    else:
+        arr = arr.astype("float32")
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(arr)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    mean = np.asarray(mean, dtype="float32")
+    std = np.asarray(std, dtype="float32")
+    if isinstance(img, Tensor):
+        shape = ([-1, 1, 1] if data_format == "CHW" else [1, 1, -1])
+        from paddle_tpu import tensor as T
+        m = Tensor(mean.reshape(shape))
+        s = Tensor(std.reshape(shape))
+        return T.divide(T.subtract(img, m), s)
+    arr = _as_hwc(img).astype("float32")
+    return (arr - mean.reshape(1, 1, -1)) / std.reshape(1, 1, -1)
+
+
+def _interp_resize(arr: np.ndarray, h: int, w: int, interpolation: str):
+    """Resize HWC numpy via jax.image (bilinear/nearest)."""
+    import jax
+    import jax.numpy as jnp
+    method = {"bilinear": "linear", "nearest": "nearest",
+              "bicubic": "cubic", "linear": "linear",
+              "cubic": "cubic"}.get(interpolation, "linear")
+    out = jax.image.resize(jnp.asarray(arr, jnp.float32),
+                           (h, w, arr.shape[2]), method=method)
+    out = np.asarray(out)
+    if arr.dtype == np.uint8:
+        out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out
+
+
+def resize(img, size, interpolation="bilinear"):
+    tensor_in = isinstance(img, Tensor)
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        # short side -> size, keep aspect (reference semantics)
+        if h <= w:
+            nh, nw = size, max(1, int(round(w * size / h)))
+        else:
+            nh, nw = max(1, int(round(h * size / w))), size
+    else:
+        nh, nw = size
+    out = _interp_resize(arr, nh, nw, interpolation)
+    return to_tensor(out) if tensor_in else out
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    tensor_in = isinstance(img, Tensor)
+    arr = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kwargs = {"constant_values": fill} if mode == "constant" else {}
+    out = np.pad(arr, ((pt, pb), (pl, pr), (0, 0)), mode=mode, **kwargs)
+    return to_tensor(out) if tensor_in else out
+
+
+def crop(img, top, left, height, width):
+    tensor_in = isinstance(img, Tensor)
+    arr = _as_hwc(img)
+    out = arr[top:top + height, left:left + width]
+    return to_tensor(out) if tensor_in else out
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = max(0, (h - th) // 2)
+    left = max(0, (w - tw) // 2)
+    return crop(img, top, left, th, tw)
+
+
+def hflip(img):
+    tensor_in = isinstance(img, Tensor)
+    out = _as_hwc(img)[:, ::-1]
+    return to_tensor(out) if tensor_in else np.ascontiguousarray(out)
+
+
+def vflip(img):
+    tensor_in = isinstance(img, Tensor)
+    out = _as_hwc(img)[::-1]
+    return to_tensor(out) if tensor_in else np.ascontiguousarray(out)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate by angle degrees counter-clockwise (reference functional
+    rotate; nearest-neighbour grid sample)."""
+    tensor_in = isinstance(img, Tensor)
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    theta = np.deg2rad(angle)
+    cos, sin = np.cos(theta), np.sin(theta)
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else center
+    if expand:
+        nh = int(abs(h * cos) + abs(w * sin) + 0.5)
+        nw = int(abs(w * cos) + abs(h * sin) + 0.5)
+    else:
+        nh, nw = h, w
+    oy, ox = (nh - 1) / 2.0, (nw - 1) / 2.0
+    yy, xx = np.meshgrid(np.arange(nh), np.arange(nw), indexing="ij")
+    # inverse map output -> input
+    sy = (yy - oy) * cos - (xx - ox) * sin + cy
+    sx = (yy - oy) * sin + (xx - ox) * cos + cx
+    syi = np.round(sy).astype(int)
+    sxi = np.round(sx).astype(int)
+    valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
+    out = np.full((nh, nw, arr.shape[2]), fill, dtype=arr.dtype)
+    out[valid] = arr[syi[valid], sxi[valid]]
+    return to_tensor(out) if tensor_in else out
+
+
+_GRAY_W = np.array([0.299, 0.587, 0.114], dtype="float32")
+
+
+def to_grayscale(img, num_output_channels=1):
+    tensor_in = isinstance(img, Tensor)
+    arr = _as_hwc(img)
+    gray = (arr[..., :3].astype("float32") @ _GRAY_W)
+    if arr.dtype == np.uint8:
+        gray = np.clip(np.round(gray), 0, 255).astype(np.uint8)
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return to_tensor(out) if tensor_in else out
+
+
+def _blend(a, b, factor, dtype):
+    out = a.astype("float32") * factor + b.astype("float32") * (1 - factor)
+    if dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    return out
+
+
+def adjust_brightness(img, brightness_factor):
+    tensor_in = isinstance(img, Tensor)
+    arr = _as_hwc(img)
+    out = _blend(arr, np.zeros_like(arr), brightness_factor, arr.dtype)
+    return to_tensor(out) if tensor_in else out
+
+
+def adjust_contrast(img, contrast_factor):
+    tensor_in = isinstance(img, Tensor)
+    arr = _as_hwc(img)
+    mean = arr[..., :3].astype("float32").mean()
+    out = _blend(arr, np.full_like(arr, mean), contrast_factor, arr.dtype)
+    return to_tensor(out) if tensor_in else out
+
+
+def adjust_saturation(img, saturation_factor):
+    tensor_in = isinstance(img, Tensor)
+    arr = _as_hwc(img)
+    gray = _as_hwc(to_grayscale(arr, 3))
+    out = _blend(arr, gray, saturation_factor, arr.dtype)
+    return to_tensor(out) if tensor_in else out
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue in HSV space; hue_factor in [-0.5, 0.5]."""
+    assert -0.5 <= hue_factor <= 0.5
+    tensor_in = isinstance(img, Tensor)
+    arr = _as_hwc(img)
+    dtype = arr.dtype
+    x = arr[..., :3].astype("float32") / (255.0 if dtype == np.uint8 else 1.0)
+    mx = x.max(-1)
+    mn = x.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    h = np.where(mx == r, ((g - b) / diff) % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4))
+    h = (h / 6.0 + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    v = mx
+    i = np.floor(h * 6)
+    f = h * 6 - i
+    p, q, t = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
+    i = i.astype(int) % 6
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    if dtype == np.uint8:
+        out = np.clip(np.round(out * 255), 0, 255).astype(np.uint8)
+    if arr.shape[-1] > 3:
+        out = np.concatenate([out, arr[..., 3:]], axis=-1)
+    return to_tensor(out) if tensor_in else out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    if isinstance(img, Tensor):
+        arr = img.numpy().copy()
+        arr[..., i:i + h, j:j + w] = v
+        return Tensor(arr)
+    arr = img if inplace else img.copy()
+    arr[i:i + h, j:j + w] = v
+    return arr

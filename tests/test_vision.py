@@ -1,0 +1,214 @@
+"""Vision package: transforms, datasets, models, ops
+(reference test pattern: test/legacy_test/test_transforms.py,
+test_vision_models.py, test_ops_roi_align.py, test_nms_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms, datasets, models, ops
+
+
+def _img(h=32, w=48, c=3, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 255, (h, w, c)).astype(np.uint8)
+
+
+class TestTransforms:
+    def test_to_tensor_normalize(self):
+        img = _img()
+        t = transforms.to_tensor(img)
+        assert t.shape == [3, 32, 48]
+        assert float(t.numpy().max()) <= 1.0
+        n = transforms.normalize(t, [0.5, 0.5, 0.5], [0.5, 0.5, 0.5])
+        assert abs(float(n.numpy().mean())) < 1.0
+
+    def test_resize_and_crops(self):
+        img = _img()
+        r = transforms.resize(img, (16, 24))
+        assert r.shape == (16, 24, 3)
+        r2 = transforms.resize(img, 16)  # short side
+        assert min(r2.shape[:2]) == 16
+        c = transforms.center_crop(img, 20)
+        assert c.shape == (20, 20, 3)
+        cr = transforms.crop(img, 2, 3, 10, 12)
+        np.testing.assert_array_equal(cr, img[2:12, 3:15])
+
+    def test_flips_pad_rotate_gray(self):
+        img = _img()
+        np.testing.assert_array_equal(transforms.hflip(img), img[:, ::-1])
+        np.testing.assert_array_equal(transforms.vflip(img), img[::-1])
+        p = transforms.pad(img, 2)
+        assert p.shape == (36, 52, 3)
+        rot = transforms.rotate(img, 90)
+        assert rot.shape == img.shape
+        g = transforms.to_grayscale(img)
+        assert g.shape == (32, 48, 1)
+
+    def test_color_ops(self):
+        img = _img()
+        b = transforms.adjust_brightness(img, 1.5)
+        assert b.mean() >= img.mean()
+        transforms.adjust_contrast(img, 0.7)
+        transforms.adjust_saturation(img, 1.2)
+        h = transforms.adjust_hue(img, 0.1)
+        assert h.shape == img.shape
+
+    def test_compose_pipeline(self):
+        tf = transforms.Compose([
+            transforms.Resize(40),
+            transforms.RandomCrop(32),
+            transforms.RandomHorizontalFlip(0.5),
+            transforms.ColorJitter(0.1, 0.1, 0.1, 0.1),
+            transforms.ToTensor(),
+            transforms.Normalize([0.5] * 3, [0.5] * 3),
+        ])
+        out = tf(_img(64, 64))
+        assert out.shape == [3, 32, 32]
+
+    def test_random_transforms_shapes(self):
+        img = _img(64, 64)
+        assert transforms.RandomResizedCrop(32)(img).shape == (32, 32, 3)
+        assert transforms.RandomRotation(15)(img).shape == img.shape
+        t = transforms.ToTensor()(img)
+        e = transforms.RandomErasing(prob=1.0)(t)
+        assert e.shape == t.shape
+
+
+class TestDatasets:
+    def test_fake_data_learnable(self):
+        ds = datasets.FakeData(num_samples=64, image_shape=(1, 8, 8),
+                               num_classes=2)
+        img, label = ds[0]
+        assert img.shape == (1, 8, 8) and label in (0, 1)
+        # deterministic
+        img2, label2 = ds[0]
+        np.testing.assert_array_equal(img, img2)
+
+    def test_mnist_idx_files(self, tmp_path):
+        import gzip
+        import struct
+        # write 4 tiny idx images/labels
+        imgs = np.random.RandomState(0).randint(
+            0, 255, (4, 28, 28)).astype(np.uint8)
+        labels = np.array([0, 1, 2, 3], dtype=np.uint8)
+        ip = tmp_path / "imgs.gz"
+        lp = tmp_path / "labels.gz"
+        with gzip.open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 4, 28, 28))
+            f.write(imgs.tobytes())
+        with gzip.open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, 4))
+            f.write(labels.tobytes())
+        ds = datasets.MNIST(image_path=str(ip), label_path=str(lp))
+        assert len(ds) == 4
+        img, lab = ds[2]
+        assert img.shape == (28, 28, 1) and lab == 2
+
+    def test_dataset_folder(self, tmp_path):
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                np.save(d / f"{i}.npy", _img(8, 8))
+        ds = datasets.DatasetFolder(str(tmp_path))
+        assert len(ds) == 6
+        assert ds.classes == ["cat", "dog"]
+        img, label = ds[5]
+        assert img.shape == (8, 8, 3) and label == 1
+
+    def test_missing_file_raises(self):
+        with pytest.raises(FileNotFoundError):
+            datasets.MNIST(image_path="/nonexistent", label_path="/none")
+
+
+class TestModels:
+    @pytest.mark.parametrize("ctor,ishape", [
+        (lambda: models.LeNet(num_classes=10), (2, 1, 28, 28)),
+        (lambda: models.resnet18(num_classes=7), (2, 3, 64, 64)),
+        (lambda: models.mobilenet_v2(scale=0.35, num_classes=7),
+         (2, 3, 64, 64)),
+        (lambda: models.mobilenet_v3_small(scale=0.5, num_classes=7),
+         (2, 3, 64, 64)),
+    ])
+    def test_forward_shapes(self, ctor, ishape):
+        net = ctor()
+        net.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(*ishape).astype("float32"))
+        out = net(x)
+        assert out.shape == [2, net.num_classes if net.num_classes > 0 else 7]
+
+    def test_resnet50_bottleneck(self):
+        net = models.resnet50(num_classes=5)
+        net.eval()
+        x = paddle.to_tensor(np.zeros((1, 3, 64, 64), "float32"))
+        assert net(x).shape == [1, 5]
+
+    def test_vit_forward(self):
+        net = models.VisionTransformer(image_size=32, patch_size=8,
+                                       embed_dim=64, depth=2, num_heads=4,
+                                       num_classes=5)
+        net.eval()
+        x = paddle.to_tensor(np.zeros((2, 3, 32, 32), "float32"))
+        assert net(x).shape == [2, 5]
+
+    def test_lenet_trains(self):
+        ds = datasets.FakeData(num_samples=64, image_shape=(1, 28, 28),
+                               num_classes=4)
+        model = paddle.Model(models.LeNet(num_classes=4))
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=model.parameters())
+        model.prepare(opt, paddle.nn.CrossEntropyLoss(),
+                      paddle.metric.Accuracy())
+        hist = model.fit(ds, epochs=2, batch_size=16, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+
+    def test_pretrained_raises(self):
+        with pytest.raises(RuntimeError):
+            models.resnet18(pretrained=True)
+
+
+class TestOps:
+    def test_nms(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                         dtype="float32")
+        scores = np.array([0.9, 0.8, 0.7], dtype="float32")
+        keep = ops.nms(paddle.to_tensor(boxes), 0.5,
+                       paddle.to_tensor(scores))
+        np.testing.assert_array_equal(keep.numpy(), [0, 2])
+
+    def test_nms_categories(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], dtype="float32")
+        scores = np.array([0.9, 0.8], dtype="float32")
+        cats = np.array([0, 1])
+        keep = ops.nms(paddle.to_tensor(boxes), 0.5,
+                       paddle.to_tensor(scores),
+                       category_idxs=paddle.to_tensor(cats),
+                       categories=[0, 1])
+        assert len(keep.numpy()) == 2  # different categories: both kept
+
+    def test_roi_align_shape_and_value(self):
+        x = paddle.to_tensor(
+            np.arange(1 * 1 * 8 * 8, dtype="float32").reshape(1, 1, 8, 8))
+        boxes = paddle.to_tensor(
+            np.array([[0, 0, 7, 7]], dtype="float32"))
+        out = ops.roi_align(x, boxes, paddle.to_tensor(np.array([1])), 2)
+        assert out.shape == [1, 1, 2, 2]
+        v = out.numpy()
+        assert v[0, 0, 0, 0] < v[0, 0, 1, 1]  # increasing ramp preserved
+
+    def test_roi_pool_shape(self):
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 3, 16, 16).astype("float32"))
+        boxes = paddle.to_tensor(np.array(
+            [[0, 0, 8, 8], [4, 4, 12, 12], [0, 0, 15, 15]], dtype="float32"))
+        out = ops.roi_pool(x, boxes, paddle.to_tensor(np.array([2, 1])), 4)
+        assert out.shape == [3, 3, 4, 4]
+
+    def test_box_iou(self):
+        a = paddle.to_tensor(np.array([[0, 0, 10, 10]], dtype="float32"))
+        b = paddle.to_tensor(np.array([[0, 0, 10, 10], [5, 5, 15, 15]],
+                                      dtype="float32"))
+        iou = ops.box_iou(a, b).numpy()
+        assert iou[0, 0] == pytest.approx(1.0)
+        assert iou[0, 1] == pytest.approx(25.0 / 175.0)
