@@ -229,7 +229,7 @@ def adjust_hue(img, hue_factor):
     i = np.floor(h * 6)
     f = h * 6 - i
     p, q, t = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
-    i = i.astype(int) % 6
+    i = (i.astype(int) % 6)[..., None]
     out = np.select(
         [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
         [np.stack([v, t, p], -1), np.stack([q, v, p], -1),

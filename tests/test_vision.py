@@ -153,6 +153,8 @@ class TestModels:
         assert net(x).shape == [2, 5]
 
     def test_lenet_trains(self):
+        paddle.seed(0)
+        np.random.seed(0)
         ds = datasets.FakeData(num_samples=64, image_shape=(1, 28, 28),
                                num_classes=4)
         model = paddle.Model(models.LeNet(num_classes=4))
