@@ -1,0 +1,37 @@
+"""paddle_tpu.distribution — probability distributions, transforms, KL.
+
+TPU-native rebuild of the reference probability library (reference:
+python/paddle/distribution/__init__.py — 25 distributions, the transform
+family, and the KL registry). All math is pure-jax through the eager op
+dispatcher: differentiable on the tape, traceable under jit.
+"""
+from .distribution import Distribution, ExponentialFamily
+from .continuous import (Beta, Cauchy, Chi2, ContinuousBernoulli,
+                         Exponential, Gamma, Gumbel, Laplace, LogNormal,
+                         Normal, StudentT, Uniform)
+from .discrete import (Bernoulli, Binomial, Categorical, Geometric,
+                       Multinomial, Poisson)
+from .multivariate import Dirichlet, LKJCholesky, MultivariateNormal
+from .transform import (AbsTransform, AffineTransform, ChainTransform,
+                        ExpTransform, IndependentTransform, PowerTransform,
+                        ReshapeTransform, SigmoidTransform,
+                        SoftmaxTransform, StackTransform,
+                        StickBreakingTransform, TanhTransform, Transform)
+from .transformed_distribution import Independent, TransformedDistribution
+from .kl import kl_divergence, register_kl
+
+__all__ = [
+    "Distribution", "ExponentialFamily",
+    "Beta", "Cauchy", "Chi2", "ContinuousBernoulli", "Exponential",
+    "Gamma", "Gumbel", "Laplace", "LogNormal", "Normal", "StudentT",
+    "Uniform",
+    "Bernoulli", "Binomial", "Categorical", "Geometric", "Multinomial",
+    "Poisson",
+    "Dirichlet", "LKJCholesky", "MultivariateNormal",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "TransformedDistribution", "Independent",
+    "kl_divergence", "register_kl",
+]

@@ -1,0 +1,66 @@
+"""Shared helpers for paddle_tpu.distribution.
+
+TPU-native rebuild of the reference probability library
+(reference: python/paddle/distribution/ — ~8k LoC over 25 files). Parameters
+may be python scalars, numpy arrays, or paddle_tpu Tensors; distribution
+math is written as pure jax functions registered once through the eager op
+registry (paddle_tpu.core.dispatch.OpDef) so log_prob/entropy/rsample are
+differentiable w.r.t. Tensor parameters on the eager tape and traceable
+under jit — replacing the reference's per-method paddle-op compositions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import OpDef, dispatch
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core.random import next_key
+
+EULER_GAMMA = 0.57721566490153286060
+
+
+def op(name, fn, *args):
+    """Run pure jax fn through the eager dispatcher (autograd + AMP + jit
+    compatible). A fresh OpDef per call: fns routinely close over
+    sample-shape/key state, so caching by name would replay stale
+    closures."""
+    return dispatch(OpDef("distribution." + name, fn), args, {})
+
+
+def arr(x, dtype=None):
+    """Raw jnp array view of a parameter (loses autograd tracking; use for
+    shape/static inspection, sampling noise, and non-differentiable paths)."""
+    if isinstance(x, Tensor):
+        a = x._value
+    else:
+        a = jnp.asarray(x)
+    if jnp.issubdtype(a.dtype, jnp.integer) or a.dtype == jnp.bool_:
+        a = a.astype(jnp.float32)
+    if dtype is not None:
+        a = a.astype(dtype)
+    return a
+
+
+def value_arr(x):
+    """Array for an observed value (keeps Tensor for autograd dispatch)."""
+    return x if isinstance(x, Tensor) else jnp.asarray(arr(x))
+
+
+def broadcast_shapes(*shapes):
+    return jnp.broadcast_shapes(*shapes)
+
+
+def param_shape(*params):
+    return jnp.broadcast_shapes(*[tuple(np.shape(arr(p))) for p in params])
+
+
+def key():
+    return next_key()
+
+
+def sample_shape(shape, batch_shape, event_shape=()):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return tuple(shape) + tuple(batch_shape) + tuple(event_shape)

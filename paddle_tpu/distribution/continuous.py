@@ -1,0 +1,569 @@
+"""Continuous distributions.
+
+Reference: python/paddle/distribution/{normal,uniform,beta,cauchy,
+continuous_bernoulli,exponential,gamma,gumbel,laplace,lognormal}.py and
+chi2/student_t. Math rebuilt as pure jax functions over lax/jnp; every
+differentiable method goes through the eager dispatcher (see _util.op).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from paddle_tpu.core.tensor import Tensor
+from . import _util as U
+from .distribution import Distribution, ExponentialFamily
+
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+class Normal(ExponentialFamily):
+    """Normal(loc, scale). Reference: distribution/normal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = loc, scale
+        super().__init__(U.param_shape(loc, scale))
+
+    @property
+    def mean(self):
+        return U.op("normal_mean", lambda l, s: jnp.broadcast_to(
+            l, jnp.broadcast_shapes(jnp.shape(l), jnp.shape(s))),
+            self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return U.op("normal_var", lambda l, s: jnp.broadcast_to(
+            s * s, jnp.broadcast_shapes(jnp.shape(l), jnp.shape(s))),
+            self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(U.key(), self._extend_shape(shape),
+                                U.arr(self.loc).dtype)
+        return U.op("normal_rsample", lambda l, s, e: l + s * e,
+                    self.loc, self.scale, eps)
+
+    def log_prob(self, value):
+        return U.op(
+            "normal_log_prob",
+            lambda v, l, s: -((v - l) ** 2) / (2 * s * s) - jnp.log(s)
+            - _HALF_LOG_2PI,
+            U.value_arr(value), self.loc, self.scale)
+
+    def entropy(self):
+        return U.op(
+            "normal_entropy",
+            lambda l, s: jnp.broadcast_to(0.5 + _HALF_LOG_2PI + jnp.log(s),
+                                          jnp.broadcast_shapes(
+                                              jnp.shape(l), jnp.shape(s))),
+            self.loc, self.scale)
+
+    def cdf(self, value):
+        return U.op("normal_cdf",
+                    lambda v, l, s: jsp.ndtr((v - l) / s),
+                    U.value_arr(value), self.loc, self.scale)
+
+    def icdf(self, value):
+        return U.op("normal_icdf",
+                    lambda v, l, s: l + s * jsp.ndtri(v),
+                    U.value_arr(value), self.loc, self.scale)
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class Uniform(Distribution):
+    """Uniform(low, high). Reference: distribution/uniform.py."""
+
+    def __init__(self, low, high, name=None):
+        self.low, self.high = low, high
+        super().__init__(U.param_shape(low, high))
+
+    @property
+    def mean(self):
+        return U.op("uniform_mean", lambda a, b: (a + b) / 2,
+                    self.low, self.high)
+
+    @property
+    def variance(self):
+        return U.op("uniform_var", lambda a, b: (b - a) ** 2 / 12,
+                    self.low, self.high)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(U.key(), self._extend_shape(shape),
+                               U.arr(self.low).dtype)
+        return U.op("uniform_rsample", lambda a, b, u: a + (b - a) * u,
+                    self.low, self.high, u)
+
+    def log_prob(self, value):
+        def f(v, a, b):
+            inside = (v >= a) & (v < b)
+            return jnp.where(inside, -jnp.log(b - a), -jnp.inf)
+        return U.op("uniform_log_prob", f, U.value_arr(value),
+                    self.low, self.high)
+
+    def entropy(self):
+        return U.op("uniform_entropy", lambda a, b: jnp.log(b - a),
+                    self.low, self.high)
+
+    def cdf(self, value):
+        return U.op("uniform_cdf",
+                    lambda v, a, b: jnp.clip((v - a) / (b - a), 0.0, 1.0),
+                    U.value_arr(value), self.low, self.high)
+
+
+class Beta(ExponentialFamily):
+    """Beta(alpha, beta). Reference: distribution/beta.py."""
+
+    def __init__(self, alpha, beta):
+        self.alpha, self.beta = alpha, beta
+        super().__init__(U.param_shape(alpha, beta))
+
+    @property
+    def mean(self):
+        return U.op("beta_mean", lambda a, b: a / (a + b),
+                    self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        return U.op("beta_var",
+                    lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                    self.alpha, self.beta)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        a, b = jnp.broadcast_to(U.arr(self.alpha), shp), \
+            jnp.broadcast_to(U.arr(self.beta), shp)
+        k1, k2 = jax.random.split(U.key())
+        ga = jax.random.gamma(k1, a)
+        gb = jax.random.gamma(k2, b)
+        return Tensor(ga / (ga + gb))
+
+    def log_prob(self, value):
+        return U.op(
+            "beta_log_prob",
+            lambda v, a, b: jsp.xlogy(a - 1, v) + jsp.xlog1py(b - 1, -v)
+            - jsp.betaln(a, b),
+            U.value_arr(value), self.alpha, self.beta)
+
+    def entropy(self):
+        def f(a, b):
+            tot = a + b
+            return (jsp.betaln(a, b) - (a - 1) * jsp.digamma(a)
+                    - (b - 1) * jsp.digamma(b)
+                    + (tot - 2) * jsp.digamma(tot))
+        return U.op("beta_entropy", f, self.alpha, self.beta)
+
+
+class Cauchy(Distribution):
+    """Cauchy(loc, scale). Reference: distribution/cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = loc, scale
+        super().__init__(U.param_shape(loc, scale))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev")
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(U.key(), self._extend_shape(shape),
+                               U.arr(self.loc).dtype, 1e-7, 1 - 1e-7)
+        return U.op("cauchy_rsample",
+                    lambda l, s, u: l + s * jnp.tan(math.pi * (u - 0.5)),
+                    self.loc, self.scale, u)
+
+    def log_prob(self, value):
+        return U.op(
+            "cauchy_log_prob",
+            lambda v, l, s: -math.log(math.pi) - jnp.log(s)
+            - jnp.log1p(((v - l) / s) ** 2),
+            U.value_arr(value), self.loc, self.scale)
+
+    def entropy(self):
+        return U.op("cauchy_entropy",
+                    lambda l, s: jnp.broadcast_to(
+                        jnp.log(4 * math.pi * s),
+                        jnp.broadcast_shapes(jnp.shape(l), jnp.shape(s))),
+                    self.loc, self.scale)
+
+    def cdf(self, value):
+        return U.op(
+            "cauchy_cdf",
+            lambda v, l, s: jnp.arctan((v - l) / s) / math.pi + 0.5,
+            U.value_arr(value), self.loc, self.scale)
+
+
+class Exponential(ExponentialFamily):
+    """Exponential(rate). Reference: distribution/exponential.py."""
+
+    def __init__(self, rate):
+        self.rate = rate
+        super().__init__(U.param_shape(rate))
+
+    @property
+    def mean(self):
+        return U.op("exp_mean", lambda r: 1.0 / r, self.rate)
+
+    @property
+    def variance(self):
+        return U.op("exp_var", lambda r: 1.0 / (r * r), self.rate)
+
+    def rsample(self, shape=()):
+        e = jax.random.exponential(U.key(), self._extend_shape(shape),
+                                   U.arr(self.rate).dtype)
+        return U.op("exp_rsample", lambda r, e: e / r, self.rate, e)
+
+    def log_prob(self, value):
+        return U.op("exp_log_prob",
+                    lambda v, r: jnp.where(v >= 0, jnp.log(r) - r * v,
+                                           -jnp.inf),
+                    U.value_arr(value), self.rate)
+
+    def entropy(self):
+        return U.op("exp_entropy", lambda r: 1.0 - jnp.log(r), self.rate)
+
+    def cdf(self, value):
+        return U.op("exp_cdf",
+                    lambda v, r: jnp.clip(1 - jnp.exp(-r * v), 0.0),
+                    U.value_arr(value), self.rate)
+
+
+class Gamma(ExponentialFamily):
+    """Gamma(concentration, rate). Reference: distribution/gamma.py."""
+
+    def __init__(self, concentration, rate):
+        self.concentration, self.rate = concentration, rate
+        super().__init__(U.param_shape(concentration, rate))
+
+    @property
+    def mean(self):
+        return U.op("gamma_mean", lambda a, r: a / r,
+                    self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        return U.op("gamma_var", lambda a, r: a / (r * r),
+                    self.concentration, self.rate)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        k = U.key()
+        # jax.random.gamma is differentiable in its shape parameter
+        # (implicit reparameterization), matching the reference's rsample.
+        return U.op(
+            "gamma_rsample",
+            lambda a, r: jax.random.gamma(
+                k, jnp.broadcast_to(a, shp)) / r,
+            self.concentration, self.rate)
+
+    def log_prob(self, value):
+        return U.op(
+            "gamma_log_prob",
+            lambda v, a, r: jsp.xlogy(a, r) + jsp.xlogy(a - 1, v) - r * v
+            - jsp.gammaln(a),
+            U.value_arr(value), self.concentration, self.rate)
+
+    def entropy(self):
+        return U.op(
+            "gamma_entropy",
+            lambda a, r: a - jnp.log(r) + jsp.gammaln(a)
+            + (1 - a) * jsp.digamma(a),
+            self.concentration, self.rate)
+
+
+class Chi2(Gamma):
+    """Chi2(df) = Gamma(df/2, 1/2). Reference: distribution/chi2.py."""
+
+    def __init__(self, df):
+        self.df = df
+        super().__init__(
+            U.op("chi2_conc", lambda d: d / 2.0, df),
+            0.5)
+
+
+class Gumbel(Distribution):
+    """Gumbel(loc, scale). Reference: distribution/gumbel.py."""
+
+    def __init__(self, loc, scale):
+        self.loc, self.scale = loc, scale
+        super().__init__(U.param_shape(loc, scale))
+
+    @property
+    def mean(self):
+        return U.op("gumbel_mean",
+                    lambda l, s: l + s * U.EULER_GAMMA, self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return U.op("gumbel_var",
+                    lambda l, s: jnp.broadcast_to(
+                        (math.pi ** 2 / 6) * s * s,
+                        jnp.broadcast_shapes(jnp.shape(l), jnp.shape(s))),
+                    self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(U.key(), self._extend_shape(shape),
+                               U.arr(self.loc).dtype, 1e-7, 1 - 1e-7)
+        return U.op("gumbel_rsample",
+                    lambda l, s, u: l - s * jnp.log(-jnp.log(u)),
+                    self.loc, self.scale, u)
+
+    def log_prob(self, value):
+        def f(v, l, s):
+            z = (v - l) / s
+            return -z - jnp.exp(-z) - jnp.log(s)
+        return U.op("gumbel_log_prob", f, U.value_arr(value),
+                    self.loc, self.scale)
+
+    def entropy(self):
+        return U.op("gumbel_entropy",
+                    lambda l, s: jnp.broadcast_to(
+                        jnp.log(s) + 1 + U.EULER_GAMMA,
+                        jnp.broadcast_shapes(jnp.shape(l), jnp.shape(s))),
+                    self.loc, self.scale)
+
+    def cdf(self, value):
+        return U.op("gumbel_cdf",
+                    lambda v, l, s: jnp.exp(-jnp.exp(-(v - l) / s)),
+                    U.value_arr(value), self.loc, self.scale)
+
+
+class Laplace(Distribution):
+    """Laplace(loc, scale). Reference: distribution/laplace.py."""
+
+    def __init__(self, loc, scale):
+        self.loc, self.scale = loc, scale
+        super().__init__(U.param_shape(loc, scale))
+
+    @property
+    def mean(self):
+        return U.op("laplace_mean", lambda l, s: jnp.broadcast_to(
+            l, jnp.broadcast_shapes(jnp.shape(l), jnp.shape(s))),
+            self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return U.op("laplace_var", lambda l, s: jnp.broadcast_to(
+            2 * s * s, jnp.broadcast_shapes(jnp.shape(l), jnp.shape(s))),
+            self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(U.key(), self._extend_shape(shape),
+                               U.arr(self.loc).dtype, 1e-7, 1 - 1e-7) - 0.5
+        return U.op(
+            "laplace_rsample",
+            lambda l, s, u: l - s * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u)),
+            self.loc, self.scale, u)
+
+    def log_prob(self, value):
+        return U.op(
+            "laplace_log_prob",
+            lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+            U.value_arr(value), self.loc, self.scale)
+
+    def entropy(self):
+        return U.op("laplace_entropy",
+                    lambda l, s: jnp.broadcast_to(
+                        1 + jnp.log(2 * s),
+                        jnp.broadcast_shapes(jnp.shape(l), jnp.shape(s))),
+                    self.loc, self.scale)
+
+    def cdf(self, value):
+        def f(v, l, s):
+            z = (v - l) / s
+            return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+        return U.op("laplace_cdf", f, U.value_arr(value),
+                    self.loc, self.scale)
+
+    def icdf(self, value):
+        def f(p, l, s):
+            t = p - 0.5
+            return l - s * jnp.sign(t) * jnp.log1p(-2 * jnp.abs(t))
+        return U.op("laplace_icdf", f, U.value_arr(value),
+                    self.loc, self.scale)
+
+
+class LogNormal(Distribution):
+    """LogNormal(loc, scale) = exp(Normal). Reference: lognormal.py."""
+
+    def __init__(self, loc, scale):
+        self.loc, self.scale = loc, scale
+        self._base = Normal(loc, scale)
+        super().__init__(U.param_shape(loc, scale))
+
+    @property
+    def mean(self):
+        return U.op("lognormal_mean",
+                    lambda l, s: jnp.exp(l + s * s / 2),
+                    self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return U.op(
+            "lognormal_var",
+            lambda l, s: jnp.expm1(s * s) * jnp.exp(2 * l + s * s),
+            self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        from paddle_tpu import tensor as T
+        return T.exp(self._base.rsample(shape))
+
+    def log_prob(self, value):
+        return U.op(
+            "lognormal_log_prob",
+            lambda v, l, s: -((jnp.log(v) - l) ** 2) / (2 * s * s)
+            - jnp.log(s * v) - _HALF_LOG_2PI,
+            U.value_arr(value), self.loc, self.scale)
+
+    def entropy(self):
+        return U.op("lognormal_entropy",
+                    lambda l, s: 0.5 + _HALF_LOG_2PI + jnp.log(s) + l,
+                    self.loc, self.scale)
+
+
+class StudentT(Distribution):
+    """StudentT(df, loc, scale). Reference: distribution/student_t.py."""
+
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df, self.loc, self.scale = df, loc, scale
+        super().__init__(U.param_shape(df, loc, scale))
+
+    @property
+    def mean(self):
+        return U.op("studentt_mean",
+                    lambda d, l, s: jnp.where(d > 1, l, jnp.nan),
+                    self.df, self.loc, self.scale)
+
+    @property
+    def variance(self):
+        def f(d, l, s):
+            v = jnp.where(d > 2, s * s * d / (d - 2), jnp.inf)
+            return jnp.where(d > 1, v, jnp.nan)
+        return U.op("studentt_var", f, self.df, self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        k = U.key()
+
+        def f(d, l, s):
+            t = jax.random.t(k, jnp.broadcast_to(d, shp))
+            return l + s * t
+        return U.op("studentt_rsample", f, self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, d, l, s):
+            z = (v - l) / s
+            return (jsp.gammaln((d + 1) / 2) - jsp.gammaln(d / 2)
+                    - 0.5 * jnp.log(d * math.pi) - jnp.log(s)
+                    - (d + 1) / 2 * jnp.log1p(z * z / d))
+        return U.op("studentt_log_prob", f, U.value_arr(value),
+                    self.df, self.loc, self.scale)
+
+    def entropy(self):
+        def f(d, l, s):
+            ent = ((d + 1) / 2 * (jsp.digamma((d + 1) / 2)
+                                  - jsp.digamma(d / 2))
+                   + 0.5 * jnp.log(d) + jsp.betaln(d / 2, 0.5) + jnp.log(s))
+            return jnp.broadcast_to(ent, jnp.broadcast_shapes(
+                jnp.shape(d), jnp.shape(l), jnp.shape(s)))
+        return U.op("studentt_entropy", f, self.df, self.loc, self.scale)
+
+
+class ContinuousBernoulli(Distribution):
+    """ContinuousBernoulli(probs). Reference: continuous_bernoulli.py."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = probs
+        self._lims = lims
+        super().__init__(U.param_shape(probs))
+
+    def _cut(self, p):
+        lo, hi = self._lims
+        return jnp.where((p > lo) & (p < hi), lo, p)
+
+    def _log_norm(self, p):
+        # log C(p); C = 2 atanh(1-2p)/(1-2p) for p != 1/2, else 2
+        pc = self._cut(p)
+        x = 1 - 2 * pc
+        out = jnp.log(2 * jnp.abs(jnp.arctanh(x)) / jnp.abs(x))
+        taylor = math.log(2.0) + (4.0 / 3 + 104.0 / 45 * (p - 0.5) ** 2) \
+            * (p - 0.5) ** 2
+        lo, hi = self._lims
+        return jnp.where((p > lo) & (p < hi), taylor, out)
+
+    @property
+    def mean(self):
+        def f(p):
+            pc = self._cut(p)
+            m = pc / (2 * pc - 1) + 1 / (2 * jnp.arctanh(1 - 2 * pc))
+            taylor = 0.5 + (p - 0.5) / 3 + 16.0 / 45 * (p - 0.5) ** 3
+            lo, hi = self._lims
+            return jnp.where((p > lo) & (p < hi), taylor, m)
+        return U.op("cb_mean", f, self.probs)
+
+    @property
+    def variance(self):
+        def f(p):
+            pc = self._cut(p)
+            at = jnp.arctanh(1 - 2 * pc)
+            v = pc * (pc - 1) / (1 - 2 * pc) ** 2 + 1 / (2 * at) ** 2
+            taylor = 1.0 / 12 - (p - 0.5) ** 2 / 15
+            lo, hi = self._lims
+            return jnp.where((p > lo) & (p < hi), taylor, v)
+        return U.op("cb_var", f, self.probs)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(U.key(), self._extend_shape(shape),
+                               U.arr(self.probs).dtype, 1e-6, 1 - 1e-6)
+        return U.op("cb_rsample", lambda p, u: self._icdf_arr(p, u),
+                    self.probs, u)
+
+    def _icdf_arr(self, p, u):
+        pc = self._cut(p)
+        icdf = (jnp.log1p(u * (2 * pc - 1) / (1 - pc))
+                / (jnp.log(pc) - jnp.log1p(-pc)))
+        lo, hi = self._lims
+        return jnp.where((p > lo) & (p < hi), u, icdf)
+
+    def icdf(self, value):
+        return U.op("cb_icdf", lambda p, v: self._icdf_arr(p, v),
+                    self.probs, U.value_arr(value))
+
+    def cdf(self, value):
+        def f(p, v):
+            pc = self._cut(p)
+            c = (pc ** v * (1 - pc) ** (1 - v) + pc - 1) / (2 * pc - 1)
+            lo, hi = self._lims
+            out = jnp.where((p > lo) & (p < hi), v, c)
+            return jnp.clip(out, 0.0, 1.0)
+        return U.op("cb_cdf", f, self.probs, U.value_arr(value))
+
+    def log_prob(self, value):
+        return U.op(
+            "cb_log_prob",
+            lambda p, v: jsp.xlogy(v, p) + jsp.xlog1py(1 - v, -p)
+            + self._log_norm(p),
+            self.probs, U.value_arr(value))
+
+    def entropy(self):
+        def f(p):
+            pc = self._cut(p)
+            at = jnp.arctanh(1 - 2 * pc)
+            m = pc / (2 * pc - 1) + 1 / (2 * at)
+            lo, hi = self._lims
+            taylor_m = 0.5 + (p - 0.5) / 3 + 16.0 / 45 * (p - 0.5) ** 3
+            m = jnp.where((p > lo) & (p < hi), taylor_m, m)
+            return (- jsp.xlogy(m, p) - jsp.xlog1py(1 - m, -p)
+                    - self._log_norm(p))
+        return U.op("cb_entropy", f, self.probs)

@@ -1,0 +1,326 @@
+"""Discrete distributions.
+
+Reference: python/paddle/distribution/{bernoulli,binomial,categorical,
+geometric,multinomial,poisson}.py. Conventions follow the reference:
+Geometric counts failures before first success (pmf p(1-p)^k, k>=0,
+mean 1/p - 1 — geometric.py:111,152); Categorical normalizes logits by
+softmax and supports unnormalized inputs.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from paddle_tpu.core.tensor import Tensor
+from . import _util as U
+from .distribution import Distribution, ExponentialFamily
+
+
+class Bernoulli(ExponentialFamily):
+    """Bernoulli(probs). Reference: distribution/bernoulli.py."""
+
+    def __init__(self, probs, name=None):
+        self.probs = probs
+        super().__init__(U.param_shape(probs))
+
+    @property
+    def logits(self):
+        return U.op("bernoulli_logits",
+                    lambda p: jnp.log(p) - jnp.log1p(-p), self.probs)
+
+    @property
+    def mean(self):
+        return U.op("bernoulli_mean", lambda p: p * 1.0, self.probs)
+
+    @property
+    def variance(self):
+        return U.op("bernoulli_var", lambda p: p * (1 - p), self.probs)
+
+    def sample(self, shape=()):
+        s = jax.random.bernoulli(
+            U.key(), jnp.broadcast_to(U.arr(self.probs),
+                                      self._extend_shape(shape)))
+        return Tensor(s.astype(U.arr(self.probs).dtype))
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax style relaxed sample (reference bernoulli.py
+        rsample uses the same logistic relaxation)."""
+        u = jax.random.uniform(U.key(), self._extend_shape(shape),
+                               U.arr(self.probs).dtype, 1e-6, 1 - 1e-6)
+
+        def f(p, u):
+            logits = jnp.log(p) - jnp.log1p(-p)
+            noise = jnp.log(u) - jnp.log1p(-u)
+            return jax.nn.sigmoid((logits + noise) / temperature)
+        return U.op("bernoulli_rsample", f, self.probs, u)
+
+    def log_prob(self, value):
+        return U.op("bernoulli_log_prob",
+                    lambda v, p: jsp.xlogy(v, p) + jsp.xlog1py(1 - v, -p),
+                    U.value_arr(value), self.probs)
+
+    def entropy(self):
+        return U.op(
+            "bernoulli_entropy",
+            lambda p: -(jsp.xlogy(p, p) + jsp.xlog1py(1 - p, -p)),
+            self.probs)
+
+    def cdf(self, value):
+        def f(v, p):
+            c = jnp.where(v < 0, 0.0, jnp.where(v < 1, 1 - p, 1.0))
+            return c
+        return U.op("bernoulli_cdf", f, U.value_arr(value), self.probs)
+
+
+class Categorical(Distribution):
+    """Categorical(logits): unnormalized log-probabilities over the last
+    axis (softmax-normalized). Reference: distribution/categorical.py."""
+
+    def __init__(self, logits, name=None):
+        self.logits = logits
+        shp = tuple(jnp.shape(U.arr(logits)))
+        super().__init__(shp[:-1])
+        self._num_categories = shp[-1]
+
+    @property
+    def probs(self):
+        return U.op("categorical_probs",
+                    lambda lg: jax.nn.softmax(lg, axis=-1), self.logits)
+
+    def sample(self, shape=()):
+        shp = U.sample_shape(shape, self._batch_shape)
+        idx = jax.random.categorical(
+            U.key(), jax.nn.log_softmax(U.arr(self.logits), axis=-1),
+            shape=shp)
+        return Tensor(idx.astype(jnp.int64 if jax.config.read("jax_enable_x64")
+                                 else jnp.int32), stop_gradient=True)
+
+    def log_prob(self, value):
+        v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+        v = v.astype(jnp.int32)
+
+        def f(lg):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            shp = jnp.broadcast_shapes(jnp.shape(v), jnp.shape(lg)[:-1])
+            vb = jnp.broadcast_to(v, shp)
+            lb = jnp.broadcast_to(logp, shp + jnp.shape(lg)[-1:])
+            return jnp.take_along_axis(lb, vb[..., None], axis=-1)[..., 0]
+        return U.op("categorical_log_prob", f, self.logits)
+
+    def probs_of(self, value):
+        from paddle_tpu import tensor as T
+        return T.exp(self.log_prob(value))
+
+    def entropy(self):
+        def f(lg):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return U.op("categorical_entropy", f, self.logits)
+
+
+class Geometric(Distribution):
+    """Geometric(probs): failures before first success, pmf p(1-p)^k.
+    Reference: distribution/geometric.py:111,152,250."""
+
+    def __init__(self, probs):
+        self.probs = probs
+        super().__init__(U.param_shape(probs))
+
+    @property
+    def mean(self):
+        return U.op("geometric_mean", lambda p: 1.0 / p - 1.0, self.probs)
+
+    @property
+    def variance(self):
+        return U.op("geometric_var",
+                    lambda p: (1.0 / p - 1.0) / p, self.probs)
+
+    def sample(self, shape=()):
+        return Tensor(self.rsample(shape)._value, stop_gradient=True)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(U.key(), self._extend_shape(shape),
+                               U.arr(self.probs).dtype, 1e-7, 1 - 1e-7)
+        return U.op("geometric_rsample",
+                    lambda p, u: jnp.floor(jnp.log(u) / jnp.log1p(-p)),
+                    self.probs, u)
+
+    def pmf(self, k):
+        from paddle_tpu import tensor as T
+        return T.exp(self.log_pmf(k))
+
+    def log_pmf(self, k):
+        return self.log_prob(k)
+
+    def log_prob(self, value):
+        return U.op("geometric_log_prob",
+                    lambda v, p: jnp.log(p) + jsp.xlog1py(v, -p),
+                    U.value_arr(value), self.probs)
+
+    def entropy(self):
+        return U.op(
+            "geometric_entropy",
+            lambda p: -(jsp.xlogy(p, p) + jsp.xlog1py(1 - p, -p)) / p,
+            self.probs)
+
+    def cdf(self, value):
+        return U.op("geometric_cdf",
+                    lambda v, p: 1 - jnp.power(1 - p, v + 1),
+                    U.value_arr(value), self.probs)
+
+
+class Binomial(Distribution):
+    """Binomial(total_count, probs). Reference: distribution/binomial.py."""
+
+    def __init__(self, total_count, probs):
+        self.total_count, self.probs = total_count, probs
+        super().__init__(U.param_shape(total_count, probs))
+
+    @property
+    def mean(self):
+        return U.op("binomial_mean", lambda n, p: n * p,
+                    self.total_count, self.probs)
+
+    @property
+    def variance(self):
+        return U.op("binomial_var", lambda n, p: n * p * (1 - p),
+                    self.total_count, self.probs)
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        n = jnp.broadcast_to(U.arr(self.total_count), shp)
+        p = jnp.broadcast_to(U.arr(self.probs), shp)
+        s = jax.random.binomial(U.key(), n, p)
+        return Tensor(s, stop_gradient=True)
+
+    def log_prob(self, value):
+        def f(v, n, p):
+            logc = (jsp.gammaln(n + 1) - jsp.gammaln(v + 1)
+                    - jsp.gammaln(n - v + 1))
+            return logc + jsp.xlogy(v, p) + jsp.xlog1py(n - v, -p)
+        return U.op("binomial_log_prob", f, U.value_arr(value),
+                    self.total_count, self.probs)
+
+    def entropy(self):
+        """Exact entropy by summing the pmf over the support (static bound:
+        max total_count)."""
+        n_arr = U.arr(self.total_count)
+        if isinstance(n_arr, jax.core.Tracer):
+            kmax = 512  # static window under jit; exact for n < 512
+        else:
+            kmax = int(jnp.max(n_arr)) + 1
+
+        def f(n, p):
+            ks = jnp.arange(kmax, dtype=p.dtype if hasattr(p, "dtype")
+                            else jnp.float32)
+            shp = jnp.broadcast_shapes(jnp.shape(n), jnp.shape(p))
+            nb = jnp.broadcast_to(n, shp)[..., None]
+            pb = jnp.broadcast_to(p, shp)[..., None]
+            logc = (jsp.gammaln(nb + 1) - jsp.gammaln(ks + 1)
+                    - jsp.gammaln(nb - ks + 1))
+            logpmf = logc + jsp.xlogy(ks, pb) + jsp.xlog1py(nb - ks, -pb)
+            valid = ks <= nb
+            pmf = jnp.where(valid, jnp.exp(logpmf), 0.0)
+            return -jnp.sum(pmf * jnp.where(valid, logpmf, 0.0), axis=-1)
+        return U.op(f"binomial_entropy_{kmax}", f,
+                    self.total_count, self.probs)
+
+
+class Multinomial(Distribution):
+    """Multinomial(total_count, probs). Reference: multinomial.py."""
+
+    def __init__(self, total_count, probs):
+        self.total_count, self.probs = total_count, probs
+        shp = tuple(jnp.shape(U.arr(probs)))
+        super().__init__(shp[:-1], shp[-1:])
+
+    @property
+    def mean(self):
+        return U.op("multinomial_mean",
+                    lambda n, p: n * (p / jnp.sum(p, -1, keepdims=True)),
+                    self.total_count, self.probs)
+
+    @property
+    def variance(self):
+        def f(n, p):
+            p = p / jnp.sum(p, -1, keepdims=True)
+            return n * p * (1 - p)
+        return U.op("multinomial_var", f, self.total_count, self.probs)
+
+    def sample(self, shape=()):
+        n_arr = U.arr(self.total_count)
+        if n_arr.ndim != 0:
+            raise ValueError(
+                "Multinomial.sample requires a scalar total_count "
+                f"(got shape {tuple(n_arr.shape)}); log_prob/mean/variance "
+                "do support batched counts.")
+        n = int(n_arr)
+        p = U.arr(self.probs)
+        shp = U.sample_shape(shape, self._batch_shape)
+        logits = jnp.log(p / jnp.sum(p, -1, keepdims=True))
+        idx = jax.random.categorical(U.key(), logits,
+                                     shape=(n,) + shp)
+        counts = jax.nn.one_hot(idx, p.shape[-1], dtype=p.dtype).sum(0)
+        return Tensor(counts, stop_gradient=True)
+
+    def log_prob(self, value):
+        def f(v, n, p):
+            p = p / jnp.sum(p, -1, keepdims=True)
+            return (jsp.gammaln(n + 1)
+                    - jnp.sum(jsp.gammaln(v + 1), axis=-1)
+                    + jnp.sum(jsp.xlogy(v, p), axis=-1))
+        return U.op("multinomial_log_prob", f, U.value_arr(value),
+                    self.total_count, self.probs)
+
+    def entropy(self):
+        """Monte-Carlo entropy estimate (no closed form; the reference
+        evaluates the same way via sampled log_prob)."""
+        samples = self.sample((128,))
+        lp = self.log_prob(samples)
+        from paddle_tpu import tensor as T
+        return T.mean(lp, axis=0) * (-1.0)
+
+
+class Poisson(ExponentialFamily):
+    """Poisson(rate). Reference: distribution/poisson.py."""
+
+    _ENTROPY_TERMS = 512
+
+    def __init__(self, rate):
+        self.rate = rate
+        super().__init__(U.param_shape(rate))
+
+    @property
+    def mean(self):
+        return U.op("poisson_mean", lambda r: r * 1.0, self.rate)
+
+    @property
+    def variance(self):
+        return U.op("poisson_var", lambda r: r * 1.0, self.rate)
+
+    def sample(self, shape=()):
+        s = jax.random.poisson(
+            U.key(), jnp.broadcast_to(U.arr(self.rate),
+                                      self._extend_shape(shape)))
+        return Tensor(s.astype(U.arr(self.rate).dtype), stop_gradient=True)
+
+    def log_prob(self, value):
+        return U.op(
+            "poisson_log_prob",
+            lambda v, r: jsp.xlogy(v, r) - r - jsp.gammaln(v + 1),
+            U.value_arr(value), self.rate)
+
+    def entropy(self):
+        """Series entropy -sum pmf*logpmf over a static window (exact to
+        float precision for rate << window; reference poisson.py does the
+        same truncation)."""
+        def f(r):
+            ks = jnp.arange(self._ENTROPY_TERMS, dtype=jnp.float32)
+            rb = jnp.asarray(r)[..., None]
+            logpmf = jsp.xlogy(ks, rb) - rb - jsp.gammaln(ks + 1)
+            ent = -jnp.sum(jnp.exp(logpmf) * logpmf, axis=-1)
+            return ent.reshape(jnp.shape(r))
+        return U.op("poisson_entropy", f, self.rate)

@@ -1,0 +1,92 @@
+"""Distribution base classes.
+
+Reference: python/paddle/distribution/distribution.py (class Distribution)
+and exponential_family.py (ExponentialFamily).
+"""
+from __future__ import annotations
+
+from paddle_tpu.core.tensor import Tensor
+from . import _util as U
+
+
+class Distribution:
+    """Base class of probability distributions.
+
+    Mirrors the reference API surface: batch_shape/event_shape, sample/
+    rsample, prob/log_prob, cdf/icdf where defined, entropy,
+    kl_divergence(other).
+    """
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(
+            batch_shape if not isinstance(batch_shape, int) else (batch_shape,))
+        self._event_shape = tuple(
+            event_shape if not isinstance(event_shape, int) else (event_shape,))
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        from paddle_tpu import tensor as T
+        return T.sqrt(self.variance)
+
+    def sample(self, shape=()):
+        """Draw (non-reparameterized) samples; gradients do not flow."""
+        out = self.rsample(shape)
+        if isinstance(out, Tensor):
+            out = Tensor(out._value, stop_gradient=True)
+        return out
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from paddle_tpu import tensor as T
+        return T.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return U.sample_shape(sample_shape, self._batch_shape,
+                              self._event_shape)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_shape={self._batch_shape}, " \
+               f"event_shape={self._event_shape})"
+
+
+class ExponentialFamily(Distribution):
+    """Marker base for exponential-family distributions (API parity with
+    the reference's exponential_family.py). The reference derives entropy
+    generically from the log-normalizer via the Bregman identity with
+    autodiff; here every subclass ships a closed-form entropy instead —
+    same results, one less autodiff pass."""
+

@@ -1,0 +1,217 @@
+"""KL divergence registry.
+
+Reference: python/paddle/distribution/kl.py — `register_kl(P, Q)` decorator
+plus `kl_divergence(p, q)` dispatch with most-specific-match resolution,
+and closed forms for the standard pairs.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from paddle_tpu.core.tensor import Tensor
+from . import _util as U
+from .continuous import (Beta, Cauchy, Exponential, Gamma, Gumbel, Laplace,
+                         LogNormal, Normal, Uniform)
+from .discrete import Bernoulli, Categorical, Geometric, Poisson
+from .distribution import Distribution
+from .multivariate import Dirichlet, MultivariateNormal
+from .transformed_distribution import Independent
+
+_REGISTRY: dict[tuple[type, type], callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator registering a pairwise KL implementation."""
+
+    def deco(fn):
+        _REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def _lookup(pt, qt):
+    matches = [(p, q) for (p, q) in _REGISTRY
+               if issubclass(pt, p) and issubclass(qt, q)]
+    if not matches:
+        return None
+    # most specific match: minimal by MRO distance (left-biased like the
+    # reference's total ordering)
+    def depth(pair):
+        p, q = pair
+        return (pt.__mro__.index(p), qt.__mro__.index(q))
+    return _REGISTRY[min(matches, key=depth)]
+
+
+def kl_divergence(p, q):
+    fn = _lookup(type(p), type(q))
+    if fn is None:
+        raise NotImplementedError(
+            f"No KL(p || q) registered for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    def f(l1, s1, l2, s2):
+        vr = (s1 / s2) ** 2
+        return 0.5 * (vr + ((l1 - l2) / s2) ** 2 - 1 - jnp.log(vr))
+    return U.op("kl_normal_normal", f, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    def f(a1, b1, a2, b2):
+        res = jnp.log((b2 - a2) / (b1 - a1))
+        return jnp.where((a2 <= a1) & (b1 <= b2), res, jnp.inf)
+    return U.op("kl_uniform_uniform", f, p.low, p.high, q.low, q.high)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    def f(p1, p2):
+        t1 = p1 * (jnp.log(p1) - jnp.log(p2))
+        t2 = (1 - p1) * (jnp.log1p(-p1) - jnp.log1p(-p2))
+        return t1 + t2
+    return U.op("kl_bern_bern", f, p.probs, q.probs)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    def f(lg1, lg2):
+        lp1 = jax.nn.log_softmax(lg1, -1)
+        lp2 = jax.nn.log_softmax(lg2, -1)
+        return jnp.sum(jnp.exp(lp1) * (lp1 - lp2), -1)
+    return U.op("kl_cat_cat", f, p.logits, q.logits)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def f(a1, b1, a2, b2):
+        t1 = jsp.betaln(a2, b2) - jsp.betaln(a1, b1)
+        return (t1 + (a1 - a2) * jsp.digamma(a1)
+                + (b1 - b2) * jsp.digamma(b1)
+                + (a2 - a1 + b2 - b1) * jsp.digamma(a1 + b1))
+    return U.op("kl_beta_beta", f, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def f(c1, c2):
+        s1 = jnp.sum(c1, -1)
+        return (jsp.gammaln(s1) - jnp.sum(jsp.gammaln(c1), -1)
+                - jsp.gammaln(jnp.sum(c2, -1))
+                + jnp.sum(jsp.gammaln(c2), -1)
+                + jnp.sum((c1 - c2) * (jsp.digamma(c1)
+                                       - jsp.digamma(s1)[..., None]), -1))
+    return U.op("kl_dir_dir", f, p.concentration, q.concentration)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    def f(a1, r1, a2, r2):
+        return ((a1 - a2) * jsp.digamma(a1) - jsp.gammaln(a1)
+                + jsp.gammaln(a2) + a2 * (jnp.log(r1) - jnp.log(r2))
+                + a1 * (r2 / r1 - 1))
+    return U.op("kl_gamma_gamma", f, p.concentration, p.rate,
+                q.concentration, q.rate)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    def f(r1, r2):
+        rr = r2 / r1
+        return rr - 1 - jnp.log(rr)
+    return U.op("kl_exp_exp", f, p.rate, q.rate)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    def f(p1, p2):
+        return (p1 * jnp.log(p1 / p2)
+                + (1.0 - p1) * jnp.log((1.0 - p1) / (1.0 - p2))) / p1
+    return U.op("kl_geom_geom", f, p.probs, q.probs)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    def f(l1, s1, l2, s2):
+        d = jnp.abs(l1 - l2)
+        return (jnp.log(s2 / s1) + (s1 * jnp.exp(-d / s1) + d) / s2 - 1)
+    return U.op("kl_laplace_laplace", f, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    def f(r1, r2):
+        return r1 * (jnp.log(r1) - jnp.log(r2)) - r1 + r2
+    return U.op("kl_poisson_poisson", f, p.rate, q.rate)
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel_gumbel(p, q):
+    """No closed form for general scales; Monte-Carlo estimate of
+    E_p[log p - log q] (the reference evaluates the same way)."""
+    samples = p.rsample((256,))
+    from paddle_tpu import tensor as T
+    return T.mean(T.subtract(p.log_prob(samples), q.log_prob(samples)),
+                  axis=0)
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    # same as KL of the underlying normals
+    def f(l1, s1, l2, s2):
+        vr = (s1 / s2) ** 2
+        return 0.5 * (vr + ((l1 - l2) / s2) ** 2 - 1 - jnp.log(vr))
+    return U.op("kl_lognorm_lognorm", f, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    def f(l1, L1, l2, L2):
+        d = L1.shape[-1]
+        # tr(S2^-1 S1) = ||L2^-1 L1||_F^2 via triangular solve
+        M = jax.scipy.linalg.solve_triangular(
+            jnp.broadcast_to(L2, jnp.broadcast_shapes(jnp.shape(L1),
+                                                      jnp.shape(L2))),
+            jnp.broadcast_to(L1, jnp.broadcast_shapes(jnp.shape(L1),
+                                                      jnp.shape(L2))),
+            lower=True)
+        tr = jnp.sum(M * M, axis=(-2, -1))
+        diff = l2 - l1
+        y = jax.scipy.linalg.solve_triangular(
+            jnp.broadcast_to(
+                L2, jnp.broadcast_shapes(
+                    jnp.shape(L2), jnp.shape(diff)[:-1] + jnp.shape(L2)[-2:]
+                )), diff[..., None], lower=True)[..., 0]
+        maha = jnp.sum(y * y, -1)
+        logdet1 = jnp.sum(jnp.log(jnp.diagonal(L1, axis1=-2, axis2=-1)), -1)
+        logdet2 = jnp.sum(jnp.log(jnp.diagonal(L2, axis1=-2, axis2=-1)), -1)
+        return 0.5 * (tr + maha - d) + logdet2 - logdet1
+    return U.op("kl_mvn_mvn", f, p.loc, p.scale_tril, q.loc, q.scale_tril)
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy_cauchy(p, q):
+    def f(l1, s1, l2, s2):
+        # closed form (Chyzak & Nielsen 2019)
+        return jnp.log(((s1 + s2) ** 2 + (l1 - l2) ** 2)
+                       / (4 * s1 * s2))
+    return U.op("kl_cauchy_cauchy", f, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Independent, Independent)
+def _kl_independent_independent(p, q):
+    if p.reinterpreted_batch_rank != q.reinterpreted_batch_rank:
+        raise NotImplementedError(
+            "Independent KL requires equal reinterpreted ranks")
+    inner = kl_divergence(p.base, q.base)
+    arr = inner._value
+    n = p.reinterpreted_batch_rank
+    return Tensor(jnp.sum(arr, axis=tuple(range(arr.ndim - n, arr.ndim))))

@@ -1,0 +1,205 @@
+"""Multivariate distributions: Dirichlet, MultivariateNormal, LKJCholesky.
+
+Reference: python/paddle/distribution/{dirichlet,multivariate_normal,
+lkj_cholesky}.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from paddle_tpu.core.tensor import Tensor
+from . import _util as U
+from .distribution import Distribution, ExponentialFamily
+
+
+class Dirichlet(ExponentialFamily):
+    """Dirichlet(concentration). Reference: distribution/dirichlet.py."""
+
+    def __init__(self, concentration):
+        self.concentration = concentration
+        shp = tuple(jnp.shape(U.arr(concentration)))
+        super().__init__(shp[:-1], shp[-1:])
+
+    @property
+    def mean(self):
+        return U.op("dirichlet_mean",
+                    lambda c: c / jnp.sum(c, -1, keepdims=True),
+                    self.concentration)
+
+    @property
+    def variance(self):
+        def f(c):
+            tot = jnp.sum(c, -1, keepdims=True)
+            m = c / tot
+            return m * (1 - m) / (tot + 1)
+        return U.op("dirichlet_var", f, self.concentration)
+
+    def rsample(self, shape=()):
+        shp = U.sample_shape(shape, self._batch_shape, self._event_shape)
+        k = U.key()
+        return U.op(
+            "dirichlet_rsample",
+            lambda c: jax.random.dirichlet(
+                k, jnp.broadcast_to(c, shp)), self.concentration)
+
+    def log_prob(self, value):
+        return U.op(
+            "dirichlet_log_prob",
+            lambda v, c: jnp.sum(jsp.xlogy(c - 1, v), -1)
+            + jsp.gammaln(jnp.sum(c, -1)) - jnp.sum(jsp.gammaln(c), -1),
+            U.value_arr(value), self.concentration)
+
+    def entropy(self):
+        def f(c):
+            k = c.shape[-1]
+            tot = jnp.sum(c, -1)
+            lnB = jnp.sum(jsp.gammaln(c), -1) - jsp.gammaln(tot)
+            return (lnB + (tot - k) * jsp.digamma(tot)
+                    - jnp.sum((c - 1) * jsp.digamma(c), -1))
+        return U.op("dirichlet_entropy", f, self.concentration)
+
+
+class MultivariateNormal(Distribution):
+    """MultivariateNormal(loc, covariance_matrix|precision_matrix|
+    scale_tril). Reference: distribution/multivariate_normal.py."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None):
+        given = sum(x is not None for x in
+                    (covariance_matrix, precision_matrix, scale_tril))
+        if given != 1:
+            raise ValueError(
+                "Exactly one of covariance_matrix, precision_matrix or "
+                "scale_tril must be specified.")
+        self.loc = loc
+        if scale_tril is not None:
+            self.scale_tril = scale_tril
+        elif covariance_matrix is not None:
+            self.covariance_matrix = covariance_matrix
+            self.scale_tril = U.op(
+                "mvn_chol", jnp.linalg.cholesky, covariance_matrix)
+        else:
+            self.precision_matrix = precision_matrix
+            self.scale_tril = U.op(
+                "mvn_prec_chol",
+                lambda p: jnp.linalg.cholesky(jnp.linalg.inv(p)),
+                precision_matrix)
+        d = tuple(jnp.shape(U.arr(self.scale_tril)))[-1]
+        batch = jnp.broadcast_shapes(
+            tuple(jnp.shape(U.arr(loc)))[:-1],
+            tuple(jnp.shape(U.arr(self.scale_tril)))[:-2])
+        super().__init__(batch, (d,))
+
+    @property
+    def mean(self):
+        return U.op("mvn_mean",
+                    lambda l: jnp.broadcast_to(
+                        l, self._batch_shape + self._event_shape), self.loc)
+
+    @property
+    def variance(self):
+        return U.op(
+            "mvn_var",
+            lambda L: jnp.broadcast_to(
+                jnp.sum(L * L, axis=-1),
+                self._batch_shape + self._event_shape), self.scale_tril)
+
+    def rsample(self, shape=()):
+        shp = U.sample_shape(shape, self._batch_shape, self._event_shape)
+        eps = jax.random.normal(U.key(), shp, U.arr(self.loc).dtype)
+        return U.op(
+            "mvn_rsample",
+            lambda l, L, e: l + jnp.einsum("...ij,...j->...i", L, e),
+            self.loc, self.scale_tril, eps)
+
+    def log_prob(self, value):
+        def f(v, l, L):
+            diff = v - l
+            # solve L y = diff (lower triangular)
+            y = jax.scipy.linalg.solve_triangular(
+                jnp.broadcast_to(
+                    L, jnp.broadcast_shapes(
+                        jnp.shape(L), jnp.shape(diff)[:-1] + jnp.shape(L)[-2:]
+                    )), diff[..., None], lower=True)[..., 0]
+            d = L.shape[-1]
+            half_log_det = jnp.sum(
+                jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            return (-0.5 * jnp.sum(y * y, -1) - half_log_det
+                    - 0.5 * d * math.log(2 * math.pi))
+        return U.op("mvn_log_prob", f, U.value_arr(value), self.loc,
+                    self.scale_tril)
+
+    def entropy(self):
+        def f(L):
+            d = L.shape[-1]
+            half_log_det = jnp.sum(
+                jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            ent = 0.5 * d * (1 + math.log(2 * math.pi)) + half_log_det
+            return jnp.broadcast_to(ent, self._batch_shape)
+        return U.op("mvn_entropy", f, self.scale_tril)
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices.
+    Reference: distribution/lkj_cholesky.py (onion-method sampling)."""
+
+    def __init__(self, dim, concentration=1.0,
+                 sample_method="onion"):
+        if dim < 2:
+            raise ValueError("dim must be >= 2")
+        self.dim = dim
+        self.concentration = concentration
+        self.sample_method = sample_method
+        batch = tuple(jnp.shape(U.arr(concentration)))
+        super().__init__(batch, (dim, dim))
+
+    def sample(self, shape=()):
+        """Onion-method sampler."""
+        d = self.dim
+        eta = U.arr(self.concentration)
+        batch = U.sample_shape(shape, self._batch_shape)
+        k1, k2 = jax.random.split(U.key())
+        # beta_k = eta + (d - 2 - (k-1))/2 for row k = 1..d-1
+        rows = []
+        L00 = jnp.ones(batch)
+        us = jax.random.normal(k1, batch + (d, d))
+        for i in range(1, d):
+            beta_a = jnp.broadcast_to(i / 2.0, batch)
+            beta_b = eta + (d - 1 - i) / 2.0
+            ka, kb, k2 = jax.random.split(k2, 3)
+            g1 = jax.random.gamma(ka, jnp.broadcast_to(beta_a, batch))
+            g2 = jax.random.gamma(kb, jnp.broadcast_to(beta_b, batch))
+            y = g1 / (g1 + g2)           # Beta(i/2, eta + (d-1-i)/2)
+            u = us[..., i, :i]
+            norm = jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(y)[..., None] * u / jnp.where(norm == 0, 1.0, norm)
+            rows.append((w, jnp.sqrt(jnp.clip(1 - y, 1e-12))))
+        L = jnp.zeros(batch + (d, d))
+        L = L.at[..., 0, 0].set(L00)
+        for i, (w, diag) in enumerate(rows, start=1):
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(diag)
+        return Tensor(L, stop_gradient=True)
+
+    def log_prob(self, value):
+        d = self.dim
+
+        def f(L, eta):
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            # exponent of L_ii for i=1..d-1: 2(eta-1) + d - i - 1
+            i = jnp.arange(1, d, dtype=diag.dtype)
+            eta_b = eta[..., None] if jnp.ndim(eta) else eta
+            exps = 2 * (eta_b - 1) + d - i - 1
+            unnorm = jnp.sum(exps * jnp.log(diag), axis=-1)
+            dm1 = d - 1
+            alpha = eta + 0.5 * dm1
+            lognorm = (0.5 * dm1 * math.log(math.pi)
+                       + jsp.multigammaln(alpha - 0.5, dm1)
+                       - dm1 * jsp.gammaln(alpha))
+            return unnorm - lognorm
+        return U.op(f"lkj_log_prob_{d}", f, U.value_arr(value),
+                    self.concentration)

@@ -1,0 +1,399 @@
+"""Bijective transforms.
+
+Reference: python/paddle/distribution/transform.py (Transform, Chain/
+Affine/Abs/Exp/Power/Reshape/Sigmoid/Softmax/Stack/StickBreaking/Tanh/
+Independent transforms). Implemented over jnp through the eager dispatcher
+so forward/inverse/log-det are differentiable.
+"""
+from __future__ import annotations
+
+import math
+from functools import reduce
+import operator
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from . import _util as U
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    _type = Type.INJECTION
+
+    @property
+    def type(self):
+        return self._type
+
+    def __call__(self, input):
+        from .transformed_distribution import TransformedDistribution
+        from .distribution import Distribution
+        if isinstance(input, Distribution):
+            return TransformedDistribution(input, [self])
+        if isinstance(input, Transform):
+            return ChainTransform([self, input])
+        return self.forward(input)
+
+    def forward(self, x):
+        return U.op(f"tfm_fwd_{type(self).__name__}",
+                    self._forward, U.value_arr(x))
+
+    def inverse(self, y):
+        return U.op(f"tfm_inv_{type(self).__name__}",
+                    self._inverse, U.value_arr(y))
+
+    def forward_log_det_jacobian(self, x):
+        return U.op(f"tfm_fldj_{type(self).__name__}",
+                    self._forward_log_det_jacobian, U.value_arr(x))
+
+    def inverse_log_det_jacobian(self, y):
+        return U.op(f"tfm_ildj_{type(self).__name__}",
+                    self._inverse_log_det_jacobian, U.value_arr(y))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # event dims consumed by this transform
+    _domain_event_dim = 0
+    _codomain_event_dim = 0
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def _inverse_log_det_jacobian(self, y):
+        return -self._forward_log_det_jacobian(self._inverse(y))
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # right-inverse (positive branch), as in the reference
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc, self.scale = loc, scale
+
+    def forward(self, x):
+        return U.op("affine_fwd", lambda x, l, s: l + s * x,
+                    U.value_arr(x), self.loc, self.scale)
+
+    def inverse(self, y):
+        return U.op("affine_inv", lambda y, l, s: (y - l) / s,
+                    U.value_arr(y), self.loc, self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return U.op(
+            "affine_fldj",
+            lambda x, s: jnp.broadcast_to(
+                jnp.log(jnp.abs(s)),
+                jnp.broadcast_shapes(jnp.shape(x), jnp.shape(s))),
+            U.value_arr(x), self.scale)
+
+    def inverse_log_det_jacobian(self, y):
+        return U.op(
+            "affine_ildj",
+            lambda y, s: jnp.broadcast_to(
+                -jnp.log(jnp.abs(s)),
+                jnp.broadcast_shapes(jnp.shape(y), jnp.shape(s))),
+            U.value_arr(y), self.scale)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = power
+
+    def forward(self, x):
+        return U.op("power_fwd", lambda x, p: jnp.power(x, p),
+                    U.value_arr(x), self.power)
+
+    def inverse(self, y):
+        return U.op("power_inv", lambda y, p: jnp.power(y, 1.0 / p),
+                    U.value_arr(y), self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return U.op(
+            "power_fldj",
+            lambda x, p: jnp.log(jnp.abs(p * jnp.power(x, p - 1))),
+            U.value_arr(x), self.power)
+
+    def inverse_log_det_jacobian(self, y):
+        return U.op(
+            "power_ildj",
+            lambda y, p: -jnp.log(jnp.abs(
+                p * jnp.power(jnp.power(y, 1.0 / p), p - 1))),
+            U.value_arr(y), self.power)
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+    _domain_event_dim = 1
+    _codomain_event_dim = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if reduce(operator.mul, self.in_event_shape, 1) != \
+                reduce(operator.mul, self.out_event_shape, 1):
+            raise ValueError("in/out event sizes must match")
+        self._domain_event_dim = len(self.in_event_shape)
+        self._codomain_event_dim = len(self.out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        if tuple(shape[len(shape) - n:]) != self.in_event_shape:
+            raise ValueError("shape mismatch in ReshapeTransform")
+        return tuple(shape[:len(shape) - n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:len(shape) - n]) + self.in_event_shape
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> K-simplex via stick breaking. Reference:
+    transform.py StickBreakingTransform."""
+    _type = Type.BIJECTION
+    _domain_event_dim = 1
+    _codomain_event_dim = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        z = jax.nn.sigmoid(x - jnp.log(offset.astype(x.dtype)))
+        zc = jnp.cumprod(1 - z, axis=-1)
+        pad = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+        return jnp.concatenate([z, pad], -1) * \
+            jnp.concatenate([pad, zc], -1)
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        offset = y.shape[-1] - jnp.arange(1, y.shape[-1])
+        sf = 1 - jnp.cumsum(y_crop, axis=-1)
+        sf = jnp.concatenate(
+            [jnp.ones(y.shape[:-1] + (1,), y.dtype), sf[..., :-1]], -1)
+        z = y_crop / sf
+        return jnp.log(z) - jnp.log1p(-z) + \
+            jnp.log(offset.astype(y.dtype))
+
+    def _forward_log_det_jacobian(self, x):
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        t = x - jnp.log(offset.astype(x.dtype))
+        z = jax.nn.sigmoid(t)
+        zc = jnp.cumprod(1 - z, axis=-1)
+        sf_prev = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), zc[..., :-1]], -1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(sf_prev), -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms to slices along an axis."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _apply(self, v, meth):
+        arrs = jnp.split(v, len(self.transforms), self.axis)
+        outs = []
+        for t, a in zip(self.transforms, arrs):
+            r = getattr(t, meth)(Tensor(jnp.squeeze(a, self.axis)))
+            outs.append(r._value if isinstance(r, Tensor) else r)
+        return jnp.stack(outs, self.axis)
+
+    def forward(self, x):
+        return Tensor(self._apply(U.arr(x), "forward"))
+
+    def inverse(self, y):
+        return Tensor(self._apply(U.arr(y), "inverse"))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._apply(U.arr(x), "forward_log_det_jacobian"))
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(self._apply(U.arr(y), "inverse_log_det_jacobian"))
+
+
+class IndependentTransform(Transform):
+    """Reinterpret batch dims of a base transform as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        self._domain_event_dim = (base._domain_event_dim
+                                  + self.reinterpreted_batch_rank)
+        self._codomain_event_dim = (base._codomain_event_dim
+                                    + self.reinterpreted_batch_rank)
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ldj = self.base.forward_log_det_jacobian(x)
+        arr = ldj._value if isinstance(ldj, Tensor) else jnp.asarray(ldj)
+        axes = tuple(range(arr.ndim - self.reinterpreted_batch_rank,
+                           arr.ndim))
+        return Tensor(jnp.sum(arr, axes)) if axes else Tensor(arr)
+
+    def inverse_log_det_jacobian(self, y):
+        ldj = self.base.inverse_log_det_jacobian(y)
+        arr = ldj._value if isinstance(ldj, Tensor) else jnp.asarray(ldj)
+        axes = tuple(range(arr.ndim - self.reinterpreted_batch_rank,
+                           arr.ndim))
+        return Tensor(jnp.sum(arr, axes)) if axes else Tensor(arr)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    @property
+    def _domain_event_dim(self):
+        return max((t._domain_event_dim for t in self.transforms), default=0)
+
+    @property
+    def _codomain_event_dim(self):
+        return max((t._codomain_event_dim for t in self.transforms),
+                   default=0)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        from paddle_tpu import tensor as T
+        total = None
+        for t in self.transforms:
+            ldj = t.forward_log_det_jacobian(x)
+            total = ldj if total is None else T.add(total, ldj)
+            x = t.forward(x)
+        return total
+
+    def inverse_log_det_jacobian(self, y):
+        from paddle_tpu import tensor as T
+        total = None
+        for t in reversed(self.transforms):
+            ldj = t.inverse_log_det_jacobian(y)
+            total = ldj if total is None else T.add(total, ldj)
+            y = t.inverse(y)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape

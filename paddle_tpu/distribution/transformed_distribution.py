@@ -1,0 +1,112 @@
+"""TransformedDistribution and Independent.
+
+Reference: python/paddle/distribution/{transformed_distribution,
+independent}.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from . import _util as U
+from .distribution import Distribution
+from .transform import ChainTransform, Transform
+
+
+class TransformedDistribution(Distribution):
+    """Distribution of T(X) for X ~ base and T a (chain of) transform(s)."""
+
+    def __init__(self, base, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transforms = list(transforms)
+        chain = ChainTransform(self.transforms)
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        out_shape = chain.forward_shape(shape)
+        event_dim = max(chain._codomain_event_dim, len(base.event_shape))
+        cut = len(out_shape) - event_dim
+        super().__init__(out_shape[:cut], out_shape[cut:])
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        if isinstance(x, Tensor):
+            x = Tensor(x._value, stop_gradient=True)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        from paddle_tpu import tensor as T
+        event_dim = len(self.event_shape)
+        lp = None
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ildj = t.inverse_log_det_jacobian(y)
+            ndiff = event_dim - t._codomain_event_dim
+            arr = ildj._value if isinstance(ildj, Tensor) else jnp.asarray(
+                ildj)
+            if ndiff > 0 and arr.ndim >= ndiff:
+                arr = jnp.sum(arr, axis=tuple(range(arr.ndim - ndiff,
+                                                    arr.ndim)))
+            term = Tensor(arr)
+            lp = term if lp is None else T.add(lp, term)
+            event_dim = t._domain_event_dim + max(
+                event_dim - t._codomain_event_dim, 0)
+            y = x
+        base_lp = self.base.log_prob(y)
+        ndiff = event_dim - len(self.base.event_shape)
+        if ndiff > 0:
+            arr = base_lp._value
+            arr = jnp.sum(arr, axis=tuple(range(arr.ndim - ndiff, arr.ndim)))
+            base_lp = Tensor(arr)
+        return T.add(base_lp, lp) if lp is not None else base_lp
+
+
+class Independent(Distribution):
+    """Reinterpret `reinterpreted_batch_rank` rightmost batch dims as
+    event dims (sums log_prob over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        if self.reinterpreted_batch_rank > len(base.batch_shape):
+            raise ValueError("reinterpreted_batch_rank too large")
+        b = tuple(base.batch_shape)
+        cut = len(b) - self.reinterpreted_batch_rank
+        super().__init__(b[:cut], b[cut:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        arr = lp._value
+        n = self.reinterpreted_batch_rank
+        return Tensor(jnp.sum(arr, axis=tuple(range(arr.ndim - n,
+                                                    arr.ndim))))
+
+    def entropy(self):
+        ent = self.base.entropy()
+        arr = ent._value
+        n = self.reinterpreted_batch_rank
+        return Tensor(jnp.sum(arr, axis=tuple(range(arr.ndim - n,
+                                                    arr.ndim))))

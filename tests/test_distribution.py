@@ -1,0 +1,427 @@
+"""paddle_tpu.distribution vs scipy.stats and analytic identities."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+RTOL = 2e-4
+ATOL = 1e-5
+
+
+def _np(t):
+    return np.asarray(t.numpy(), dtype=np.float64)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(1234)
+
+
+class TestLogProbVsScipy:
+    def test_normal(self):
+        d = D.Normal(1.5, 2.0)
+        v = np.linspace(-3, 5, 7).astype("float32")
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(v))),
+            st.norm.logpdf(v, 1.5, 2.0), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(
+            _np(d.entropy()), st.norm.entropy(1.5, 2.0), rtol=RTOL)
+        np.testing.assert_allclose(
+            _np(d.cdf(paddle.to_tensor(v))),
+            st.norm.cdf(v, 1.5, 2.0), rtol=RTOL, atol=ATOL)
+
+    def test_uniform(self):
+        d = D.Uniform(-1.0, 3.0)
+        v = np.array([-0.5, 0.0, 2.9], dtype="float32")
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(v))),
+            st.uniform.logpdf(v, -1.0, 4.0), rtol=RTOL)
+        np.testing.assert_allclose(_np(d.entropy()), st.uniform.entropy(
+            -1.0, 4.0), rtol=RTOL)
+
+    def test_beta(self):
+        d = D.Beta(2.0, 3.0)
+        v = np.array([0.1, 0.5, 0.9], dtype="float32")
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(v))),
+            st.beta.logpdf(v, 2.0, 3.0), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(
+            _np(d.entropy()), st.beta.entropy(2.0, 3.0),
+            rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(_np(d.mean), 2.0 / 5, rtol=RTOL)
+        np.testing.assert_allclose(_np(d.variance),
+                                   st.beta.var(2.0, 3.0), rtol=RTOL)
+
+    def test_gamma_chi2(self):
+        d = D.Gamma(3.0, 2.0)
+        v = np.array([0.2, 1.0, 4.0], dtype="float32")
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(v))),
+            st.gamma.logpdf(v, 3.0, scale=0.5), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(
+            _np(d.entropy()), st.gamma.entropy(3.0, scale=0.5), rtol=1e-3)
+        c = D.Chi2(4.0)
+        np.testing.assert_allclose(
+            _np(c.log_prob(paddle.to_tensor(v))),
+            st.chi2.logpdf(v, 4.0), rtol=RTOL, atol=ATOL)
+
+    def test_exponential(self):
+        d = D.Exponential(0.5)
+        v = np.array([0.1, 1.0, 5.0], dtype="float32")
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(v))),
+            st.expon.logpdf(v, scale=2.0), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(_np(d.entropy()),
+                                   st.expon.entropy(scale=2.0), rtol=RTOL)
+
+    def test_laplace_gumbel_cauchy(self):
+        v = np.array([-2.0, 0.3, 4.0], dtype="float32")
+        d = D.Laplace(0.5, 1.5)
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(v))),
+            st.laplace.logpdf(v, 0.5, 1.5), rtol=RTOL, atol=ATOL)
+        g = D.Gumbel(1.0, 2.0)
+        np.testing.assert_allclose(
+            _np(g.log_prob(paddle.to_tensor(v))),
+            st.gumbel_r.logpdf(v, 1.0, 2.0), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(_np(g.entropy()),
+                                   st.gumbel_r.entropy(1.0, 2.0), rtol=RTOL)
+        c = D.Cauchy(0.0, 2.0)
+        np.testing.assert_allclose(
+            _np(c.log_prob(paddle.to_tensor(v))),
+            st.cauchy.logpdf(v, 0.0, 2.0), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(_np(c.entropy()),
+                                   st.cauchy.entropy(0.0, 2.0), rtol=RTOL)
+
+    def test_lognormal_studentt(self):
+        v = np.array([0.5, 1.0, 3.0], dtype="float32")
+        d = D.LogNormal(0.2, 0.7)
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(v))),
+            st.lognorm.logpdf(v, 0.7, scale=np.exp(0.2)),
+            rtol=RTOL, atol=ATOL)
+        s = D.StudentT(5.0, 0.5, 2.0)
+        np.testing.assert_allclose(
+            _np(s.log_prob(paddle.to_tensor(v))),
+            st.t.logpdf(v, 5.0, 0.5, 2.0), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(_np(s.entropy()),
+                                   st.t.entropy(5.0, 0.5, 2.0), rtol=1e-3)
+
+    def test_bernoulli_geometric(self):
+        d = D.Bernoulli(0.3)
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(
+                np.array([0.0, 1.0], "float32")))),
+            st.bernoulli.logpmf([0, 1], 0.3), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(_np(d.entropy()),
+                                   st.bernoulli.entropy(0.3), rtol=RTOL)
+        g = D.Geometric(0.25)
+        ks = np.array([0.0, 1.0, 5.0], "float32")
+        # scipy geom counts trials (k>=1); ours counts failures (k>=0)
+        np.testing.assert_allclose(
+            _np(g.log_prob(paddle.to_tensor(ks))),
+            st.geom.logpmf(ks + 1, 0.25), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(_np(g.mean), 1 / 0.25 - 1, rtol=RTOL)
+
+    def test_binomial_poisson_multinomial(self):
+        b = D.Binomial(10.0, 0.4)
+        ks = np.array([0.0, 3.0, 10.0], "float32")
+        np.testing.assert_allclose(
+            _np(b.log_prob(paddle.to_tensor(ks))),
+            st.binom.logpmf(ks, 10, 0.4), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(_np(b.entropy()),
+                                   st.binom.entropy(10, 0.4), rtol=1e-3)
+        p = D.Poisson(3.5)
+        np.testing.assert_allclose(
+            _np(p.log_prob(paddle.to_tensor(ks))),
+            st.poisson.logpmf(ks, 3.5), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(_np(p.entropy()),
+                                   st.poisson.entropy(3.5), rtol=1e-3)
+        m = D.Multinomial(6.0, np.array([0.2, 0.3, 0.5], "float32"))
+        val = np.array([1.0, 2.0, 3.0], "float32")
+        np.testing.assert_allclose(
+            _np(m.log_prob(paddle.to_tensor(val))),
+            st.multinomial.logpmf([1, 2, 3], 6, [0.2, 0.3, 0.5]),
+            rtol=RTOL, atol=ATOL)
+
+    def test_categorical(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5], "float32")) + 1.7
+        d = D.Categorical(paddle.to_tensor(logits))
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(np.array([0, 1, 2])))),
+            np.log([0.2, 0.3, 0.5]), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(
+            _np(d.entropy()),
+            st.multinomial.entropy(1, [0.2, 0.3, 0.5]), rtol=1e-3)
+
+    def test_dirichlet_mvn(self):
+        conc = np.array([1.5, 2.0, 3.0], "float32")
+        d = D.Dirichlet(conc)
+        v = np.array([0.2, 0.3, 0.5], "float32")
+        np.testing.assert_allclose(
+            _np(d.log_prob(paddle.to_tensor(v))),
+            st.dirichlet.logpdf(v, conc), rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(
+            _np(d.entropy()), st.dirichlet.entropy(conc),
+            rtol=1e-3, atol=1e-5)
+        cov = np.array([[2.0, 0.3], [0.3, 1.0]], "float32")
+        mvn = D.MultivariateNormal(np.zeros(2, "float32"),
+                                   covariance_matrix=cov)
+        x = np.array([0.5, -1.0], "float32")
+        np.testing.assert_allclose(
+            _np(mvn.log_prob(paddle.to_tensor(x))),
+            st.multivariate_normal.logpdf(x, np.zeros(2), cov),
+            rtol=RTOL, atol=ATOL)
+        np.testing.assert_allclose(
+            _np(mvn.entropy()),
+            st.multivariate_normal.entropy(np.zeros(2), cov), rtol=1e-4)
+
+    def test_continuous_bernoulli(self):
+        d = D.ContinuousBernoulli(0.3)
+        # normalizer C = 2 atanh(1-2p)/(1-2p); check pdf integrates to 1
+        xs = np.linspace(1e-4, 1 - 1e-4, 20001).astype("float32")
+        pdf = np.exp(_np(d.log_prob(paddle.to_tensor(xs))))
+        np.testing.assert_allclose(np.trapezoid(pdf, xs.astype("float64")),
+                                   1.0, rtol=1e-3)
+        m = _np(d.mean)
+        est = np.trapezoid(pdf * xs, xs.astype("float64"))
+        np.testing.assert_allclose(m, est, rtol=1e-3)
+
+
+class TestSampling:
+    def test_moments(self):
+        n = 20000
+        for d, mean, var in [
+            (D.Normal(1.0, 2.0), 1.0, 4.0),
+            (D.Uniform(0.0, 2.0), 1.0, 1.0 / 3),
+            (D.Exponential(2.0), 0.5, 0.25),
+            (D.Gamma(3.0, 2.0), 1.5, 0.75),
+            (D.Laplace(0.0, 1.0), 0.0, 2.0),
+            (D.Gumbel(0.0, 1.0), 0.5772, np.pi ** 2 / 6),
+            (D.Geometric(0.4), 1.5, 3.75),
+            (D.Poisson(4.0), 4.0, 4.0),
+        ]:
+            s = np.asarray(d.sample((n,)).numpy(), np.float64)
+            assert s.shape[0] == n
+            np.testing.assert_allclose(s.mean(0), mean, atol=0.1)
+            np.testing.assert_allclose(s.var(0), var, rtol=0.15, atol=0.05)
+
+    def test_mvn_dirichlet_sampling(self):
+        cov = np.array([[1.0, 0.5], [0.5, 2.0]], "float32")
+        mvn = D.MultivariateNormal(np.array([1.0, -1.0], "float32"),
+                                   covariance_matrix=cov)
+        s = np.asarray(mvn.sample((20000,)).numpy(), np.float64)
+        np.testing.assert_allclose(s.mean(0), [1.0, -1.0], atol=0.05)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.1)
+        dd = D.Dirichlet(np.array([2.0, 3.0, 5.0], "float32"))
+        s = np.asarray(dd.sample((20000,)).numpy(), np.float64)
+        np.testing.assert_allclose(s.mean(0), [0.2, 0.3, 0.5], atol=0.02)
+
+    def test_categorical_multinomial_sampling(self):
+        logits = np.log(np.array([0.1, 0.2, 0.7], "float32"))
+        c = D.Categorical(logits)
+        s = np.asarray(c.sample((20000,)).numpy())
+        freq = np.bincount(s, minlength=3) / 20000
+        np.testing.assert_allclose(freq, [0.1, 0.2, 0.7], atol=0.02)
+        m = D.Multinomial(20.0, np.array([0.3, 0.7], "float32"))
+        s = np.asarray(m.sample((5000,)).numpy(), np.float64)
+        assert np.all(s.sum(-1) == 20)
+        np.testing.assert_allclose(s.mean(0), [6.0, 14.0], atol=0.2)
+
+    def test_lkj(self):
+        d = D.LKJCholesky(3, 1.5)
+        L = np.asarray(d.sample((100,)).numpy(), np.float64)
+        corr = L @ np.swapaxes(L, -1, -2)
+        np.testing.assert_allclose(np.diagonal(corr, axis1=-2, axis2=-1),
+                                   1.0, atol=1e-5)
+        lp = _np(d.log_prob(paddle.to_tensor(L[0].astype("float32"))))
+        assert np.isfinite(lp)
+
+
+class TestKL:
+    def test_closed_forms_vs_mc(self):
+        pairs = [
+            (D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)),
+            (D.Beta(2.0, 3.0), D.Beta(3.0, 2.0)),
+            (D.Gamma(3.0, 2.0), D.Gamma(2.0, 1.0)),
+            (D.Exponential(1.0), D.Exponential(2.0)),
+            (D.Laplace(0.0, 1.0), D.Laplace(0.5, 2.0)),
+            (D.Cauchy(0.0, 1.0), D.Cauchy(1.0, 2.0)),
+        ]
+        for p, q in pairs:
+            kl = float(_np(D.kl_divergence(p, q)))
+            s = p.sample((200000,))
+            mc = float(_np(p.log_prob(s)).mean()
+                       - _np(q.log_prob(s)).mean())
+            assert abs(kl - mc) < max(0.05, 0.1 * abs(kl)), \
+                (type(p).__name__, kl, mc)
+            assert kl >= -1e-6
+
+    def test_discrete_kls(self):
+        kl = float(_np(D.kl_divergence(D.Bernoulli(0.3), D.Bernoulli(0.6))))
+        ref = 0.3 * np.log(0.3 / 0.6) + 0.7 * np.log(0.7 / 0.4)
+        np.testing.assert_allclose(kl, ref, rtol=1e-4)
+        c1 = D.Categorical(np.log(np.array([0.2, 0.8], "float32")))
+        c2 = D.Categorical(np.log(np.array([0.5, 0.5], "float32")))
+        ref = 0.2 * np.log(0.2 / 0.5) + 0.8 * np.log(0.8 / 0.5)
+        np.testing.assert_allclose(
+            float(_np(D.kl_divergence(c1, c2))), ref, rtol=1e-4)
+
+    def test_mvn_kl(self):
+        a = D.MultivariateNormal(np.zeros(2, "float32"),
+                                 covariance_matrix=np.eye(2, dtype="float32"))
+        b = D.MultivariateNormal(
+            np.ones(2, "float32"),
+            covariance_matrix=np.array([[2.0, 0.0], [0.0, 2.0]], "float32"))
+        # closed form: 0.5*(tr + maha - d + logdet ratio)
+        ref = 0.5 * (1.0 + 1.0 / 2 * 2 - 2 + np.log(4.0))
+        np.testing.assert_allclose(float(_np(D.kl_divergence(a, b))),
+                                   ref, rtol=1e-4)
+
+    def test_unregistered_raises(self):
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(0.0, 1.0), D.Gamma(1.0, 1.0))
+
+
+class TestTransforms:
+    def test_roundtrip_and_ldj(self):
+        x = np.linspace(-2, 2, 9).astype("float32")
+        for t in [D.ExpTransform(), D.SigmoidTransform(), D.TanhTransform(),
+                  D.AffineTransform(1.0, 3.0), D.PowerTransform(2.0)]:
+            xt = paddle.to_tensor(np.abs(x) + 0.1 if isinstance(
+                t, D.PowerTransform) else x)
+            y = t.forward(xt)
+            back = t.inverse(y)
+            np.testing.assert_allclose(_np(back), _np(xt),
+                                       rtol=1e-4, atol=1e-5)
+            # numeric log|dy/dx|
+            eps = 1e-3
+            y2 = t.forward(paddle.to_tensor(_np(xt).astype("float32") + eps))
+            num = np.log(np.abs((_np(y2) - _np(y)) / eps))
+            np.testing.assert_allclose(_np(t.forward_log_det_jacobian(xt)),
+                                       num, atol=1e-2)
+
+    def test_stickbreaking(self):
+        t = D.StickBreakingTransform()
+        x = paddle.to_tensor(np.array([0.3, -0.5], "float32"))
+        y = t.forward(x)
+        assert abs(_np(y).sum() - 1.0) < 1e-5
+        np.testing.assert_allclose(_np(t.inverse(y)), _np(x),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_inverse_ldj_power_chain_stack(self):
+        y = paddle.to_tensor(np.array([0.5, 2.0, 4.0], "float32"))
+        t = D.PowerTransform(2.0)
+        np.testing.assert_allclose(
+            _np(t.inverse_log_det_jacobian(y)),
+            -_np(t.forward_log_det_jacobian(t.inverse(y))),
+            rtol=1e-5)
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                                  D.ExpTransform()])
+        np.testing.assert_allclose(
+            _np(chain.inverse_log_det_jacobian(y)),
+            -_np(chain.forward_log_det_jacobian(chain.inverse(y))),
+            rtol=1e-5)
+        # TransformedDistribution with PowerTransform computes log_prob
+        d = D.TransformedDistribution(D.Exponential(1.0),
+                                      [D.PowerTransform(2.0)])
+        lp = d.log_prob(paddle.to_tensor(np.float32(1.5)))
+        # density of X^2 for X~Exp(1): f(y) = exp(-sqrt(y))/(2 sqrt(y))
+        ref = -np.sqrt(1.5) - np.log(2 * np.sqrt(1.5))
+        np.testing.assert_allclose(float(_np(lp)), ref, rtol=1e-4)
+
+    def test_multinomial_batched_count_raises(self):
+        m = D.Multinomial(np.array([3.0, 5.0], "float32"),
+                          np.full((2, 2), 0.5, "float32"))
+        with pytest.raises(ValueError, match="scalar total_count"):
+            m.sample()
+
+    def test_reshape_chain(self):
+        t = D.ReshapeTransform((4,), (2, 2))
+        x = paddle.to_tensor(np.arange(4, dtype="float32"))
+        y = t.forward(x)
+        assert y.shape == [2, 2]
+        np.testing.assert_allclose(_np(t.inverse(y)), _np(x))
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                                  D.ExpTransform()])
+        z = chain.forward(x)
+        np.testing.assert_allclose(_np(z), np.exp(2.0 * np.arange(4)),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(_np(chain.inverse(z)), _np(x),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestTransformedAndIndependent:
+    def test_lognormal_via_transform(self):
+        base = D.Normal(0.2, 0.7)
+        d = D.TransformedDistribution(base, [D.ExpTransform()])
+        ref = D.LogNormal(0.2, 0.7)
+        v = paddle.to_tensor(np.array([0.5, 1.0, 2.0], "float32"))
+        np.testing.assert_allclose(_np(d.log_prob(v)), _np(ref.log_prob(v)),
+                                   rtol=1e-4, atol=1e-5)
+        s = d.sample((5000,))
+        assert float(s.numpy().min()) > 0
+
+    def test_independent(self):
+        base = D.Normal(np.zeros((3, 2), "float32"),
+                        np.ones((3, 2), "float32"))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == (3,)
+        assert ind.event_shape == (2,)
+        v = paddle.to_tensor(np.ones((3, 2), "float32"))
+        np.testing.assert_allclose(
+            _np(ind.log_prob(v)), _np(base.log_prob(v)).sum(-1), rtol=1e-5)
+        kl = D.kl_divergence(
+            D.Independent(D.Normal(np.zeros(2, "float32"),
+                                   np.ones(2, "float32")), 1),
+            D.Independent(D.Normal(np.ones(2, "float32"),
+                                   np.ones(2, "float32")), 1))
+        np.testing.assert_allclose(float(_np(kl)), 1.0, rtol=1e-4)
+
+
+class TestAutograd:
+    def test_log_prob_grad(self):
+        loc = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+        scale = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        d = D.Normal(loc, scale)
+        lp = d.log_prob(paddle.to_tensor(np.float32(1.0)))
+        lp.backward()
+        # d/dloc logN = (v-loc)/scale^2
+        np.testing.assert_allclose(float(loc.grad.numpy()),
+                                   (1.0 - 0.5) / 4.0, rtol=1e-4)
+        # d/dscale = ((v-loc)^2/scale^2 - 1)/scale
+        np.testing.assert_allclose(float(scale.grad.numpy()),
+                                   ((0.25 / 4.0) - 1) / 2.0, rtol=1e-4)
+
+    def test_rsample_reparam_grad(self):
+        paddle.seed(7)
+        loc = paddle.to_tensor(np.float32(0.0), stop_gradient=False)
+        d = D.Normal(loc, 1.0)
+        s = d.rsample((256,))
+        loss = (s * s).mean()
+        loss.backward()
+        # E[d/dloc (loc+eps)^2] = 2 loc + 2 E[eps] ~ 0 at loc=0
+        assert abs(float(loc.grad.numpy())) < 0.3
+
+    def test_kl_grad(self):
+        p_loc = paddle.to_tensor(np.float32(0.5), stop_gradient=False)
+        kl = D.kl_divergence(D.Normal(p_loc, 1.0), D.Normal(0.0, 1.0))
+        kl.backward()
+        np.testing.assert_allclose(float(p_loc.grad.numpy()), 0.5,
+                                   rtol=1e-4)
+
+
+class TestJit:
+    def test_log_prob_under_jit(self):
+        import jax
+
+        @jax.jit
+        def f(v):
+            d = D.Normal(0.0, 1.0)
+            return d.log_prob(paddle.to_tensor(v))._value
+
+        out = f(np.float32(0.5))
+        np.testing.assert_allclose(np.asarray(out),
+                                   st.norm.logpdf(0.5), rtol=1e-5)
