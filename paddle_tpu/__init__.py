@@ -36,6 +36,9 @@ bool = bool_  # paddle.bool
 # functional tensor API (creation/math/manipulation/linalg/...)
 from paddle_tpu.tensor import *  # noqa: F401,F403
 from paddle_tpu.tensor import einsum  # noqa: F401
+# the star import binds `linalg` to paddle_tpu.tensor.linalg; rebind the
+# public `paddle.linalg` namespace module over it
+from paddle_tpu import linalg  # noqa: F401,E402
 
 # subpackages (paddle.nn, paddle.optimizer, ...)
 from paddle_tpu import nn  # noqa: F401
@@ -59,9 +62,16 @@ def __getattr__(name):
     import importlib
     if name in ("distributed", "vision", "distribution", "profiler",
                 "incubate", "sparse", "static", "hapi", "models", "fft",
-                "signal", "linalg_mod", "quantization", "geometric", "text",
-                "audio", "onnx", "utils", "sysconfig", "version"):
-        mod = importlib.import_module(f"paddle_tpu.{name}")
+                "signal", "linalg", "quantization", "geometric", "text",
+                "audio", "onnx", "utils", "inference", "sysconfig", "version"):
+        try:
+            mod = importlib.import_module(f"paddle_tpu.{name}")
+        except ModuleNotFoundError as e:
+            if e.name != f"paddle_tpu.{name}":
+                raise  # real dependency failure inside an existing submodule
+            # keep hasattr()/getattr() semantics for not-yet-built submodules
+            raise AttributeError(
+                f"module 'paddle_tpu' has no attribute {name!r}") from e
         globals()[name] = mod
         return mod
     if name == "Model":

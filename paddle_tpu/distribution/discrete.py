@@ -314,12 +314,25 @@ class Poisson(ExponentialFamily):
             U.value_arr(value), self.rate)
 
     def entropy(self):
-        """Series entropy -sum pmf*logpmf over a static window (exact to
-        float precision for rate << window; reference poisson.py does the
-        same truncation)."""
+        """Series entropy -sum pmf*logpmf over a window centred on each
+        rate (pmf mass lies within ~10 sigma of the rate, so a shifted
+        window of ~24*sqrt(rate_max) terms is exact to float precision for
+        any rate; a static 0-based window would silently lose the mass for
+        rate >~ window)."""
+        ra = U.arr(self.rate)
+        if isinstance(ra, jax.core.Tracer):
+            width, shift = self._ENTROPY_TERMS, False  # static under jit
+        else:
+            rmax = float(jnp.max(ra)) if ra.size else 0.0
+            shift = rmax + 10.0 * (rmax ** 0.5) + 16 > self._ENTROPY_TERMS
+            width = (int(min(8192, 24 * rmax ** 0.5 + 64)) if shift
+                     else self._ENTROPY_TERMS)
+
         def f(r):
-            ks = jnp.arange(self._ENTROPY_TERMS, dtype=jnp.float32)
             rb = jnp.asarray(r)[..., None]
+            kstart = (jnp.floor(jnp.maximum(rb - width / 2, 0.0)) if shift
+                      else jnp.zeros_like(rb))
+            ks = kstart + jnp.arange(width, dtype=jnp.float32)
             logpmf = jsp.xlogy(ks, rb) - rb - jsp.gammaln(ks + 1)
             ent = -jnp.sum(jnp.exp(logpmf) * logpmf, axis=-1)
             return ent.reshape(jnp.shape(r))

@@ -165,11 +165,9 @@ def _kl_gumbel_gumbel(p, q):
 
 @register_kl(LogNormal, LogNormal)
 def _kl_lognormal_lognormal(p, q):
-    # same as KL of the underlying normals
-    def f(l1, s1, l2, s2):
-        vr = (s1 / s2) ** 2
-        return 0.5 * (vr + ((l1 - l2) / s2) ** 2 - 1 - jnp.log(vr))
-    return U.op("kl_lognorm_lognorm", f, p.loc, p.scale, q.loc, q.scale)
+    # equals the KL of the underlying normals; delegate so any fix to the
+    # Normal closed form applies here too
+    return _kl_normal_normal(Normal(p.loc, p.scale), Normal(q.loc, q.scale))
 
 
 @register_kl(MultivariateNormal, MultivariateNormal)
@@ -212,6 +210,6 @@ def _kl_independent_independent(p, q):
         raise NotImplementedError(
             "Independent KL requires equal reinterpreted ranks")
     inner = kl_divergence(p.base, q.base)
-    arr = inner._value
     n = p.reinterpreted_batch_rank
-    return Tensor(jnp.sum(arr, axis=tuple(range(arr.ndim - n, arr.ndim))))
+    return U.op("kl_independent_sum", lambda a: jnp.sum(
+        a, axis=tuple(range(a.ndim - n, a.ndim))), inner)

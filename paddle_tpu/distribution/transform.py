@@ -295,24 +295,29 @@ class StackTransform(Transform):
         self.axis = axis
 
     def _apply(self, v, meth):
-        arrs = jnp.split(v, len(self.transforms), self.axis)
+        # slice -> per-transform method -> stack, all on the tape so grads
+        # flow through each sub-transform's parameters
+        from paddle_tpu import tensor as T
+        if not isinstance(v, Tensor):
+            v = Tensor(jnp.asarray(v))
+        arrs = T.split(v, len(self.transforms), self.axis)
         outs = []
         for t, a in zip(self.transforms, arrs):
-            r = getattr(t, meth)(Tensor(jnp.squeeze(a, self.axis)))
-            outs.append(r._value if isinstance(r, Tensor) else r)
-        return jnp.stack(outs, self.axis)
+            r = getattr(t, meth)(T.squeeze(a, self.axis))
+            outs.append(r if isinstance(r, Tensor) else Tensor(jnp.asarray(r)))
+        return T.stack(outs, self.axis)
 
     def forward(self, x):
-        return Tensor(self._apply(U.arr(x), "forward"))
+        return self._apply(x, "forward")
 
     def inverse(self, y):
-        return Tensor(self._apply(U.arr(y), "inverse"))
+        return self._apply(y, "inverse")
 
     def forward_log_det_jacobian(self, x):
-        return Tensor(self._apply(U.arr(x), "forward_log_det_jacobian"))
+        return self._apply(x, "forward_log_det_jacobian")
 
     def inverse_log_det_jacobian(self, y):
-        return Tensor(self._apply(U.arr(y), "inverse_log_det_jacobian"))
+        return self._apply(y, "inverse_log_det_jacobian")
 
 
 class IndependentTransform(Transform):
@@ -332,19 +337,20 @@ class IndependentTransform(Transform):
     def inverse(self, y):
         return self.base.inverse(y)
 
+    def _sum_rightmost(self, ldj):
+        if not isinstance(ldj, Tensor):
+            ldj = Tensor(jnp.asarray(ldj))
+        n = self.reinterpreted_batch_rank
+        if n == 0 or ldj.ndim == 0:
+            return ldj
+        return U.op("independent_transform_sum", lambda a: jnp.sum(
+            a, axis=tuple(range(a.ndim - n, a.ndim))), ldj)
+
     def forward_log_det_jacobian(self, x):
-        ldj = self.base.forward_log_det_jacobian(x)
-        arr = ldj._value if isinstance(ldj, Tensor) else jnp.asarray(ldj)
-        axes = tuple(range(arr.ndim - self.reinterpreted_batch_rank,
-                           arr.ndim))
-        return Tensor(jnp.sum(arr, axes)) if axes else Tensor(arr)
+        return self._sum_rightmost(self.base.forward_log_det_jacobian(x))
 
     def inverse_log_det_jacobian(self, y):
-        ldj = self.base.inverse_log_det_jacobian(y)
-        arr = ldj._value if isinstance(ldj, Tensor) else jnp.asarray(ldj)
-        axes = tuple(range(arr.ndim - self.reinterpreted_batch_rank,
-                           arr.ndim))
-        return Tensor(jnp.sum(arr, axes)) if axes else Tensor(arr)
+        return self._sum_rightmost(self.base.inverse_log_det_jacobian(y))
 
 
 class ChainTransform(Transform):

@@ -51,12 +51,13 @@ class TransformedDistribution(Distribution):
             x = t.inverse(y)
             ildj = t.inverse_log_det_jacobian(y)
             ndiff = event_dim - t._codomain_event_dim
-            arr = ildj._value if isinstance(ildj, Tensor) else jnp.asarray(
-                ildj)
-            if ndiff > 0 and arr.ndim >= ndiff:
-                arr = jnp.sum(arr, axis=tuple(range(arr.ndim - ndiff,
-                                                    arr.ndim)))
-            term = Tensor(arr)
+            term = ildj if isinstance(ildj, Tensor) else Tensor(
+                jnp.asarray(ildj))
+            if ndiff > 0 and term.ndim >= ndiff:
+                # tape-preserving trailing-axis sum: grads must flow to
+                # transform parameters through the Jacobian term
+                term = U.op("tdist_ildj_sum", lambda a, nd=ndiff: jnp.sum(
+                    a, axis=tuple(range(a.ndim - nd, a.ndim))), term)
             lp = term if lp is None else T.add(lp, term)
             event_dim = t._domain_event_dim + max(
                 event_dim - t._codomain_event_dim, 0)
@@ -64,9 +65,8 @@ class TransformedDistribution(Distribution):
         base_lp = self.base.log_prob(y)
         ndiff = event_dim - len(self.base.event_shape)
         if ndiff > 0:
-            arr = base_lp._value
-            arr = jnp.sum(arr, axis=tuple(range(arr.ndim - ndiff, arr.ndim)))
-            base_lp = Tensor(arr)
+            base_lp = U.op("tdist_base_sum", lambda a, nd=ndiff: jnp.sum(
+                a, axis=tuple(range(a.ndim - nd, a.ndim))), base_lp)
         return T.add(base_lp, lp) if lp is not None else base_lp
 
 
@@ -99,14 +99,12 @@ class Independent(Distribution):
 
     def log_prob(self, value):
         lp = self.base.log_prob(value)
-        arr = lp._value
         n = self.reinterpreted_batch_rank
-        return Tensor(jnp.sum(arr, axis=tuple(range(arr.ndim - n,
-                                                    arr.ndim))))
+        return U.op("independent_sum", lambda a: jnp.sum(
+            a, axis=tuple(range(a.ndim - n, a.ndim))), lp)
 
     def entropy(self):
         ent = self.base.entropy()
-        arr = ent._value
         n = self.reinterpreted_batch_rank
-        return Tensor(jnp.sum(arr, axis=tuple(range(arr.ndim - n,
-                                                    arr.ndim))))
+        return U.op("independent_sum", lambda a: jnp.sum(
+            a, axis=tuple(range(a.ndim - n, a.ndim))), ent)

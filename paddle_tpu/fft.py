@@ -1,0 +1,142 @@
+"""`paddle.fft` — discrete Fourier transforms (reference: python/paddle/fft.py).
+
+The reference routes these to pocketfft (CPU) / cuFFT (GPU) kernels; here
+every transform lowers to XLA's FFT HLO via jnp.fft, which TPU executes
+natively. Normalization-mode semantics ('forward' | 'backward' | 'ortho')
+match the reference (`fft.py:_check_normalization`).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    'fft', 'ifft', 'rfft', 'irfft', 'hfft', 'ihfft',
+    'fft2', 'ifft2', 'rfft2', 'irfft2', 'hfft2', 'ihfft2',
+    'fftn', 'ifftn', 'rfftn', 'irfftn', 'hfftn', 'ihfftn',
+    'fftfreq', 'rfftfreq', 'fftshift', 'ifftshift',
+]
+
+
+def _norm(norm):
+    if norm not in ('forward', 'backward', 'ortho'):
+        raise ValueError(
+            f"Unexpected norm: {norm}. Norm should be forward, backward or ortho")
+    return norm
+
+
+def _mk1d(jnp_fn, opname):
+    @defop(opname)
+    def op(x, n=None, axis=-1, norm="backward"):
+        return jnp_fn(x, n=n, axis=axis, norm=_norm(norm))
+
+    def api(x, n=None, axis=-1, norm="backward", name=None):
+        return op(x, n=n, axis=axis, norm=norm)
+
+    api.__name__ = opname
+    return api
+
+
+def _mknd(jnp_fn, opname, default_axes):
+    @defop(opname)
+    def op(x, s=None, axes=default_axes, norm="backward"):
+        return jnp_fn(x, s=s, axes=axes, norm=_norm(norm))
+
+    def api(x, s=None, axes=default_axes, norm="backward", name=None):
+        if axes is not None:
+            axes = tuple(axes)
+        return op(x, s=tuple(s) if s is not None else None, axes=axes,
+                  norm=norm)
+
+    api.__name__ = opname
+    return api
+
+
+fft = _mk1d(jnp.fft.fft, "fft")
+ifft = _mk1d(jnp.fft.ifft, "ifft")
+rfft = _mk1d(jnp.fft.rfft, "rfft")
+irfft = _mk1d(jnp.fft.irfft, "irfft")
+hfft = _mk1d(jnp.fft.hfft, "hfft")
+ihfft = _mk1d(jnp.fft.ihfft, "ihfft")
+
+fft2 = _mknd(jnp.fft.fft2, "fft2", (-2, -1))
+ifft2 = _mknd(jnp.fft.ifft2, "ifft2", (-2, -1))
+rfft2 = _mknd(jnp.fft.rfft2, "rfft2", (-2, -1))
+irfft2 = _mknd(jnp.fft.irfft2, "irfft2", (-2, -1))
+fftn = _mknd(jnp.fft.fftn, "fftn", None)
+ifftn = _mknd(jnp.fft.ifftn, "ifftn", None)
+rfftn = _mknd(jnp.fft.rfftn, "rfftn", None)
+irfftn = _mknd(jnp.fft.irfftn, "irfftn", None)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+@defop("hfftn")
+def _hfftn(x, s=None, axes=None, norm="backward"):
+    # hermitian-input FFT: forward fftn over leading axes, hfft over the
+    # last (matches scipy.fft.hfftn == irfftn(conj(x)) up to scale)
+    _norm(norm)
+    axes = tuple(range(-x.ndim, 0)) if axes is None else tuple(axes)
+    last = axes[-1]
+    n_last = None if s is None else s[-1]
+    if len(axes) > 1:
+        pre_s = None if s is None else tuple(s[:-1])
+        x = jnp.fft.fftn(x, s=pre_s, axes=axes[:-1], norm=norm)
+    return jnp.fft.hfft(x, n=n_last, axis=last, norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _hfftn(x, s=tuple(s) if s is not None else None,
+                  axes=tuple(axes) if axes is not None else None, norm=norm)
+
+
+@defop("ihfftn")
+def _ihfftn(x, s=None, axes=None, norm="backward"):
+    _norm(norm)
+    axes = tuple(range(-x.ndim, 0)) if axes is None else tuple(axes)
+    last = axes[-1]
+    n_last = None if s is None else s[-1]
+    out = jnp.fft.ihfft(x, n=n_last, axis=last, norm=norm)
+    if len(axes) > 1:
+        pre_s = None if s is None else tuple(s[:-1])
+        out = jnp.fft.ifftn(out, s=pre_s, axes=axes[:-1], norm=norm)
+    return out
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _ihfftn(x, s=tuple(s) if s is not None else None,
+                   axes=tuple(axes) if axes is not None else None, norm=norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d=d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d=d).astype(dtype or jnp.float32))
+
+
+@defop("fftshift")
+def _fftshift(x, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+def fftshift(x, axes=None, name=None):
+    return _fftshift(x, axes=tuple(axes) if axes is not None else None)
+
+
+@defop("ifftshift")
+def _ifftshift(x, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)
+
+
+def ifftshift(x, axes=None, name=None):
+    return _ifftshift(x, axes=tuple(axes) if axes is not None else None)

@@ -1,0 +1,46 @@
+"""`paddle.linalg` namespace (reference: python/paddle/linalg.py).
+
+Pure re-export of the linear-algebra ops implemented in
+paddle_tpu.tensor.linalg — all of them lower to XLA dot_general /
+batched LAPACK custom-calls, which XLA schedules onto the MXU where
+possible.
+"""
+from paddle_tpu.tensor.linalg import (  # noqa: F401
+    cholesky,
+    cholesky_solve,
+    cond,
+    corrcoef,
+    cov,
+    det,
+    eig,
+    eigh,
+    eigvals,
+    eigvalsh,
+    householder_product,
+    lstsq,
+    lu,
+    lu_unpack,
+    matrix_exp,
+    matrix_norm,
+    matrix_power,
+    matrix_rank,
+    multi_dot,
+    norm,
+    pca_lowrank,
+    pinv,
+    qr,
+    slogdet,
+    solve,
+    svd,
+    triangular_solve,
+    vector_norm,
+)
+from paddle_tpu.tensor.linalg import inverse as inv  # noqa: F401
+
+__all__ = [
+    'cholesky', 'norm', 'matrix_norm', 'vector_norm', 'cond', 'cov',
+    'corrcoef', 'inv', 'eig', 'eigvals', 'multi_dot', 'matrix_rank',
+    'svd', 'qr', 'householder_product', 'pca_lowrank', 'lu', 'lu_unpack',
+    'matrix_exp', 'matrix_power', 'det', 'slogdet', 'eigh', 'eigvalsh',
+    'pinv', 'solve', 'cholesky_solve', 'triangular_solve', 'lstsq',
+]

@@ -1,0 +1,144 @@
+"""`paddle.signal` — STFT/ISTFT (reference: python/paddle/signal.py).
+
+frame/overlap_add are expressed as gather/scatter-add over XLA ops;
+stft/istft compose them with rfft/irfft. Everything routes through the
+op dispatcher so gradients flow to both the signal and the window
+(spectral losses are a training use-case), and the whole pipeline is
+static-shape so it jits onto TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ['stft', 'istft', 'frame', 'overlap_add']
+
+
+@defop("frame")
+def _frame(x, frame_length, hop_length, axis=-1):
+    n = x.shape[axis]
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(num_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])        # (F, L)
+    frames = jnp.take(x, idx.reshape(-1), axis=axis)
+    shp = list(x.shape)
+    ax = axis % x.ndim
+    new_shape = shp[:ax] + [num_frames, frame_length] + shp[ax + 1:]
+    frames = frames.reshape(new_shape)
+    if axis == -1 or ax == x.ndim - 1:
+        # paddle returns (..., frame_length, num_frames) for axis=-1
+        frames = jnp.swapaxes(frames, -1, -2)
+    return frames
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    return _frame(x, frame_length, hop_length, axis=axis)
+
+
+@defop("overlap_add")
+def _overlap_add(x, hop_length, axis=-1):
+    # axis=-1: x is (..., frame_length, num_frames)
+    # axis=0:  x is (num_frames, frame_length, ...)
+    if axis == -1 or axis == x.ndim - 1:
+        frames = jnp.swapaxes(x, -1, -2)  # (..., F, L)
+    else:  # axis == 0: (F, L, *batch) -> (*batch, F, L)
+        frames = jnp.moveaxis(jnp.moveaxis(x, 0, -1), 0, -1)
+    F, L = frames.shape[-2], frames.shape[-1]
+    n = (F - 1) * hop_length + L
+    idx = (jnp.arange(F)[:, None] * hop_length + jnp.arange(L)[None, :])
+    out = jnp.zeros(frames.shape[:-2] + (n,), dtype=x.dtype)
+    out = out.at[..., idx.reshape(-1)].add(frames.reshape(frames.shape[:-2] + (-1,)))
+    if not (axis == -1 or axis == x.ndim - 1):
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    return _overlap_add(x, hop_length, axis=axis)
+
+
+def _padded_window(wv, win_length, n_fft):
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        wv = jnp.pad(wv, (lpad, n_fft - win_length - lpad))
+    return wv
+
+
+@defop("stft")
+def _stft(x, window, n_fft, hop_length, win_length, center, pad_mode,
+          normalized, onesided):
+    wv = _padded_window(window, win_length, n_fft)
+    if center:
+        pad = n_fft // 2
+        pad_widths = [(0, 0)] * (x.ndim - 1) + [(pad, pad)]
+        x = jnp.pad(x, pad_widths, mode=pad_mode)
+    frames = _frame.raw_fn(x, n_fft, hop_length, axis=-1)  # (..., n_fft, F)
+    frames = frames * wv[..., :, None]
+    spec = (jnp.fft.rfft(frames, axis=-2) if onesided
+            else jnp.fft.fft(frames, axis=-2))
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return spec
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode='reflect', normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference: python/paddle/signal.py stft).
+
+    Returns (..., n_fft//2+1 if onesided else n_fft, num_frames), complex.
+    """
+    if hop_length is None:
+        hop_length = n_fft // 4
+    if win_length is None:
+        win_length = n_fft
+    if window is None:
+        window = Tensor(jnp.ones((win_length,), dtype=jnp.float32))
+    return _stft(x, window, n_fft, hop_length, win_length, center, pad_mode,
+                 normalized, onesided)
+
+
+@defop("istft")
+def _istft(x, window, n_fft, hop_length, win_length, center, normalized,
+           onesided, length, return_complex):
+    wv = _padded_window(window, win_length, n_fft)
+    if normalized:
+        x = x * jnp.sqrt(jnp.asarray(n_fft, x.real.dtype))
+    if onesided:
+        frames = jnp.fft.irfft(x, n=n_fft, axis=-2)  # (..., n_fft, F)
+    else:
+        frames = jnp.fft.ifft(x, axis=-2)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * wv[..., :, None]
+    y = _overlap_add.raw_fn(frames, hop_length, axis=-1)
+    wsq = jnp.broadcast_to((wv * wv)[:, None], (n_fft, x.shape[-1]))
+    env = _overlap_add.raw_fn(wsq, hop_length, axis=-1)
+    y = y / jnp.where(env > 1e-11, env, 1.0)
+    if center:
+        pad = n_fft // 2
+        y = y[..., pad:]
+        env_len = y.shape[-1]
+        y = y[..., : env_len - pad] if length is None else y
+    if length is not None:
+        y = y[..., :length]
+    return y
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (reference:
+    python/paddle/signal.py istft)."""
+    if onesided and return_complex:
+        raise ValueError("istft: return_complex=True requires onesided=False")
+    if hop_length is None:
+        hop_length = n_fft // 4
+    if win_length is None:
+        win_length = n_fft
+    if window is None:
+        window = Tensor(jnp.ones((win_length,), dtype=jnp.float32))
+    return _istft(x, window, n_fft, hop_length, win_length, center,
+                  normalized, onesided, length, return_complex)

@@ -289,3 +289,94 @@ def corrcoef(x, rowvar=True):
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
     return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
                    fweights=fweights, aweights=aweights)
+
+
+@defop("matrix_norm", amp_policy="black")
+def _matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return _matrix_norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+@defop("vector_norm", amp_policy="black")
+def _vector_norm(x, p=2.0, axis=None, keepdim=False):
+    if axis is None:
+        # reduce over ALL axes; keepdim must preserve rank (reference
+        # sets axis=list(range(x.ndim)) when axis is None)
+        out = jnp.linalg.norm(x.reshape(-1), ord=p, axis=0)
+        return out.reshape((1,) * x.ndim) if keepdim else out
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return _vector_norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+@defop("lu_unpack_l_u", differentiable=False)
+def _lu_unpack_l_u(lu_data):
+    m, n = lu_data.shape[-2], lu_data.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(lu_data[..., :, :k], k=-1) + jnp.eye(m, k, dtype=lu_data.dtype)
+    U = jnp.triu(lu_data[..., :k, :])
+    return L, U
+
+
+@defop("lu_unpack_p", differentiable=False)
+def _lu_unpack_p(lu_data, lu_pivots):
+    # pivots (1-based sequential swaps) -> permutation matrix; batched
+    m = lu_data.shape[-2]
+    piv = lu_pivots - 1                       # (..., k)
+    batch = piv.shape[:-1]
+    perm = jnp.broadcast_to(jnp.arange(m), batch + (m,))
+    for i in range(piv.shape[-1]):
+        j = piv[..., i]                        # (...,)
+        pi = perm[..., i]
+        pj = jnp.take_along_axis(perm, j[..., None], axis=-1)[..., 0]
+        perm = perm.at[..., i].set(pj)
+        perm = jnp.where(jnp.arange(m) == j[..., None], pi[..., None], perm)
+    P = jnp.take(jnp.eye(m, dtype=lu_data.dtype), perm, axis=0)  # (...,m,m)
+    return jnp.swapaxes(P, -1, -2)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    P = _lu_unpack_p(x, y) if unpack_pivots else None
+    if unpack_ludata:
+        L, U = _lu_unpack_l_u(x)
+    else:
+        L = U = None
+    return P, L, U
+
+
+@defop("pca_lowrank", differentiable=False)
+def _pca_lowrank(x, omega, center=True, niter=2):
+    if center:
+        x = x - jnp.mean(x, axis=-2, keepdims=True)
+    # randomized range finder with power iterations
+    Y = x @ omega
+    Q_, _ = jnp.linalg.qr(Y)
+    for _ in range(niter):
+        Z = jnp.swapaxes(x, -1, -2) @ Q_
+        Qz, _ = jnp.linalg.qr(Z)
+        Y = x @ Qz
+        Q_, _ = jnp.linalg.qr(Y)
+    B = jnp.swapaxes(Q_, -1, -2) @ x
+    u, s, vh = jnp.linalg.svd(B, full_matrices=False)
+    return Q_ @ u, s, jnp.swapaxes(vh, -1, -2)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Low-rank PCA via randomized SVD (reference:
+    python/paddle/tensor/linalg.py pca_lowrank). Non-differentiable like
+    svd; the projection basis omega is drawn from the global Generator
+    outside the op so jit tracing stays pure."""
+    from paddle_tpu.core.random import next_key
+    shape = tuple(x.shape)
+    m, n = shape[-2], shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    dt = x.dtype if not isinstance(x, Tensor) else x._value.dtype
+    omega = Tensor(jax.random.normal(next_key(), shape[:-2] + (n, q),
+                                     dtype=dt))
+    return _pca_lowrank(x, omega, center=center, niter=niter)

@@ -425,3 +425,34 @@ class TestJit:
         out = f(np.float32(0.5))
         np.testing.assert_allclose(np.asarray(out),
                                    st.norm.logpdf(0.5), rtol=1e-5)
+
+
+def test_transformed_distribution_grad_flows_to_transform_params():
+    Normal, TransformedDistribution = D.Normal, D.TransformedDistribution
+    AffineTransform = D.AffineTransform
+    # analytic: log_prob(y)=logN(y/s)-log s => d/ds at y=1,s=2 is -0.375
+    scale = paddle.to_tensor(2.0)
+    scale.stop_gradient = False
+    d = TransformedDistribution(
+        Normal(paddle.to_tensor(0.0), paddle.to_tensor(1.0)),
+        [AffineTransform(paddle.to_tensor(0.0), scale)])
+    d.log_prob(paddle.to_tensor(1.0)).backward()
+    np.testing.assert_allclose(float(scale.grad.numpy()), -0.375, atol=1e-5)
+
+
+def test_independent_log_prob_grad():
+    Normal, Independent = D.Normal, D.Independent
+    loc = paddle.to_tensor(np.zeros(2, np.float32))
+    loc.stop_gradient = False
+    ind = Independent(Normal(loc, paddle.to_tensor(np.ones(2, np.float32))), 1)
+    lp = ind.log_prob(paddle.to_tensor(np.array([1.0, -1.0], np.float32)))
+    assert not lp.stop_gradient
+    lp.backward()
+    np.testing.assert_allclose(loc.grad.numpy(), [1.0, -1.0], atol=1e-6)
+
+
+def test_poisson_entropy_large_rate():
+    Poisson = D.Poisson
+    ent = float(Poisson(paddle.to_tensor(1000.0)).entropy().numpy())
+    approx = 0.5 * np.log(2 * np.pi * np.e * 1000.0)  # gaussian limit
+    assert abs(ent - approx) < 0.01

@@ -1,0 +1,192 @@
+"""paddle.linalg / paddle.fft / paddle.signal namespace tests.
+
+Reference behaviours: python/paddle/linalg.py (29-export namespace),
+python/paddle/fft.py, python/paddle/signal.py. Checked against numpy.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_linalg_namespace_complete():
+    expected = [
+        'cholesky', 'norm', 'matrix_norm', 'vector_norm', 'cond', 'cov',
+        'corrcoef', 'inv', 'eig', 'eigvals', 'multi_dot', 'matrix_rank',
+        'svd', 'qr', 'householder_product', 'pca_lowrank', 'lu', 'lu_unpack',
+        'matrix_exp', 'matrix_power', 'det', 'slogdet', 'eigh', 'eigvalsh',
+        'pinv', 'solve', 'cholesky_solve', 'triangular_solve', 'lstsq',
+    ]
+    for name in expected:
+        assert hasattr(paddle.linalg, name), name
+
+
+def test_linalg_basic_numerics():
+    rng = np.random.RandomState(0)
+    a = rng.randn(4, 4).astype(np.float32)
+    spd = (a @ a.T + 4 * np.eye(4)).astype(np.float32)
+    x = paddle.to_tensor(spd)
+
+    np.testing.assert_allclose(paddle.linalg.inv(x).numpy(),
+                               np.linalg.inv(spd), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(paddle.linalg.det(x).numpy(),
+                               np.linalg.det(spd), rtol=1e-3)
+    L = paddle.linalg.cholesky(x).numpy()
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+
+    mn = paddle.linalg.matrix_norm(x).numpy()
+    np.testing.assert_allclose(mn, np.linalg.norm(spd, 'fro'), rtol=1e-5)
+    vn = paddle.linalg.vector_norm(x).numpy()
+    np.testing.assert_allclose(vn, np.linalg.norm(spd.ravel()), rtol=1e-5)
+
+
+def test_lu_unpack_roundtrip():
+    rng = np.random.RandomState(1)
+    a = rng.randn(5, 5).astype(np.float32)
+    x = paddle.to_tensor(a)
+    lu_t, piv = paddle.linalg.lu(x)
+    P, L, U = paddle.linalg.lu_unpack(lu_t, piv)
+    recon = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(recon, a, rtol=1e-4, atol=1e-4)
+
+
+def test_pca_lowrank_shapes():
+    rng = np.random.RandomState(2)
+    a = rng.randn(20, 8).astype(np.float32)
+    u, s, v = paddle.linalg.pca_lowrank(paddle.to_tensor(a), q=4)
+    assert u.shape == [20, 4] and s.shape == [4] and v.shape == [8, 4]
+    # principal subspace of a rank-deficient matrix is recovered
+    b = (rng.randn(20, 2) @ rng.randn(2, 8)).astype(np.float32)
+    u, s, v = paddle.linalg.pca_lowrank(paddle.to_tensor(b), q=4)
+    assert float(s.numpy()[2]) < 1e-3 * float(s.numpy()[0]) + 1e-4
+
+
+@pytest.mark.parametrize("fn,np_fn", [
+    ("fft", np.fft.fft), ("ifft", np.fft.ifft), ("rfft", np.fft.rfft),
+])
+def test_fft_1d(fn, np_fn):
+    rng = np.random.RandomState(3)
+    a = rng.randn(16).astype(np.float32)
+    out = getattr(paddle.fft, fn)(paddle.to_tensor(a)).numpy()
+    np.testing.assert_allclose(out, np_fn(a), rtol=1e-4, atol=1e-4)
+
+
+def test_fft_norm_modes_and_nd():
+    rng = np.random.RandomState(4)
+    a = rng.randn(4, 8).astype(np.float32)
+    x = paddle.to_tensor(a)
+    for norm in ("backward", "forward", "ortho"):
+        np.testing.assert_allclose(
+            paddle.fft.fft2(x, norm=norm).numpy(),
+            np.fft.fft2(a, norm=norm), rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError):
+        paddle.fft.fft(x, norm="bogus")
+    np.testing.assert_allclose(paddle.fft.fftn(x).numpy(), np.fft.fftn(a),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        paddle.fft.irfft(paddle.fft.rfft(x), n=8).numpy(), a,
+        rtol=1e-4, atol=1e-4)
+
+
+def test_fft_helpers():
+    np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                               np.fft.fftfreq(8, d=0.5).astype(np.float32))
+    a = np.arange(8, dtype=np.float32)
+    np.testing.assert_allclose(
+        paddle.fft.fftshift(paddle.to_tensor(a)).numpy(), np.fft.fftshift(a))
+    np.testing.assert_allclose(
+        paddle.fft.ifftshift(paddle.to_tensor(a)).numpy(), np.fft.ifftshift(a))
+
+
+def test_fft_grad_flows():
+    x = paddle.to_tensor(np.random.RandomState(5).randn(8).astype(np.float32))
+    x.stop_gradient = False
+    y = paddle.fft.rfft(x)
+    loss = (y.abs() ** 2).sum()
+    loss.backward()
+    assert x.grad is not None and x.grad.shape == [8]
+
+
+def test_stft_istft_roundtrip():
+    rng = np.random.RandomState(6)
+    sig = rng.randn(1, 256).astype(np.float32)
+    window = np.hanning(64).astype(np.float32)
+    spec = paddle.signal.stft(paddle.to_tensor(sig), n_fft=64, hop_length=16,
+                              window=paddle.to_tensor(window))
+    assert spec.shape[-2] == 33  # onesided bins
+    recon = paddle.signal.istft(spec, n_fft=64, hop_length=16,
+                                window=paddle.to_tensor(window),
+                                length=256).numpy()
+    np.testing.assert_allclose(recon[0, 32:-32], sig[0, 32:-32],
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_frame_overlap_add():
+    a = np.arange(10, dtype=np.float32)
+    f = paddle.signal.frame(paddle.to_tensor(a), frame_length=4, hop_length=2)
+    assert f.shape == [4, 4]  # (frame_length, num_frames)
+    # overlap_add of disjoint frames (hop == frame_length) reconstructs
+    f2 = paddle.signal.frame(paddle.to_tensor(a[:8]), frame_length=4,
+                             hop_length=4)
+    y = paddle.signal.overlap_add(f2, hop_length=4).numpy()
+    np.testing.assert_allclose(y, a[:8])
+
+
+def test_hfft2_matches_scipy():
+    import scipy.fft as sf
+    rng = np.random.RandomState(7)
+    x = (rng.randn(4, 6) + 1j * rng.randn(4, 6)).astype(np.complex64)
+    np.testing.assert_allclose(paddle.fft.hfft2(paddle.to_tensor(x)).numpy(),
+                               sf.hfft2(x), rtol=1e-3, atol=1e-3)
+    r = rng.randn(4, 6).astype(np.float32)
+    np.testing.assert_allclose(paddle.fft.ihfft2(paddle.to_tensor(r)).numpy(),
+                               sf.ihfft2(r), rtol=1e-3, atol=1e-4)
+
+
+def test_lu_unpack_batched_and_flags():
+    rng = np.random.RandomState(8)
+    a = rng.randn(2, 4, 4).astype(np.float32)
+    x = paddle.to_tensor(a)
+    lu_t, piv = paddle.linalg.lu(x)
+    P, L, U = paddle.linalg.lu_unpack(lu_t, piv)
+    recon = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(recon, a, rtol=1e-4, atol=1e-4)
+    P2, L2, U2 = paddle.linalg.lu_unpack(lu_t, piv, unpack_ludata=False)
+    assert L2 is None and U2 is None and P2 is not None
+    P3, L3, U3 = paddle.linalg.lu_unpack(lu_t, piv, unpack_pivots=False)
+    assert P3 is None and L3 is not None
+
+
+def test_overlap_add_axis0_3d():
+    x = np.arange(24, dtype=np.float32).reshape(3, 4, 2)  # (F, L, batch)
+    y = paddle.signal.overlap_add(paddle.to_tensor(x), hop_length=4,
+                                  axis=0).numpy()
+    assert y.shape == (12, 2)
+    np.testing.assert_allclose(y, x.transpose(2, 0, 1).reshape(2, 12).T)
+
+
+def test_istft_return_complex_contract():
+    spec = paddle.signal.stft(paddle.to_tensor(
+        np.random.RandomState(9).randn(1, 128).astype(np.float32)),
+        n_fft=32, hop_length=8)
+    with pytest.raises(ValueError):
+        paddle.signal.istft(spec, n_fft=32, hop_length=8, return_complex=True)
+
+
+def test_missing_submodule_is_attribute_error():
+    assert not hasattr(paddle, "definitely_not_a_module")
+
+
+def test_stft_differentiable():
+    x = paddle.to_tensor(
+        np.random.RandomState(10).randn(1, 128).astype(np.float32))
+    x.stop_gradient = False
+    spec = paddle.signal.stft(x, n_fft=32, hop_length=8)
+    assert not spec.stop_gradient
+    (spec.abs() ** 2).sum().backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+def test_vector_norm_keepdim_preserves_rank():
+    v = paddle.linalg.vector_norm(paddle.ones([3, 4]), keepdim=True)
+    assert v.shape == [1, 1]
