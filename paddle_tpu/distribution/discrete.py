@@ -321,17 +321,17 @@ class Poisson(ExponentialFamily):
         rate >~ window)."""
         ra = U.arr(self.rate)
         if isinstance(ra, jax.core.Tracer):
-            width, shift = self._ENTROPY_TERMS, False  # static under jit
+            # static width under jit; the rate-centred shift below is
+            # traceable so large rates stay accurate up to ~(width/10)^2
+            width = self._ENTROPY_TERMS
         else:
             rmax = float(jnp.max(ra)) if ra.size else 0.0
-            shift = rmax + 10.0 * (rmax ** 0.5) + 16 > self._ENTROPY_TERMS
-            width = (int(min(8192, 24 * rmax ** 0.5 + 64)) if shift
-                     else self._ENTROPY_TERMS)
+            width = int(min(8192, max(self._ENTROPY_TERMS,
+                                      24 * rmax ** 0.5 + 64)))
 
         def f(r):
             rb = jnp.asarray(r)[..., None]
-            kstart = (jnp.floor(jnp.maximum(rb - width / 2, 0.0)) if shift
-                      else jnp.zeros_like(rb))
+            kstart = jnp.floor(jnp.maximum(rb - width / 2, 0.0))
             ks = kstart + jnp.arange(width, dtype=jnp.float32)
             logpmf = jsp.xlogy(ks, rb) - rb - jsp.gammaln(ks + 1)
             ent = -jnp.sum(jnp.exp(logpmf) * logpmf, axis=-1)

@@ -1,0 +1,531 @@
+"""`paddle.sparse` — COO/CSR sparse tensors (reference: python/paddle/sparse/,
+C++ types paddle/phi/core/sparse_coo_tensor.h, sparse_csr_tensor.h, kernels
+paddle/phi/kernels/sparse/).
+
+TPU-native design: XLA has no sparse buffer type, and TPU sparse compute is
+idiomatically expressed as gather / scatter-add / segment-sum over dense
+index+value arrays — which is exactly the COO/CSR decomposition. So a sparse
+tensor here is a pair of arrays (indices + values) where the VALUES live on
+the autograd tape (a paddle Tensor) and the indices are static jax arrays:
+every op below is a defop over the values (and any dense operand), so
+gradients flow exactly like the reference's sparse autograd, and everything
+jits. matmul lowers to one gather + one segment-sum — the XLA-friendly spmv.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop, dispatch, OpDef
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    'sparse_coo_tensor', 'sparse_csr_tensor',
+    'sin', 'tan', 'asin', 'atan', 'sinh', 'tanh', 'asinh', 'atanh',
+    'sqrt', 'square', 'log1p', 'abs', 'pow', 'cast', 'neg', 'deg2rad',
+    'rad2deg', 'expm1',
+    'mv', 'matmul', 'masked_matmul', 'addmm',
+    'add', 'subtract', 'multiply', 'divide',
+    'transpose', 'sum', 'coalesce', 'is_same_shape', 'reshape', 'isnan',
+    'slice',
+]
+
+
+def _values_tensor(v):
+    return v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+
+
+def _idx_arr(x):
+    if isinstance(x, Tensor):
+        x = x._value
+    return jnp.asarray(x).astype(jnp.int32)
+
+
+def _vop(name, fn, *tensors, **kw):
+    """Run fn through the eager dispatcher so values stay on the tape."""
+    return dispatch(OpDef("sparse." + name, fn), tensors, kw)
+
+
+class SparseCooTensor:
+    """COO sparse tensor: indices (ndim, nnz) int32 + values (nnz, ...)
+    (reference: paddle/phi/core/sparse_coo_tensor.h)."""
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        self._indices = _idx_arr(indices)
+        self._values = _values_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        self._coalesced = bool(coalesced)
+
+    # -- paddle Tensor-protocol surface ------------------------------------
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self):
+        return int(self._indices.shape[1])
+
+    @property
+    def sparse_dim(self):
+        # hybrid COO: index rows may cover only the leading dims, with the
+        # rest carried as trailing dense dims of the values
+        return int(self._indices.shape[0])
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+    def indices(self):
+        return Tensor(self._indices)
+
+    def values(self):
+        return self._values
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def to_dense(self):
+        idx = tuple(self._indices[d] for d in range(self._indices.shape[0]))
+        shape = self._shape
+
+        def f(v):
+            dense = jnp.zeros(shape, v.dtype)
+            return dense.at[idx].add(v)
+        return _vop("coo_to_dense", f, self._values)
+
+    def to_sparse_csr(self):
+        coo = self.coalesce() if not self._coalesced else self
+        if coo.ndim != 2:
+            raise ValueError("to_sparse_csr requires a 2-D sparse tensor")
+        rows, cols = coo._indices[0], coo._indices[1]
+        nrows = coo._shape[0]
+        crows = jnp.cumsum(jnp.bincount(rows, length=nrows))
+        crows = jnp.concatenate([jnp.zeros((1,), crows.dtype), crows])
+        return SparseCsrTensor(crows, cols, coo._values, coo._shape)
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return self
+
+    def coalesce(self):
+        """Sum duplicate indices (reference: sparse/unary.py coalesce op)."""
+        # int32 linear ids: fine for any shape XLA can index on TPU
+        sd = self.sparse_dim
+        lin = jnp.zeros((self.nnz,), jnp.int32)
+        for d in range(sd):
+            lin = lin * self._shape[d] + self._indices[d]
+        uniq, inv = jnp.unique(lin, return_inverse=True, size=self.nnz,
+                               fill_value=-1)
+        n_uniq = int(jnp.sum(uniq >= 0))
+        # positions of unique linear ids, decomposed back to nd indices
+        uu = uniq[:n_uniq]
+        nd = []
+        rem = uu
+        for d in reversed(range(sd)):
+            nd.append(rem % self._shape[d])
+            rem = rem // self._shape[d]
+        new_idx = jnp.stack(list(reversed(nd))).astype(jnp.int32)
+        # jnp.unique(size=...) pads with fill_value at the END, so inverse
+        # ids already index uniq[:n_uniq] directly
+
+        def f(v):
+            out = jnp.zeros((n_uniq,) + v.shape[1:], v.dtype)
+            return out.at[inv.reshape(-1)].add(v)
+        vals = _vop("coo_coalesce", f, self._values)
+        return SparseCooTensor(new_idx, vals, self._shape, coalesced=True)
+
+    def t(self):
+        return transpose(self, [1, 0])
+
+    def numpy(self):
+        return np.asarray(self.to_dense().numpy())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+    def backward(self, *a, **k):
+        return self._values.backward(*a, **k)
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix: crows (rows+1), cols (nnz), values (nnz)
+    (reference: paddle/phi/core/sparse_csr_tensor.h)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = _idx_arr(crows)
+        self._cols = _idx_arr(cols)
+        self._values = _values_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def nnz(self):
+        return int(self._cols.shape[0])
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return self._values
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def _row_indices(self):
+        counts = jnp.diff(self._crows)
+        return jnp.repeat(jnp.arange(self._shape[0], dtype=jnp.int32),
+                          counts, total_repeat_length=self.nnz)
+
+    def to_sparse_coo(self, sparse_dim=None):
+        idx = jnp.stack([self._row_indices(), self._cols])
+        return SparseCooTensor(idx, self._values, self._shape,
+                               coalesced=True)
+
+    def to_sparse_csr(self):
+        return self
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def numpy(self):
+        return np.asarray(self.to_dense().numpy())
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+    def backward(self, *a, **k):
+        return self._values.backward(*a, **k)
+
+
+def _is_sparse(x):
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+# -- creation ---------------------------------------------------------------
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """Build a COO tensor (reference: python/paddle/sparse/creation.py)."""
+    idx = _idx_arr(indices)
+    vals = _values_tensor(values)
+    if dtype is not None:
+        vals = Tensor(vals._value.astype(dtype), stop_gradient=vals.stop_gradient)
+    if shape is None:
+        shape = tuple(int(jnp.max(idx[d])) + 1 for d in range(idx.shape[0]))
+    # fresh leaf wrapper: creation copies (reference semantics) so flipping
+    # stop_gradient here must not detach the caller's Tensor elsewhere
+    vals = Tensor(vals._value, stop_gradient=stop_gradient)
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    """Build a CSR matrix (reference: python/paddle/sparse/creation.py)."""
+    vals = _values_tensor(values)
+    if dtype is not None:
+        vals = Tensor(vals._value.astype(dtype), stop_gradient=vals.stop_gradient)
+    vals = Tensor(vals._value, stop_gradient=stop_gradient)
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+# -- unary (zero-preserving ops apply to values only) -----------------------
+
+def _unary(name, fn):
+    def op(x, name_arg=None):
+        if not _is_sparse(x):
+            raise TypeError(f"paddle.sparse.{name} expects a sparse tensor")
+        vals = _vop(name, fn, x._values)
+        if x.is_sparse_coo():
+            return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)
+        return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+    op.__name__ = name
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+expm1 = _unary("expm1", jnp.expm1)
+isnan = _unary("isnan", jnp.isnan)
+
+
+def pow(x, factor, name=None):
+    return _unary("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    vals = x._values
+    if value_dtype is not None:
+        vals = _vop("cast", lambda v: v.astype(value_dtype), vals)
+    if x.is_sparse_coo():
+        out = SparseCooTensor(x._indices, vals, x._shape, x._coalesced)
+        if index_dtype is not None:
+            # bypass the constructor's int32 normalization; whether int64
+            # actually sticks follows jax's x64 policy like every other
+            # dtype in the framework
+            out._indices = x._indices.astype(index_dtype)
+        return out
+    out = SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+    if index_dtype is not None:
+        out._crows = x._crows.astype(index_dtype)
+        out._cols = x._cols.astype(index_dtype)
+    return out
+
+
+# -- binary -----------------------------------------------------------------
+
+def _coo_binary(name, fn, x, y):
+    """Elementwise sparse-sparse op via union of patterns (both operands'
+    values stay on the tape)."""
+    xc = x.to_sparse_coo().coalesce()
+    yc = y.to_sparse_coo().coalesce()
+    if xc._shape != yc._shape:
+        raise ValueError("sparse binary op requires equal shapes")
+    idx = jnp.concatenate([xc._indices, yc._indices], axis=1)
+
+    def f(xv, yv):
+        zeros_y = jnp.zeros(yv.shape, yv.dtype)
+        zeros_x = jnp.zeros(xv.shape, xv.dtype)
+        left = jnp.concatenate([xv, zeros_y])
+        right = jnp.concatenate([zeros_x, yv])
+        return fn(left, right)
+    vals = _vop(name, f, xc._values, yc._values)
+    out = SparseCooTensor(idx, vals, xc._shape).coalesce()
+    # divide/multiply across the union pattern must still be computed on
+    # summed duplicates — fn is applied pre-coalesce which is only valid
+    # for add/subtract; multiply/divide go through aligned patterns below.
+    return out
+
+
+def _aligned_binary(name, fn, x, y):
+    """multiply/divide need value alignment, not union accumulate: compute
+    on the union pattern AFTER coalescing each side onto it."""
+    xc = x.to_sparse_coo().coalesce()
+    yc = y.to_sparse_coo().coalesce()
+    if xc._shape != yc._shape:
+        raise ValueError("sparse binary op requires equal shapes")
+    # scatter each side into dense, op, re-sparsify on the union pattern
+    union = SparseCooTensor(
+        jnp.concatenate([xc._indices, yc._indices], axis=1),
+        jnp.concatenate([jnp.ones((xc.nnz,), xc._values._value.dtype),
+                         jnp.ones((yc.nnz,), yc._values._value.dtype)]),
+        xc._shape).coalesce()
+    uidx = tuple(union._indices[d] for d in range(union.sparse_dim))
+    xi = tuple(xc._indices[d] for d in range(xc.sparse_dim))
+    yi = tuple(yc._indices[d] for d in range(yc.sparse_dim))
+    shape = xc._shape
+
+    def f(xv, yv):
+        dx = jnp.zeros(shape, xv.dtype).at[xi].set(xv)
+        dy = jnp.zeros(shape, yv.dtype).at[yi].set(yv)
+        return fn(dx, dy)[uidx]
+    vals = _vop(name, f, xc._values, yc._values)
+    return SparseCooTensor(union._indices, vals, shape, coalesced=True)
+
+
+def add(x, y, name=None):
+    return _coo_binary("add", jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return _coo_binary("subtract", jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return _aligned_binary("multiply", jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    return _aligned_binary("divide", jnp.divide, x, y)
+
+
+# -- matmul family ----------------------------------------------------------
+
+def _spmm(sp, dense_t, name):
+    """sparse (M,K) @ dense (K,N) -> dense (M,N): gather rows of the dense
+    operand at the sparse column ids, scale by values, segment-sum into
+    output rows. One gather + one scatter-add — the XLA/TPU-canonical spmv
+    (reference kernel: paddle/phi/kernels/sparse/gpu/matmul_kernel.cu via
+    cusparse; ours is the gather/scatter formulation XLA tiles natively)."""
+    coo = sp.to_sparse_coo()
+    if coo.ndim != 2 or coo.sparse_dim != 2:
+        raise ValueError(
+            f"sparse matmul requires a 2-D sparse operand, got shape "
+            f"{coo.shape} with {coo.sparse_dim} sparse dims")
+    rows, cols = coo._indices[0], coo._indices[1]
+    M = coo._shape[0]
+
+    def f(v, d):
+        gathered = v[:, None] * d[cols]          # (nnz, N)
+        return jax.ops.segment_sum(gathered, rows, num_segments=M)
+    return _vop(name, f, coo._values, dense_t)
+
+
+def matmul(x, y, name=None):
+    if _is_sparse(x) and not _is_sparse(y):
+        return _spmm(x, y, "spmm")
+    if _is_sparse(x) and _is_sparse(y):
+        # sparse @ sparse -> dense of x @ dense(y) kept sparse-free
+        return _spmm(x, y.to_dense(), "spspmm")
+    raise TypeError("paddle.sparse.matmul: first operand must be sparse")
+
+
+def mv(x, vec, name=None):
+    coo = x.to_sparse_coo()
+    if coo.ndim != 2 or coo.sparse_dim != 2:
+        raise ValueError("sparse mv requires a 2-D sparse operand")
+    rows, cols = coo._indices[0], coo._indices[1]
+    M = coo._shape[0]
+
+    def f(v, d):
+        return jax.ops.segment_sum(v * d[cols], rows, num_segments=M)
+    return _vop("spmv", f, coo._values, vec)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated only at `mask`'s sparsity pattern
+    (reference: sparse/binary.py masked_matmul, SDDMM)."""
+    coo = mask.to_sparse_coo()
+    rows, cols = coo._indices[0], coo._indices[1]
+
+    def f(xv, yv):
+        return jnp.sum(xv[rows] * yv[:, cols].T, axis=-1)
+    vals = _vop("sddmm", f, x, y)
+    if mask.is_sparse_csr():
+        return SparseCsrTensor(mask._crows, mask._cols, vals, mask._shape)
+    return SparseCooTensor(coo._indices, vals, coo._shape, coo._coalesced)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x@y) with sparse x (reference: sparse/multiary.py)."""
+    from paddle_tpu import tensor as T
+    prod = matmul(x, y)
+    return T.add(T.scale(input, beta), T.scale(prod, alpha))
+
+
+# -- shape ops --------------------------------------------------------------
+
+def transpose(x, perm, name=None):
+    coo = x.to_sparse_coo()
+    if len(perm) != coo.sparse_dim:
+        raise NotImplementedError(
+            "sparse transpose only permutes the sparse dims")
+    idx = jnp.stack([coo._indices[p] for p in perm])
+    shape = tuple(coo._shape[p] for p in perm)
+    out = SparseCooTensor(idx, coo._values, shape)
+    return out.to_sparse_csr() if x.is_sparse_csr() else out
+
+
+def reshape(x, shape, name=None):
+    coo = x.to_sparse_coo().coalesce()
+    if coo.sparse_dim != coo.ndim:
+        raise NotImplementedError(
+            "sparse reshape of hybrid COO (trailing dense dims) is not "
+            "supported")
+    shape = tuple(int(s) for s in shape)
+    n_old = int(np.prod(coo._shape))
+    # resolve a single -1
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape = tuple(n_old // known if s == -1 else s for s in shape)
+    if int(np.prod(shape)) != n_old:
+        raise ValueError(
+            f"sparse reshape: cannot reshape {coo.shape} ({n_old} elements) "
+            f"to {list(shape)}")
+    lin = jnp.zeros((coo.nnz,), jnp.int32)
+    for d in range(coo.ndim):
+        lin = lin * coo._shape[d] + coo._indices[d]
+    nd = []
+    rem = lin
+    for d in reversed(range(len(shape))):
+        nd.append(rem % shape[d])
+        rem = rem // shape[d]
+    idx = jnp.stack(list(reversed(nd))).astype(jnp.int32)
+    out = SparseCooTensor(idx, coo._values, shape, coalesced=True)
+    return out.to_sparse_csr() if x.is_sparse_csr() else out
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """Reduce to dense (reference returns sparse for axis reductions of coo;
+    the dense result is the useful one on TPU and feeds straight into XLA)."""
+    dense = x.to_dense()
+    from paddle_tpu import tensor as T
+    return T.sum(dense, axis=axis, dtype=dtype, keepdim=keepdim)
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def slice(x, axes, starts, ends, name=None):
+    from paddle_tpu import tensor as T
+    return T.slice(x.to_dense(), axes, starts, ends)
+
+
+from paddle_tpu.sparse import nn  # noqa: E402,F401

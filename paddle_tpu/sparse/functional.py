@@ -1,0 +1,87 @@
+"""`paddle.sparse.nn.functional` (reference:
+python/paddle/sparse/nn/functional/).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['relu', 'relu6', 'leaky_relu', 'softmax', 'attention']
+
+
+def _unary_vals(x, name, fn):
+    from paddle_tpu.sparse import SparseCooTensor, SparseCsrTensor, _vop
+    vals = _vop(name, fn, x._values)
+    if x.is_sparse_coo():
+        return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)
+    return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+
+
+def relu(x, name=None):
+    return _unary_vals(x, "relu", jax.nn.relu)
+
+
+def relu6(x, name=None):
+    return _unary_vals(x, "relu6", lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _unary_vals(
+        x, "leaky_relu", lambda v: jax.nn.leaky_relu(v, negative_slope))
+
+
+def softmax(x, axis=-1, name=None):
+    """Per-row softmax over the sparsity pattern (reference:
+    sparse/nn/functional/activation.py softmax — only supports the last
+    axis, which is the attention-logits use-case). Segment-max/sum over the
+    CSR row ids — the XLA-native masked softmax."""
+    from paddle_tpu.sparse import SparseCooTensor, SparseCsrTensor, _vop
+    if axis not in (-1, x.ndim - 1):
+        raise ValueError("sparse softmax supports the last axis only")
+    csr = x if x.is_sparse_csr() else x.to_sparse_csr()
+    rows = csr._row_indices()
+    nrows = csr._shape[0]
+
+    def f(v):
+        row_max = jax.ops.segment_max(v, rows, num_segments=nrows)
+        e = jnp.exp(v - row_max[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=nrows)
+        return e / denom[rows]
+    vals = _vop("csr_softmax", f, csr._values)
+    out = SparseCsrTensor(csr._crows, csr._cols, vals, csr._shape)
+    return out if x.is_sparse_csr() else out.to_sparse_coo()
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-pattern attention: QK^T evaluated only on sparse_mask's
+    pattern, softmax per row, then spmv against V (reference:
+    sparse/nn/functional/transformer.py attention over SparseCsrTensor).
+    key_padding_mask (keys,) and attn_mask (queries, keys) are additive
+    masks gathered at the sparse pattern positions before the softmax."""
+    from paddle_tpu import tensor as T
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.sparse import (SparseCooTensor, SparseCsrTensor,
+                                   masked_matmul, matmul, _vop)
+    import math
+    d = query.shape[-1]
+    scores = masked_matmul(T.scale(query, 1.0 / math.sqrt(d)),
+                           T.transpose(key, [1, 0]), sparse_mask)
+    if key_padding_mask is not None or attn_mask is not None:
+        coo = scores.to_sparse_coo()
+        rows, cols = coo._indices[0], coo._indices[1]
+
+        def add_masks(v, *masks):
+            i = 0
+            if key_padding_mask is not None:
+                v = v + masks[i][cols]
+                i += 1
+            if attn_mask is not None:
+                v = v + masks[i][rows, cols]
+            return v
+        margs = [m for m in (key_padding_mask, attn_mask) if m is not None]
+        vals = _vop("sp_attn_mask", add_masks, coo._values, *margs)
+        coo = SparseCooTensor(coo._indices, vals, coo._shape, coo._coalesced)
+        scores = coo if scores.is_sparse_coo() else coo.to_sparse_csr()
+    probs = softmax(scores)
+    return matmul(probs, value)

@@ -1,0 +1,158 @@
+"""`paddle.sparse.nn` — sparse NN layers (reference:
+python/paddle/sparse/nn/).
+
+ReLU/ReLU6/LeakyReLU act on values; Softmax is a per-row segment softmax
+over the CSR pattern (the attention-mask use-case); BatchNorm normalizes
+values per channel; sparse convs densify per-block (XLA conv is dense —
+submanifold sparse conv is a gather/scatter program that only pays off at
+extreme sparsity; the dense path is the TPU-fast one at typical densities).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.sparse import (SparseCooTensor, SparseCsrTensor, _is_sparse,
+                               _vop)
+from paddle_tpu.sparse import functional  # noqa: F401
+
+__all__ = ['ReLU', 'ReLU6', 'LeakyReLU', 'Softmax', 'BatchNorm',
+           'SyncBatchNorm', 'Conv2D', 'Conv3D', 'SubmConv2D', 'SubmConv3D',
+           'MaxPool3D']
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self.axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel (last) dim of COO values (reference:
+    sparse/nn/layer/norm.py — normalizes nnz x C values like dense BN over
+    the flattened spatial dims)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format='NDHWC',
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from paddle_tpu.nn.layer.norm import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon)
+
+    def forward(self, x):
+        vals = x.values()
+        out_vals = self._bn(vals)
+        return SparseCooTensor(x._indices, out_vals, x._shape, x._coalesced)
+
+
+class SyncBatchNorm(BatchNorm):
+    """On TPU, batch stats sync falls out of GSPMD when values are sharded;
+    the layer is identical to BatchNorm (reference needs a NCCL allreduce)."""
+
+
+class _DenseConvWrapper(Layer):
+    """Sparse conv via densify -> XLA conv -> re-sparsify. Submanifold
+    variants preserve the input pattern (reference:
+    sparse/nn/layer/conv.py SubmConv3D)."""
+
+    def __init__(self, conv, subm):
+        super().__init__()
+        self._conv = conv
+        self._subm = subm
+
+    def forward(self, x):
+        # values layout (reference): indices (ndim, nnz) over N,*spatial;
+        # values (nnz, C); dense layout channels-last
+        dense = x.to_dense()  # (N, *spatial, C)
+        from paddle_tpu import tensor as T
+        perm_in = [0, dense.ndim - 1] + list(range(1, dense.ndim - 1))
+        out = self._conv(T.transpose(dense, perm_in))  # NC* conv
+        perm_out = [0] + list(range(2, out.ndim)) + [1]
+        out = T.transpose(out, perm_out)               # back to N*...C
+        if not self._subm:
+            return _dense_to_coo(out)
+        # submanifold: keep input sparsity pattern
+        idx = tuple(x._indices[d] for d in range(x._indices.shape[0]))
+        vals = _vop("subm_gather", lambda o: o[idx], out)
+        return SparseCooTensor(x._indices, vals, tuple(out.shape),
+                               coalesced=x._coalesced)
+
+
+def _dense_to_coo(dense_t, sparse_dim=None):
+    arr = dense_t._value if isinstance(dense_t, Tensor) else dense_t
+    ndim_sp = (arr.ndim - 1) if sparse_dim is None else sparse_dim
+    mask = jnp.any(arr != 0, axis=tuple(range(ndim_sp, arr.ndim)))
+    nz = jnp.nonzero(mask)
+    idx = jnp.stack(nz).astype(jnp.int32)
+    vals = _vop("dense_to_coo", lambda a: a[nz], dense_t)
+    return SparseCooTensor(idx, vals, tuple(arr.shape))
+
+
+def Conv2D(in_channels, out_channels, kernel_size, stride=1, padding=0,
+           dilation=1, groups=1, subm=False, key=None, weight_attr=None,
+           bias_attr=None, data_format="NHWC"):
+    from paddle_tpu.nn import Conv2D as DenseConv2D
+    return _DenseConvWrapper(
+        DenseConv2D(in_channels, out_channels, kernel_size, stride=stride,
+                    padding=padding, dilation=dilation, groups=groups), subm)
+
+
+def Conv3D(in_channels, out_channels, kernel_size, stride=1, padding=0,
+           dilation=1, groups=1, subm=False, key=None, weight_attr=None,
+           bias_attr=None, data_format="NDHWC"):
+    from paddle_tpu.nn import Conv3D as DenseConv3D
+    return _DenseConvWrapper(
+        DenseConv3D(in_channels, out_channels, kernel_size, stride=stride,
+                    padding=padding, dilation=dilation, groups=groups), subm)
+
+
+def SubmConv2D(*args, **kwargs):
+    kwargs["subm"] = True
+    return Conv2D(*args, **kwargs)
+
+
+def SubmConv3D(*args, **kwargs):
+    kwargs["subm"] = True
+    return Conv3D(*args, **kwargs)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        from paddle_tpu.nn import MaxPool3D as DenseMaxPool3D
+        self._pool = DenseMaxPool3D(kernel_size, stride=stride,
+                                    padding=padding)
+
+    def forward(self, x):
+        dense = x.to_dense()
+        from paddle_tpu import tensor as T
+        perm_in = [0, dense.ndim - 1] + list(range(1, dense.ndim - 1))
+        out = self._pool(T.transpose(dense, perm_in))
+        perm_out = [0] + list(range(2, out.ndim)) + [1]
+        return _dense_to_coo(T.transpose(out, perm_out))

@@ -1,0 +1,243 @@
+"""paddle.sparse COO/CSR tests (reference: python/paddle/sparse/,
+test/legacy_test/test_sparse_*.py patterns — dense parity checks).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _rand_coo(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = rng.randn(*shape).astype(np.float32)
+    dense[rng.rand(*shape) > density] = 0.0
+    idx = np.stack(np.nonzero(dense))
+    vals = dense[tuple(idx)]
+    return dense, idx, vals
+
+
+def test_coo_create_to_dense_roundtrip():
+    dense, idx, vals = _rand_coo((4, 6))
+    sp = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    assert sp.is_sparse_coo() and not sp.is_sparse_csr()
+    assert sp.nnz == len(vals)
+    np.testing.assert_allclose(sp.to_dense().numpy(), dense)
+
+
+def test_coo_coalesce_sums_duplicates():
+    idx = np.array([[0, 0, 1], [1, 1, 2]])
+    vals = np.array([1.0, 2.0, 5.0], np.float32)
+    sp = sparse.sparse_coo_tensor(idx, vals, (2, 3)).coalesce()
+    assert sp.nnz == 2
+    dense = sp.to_dense().numpy()
+    assert dense[0, 1] == 3.0 and dense[1, 2] == 5.0
+
+
+def test_csr_roundtrip():
+    dense, idx, vals = _rand_coo((5, 7), seed=1)
+    coo = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    csr = coo.to_sparse_csr()
+    assert csr.is_sparse_csr()
+    np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+    back = csr.to_sparse_coo()
+    np.testing.assert_allclose(back.to_dense().numpy(), dense)
+
+
+def test_csr_create_direct():
+    # [[1,0,2],[0,3,0]]
+    csr = sparse.sparse_csr_tensor([0, 2, 3], [0, 2, 1],
+                                   [1.0, 2.0, 3.0], (2, 3))
+    np.testing.assert_allclose(csr.to_dense().numpy(),
+                               [[1, 0, 2], [0, 3, 0]])
+
+
+@pytest.mark.parametrize("op", ["sin", "tanh", "sqrt", "square", "log1p",
+                                "abs", "expm1"])
+def test_unary_matches_dense(op):
+    dense, idx, vals = _rand_coo((4, 5), seed=2)
+    vals = np.abs(vals)  # sqrt/log1p domain
+    dense = np.zeros_like(dense)
+    dense[tuple(idx)] = vals
+    sp = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    out = getattr(sparse, op)(sp)
+    ref = getattr(np, op if op != "abs" else "abs")(dense)
+    # zero-preserving ops: only compare where nonzero (sin(0)=0 etc. anyway)
+    np.testing.assert_allclose(out.to_dense().numpy(), ref,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_add_subtract_multiply():
+    d1, i1, v1 = _rand_coo((4, 5), seed=3)
+    d2, i2, v2 = _rand_coo((4, 5), seed=4)
+    s1 = sparse.sparse_coo_tensor(i1, v1, d1.shape)
+    s2 = sparse.sparse_coo_tensor(i2, v2, d2.shape)
+    np.testing.assert_allclose(sparse.add(s1, s2).to_dense().numpy(),
+                               d1 + d2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(sparse.subtract(s1, s2).to_dense().numpy(),
+                               d1 - d2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(sparse.multiply(s1, s2).to_dense().numpy(),
+                               d1 * d2, rtol=1e-5, atol=1e-6)
+
+
+def test_matmul_spmm_and_grad():
+    dense, idx, vals = _rand_coo((4, 6), seed=5)
+    sp = sparse.sparse_coo_tensor(idx, vals, dense.shape,
+                                  stop_gradient=False)
+    y = paddle.to_tensor(np.random.RandomState(6).randn(6, 3).astype(np.float32))
+    y.stop_gradient = False
+    out = sparse.matmul(sp, y)
+    np.testing.assert_allclose(out.numpy(), dense @ y.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    out.sum().backward()
+    assert y.grad is not None
+    np.testing.assert_allclose(y.grad.numpy(),
+                               dense.T @ np.ones((4, 3), np.float32),
+                               rtol=1e-4, atol=1e-5)
+    # grad to sparse values
+    assert sp.grad is not None and sp.grad.shape == [sp.nnz]
+
+
+def test_mv():
+    dense, idx, vals = _rand_coo((4, 6), seed=7)
+    sp = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    v = np.random.RandomState(8).randn(6).astype(np.float32)
+    np.testing.assert_allclose(
+        sparse.mv(sp, paddle.to_tensor(v)).numpy(), dense @ v,
+        rtol=1e-4, atol=1e-5)
+
+
+def test_masked_matmul():
+    rng = np.random.RandomState(9)
+    x = rng.randn(4, 5).astype(np.float32)
+    y = rng.randn(5, 4).astype(np.float32)
+    mask_dense, midx, mvals = _rand_coo((4, 4), seed=10)
+    mask = sparse.sparse_coo_tensor(midx, np.ones_like(mvals), mask_dense.shape)
+    out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), mask)
+    ref = (x @ y) * (mask_dense != 0)
+    np.testing.assert_allclose(out.to_dense().numpy(), ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_addmm():
+    dense, idx, vals = _rand_coo((3, 4), seed=11)
+    sp = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    rng = np.random.RandomState(12)
+    y = rng.randn(4, 2).astype(np.float32)
+    inp = rng.randn(3, 2).astype(np.float32)
+    out = sparse.addmm(paddle.to_tensor(inp), sp, paddle.to_tensor(y),
+                       beta=0.5, alpha=2.0)
+    np.testing.assert_allclose(out.numpy(), 0.5 * inp + 2.0 * (dense @ y),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_transpose_reshape_sum():
+    dense, idx, vals = _rand_coo((3, 4), seed=13)
+    sp = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    np.testing.assert_allclose(
+        sparse.transpose(sp, [1, 0]).to_dense().numpy(), dense.T)
+    np.testing.assert_allclose(
+        sparse.reshape(sp, [2, 6]).to_dense().numpy(), dense.reshape(2, 6))
+    np.testing.assert_allclose(sparse.sum(sp).numpy(), dense.sum(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(sparse.sum(sp, axis=1).numpy(),
+                               dense.sum(1), rtol=1e-5)
+    assert sparse.is_same_shape(sp, sp)
+
+
+def test_nn_relu_and_softmax():
+    dense, idx, vals = _rand_coo((4, 6), seed=14)
+    sp = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    relu_out = sparse.nn.functional.relu(sp).to_dense().numpy()
+    np.testing.assert_allclose(relu_out, np.maximum(dense, 0))
+
+    csr = sp.to_sparse_csr()
+    sm = sparse.nn.functional.softmax(csr)
+    out = sm.to_dense().numpy()
+    # each nonempty row sums to 1 over its pattern
+    for r in range(4):
+        nz = dense[r] != 0
+        if nz.any():
+            np.testing.assert_allclose(out[r][nz].sum(), 1.0, rtol=1e-5)
+            # matches dense masked softmax
+            logits = np.where(nz, dense[r], -np.inf)
+            ref = np.exp(logits - logits[nz].max())
+            ref = ref / ref[nz].sum()
+            np.testing.assert_allclose(out[r][nz], ref[nz], rtol=1e-5)
+
+
+def test_sparse_attention():
+    rng = np.random.RandomState(15)
+    q = rng.randn(4, 8).astype(np.float32)
+    k = rng.randn(4, 8).astype(np.float32)
+    v = rng.randn(4, 8).astype(np.float32)
+    # full mask == dense attention
+    idx = np.stack(np.nonzero(np.ones((4, 4))))
+    mask = sparse.sparse_coo_tensor(idx, np.ones(16, np.float32), (4, 4))
+    out = sparse.nn.functional.attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v), mask)
+    scores = q @ k.T / np.sqrt(8)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy(), p @ v, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_and_cast():
+    dense, idx, vals = _rand_coo((8, 4), seed=16)
+    nnz = len(vals)
+    vals2 = np.stack([vals, vals * 2], axis=-1)  # (nnz, 2) channels
+    sp = sparse.sparse_coo_tensor(idx, vals2, (8, 4, 2))
+    bn = sparse.nn.BatchNorm(2)
+    out = bn(sp)
+    assert out.values().shape == [nnz, 2]
+    c = sparse.cast(sp, value_dtype="float16")
+    assert "float16" in str(c.dtype)
+
+
+def test_creation_does_not_mutate_caller_values():
+    v = paddle.to_tensor(np.ones(3, np.float32))
+    v.stop_gradient = False
+    idx = np.array([[0, 1, 2], [0, 1, 2]])
+    sparse.sparse_coo_tensor(idx, v, (3, 3))  # default stop_gradient=True
+    assert v.stop_gradient is False
+
+
+def test_hybrid_coo_coalesce_and_add():
+    idx = np.array([[0, 0, 1], [1, 1, 0]])
+    vals = np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32)  # (nnz, 2)
+    sp = sparse.sparse_coo_tensor(idx, vals, (2, 2, 2))
+    c = sp.coalesce()
+    assert c.nnz == 2
+    np.testing.assert_allclose(c.to_dense().numpy()[0, 1], [4., 6.])
+    s = sparse.add(sp, sp)
+    np.testing.assert_allclose(s.to_dense().numpy()[0, 1], [8., 12.])
+
+
+def test_reshape_validates_numel():
+    dense, idx, vals = _rand_coo((3, 4), seed=20)
+    sp = sparse.sparse_coo_tensor(idx, vals, dense.shape)
+    with pytest.raises(ValueError):
+        sparse.reshape(sp, [2, 5])
+
+
+def test_matmul_rejects_nd_sparse():
+    idx = np.array([[0, 1], [0, 1], [0, 1]])
+    sp = sparse.sparse_coo_tensor(idx, np.ones(2, np.float32), (2, 2, 2))
+    with pytest.raises(ValueError):
+        sparse.matmul(sp, paddle.ones([2, 2]))
+
+
+def test_attention_masks_applied():
+    rng = np.random.RandomState(21)
+    q = rng.randn(4, 8).astype(np.float32)
+    idx = np.stack(np.nonzero(np.ones((4, 4))))
+    mask = sparse.sparse_coo_tensor(idx, np.ones(16, np.float32), (4, 4))
+    kpm = np.array([0., 0., 0., -1e9], np.float32)  # mask out last key
+    out = sparse.nn.functional.attention(
+        paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q), mask,
+        key_padding_mask=paddle.to_tensor(kpm))
+    # equivalent dense computation with key 3 masked
+    scores = q @ q.T / np.sqrt(8) + kpm[None, :]
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy(), p @ q, rtol=1e-4, atol=1e-5)
