@@ -1,0 +1,247 @@
+"""`paddle.geometric` — graph message passing (reference:
+python/paddle/geometric/: message_passing/send_recv.py, math.py,
+reindex.py, sampling/neighbors.py; GPU kernels
+paddle/phi/kernels/gpu/graph_send_recv_kernel.cu).
+
+TPU-native: gather + jax.ops.segment_{sum,max,min} ARE the message-passing
+primitives — XLA lowers them to the same scatter-reduce the reference's
+CUDA kernels hand-roll, and they fuse with surrounding elementwise work.
+Sampling/reindex are host-side graph-prep utilities (numpy), matching the
+reference's CPU path; they feed static-shape device batches.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import dispatch, OpDef
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    'send_u_recv', 'send_ue_recv', 'send_uv',
+    'segment_sum', 'segment_mean', 'segment_min', 'segment_max',
+    'reindex_graph', 'reindex_heter_graph',
+    'sample_neighbors', 'weighted_sample_neighbors',
+]
+
+
+def _op(name, fn, *tensors):
+    return dispatch(OpDef("geometric." + name, fn), tensors, {})
+
+
+def _idx(x):
+    a = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return a.astype(jnp.int32)
+
+
+_MSG = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def _segment_reduce(msgs, dst, num_segments, reduce_op):
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msgs, dst, num_segments=num_segments)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msgs, dst, num_segments=num_segments)
+        cnt = jax.ops.segment_sum(jnp.ones_like(dst, msgs.dtype), dst,
+                                  num_segments=num_segments)
+        cnt = jnp.maximum(cnt, 1.0)
+        return s / cnt.reshape((-1,) + (1,) * (msgs.ndim - 1))
+    if reduce_op in ("max", "min"):
+        seg = (jax.ops.segment_max if reduce_op == "max"
+               else jax.ops.segment_min)
+        out = seg(msgs, dst, num_segments=num_segments)
+        # empty segments: identity is +/-inf for floats, INT_MIN/MAX for
+        # ints; fill with a dtype-matched 0 like the reference kernels
+        cnt = jax.ops.segment_sum(jnp.ones_like(dst), dst,
+                                  num_segments=num_segments)
+        nonempty = (cnt > 0).reshape((-1,) + (1,) * (msgs.ndim - 1))
+        return jnp.where(nonempty, out, jnp.zeros((), msgs.dtype))
+    raise ValueError(f"unknown reduce_op {reduce_op!r}")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x at src, reduce onto dst (reference:
+    message_passing/send_recv.py:36)."""
+    src, dst = _idx(src_index), _idx(dst_index)
+    n_out = int(out_size) if out_size is not None else int(x.shape[0])
+
+    def f(xv):
+        return _segment_reduce(xv[src], dst, n_out, reduce_op)
+    return _op("send_u_recv", f, x)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Gather x at src, combine with edge feature y, reduce onto dst
+    (reference: message_passing/send_recv.py:187)."""
+    if message_op not in _MSG:
+        raise ValueError(f"unknown message_op {message_op!r}")
+    src, dst = _idx(src_index), _idx(dst_index)
+    n_out = int(out_size) if out_size is not None else int(x.shape[0])
+
+    def f(xv, yv):
+        return _segment_reduce(_MSG[message_op](xv[src], yv), dst, n_out,
+                               reduce_op)
+    return _op("send_ue_recv", f, x, y)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from src-node and dst-node features (reference:
+    message_passing/send_recv.py:392)."""
+    if message_op not in _MSG:
+        raise ValueError(f"unknown message_op {message_op!r}")
+    src, dst = _idx(src_index), _idx(dst_index)
+
+    def f(xv, yv):
+        return _MSG[message_op](xv[src], yv[dst])
+    return _op("send_uv", f, x, y)
+
+
+def _segment(name, reduce_op):
+    def api(data, segment_ids, name_arg=None):
+        seg = _idx(segment_ids)
+        n = int(jnp.max(seg)) + 1 if seg.size else 0
+
+        def f(d):
+            return _segment_reduce(d, seg, n, reduce_op)
+        return _op(name, f, data)
+    api.__name__ = name
+    return api
+
+
+segment_sum = _segment("segment_sum", "sum")
+segment_mean = _segment("segment_mean", "mean")
+segment_min = _segment("segment_min", "min")
+segment_max = _segment("segment_max", "max")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids (reference: reindex.py:25).
+    Host-side graph prep: returns (reindex_src, reindex_dst, out_nodes)
+    where out_nodes = unique nodes in [x, neighbors] with x first."""
+    xv = np.asarray(x._value if isinstance(x, Tensor) else x).ravel()
+    nb = np.asarray(
+        neighbors._value if isinstance(neighbors, Tensor) else neighbors
+    ).ravel()
+    cnt = np.asarray(count._value if isinstance(count, Tensor) else count
+                     ).ravel()
+    seen = dict((int(n), i) for i, n in enumerate(xv))
+    out_nodes = list(xv)
+    for n in nb:
+        n = int(n)
+        if n not in seen:
+            seen[n] = len(out_nodes)
+            out_nodes.append(n)
+    reindex_src = np.array([seen[int(n)] for n in nb], np.int32)
+    reindex_dst = np.repeat(np.arange(len(xv), dtype=np.int32), cnt)
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(np.array(out_nodes, np.int32))))
+
+
+def reindex_heter_graph(x, neighbors_list, count_list, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: neighbors per edge type share one id space
+    (reference: reindex.py reindex_heter_graph)."""
+    xv = np.asarray(x._value if isinstance(x, Tensor) else x).ravel()
+    nbs = [np.asarray(n._value if isinstance(n, Tensor) else n).ravel()
+           for n in neighbors_list]
+    cnts = [np.asarray(c._value if isinstance(c, Tensor) else c).ravel()
+            for c in count_list]
+    seen = dict((int(n), i) for i, n in enumerate(xv))
+    out_nodes = list(xv)
+    srcs, dsts = [], []
+    for nb, cnt in zip(nbs, cnts):
+        for n in nb:
+            n = int(n)
+            if n not in seen:
+                seen[n] = len(out_nodes)
+                out_nodes.append(n)
+        srcs.append(np.array([seen[int(n)] for n in nb], np.int32))
+        dsts.append(np.repeat(np.arange(len(xv), dtype=np.int32), cnt))
+    return (Tensor(jnp.asarray(np.concatenate(srcs))),
+            Tensor(jnp.asarray(np.concatenate(dsts))),
+            Tensor(jnp.asarray(np.array(out_nodes, np.int32))))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling on a CSC graph (reference:
+    sampling/neighbors.py:23). Host-side; returns (out_neighbors,
+    out_count[, out_eids])."""
+    rv = np.asarray(row._value if isinstance(row, Tensor) else row).ravel()
+    cp = np.asarray(colptr._value if isinstance(colptr, Tensor) else colptr
+                    ).ravel()
+    nodes = np.asarray(
+        input_nodes._value if isinstance(input_nodes, Tensor)
+        else input_nodes).ravel()
+    ev = (np.asarray(eids._value if isinstance(eids, Tensor) else eids
+                     ).ravel() if eids is not None else None)
+    rng = np.random.RandomState()
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        beg, end = int(cp[v]), int(cp[v + 1])
+        neigh = rv[beg:end]
+        ids = np.arange(beg, end)
+        if sample_size != -1 and len(neigh) > sample_size:
+            pick = rng.choice(len(neigh), size=sample_size, replace=False)
+            neigh, ids = neigh[pick], ids[pick]
+        out_n.append(neigh)
+        out_c.append(len(neigh))
+        if ev is not None:
+            out_e.append(ev[ids])
+    res = (Tensor(jnp.asarray(np.concatenate(out_n) if out_n else
+                              np.zeros(0, np.int32), jnp.int32)),
+           Tensor(jnp.asarray(np.array(out_c, np.int32))))
+    if return_eids:
+        if ev is None:
+            raise ValueError("return_eids=True requires eids")
+        return res + (Tensor(jnp.asarray(np.concatenate(out_e))),)
+    return res
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted-without-replacement neighbor sampling (reference:
+    sampling/neighbors.py weighted_sample_neighbors; uses the A-ExpJ
+    reservoir method — here numpy Gumbel top-k, same distribution)."""
+    rv = np.asarray(row._value if isinstance(row, Tensor) else row).ravel()
+    cp = np.asarray(colptr._value if isinstance(colptr, Tensor) else colptr
+                    ).ravel()
+    wv = np.asarray(edge_weight._value if isinstance(edge_weight, Tensor)
+                    else edge_weight).ravel()
+    nodes = np.asarray(
+        input_nodes._value if isinstance(input_nodes, Tensor)
+        else input_nodes).ravel()
+    ev = (np.asarray(eids._value if isinstance(eids, Tensor) else eids
+                     ).ravel() if eids is not None else None)
+    rng = np.random.RandomState()
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        beg, end = int(cp[v]), int(cp[v + 1])
+        neigh, w = rv[beg:end], wv[beg:end]
+        ids = np.arange(beg, end)
+        if sample_size != -1 and len(neigh) > sample_size:
+            # Gumbel top-k == weighted sampling without replacement
+            keys = np.log(np.maximum(w, 1e-30)) + rng.gumbel(size=len(w))
+            pick = np.argsort(-keys)[:sample_size]
+            neigh, ids = neigh[pick], ids[pick]
+        out_n.append(neigh)
+        out_c.append(len(neigh))
+        if ev is not None:
+            out_e.append(ev[ids])
+    res = (Tensor(jnp.asarray(np.concatenate(out_n) if out_n else
+                              np.zeros(0, np.int32), jnp.int32)),
+           Tensor(jnp.asarray(np.array(out_c, np.int32))))
+    if return_eids:
+        if ev is None:
+            raise ValueError("return_eids=True requires eids")
+        return res + (Tensor(jnp.asarray(np.concatenate(out_e))),)
+    return res

@@ -1,0 +1,136 @@
+"""paddle.geometric tests (reference: python/paddle/geometric/,
+test/legacy_test/test_graph_send_recv.py patterns).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+
+def _graph():
+    # edges src->dst: 0->1, 1->2, 2->1, 0->0
+    src = np.array([0, 1, 2, 0], np.int32)
+    dst = np.array([1, 2, 1, 0], np.int32)
+    x = np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32)
+    return x, src, dst
+
+
+@pytest.mark.parametrize("reduce_op", ["sum", "mean", "max", "min"])
+def test_send_u_recv(reduce_op):
+    x, src, dst = _graph()
+    out = G.send_u_recv(paddle.to_tensor(x), src, dst,
+                        reduce_op=reduce_op).numpy()
+    expect = np.zeros_like(x)
+    buckets = {0: [x[0]], 1: [x[0], x[2]], 2: [x[1]]}
+    for d, msgs in buckets.items():
+        m = np.stack(msgs)
+        expect[d] = {"sum": m.sum(0), "mean": m.mean(0),
+                     "max": m.max(0), "min": m.min(0)}[reduce_op]
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_send_u_recv_grad():
+    x, src, dst = _graph()
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    out = G.send_u_recv(xt, src, dst, reduce_op="sum")
+    out.sum().backward()
+    # node 0 appears as src twice, node 1 once, node 2 once
+    np.testing.assert_allclose(xt.grad.numpy(),
+                               [[2., 2.], [1., 1.], [1., 1.]])
+
+
+def test_send_ue_recv():
+    x, src, dst = _graph()
+    e = np.array([[10., 10.], [20., 20.], [30., 30.], [40., 40.]],
+                 np.float32)
+    out = G.send_ue_recv(paddle.to_tensor(x), paddle.to_tensor(e), src, dst,
+                         message_op="add", reduce_op="sum").numpy()
+    expect = np.zeros_like(x)
+    msgs = x[src] + e
+    for i, d in enumerate(dst):
+        expect[d] += msgs[i]
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+    out2 = G.send_ue_recv(paddle.to_tensor(x), paddle.to_tensor(e), src,
+                          dst, message_op="mul", reduce_op="max").numpy()
+    assert out2.shape == x.shape
+
+
+def test_send_uv():
+    x, src, dst = _graph()
+    y = x * 10
+    out = G.send_uv(paddle.to_tensor(x), paddle.to_tensor(y), src, dst,
+                    message_op="add").numpy()
+    np.testing.assert_allclose(out, x[src] + y[dst], rtol=1e-6)
+
+
+def test_segment_ops():
+    data = np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], np.float32)
+    seg = np.array([0, 0, 1, 1], np.int32)
+    np.testing.assert_allclose(
+        G.segment_sum(paddle.to_tensor(data), seg).numpy(),
+        [[4., 6.], [12., 14.]])
+    np.testing.assert_allclose(
+        G.segment_mean(paddle.to_tensor(data), seg).numpy(),
+        [[2., 3.], [6., 7.]])
+    np.testing.assert_allclose(
+        G.segment_max(paddle.to_tensor(data), seg).numpy(),
+        [[3., 4.], [7., 8.]])
+    np.testing.assert_allclose(
+        G.segment_min(paddle.to_tensor(data), seg).numpy(),
+        [[1., 2.], [5., 6.]])
+
+
+def test_reindex_graph():
+    x = np.array([5, 9], np.int32)
+    neighbors = np.array([9, 7, 5, 8], np.int32)
+    count = np.array([2, 2], np.int32)
+    rs, rd, nodes = G.reindex_graph(paddle.to_tensor(x),
+                                    paddle.to_tensor(neighbors),
+                                    paddle.to_tensor(count))
+    nodes = nodes.numpy()
+    np.testing.assert_array_equal(nodes[:2], [5, 9])
+    assert set(nodes.tolist()) == {5, 9, 7, 8}
+    # reindexed neighbors map back to originals
+    np.testing.assert_array_equal(nodes[rs.numpy()], neighbors)
+    np.testing.assert_array_equal(rd.numpy(), [0, 0, 1, 1])
+
+
+def test_sample_neighbors():
+    # CSC: col j's neighbors are row[colptr[j]:colptr[j+1]]
+    row = np.array([1, 2, 3, 0, 2, 0], np.int32)
+    colptr = np.array([0, 3, 5, 6, 6], np.int32)
+    nodes = np.array([0, 1], np.int32)
+    out_n, out_c = G.sample_neighbors(row, colptr, nodes, sample_size=2)
+    cnt = out_c.numpy()
+    assert cnt.shape == (2,) and (cnt <= 2).all()
+    flat = out_n.numpy()
+    assert len(flat) == cnt.sum()
+    # sampled neighbors are real neighbors
+    assert set(flat[:cnt[0]]).issubset({1, 2, 3})
+    assert set(flat[cnt[0]:]).issubset({0, 2})
+
+
+def test_weighted_sample_neighbors():
+    row = np.array([1, 2, 3, 0, 2, 0], np.int32)
+    colptr = np.array([0, 3, 5, 6, 6], np.int32)
+    w = np.array([1., 1., 1., 5., 1., 1.], np.float32)
+    nodes = np.array([0, 1, 2], np.int32)
+    out_n, out_c = G.weighted_sample_neighbors(row, colptr, w, nodes,
+                                               sample_size=1)
+    assert out_c.numpy().sum() == 3
+    eids = np.arange(6, dtype=np.int32)
+    out_n2, out_c2, out_e = G.weighted_sample_neighbors(
+        row, colptr, w, nodes, sample_size=-1, eids=eids, return_eids=True)
+    assert len(out_e.numpy()) == out_c2.numpy().sum()
+
+
+def test_segment_max_int_dtype_and_empty_segment():
+    data = np.array([3, 7, 5], np.int32)
+    seg = np.array([0, 0, 2], np.int32)
+    out = G.segment_max(paddle.to_tensor(data), seg).numpy()
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out, [7, 0, 5])  # empty segment -> 0
+    out2 = G.segment_min(paddle.to_tensor(data), seg).numpy()
+    np.testing.assert_array_equal(out2, [3, 0, 5])
