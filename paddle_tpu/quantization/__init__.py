@@ -1,0 +1,338 @@
+"""`paddle.quantization` — QAT/PTQ framework (reference:
+python/paddle/quantization/: config.py, qat.py, ptq.py, quanters/abs_max.py,
+observers/abs_max.py, wrapper.py).
+
+TPU-native: fake-quant is a pure elementwise round/clip program with a
+straight-through estimator (custom STE composed as
+x + stop_gradient(q(x) - x)), which XLA fuses into the surrounding matmul —
+no custom kernels needed. int8 matmul execution at inference rides XLA's
+native int8 MXU path when exported.
+"""
+from __future__ import annotations
+
+import copy
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import dispatch, OpDef
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["QuantConfig", "BaseQuanter", "BaseObserver", "quanter",
+           "QAT", "PTQ"]
+
+
+def _op(name, fn, *tensors):
+    return dispatch(OpDef("quant." + name, fn), tensors, {})
+
+
+def _fake_quant_ste(x, scale, bit_length=8):
+    """Simulated quantization with straight-through gradients."""
+    bnd = float(2 ** (bit_length - 1) - 1)
+
+    def f(xv, sv):
+        s = jnp.maximum(sv, 1e-9)
+        q = jnp.clip(jnp.round(xv / s * bnd), -bnd, bnd) * s / bnd
+        # STE: identity gradient within range
+        return xv + jax.lax.stop_gradient(q - xv)
+    return _op("fake_quant", f, x, scale)
+
+
+# -- base types (reference: base_quanter.py / base_observer.py) -------------
+
+class BaseQuanter(Layer):
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+    def bit_length(self):
+        return 8
+
+    def quant_axis(self):
+        return -1
+
+
+class BaseObserver(BaseQuanter):
+    pass
+
+
+class QuanterFactory:
+    """Partial-application factory so one config object can instantiate a
+    fresh quanter per layer (reference: factory.py)."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self._cls, self._args, self._kwargs = cls, args, kwargs
+
+    def _instance(self, layer=None):
+        return self._cls(*self._args, **self._kwargs)
+
+
+def quanter(name):
+    """Decorator registering a quanter layer under a factory name
+    (reference: factory.py quanter)."""
+    def deco(cls):
+        def factory(*args, **kwargs):
+            return QuanterFactory(cls, *args, **kwargs)
+        factory.__name__ = name
+        globals()[name] = factory
+        return cls
+    return deco
+
+
+# -- quanters / observers ---------------------------------------------------
+
+class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
+    """Moving-average absmax fake quanter (reference:
+    quanters/abs_max.py:96 — dynamic_forward updates state, static_forward
+    uses accumulated scale)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._bit_length = bit_length
+        self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.ones((), jnp.float32)))
+        self.register_buffer("accum", Tensor(jnp.ones((), jnp.float32)))
+
+    def forward(self, x):
+        if self.training:
+            absmax = float(jnp.max(jnp.abs(x._value)))
+            r = self._moving_rate
+            state = float(self.state._value) * r + 1.0
+            accum = float(self.accum._value) * r + absmax
+            self.state._value = jnp.asarray(state, jnp.float32)
+            self.accum._value = jnp.asarray(accum, jnp.float32)
+            self.scale._value = jnp.asarray(accum / state, jnp.float32)
+        return _fake_quant_ste(x, self.scale, self._bit_length)
+
+    def scales(self):
+        return self.scale
+
+    def bit_length(self):
+        return self._bit_length
+
+
+def FakeQuanterWithAbsMaxObserver(moving_rate=0.9, bit_length=8,
+                                  dtype="float32", name=None):
+    return QuanterFactory(FakeQuanterWithAbsMaxObserverLayer,
+                          moving_rate=moving_rate, bit_length=bit_length)
+
+
+class AbsmaxObserverLayer(BaseObserver):
+    """PTQ absmax observer: tracks the max |x| seen, no fake-quant during
+    calibration (reference: observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._bit_length = quant_bits
+        self.register_buffer("max_value", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        m = float(jnp.max(jnp.abs(x._value)))
+        if m > float(self.max_value._value):
+            self.max_value._value = jnp.asarray(m, jnp.float32)
+        return x
+
+    def scales(self):
+        return self.max_value
+
+    def bit_length(self):
+        return self._bit_length
+
+
+def AbsmaxObserver(quant_bits=8):
+    return QuanterFactory(AbsmaxObserverLayer, quant_bits=quant_bits)
+
+
+# -- quanted layer wrappers (reference: nn/quant/ + wrapper.py) -------------
+
+class QuantedLinear(Layer):
+    def __init__(self, layer, q_config):
+        super().__init__()
+        self._layer = layer
+        self.weight_quanter = (q_config.weight._instance(layer)
+                               if q_config.weight else None)
+        self.activation_quanter = (q_config.activation._instance(layer)
+                                   if q_config.activation else None)
+
+    def forward(self, x):
+        from paddle_tpu.nn import functional as F
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self._layer.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self._layer.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, layer, q_config):
+        super().__init__()
+        self._layer = layer
+        self.weight_quanter = (q_config.weight._instance(layer)
+                               if q_config.weight else None)
+        self.activation_quanter = (q_config.activation._instance(layer)
+                                   if q_config.activation else None)
+
+    def forward(self, x):
+        from paddle_tpu.nn import functional as F
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self._layer.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        lay = self._layer
+        return F.conv2d(x, w, lay.bias, stride=lay._stride,
+                        padding=lay._padding, dilation=lay._dilation,
+                        groups=lay._groups, data_format=lay._data_format)
+
+
+class ObserveWrapper(Layer):
+    """Observer around a leaf layer's output (reference: wrapper.py)."""
+
+    def __init__(self, observer, observed):
+        super().__init__()
+        self._observer = observer
+        self._observed = observed
+
+    def forward(self, *a, **k):
+        out = self._observed(*a, **k)
+        return self._observer(out)
+
+
+# -- config -----------------------------------------------------------------
+
+class SingleLayerConfig:
+    def __init__(self, activation, weight):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    """Maps layers -> quanter factories (reference: config.py:60; priority
+    layer > name > type > global)."""
+
+    def __init__(self, activation, weight):
+        self._global = SingleLayerConfig(activation, weight)
+        self._layer_configs = []   # (layer_instance, cfg)
+        self._name_configs = []    # (name, cfg)
+        self._type_configs = []    # (type, cfg)
+        self.qat_layer_mappings = dict(DEFAULT_QAT_LAYER_MAPPINGS)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_configs.append(
+                (l, SingleLayerConfig(activation, weight)))
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = (layer_name if isinstance(layer_name, (list, tuple))
+                 else [layer_name])
+        for n in names:
+            self._name_configs.append(
+                (n, SingleLayerConfig(activation, weight)))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._type_configs.append(
+                (t, SingleLayerConfig(activation, weight)))
+
+    def add_qat_layer_mapping(self, source, target):
+        self.qat_layer_mappings[source] = target
+
+    def _config_for(self, name, layer):
+        for l, cfg in self._layer_configs:
+            if l is layer:
+                return cfg
+        for n, cfg in self._name_configs:
+            if n == name:
+                return cfg
+        for t, cfg in self._type_configs:
+            if isinstance(layer, t):
+                return cfg
+        return self._global
+
+
+def _default_mappings():
+    from paddle_tpu import nn
+    return {nn.Linear: QuantedLinear, nn.Conv2D: QuantedConv2D}
+
+
+DEFAULT_QAT_LAYER_MAPPINGS = None  # filled lazily below
+
+
+class _Quantization:
+    def __init__(self, config):
+        self._config = config
+
+    def _transform(self, model, make_wrapper):
+        for name, child in list(model.named_children()):
+            cfg = self._config._config_for(name, child)
+            wrapper = make_wrapper(name, child, cfg)
+            if wrapper is not None:
+                model.add_sublayer(name, wrapper)
+            else:
+                self._transform(child, make_wrapper)
+        return model
+
+
+class QAT(_Quantization):
+    """Insert fake quanters for quantization-aware training (reference:
+    qat.py:23)."""
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def make(name, child, cfg):
+            for src, dst in self._config.qat_layer_mappings.items():
+                if type(child) is src:
+                    return dst(child, cfg)
+            return None
+        return self._transform(model, make)
+
+
+class PTQ(_Quantization):
+    """Post-training quantization: insert observers, calibrate by running
+    batches, then convert (reference: ptq.py)."""
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def make(name, child, cfg):
+            for src in self._config.qat_layer_mappings:
+                if type(child) is src:
+                    obs_cfg = SingleLayerConfig(
+                        cfg.activation or QuanterFactory(AbsmaxObserverLayer),
+                        cfg.weight or QuanterFactory(AbsmaxObserverLayer))
+                    cls = (QuantedLinear if src.__name__ == "Linear"
+                           else QuantedConv2D)
+                    return cls(child, obs_cfg)
+            return None
+        return self._transform(model, make)
+
+    def convert(self, model, inplace=False):
+        """Freeze observed scales into fake-quant layers."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        for lay in model.sublayers(include_self=True):
+            if isinstance(lay, (QuantedLinear, QuantedConv2D)):
+                for attr in ("weight_quanter", "activation_quanter"):
+                    q = getattr(lay, attr)
+                    if isinstance(q, AbsmaxObserverLayer):
+                        fq = FakeQuanterWithAbsMaxObserverLayer(
+                            bit_length=q.bit_length())
+                        fq.scale._value = q.max_value._value
+                        fq.eval()
+                        setattr(lay, attr, fq)
+        return model
+
+
+DEFAULT_QAT_LAYER_MAPPINGS = _default_mappings()

@@ -359,46 +359,67 @@ def _coo_binary(name, fn, x, y):
     return out
 
 
+def _linear_ids(indices, shape, sparse_dim):
+    lin = jnp.zeros((indices.shape[1],), jnp.int32)
+    for d in range(sparse_dim):
+        lin = lin * shape[d] + indices[d]
+    return lin
+
+
 def _aligned_binary(name, fn, x, y):
-    """multiply/divide need value alignment, not union accumulate: compute
-    on the union pattern AFTER coalescing each side onto it."""
+    """multiply/divide need value alignment, not union accumulate: scatter
+    each side's values onto the union-pattern slots (searchsorted over
+    linear ids — O(nnz), never densified), then apply fn slotwise."""
     xc = x.to_sparse_coo().coalesce()
     yc = y.to_sparse_coo().coalesce()
     if xc._shape != yc._shape:
         raise ValueError("sparse binary op requires equal shapes")
-    # scatter each side into dense, op, re-sparsify on the union pattern
     union = SparseCooTensor(
         jnp.concatenate([xc._indices, yc._indices], axis=1),
         jnp.concatenate([jnp.ones((xc.nnz,), xc._values._value.dtype),
                          jnp.ones((yc.nnz,), yc._values._value.dtype)]),
         xc._shape).coalesce()
-    uidx = tuple(union._indices[d] for d in range(union.sparse_dim))
-    xi = tuple(xc._indices[d] for d in range(xc.sparse_dim))
-    yi = tuple(yc._indices[d] for d in range(yc.sparse_dim))
-    shape = xc._shape
+    # coalesce() emits indices in ascending linear-id order, so the union
+    # ids are sorted and each side's slot is found by searchsorted
+    u_lin = _linear_ids(union._indices, union._shape, union.sparse_dim)
+    x_pos = jnp.searchsorted(u_lin, _linear_ids(xc._indices, xc._shape,
+                                                xc.sparse_dim))
+    y_pos = jnp.searchsorted(u_lin, _linear_ids(yc._indices, yc._shape,
+                                                yc.sparse_dim))
+    n_union = union.nnz
+    trail = xc._values._value.shape[1:]
 
     def f(xv, yv):
-        dx = jnp.zeros(shape, xv.dtype).at[xi].set(xv)
-        dy = jnp.zeros(shape, yv.dtype).at[yi].set(yv)
-        return fn(dx, dy)[uidx]
+        dx = jnp.zeros((n_union,) + trail, xv.dtype).at[x_pos].set(xv)
+        dy = jnp.zeros((n_union,) + trail, yv.dtype).at[y_pos].set(yv)
+        return fn(dx, dy)
     vals = _vop(name, f, xc._values, yc._values)
-    return SparseCooTensor(union._indices, vals, shape, coalesced=True)
+    return SparseCooTensor(union._indices, vals, union._shape,
+                           coalesced=True)
+
+
+def _keep_format(out, x, y):
+    # reference returns CSR when both operands are CSR
+    if x.is_sparse_csr() and y.is_sparse_csr():
+        return out.to_sparse_csr()
+    return out
 
 
 def add(x, y, name=None):
-    return _coo_binary("add", jnp.add, x, y)
+    return _keep_format(_coo_binary("add", jnp.add, x, y), x, y)
 
 
 def subtract(x, y, name=None):
-    return _coo_binary("subtract", jnp.subtract, x, y)
+    return _keep_format(_coo_binary("subtract", jnp.subtract, x, y), x, y)
 
 
 def multiply(x, y, name=None):
-    return _aligned_binary("multiply", jnp.multiply, x, y)
+    return _keep_format(_aligned_binary("multiply", jnp.multiply, x, y),
+                        x, y)
 
 
 def divide(x, y, name=None):
-    return _aligned_binary("divide", jnp.divide, x, y)
+    return _keep_format(_aligned_binary("divide", jnp.divide, x, y), x, y)
 
 
 # -- matmul family ----------------------------------------------------------

@@ -9,25 +9,20 @@ import jax.numpy as jnp
 __all__ = ['relu', 'relu6', 'leaky_relu', 'softmax', 'attention']
 
 
-def _unary_vals(x, name, fn):
-    from paddle_tpu.sparse import SparseCooTensor, SparseCsrTensor, _vop
-    vals = _vop(name, fn, x._values)
-    if x.is_sparse_coo():
-        return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)
-    return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
-
-
 def relu(x, name=None):
-    return _unary_vals(x, "relu", jax.nn.relu)
+    from paddle_tpu.sparse import _unary
+    return _unary("relu", jax.nn.relu)(x)
 
 
 def relu6(x, name=None):
-    return _unary_vals(x, "relu6", lambda v: jnp.clip(v, 0.0, 6.0))
+    from paddle_tpu.sparse import _unary
+    return _unary("relu6", lambda v: jnp.clip(v, 0.0, 6.0))(x)
 
 
 def leaky_relu(x, negative_slope=0.01, name=None):
-    return _unary_vals(
-        x, "leaky_relu", lambda v: jax.nn.leaky_relu(v, negative_slope))
+    from paddle_tpu.sparse import _unary
+    return _unary("leaky_relu",
+                  lambda v: jax.nn.leaky_relu(v, negative_slope))(x)
 
 
 def softmax(x, axis=-1, name=None):

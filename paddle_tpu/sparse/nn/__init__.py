@@ -96,7 +96,14 @@ class _DenseConvWrapper(Layer):
         out = T.transpose(out, perm_out)               # back to N*...C
         if not self._subm:
             return _dense_to_coo(out)
-        # submanifold: keep input sparsity pattern
+        # submanifold: output must keep the input geometry — enforce it
+        # (same-padding, stride 1); a silent clamp-gather would corrupt
+        # border activations
+        if tuple(out.shape[:-1]) != tuple(x.shape[:-1]):
+            raise ValueError(
+                f"SubmConv requires output spatial shape == input shape; "
+                f"got {list(out.shape)} vs {x.shape}. Use stride=1 and "
+                f"'same' padding (padding=(k-1)//2*dilation).")
         idx = tuple(x._indices[d] for d in range(x._indices.shape[0]))
         vals = _vop("subm_gather", lambda o: o[idx], out)
         return SparseCooTensor(x._indices, vals, tuple(out.shape),
@@ -113,10 +120,19 @@ def _dense_to_coo(dense_t, sparse_dim=None):
     return SparseCooTensor(idx, vals, tuple(arr.shape))
 
 
+def _same_padding(kernel_size, dilation, n):
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) else \
+        [kernel_size] * n
+    dl = dilation if isinstance(dilation, (list, tuple)) else [dilation] * n
+    return [((k - 1) // 2) * d for k, d in zip(ks, dl)]
+
+
 def Conv2D(in_channels, out_channels, kernel_size, stride=1, padding=0,
            dilation=1, groups=1, subm=False, key=None, weight_attr=None,
            bias_attr=None, data_format="NHWC"):
     from paddle_tpu.nn import Conv2D as DenseConv2D
+    if subm:
+        stride, padding = 1, _same_padding(kernel_size, dilation, 2)
     return _DenseConvWrapper(
         DenseConv2D(in_channels, out_channels, kernel_size, stride=stride,
                     padding=padding, dilation=dilation, groups=groups), subm)
@@ -126,6 +142,8 @@ def Conv3D(in_channels, out_channels, kernel_size, stride=1, padding=0,
            dilation=1, groups=1, subm=False, key=None, weight_attr=None,
            bias_attr=None, data_format="NDHWC"):
     from paddle_tpu.nn import Conv3D as DenseConv3D
+    if subm:
+        stride, padding = 1, _same_padding(kernel_size, dilation, 3)
     return _DenseConvWrapper(
         DenseConv3D(in_channels, out_channels, kernel_size, stride=stride,
                     padding=padding, dilation=dilation, groups=groups), subm)

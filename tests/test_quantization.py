@@ -1,0 +1,102 @@
+"""paddle.quantization QAT/PTQ tests (reference: python/paddle/quantization/,
+test/quantization/test_quant_aware* patterns).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.quantization import (
+    QAT, PTQ, QuantConfig, FakeQuanterWithAbsMaxObserver,
+    FakeQuanterWithAbsMaxObserverLayer, QuantedLinear, QuantedConv2D,
+    AbsmaxObserverLayer)
+
+
+def _model():
+    return paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+
+
+def test_qat_inserts_fake_quanters():
+    quanter = FakeQuanterWithAbsMaxObserver(moving_rate=0.9)
+    cfg = QuantConfig(activation=quanter, weight=quanter)
+    model = _model()
+    qmodel = QAT(cfg).quantize(model)
+    quanted = [l for l in qmodel.sublayers() if isinstance(l, QuantedLinear)]
+    assert len(quanted) == 2
+    # original model untouched (inplace=False)
+    assert not any(isinstance(l, QuantedLinear) for l in model.sublayers())
+
+
+def test_qat_forward_and_train_step():
+    rng = np.random.RandomState(0)
+    quanter = FakeQuanterWithAbsMaxObserver(moving_rate=0.9)
+    cfg = QuantConfig(activation=quanter, weight=quanter)
+    qmodel = QAT(cfg).quantize(_model())
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    out = qmodel(x)
+    assert out.shape == [4, 4]
+    # fake-quant error is bounded by scale/127 per element
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=qmodel.parameters())
+    y = paddle.to_tensor(rng.randn(4, 4).astype(np.float32))
+    loss = ((qmodel(x) - y) ** 2).mean()
+    loss.backward()
+    grads = [p.grad for p in qmodel.parameters() if not p.stop_gradient]
+    assert any(g is not None for g in grads)  # STE passes gradients
+    opt.step()
+
+
+def test_fake_quant_values_on_grid():
+    fq = FakeQuanterWithAbsMaxObserverLayer(bit_length=8)
+    fq.eval()
+    fq.scale._value = fq.scale._value * 0 + 1.0
+    x = paddle.to_tensor(np.array([0.5, -0.337, 0.9999], np.float32))
+    out = fq(x).numpy()
+    grid = np.round(np.array([0.5, -0.337, 0.9999]) * 127) / 127
+    np.testing.assert_allclose(out, grid.astype(np.float32), atol=1e-6)
+
+
+def test_qat_quant_error_bounded():
+    rng = np.random.RandomState(1)
+    quanter = FakeQuanterWithAbsMaxObserver()
+    cfg = QuantConfig(activation=None, weight=quanter)
+    lin = paddle.nn.Linear(8, 8)
+    q = QuantedLinear(lin, cfg._global)
+    x = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
+    ref = lin(x).numpy()
+    out = q(x).numpy()
+    # int8 weight quant: outputs close but not exact
+    assert np.abs(out - ref).max() < 0.2
+    assert np.abs(out - ref).max() > 0  # quantization actually applied
+
+
+def test_ptq_calibrate_convert():
+    rng = np.random.RandomState(2)
+    cfg = QuantConfig(activation=None, weight=None)
+    model = _model()
+    ptq = PTQ(cfg)
+    qmodel = ptq.quantize(model)
+    # calibration: observers record absmax without changing outputs
+    x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+    ref = model(x).numpy()
+    out = qmodel(x).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    observers = [l for l in qmodel.sublayers()
+                 if isinstance(l, AbsmaxObserverLayer)]
+    assert observers and all(float(o.max_value.numpy()) > 0
+                             for o in observers)
+    converted = ptq.convert(qmodel)
+    out_q = converted(x).numpy()
+    # quantized model approximates the float model
+    assert np.abs(out_q - ref).max() < 0.5
+    assert np.abs(out_q - ref).max() > 0
+
+
+def test_type_and_name_config_priority():
+    quanter = FakeQuanterWithAbsMaxObserver()
+    cfg = QuantConfig(activation=quanter, weight=quanter)
+    cfg.add_type_config(paddle.nn.Linear, activation=None, weight=quanter)
+    model = _model()
+    qmodel = QAT(cfg).quantize(model)
+    quanted = [l for l in qmodel.sublayers() if isinstance(l, QuantedLinear)]
+    assert all(q.activation_quanter is None for q in quanted)
+    assert all(q.weight_quanter is not None for q in quanted)

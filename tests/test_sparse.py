@@ -241,3 +241,40 @@ def test_attention_masks_applied():
     p = np.exp(scores - scores.max(-1, keepdims=True))
     p /= p.sum(-1, keepdims=True)
     np.testing.assert_allclose(out.numpy(), p @ q, rtol=1e-4, atol=1e-5)
+
+
+def test_csr_binary_keeps_format():
+    d1, i1, v1 = _rand_coo((4, 5), seed=30)
+    d2, i2, v2 = _rand_coo((4, 5), seed=31)
+    c1 = sparse.sparse_coo_tensor(i1, v1, d1.shape).to_sparse_csr()
+    c2 = sparse.sparse_coo_tensor(i2, v2, d2.shape).to_sparse_csr()
+    out = sparse.add(c1, c2)
+    assert out.is_sparse_csr()
+    out.crows()  # CSR surface intact
+    np.testing.assert_allclose(out.to_dense().numpy(), d1 + d2,
+                               rtol=1e-5, atol=1e-6)
+    m = sparse.multiply(c1, c2)
+    assert m.is_sparse_csr()
+    np.testing.assert_allclose(m.to_dense().numpy(), d1 * d2,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_subm_conv_preserves_pattern():
+    rng = np.random.RandomState(32)
+    dense = np.zeros((1, 6, 6, 2), np.float32)
+    pts = [(1, 1), (2, 4), (4, 2)]
+    for (i, j) in pts:
+        dense[0, i, j] = rng.randn(2)
+    idx = np.stack(np.nonzero(dense[..., 0]))
+    vals = dense[idx[0], idx[1], idx[2]]
+    sp = sparse.sparse_coo_tensor(idx, vals, (1, 6, 6, 2))
+    conv = sparse.nn.SubmConv2D(2, 3, 3)  # same-padding enforced
+    out = conv(sp)
+    assert out.shape == [1, 6, 6, 3]
+    # output pattern == input pattern
+    outd = out.to_dense().numpy()
+    mask = np.any(outd != 0, -1)
+    inmask = np.zeros((1, 6, 6), bool)
+    for (i, j) in pts:
+        inmask[0, i, j] = True
+    assert not np.any(mask & ~inmask)
