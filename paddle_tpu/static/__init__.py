@@ -1,0 +1,173 @@
+"""`paddle.static` — static-graph compatibility surface (reference:
+python/paddle/static/).
+
+There is no separate static engine in paddle_tpu: `jax.jit` tracing IS the
+static mode (SURVEY.md §3.3 — SOT/AST-to-PIR + PirInterpreter collapse to
+jaxpr -> StableHLO -> XLA). This module keeps the reference's user-facing
+names so static-style programs port: InputSpec/data for input declaration,
+save/load_inference_model for deployment artifacts, and thin Program/
+Executor shims that delegate to jit tracing.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit.api import InputSpec
+from paddle_tpu.jit import save as _jit_save, load as _jit_load
+
+__all__ = [
+    'InputSpec', 'data', 'save_inference_model', 'load_inference_model',
+    'Program', 'program_guard', 'default_main_program',
+    'default_startup_program', 'Executor', 'global_scope', 'name_scope',
+    'gradients', 'normalize_program',
+]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a graph input (reference: python/paddle/static/input.py data).
+    Returns an InputSpec usable with to_static/jit.save."""
+    return InputSpec(shape=shape, dtype=dtype, name=name)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Export for inference (reference: python/paddle/static/io.py
+    save_inference_model). `fetch_vars` carries the traced callable via
+    Program.capture or a (layer, fn) pair; feed_vars are InputSpecs."""
+    layer_or_fn = kwargs.get("layer")
+    if layer_or_fn is None and program is not None:
+        layer_or_fn = program._layer
+    if layer_or_fn is None:
+        raise ValueError(
+            "save_inference_model on paddle_tpu needs the model object: "
+            "pass layer=<Layer or callable> (the graph-free equivalent of "
+            "the reference's program argument)")
+    specs = [v if isinstance(v, InputSpec) else InputSpec(v.shape, v.dtype)
+             for v in feed_vars]
+    _jit_save(layer_or_fn, path_prefix, input_spec=specs)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Load an exported model; returns (program, feed_names, fetch_names)
+    like the reference, where program is callable."""
+    tl = _jit_load(path_prefix)
+    prog = Program()
+    prog._layer = tl
+    args_tree = tl._exported.in_tree.children()[0]
+    prog._feed_names = [f"x{i}" for i in range(len(args_tree.children()) - 1)]
+    return prog, list(prog._feed_names), ["out"]
+
+
+class Program:
+    """Compat shim for paddle.static.Program (reference:
+    python/paddle/base/framework.py:5741). Holds a callable; tracing state
+    is jax's, not an op graph we mutate."""
+
+    def __init__(self):
+        self._layer = None
+        self._feed_names = None
+
+    def __call__(self, *args):
+        if self._layer is None:
+            raise RuntimeError("empty Program")
+        return self._layer(*args)
+
+    def clone(self, for_test=False):
+        return self
+
+    def global_block(self):
+        return self
+
+    # Block surface used by feed/fetch code
+    @property
+    def ops(self):
+        return []
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+class program_guard:
+    """with program_guard(main, startup): no-op context — tracing replaces
+    graph construction; kept so reference code runs."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+
+    def __enter__(self):
+        return self._main
+
+    def __exit__(self, *exc):
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self._prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class Executor:
+    """Compat shim for paddle.static.Executor (reference:
+    python/paddle/base/executor.py:1158): run(feed=..., fetch_list=[fn])
+    calls the jitted callable with feed arrays."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        prog = program or _main_program
+        feed = feed or {}
+        names = getattr(prog, "_feed_names", None)
+        if names and len(names) == len(feed) and all(n in feed
+                                                     for n in names):
+            # bind by the program's declared input names, not dict order
+            args = [Tensor(np.asarray(feed[n])) for n in names]
+        elif names is not None and len(feed) != len(names):
+            raise ValueError(
+                f"Executor.run: program expects feeds {names}, "
+                f"got {sorted(feed)}")
+        else:
+            args = [Tensor(np.asarray(v)) for v in feed.values()]
+        out = prog(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        if return_numpy:
+            return [o.numpy() if isinstance(o, Tensor) else np.asarray(o)
+                    for o in outs]
+        return list(outs)
+
+    def close(self):
+        return None
+
+
+def global_scope():
+    return None
+
+
+def gradients(targets, inputs, target_gradients=None):
+    """Static-mode AD entry (reference: python/paddle/base/backward.py
+    gradients) — delegates to the eager/tape grad which jits identically."""
+    from paddle_tpu.autograd import grad as _grad
+    return _grad(targets, inputs, grad_outputs=target_gradients)
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    return program

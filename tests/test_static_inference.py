@@ -1,0 +1,107 @@
+"""paddle.static + paddle.inference tests (reference:
+python/paddle/static/io.py save/load_inference_model,
+paddle/fluid/inference AnalysisPredictor surface).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static, inference
+
+
+def _net():
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(4, 8), paddle.nn.ReLU(), paddle.nn.Linear(8, 3))
+    net.eval()
+    return net
+
+
+def test_static_data_returns_inputspec():
+    spec = static.data("x", [2, 4], "float32")
+    assert spec.name == "x" and list(spec.shape) == [2, 4]
+
+
+def test_save_load_inference_model(tmp_path):
+    net = _net()
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    prefix = str(tmp_path / "model")
+    static.save_inference_model(prefix, [static.data("x", [2, 4])], None,
+                                layer=net)
+    prog, feeds, fetches = static.load_inference_model(prefix)
+    assert feeds == ["x0"]
+    exe = static.Executor()
+    out = exe.run(prog, feed={"x": x}, fetch_list=fetches)
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_program_guard_compat():
+    main = static.default_main_program()
+    with static.program_guard(main):
+        spec = static.data("x", [1, 4])
+    assert isinstance(spec, static.InputSpec)
+
+
+def test_predictor_list_api(tmp_path):
+    net = _net()
+    x = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    prefix = str(tmp_path / "model")
+    import paddle_tpu.jit as jit
+    jit.save(net, prefix, input_spec=[static.InputSpec([2, 4], "float32")])
+
+    cfg = inference.Config(prefix + ".pdmodel")
+    pred = inference.create_predictor(cfg)
+    assert pred.get_input_names() == ["x0"]
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_handle_api(tmp_path):
+    net = _net()
+    x = np.random.RandomState(2).randn(2, 4).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+    prefix = str(tmp_path / "model")
+    import paddle_tpu.jit as jit
+    jit.save(net, prefix, input_spec=[static.InputSpec([2, 4], "float32")])
+
+    pred = inference.Predictor(inference.Config(prefix))
+    h = pred.get_input_handle("x0")
+    h.copy_from_cpu(x)
+    assert pred.run() is True
+    names = pred.get_output_names()
+    out = pred.get_output_handle(names[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_static_gradients():
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    x.stop_gradient = False
+    y = (x * x).sum()
+    (g,) = static.gradients(y, [x])
+    np.testing.assert_allclose(g.numpy(), [4.0], rtol=1e-6)
+
+
+def test_executor_feed_bound_by_name(tmp_path):
+    def f(a, b):
+        return a - b
+
+    import paddle_tpu.jit as jit
+    prefix = str(tmp_path / "m")
+    jit.save(f, prefix, input_spec=[static.InputSpec([1], "float32"),
+                                    static.InputSpec([1], "float32")])
+    prog, feeds, fetches = static.load_inference_model(prefix)
+    exe = static.Executor()
+    a = np.array([9.0], np.float32)
+    b = np.array([2.0], np.float32)
+    r1 = exe.run(prog, feed={"x0": a, "x1": b})
+    r2 = exe.run(prog, feed={"x1": b, "x0": a})  # different dict order
+    np.testing.assert_allclose(r1[0], [7.0])
+    np.testing.assert_allclose(r2[0], [7.0])
+
+
+def test_inference_config_preserves_settings():
+    cfg = inference.Config()
+    cfg.enable_use_gpu(precision=inference.PrecisionType.Int8)
+    cfg.set_prog_file("m.pdmodel")
+    assert cfg._precision == inference.PrecisionType.Int8
+    assert cfg.model_dir() == "m"
