@@ -1,0 +1,176 @@
+"""Distributed-config auto-tuner (reference:
+python/paddle/distributed/auto_tuner/: tuner.py AutoTuner, prune.py
+prune_by_mp/pp/..., search.py, recorder.py).
+
+Searches dp/mp/pp/sharding/micro-batch configurations for a model+cluster,
+prunes infeasible points (divisibility, memory bound), ranks the rest by a
+roofline-style cost model for TPU (MXU flops + ICI collective bytes), and
+optionally measures candidates with a user-supplied trial runner.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+
+__all__ = ["AutoTuner", "default_candidates", "prune_candidates",
+           "HistoryRecorder"]
+
+
+def default_candidates(tuner_cfg):
+    """Enumerate the dp/mp/pp/micro-bsz grid (reference: search.py
+    all_cfgs from tuner_cfg ranges)."""
+    n = int(tuner_cfg["num_devices"])
+    gbs = int(tuner_cfg.get("global_batch_size", 8))
+
+    def divisors(k):
+        return [d for d in range(1, k + 1) if k % d == 0]
+
+    mp_cands = tuner_cfg.get("mp_degree", divisors(n))
+    pp_cands = tuner_cfg.get("pp_degree", divisors(n))
+    micro_cands = tuner_cfg.get("micro_batch_size", divisors(gbs))
+    out = []
+    for mp, pp, mbs in itertools.product(mp_cands, pp_cands, micro_cands):
+        if n % (mp * pp):
+            continue
+        dp = n // (mp * pp)
+        if gbs % (dp * mbs):
+            continue
+        out.append({"dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+                    "micro_batch_size": mbs,
+                    "sharding_degree": tuner_cfg.get("sharding_degree", 1)})
+    return out
+
+
+def _memory_bytes(cfg, tuner_cfg):
+    """Per-chip memory estimate: params/grads/opt-state sharded over
+    mp*pp*sharding, activations over dp microbatching (reference:
+    memory_cost_model.py)."""
+    p = float(tuner_cfg.get("model_params", 1e9))
+    layers = int(tuner_cfg.get("num_layers", 32))
+    h = int(tuner_cfg.get("hidden_size", 4096))
+    seq = int(tuner_cfg.get("seq_length", 2048))
+    shard = cfg["mp_degree"] * cfg["pp_degree"] * max(
+        cfg.get("sharding_degree", 1), 1)
+    # bf16 weights+grads + fp32 master+adam m,v = 2+2+4+4+4 bytes/param
+    state = p * 16.0 / shard
+    act_per_layer = seq * h * 14 * 2.0  # transformer rough, bf16, remat-lite
+    acts = (cfg["micro_batch_size"] * act_per_layer
+            * layers / cfg["pp_degree"] / cfg["mp_degree"])
+    return state + acts
+
+
+def prune_candidates(candidates, tuner_cfg, history=()):
+    """Drop infeasible configs (reference: prune.py prune_by_mp/pp/mem).
+    Returns (kept, pruned_with_reason)."""
+    kept, pruned = [], []
+    hbm = float(tuner_cfg.get("hbm_bytes", 95e9))  # v5p chip
+    layers = int(tuner_cfg.get("num_layers", 32))
+    max_mp = int(tuner_cfg.get("max_mp_degree",
+                               tuner_cfg.get("num_attention_heads", 64)))
+    for c in candidates:
+        if c["pp_degree"] > layers:
+            pruned.append((c, "pp_degree > num_layers"))
+            continue
+        if c["mp_degree"] > max_mp:
+            pruned.append((c, "mp_degree > num_attention_heads"))
+            continue
+        if _memory_bytes(c, tuner_cfg) > hbm:
+            pruned.append((c, "est. memory > HBM"))
+            continue
+        if any(h == c for h, _ in history):
+            pruned.append((c, "already tried"))
+            continue
+        kept.append(c)
+    return kept, pruned
+
+
+def _cost(cfg, tuner_cfg):
+    """Roofline step-time proxy: compute time on MXU + collective time on
+    ICI (reference: cost_model.py; ours prices XLA collectives instead of
+    NCCL rings)."""
+    p = float(tuner_cfg.get("model_params", 1e9))
+    gbs = int(tuner_cfg.get("global_batch_size", 8))
+    seq = int(tuner_cfg.get("seq_length", 2048))
+    n = int(tuner_cfg["num_devices"])
+    flops = 6.0 * p * gbs * seq            # fwd+bwd matmul flops
+    peak = float(tuner_cfg.get("peak_flops", 459e12)) * n
+    t_compute = flops / peak
+    # TP all-reduces: 2 per layer fwd+bwd over activations
+    h = int(tuner_cfg.get("hidden_size", 4096))
+    layers = int(tuner_cfg.get("num_layers", 32))
+    ici = float(tuner_cfg.get("ici_bandwidth", 9e10))  # bytes/s/link
+    mbs = cfg["micro_batch_size"]
+    t_tp = 0.0
+    if cfg["mp_degree"] > 1:
+        bytes_tp = 4 * layers * mbs * seq * h * 2.0
+        t_tp = bytes_tp * (cfg["mp_degree"] - 1) / cfg["mp_degree"] / ici
+    # PP bubble: (pp-1)/microbatches overhead
+    micro_steps = max(gbs // (cfg["dp_degree"] * mbs), 1)
+    bubble = (cfg["pp_degree"] - 1) / (micro_steps + cfg["pp_degree"] - 1)
+    # DP gradient all-reduce
+    t_dp = 0.0
+    if cfg["dp_degree"] > 1:
+        t_dp = 2.0 * p * 2 / ici * (cfg["dp_degree"] - 1) / cfg["dp_degree"] / n
+    return (t_compute + t_tp + t_dp) / max(1 - bubble, 1e-3)
+
+
+class HistoryRecorder:
+    """Trial history (reference: recorder.py HistoryRecorder + csv store)."""
+
+    def __init__(self):
+        self.history = []
+
+    def add_cfg(self, cfg, metric):
+        self.history.append((dict(cfg), metric))
+
+    def get_best(self, mode="max"):
+        if not self.history:
+            return None, None
+        pick = max if mode == "max" else min
+        return pick(self.history, key=lambda cm: cm[1])
+
+    def store_history(self, path):
+        with open(path, "w") as f:
+            json.dump([{"cfg": c, "metric": m} for c, m in self.history], f)
+
+
+class AutoTuner:
+    """Search driver (reference: tuner.py:21 AutoTuner.search_once)."""
+
+    def __init__(self, tuner_cfg):
+        self.tuner_cfg = dict(tuner_cfg)
+        self.recorder = HistoryRecorder()
+        cands = default_candidates(self.tuner_cfg)
+        kept, self.pruned = prune_candidates(cands, self.tuner_cfg)
+        kept.sort(key=lambda c: _cost(c, self.tuner_cfg))
+        self._queue = kept
+        self.cur_cfg = None
+
+    @property
+    def candidates(self):
+        return list(self._queue)
+
+    def search_once(self):
+        """Next most-promising untried config, or None when exhausted."""
+        self.cur_cfg = self._queue.pop(0) if self._queue else None
+        return self.cur_cfg
+
+    def add_cfg(self, cfg, metric):
+        self.recorder.add_cfg(cfg, metric)
+
+    def tune(self, run_fn, max_trials=None):
+        """Measure candidates with run_fn(cfg)->metric (higher=better);
+        returns the best config."""
+        trials = 0
+        while True:
+            if max_trials and trials >= max_trials:
+                break  # check BEFORE popping so untried configs survive
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            metric = run_fn(cfg)
+            if metric is not None:
+                self.add_cfg(cfg, metric)
+            trials += 1
+        best, _ = self.recorder.get_best()
+        return best

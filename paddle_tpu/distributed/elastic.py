@@ -1,0 +1,108 @@
+"""Elastic / preemption-aware training (reference:
+python/paddle/distributed/fleet/elastic/manager.py:126 ElasticManager —
+etcd membership watch + relaunch; launch-side watcher.py).
+
+TPU-native failure model: TPU VMs receive a SIGTERM ahead of preemption
+(maintenance events), and multi-slice jobs see peers vanish via the
+jax.distributed heartbeat. Recovery is restart-from-checkpoint — there is
+no NCCL communicator to rebuild; XLA re-compiles on the new topology. So
+the manager here is: signal-hook -> flush an async checkpoint -> mark a
+resume file; on start, resume from the newest complete checkpoint; a
+`run` loop with bounded restarts replaces the reference's relaunch agent.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+
+__all__ = ["ElasticManager", "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
+
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 101  # reference manager.py same code
+
+
+class ElasticManager:
+    """Wraps a training loop with preemption handling + resume.
+
+    save_fn(step) -> writes a checkpoint for `step`
+    load_fn() -> returns last step to resume from (or -1)
+    """
+
+    def __init__(self, save_fn=None, load_fn=None, checkpoint_dir=None,
+                 max_restarts=3, signals=(signal.SIGTERM,)):
+        self._save_fn = save_fn
+        self._load_fn = load_fn
+        self._dir = checkpoint_dir
+        self.max_restarts = max_restarts
+        self._preempted = False
+        self._prev_handlers = {}
+        for s in signals:
+            try:
+                self._prev_handlers[s] = signal.signal(s, self._on_signal)
+            except ValueError:
+                pass  # not main thread; polling-only mode
+
+    # -- preemption --------------------------------------------------------
+    def _on_signal(self, signum, frame):
+        self._preempted = True
+
+    @property
+    def preempted(self):
+        return self._preempted
+
+    def checkpoint(self, step):
+        """Record a completed checkpoint for `step` (atomic marker file so a
+        death mid-write never yields a half checkpoint on resume)."""
+        if self._save_fn is not None:
+            self._save_fn(step)
+        if self._dir is not None:
+            os.makedirs(self._dir, exist_ok=True)
+            tmp = os.path.join(self._dir, ".latest.tmp")
+            with open(tmp, "w") as f:
+                json.dump({"step": int(step), "time": time.time()}, f)
+            os.replace(tmp, os.path.join(self._dir, "latest.json"))
+
+    def last_step(self):
+        if self._dir is not None:
+            marker = os.path.join(self._dir, "latest.json")
+            if os.path.exists(marker):
+                with open(marker) as f:
+                    return int(json.load(f)["step"])
+        if self._load_fn is not None:
+            return int(self._load_fn())
+        return -1
+
+    # -- restart loop ------------------------------------------------------
+    def run(self, train_fn, total_steps, checkpoint_interval=100):
+        """train_fn(start_step, end_step, manager) runs steps; the manager
+        checkpoints every `checkpoint_interval` and on preemption, and
+        retries after failures up to max_restarts (reference: relaunch in
+        LauncherInterface, manager.py:56)."""
+        restarts = 0
+        while True:
+            start = self.last_step() + 1
+            if start >= total_steps:
+                return start
+            try:
+                step = start
+                while step < total_steps:
+                    end = min(step + checkpoint_interval, total_steps)
+                    train_fn(step, end, self)
+                    step = end
+                    self.checkpoint(step - 1)
+                    if self._preempted:
+                        return step  # clean exit; scheduler restarts us
+                return total_steps
+            except Exception:
+                restarts += 1
+                if restarts > self.max_restarts:
+                    raise
+                # resume loop from last checkpoint
+
+    def close(self):
+        for s, h in self._prev_handlers.items():
+            try:
+                signal.signal(s, h)
+            except ValueError:
+                pass

@@ -1,0 +1,88 @@
+"""`python -m paddle_tpu.distributed.launch` — multi-host launcher
+(reference: python/paddle/distributed/launch/main.py:20,
+controllers/collective.py:22, controllers/master.py).
+
+TPU-native: the reference forks one process per GPU and rendezvouses via
+its HTTP/etcd Master; on TPU the unit is one process per HOST and the
+rendezvous is jax.distributed's coordination service (the TCPStore
+equivalent). So the launcher's job collapses to: parse the rendezvous
+config, export the env jax.distributed.initialize reads, then exec the
+training script in-process (no fork — XLA owns all local chips from one
+process).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+__all__ = ["launch", "main"]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a distributed training script")
+    p.add_argument("--master", default=None,
+                   help="coordinator address host:port "
+                        "(reference: --master etcd://... or http host)")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")),
+                   help="number of hosts")
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINER_ID", "-1")),
+                   help="this host's rank (-1: from env/TPU metadata)")
+    p.add_argument("--devices", default=None,
+                   help="accepted for reference-compat; TPU chips are "
+                        "owned by the single host process")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(script, script_args=(), master=None, nnodes=1, rank=-1,
+           job_id="default", log_dir=None):
+    """Programmatic entry. Sets the distributed env and runs `script`
+    in-process under __main__."""
+    env = os.environ
+    env["PADDLE_NNODES"] = str(nnodes)
+    if master:
+        env["PADDLE_MASTER"] = master
+        # jax.distributed.initialize reads these (or its args); exporting
+        # both names keeps user scripts working with either API
+        env["JAX_COORDINATOR_ADDRESS"] = master
+    if rank >= 0:
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        env["JAX_PROCESS_ID"] = str(rank)
+    env["JAX_NUM_PROCESSES"] = str(nnodes)
+    env["PADDLE_JOB_ID"] = job_id
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        env["PADDLE_LOG_DIR"] = log_dir
+
+    if nnodes > 1:
+        import jax
+        kw = {}
+        if master:
+            kw["coordinator_address"] = master
+        if rank >= 0:
+            kw["process_id"] = rank
+            kw["num_processes"] = nnodes
+        jax.distributed.initialize(**kw)
+
+    sys.argv = [script] + list(script_args)
+    runpy.run_path(script, run_name="__main__")
+
+
+def main(argv=None):
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    launch(args.script, args.script_args, master=args.master,
+           nnodes=args.nnodes, rank=args.rank, job_id=args.job_id,
+           log_dir=args.log_dir)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -70,14 +70,18 @@ class QuanterFactory:
         return self._cls(*self._args, **self._kwargs)
 
 
+QUANTER_REGISTRY = {}
+
+
 def quanter(name):
     """Decorator registering a quanter layer under a factory name
-    (reference: factory.py quanter)."""
+    (reference: factory.py quanter). The factory is available as
+    QUANTER_REGISTRY[name]."""
     def deco(cls):
         def factory(*args, **kwargs):
             return QuanterFactory(cls, *args, **kwargs)
         factory.__name__ = name
-        globals()[name] = factory
+        QUANTER_REGISTRY[name] = factory
         return cls
     return deco
 
@@ -100,13 +104,20 @@ class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
 
     def forward(self, x):
         if self.training:
-            absmax = float(jnp.max(jnp.abs(x._value)))
-            r = self._moving_rate
-            state = float(self.state._value) * r + 1.0
-            accum = float(self.accum._value) * r + absmax
-            self.state._value = jnp.asarray(state, jnp.float32)
-            self.accum._value = jnp.asarray(accum, jnp.float32)
-            self.scale._value = jnp.asarray(accum / state, jnp.float32)
+            # dynamic_forward: update running absmax. Eager-only — under
+            # any jit/vjp tracing (input OR buffers abstract) the
+            # accumulated scale is used instead, matching the reference's
+            # static_forward (quanters/abs_max.py:180).
+            try:
+                absmax = float(jnp.max(jnp.abs(x._value)))
+                r = self._moving_rate
+                state = float(self.state._value) * r + 1.0
+                accum = float(self.accum._value) * r + absmax
+                self.state._value = jnp.asarray(state, jnp.float32)
+                self.accum._value = jnp.asarray(accum, jnp.float32)
+                self.scale._value = jnp.asarray(accum / state, jnp.float32)
+            except jax.errors.ConcretizationTypeError:
+                pass
         return _fake_quant_ste(x, self.scale, self._bit_length)
 
     def scales(self):
@@ -132,9 +143,12 @@ class AbsmaxObserverLayer(BaseObserver):
         self.register_buffer("max_value", Tensor(jnp.zeros((), jnp.float32)))
 
     def forward(self, x):
-        m = float(jnp.max(jnp.abs(x._value)))
-        if m > float(self.max_value._value):
-            self.max_value._value = jnp.asarray(m, jnp.float32)
+        try:
+            m = float(jnp.max(jnp.abs(x._value)))
+            if m > float(self.max_value._value):
+                self.max_value._value = jnp.asarray(m, jnp.float32)
+        except jax.errors.ConcretizationTypeError:
+            pass  # under tracing: calibration is an eager-mode activity
         return x
 
     def scales(self):
@@ -307,14 +321,17 @@ class PTQ(_Quantization):
             model = copy.deepcopy(model)
 
         def make(name, child, cfg):
-            for src in self._config.qat_layer_mappings:
+            for src, dst in self._config.qat_layer_mappings.items():
                 if type(child) is src:
                     obs_cfg = SingleLayerConfig(
                         cfg.activation or QuanterFactory(AbsmaxObserverLayer),
                         cfg.weight or QuanterFactory(AbsmaxObserverLayer))
-                    cls = (QuantedLinear if src.__name__ == "Linear"
-                           else QuantedConv2D)
-                    return cls(child, obs_cfg)
+                    return dst(child, obs_cfg)
+            if cfg.activation is not None and not list(child.children()):
+                # observe outputs of non-quantized leaf layers so their
+                # ranges are available at export (reference: ptq.py wraps
+                # them in ObserveWrapper)
+                return ObserveWrapper(cfg.activation._instance(child), child)
             return None
         return self._transform(model, make)
 
@@ -322,6 +339,13 @@ class PTQ(_Quantization):
         """Freeze observed scales into fake-quant layers."""
         if not inplace:
             model = copy.deepcopy(model)
+        def unwrap(parent):
+            for name, child in list(parent.named_children()):
+                if isinstance(child, ObserveWrapper):
+                    parent.add_sublayer(name, child._observed)
+                else:
+                    unwrap(child)
+        unwrap(model)
         for lay in model.sublayers(include_self=True):
             if isinstance(lay, (QuantedLinear, QuantedConv2D)):
                 for attr in ("weight_quanter", "activation_quanter"):

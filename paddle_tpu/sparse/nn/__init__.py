@@ -127,11 +127,26 @@ def _same_padding(kernel_size, dilation, n):
     return [((k - 1) // 2) * d for k, d in zip(ks, dl)]
 
 
+def _check_subm(kernel_size, stride, n):
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) else \
+        [kernel_size] * n
+    st = stride if isinstance(stride, (list, tuple)) else [stride] * n
+    if any(s != 1 for s in st):
+        raise ValueError(
+            "SubmConv preserves the input sparsity pattern and therefore "
+            f"requires stride=1, got stride={stride}")
+    if any(k % 2 == 0 for k in ks):
+        raise ValueError(
+            "SubmConv requires odd kernel sizes (same-padding must keep "
+            f"the spatial shape), got kernel_size={kernel_size}")
+
+
 def Conv2D(in_channels, out_channels, kernel_size, stride=1, padding=0,
            dilation=1, groups=1, subm=False, key=None, weight_attr=None,
            bias_attr=None, data_format="NHWC"):
     from paddle_tpu.nn import Conv2D as DenseConv2D
     if subm:
+        _check_subm(kernel_size, stride, 2)
         stride, padding = 1, _same_padding(kernel_size, dilation, 2)
     return _DenseConvWrapper(
         DenseConv2D(in_channels, out_channels, kernel_size, stride=stride,
@@ -143,6 +158,7 @@ def Conv3D(in_channels, out_channels, kernel_size, stride=1, padding=0,
            bias_attr=None, data_format="NDHWC"):
     from paddle_tpu.nn import Conv3D as DenseConv3D
     if subm:
+        _check_subm(kernel_size, stride, 3)
         stride, padding = 1, _same_padding(kernel_size, dilation, 3)
     return _DenseConvWrapper(
         DenseConv3D(in_channels, out_channels, kernel_size, stride=stride,

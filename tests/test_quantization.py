@@ -100,3 +100,29 @@ def test_type_and_name_config_priority():
     quanted = [l for l in qmodel.sublayers() if isinstance(l, QuantedLinear)]
     assert all(q.activation_quanter is None for q in quanted)
     assert all(q.weight_quanter is not None for q in quanted)
+
+
+def test_ptq_honors_custom_mapping():
+    class MyLinear(paddle.nn.Linear):
+        pass
+
+    quanter = FakeQuanterWithAbsMaxObserver()
+    cfg = QuantConfig(activation=None, weight=None)
+    cfg.add_qat_layer_mapping(MyLinear, QuantedLinear)
+    model = paddle.nn.Sequential(MyLinear(4, 4))
+    qmodel = PTQ(cfg).quantize(model)
+    x = paddle.to_tensor(np.random.RandomState(3).randn(2, 4).astype(np.float32))
+    out = qmodel(x)  # would crash with QuantedConv2D
+    assert out.shape == [2, 4]
+
+
+def test_fake_quanter_under_jit():
+    quanter = FakeQuanterWithAbsMaxObserver()
+    cfg = QuantConfig(activation=quanter, weight=quanter)
+    qmodel = QAT(cfg).quantize(
+        paddle.nn.Sequential(paddle.nn.Linear(4, 4)))
+    x = paddle.to_tensor(np.random.RandomState(4).randn(2, 4).astype(np.float32))
+    qmodel(x)  # eager warm-up records scales
+    st = paddle.jit.to_static(lambda t: qmodel(t))
+    out = st(x)  # must not raise ConcretizationTypeError
+    assert np.isfinite(out.numpy()).all()
