@@ -1,0 +1,71 @@
+"""`paddle.utils` (reference: python/paddle/utils/).
+
+cpp_extension (JIT C++ host extensions + custom-op registration),
+dlpack interop, unique_name, deprecated, run_check.
+"""
+from __future__ import annotations
+
+import warnings
+
+from paddle_tpu.utils import cpp_extension  # noqa: F401
+from paddle_tpu.utils import dlpack  # noqa: F401
+from paddle_tpu.utils import unique_name  # noqa: F401
+
+__all__ = ["cpp_extension", "dlpack", "unique_name", "deprecated",
+           "run_check", "require_version", "try_import"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference: utils/deprecated.py)."""
+    def deco(fn):
+        def wrapper(*args, **kwargs):
+            warnings.warn(
+                f"{fn.__name__} is deprecated since {since}"
+                + (f", use {update_to} instead" if update_to else "")
+                + (f" ({reason})" if reason else ""),
+                DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        wrapper.__name__ = fn.__name__
+        wrapper.__doc__ = fn.__doc__
+        return wrapper
+    return deco
+
+
+def run_check():
+    """Sanity-check the install (reference: utils/install_check.py
+    run_check): one matmul fwd+bwd on the default device."""
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    x.stop_gradient = False
+    y = (x @ x).sum()
+    y.backward()
+    assert x.grad is not None
+    dev = paddle.device.get_device()
+    print(f"paddle_tpu is installed successfully! (device: {dev})")
+
+
+def require_version(min_version, max_version=None):
+    import paddle_tpu
+
+    def parse(s):
+        return tuple(int(p) for p in str(s).split(".") if p.isdigit())
+
+    v = parse(paddle_tpu.__version__)
+    if v < parse(min_version):
+        raise ImportError(
+            f"paddle_tpu>={min_version} required, found "
+            f"{paddle_tpu.__version__}")
+    if max_version is not None and v > parse(max_version):
+        raise ImportError(
+            f"paddle_tpu<={max_version} required, found "
+            f"{paddle_tpu.__version__}")
+    return True
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"{module_name} is not installed")

@@ -1,0 +1,136 @@
+"""`paddle.utils.cpp_extension` — JIT-built C++ host extensions and the
+custom-op registration API (reference:
+python/paddle/utils/cpp_extension/cpp_extension.py `load`:797 `setup`:79;
+C++ side paddle/fluid/framework/custom_operator.cc + PD_BUILD_OP in
+paddle/extension.h).
+
+TPU-native split of the reference's custom-op story:
+- DEVICE custom ops are Pallas kernels or jnp compositions registered with
+  `register_op` — they enter the same op registry as built-ins and get
+  autograd, AMP and jit for free (SURVEY.md §7 "custom-op API as Pallas
+  plug-in point").
+- HOST custom ops (C++ preprocessing, tokenizers, IO) are compiled here
+  with g++ and called through ctypes; `as_host_op` lifts such a function
+  into a jit-compatible op via jax.pure_callback.
+No pybind11 in this image — the C ABI + ctypes replaces it.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+
+import jax
+import numpy as np
+
+from paddle_tpu.core.dispatch import defop, OP_REGISTRY
+
+__all__ = ["load", "CppExtension", "CUDAExtension", "setup",
+           "register_op", "as_host_op", "get_build_directory"]
+
+
+def get_build_directory():
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.expanduser("~/.cache/paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load(name, sources, extra_cxx_flags=None, extra_include_paths=None,
+         build_directory=None, verbose=False, **kwargs):
+    """Compile C++ sources into a shared library and load it via ctypes
+    (reference: cpp_extension.py:797 load — theirs builds a pybind module;
+    ours builds a C-ABI .so, which is what the no-pybind11 toolchain
+    supports and what ctypes/jax callbacks need)."""
+    build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
+    srcs = [os.path.abspath(s) for s in sources]
+    tag = hashlib.sha1(
+        ("".join(srcs) + str(extra_cxx_flags) + str(extra_include_paths)
+         + "".join(open(s).read() for s in srcs)).encode()).hexdigest()[:12]
+    lib_path = os.path.join(build_dir, f"{name}_{tag}.so")
+    if not os.path.exists(lib_path):
+        cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+               + (extra_cxx_flags or [])
+               + [f"-I{p}" for p in (extra_include_paths or [])]
+               + srcs + ["-o", lib_path])
+        if verbose:
+            print(" ".join(cmd))
+        res = subprocess.run(cmd, capture_output=True, text=True)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{res.stderr}")
+    return ctypes.CDLL(lib_path)
+
+
+class CppExtension:
+    """setup()-style extension description (reference: cpp_extension.py
+    CppExtension). Built by `setup` below using the same g++ path."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension is not supported on the TPU backend: device kernels "
+        "are Pallas (see paddle_tpu.utils.cpp_extension.register_op); "
+        "host C++ uses CppExtension")
+
+
+def setup(name=None, ext_modules=None, **attr):
+    """Build extensions in-place (reference: cpp_extension.py:79 setup).
+    Returns {ext_name: CDLL}."""
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) \
+        else [ext_modules]
+    out = {}
+    for i, ext in enumerate(exts):
+        if ext is None:
+            continue
+        ext_name = name or f"ext{i}"
+        out[ext_name] = load(ext_name, ext.sources, **ext.kwargs)
+    return out
+
+
+# -- custom op registration (device path) -----------------------------------
+
+def register_op(name, forward, backward=None, amp_policy="promote"):
+    """Register a custom device op into the global op registry (reference:
+    PD_BUILD_OP macro in paddle/extension.h + RegisterOperatorWithMetaInfo
+    in paddle/fluid/framework/custom_operator.cc).
+
+    forward: pure jax function (jnp/lax/Pallas) over arrays.
+    backward: optional VJP — backward(res, *grads_out) with res the
+    residuals returned by forward_fwd. If backward is None, jax traces the
+    gradient through `forward` automatically. Pass a (fwd, bwd) pair via
+    `backward` for a hand-written kernel gradient:
+        register_op("my_op", f, backward=(f_fwd, f_bwd))
+    Returns the eager op callable (Tensor-in/Tensor-out with autograd).
+    """
+    if name in OP_REGISTRY:
+        raise ValueError(f"op {name!r} already registered")
+    fn = forward
+    if backward is not None:
+        fwd_rule, bwd_rule = backward
+        fn = jax.custom_vjp(forward)
+        fn.defvjp(fwd_rule, bwd_rule)
+    return defop(name, amp_policy=amp_policy)(fn)
+
+
+def as_host_op(name, host_fn, out_shape_fn, differentiable=False):
+    """Lift a host function (e.g. a ctypes call into a loaded C++ library)
+    into a jit-compatible op via jax.pure_callback (reference analog: CPU
+    custom kernels registered through device_ext.h).
+
+    host_fn(*numpy_arrays) -> numpy array;
+    out_shape_fn(*ShapeDtypeStruct) -> ShapeDtypeStruct (or jax array
+    prototype) describing the output.
+    """
+    def fn(*arrays):
+        out_spec = out_shape_fn(*[
+            jax.ShapeDtypeStruct(np.shape(a), a.dtype) for a in arrays])
+        return jax.pure_callback(host_fn, out_spec, *arrays)
+
+    return defop(name, differentiable=differentiable)(fn)

@@ -1,0 +1,26 @@
+"""DLPack interop (reference: python/paddle/utils/dlpack.py) — zero-copy
+exchange with torch/numpy/cupy via jax's dlpack support."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    # jax arrays implement __dlpack__ natively — consumers call
+    # from_dlpack(arr) on the returned object (the legacy
+    # jax.dlpack.to_dlpack capsule API was removed in jax 0.9)
+    arr = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return arr
+
+
+def from_dlpack(capsule):
+    if hasattr(capsule, "__dlpack__"):
+        arr = jnp.from_dlpack(capsule)
+    else:
+        arr = jax.dlpack.from_dlpack(capsule)
+    return Tensor(arr)

@@ -1,0 +1,28 @@
+"""Unique name generator (reference: python/paddle/utils/unique_name.py)."""
+from __future__ import annotations
+
+import contextlib
+
+_counters: dict = {}
+
+
+def generate(key):
+    n = _counters.get(key, 0)
+    _counters[key] = n + 1
+    return f"{key}_{n}"
+
+
+def switch(new_generator=None):
+    global _counters
+    old = _counters
+    _counters = new_generator if new_generator is not None else {}
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator if isinstance(new_generator, dict) else {})
+    try:
+        yield
+    finally:
+        switch(old)

@@ -1,0 +1,155 @@
+"""paddle.utils tests: cpp_extension JIT build + ctypes, custom op
+registration with custom VJP, host ops via pure_callback, dlpack
+(reference: test/custom_op/, python/paddle/utils/).
+"""
+import ctypes
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension, dlpack, unique_name
+
+
+def test_register_custom_op_autograd():
+    def swish3(x):
+        return x * jax.nn.sigmoid(3.0 * x)
+
+    op = cpp_extension.register_op("custom_swish3", swish3)
+    x = paddle.to_tensor(np.array([0.5, -1.0], np.float32))
+    x.stop_gradient = False
+    y = op(x)
+    ref = 0.5 / (1 + np.exp(-1.5))
+    np.testing.assert_allclose(y.numpy()[0], ref, rtol=1e-5)
+    y.sum().backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+def test_register_custom_op_with_custom_vjp():
+    def clip_fw(x):
+        return jnp.clip(x, -1.0, 1.0)
+
+    def clip_fwd(x):
+        return jnp.clip(x, -1.0, 1.0), x
+
+    def clip_bwd(res, g):
+        # straight-through: pretend clip is identity in backward
+        return (g,)
+
+    op = cpp_extension.register_op("custom_clip_ste", clip_fw,
+                                   backward=(clip_fwd, clip_bwd))
+    x = paddle.to_tensor(np.array([2.0, 0.5], np.float32))
+    x.stop_gradient = False
+    y = op(x)
+    np.testing.assert_allclose(y.numpy(), [1.0, 0.5])
+    y.sum().backward()
+    # straight-through gradient: ones even outside the clip range
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+
+def test_register_op_rejects_duplicates():
+    cpp_extension.register_op("custom_dup_op", lambda x: x)
+    with pytest.raises(ValueError):
+        cpp_extension.register_op("custom_dup_op", lambda x: x)
+
+
+def test_cpp_extension_load_and_host_op(tmp_path):
+    src = tmp_path / "ops.cc"
+    src.write_text(r"""
+extern "C" {
+void scale_add(const float* x, float* out, long n, float scale, float bias) {
+    for (long i = 0; i < n; ++i) out[i] = x[i] * scale + bias;
+}
+float dot(const float* a, const float* b, long n) {
+    float s = 0;
+    for (long i = 0; i < n; ++i) s += a[i] * b[i];
+    return s;
+}
+}
+""")
+    lib = cpp_extension.load("test_ops", [str(src)],
+                             build_directory=str(tmp_path))
+    lib.dot.restype = ctypes.c_float
+    a = np.arange(4, dtype=np.float32)
+    out = np.empty_like(a)
+    lib.scale_add(a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                  4, ctypes.c_float(2.0), ctypes.c_float(1.0))
+    np.testing.assert_allclose(out, a * 2 + 1)
+
+    # lift into a jit-compatible op
+    def host_scale(x):
+        x = np.ascontiguousarray(x, np.float32)
+        res = np.empty_like(x)
+        lib.scale_add(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      res.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                      x.size, ctypes.c_float(3.0), ctypes.c_float(0.0))
+        return res
+
+    op = cpp_extension.as_host_op(
+        "custom_host_scale", host_scale,
+        out_shape_fn=lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype))
+    x = paddle.to_tensor(a)
+    np.testing.assert_allclose(op(x).numpy(), a * 3)
+    # and under jit
+    st = paddle.jit.to_static(lambda t: op(t))
+    np.testing.assert_allclose(st(x).numpy(), a * 3)
+
+
+def test_cpp_extension_build_error_is_reported(tmp_path):
+    bad = tmp_path / "bad.cc"
+    bad.write_text("this is not C++")
+    with pytest.raises(RuntimeError, match="build failed"):
+        cpp_extension.load("bad_ext", [str(bad)],
+                           build_directory=str(tmp_path))
+
+
+def test_cuda_extension_rejected():
+    with pytest.raises(RuntimeError, match="Pallas"):
+        cpp_extension.CUDAExtension(["x.cu"])
+
+
+def test_dlpack_roundtrip():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    t = dlpack.from_dlpack(x._value)  # jax array has __dlpack__
+    np.testing.assert_allclose(t.numpy(), x.numpy())
+    # torch interop
+    import torch
+    tt = torch.arange(4, dtype=torch.float32)
+    back = dlpack.from_dlpack(tt)
+    np.testing.assert_allclose(back.numpy(), [0, 1, 2, 3])
+
+
+def test_unique_name():
+    with unique_name.guard():
+        assert unique_name.generate("fc") == "fc_0"
+        assert unique_name.generate("fc") == "fc_1"
+        assert unique_name.generate("conv") == "conv_0"
+    with unique_name.guard():
+        assert unique_name.generate("fc") == "fc_0"
+
+
+def test_run_check(capsys):
+    from paddle_tpu.utils import run_check
+    run_check()
+    assert "successfully" in capsys.readouterr().out
+
+
+def test_to_dlpack_consumable():
+    import torch
+    x = paddle.to_tensor(np.arange(3, dtype=np.float32))
+    cap = dlpack.to_dlpack(x)
+    back = torch.from_dlpack(cap)
+    np.testing.assert_allclose(back.numpy(), [0, 1, 2])
+
+
+def test_require_version_numeric_compare():
+    from paddle_tpu.utils import require_version
+    assert require_version("0.0.1")
+    with pytest.raises(ImportError):
+        require_version("99.0")
+    with pytest.raises(ImportError):
+        require_version("0.0.1", max_version="0.0.2")
