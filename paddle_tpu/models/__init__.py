@@ -11,3 +11,16 @@ from paddle_tpu.models.llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, RMSNorm,
     llama3_8b_config, tiny_llama_config,
 )
+from paddle_tpu.models.qwen2_moe import (  # noqa: F401
+    Qwen2MoeConfig, Qwen2MoeForCausalLM, tiny_qwen2_moe_config,
+)
+from paddle_tpu.models.bert import (  # noqa: F401
+    BertConfig, BertModel, BertForSequenceClassification, BertForMaskedLM,
+    bert_base_config, tiny_bert_config,
+)
+from paddle_tpu.models.gpt import (  # noqa: F401
+    GPTConfig, GPTModel, GPTForCausalLM, gpt2_small_config, tiny_gpt_config,
+)
+from paddle_tpu.models.dit import (  # noqa: F401
+    DiTConfig, DiT, dit_xl_2_config, tiny_dit_config,
+)

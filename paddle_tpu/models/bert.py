@@ -1,0 +1,141 @@
+"""BERT family (PaddleNLP transformers/bert equivalent; M2 milestone
+BERT-SST2 finetune per SURVEY.md §7). Built from paddle_tpu.nn blocks —
+post-LN encoder, learned positions, GELU FFN, pooler.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu import tensor as T
+from paddle_tpu.core.tensor import Tensor
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    num_labels: int = 2
+
+
+def bert_base_config(**overrides) -> BertConfig:
+    return BertConfig(**overrides)
+
+
+def tiny_bert_config(**overrides) -> BertConfig:
+    kw = dict(vocab_size=1024, hidden_size=64, num_hidden_layers=2,
+              num_attention_heads=4, intermediate_size=128,
+              max_position_embeddings=128, hidden_dropout_prob=0.0,
+              attention_probs_dropout_prob=0.0)
+    kw.update(overrides)
+    return BertConfig(**kw)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = T.arange(0, s, dtype="int32")
+            position_ids = T.unsqueeze(position_ids, 0)
+        if token_type_ids is None:
+            token_type_ids = T.zeros_like(input_ids)
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertModel(nn.Layer):
+    """Encoder stack (PaddleNLP BertModel). attention_mask: (b, s) with 1
+    for real tokens, 0 for padding."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation="gelu",
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            act_dropout=0.0, normalize_before=False)
+        self.encoder = nn.TransformerEncoder(layer, cfg.num_hidden_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        mask = None
+        if attention_mask is not None:
+            # (b, s) keep-mask -> additive (b, 1, 1, s)
+            m = T.cast(attention_mask, "float32")
+            mask = T.unsqueeze(T.unsqueeze((m - 1.0) * 1e9, 1), 1)
+        seq = self.encoder(x, src_mask=mask)
+        pooled = T.tanh(self.pooler(seq[:, 0]))
+        return seq, pooled
+
+
+class BertForSequenceClassification(nn.Layer):
+    """(PaddleNLP BertForSequenceClassification — the BERT-SST2 finetune
+    head, SURVEY.md §7 M2)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self.classifier = nn.Linear(cfg.hidden_size, cfg.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            loss = nn.functional.cross_entropy(logits, labels)
+            return loss, logits
+        return logits
+
+
+class BertForMaskedLM(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.decoder = nn.Linear(cfg.hidden_size, cfg.vocab_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        seq, _ = self.bert(input_ids, token_type_ids,
+                           attention_mask=attention_mask)
+        h = self.layer_norm(nn.functional.gelu(self.transform(seq)))
+        logits = self.decoder(h)
+        if labels is not None:
+            loss = nn.functional.cross_entropy(
+                T.reshape(logits, [-1, logits.shape[-1]]),
+                T.reshape(labels, [-1]), ignore_index=-100)
+            return loss, logits
+        return logits

@@ -1,0 +1,201 @@
+"""DiT — Diffusion Transformer (PaddleMIX ppdiffusers DiTTransformer2DModel
+equivalent; SURVEY.md §7 M5 "DiT/SD3 conv+attention config").
+
+Patchify conv -> N DiT blocks with adaLN-Zero conditioning on (timestep,
+class label) -> unpatchify. Attention + large matmuls dominate, so the
+whole model rides the MXU; timestep embedding is the standard sinusoidal
+MLP.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu import tensor as T
+from paddle_tpu.core.tensor import Tensor
+
+
+@dataclass
+class DiTConfig:
+    input_size: int = 32          # latent spatial size
+    patch_size: int = 2
+    in_channels: int = 4
+    hidden_size: int = 1152
+    num_layers: int = 28
+    num_attention_heads: int = 16
+    mlp_ratio: float = 4.0
+    num_classes: int = 1000
+    learn_sigma: bool = True
+
+    @property
+    def out_channels(self):
+        return self.in_channels * (2 if self.learn_sigma else 1)
+
+    @property
+    def num_patches(self):
+        return (self.input_size // self.patch_size) ** 2
+
+
+def dit_xl_2_config(**overrides) -> DiTConfig:
+    return DiTConfig(**overrides)
+
+
+def tiny_dit_config(**overrides) -> DiTConfig:
+    kw = dict(input_size=8, patch_size=2, in_channels=4, hidden_size=64,
+              num_layers=2, num_attention_heads=4, num_classes=10)
+    kw.update(overrides)
+    return DiTConfig(**kw)
+
+
+def timestep_embedding(t, dim, max_period=10000):
+    """Sinusoidal timestep features (DiT paper eq.; ppdiffusers
+    TimestepEmbedding)."""
+    half = dim // 2
+    freqs = T.exp(T.arange(0, half, dtype="float32")
+                  * (-math.log(max_period) / half))
+    args = T.unsqueeze(T.cast(t, "float32"), -1) * T.unsqueeze(freqs, 0)
+    return T.concat([T.cos(args), T.sin(args)], axis=-1)
+
+
+class TimestepEmbedder(nn.Layer):
+    def __init__(self, hidden_size, freq_dim=256):
+        super().__init__()
+        self.freq_dim = freq_dim
+        self.mlp = nn.Sequential(
+            nn.Linear(freq_dim, hidden_size), nn.Silu(),
+            nn.Linear(hidden_size, hidden_size))
+
+    def forward(self, t):
+        return self.mlp(timestep_embedding(t, self.freq_dim))
+
+
+class LabelEmbedder(nn.Layer):
+    def __init__(self, num_classes, hidden_size):
+        super().__init__()
+        # +1 slot: the null (unconditional) class for CFG
+        self.embedding_table = nn.Embedding(num_classes + 1, hidden_size)
+        self.num_classes = num_classes
+
+    def forward(self, labels):
+        return self.embedding_table(labels)
+
+
+def modulate(x, shift, scale):
+    return x * (1 + T.unsqueeze(scale, 1)) + T.unsqueeze(shift, 1)
+
+
+class DiTBlock(nn.Layer):
+    """Transformer block with adaLN-Zero conditioning (DiT paper §3)."""
+
+    def __init__(self, cfg: DiTConfig):
+        super().__init__()
+        d = cfg.hidden_size
+        self.norm1 = nn.LayerNorm(d, epsilon=1e-6, weight_attr=False,
+                                  bias_attr=False)
+        self.attn = nn.MultiHeadAttention(d, cfg.num_attention_heads, 0.0)
+        self.norm2 = nn.LayerNorm(d, epsilon=1e-6, weight_attr=False,
+                                  bias_attr=False)
+        f = int(d * cfg.mlp_ratio)
+        self.mlp = nn.Sequential(nn.Linear(d, f), nn.GELU(approximate=True),
+                                 nn.Linear(f, d))
+        # adaLN-zero: 6 modulation params, zero-init so blocks start as
+        # identity (DiT paper: stabilizes large-depth training)
+        zero = paddle_tpu.nn.ParamAttr(
+            initializer=nn.initializer.Constant(0.0))
+        self.adaLN_modulation = nn.Sequential(
+            nn.Silu(), nn.Linear(d, 6 * d, weight_attr=zero,
+                                 bias_attr=zero))
+
+    def forward(self, x, c):
+        mod = self.adaLN_modulation(c)
+        (shift_msa, scale_msa, gate_msa, shift_mlp, scale_mlp,
+         gate_mlp) = tuple(T.split(mod, 6, axis=-1))
+        h = modulate(self.norm1(x), shift_msa, scale_msa)
+        x = x + T.unsqueeze(gate_msa, 1) * self.attn(h, h, h)
+        h = modulate(self.norm2(x), shift_mlp, scale_mlp)
+        x = x + T.unsqueeze(gate_mlp, 1) * self.mlp(h)
+        return x
+
+
+class FinalLayer(nn.Layer):
+    def __init__(self, cfg: DiTConfig):
+        super().__init__()
+        d = cfg.hidden_size
+        self.norm_final = nn.LayerNorm(d, epsilon=1e-6, weight_attr=False,
+                                       bias_attr=False)
+        zero = paddle_tpu.nn.ParamAttr(
+            initializer=nn.initializer.Constant(0.0))
+        self.adaLN_modulation = nn.Sequential(
+            nn.Silu(), nn.Linear(d, 2 * d, weight_attr=zero,
+                                 bias_attr=zero))
+        self.linear = nn.Linear(
+            d, cfg.patch_size * cfg.patch_size * cfg.out_channels,
+            weight_attr=zero, bias_attr=zero)
+
+    def forward(self, x, c):
+        shift, scale = tuple(T.split(self.adaLN_modulation(c), 2, axis=-1))
+        return self.linear(modulate(self.norm_final(x), shift, scale))
+
+
+class DiT(nn.Layer):
+    """Latent-space diffusion transformer: forward(x, t, y) -> noise
+    prediction with the same spatial shape (+sigma channels)."""
+
+    def __init__(self, cfg: DiTConfig):
+        super().__init__()
+        self.config = cfg
+        p, d = cfg.patch_size, cfg.hidden_size
+        self.x_embedder = nn.Conv2D(cfg.in_channels, d, p, stride=p)
+        self.t_embedder = TimestepEmbedder(d)
+        self.y_embedder = LabelEmbedder(cfg.num_classes, d)
+        # fixed sin-cos 2D position table (DiT uses non-learned)
+        grid = cfg.input_size // p
+        self.register_buffer(
+            "pos_embed",
+            Tensor(_sincos_2d(d, grid)[None].astype(np.float32)),
+            persistable=False)
+        self.blocks = nn.LayerList([DiTBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.final_layer = FinalLayer(cfg)
+
+    def unpatchify(self, x):
+        cfg = self.config
+        p, c = cfg.patch_size, cfg.out_channels
+        g = cfg.input_size // p
+        b = x.shape[0]
+        x = T.reshape(x, [b, g, g, p, p, c])
+        x = T.transpose(x, [0, 5, 1, 3, 2, 4])  # b c gh p gw p
+        return T.reshape(x, [b, c, g * p, g * p])
+
+    def forward(self, x, t, y):
+        # x: (b, c, h, w) latents; t: (b,) timesteps; y: (b,) labels
+        x = self.x_embedder(x)                      # (b, d, g, g)
+        b, d = x.shape[0], x.shape[1]
+        x = T.reshape(x, [b, d, -1])
+        x = T.transpose(x, [0, 2, 1]) + self.pos_embed
+        c = self.t_embedder(t) + self.y_embedder(y)
+        for block in self.blocks:
+            x = block(x, c)
+        x = self.final_layer(x, c)
+        return self.unpatchify(x)
+
+
+def _sincos_2d(dim, grid_size):
+    """2D sin-cos position embedding (DiT repo get_2d_sincos_pos_embed)."""
+    def _1d(d, pos):
+        omega = np.arange(d // 2, dtype=np.float64) / (d / 2.0)
+        omega = 1.0 / 10000 ** omega
+        out = np.einsum("m,d->md", pos.reshape(-1), omega)
+        return np.concatenate([np.sin(out), np.cos(out)], axis=1)
+
+    grid_h = np.arange(grid_size, dtype=np.float64)
+    grid_w = np.arange(grid_size, dtype=np.float64)
+    grid = np.meshgrid(grid_w, grid_h)  # w goes first
+    grid = np.stack(grid, axis=0).reshape([2, 1, grid_size, grid_size])
+    emb_h = _1d(dim // 2, grid[0])
+    emb_w = _1d(dim // 2, grid[1])
+    return np.concatenate([emb_h, emb_w], axis=1)

@@ -1,0 +1,118 @@
+"""GPT-2/3 family (PaddleNLP transformers/gpt equivalent; PaddleFleetX's
+classic pretrain config). Pre-LN decoder-only transformer with learned
+positions and tied input/output embedding.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu import tensor as T
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+
+
+def gpt2_small_config(**overrides) -> GPTConfig:
+    return GPTConfig(**overrides)
+
+
+def tiny_gpt_config(**overrides) -> GPTConfig:
+    kw = dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+              num_attention_heads=4, intermediate_size=128,
+              max_position_embeddings=128, hidden_dropout_prob=0.0,
+              attention_probs_dropout_prob=0.0)
+    kw.update(overrides)
+    return GPTConfig(**kw)
+
+
+class GPTDecoderLayer(nn.Layer):
+    """Pre-LN causal block (PaddleNLP GPTDecoderLayer)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(cfg.hidden_size,
+                                  epsilon=cfg.layer_norm_eps)
+        self.self_attn = nn.MultiHeadAttention(
+            cfg.hidden_size, cfg.num_attention_heads,
+            cfg.attention_probs_dropout_prob)
+        self.norm2 = nn.LayerNorm(cfg.hidden_size,
+                                  epsilon=cfg.layer_norm_eps)
+        self.linear1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.linear2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        h = self.norm1(x)
+        x = x + self.dropout(self.self_attn(h, h, h, attn_mask))
+        h = self.norm2(x)
+        x = x + self.dropout(
+            self.linear2(nn.functional.gelu(self.linear1(h))))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.config = cfg
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+        self.layers = nn.LayerList(
+            [GPTDecoderLayer(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.final_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids, position_ids=None, attn_mask=None):
+        b, s = input_ids.shape[0], input_ids.shape[1]
+        if position_ids is None:
+            position_ids = T.unsqueeze(T.arange(0, s, dtype="int32"), 0)
+        x = self.dropout(self.word_embeddings(input_ids)
+                         + self.position_embeddings(position_ids))
+        # causal additive mask (b-agnostic, (1, 1, s, s)) — ALWAYS applied;
+        # a user mask (e.g. padding) is combined with it, never replaces it
+        causal = T.triu(T.full([s, s], -1e9, dtype="float32"), 1)
+        causal = T.unsqueeze(T.unsqueeze(causal, 0), 0)
+        attn_mask = causal if attn_mask is None else causal + attn_mask
+        for layer in self.layers:
+            x = layer(x, attn_mask)
+        return self.final_norm(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    """LM head tied to the input embedding (PaddleNLP
+    GPTForCausalLM/GPTLMHeadModel)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+
+    def logits(self, hidden):
+        w = self.gpt.word_embeddings.weight  # (vocab, d) — tied
+        return T.matmul(hidden, w, transpose_y=True)
+
+    def forward(self, input_ids, labels=None, position_ids=None,
+                attn_mask=None):
+        hidden = self.gpt(input_ids, position_ids, attn_mask)
+        logits = self.logits(hidden)
+        if labels is None:
+            return logits
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        loss = nn.functional.cross_entropy(
+            T.reshape(shift_logits, [-1, shift_logits.shape[-1]]),
+            T.reshape(shift_labels, [-1]))
+        return loss, logits
