@@ -1,0 +1,141 @@
+"""`paddle.amp.debugging` — numerics debugging (reference:
+python/paddle/amp/debugging.py:157 TensorCheckerConfig, :339
+check_numerics, :459 enable_operator_stats_collection, :634
+enable_tensor_checker; C++ guard paddle/fluid/eager/nan_inf_utils.cc
+behind FLAGS_check_nan_inf).
+
+The eager dispatcher already consults FLAGS_check_nan_inf after every op
+(paddle_tpu/core/dispatch.py); this module is the user-facing switchboard
+plus per-op dtype statistics collected from the same dispatch hook.
+"""
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core import flags
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "DebugMode", "TensorCheckerConfig", "check_numerics",
+    "enable_tensor_checker", "disable_tensor_checker",
+    "enable_operator_stats_collection",
+    "disable_operator_stats_collection", "collect_operator_stats",
+]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+@dataclass
+class TensorCheckerConfig:
+    """(reference: debugging.py:157) enable_check + debug level; op-type
+    allow/deny lists narrow the checked set."""
+    enable: bool = True
+    debug_mode: int = DebugMode.CHECK_NAN_INF_AND_ABORT
+    checked_op_list: list = field(default_factory=list)
+    skipped_op_list: list = field(default_factory=list)
+
+    def _level(self):
+        # dispatcher semantics: level 0 raises, >0 warns
+        return 0 if self.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT \
+            else 1
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Immediate NaN/Inf check of one tensor (reference: debugging.py:339).
+    Returns (num_nan, num_inf, num_zero) like the reference's stats."""
+    arr = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    nan = int(jnp.sum(jnp.isnan(arr)))
+    inf = int(jnp.sum(jnp.isinf(arr)))
+    zero = int(jnp.sum(arr == 0))
+    if nan or inf:
+        msg = (f"check_numerics: op={op_type or '?'} var={var_name or '?'} "
+               f"num_nan={nan} num_inf={inf}")
+        if debug_mode in (None, DebugMode.CHECK_NAN_INF_AND_ABORT):
+            raise FloatingPointError(msg)
+        print("WARNING:", msg)
+    return (Tensor(jnp.asarray(nan)), Tensor(jnp.asarray(inf)),
+            Tensor(jnp.asarray(zero)))
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    """Turn on after-every-op NaN/Inf checking (reference:
+    debugging.py:634). Wired to the dispatcher's FLAGS_check_nan_inf;
+    checked_op_list/skipped_op_list narrow the checked set via the
+    dispatcher's NAN_CHECK_FILTER hook."""
+    flags.set_flags({"FLAGS_check_nan_inf": bool(checker_config.enable),
+                     "FLAGS_check_nan_inf_level": checker_config._level()})
+    from paddle_tpu.core import dispatch as D
+    checked = set(checker_config.checked_op_list or [])
+    skipped = set(checker_config.skipped_op_list or [])
+    if checked or skipped:
+        def _filter(op_name):
+            if checked and op_name not in checked:
+                return False
+            return op_name not in skipped
+        D.NAN_CHECK_FILTER = _filter
+    else:
+        D.NAN_CHECK_FILTER = None
+
+
+def disable_tensor_checker():
+    flags.set_flags({"FLAGS_check_nan_inf": False})
+    from paddle_tpu.core import dispatch as D
+    D.NAN_CHECK_FILTER = None
+
+
+# -- per-op dtype statistics -------------------------------------------------
+
+_op_stats: dict | None = None
+
+
+def _record_op(op_name, out_arrays):
+    if _op_stats is None:
+        return
+    for a in out_arrays:
+        dt = str(getattr(a, "dtype", "?"))
+        key = (op_name, dt)
+        _op_stats[key] = _op_stats.get(key, 0) + 1
+
+
+def enable_operator_stats_collection():
+    """Start counting executed ops by output dtype (reference:
+    debugging.py:459 — used to audit AMP white/black list coverage)."""
+    global _op_stats
+    _op_stats = {}
+    from paddle_tpu.core import dispatch as D
+    D.OP_STATS_HOOK = _record_op
+
+
+def disable_operator_stats_collection():
+    """Stop collecting and print the summary table."""
+    global _op_stats
+    from paddle_tpu.core import dispatch as D
+    D.OP_STATS_HOOK = None
+    stats = _op_stats or {}
+    _op_stats = None
+    by_dtype: dict = {}
+    for (op, dt), n in sorted(stats.items()):
+        by_dtype.setdefault(dt, []).append((op, n))
+    print("<------------------------------ op list ------------------------------>")
+    for dt, ops in by_dtype.items():
+        print(f"  dtype {dt}: " + ", ".join(f"{o} ({n})" for o, n in ops))
+    print("<----------------------------- op count ------------------------------>")
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """(reference: debugging.py:540) context-manager form."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()

@@ -1,0 +1,12 @@
+"""`paddle.audio` — audio feature toolkit (reference: python/paddle/audio/:
+functional/{functional,window}.py, features/layers.py, datasets, backends).
+
+Feature extraction composes paddle_tpu.signal.stft with mel filterbanks —
+all static-shape jnp, so a whole MelSpectrogram/MFCC frontend jits into
+one XLA program on TPU.
+"""
+from paddle_tpu.audio import functional  # noqa: F401
+from paddle_tpu.audio import features  # noqa: F401
+from paddle_tpu.audio import datasets  # noqa: F401
+
+__all__ = ["functional", "features", "datasets"]

@@ -1,0 +1,56 @@
+"""`paddle.audio.datasets` (reference: python/paddle/audio/datasets/
+TESS, ESC50 — downloadable corpora).
+
+This build runs with zero network egress, so the downloadable datasets
+raise a clear error; AudioFolderDataset covers the local-files workflow.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = ["TESS", "ESC50", "AudioFolderDataset"]
+
+
+class _Downloadable(Dataset):
+    _NAME = "?"
+
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            f"paddle_tpu.audio.datasets.{self._NAME} downloads its corpus "
+            f"from the internet, which this environment does not allow. "
+            f"Fetch the archive yourself and use AudioFolderDataset over "
+            f"the extracted directory.")
+
+
+class TESS(_Downloadable):
+    _NAME = "TESS"
+
+
+class ESC50(_Downloadable):
+    _NAME = "ESC50"
+
+
+class AudioFolderDataset(Dataset):
+    """label-per-subdirectory layout of .npy waveform files."""
+
+    def __init__(self, root):
+        self.samples = []
+        self.labels = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        for li, lab in enumerate(self.labels):
+            for f in sorted(os.listdir(os.path.join(root, lab))):
+                if f.endswith(".npy"):
+                    self.samples.append(
+                        (os.path.join(root, lab, f), li))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        return np.load(path), label

@@ -1,0 +1,92 @@
+"""`paddle.audio.features` — feature-extraction layers (reference:
+python/paddle/audio/features/layers.py: Spectrogram, MelSpectrogram,
+LogMelSpectrogram, MFCC).
+"""
+from __future__ import annotations
+
+from paddle_tpu import nn
+from paddle_tpu import tensor as T
+from paddle_tpu import signal
+from paddle_tpu.audio import functional as AF
+
+__all__ = ['Spectrogram', 'MelSpectrogram', 'LogMelSpectrogram', 'MFCC']
+
+
+class Spectrogram(nn.Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer(
+            "window", AF.get_window(window, self.win_length, dtype=dtype),
+            persistable=False)
+
+    def forward(self, x):
+        spec = signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                           window=self.window, center=self.center,
+                           pad_mode=self.pad_mode)
+        mag = spec.abs()
+        return mag ** self.power if self.power != 1.0 else mag
+
+
+class MelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.register_buffer(
+            "fbank_matrix",
+            AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk,
+                                    norm, dtype),
+            persistable=False)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)       # (..., freq, time)
+        return T.matmul(self.fbank_matrix, spec)
+
+
+class LogMelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(nn.Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.register_buffer(
+            "dct_matrix", AF.create_dct(n_mfcc, n_mels, dtype=dtype),
+            persistable=False)
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)       # (..., n_mels, time)
+        return T.matmul(T.transpose(self.dct_matrix, [1, 0]), logmel)

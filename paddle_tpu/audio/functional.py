@@ -1,0 +1,158 @@
+"""`paddle.audio.functional` (reference:
+python/paddle/audio/functional/functional.py — mel scale conversions,
+fbank matrix, dct; window.py — get_window).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ['compute_fbank_matrix', 'create_dct', 'fft_frequencies',
+           'hz_to_mel', 'mel_frequencies', 'mel_to_hz', 'power_to_db',
+           'get_window']
+
+
+def _arr(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def hz_to_mel(freq, htk=False):
+    """(reference: functional.py hz_to_mel — slaney by default)."""
+    scalar = not isinstance(freq, (Tensor, jnp.ndarray, np.ndarray))
+    f = _arr(np.asarray(freq, np.float32))
+    if htk:
+        mel = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                              / min_log_hz) / logstep,
+                        mel)
+    return float(mel) if scalar else Tensor(mel)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, (Tensor, jnp.ndarray, np.ndarray))
+    m = _arr(np.asarray(mel, np.float32))
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = jnp.where(m >= min_log_mel,
+                       min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                       hz)
+    return float(hz) if scalar else Tensor(hz)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    low = hz_to_mel(f_min, htk)
+    high = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(low, high, n_mels).astype(dtype)
+    return mel_to_hz(Tensor(mels), htk)
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.linspace(0, sr / 2, n_fft // 2 + 1).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank (n_mels, n_fft//2+1) (reference:
+    functional.py compute_fbank_matrix)."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)._value
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)._value
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix (n_mels, n_mfcc) (reference: functional.py create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct = dct * math.sqrt(2.0 / n_mels)
+        dct = dct.at[:, 0].set(dct[:, 0] / math.sqrt(2.0))
+    else:
+        dct = dct * 2.0
+    return Tensor(dct.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10(S/ref) with clipping (reference: functional.py power_to_db)."""
+    s = _arr(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return Tensor(log_spec)
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Window functions (reference: window.py get_window)."""
+    if isinstance(window, tuple):
+        name, *params = window
+    else:
+        name, params = window, []
+    n = win_length
+    sym = not fftbins
+
+    def periodic(fn_n):
+        # scipy convention: fftbins=True -> periodic window
+        if sym:
+            return fn_n(n)
+        w = fn_n(n + 1)
+        return w[:-1]
+
+    if name in ("hann", "hanning"):
+        w = periodic(lambda k: 0.5 - 0.5 * np.cos(
+            2 * np.pi * np.arange(k) / (k - 1)))
+    elif name == "hamming":
+        w = periodic(lambda k: 0.54 - 0.46 * np.cos(
+            2 * np.pi * np.arange(k) / (k - 1)))
+    elif name == "blackman":
+        w = periodic(lambda k: 0.42 - 0.5 * np.cos(
+            2 * np.pi * np.arange(k) / (k - 1))
+            + 0.08 * np.cos(4 * np.pi * np.arange(k) / (k - 1)))
+    elif name in ("rect", "rectangular", "boxcar", "ones"):
+        w = np.ones(n)
+    elif name == "triang":
+        w = periodic(lambda k: 1 - np.abs(
+            (np.arange(k) - (k - 1) / 2) / ((k - 1) / 2)))
+    elif name == "bartlett":
+        w = periodic(lambda k: np.bartlett(k))
+    elif name == "gaussian":
+        std = params[0] if params else 7.0
+
+        def gauss(k):
+            idx = np.arange(k) - (k - 1) / 2
+            return np.exp(-0.5 * (idx / std) ** 2)
+        w = periodic(gauss)
+    elif name == "kaiser":
+        beta = params[0] if params else 12.0
+        w = periodic(lambda k: np.kaiser(k, beta))
+    else:
+        raise ValueError(f"unknown window {window!r}")
+    return Tensor(jnp.asarray(w.astype(dtype)))

@@ -43,6 +43,14 @@ class OpDef:
 
 OP_REGISTRY: dict[str, OpDef] = {}
 
+# amp.debugging installs a callable(op_name, out_arrays) here to count
+# executed ops by output dtype (reference: debugging.py operator stats)
+OP_STATS_HOOK = None
+
+# amp.debugging installs a callable(op_name)->bool here to narrow the
+# NaN/Inf check to TensorCheckerConfig's checked/skipped op lists
+NAN_CHECK_FILTER = None
+
 
 def _is_tensor(x):
     from paddle_tpu.core.tensor import Tensor
@@ -50,6 +58,8 @@ def _is_tensor(x):
 
 
 def _check_nan_inf(name, arrays):
+    if NAN_CHECK_FILTER is not None and not NAN_CHECK_FILTER(name):
+        return
     for a in arrays:
         if isinstance(a, jax.core.Tracer):
             # can't concretize under jit tracing; the fused program is
@@ -111,6 +121,8 @@ def dispatch(op: OpDef, args, kwargs):
 
     if not need_grad:
         out = call_with([t._value for t in tensors])
+        if OP_STATS_HOOK is not None:
+            OP_STATS_HOOK(op.name, jax.tree.flatten(out)[0])
         return _wrap_outputs(op, out, stop_gradient=True)
 
     diff_pos = [j for j, t in enumerate(tensors)
@@ -139,6 +151,8 @@ def dispatch(op: OpDef, args, kwargs):
         out_avals=[(o.shape, o.dtype) for o in out_flat],
     )
     current_tape().record(node)
+    if OP_STATS_HOOK is not None:
+        OP_STATS_HOOK(op.name, list(out_flat))
     if flags.get_flag("FLAGS_check_nan_inf"):
         _check_nan_inf(op.name, out_flat)
     return outputs

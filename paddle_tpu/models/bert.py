@@ -119,20 +119,25 @@ class BertForSequenceClassification(nn.Layer):
 
 
 class BertForMaskedLM(nn.Layer):
+    """MLM head with the decoder weight TIED to the word embedding
+    (PaddleNLP BertLMPredictionHead ties the same way)."""
+
     def __init__(self, cfg: BertConfig):
         super().__init__()
         self.bert = BertModel(cfg)
         self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
         self.layer_norm = nn.LayerNorm(cfg.hidden_size,
                                        epsilon=cfg.layer_norm_eps)
-        self.decoder = nn.Linear(cfg.hidden_size, cfg.vocab_size)
+        self.decoder_bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 labels=None):
         seq, _ = self.bert(input_ids, token_type_ids,
                            attention_mask=attention_mask)
         h = self.layer_norm(nn.functional.gelu(self.transform(seq)))
-        logits = self.decoder(h)
+        emb_w = self.bert.embeddings.word_embeddings.weight  # (vocab, d)
+        logits = T.matmul(h, emb_w, transpose_y=True) + self.decoder_bias
         if labels is not None:
             loss = nn.functional.cross_entropy(
                 T.reshape(logits, [-1, logits.shape[-1]]),

@@ -86,7 +86,13 @@ class GPTModel(nn.Layer):
         # a user mask (e.g. padding) is combined with it, never replaces it
         causal = T.triu(T.full([s, s], -1e9, dtype="float32"), 1)
         causal = T.unsqueeze(T.unsqueeze(causal, 0), 0)
-        attn_mask = causal if attn_mask is None else causal + attn_mask
+        if attn_mask is None:
+            attn_mask = causal
+        else:
+            if "bool" in str(attn_mask.dtype):
+                # keep-mask -> additive before combining with the causal mask
+                attn_mask = (T.cast(attn_mask, "float32") - 1.0) * 1e9
+            attn_mask = causal + attn_mask
         for layer in self.layers:
             x = layer(x, attn_mask)
         return self.final_norm(x)

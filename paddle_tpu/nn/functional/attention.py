@@ -46,6 +46,14 @@ def _sdpa_ref(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
         else:
             scores = scores + attn_mask.astype(scores.dtype)
     probs = jax.nn.softmax(scores, axis=-1).astype(qt.dtype)
+    if dropout_p:
+        # layers gate on self.training before passing dropout_p; under jit
+        # the key is baked at trace time (fixed mask per compile), matching
+        # the reference's seeded static-graph dropout
+        from paddle_tpu.core.random import next_key
+        keep = 1.0 - dropout_p
+        dmask = jax.random.bernoulli(next_key(), keep, probs.shape)
+        probs = jnp.where(dmask, probs / keep, 0.0).astype(qt.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
     return jnp.swapaxes(out, 1, 2)
 

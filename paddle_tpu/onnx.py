@@ -1,0 +1,31 @@
+"""`paddle.onnx` export shim (reference: python/paddle/onnx/ — delegates
+to the external paddle2onnx package).
+
+The TPU-native deployment format is serialized StableHLO (paddle_tpu.jit
+.save / paddle_tpu.static.save_inference_model), which every XLA runtime
+loads directly. ONNX export would need an external converter; when one is
+unavailable this shim still produces the StableHLO artifacts and says so,
+rather than failing silently.
+"""
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import paddle2onnx  # noqa: F401
+    except ImportError:
+        from paddle_tpu import jit
+        warnings.warn(
+            "paddle2onnx is not installed; exporting serialized StableHLO "
+            f"({path}.pdmodel + {path}.pdiparams) instead of ONNX — this "
+            "is the TPU-native deployment format (loadable by any XLA "
+            "runtime and by paddle_tpu.inference.Predictor).")
+        jit.save(layer, path, input_spec=input_spec)
+        return path + ".pdmodel"
+    raise NotImplementedError(
+        "paddle2onnx found, but the paddle_tpu bridge for it is not "
+        "implemented; use StableHLO export (paddle_tpu.jit.save)")

@@ -1,0 +1,119 @@
+"""`paddle.text` (reference: python/paddle/text/: viterbi_decode.py,
+datasets/{imdb,imikolov,...}).
+
+viterbi_decode is implemented natively (lax.scan over time — the
+TPU-idiomatic dynamic program); the downloadable datasets raise a clear
+zero-egress error.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.io import Dataset
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "Imikolov",
+           "UCIHousing"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """Batched Viterbi decoding (reference: text/viterbi_decode.py;
+    kernel paddle/phi/kernels/cpu/viterbi_decode_kernel.cc:236-282).
+    potentials: (b, t, n) emission scores; transition_params: (n, n) with
+    trans[i, j] = score of tag i -> tag j (reference convention; with
+    include_bos_eos_tag, the LAST row is the start tag and the
+    second-to-last row the stop tag, as in the reference kernel).
+    Returns (scores (b,), paths (b, t)).
+
+    The time recursion is a lax.scan — compiled, no per-step host trips.
+    """
+    pot = potentials._value if isinstance(potentials, Tensor) \
+        else jnp.asarray(potentials)
+    trans = transition_params._value \
+        if isinstance(transition_params, Tensor) \
+        else jnp.asarray(transition_params)
+    b, t, n = pot.shape
+    if lengths is None:
+        lens = jnp.full((b,), t, jnp.int32)
+    else:
+        lens = (lengths._value if isinstance(lengths, Tensor)
+                else jnp.asarray(lengths)).astype(jnp.int32)
+
+    bos, eos = n - 1, n - 2   # last row = start, second-to-last = stop
+    init = pot[:, 0]
+    if include_bos_eos_tag:
+        init = init + trans[bos][None, :]
+        init = init + jnp.where((lens == 1)[:, None], trans[eos][None, :],
+                                0.0)
+
+    def step(carry, xs):
+        alpha, i = carry
+        emit = xs                                  # (b, n)
+        # scores[b, j_prev, i_next] = alpha[b, j] + trans[j, i]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)     # (b, n)
+        new_alpha = jnp.max(scores, axis=1) + emit
+        if include_bos_eos_tag:
+            new_alpha = new_alpha + jnp.where(
+                (i == lens - 1)[:, None], trans[eos][None, :], 0.0)
+        # positions past each sequence's length keep their alpha
+        active = (i < lens)[:, None]
+        new_alpha = jnp.where(active, new_alpha, alpha)
+        best_prev = jnp.where(active, best_prev,
+                              jnp.arange(n)[None, :])
+        return (new_alpha, i + 1), best_prev
+
+    (alpha, _), backptrs = jax.lax.scan(
+        step, (init, jnp.ones((), jnp.int32)),
+        jnp.swapaxes(pot[:, 1:], 0, 1))
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1)          # (b,)
+
+    def back(carry, bp):
+        tag = carry
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        # emit the tag at time k+1; the final carry is the tag at time 0
+        return prev, tag
+
+    first_tag, path_rev = jax.lax.scan(back, last_tag, backptrs,
+                                       reverse=True)
+    paths = jnp.concatenate(
+        [first_tag[:, None], jnp.swapaxes(path_rev, 0, 1)], axis=1)
+    return Tensor(scores), Tensor(paths.astype(jnp.int32))
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (reference: text/viterbi_decode.py
+    ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class _Downloadable(Dataset):
+    _NAME = "?"
+
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            f"paddle_tpu.text.{self._NAME} downloads its corpus from the "
+            f"internet, which this environment does not allow; load your "
+            f"local copy with paddle_tpu.io.Dataset instead.")
+
+
+class Imdb(_Downloadable):
+    _NAME = "Imdb"
+
+
+class Imikolov(_Downloadable):
+    _NAME = "Imikolov"
+
+
+class UCIHousing(_Downloadable):
+    _NAME = "UCIHousing"

@@ -1,0 +1,183 @@
+"""paddle.audio / paddle.text / paddle.amp.debugging / paddle.onnx tests
+(reference: python/paddle/audio, text/viterbi_decode.py, amp/debugging.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, text
+from paddle_tpu.amp import debugging as dbg
+
+
+def test_mel_conversions_match_librosa_formulas():
+    # slaney scale fixpoints: 1000 Hz is the log-knee
+    m = audio.functional.hz_to_mel(1000.0)
+    np.testing.assert_allclose(m, 15.0, rtol=1e-6)  # (1000-0)/(200/3)
+    hz = audio.functional.mel_to_hz(15.0)
+    np.testing.assert_allclose(hz, 1000.0, rtol=1e-5)
+    # htk formula
+    np.testing.assert_allclose(audio.functional.hz_to_mel(700.0, htk=True),
+                               2595.0 * np.log10(2.0), rtol=1e-6)
+
+
+def test_fbank_matrix_properties():
+    fb = audio.functional.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    # every filter has support
+    assert (fb.sum(1) > 0).all()
+
+
+def test_windows_match_numpy():
+    w = audio.functional.get_window("hann", 16, fftbins=False).numpy()
+    np.testing.assert_allclose(w, np.hanning(16), atol=1e-6)
+    w2 = audio.functional.get_window("hamming", 16, fftbins=False).numpy()
+    np.testing.assert_allclose(w2, np.hamming(16), atol=1e-6)
+
+
+def test_mel_spectrogram_and_mfcc_shapes():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 2048).astype(np.float32))
+    mel = audio.features.MelSpectrogram(sr=16000, n_fft=256, n_mels=32,
+                                        hop_length=128)
+    out = mel(x)
+    assert out.shape[0] == 2 and out.shape[1] == 32
+    mfcc = audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=256, n_mels=32,
+                               hop_length=128)
+    out2 = mfcc(x)
+    assert out2.shape[1] == 13
+    assert np.isfinite(out2.numpy()).all()
+
+
+def test_log_mel_matches_power_to_db():
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(1, 1024).astype(np.float32))
+    mel = audio.features.MelSpectrogram(sr=8000, n_fft=128, n_mels=16)
+    logmel = audio.features.LogMelSpectrogram(sr=8000, n_fft=128, n_mels=16)
+    ref = audio.functional.power_to_db(mel(x)).numpy()
+    np.testing.assert_allclose(logmel(x).numpy(), ref, rtol=1e-5)
+
+
+def test_viterbi_decode_matches_bruteforce():
+    rng = np.random.RandomState(2)
+    b, t, n = 2, 5, 4
+    pot = rng.randn(b, t, n).astype(np.float32)
+    trans = rng.randn(n, n).astype(np.float32)
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        include_bos_eos_tag=False)
+    # brute force over all tag sequences
+    import itertools
+    for bi in range(b):
+        best, best_path = -1e30, None
+        for seq in itertools.product(range(n), repeat=t):
+            s = pot[bi, 0, seq[0]]
+            for k in range(1, t):
+                # reference convention: trans[from, to]
+                s += trans[seq[k - 1], seq[k]] + pot[bi, k, seq[k]]
+            if s > best:
+                best, best_path = s, seq
+        np.testing.assert_allclose(float(scores.numpy()[bi]), best,
+                                   rtol=1e-4)
+        assert list(paths.numpy()[bi]) == list(best_path)
+
+
+def test_viterbi_decoder_layer():
+    rng = np.random.RandomState(3)
+    pot = paddle.to_tensor(rng.randn(1, 3, 5).astype(np.float32))
+    trans = paddle.to_tensor(rng.randn(5, 5).astype(np.float32))
+    dec = text.ViterbiDecoder(trans, include_bos_eos_tag=True)
+    scores, paths = dec(pot)
+    assert paths.shape == [1, 3]
+
+
+def test_text_datasets_raise_clear_error():
+    with pytest.raises(RuntimeError, match="internet"):
+        text.Imdb()
+
+
+def test_tensor_checker_flags():
+    cfg = dbg.TensorCheckerConfig(enable=True)
+    dbg.enable_tensor_checker(cfg)
+    x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+    with pytest.raises(FloatingPointError):
+        _ = x / x  # 0/0 -> nan triggers the dispatcher guard
+    dbg.disable_tensor_checker()
+    y = x / x  # no error when disabled
+    assert np.isnan(y.numpy()[1])
+
+
+def test_check_numerics():
+    nan, inf, zero = dbg.check_numerics(
+        paddle.to_tensor(np.array([1.0, 0.0], np.float32)))
+    assert int(nan.numpy()) == 0 and int(zero.numpy()) == 1
+    with pytest.raises(FloatingPointError):
+        dbg.check_numerics(paddle.to_tensor(np.array([np.nan], np.float32)))
+
+
+def test_operator_stats_collection(capsys):
+    with dbg.collect_operator_stats():
+        a = paddle.ones([2, 2])
+        _ = a @ a
+        _ = a + a
+    out = capsys.readouterr().out
+    assert "op list" in out and "float32" in out
+
+
+def test_onnx_export_fallback(tmp_path):
+    import paddle_tpu.onnx as onnx
+    from paddle_tpu.static import InputSpec
+    net = paddle.nn.Linear(4, 2)
+    with pytest.warns(UserWarning, match="StableHLO"):
+        out = onnx.export(net, str(tmp_path / "m"),
+                          input_spec=[InputSpec([1, 4], "float32")])
+    assert out.endswith(".pdmodel")
+    import os
+    assert os.path.exists(out)
+
+
+def test_tensor_checker_skipped_op_list():
+    cfg = dbg.TensorCheckerConfig(enable=True,
+                                  skipped_op_list=["divide", "true_divide"])
+    dbg.enable_tensor_checker(cfg)
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        y = x / x  # nan from the skipped op: no error
+        assert np.isnan(y.numpy()[1])
+    finally:
+        dbg.disable_tensor_checker()
+
+
+def test_gaussian_window_periodic():
+    w_sym = audio.functional.get_window(("gaussian", 3.0), 16,
+                                        fftbins=False).numpy()
+    w_per = audio.functional.get_window(("gaussian", 3.0), 16,
+                                        fftbins=True).numpy()
+    import scipy.signal.windows as sw
+    np.testing.assert_allclose(w_sym, sw.gaussian(16, 3.0, sym=True),
+                               atol=1e-6)
+    np.testing.assert_allclose(w_per, sw.gaussian(16, 3.0, sym=False),
+                               atol=1e-6)
+
+
+def test_attention_dropout_applied():
+    from paddle_tpu import nn
+    mha = nn.MultiHeadAttention(16, 2, dropout=0.5)
+    x = paddle.to_tensor(np.random.RandomState(7).randn(2, 8, 16)
+                         .astype(np.float32))
+    mha.train()
+    o1, o2 = mha(x, x, x), mha(x, x, x)
+    assert not np.allclose(o1.numpy(), o2.numpy())  # stochastic
+    mha.eval()
+    e1, e2 = mha(x, x, x), mha(x, x, x)
+    np.testing.assert_allclose(e1.numpy(), e2.numpy())
+
+
+def test_bert_mlm_decoder_tied():
+    from paddle_tpu.models import BertForMaskedLM, tiny_bert_config
+    m = BertForMaskedLM(tiny_bert_config())
+    names = [n for n, _ in m.named_parameters()]
+    assert not any("decoder.weight" in n for n in names)
+    ids = paddle.to_tensor(np.random.RandomState(8).randint(0, 100, (2, 8)))
+    logits = m(ids)
+    assert logits.shape == [2, 8, 1024]
