@@ -1,0 +1,121 @@
+"""`paddle.distributed.fleet` — hybrid-parallel facade (reference:
+python/paddle/distributed/fleet/fleet.py:100,167 init/distributed_model/
+distributed_optimizer; base/topology.py:61,174; SURVEY.md §3.4).
+
+TPU-native mapping: the reference builds dp x pp x sharding x sep x mp
+NCCL process groups and wraps the model/optimizer per strategy; here
+`init` builds ONE jax.sharding.Mesh with the same axes, the topology
+classes keep the reference's rank math (so rank-placement code ports),
+and distributed_model/distributed_optimizer attach a ShardingPlan that
+GSPMD executes — collectives are compiled into the step, not issued by
+wrappers.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.distributed.fleet.topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup)
+from paddle_tpu.distributed.fleet import layers  # noqa: F401
+from paddle_tpu.distributed.fleet.strategy import DistributedStrategy  # noqa: F401
+
+__all__ = ["init", "DistributedStrategy", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "worker_index", "worker_num", "is_first_worker",
+           "CommunicateTopology", "HybridCommunicateGroup"]
+
+_fleet_state = {"inited": False, "strategy": None, "hcg": None,
+                "mesh": None}
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level=None):
+    """(reference: fleet/fleet.py:167) Build the hybrid topology. The
+    hybrid_configs degrees multiply up to the device count; remaining
+    devices go to the data-parallel axis."""
+    import jax
+    from paddle_tpu.distributed.mesh import ProcessMesh
+
+    strategy = strategy or DistributedStrategy()
+    n = jax.device_count()
+    hc = strategy.hybrid_configs
+    mp = int(hc.get("mp_degree", 1))
+    pp = int(hc.get("pp_degree", 1))
+    sharding = int(hc.get("sharding_degree", 1))
+    sep = int(hc.get("sep_degree", 1))
+    dp = int(hc.get("dp_degree", 0))
+    if dp <= 0:  # reference convention: -1 (or 0) means auto-infer
+        dp = n // max(mp * pp * sharding * sep, 1)
+    if dp * mp * pp * sharding * sep != n:
+        raise ValueError(
+            f"hybrid degrees dp={dp} x sharding={sharding} x pp={pp} x "
+            f"sep={sep} x mp={mp} != device count {n}")
+
+    topo = CommunicateTopology(
+        hybrid_group_names=["data", "pipe", "sharding", "sep", "model"],
+        dims=[dp, pp, sharding, sep, mp])
+    hcg = HybridCommunicateGroup(topo)
+
+    # one mesh, same axis order as the topology (SURVEY.md §7)
+    mesh = ProcessMesh(
+        np.arange(n).reshape(dp, pp, sharding, sep, mp).tolist(),
+        dim_names=["dp", "pp", "fsdp", "sp", "mp"])
+    from paddle_tpu.distributed import mesh as mesh_mod
+    mesh_mod.set_mesh(mesh)
+
+    _fleet_state.update(inited=True, strategy=strategy, hcg=hcg, mesh=mesh)
+    return None
+
+
+def _require_init():
+    if not _fleet_state["inited"]:
+        raise RuntimeError("call fleet.init(...) first")
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    _require_init()
+    return _fleet_state["hcg"]
+
+
+def get_mesh():
+    _require_init()
+    return _fleet_state["mesh"]
+
+
+def worker_index():
+    import jax
+    return jax.process_index()
+
+
+def worker_num():
+    import jax
+    return jax.process_count()
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+def distributed_model(model):
+    """(reference: fleet/model.py:141) Attach the hybrid sharding plan.
+    The model object is returned unchanged API-wise; its parameters are
+    resharded onto the fleet mesh per the plan, and paddle_tpu.parallel.
+    Trainer picks the plan up for the compiled step."""
+    _require_init()
+    from paddle_tpu.parallel import llama_sharding_plan, apply_plan
+    mesh = _fleet_state["mesh"]
+    plan = llama_sharding_plan(mesh.jax_mesh.axis_names)
+    model._fleet_plan = plan
+    model._fleet_mesh = mesh
+    apply_plan(model, mesh.jax_mesh, plan)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """(reference: fleet/fleet.py distributed_optimizer +
+    hybrid_parallel_optimizer.py:254). Under GSPMD grads arrive already
+    reduced over 'dp' and sharded over 'fsdp', so the optimizer needs no
+    wrapper logic; we tag it so Trainer shards its state per the plan
+    (ZeRO-style, reference dygraph_sharding_optimizer.py:48)."""
+    _require_init()
+    optimizer._fleet_strategy = strategy or _fleet_state["strategy"]
+    return optimizer

