@@ -452,3 +452,222 @@ class L1Decay:
 class L2Decay:
     def __init__(self, coeff=0.0):
         self._coeff = coeff
+
+
+class Adadelta(Optimizer):
+    """(reference: python/paddle/optimizer/adadelta.py)."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        self._rho = rho
+        self._eps = epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros(p.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(p.shape, jnp.float32)}
+
+    def _rule(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        g = g + self._decay_term(p.astype(jnp.float32), wd)
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g * g
+        update = (g * jnp.sqrt(state["avg_squared_update"] + self._eps)
+                  / jnp.sqrt(asg + self._eps))
+        asu = (self._rho * state["avg_squared_update"]
+               + (1 - self._rho) * update * update)
+        new_p = (p.astype(jnp.float32) - lr * update).astype(p.dtype)
+        return new_p, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop — sign-based per-weight step sizes
+    (reference: python/paddle/optimizer/rprop.py)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+        self._init_lr = learning_rate
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        return {"prev_grad": jnp.zeros(p.shape, jnp.float32),
+                "step_size": jnp.full(p.shape, self._init_lr, jnp.float32)}
+
+    def _rule(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        sign = jnp.sign(g * state["prev_grad"])
+        factor = jnp.where(sign > 0, self._eta_pos,
+                           jnp.where(sign < 0, self._eta_neg, 1.0))
+        step = jnp.clip(state["step_size"] * factor, self._lr_min,
+                        self._lr_max)
+        # on sign change, grad is zeroed (no step) per classic Rprop-
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_p = (p.astype(jnp.float32)
+                 - jnp.sign(g_eff) * step).astype(p.dtype)
+        return new_p, {"prev_grad": g_eff, "step_size": step}
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference: python/paddle/optimizer/asgd.py — running
+    average of iterates over a window)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        self._batch_num = batch_num
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _init_state(self, p):
+        return {"d": jnp.zeros(p.shape, jnp.float32),
+                "ys": jnp.zeros((self._batch_num,) + tuple(p.shape),
+                                jnp.float32),
+                "step": jnp.zeros((), jnp.float32)}
+
+    def _rule(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        g = g + self._decay_term(p.astype(jnp.float32), wd)
+        idx = (state["step"] % self._batch_num).astype(jnp.int32)
+        old_y = state["ys"][idx]
+        d = state["d"] - old_y + g
+        ys = state["ys"].at[idx].set(g)
+        n = jnp.minimum(state["step"] + 1, float(self._batch_num))
+        new_p = (p.astype(jnp.float32) - lr * d / n).astype(p.dtype)
+        return new_p, {"d": d, "ys": ys, "step": state["step"] + 1}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS (reference: python/paddle/optimizer/lbfgs.py).
+
+    Unlike the per-parameter rule optimizers, LBFGS needs the closure
+    re-evaluating the loss; `step(closure)` runs strong-Wolfe-free
+    backtracking line search over the two-loop-recursion direction on the
+    CONCATENATED parameter vector (the reference flattens the same way).
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=10,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        self._max_iter = max_iter
+        self._max_eval = max_eval
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._hist = history_size
+        self._line_search_fn = line_search_fn
+        self._s, self._y = [], []
+
+    def _flat(self):
+        return jnp.concatenate([p._value.astype(jnp.float32).ravel()
+                                for p in self._parameter_list])
+
+    def _unflat_set(self, vec):
+        off = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p._value.shape)) if p._value.shape else 1
+            p._value = vec[off:off + n].reshape(p._value.shape).astype(
+                p._value.dtype)
+            off += n
+
+    def _grad_flat(self):
+        gs = []
+        for p in self._parameter_list:
+            g = p.grad._value if p.grad is not None else jnp.zeros_like(
+                p._value)
+            gs.append(g.astype(jnp.float32).ravel())
+        return jnp.concatenate(gs)
+
+    def step(self, closure=None):
+        """(torch/paddle LBFGS semantics: with line_search_fn=None, take
+        fixed lr-sized quasi-Newton steps — first iteration scaled by
+        min(1, 1/|g|_1); with 'strong_wolfe', a sufficient-decrease
+        backtracking search that REVERTS when no decrease is found.)"""
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure returning the "
+                             "loss (reference lbfgs.py same contract)")
+
+        def eval_closure():
+            self.clear_grad()
+            loss = closure()
+            g = self._grad_flat()
+            if self._weight_decay:
+                g = g + self._weight_decay * self._flat()
+            return loss, g
+
+        loss, g = eval_closure()
+        f_prev = float(loss.numpy() if hasattr(loss, "numpy") else loss)
+        n_evals = 0
+        max_eval = self._max_eval or self._max_iter * 5 // 4
+        for it in range(self._max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self._tol_grad:
+                break
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s_v, y_v in zip(reversed(self._s), reversed(self._y)):
+                rho = 1.0 / (float(jnp.dot(y_v, s_v)) + 1e-20)
+                a = rho * float(jnp.dot(s_v, q))
+                alphas.append((a, rho, s_v, y_v))
+                q = q - a * y_v
+            if self._y:
+                y_last, s_last = self._y[-1], self._s[-1]
+                gamma = (float(jnp.dot(s_last, y_last))
+                         / (float(jnp.dot(y_last, y_last)) + 1e-20))
+                q = q * gamma
+            for a, rho, s_v, y_v in reversed(alphas):
+                b = rho * float(jnp.dot(y_v, q))
+                q = q + (a - b) * s_v
+            d = -q
+            gtd = float(jnp.dot(g, d))
+            if gtd > -self._tol_change:
+                break
+            lr = float(self._lr_value())
+            t = (min(1.0, 1.0 / (float(jnp.sum(jnp.abs(g))) + 1e-20)) * lr
+                 if it == 0 and not self._s else lr)
+            x0 = self._flat()
+            if self._line_search_fn is None:
+                self._unflat_set(x0 + t * d)
+                f_new, g_new = eval_closure()
+                n_evals += 1
+            else:   # 'strong_wolfe' -> sufficient-decrease backtracking
+                ok = False
+                for _ls in range(12):
+                    self._unflat_set(x0 + t * d)
+                    f_new, g_new = eval_closure()
+                    n_evals += 1
+                    fv = float(f_new.numpy() if hasattr(f_new, "numpy")
+                               else f_new)
+                    if fv <= f_prev + 1e-4 * t * gtd:
+                        ok = True
+                        break
+                    t *= 0.5
+                if not ok:
+                    # never commit a step that failed the decrease test
+                    self._unflat_set(x0)
+                    loss, g = eval_closure()
+                    break
+            s_vec = t * d
+            y_vec = g_new - g
+            if float(jnp.dot(s_vec, y_vec)) > 1e-10:
+                self._s.append(s_vec)
+                self._y.append(y_vec)
+                if len(self._s) > self._hist:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            loss, g = f_new, g_new
+            f_new_val = float(loss.numpy() if hasattr(loss, "numpy")
+                              else loss)
+            if (float(jnp.max(jnp.abs(s_vec))) <= self._tol_change
+                    or abs(f_new_val - f_prev) < self._tol_change
+                    or n_evals >= max_eval):
+                f_prev = f_new_val
+                break
+            f_prev = f_new_val
+        return loss

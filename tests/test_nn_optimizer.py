@@ -276,3 +276,58 @@ def test_grad_scaler():
     scaler.step(opt)
     scaler.update()
     np.testing.assert_allclose(p.numpy(), [0.8], rtol=1e-5)
+
+
+def test_adadelta_rprop_asgd_converge():
+    import paddle_tpu.optimizer as opt
+    rng = np.random.RandomState(40)
+    # Adadelta's denominator-adaptive steps start tiny (classic behavior)
+    for cls, kw, steps in [
+            (opt.Adadelta, dict(learning_rate=1.0), 1500),
+            (opt.Rprop, dict(learning_rate=0.01), 200),
+            (opt.ASGD, dict(learning_rate=0.05, batch_num=4), 200)]:
+        w = paddle.to_tensor(rng.randn(4).astype(np.float32))
+        w.stop_gradient = False
+        target = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+        o = cls(parameters=[w], **kw)
+        for _ in range(steps):
+            loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        assert float(loss.numpy()) < 0.05, (cls.__name__,
+                                            float(loss.numpy()))
+
+
+def test_lbfgs_rosenbrock():
+    import paddle_tpu.optimizer as opt
+    w = paddle.to_tensor(np.array([-1.2, 1.0], np.float32))
+    w.stop_gradient = False
+    o = opt.LBFGS(learning_rate=1.0, parameters=[w])
+
+    def closure():
+        x, y = w[0], w[1]
+        loss = (1 - x) ** 2 + 100 * (y - x * x) ** 2
+        loss.backward()
+        return loss
+
+    for _ in range(15):
+        loss = o.step(closure)
+    assert float(loss.numpy()) < 1e-6
+    np.testing.assert_allclose(w.numpy(), [1.0, 1.0], atol=1e-3)
+
+    # strong_wolfe path: backtracking with revert, still converges
+    w2 = paddle.to_tensor(np.array([-1.2, 1.0], np.float32))
+    w2.stop_gradient = False
+    o2 = opt.LBFGS(learning_rate=1.0, parameters=[w2],
+                   line_search_fn="strong_wolfe")
+
+    def closure2():
+        x, y = w2[0], w2[1]
+        loss = (1 - x) ** 2 + 100 * (y - x * x) ** 2
+        loss.backward()
+        return loss
+
+    for _ in range(40):
+        loss2 = o2.step(closure2)
+    assert float(loss2.numpy()) < 1e-2
