@@ -45,15 +45,33 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
 
+    def _hbm_bytes():
+        try:
+            stats = dev.memory_stats()
+            return int(stats.get("bytes_limit", 0)) or 16e9
+        except Exception:
+            return 16e9
+
     if on_tpu:
-        # TinyLlama-1.1B-class: fits one chip with Adam fp32 state
-        cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-            num_hidden_layers=22, num_attention_heads=32,
-            num_key_value_heads=4, max_position_embeddings=2048,
-            rope_theta=10000.0, seq_length=2048, recompute=True,
-            use_flash_attention=True)
-        batch, seq, steps = 8, 2048, 10
+        # size the model to the chip: params * 14B (bf16 w + fp32 master +
+        # adam m,v) must leave headroom for activations (remat on)
+        hbm = _hbm_bytes()
+        if hbm > 6e10:   # v5p/v4-class (95G/32G): TinyLlama-1.1B
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+                num_hidden_layers=22, num_attention_heads=32,
+                num_key_value_heads=4, max_position_embeddings=2048,
+                rope_theta=10000.0, seq_length=2048, recompute=True,
+                use_flash_attention=True)
+            batch, seq, steps = 8, 2048, 10
+        else:            # 16G-class chip (v5e/v6e): ~400M params
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=1280, intermediate_size=3584,
+                num_hidden_layers=16, num_attention_heads=20,
+                num_key_value_heads=4, max_position_embeddings=2048,
+                rope_theta=10000.0, seq_length=2048, recompute=True,
+                use_flash_attention=True)
+            batch, seq, steps = 4, 2048, 10
     else:
         cfg = tiny_llama_config(recompute=True)
         batch, seq, steps = 4, 32, 3
