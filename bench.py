@@ -56,7 +56,7 @@ def main():
         # size the model to the chip: params * 14B (bf16 w + fp32 master +
         # adam m,v) must leave headroom for activations (remat on)
         hbm = _hbm_bytes()
-        if hbm > 6e10:   # v5p/v4-class (95G/32G): TinyLlama-1.1B
+        if hbm > 2.5e10:  # v5p/v4-class (95G/32G): TinyLlama-1.1B
             cfg = LlamaConfig(
                 vocab_size=32000, hidden_size=2048, intermediate_size=5632,
                 num_hidden_layers=22, num_attention_heads=32,

@@ -6,3 +6,6 @@ Hosts the fused-op functional API the reference's LLM recipes call
 Pallas kernel where XLA's fusion is insufficient (paddle_tpu.kernels).
 """
 from paddle_tpu.incubate import nn  # noqa: F401
+from paddle_tpu.incubate import asp  # noqa: F401
+from paddle_tpu.incubate import optimizer  # noqa: F401
+from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,97 @@
+"""`paddle.incubate.asp` — automatic sparsity (2:4 semi-structured)
+(reference: python/paddle/incubate/asp/: asp.py decorate/prune_model,
+supported_layer_list.py, utils.py check_mask_2d/get_mask_2d_greedy).
+
+TPU note: sparse-MXU execution (like Ampere's 2:4 units) is not a TPU
+feature; ASP here provides the PRUNING workflow — 2:4 masks computed by
+magnitude, applied at step end so masked weights stay zero through
+training (the reference's OptimizerWithSparsityGuarantee) — producing
+checkpoints deployable on sparse-capable hardware.
+"""
+from __future__ import annotations
+
+import weakref
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density"]
+
+_excluded: set = set()
+_masks: dict = {}
+
+
+def set_excluded_layers(param_names, main_program=None):
+    for n in param_names:
+        _excluded.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def calculate_density(x):
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def _mask_2to4(arr):
+    """Keep the 2 largest-|.| of every 4 consecutive elements along the
+    last axis (reference: utils.py get_mask_1d / 2:4 pattern)."""
+    shape = arr.shape
+    n = shape[-1]
+    pad = (-n) % 4
+    a = np.abs(np.pad(arr.reshape(-1, n), ((0, 0), (0, pad))))
+    g = a.reshape(a.shape[0], -1, 4)
+    order = np.argsort(-g, axis=-1)
+    mask = np.zeros_like(g, dtype=bool)
+    np.put_along_axis(mask, order[..., :2], True, axis=-1)
+    mask = mask.reshape(a.shape)[:, :n].reshape(shape)
+    return mask
+
+
+def _prunable(name, t):
+    return (t._value.ndim == 2 and not t.stop_gradient
+            and name not in _excluded
+            and all(s % 4 == 0 or i == 0
+                    for i, s in enumerate(t._value.shape)))
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to every prunable weight (reference: asp.py
+    prune_model). Returns {param_name: mask}."""
+    if (n, m) != (2, 4):
+        raise NotImplementedError("only 2:4 sparsity is supported")
+    masks = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        arr = np.asarray(p._value)
+        mask = _mask_2to4(arr)
+        p._value = jnp.asarray(arr * mask)
+        masks[name] = mask
+        # keyed by id but validated against a weakref at use: a recycled
+        # id must never attach a stale mask to an unrelated parameter
+        _masks[id(p)] = (weakref.ref(p), jnp.asarray(mask, p._value.dtype))
+    return masks
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply masks after each update so pruned
+    weights stay zero (reference: asp.py decorate ->
+    OptimizerWithSparsityGuarantee)."""
+    orig_step = optimizer.step
+
+    def step(*a, **k):
+        out = orig_step(*a, **k)
+        for p in optimizer._parameter_list:
+            entry = _masks.get(id(p))
+            if entry is not None and entry[0]() is p:
+                p._value = p._value * entry[1]
+        return out
+
+    optimizer.step = step
+    return optimizer

@@ -193,3 +193,144 @@ def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
                             activation="gelu"):
     out = fused_linear(x, y, bias, transpose_weight=trans_y)
     return fused_bias_act(out, None, act_method=activation)
+
+
+# ---------------------------------------------------------------------------
+# fused attention family (reference: incubate/nn/functional/
+# fused_dot_product_attention.py, block_multihead_attention.py,
+# masked_multihead_attention.py, variable_length_memory_efficient_attention
+# .py — CUDA kernels fused_multi_transformer / block_multi_head_attention)
+# ---------------------------------------------------------------------------
+
+def fused_dot_product_attention(q, k, v, attn_mask=None, dropout=0.0,
+                                causal=False, return_softmax=False,
+                                training=True, name=None):
+    """(reference: fused_dot_product_attention.py — cuDNN fused MHA).
+    Routes to the flash kernel when unmasked, the fused SDPA otherwise;
+    layout (batch, seq, heads, head_dim)."""
+    from paddle_tpu.nn import functional as F
+    if attn_mask is None and not (dropout and training):
+        out, _ = F.flash_attention(q, k, v, causal=causal,
+                                   training=training)
+        return out
+    # dropout (or a mask) needs the SDPA path — the flash kernel has no
+    # dropout support, and silently dropping it would change training
+    return F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=dropout if training else 0.0, is_causal=causal)
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0):
+    """(reference: variable_length_memory_efficient_attention.py — cutlass
+    memory-efficient attention over ragged batches). TPU-native: lengths
+    become an additive mask; compute stays dense/static-shape (padded),
+    which is how TPU serving batches anyway. Layout (b, heads, seq, dim)."""
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu import tensor as T
+    import numpy as np  # noqa: F811
+    if pre_cache_length:
+        raise NotImplementedError(
+            "pre_cache_length is a CUDA-cache detail; prepend the cache to "
+            "key/value instead")
+    b = query.shape[0]
+    sq = query.shape[2]
+    sk = key.shape[2]
+    kv_lens = kv_seq_lens if kv_seq_lens is not None else seq_lens
+
+    def build_mask(q_lens_a, kv_lens_a):
+        col = jnp.arange(sk)[None, None, None, :]
+        row = jnp.arange(sq)[None, None, :, None]
+        valid = col < kv_lens_a.reshape(b, 1, 1, 1)
+        valid = jnp.logical_and(valid, row < q_lens_a.reshape(b, 1, 1, 1))
+        if causal:
+            valid = jnp.logical_and(valid, col <= row)
+        return jnp.where(valid, 0.0, -1e9).astype(jnp.float32)
+
+    amask = defop("varlen_attn_mask", differentiable=False)(build_mask)(
+        seq_lens, kv_lens)
+    if mask is not None:
+        amask = amask + mask
+    # (b, h, s, d) -> (b, s, h, d) for the sdpa surface
+    qs = T.transpose(query, [0, 2, 1, 3])
+    ks = T.transpose(key, [0, 2, 1, 3])
+    vs = T.transpose(value, [0, 2, 1, 3])
+    if scale is not None:
+        # SDPA applies 1/sqrt(d); fold the requested scale into q
+        import math as _math
+        qs = qs * float(scale) * _math.sqrt(query.shape[-1])
+    out = F.scaled_dot_product_attention(qs, ks, vs, attn_mask=amask,
+                                         is_causal=False)
+    return T.transpose(out, [0, 2, 1, 3])
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None,
+                               src_mask=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None, **kw):
+    """Single-step decode attention with KV cache (reference:
+    masked_multihead_attention.py — the reference's fused decode kernel).
+    x: (b, 3*h*d) packed qkv for ONE new token; cache_kv: (2, b, heads,
+    max_seq, d). Returns (out, cache_kv) like the reference."""
+    from paddle_tpu.core.tensor import Tensor
+    import math
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    unsupported = {"rotary_tensor": rotary_tensor, "bias": bias,
+                   "src_mask": src_mask,
+                   "beam_cache_offset": beam_cache_offset,
+                   "qkv_out_scale": qkv_out_scale, "out_shift": out_shift}
+    bad = [k for k, v in unsupported.items() if v is not None]
+    if bad:
+        raise NotImplementedError(
+            f"masked_multihead_attention: {bad} not supported here — apply "
+            f"RoPE/bias to qkv before the call (incubate."
+            f"fused_rotary_position_embedding)")
+    cache = cache_kv._value if isinstance(cache_kv, Tensor) else cache_kv
+    xv = x._value if isinstance(x, Tensor) else x
+    b = xv.shape[0]
+    _, _, h, max_seq, d = cache.shape
+    q, k, v = jnp.split(xv.reshape(b, 3, h, d), 3, axis=1)
+    q, k, v = q[:, 0], k[:, 0], v[:, 0]        # (b, h, d)
+    if sequence_lengths is not None:
+        sl = (sequence_lengths._value
+              if isinstance(sequence_lengths, Tensor) else sequence_lengths)
+        pos = sl.reshape(b).astype(jnp.int32)
+    else:
+        pos = jnp.zeros((b,), jnp.int32)
+
+    # write k,v at pos
+    bidx = jnp.arange(b)
+    new_k = cache[0].at[bidx, :, pos, :].set(k)
+    new_v = cache[1].at[bidx, :, pos, :].set(v)
+    cache_new = jnp.stack([new_k, new_v])
+
+    scores = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                        new_k.astype(jnp.float32)) / math.sqrt(d)
+    col = jnp.arange(max_seq)[None, None, :]
+    valid = col <= pos.reshape(b, 1, 1)
+    scores = jnp.where(valid, scores, -1e9)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhs,bhsd->bhd", p, new_v.astype(jnp.float32))
+    out = out.reshape(b, h * d).astype(xv.dtype)
+    from paddle_tpu.core.tensor import Tensor as _T
+    return _T(out), _T(cache_new)
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets=None, cum_offsets=None,
+                              cu_seqlens_q=None, cu_seqlens_k=None,
+                              block_tables=None, **kw):
+    """Paged/block KV-cache attention (reference:
+    block_multihead_attention.py + block_multi_head_attention_kernel.cu).
+    The paged-KV layout exists to fight fragmentation in CUDA serving;
+    XLA serving uses static ring caches, so this surface delegates to
+    masked_multihead_attention semantics per step. Provided for API
+    parity; high-throughput TPU serving should use the static-cache path
+    (paddle_tpu.nn.functional.flash_attention + ring buffers)."""
+    raise NotImplementedError(
+        "block (paged) KV caches are CUDA-serving-specific; on TPU use "
+        "masked_multihead_attention with a static ring cache, or "
+        "flash_attention over the full prefix")

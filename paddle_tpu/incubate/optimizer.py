@@ -1,0 +1,117 @@
+"""`paddle.incubate.optimizer` — LookAhead, ModelAverage (reference:
+python/paddle/incubate/optimizer/lookahead.py:30, modelaverage.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k steps forward, 1 step back (reference: lookahead.py LookAhead —
+    wraps an inner optimizer; slow weights pulled toward fast weights
+    every k steps)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not isinstance(inner_optimizer, Optimizer):
+            raise TypeError("inner_optimizer must be a paddle_tpu Optimizer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._slow = {id(p): jnp.asarray(p._value)
+                      for p in inner_optimizer._parameter_list}
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for p in self.inner_optimizer._parameter_list:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (
+                    p._value.astype(slow.dtype) - slow)
+                self._slow[id(p)] = slow
+                p._value = slow.astype(p._value.dtype)
+
+    def clear_grad(self, *a, **k):
+        return self.inner_optimizer.clear_grad(*a, **k)
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_num
+        return sd
+
+
+class ModelAverage:
+    """Maintains an exponential/window average of parameters for eval
+    (reference: modelaverage.py ModelAverage; apply()/restore())."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("ModelAverage requires the parameter list")
+        self._params = list(parameters)
+        self._rate = average_window_rate
+        self._min_window = int(min_average_window)
+        self._max_window = int(max_average_window)
+        self._sums = {id(p): jnp.zeros_like(p._value.astype(jnp.float32))
+                      for p in self._params}
+        self._count = 0
+        self._total_steps = 0
+        self._backup = None
+
+    def step(self):
+        self._total_steps += 1
+        # window restart (reference modelaverage.py: the accumulator is
+        # restarted so at most ~max_average_window recent snapshots — and
+        # no more than rate*num_updates once past min_average_window —
+        # contribute to the average)
+        if (self._count >= self._max_window
+                or (self._total_steps > self._min_window
+                    and self._count >= max(
+                        1, int(self._rate * self._total_steps)))):
+            for p in self._params:
+                self._sums[id(p)] = jnp.zeros_like(
+                    p._value.astype(jnp.float32))
+            self._count = 0
+        for p in self._params:
+            self._sums[id(p)] = (self._sums[id(p)]
+                                 + p._value.astype(jnp.float32))
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context-manager style also works)."""
+        self._backup = {id(p): p._value for p in self._params}
+        for p in self._params:
+            if self._count:
+                p._value = (self._sums[id(p)] / self._count).astype(
+                    p._value.dtype)
+        if not need_restore:
+            self._backup = None
+        return self
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p in self._params:
+                p._value = self._backup[id(p)]
+            self._backup = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.restore()
+        return False

@@ -41,11 +41,21 @@ def _pick_block(seq, target):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_k,
                 kv_valid):
+    # k arrives pre-transposed as (1, 1, d, sk) so the q @ k dot uses the
+    # standard (1),(0) contraction — Mosaic only lowers bf16 matmuls in
+    # that form
     bq, d = q_ref.shape[2], q_ref.shape[3]
-    kv_pad = k_ref.shape[2]
+    kv_pad = k_ref.shape[3]
     iq = pl.program_id(2)
 
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale
+    # keep operands in the input dtype (bf16): the MXU multiplies bf16 at
+    # full rate with f32 accumulation; upcasting operands to f32 halves
+    # throughput. f32 inputs keep HIGHEST precision (exact f32) — only
+    # bf16/f16 operands use the native one-pass mode.
+    q = (q_ref[0, 0] * jnp.asarray(sm_scale, q_ref.dtype))
+    prec = (jax.lax.Precision.DEFAULT
+            if q_ref.dtype in (jnp.bfloat16, jnp.float16)
+            else jax.lax.Precision.HIGHEST)
 
     nk_total = kv_pad // block_k
     if causal:
@@ -56,11 +66,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_k,
 
     def body(j, carry):
         m, l, acc = carry
-        kj = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vj = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        kj = k_ref[0, 0, :, pl.ds(j * block_k, block_k)]   # (d, bk)
+        vj = v_ref[0, 0, pl.ds(j * block_k, block_k), :]   # (bk, d)
         s = jax.lax.dot_general(
-            q, kj, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # (bq, bk)
+            q, kj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec)                              # (bq, bk) f32
+        # bf16: the package-global 'highest' would force an f32-contract
+        # form Mosaic can't lower; bf16 inputs with f32 accumulation IS
+        # the full-rate MXU mode
         col = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1) \
             + j * block_k
         valid = col < kv_valid
@@ -74,8 +88,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_k,
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, vj, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            p.astype(vj.dtype), vj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
         return m_new, l_new, acc_new
 
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
@@ -101,6 +115,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=512, block_k=512,
         k = jnp.pad(k, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
 
+    kt = jnp.swapaxes(k, 2, 3)   # (b, h, d, sk): XLA fuses the transpose
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
                                causal=causal, block_k=bk, kv_valid=sk)
     out = pl.pallas_call(
@@ -108,14 +123,14 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=512, block_k=512,
         grid=(b, h, sq_p // bq),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, sk_p, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, d, sk_p), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, sk_p, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
         interpret=interpret,
-    )(q, k, v)
+    )(q, kt, v)
     return out[:, :, :sq, :]
 
 
@@ -143,9 +158,13 @@ def _chunked_attention(q, k, v, causal, sm_scale, block_q=512, block_k=512):
 
     @jax.checkpoint
     def block(qi, kj, vj, iq, jk):
-        qf = qi.astype(jnp.float32) * sm_scale
-        s = jnp.einsum("...qd,...kd->...qk", qf, kj.astype(jnp.float32),
-                       preferred_element_type=jnp.float32)
+        prec = (jax.lax.Precision.DEFAULT
+                if qi.dtype in (jnp.bfloat16, jnp.float16)
+                else jax.lax.Precision.HIGHEST)
+        qf = qi * jnp.asarray(sm_scale, qi.dtype)
+        s = jnp.einsum("...qd,...kd->...qk", qf, kj,
+                       preferred_element_type=jnp.float32,
+                       precision=prec)
         col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + jk * bk
         valid = col < sk
         if causal:
@@ -155,8 +174,9 @@ def _chunked_attention(q, k, v, causal, sm_scale, block_q=512, block_k=512):
         m = jnp.max(s, axis=-1, keepdims=True)
         p = jnp.exp(s - m)
         l = jnp.sum(p, axis=-1, keepdims=True)
-        o = jnp.einsum("...qk,...kd->...qd", p, vj.astype(jnp.float32),
-                       preferred_element_type=jnp.float32)
+        o = jnp.einsum("...qk,...kd->...qd", p.astype(vj.dtype), vj,
+                       preferred_element_type=jnp.float32,
+                       precision=prec)
         return m, l, o
 
     def q_block(iq, qi):

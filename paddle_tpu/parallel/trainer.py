@@ -135,6 +135,21 @@ class Trainer:
             lambda tp, fp, b: loss_for({**fp, **tp}, b))
 
         def step(params, opt_state, lr, batch):
+            # The package-global matmul precision is 'highest' so EAGER f32
+            # numerics match the reference; inside the compiled bf16 train
+            # step that setting would run every bf16 matmul as multi-pass
+            # f32 emulation (several x slower on the MXU). bf16 compute
+            # with f32 accumulation is the intended training numerics.
+            import contextlib
+            low_prec = (cfg.compute_dtype is not None and
+                        jnp.dtype(cfg.compute_dtype) in
+                        (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)))
+            prec_ctx = (jax.default_matmul_precision("default") if low_prec
+                        else contextlib.nullcontext())
+            with prec_ctx:
+                return _step_inner(params, opt_state, lr, batch)
+
+        def _step_inner(params, opt_state, lr, batch):
             train_p = {n: params[n] for n in self.param_names}
             frozen_p = {n: v for n, v in params.items()
                         if n not in train_p}

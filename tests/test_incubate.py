@@ -1,0 +1,151 @@
+"""paddle.incubate tests: ASP 2:4 sparsity, LookAhead/ModelAverage,
+fused attention family (reference: python/paddle/incubate/).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import incubate
+from paddle_tpu.incubate import asp
+from paddle_tpu.incubate.nn import functional as IF
+
+
+def test_asp_prune_2to4_pattern():
+    net = paddle.nn.Linear(8, 8)
+    masks = asp.prune_model(net)
+    assert masks, "linear weight should be pruned"
+    w = net.weight.numpy()
+    # every group of 4 along the last axis has exactly 2 nonzeros
+    g = (w.reshape(-1, 2, 4) != 0).sum(-1)
+    assert (g <= 2).all()
+    assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6
+
+
+def test_asp_decorate_keeps_masks_through_training():
+    net = paddle.nn.Linear(8, 8)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    asp.prune_model(net)
+    opt = asp.decorate(opt)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    for _ in range(3):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert abs(asp.calculate_density(net.weight) - 0.5) < 1e-6
+
+
+def test_asp_excluded_layers():
+    asp.reset_excluded_layers()
+    net = paddle.nn.Linear(8, 8)
+    name = [n for n, _ in net.named_parameters() if "weight" in n][0]
+    asp.set_excluded_layers([name])
+    try:
+        masks = asp.prune_model(net)
+        assert not masks
+    finally:
+        asp.reset_excluded_layers()
+
+
+def test_lookahead_converges():
+    rng = np.random.RandomState(1)
+    w = paddle.to_tensor(rng.randn(4).astype(np.float32))
+    w.stop_gradient = False
+    target = np.array([1., 2., 3., 4.], np.float32)
+    inner = paddle.optimizer.SGD(learning_rate=0.3, parameters=[w])
+    la = incubate.LookAhead(inner, alpha=0.5, k=5)
+    for _ in range(100):
+        loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+        loss.backward()
+        la.step()
+        la.clear_grad()
+    np.testing.assert_allclose(w.numpy(), target, atol=1e-2)
+
+
+def test_model_average_apply_restore():
+    w = paddle.to_tensor(np.zeros(2, np.float32))
+    w.stop_gradient = False
+    ma = incubate.ModelAverage(parameters=[w])
+    for v in [1.0, 2.0, 3.0]:
+        w._value = w._value * 0 + v
+        ma.step()
+    w._value = w._value * 0 + 7.0
+    ma.apply()
+    np.testing.assert_allclose(w.numpy(), [2.0, 2.0])  # mean of 1,2,3
+    ma.restore()
+    np.testing.assert_allclose(w.numpy(), [7.0, 7.0])
+
+
+def test_fused_dot_product_attention_matches_sdpa():
+    rng = np.random.RandomState(2)
+    q = paddle.to_tensor(rng.randn(2, 8, 4, 16).astype(np.float32))
+    out = IF.fused_dot_product_attention(q, q, q, causal=True)
+    ref = paddle.nn.functional.scaled_dot_product_attention(
+        q, q, q, is_causal=True)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_variable_length_attention_masks_padding():
+    rng = np.random.RandomState(3)
+    q = paddle.to_tensor(rng.randn(2, 4, 8, 16).astype(np.float32))
+    lens = paddle.to_tensor(np.array([8, 5], np.int32))
+    out = IF.variable_length_memory_efficient_attention(
+        q, q, q, lens, lens)
+    assert out.shape == [2, 4, 8, 16]
+    # batch 1 rows beyond its length must not influence the valid rows:
+    # zeroing the padding keys changes nothing
+    qz = q.numpy().copy()
+    qz[1, :, 5:, :] = 99.0  # corrupt padding region
+    out2 = IF.variable_length_memory_efficient_attention(
+        paddle.to_tensor(qz), paddle.to_tensor(qz), paddle.to_tensor(qz),
+        lens, lens)
+    np.testing.assert_allclose(out.numpy()[1, :, :5],
+                               out2.numpy()[1, :, :5], rtol=1e-4, atol=1e-4)
+
+
+def test_masked_multihead_attention_decode_step():
+    rng = np.random.RandomState(4)
+    b, h, d, max_seq = 2, 4, 16, 8
+    cache = np.zeros((2, b, h, max_seq, d), np.float32)
+    # pre-fill 3 cached positions
+    cache[:, :, :, :3, :] = rng.randn(2, b, h, 3, d)
+    x = paddle.to_tensor(rng.randn(b, 3 * h * d).astype(np.float32))
+    seq_lens = paddle.to_tensor(np.array([3, 3], np.int32))
+    out, new_cache = IF.masked_multihead_attention(
+        x, cache_kv=paddle.to_tensor(cache), sequence_lengths=seq_lens)
+    assert out.shape == [b, h * d]
+    nc = new_cache.numpy()
+    # new k/v written at position 3
+    assert not np.allclose(nc[0][:, :, 3, :], 0)
+    # earlier cache untouched
+    np.testing.assert_allclose(nc[0][:, :, :3, :], cache[0][:, :, :3, :])
+
+
+def test_block_multihead_attention_raises_helpfully():
+    with pytest.raises(NotImplementedError, match="ring cache"):
+        IF.block_multihead_attention(None, None, None, None, None, None)
+
+
+def test_variable_length_attention_scale():
+    rng = np.random.RandomState(5)
+    q = paddle.to_tensor(rng.randn(1, 2, 4, 8).astype(np.float32))
+    lens = paddle.to_tensor(np.array([4], np.int32))
+    default = IF.variable_length_memory_efficient_attention(q, q, q, lens,
+                                                            lens)
+    matched = IF.variable_length_memory_efficient_attention(
+        q, q, q, lens, lens, scale=1.0 / np.sqrt(8))
+    np.testing.assert_allclose(default.numpy(), matched.numpy(), rtol=1e-5)
+    different = IF.variable_length_memory_efficient_attention(
+        q, q, q, lens, lens, scale=1.0)
+    assert not np.allclose(default.numpy(), different.numpy())
+
+
+def test_masked_mha_rejects_unsupported_args():
+    cache = paddle.to_tensor(np.zeros((2, 1, 2, 4, 8), np.float32))
+    x = paddle.to_tensor(np.zeros((1, 3 * 2 * 8), np.float32))
+    with pytest.raises(NotImplementedError, match="rotary"):
+        IF.masked_multihead_attention(x, cache_kv=cache,
+                                      rotary_tensor=paddle.ones([1]))
