@@ -39,6 +39,25 @@ from paddle_tpu.tensor import einsum  # noqa: F401
 # the star import binds `linalg` to paddle_tpu.tensor.linalg; rebind the
 # public `paddle.linalg` namespace module over it
 from paddle_tpu import linalg  # noqa: F401,E402
+from paddle_tpu.signal import stft, istft  # noqa: F401,E402
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """(reference: tensor/creation.py create_parameter)."""
+    from paddle_tpu.core.tensor import Parameter as _Param
+    from paddle_tpu.nn import initializer as _I
+    init = default_initializer or _I.XavierNormal()
+    arr = init(tuple(shape), dtype)
+    t = _Param(arr)
+    t.stop_gradient = False
+    return t
+
+
+def create_tensor(dtype="float32", name=None, persistable=False):
+    import jax.numpy as _jnp
+    from paddle_tpu.core.tensor import Tensor as _T
+    return _T(_jnp.zeros((), dtype))
 
 # subpackages (paddle.nn, paddle.optimizer, ...)
 from paddle_tpu import nn  # noqa: F401

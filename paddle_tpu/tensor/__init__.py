@@ -23,13 +23,14 @@ from paddle_tpu.tensor.logic import *  # noqa: F401,F403
 from paddle_tpu.tensor.search import *  # noqa: F401,F403
 from paddle_tpu.tensor.stat import *  # noqa: F401,F403
 from paddle_tpu.tensor.random import *  # noqa: F401,F403
+from paddle_tpu.tensor.extras import *  # noqa: F401,F403
 from paddle_tpu.tensor.einsum import einsum  # noqa: F401
 from paddle_tpu.tensor import attribute  # noqa: F401
-from paddle_tpu.tensor.attribute import shape as shape_op  # noqa: F401
+from paddle_tpu.tensor.attribute import shape, shape as shape_op  # noqa: F401
 from paddle_tpu.tensor.attribute import numel, rank  # noqa: F401
 
-from paddle_tpu.tensor import (creation, math, manipulation, linalg, logic,
-                               search, stat)
+from paddle_tpu.tensor import (creation, extras, math, manipulation,
+                               linalg, logic, search, stat)
 from paddle_tpu.tensor import random as random_mod
 
 
@@ -264,6 +265,76 @@ def _patch():
                    ("remainder_", math.mod), ("lerp_", math.lerp),
                    ("masked_fill_", manipulation.masked_fill)]:
         setattr(T, nm, _inplace(fn))
+
+    # remaining reference inplace variants, generated from their base ops
+    # (reference: tensor/__init__.py *_ entries; on the immutable substrate
+    # inplace = compute + rebind _value + bump the version counter)
+    _extra_inplace = [
+        "acos", "acosh", "asin", "asinh", "atan", "atanh", "cast",
+        "copysign", "cos", "cosh", "cumprod", "cumsum", "digamma",
+        "erfinv", "floor_divide", "frac", "gammainc", "gammaincc",
+        "gammaln", "gcd", "hypot", "i0", "lcm", "lgamma", "log", "log10",
+        "log1p", "log2", "logit", "mod", "nan_to_num", "neg", "polygamma",
+        "sigmoid", "sin", "sinh", "sqrt", "tan", "trunc", "tril", "triu",
+        "equal", "not_equal", "greater_equal", "greater_than",
+        "less_equal", "less_than", "logical_and", "logical_not",
+        "logical_or", "logical_xor", "bitwise_and", "bitwise_not",
+        "bitwise_or", "bitwise_xor", "bitwise_left_shift",
+        "bitwise_right_shift", "multigammaln", "addmm", "index_fill",
+        "index_put", "masked_scatter", "put_along_axis", "renorm",
+        "ldexp", "divide", "multiply", "subtract", "add",
+        "scale", "clip", "floor", "ceil", "exp", "rsqrt", "reciprocal",
+        "round", "abs", "tanh", "pow", "lerp", "masked_fill",
+    ]
+    import sys as _sys
+    _mod = _sys.modules[__name__]
+    for _base in _extra_inplace:
+        _fn = getattr(_mod, _base, None)
+        if _fn is None or not callable(_fn):
+            continue
+        _nm = _base + "_"
+        if not hasattr(T, _nm):
+            setattr(T, _nm, _inplace(_fn))
+        if not hasattr(_mod, _nm):
+            def _make_free(fn):
+                def free(x, *a, **k):
+                    return x._inplace_assign(fn(x, *a, **k))
+                return free
+            setattr(_mod, _nm, _make_free(_fn))
+
+    # aliases + in-place random fills (reference: random.py cauchy_/
+    # geometric_ fill the tensor from the distribution)
+    # where_ mutates X (reference: search.py:743), not the condition
+    def _where_(cond, x, y, name=None):
+        return x._inplace_assign(manipulation.where(cond, x, y))
+
+    _mod.where_ = _where_
+    T.where_ = lambda self, x, y, name=None: _where_(self, x, y)
+
+    T.floor_mod_ = T.mod_
+    T.remainder_ = T.mod_
+    _mod.floor_mod_ = _mod.mod_
+    _mod.remainder_ = _mod.mod_
+
+    def _cauchy_(self, loc=0, scale=1, name=None):
+        from paddle_tpu.core.random import next_key
+        u = jax.random.uniform(next_key(), self._value.shape,
+                               jnp.float32, 1e-6, 1 - 1e-6)
+        vals = loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+        return self._inplace_assign(Tensor(vals.astype(self._value.dtype)))
+
+    def _geometric_(self, probs, name=None):
+        from paddle_tpu.core.random import next_key
+        p = probs._value if isinstance(probs, Tensor) else jnp.asarray(probs)
+        u = jax.random.uniform(next_key(), self._value.shape,
+                               jnp.float32, 1e-6, 1 - 1e-6)
+        vals = jnp.ceil(jnp.log(u) / jnp.log1p(-p))
+        return self._inplace_assign(Tensor(vals.astype(self._value.dtype)))
+
+    T.cauchy_ = _cauchy_
+    T.geometric_ = _geometric_
+    _mod.cauchy_ = lambda x, *a, **k: _cauchy_(x, *a, **k)
+    _mod.geometric_ = lambda x, *a, **k: _geometric_(x, *a, **k)
 
     # paddle: x.cuda()/cpu()/to() are placement ops; PjRt owns placement.
     T.cuda = lambda s, *a, **k: s

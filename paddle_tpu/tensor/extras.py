@@ -1,0 +1,325 @@
+"""Long-tail tensor ops (reference: python/paddle/tensor/{math,
+manipulation,creation,search}.py entries not covered by the core modules).
+"""
+from __future__ import annotations
+
+import math as _math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    'take', 'add_n', 'cdist', 'diag_embed', 'diagonal_scatter',
+    'select_scatter', 'slice_scatter', 'frexp', 'ldexp', 'gammainc',
+    'gammaincc', 'multigammaln', 'multiplex', 'renorm', 'reverse',
+    'signbit', 'trapezoid', 'cumulative_trapezoid', 'unflatten', 'unstack',
+    'vander', 'top_p_sampling', 'set_printoptions', 'index_fill',
+]
+
+
+def _arr(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+@defop("take")
+def _take(x, index, mode="raise"):
+    idx = index.astype(jnp.int32)
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    elif mode == "clip":
+        # reference disables negative indexing in clip mode: [0, n-1]
+        idx = jnp.clip(idx, 0, n - 1)
+    idx = jnp.where(idx < 0, idx + n, idx)
+    return flat[idx]
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather (reference: tensor/math.py take)."""
+    return _take(x, _arr(index), mode=mode)
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (reference: tensor/math.py add_n)."""
+    from paddle_tpu import tensor as T
+    if isinstance(inputs, Tensor):
+        return inputs
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = T.add(out, t)
+    return out
+
+
+@defop("cdist", amp_policy="black")
+def _cdist(x, y, p=2.0):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff), axis=-1)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), axis=-1), 1.0 / p)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    return _cdist(x, y, p=p)
+
+
+@defop("diag_embed")
+def _diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    last = input.shape[-1]
+    n = last + abs(offset)
+    out = jnp.zeros(input.shape[:-1] + (n, n), input.dtype)
+    rows = jnp.arange(last) + max(-offset, 0)
+    cols = jnp.arange(last) + max(offset, 0)
+    out = out.at[..., rows, cols].set(input)
+    # move the two new axes to dim1/dim2
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+    return out
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    return _diag_embed(input, offset=offset, dim1=dim1, dim2=dim2)
+
+
+@defop("diagonal_scatter")
+def _diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    nd = x.ndim
+    a1, a2 = axis1 % nd, axis2 % nd
+    # bring target plane to the back
+    perm = [i for i in range(nd) if i not in (a1, a2)] + [a1, a2]
+    xt = jnp.transpose(x, perm)
+    k = y.shape[-1] if y.ndim else 1
+    rows = jnp.arange(k) + max(-offset, 0)
+    cols = jnp.arange(k) + max(offset, 0)
+    xt = xt.at[..., rows, cols].set(y)
+    inv = np.argsort(perm)
+    return jnp.transpose(xt, inv)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    return _diagonal_scatter(x, y, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop("select_scatter")
+def _select_scatter(x, values, axis, index):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(values)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    return _select_scatter(x, values, axis, index)
+
+
+@defop("slice_scatter")
+def _slice_scatter(x, value, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sr)
+    return x.at[tuple(idx)].set(value)
+
+
+def slice_scatter(x, value, axes=(0,), starts=(0,), ends=(1,),
+                  strides=(1,), name=None):
+    return _slice_scatter(x, value, tuple(axes), tuple(starts),
+                          tuple(ends), tuple(strides))
+
+
+def frexp(x, name=None):
+    """mantissa, exponent with x = m * 2**e (reference: math.py frexp)."""
+    m, e = jnp.frexp(_arr(x))
+    return Tensor(m), Tensor(e.astype(jnp.int32))
+
+
+@defop("ldexp")
+def _ldexp(x, y):
+    return x * jnp.power(jnp.asarray(2.0, x.dtype if
+                                     jnp.issubdtype(x.dtype, jnp.floating)
+                                     else jnp.float32), y.astype(jnp.float32))
+
+
+def ldexp(x, y, name=None):
+    return _ldexp(x, _arr(y))
+
+
+@defop("gammainc")
+def gammainc(x, y):
+    return jsp.gammainc(x, y)
+
+
+@defop("gammaincc")
+def gammaincc(x, y):
+    return jsp.gammaincc(x, y)
+
+
+@defop("multigammaln")
+def _multigammaln(x, p):
+    out = jnp.asarray(p * (p - 1) / 4.0 * _math.log(_math.pi), x.dtype)
+    for i in range(p):
+        out = out + jsp.gammaln(x - i / 2.0)
+    return out
+
+
+def multigammaln(x, p, name=None):
+    return _multigammaln(x, int(p))
+
+
+@defop("multiplex")
+def _multiplex(index, *inputs):
+    stacked = jnp.stack(inputs)                # (n, batch, ...)
+    idx = index.reshape(-1).astype(jnp.int32)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among tensors (reference: math.py multiplex)."""
+    return _multiplex(_arr(index), *inputs)
+
+
+@defop("renorm")
+def _renorm(x, p, axis, max_norm):
+    moved = jnp.moveaxis(x, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p), axis=1),
+                      1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    out = flat * factor[:, None]
+    return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    return _renorm(x, float(p), int(axis), float(max_norm))
+
+
+def reverse(x, axis, name=None):
+    from paddle_tpu import tensor as T
+    return T.flip(x, axis)
+
+
+@defop("signbit", differentiable=False)
+def signbit(x):
+    return jnp.signbit(x)
+
+
+@defop("trapezoid", amp_policy="black")
+def _trapezoid(y, x=None, dx=None, axis=-1):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return _trapezoid(y, _arr(x), axis=axis)
+    return _trapezoid(y, dx=dx, axis=axis)
+
+
+@defop("cumulative_trapezoid", amp_policy="black")
+def _cumtrapz(y, x=None, dx=None, axis=-1):
+    y1 = jax.lax.slice_in_dim(y, 1, y.shape[axis], axis=axis)
+    y0 = jax.lax.slice_in_dim(y, 0, y.shape[axis] - 1, axis=axis)
+    if x is not None:
+        x1 = jax.lax.slice_in_dim(x, 1, x.shape[axis], axis=axis)
+        x0 = jax.lax.slice_in_dim(x, 0, x.shape[axis] - 1, axis=axis)
+        d = x1 - x0
+    else:
+        d = 1.0 if dx is None else dx
+    return jnp.cumsum((y0 + y1) / 2.0 * d, axis=axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return _cumtrapz(y, _arr(x), axis=axis)
+    return _cumtrapz(y, dx=dx, axis=axis)
+
+
+def unflatten(x, axis, shape, name=None):
+    from paddle_tpu import tensor as T
+    xs = list(x.shape)
+    ax = axis % len(xs)
+    shape = list(shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = xs[ax] // known
+    return T.reshape(x, xs[:ax] + shape + xs[ax + 1:])
+
+
+def unstack(x, axis=0, num=None, name=None):
+    from paddle_tpu import tensor as T
+    n = num if num is not None else x.shape[axis]
+    parts = T.split(x, n, axis)
+    return [T.squeeze(p, axis) for p in parts]
+
+
+@defop("vander")
+def _vander(x, n, increasing):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    nn = n if n is not None else x.shape[0]
+    return _vander(x, int(nn), bool(increasing))
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling over the last axis (reference: math.py
+    top_p_sampling; CUDA kernel phi/kernels/gpu/top_p_sampling_kernel.cu).
+    x: (batch, vocab) logits; ps: (batch,) cumulative-probability cutoffs.
+    Returns (scores, ids)."""
+    from paddle_tpu.core.random import next_key
+    logits = _arr(x)
+    p_arr = _arr(ps).reshape(-1)
+    probs = jax.nn.softmax(logits, axis=-1)
+    sort_idx = jnp.argsort(-probs, axis=-1)
+    sorted_probs = jnp.take_along_axis(probs, sort_idx, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    keep = cum - sorted_probs < p_arr[:, None]    # always keep top-1
+    filt = jnp.where(keep, sorted_probs, 0.0)
+    filt = filt / jnp.sum(filt, axis=-1, keepdims=True)
+    key = next_key()
+    pick = jax.random.categorical(key, jnp.log(jnp.maximum(filt, 1e-30)),
+                                  axis=-1)
+    ids = jnp.take_along_axis(sort_idx, pick[:, None], axis=-1)
+    scores = jnp.take_along_axis(probs, ids, axis=-1)
+    return Tensor(scores), Tensor(ids.astype(jnp.int32))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """(reference: tensor/to_string.py set_printoptions) — numpy-backed."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+@defop("index_fill")
+def _index_fill(x, index, axis, value):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(value)
+
+
+def index_fill(x, index, axis, value, name=None):
+    return _index_fill(x, _arr(index).astype(jnp.int32), axis % x.ndim,
+                       value)
+
+
