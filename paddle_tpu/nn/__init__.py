@@ -42,3 +42,5 @@ class ClipGradByGlobalNorm:
     def __init__(self, clip_norm, group_name="default_group",
                  auto_skip_clip=False):
         self.clip_norm = clip_norm
+
+from paddle_tpu.nn.layer.extras import *  # noqa: F401,F403,E402

@@ -8,3 +8,8 @@ from paddle_tpu.nn.functional.loss import *  # noqa: F401,F403
 from paddle_tpu.nn.functional.attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention)
 from paddle_tpu.tensor.manipulation import pad  # noqa: F401
+
+
+from paddle_tpu.nn.functional.extras import *  # noqa: F401,F403,E402
+from paddle_tpu.nn.functional.extras import (  # noqa: F401,E402
+    hardtanh_, leaky_relu_, tanh_, thresholded_relu_)

@@ -1,0 +1,675 @@
+"""Long-tail nn.functional ops (reference: python/paddle/nn/functional/
+{pooling,loss,vision,common}.py entries not in the core modules; native
+kernels being replaced: warprnnt (rnnt_loss), grid_sampler CUDA kernel).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    'adaptive_max_pool3d', 'fractional_max_pool2d', 'fractional_max_pool3d',
+    'max_unpool1d', 'max_unpool2d', 'max_unpool3d', 'affine_grid',
+    'grid_sample', 'class_center_sample', 'dice_loss', 'gaussian_nll_loss',
+    'hsigmoid_loss', 'margin_cross_entropy', 'multi_label_soft_margin_loss',
+    'multi_margin_loss', 'npair_loss', 'pairwise_distance',
+    'poisson_nll_loss', 'rnnt_loss', 'soft_margin_loss', 'sparse_attention',
+    'triplet_margin_with_distance_loss', 'zeropad2d', 'gather_tree',
+]
+
+
+def _arr(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# -- pooling ---------------------------------------------------------------
+
+@defop("adaptive_max_pool3d")
+def _adaptive_max_pool3d(x, output_size):
+    # x: (N, C, D, H, W); divisible dims take the reshape fast path like
+    # the 2D implementation (pooling.py _adaptive_max_pool2d)
+    n, c, d, h, w = x.shape
+    od, oh, ow = output_size
+    if d % od == 0 and h % oh == 0 and w % ow == 0:
+        r = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+        return jnp.max(r, axis=(3, 5, 7))
+
+    def bounds(size, out):
+        return [((i * size) // out,
+                 max(((i + 1) * size + out - 1) // out,
+                     (i * size) // out + 1)) for i in range(out)]
+    db, hb, wb = bounds(d, od), bounds(h, oh), bounds(w, ow)
+    planes = []
+    for (d0, d1) in db:
+        rows = []
+        for (h0, h1) in hb:
+            cells = [jnp.max(x[:, :, d0:d1, h0:h1, w0:w1], axis=(2, 3, 4))
+                     for (w0, w1) in wb]
+            rows.append(jnp.stack(cells, axis=-1))
+        planes.append(jnp.stack(rows, axis=-2))
+    return jnp.stack(planes, axis=-3)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    out = _adaptive_max_pool3d(x, tuple(output_size))
+    if return_mask:
+        raise NotImplementedError("return_mask unsupported on TPU path")
+    return out
+
+
+def _fractional_pool(x, output_size, kernel_size, random_u, ndim):
+    spatial = x.shape[2:]
+    outs = list(output_size)
+    u = random_u if random_u is not None else 0.5
+    # pseudo-random (deterministic given u) region boundaries, per the
+    # fractional max-pooling paper's alpha-sequence construction
+    idxs = []
+    for s, o in zip(spatial, outs):
+        alpha = s / o
+        seq = [int(math.ceil(alpha * (i + u))) - int(math.ceil(alpha * u))
+               for i in range(o + 1)]
+        seq[-1] = s
+        idxs.append(seq)
+    return outs, idxs
+
+
+@defop("fractional_max_pool2d")
+def _fractional_max_pool2d(x, output_size, random_u):
+    outs, (rows, cols) = _fractional_pool(x, output_size, None, random_u, 2)
+    oh, ow = outs
+    n, c = x.shape[:2]
+    out = jnp.zeros((n, c, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            out = out.at[:, :, i, j].set(jnp.max(
+                x[:, :, rows[i]:max(rows[i + 1], rows[i] + 1),
+                  cols[j]:max(cols[j + 1], cols[j] + 1)], axis=(2, 3)))
+    return out
+
+
+def _sample_u(random_u):
+    if random_u is not None:
+        return float(random_u)
+    from paddle_tpu.core.random import next_key
+    return float(jax.random.uniform(next_key(), (), jnp.float32, 1e-3,
+                                    1 - 1e-3))
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("return_mask unsupported on TPU path")
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 2
+    return _fractional_max_pool2d(x, tuple(output_size),
+                                  _sample_u(random_u))
+
+
+@defop("fractional_max_pool3d")
+def _fractional_max_pool3d(x, output_size, random_u):
+    outs, (ds, rows, cols) = _fractional_pool(x, output_size, None,
+                                              random_u, 3)
+    od, oh, ow = outs
+    n, c = x.shape[:2]
+    out = jnp.zeros((n, c, od, oh, ow), x.dtype)
+    for z in range(od):
+        for i in range(oh):
+            for j in range(ow):
+                out = out.at[:, :, z, i, j].set(jnp.max(
+                    x[:, :, ds[z]:max(ds[z + 1], ds[z] + 1),
+                      rows[i]:max(rows[i + 1], rows[i] + 1),
+                      cols[j]:max(cols[j + 1], cols[j] + 1)],
+                    axis=(2, 3, 4)))
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("return_mask unsupported on TPU path")
+    if isinstance(output_size, int):
+        output_size = (output_size,) * 3
+    return _fractional_max_pool3d(x, tuple(output_size),
+                                  _sample_u(random_u))
+
+
+def _unpool(x, indices, spatial_out, ndim):
+    # x, indices: (N, C, *spatial_in); indices flat into spatial_out
+    n, c = x.shape[:2]
+    flat_in = int(np.prod(x.shape[2:]))
+    flat_out = int(np.prod(spatial_out))
+    xi = x.reshape(n, c, flat_in)
+    ii = indices.reshape(n, c, flat_in)
+    out = jnp.zeros((n, c, flat_out), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda o, idx, v: o.at[idx].set(v)))(out, ii, xi)
+    return out.reshape((n, c) + tuple(spatial_out))
+
+
+def _unpool_out_shape(in_sp, kernel_size, stride, padding, output_size, nd):
+    if output_size is not None:
+        return tuple(output_size)[-nd:]
+    ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+        else (kernel_size,) * nd
+    st = stride if isinstance(stride, (list, tuple)) else \
+        ((stride,) * nd if stride is not None else ks)
+    pd = padding if isinstance(padding, (list, tuple)) else (padding,) * nd
+    return tuple((i - 1) * s - 2 * p + k
+                 for i, k, s, p in zip(in_sp, ks, st, pd))
+
+
+def _make_unpool(name, nd):
+    @defop(name)
+    def op(x, indices, spatial_out):
+        return _unpool(x, indices.astype(jnp.int32), spatial_out, nd)
+
+    def api(x, indices, kernel_size, stride=None, padding=0,
+            data_format="NCL" if nd == 1 else ("NCHW" if nd == 2
+                                               else "NCDHW"),
+            output_size=None, name_arg=None, name=None):
+        sp = _unpool_out_shape(tuple(x.shape[2:]), kernel_size, stride,
+                               padding, output_size, nd)
+        return op(x, _arr(indices), tuple(sp))
+    api.__name__ = name
+    return api
+
+
+max_unpool1d = _make_unpool("max_unpool1d", 1)
+max_unpool2d = _make_unpool("max_unpool2d", 2)
+max_unpool3d = _make_unpool("max_unpool3d", 3)
+
+
+# -- vision: affine_grid / grid_sample -------------------------------------
+
+@defop("affine_grid")
+def _affine_grid(theta, out_h, out_w, align_corners):
+    n = theta.shape[0]
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, out_h)
+        xs = jnp.linspace(-1.0, 1.0, out_w)
+    else:
+        ys = (jnp.arange(out_h) * 2 + 1) / out_h - 1
+        xs = (jnp.arange(out_w) * 2 + 1) / out_w - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)          # (H, W, 3)
+    grid = jnp.einsum("hwk,nck->nhwc", base, theta)    # (N, H, W, 2)
+    return grid
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    n, c, h, w = [int(s) for s in out_shape]
+    return _affine_grid(theta, h, w, bool(align_corners))
+
+
+@defop("grid_sample")
+def _grid_sample(x, grid, mode, padding_mode, align_corners):
+    # x: (N, C, H, W); grid: (N, Hg, Wg, 2) in [-1, 1] (x, y)
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * (w - 1) / 2
+        fy = (gy + 1) * (h - 1) / 2
+    else:
+        fx = ((gx + 1) * w - 1) / 2
+        fy = ((gy + 1) * h - 1) / 2
+
+    if padding_mode == "reflection":
+        def reflect(v, lo, hi):
+            if hi <= lo:
+                return jnp.zeros_like(v) + lo
+            span = hi - lo
+            v = jnp.abs((v - lo) % (2 * span))
+            return jnp.minimum(v, 2 * span - v) + lo
+        if align_corners:
+            fx = reflect(fx, 0.0, w - 1.0)
+            fy = reflect(fy, 0.0, h - 1.0)
+        else:
+            fx = reflect(fx, -0.5, w - 0.5)
+            fy = reflect(fy, -0.5, h - 0.5)
+
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = fx - x0
+    wy = fy - y0
+
+    def gather(xi, yi):
+        inb = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+        xi_c = jnp.clip(xi, 0, w - 1)
+        yi_c = jnp.clip(yi, 0, h - 1)
+        # (N, Hg, Wg) index into (N, C, H, W) -> (N, C, Hg, Wg)
+        batch = jnp.arange(n).reshape(n, 1, 1)
+        v = x[batch, :, yi_c, xi_c]                    # (N, Hg, Wg, C)
+        v = jnp.moveaxis(v, -1, 1)
+        if padding_mode == "zeros":
+            v = v * inb[:, None, :, :]
+        return v
+
+    if mode == "nearest":
+        xi = jnp.round(fx).astype(jnp.int32)
+        yi = jnp.round(fy).astype(jnp.int32)
+        return gather(xi, yi)
+    v00 = gather(x0, y0)
+    v01 = gather(x1, y0)
+    v10 = gather(x0, y1)
+    v11 = gather(x1, y1)
+    wx_ = wx[:, None, :, :]
+    wy_ = wy[:, None, :, :]
+    return (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_)
+            + v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Bilinear/nearest sampler (reference: functional/vision.py
+    grid_sample; CUDA kernel grid_sampler). XLA gathers ride the same
+    fused path as embedding lookups on TPU."""
+    return _grid_sample(x, grid, mode, padding_mode, bool(align_corners))
+
+
+# -- losses ----------------------------------------------------------------
+
+@defop("dice_loss")
+def _dice_loss(input, label, epsilon):
+    # input: (N, ..., C) probabilities, label: (N, ..., 1) int
+    n = input.shape[0]
+    c = input.shape[-1]
+    lab = jax.nn.one_hot(label[..., 0], c, dtype=input.dtype)
+    red = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * lab, axis=red)
+    union = jnp.sum(input, axis=red) + jnp.sum(lab, axis=red)
+    dice = (2 * inter + epsilon) / (union + epsilon)
+    return jnp.mean(1 - dice)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    return _dice_loss(input, _arr(label), epsilon)
+
+
+@defop("gaussian_nll_loss", amp_policy="black")
+def _gaussian_nll(input, label, variance, full, epsilon, reduction):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * math.log(2 * math.pi)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    return _gaussian_nll(input, label, variance, bool(full), epsilon,
+                         reduction)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference: functional/loss.py hsigmoid_loss; CPU kernel
+    phi/kernels/cpu/hsigmoid_loss_kernel.cc — same default-tree coding)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError("custom trees not supported; use the "
+                                  "default complete binary tree")
+    lv = _arr(label).astype(jnp.int32)
+    code_len = int(math.ceil(math.log2(max(num_classes, 2))))
+    losses = _hsigmoid_op(input, weight, bias, lv, num_classes, code_len)
+    from paddle_tpu import tensor as T
+    return T.mean(losses)
+
+
+@defop("hsigmoid_loss_op", amp_policy="black")
+def _hsigmoid_op(x, w, b, lab, num_classes, code_len):
+    """Walk leaf (lab + num_classes) up the complete binary tree; the
+    walk STOPS at the root (node 1) — for non-power-of-two num_classes
+    some classes have shorter codes, masked out via `live`."""
+    total = jnp.zeros((x.shape[0],), jnp.float32)
+    node = lab + num_classes
+    for _ in range(code_len):
+        parent = node // 2
+        live = (node > 1).astype(jnp.float32)
+        bit = (node % 2).astype(jnp.float32)               # code bit
+        idx = jnp.clip(parent - 1, 0, num_classes - 1)
+        logits = jnp.einsum("nd,nd->n", x.astype(jnp.float32),
+                            w[idx].astype(jnp.float32))
+        if b is not None:
+            logits = logits + b.reshape(-1)[idx]
+        # bit==1 -> sigmoid(-logit); standard hsigmoid BCE form
+        total = total + live * (jax.nn.softplus(logits)
+                                - (1 - bit) * logits)
+        node = jnp.maximum(parent, 1)
+    return total
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace margin loss (reference: functional/loss.py
+    margin_cross_entropy; GPU kernel margin_cross_entropy_kernel.cu)."""
+    return _margin_ce(logits, _arr(label), margin1, margin2, margin3,
+                      scale, return_softmax, reduction)
+
+
+@defop("margin_ce", amp_policy="black")
+def _margin_ce(lg, lab, margin1, margin2, margin3, scale, return_softmax,
+               reduction):
+    lab = lab.astype(jnp.int32)
+    theta = jnp.arccos(jnp.clip(lg, -1.0, 1.0))
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot = jax.nn.one_hot(lab, lg.shape[-1], dtype=lg.dtype)
+    adj = jnp.where(onehot > 0, target, lg) * scale
+    logp = jax.nn.log_softmax(adj, axis=-1)
+    nll = -jnp.take_along_axis(logp, lab[:, None], axis=-1)[:, 0]
+    if reduction == "mean":
+        loss = jnp.mean(nll)
+    elif reduction == "sum":
+        loss = jnp.sum(nll)
+    else:
+        loss = nll
+    if return_softmax:
+        return loss, jax.nn.softmax(adj, axis=-1)
+    return loss
+
+
+@defop("multi_label_soft_margin_loss")
+def _mlsm(input, label, weight, reduction):
+    loss = -(label * jax.nn.log_sigmoid(input)
+             + (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = jnp.mean(loss, axis=-1)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    return _mlsm(input, label, weight, reduction)
+
+
+@defop("multi_margin_loss")
+def _mml(input, label, p, margin, weight, reduction):
+    n, c = input.shape
+    lab = label.astype(jnp.int32)
+    x_y = jnp.take_along_axis(input, lab[:, None], axis=-1)
+    m = jnp.maximum(margin - x_y + input, 0.0) ** p
+    if weight is not None:
+        m = m * weight.reshape(-1)[lab][:, None]
+    mask = 1.0 - jax.nn.one_hot(lab, c, dtype=input.dtype)
+    loss = jnp.sum(m * mask, axis=-1) / c
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    return _mml(input, _arr(label), p, margin, weight, reduction)
+
+
+@defop("npair_loss")
+def _npair(anchor, positive, labels, l2_reg):
+    sim = anchor @ positive.T                       # (N, N)
+    lab = labels.reshape(-1)
+    tgt = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+    tgt = tgt / jnp.sum(tgt, axis=-1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=-1)
+    ce = -jnp.mean(jnp.sum(tgt * logp, axis=-1))
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, -1))
+                    + jnp.mean(jnp.sum(positive * positive, -1))) / 4
+    return ce + reg
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    return _npair(anchor, positive, _arr(labels), l2_reg)
+
+
+@defop("pairwise_distance", amp_policy="black")
+def _pairwise_distance(x, y, p, epsilon, keepdim):
+    d = x - y + epsilon
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p), axis=-1,
+                             keepdims=keepdim), 1.0 / p)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    return _pairwise_distance(x, y, p, epsilon, bool(keepdim))
+
+
+@defop("poisson_nll_loss", amp_policy="black")
+def _poisson_nll(input, label, log_input, full, epsilon, reduction):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = (label * jnp.log(label + 1e-30) - label
+                    + 0.5 * jnp.log(2 * math.pi * jnp.maximum(label, 1e-30)))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    return _poisson_nll(input, label, bool(log_input), bool(full), epsilon,
+                        reduction)
+
+
+@defop("soft_margin_loss")
+def _soft_margin(input, label, reduction):
+    loss = jax.nn.softplus(-label * input)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return _soft_margin(input, label, reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """(reference: functional/loss.py triplet_margin_with_distance_loss)."""
+    from paddle_tpu import tensor as T
+    dist = distance_function or (lambda a, b: pairwise_distance(a, b))
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_pn = dist(positive, negative)
+        d_neg = T.minimum(d_neg, d_pn)
+    loss = T.clip(d_pos - d_neg + margin, min=0.0)
+    if reduction == "mean":
+        return T.mean(loss)
+    if reduction == "sum":
+        return T.sum(loss)
+    return loss
+
+
+@defop("rnnt_loss", amp_policy="black")
+def _rnnt_loss(logits, labels, logit_lengths, label_lengths, blank,
+               fastemit_lambda):
+    """RNN-Transducer loss (reference: python/paddle/nn/functional/loss.py
+    rnnt_loss over third_party/warprnnt). TPU-native: the alpha-lattice
+    dynamic program as a lax.scan over time; each step updates the whole
+    label axis vectorized — no per-cell kernel needed.
+    logits: (B, T, U+1, V) raw; labels: (B, U) int."""
+    b, t_max, u_max1, v = logits.shape
+    u_max = u_max1 - 1
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    lab = labels.astype(jnp.int32)
+    # per (b,t,u): blank prob and emit prob of the next label
+    p_blank = logp[:, :, :, blank]                        # (B, T, U+1)
+    lab_pad = jnp.concatenate(
+        [lab, jnp.zeros((b, 1), jnp.int32)], axis=1)      # (B, U+1)
+    p_emit = jnp.take_along_axis(
+        logp, lab_pad[:, None, :, None], axis=-1)[..., 0]  # (B, T, U+1)
+    if fastemit_lambda:
+        # FastEmit: scale emission probability mass by (1 + lambda) so
+        # early-emitting paths are favored (warprnnt applies the same
+        # (1+lambda) factor on the emit arcs)
+        p_emit = p_emit + math.log1p(fastemit_lambda)
+
+    NEG = -1e30
+
+    # alpha recursion (time outer scan, label inner scan):
+    #   alpha[t,u] = logsumexp(alpha[t-1,u] + blank(t-1,u),
+    #                          alpha[t,u-1] + emit(t,u-1))
+    def time_step(alpha, t):
+        from_blank = alpha + p_blank[:, t - 1, :]          # (B, U+1)
+
+        def label_scan(carry, u):
+            left = carry
+            cur = jnp.where(
+                u == 0, from_blank[:, 0],
+                jnp.logaddexp(from_blank[:, u],
+                              left + p_emit[:, t, u - 1]))
+            return cur, cur
+        _, cols = jax.lax.scan(label_scan, jnp.full((b,), NEG),
+                               jnp.arange(u_max1))
+        new_alpha = jnp.swapaxes(cols, 0, 1)
+        active = (t < logit_lengths)[:, None]
+        return jnp.where(active, new_alpha, alpha), None
+
+    # t = 0 row: only emits
+    def init_scan(carry, u):
+        left = carry
+        cur = jnp.where(u == 0, 0.0, left + p_emit[:, 0, u - 1])
+        return cur, cur
+    _, cols0 = jax.lax.scan(init_scan, jnp.zeros((b,)), jnp.arange(u_max1))
+    alpha = jnp.swapaxes(cols0, 0, 1)
+
+    alpha, _ = jax.lax.scan(time_step, alpha, jnp.arange(1, t_max))
+    # total log prob: alpha[T-1, U] + blank(T-1, U)
+    t_last = jnp.clip(logit_lengths - 1, 0, t_max - 1)
+    bidx = jnp.arange(b)
+    final = (alpha[bidx, label_lengths]
+             + p_blank[bidx, t_last, label_lengths])
+    return -final
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    out = _rnnt_loss(input, _arr(label), _arr(input_lengths),
+                     _arr(label_lengths), int(blank), fastemit_lambda)
+    from paddle_tpu import tensor as T
+    if reduction == "mean":
+        return T.mean(out)
+    if reduction == "sum":
+        return T.sum(out)
+    return out
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """(reference: functional/sparse_attention.py — CUDA block-sparse
+    kernel). Routes to the CSR-pattern attention in paddle.sparse."""
+    from paddle_tpu import sparse
+    b, h = query.shape[0], query.shape[1]
+    outs = []
+    from paddle_tpu import tensor as T
+    for bi in range(b):
+        for hi in range(h):
+            q = query[bi, hi]
+            k = key[bi, hi]
+            v = value[bi, hi]
+            crows = _arr(sparse_csr_offset)[bi, hi]
+            cols = _arr(sparse_csr_columns)[bi, hi]
+            mask = sparse.sparse_csr_tensor(
+                crows, cols, jnp.ones((cols.shape[0],), jnp.float32),
+                (q.shape[0], k.shape[0]))
+            outs.append(sparse.nn.functional.attention(q, k, v, mask))
+    out = T.stack(outs, 0)
+    return T.reshape(out, [b, h] + list(out.shape[1:]))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    from paddle_tpu.nn import functional as F
+    return F.pad(x, padding, mode="constant", value=0.0,
+                 data_format=data_format)
+
+
+@defop("gather_tree", differentiable=False)
+def _gather_tree(ids, parents):
+    # ids, parents: (max_time, batch, beam)
+    t_max = ids.shape[0]
+
+    def back(carry, t):
+        beams = carry                                    # (batch, beam)
+        step_ids = jnp.take_along_axis(ids[t], beams, axis=-1)
+        next_beams = jnp.take_along_axis(parents[t], beams, axis=-1)
+        return next_beams, step_ids
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2]),
+                            ids.shape[1:]).astype(ids.dtype)
+    _, out = jax.lax.scan(back, init, jnp.arange(t_max), reverse=True)
+    return out
+
+
+def gather_tree(ids, parents):
+    """Beam-search ancestry resolution (reference: functional/common
+    gather_tree op)."""
+    return _gather_tree(_arr(ids), _arr(parents))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers union positive ones (reference:
+    functional/common.py class_center_sample — PartialFC training).
+    Returns (remapped_label, sampled_class_index)."""
+    lab = np.asarray(_arr(label)).ravel()
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+        rng = np.random.RandomState()
+        extra = rng.choice(neg_pool, size=num_samples - len(pos),
+                           replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[lab].astype(np.int32))),
+            Tensor(jnp.asarray(sampled.astype(np.int32))))
+
+
+def _act_inplace(fn):
+    def api(x, *a, **k):
+        return x._inplace_assign(fn(x, *a, **k))
+    return api
+
+
+def _late_bind_inplace():
+    # bound late: activation module is part of the same package import
+    from paddle_tpu.nn.functional import activation as A
+    globals()["hardtanh_"] = _act_inplace(A.hardtanh)
+    globals()["leaky_relu_"] = _act_inplace(A.leaky_relu)
+    globals()["tanh_"] = _act_inplace(A.tanh)
+    globals()["thresholded_relu_"] = _act_inplace(A.thresholded_relu)
+    __all__.extend(["hardtanh_", "leaky_relu_", "tanh_",
+                    "thresholded_relu_"])
+
+
+_late_bind_inplace()

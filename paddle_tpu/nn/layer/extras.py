@@ -1,0 +1,241 @@
+"""Long-tail nn layers wrapping functional.extras (reference:
+python/paddle/nn/layer/{pooling,loss,common,rnn}.py remainder).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.nn import functional as F
+
+__all__ = [
+    'AdaptiveMaxPool3D', 'FractionalMaxPool2D', 'FractionalMaxPool3D',
+    'MaxUnPool1D', 'MaxUnPool2D', 'MaxUnPool3D', 'CTCLoss',
+    'GaussianNLLLoss', 'HSigmoidLoss', 'MultiLabelSoftMarginLoss',
+    'MultiMarginLoss', 'PoissonNLLLoss', 'RNNTLoss', 'SoftMarginLoss',
+    'TripletMarginWithDistanceLoss', 'Unflatten', 'BeamSearchDecoder',
+    'dynamic_decode',
+]
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._output_size = output_size
+        self._return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self._output_size,
+                                     self._return_mask)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, *self._args)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._args = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, *self._args)
+
+
+class _MaxUnPoolNd(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self._kernel_size = kernel_size
+        self._stride = stride
+        self._padding = padding
+        self._output_size = output_size
+
+    def forward(self, x, indices):
+        return type(self)._fn(x, indices, self._kernel_size, self._stride,
+                              self._padding,
+                              output_size=self._output_size)
+
+
+class MaxUnPool1D(_MaxUnPoolNd):
+    _fn = staticmethod(F.max_unpool1d)
+
+
+class MaxUnPool2D(_MaxUnPoolNd):
+    _fn = staticmethod(F.max_unpool2d)
+
+
+class MaxUnPool3D(_MaxUnPoolNd):
+    _fn = staticmethod(F.max_unpool3d)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._args = (full, epsilon, reduction)
+
+    def forward(self, input, label, variance):
+        full, eps, red = self._args
+        return F.gaussian_nll_loss(input, label, variance, full, eps, red)
+
+
+class HSigmoidLoss(Layer):
+    """(reference: nn/layer/loss.py HSigmoidLoss — owns the path weights)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError("custom trees not supported")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes, feature_size], attr=weight_attr)
+        self.bias = self.create_parameter([num_classes], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._weight, self._reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self._weight,
+                                              self._reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._args = (p, margin, weight, reduction)
+
+    def forward(self, input, label):
+        p, m, w, r = self._args
+        return F.multi_margin_loss(input, label, p, m, w, r)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._args = (log_input, full, epsilon, reduction)
+
+    def forward(self, input, label):
+        li, fu, ep, re = self._args
+        return F.poisson_nll_loss(input, label, li, fu, ep, re)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._args = (blank, fastemit_lambda, reduction)
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        b, fe, r = self._args
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=b, fastemit_lambda=fe, reduction=r)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self._reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._args = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        d, m, s, r = self._args
+        return F.triplet_margin_with_distance_loss(input, positive,
+                                                   negative, d, m, s, r)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self._axis, self._shape = axis, shape
+
+    def forward(self, x):
+        from paddle_tpu import tensor as T
+        return T.unflatten(x, self._axis, self._shape)
+
+
+class BeamSearchDecoder:
+    """Greedy/beam decoding driver (reference: nn/decode.py
+    BeamSearchDecoder over RNN cells). Compact TPU version: the loop in
+    dynamic_decode is host-side (decode is interactive/eval, not a hot
+    training path); each step's cell call is jitted as usual."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=20, **kwargs):
+    """Greedy decode loop over a BeamSearchDecoder's cell (reference:
+    nn/decode.py dynamic_decode; beam_size=1 greedy semantics)."""
+    import numpy as np
+    from paddle_tpu import tensor as T
+    cell, emb = decoder.cell, decoder.embedding_fn
+    state = inits
+    token = decoder.start_token
+    outputs = []
+    finished = None
+    for _ in range(max_step_num):
+        inp = emb(token) if emb is not None else token
+        out, state = cell(inp, state)
+        logits = decoder.output_fn(out) if decoder.output_fn else out
+        token = T.argmax(logits, axis=-1)
+        tok_np = np.asarray(token._value)
+        done_now = (tok_np == decoder.end_token)
+        finished = done_now if finished is None else (finished | done_now)
+        # finished sequences keep emitting end_token, not garbage
+        if finished.any():
+            token = Tensor(jnp.where(jnp.asarray(finished),
+                                     decoder.end_token, token._value))
+        outputs.append(token)
+        if finished.all():
+            break
+    return T.stack(outputs, axis=1), state
