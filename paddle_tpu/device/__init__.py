@@ -101,3 +101,80 @@ class Event:
 
     def synchronize(self):
         synchronize()
+
+
+# -- remaining reference surface (reference: python/paddle/device/__init__)
+
+class _Place:
+    def __init__(self, kind, dev_id=0):
+        self._kind, self._dev_id = kind, dev_id
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self._dev_id})"
+
+
+class XPUPlace(_Place):
+    def __init__(self, dev_id=0):
+        super().__init__("xpu", dev_id)
+
+
+class IPUPlace(_Place):
+    def __init__(self, dev_id=0):
+        super().__init__("ipu", dev_id)
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def get_cudnn_version():
+    return None  # no cuDNN on the TPU backend
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def set_stream(stream):
+    global _current_stream
+    prev = _current_stream
+    _current_stream = stream
+    return prev
+
+
+class stream_guard:
+    """(reference: device/__init__.py stream_guard) — XLA owns ordering;
+    the guard swaps the bookkeeping object only."""
+
+    def __init__(self, stream):
+        self._stream = stream
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False
+
+
+def is_compiled_with_ipu():
+    return False

@@ -310,3 +310,24 @@ class CyclicLR(LRScheduler):
         elif self.mode == "exp_range":
             scale = self.exp_gamma ** self.last_epoch
         return self.base_lr + (self.max_lr - self.base_lr) * pct * scale
+
+
+class LinearLR(LRScheduler):
+    """Linear warmup from start_factor to end_factor over total_steps
+    (reference: optimizer/lr.py LinearLR)."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(self.last_epoch, self.total_steps)
+        frac = t / self.total_steps
+        factor = self.start_factor + (self.end_factor
+                                      - self.start_factor) * frac
+        return self.base_lr * factor

@@ -19,3 +19,16 @@ def set_image_backend(backend):
 
 def get_image_backend():
     return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file (reference: vision/image.py image_load).
+    backend 'pil' returns a PIL Image; 'cv2'/'tensor' return an HWC uint8
+    numpy array (no OpenCV in this image — PIL decodes either way)."""
+    from PIL import Image
+    import numpy as np
+    be = backend or get_image_backend()
+    img = Image.open(path)
+    if be == "pil":
+        return img
+    return np.asarray(img)

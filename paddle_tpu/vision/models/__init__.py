@@ -4,3 +4,12 @@ from paddle_tpu.vision.models.vgg import *  # noqa: F401,F403
 from paddle_tpu.vision.models.small import *  # noqa: F401,F403
 from paddle_tpu.vision.models.mobilenet import *  # noqa: F401,F403
 from paddle_tpu.vision.models.vit import *  # noqa: F401,F403
+from paddle_tpu.vision.models.densenet import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201,
+    densenet264)
+from paddle_tpu.vision.models.shufflenetv2 import (  # noqa: F401
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+    shufflenet_v2_x0_5, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0, shufflenet_v2_swish)
+from paddle_tpu.vision.models.googlenet_inception import (  # noqa: F401
+    GoogLeNet, googlenet, InceptionV3, inception_v3)

@@ -1,0 +1,107 @@
+"""DenseNet (reference: python/paddle/vision/models/densenet.py)."""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+from paddle_tpu import tensor as T
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(in_c)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        out = self.dropout(out)
+        return T.concat([x, out], axis=1)
+
+
+class _Transition(nn.Sequential):
+    def __init__(self, in_c, out_c):
+        super().__init__(
+            nn.BatchNorm2D(in_c), nn.ReLU(),
+            nn.Conv2D(in_c, out_c, 1, bias_attr=False),
+            nn.AvgPool2D(2, stride=2))
+
+
+class DenseNet(nn.Layer):
+    """(reference: densenet.py DenseNet — layers in {121,161,169,201,264})."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        if layers not in _CFG:
+            raise ValueError(f"layers must be one of {sorted(_CFG)}")
+        init_c, growth, blocks = _CFG[layers]
+        self.features = [nn.Conv2D(3, init_c, 7, stride=2, padding=3,
+                                   bias_attr=False),
+                         nn.BatchNorm2D(init_c), nn.ReLU(),
+                         nn.MaxPool2D(3, stride=2, padding=1)]
+        c = init_c
+        for bi, n_layers in enumerate(blocks):
+            for li in range(n_layers):
+                self.features.append(_DenseLayer(c, growth, bn_size,
+                                                 dropout))
+                c += growth
+            if bi != len(blocks) - 1:
+                self.features.append(_Transition(c, c // 2))
+                c = c // 2
+        self.features.append(nn.BatchNorm2D(c))
+        self.features.append(nn.ReLU())
+        self.features = nn.Sequential(*self.features)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = T.flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise RuntimeError(
+            "pretrained weights require network download, which this "
+            "environment does not allow; load a local state_dict instead")
+
+
+def _make(layers):
+    def ctor(pretrained=False, **kwargs):
+        _no_pretrained(pretrained)
+        return DenseNet(layers=layers, **kwargs)
+    ctor.__name__ = f"densenet{layers}"
+    return ctor
+
+
+densenet121 = _make(121)
+densenet161 = _make(161)
+densenet169 = _make(169)
+densenet201 = _make(201)
+densenet264 = _make(264)

@@ -1,0 +1,223 @@
+"""GoogLeNet + InceptionV3 (reference: python/paddle/vision/models/
+googlenet.py, inceptionv3.py).
+"""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+from paddle_tpu import tensor as T
+
+__all__ = ["GoogLeNet", "googlenet", "InceptionV3", "inception_v3"]
+
+
+def _cbr(in_c, out_c, k, stride=1, padding=0):
+    return nn.Sequential(
+        nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                  bias_attr=False),
+        nn.BatchNorm2D(out_c), nn.ReLU())
+
+
+class _Inception(nn.Layer):
+    """GoogLeNet inception block (1x1 / 3x3 / 5x5 / pool-proj)."""
+
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _cbr(in_c, c1, 1)
+        self.b3 = nn.Sequential(_cbr(in_c, c3r, 1), _cbr(c3r, c3, 3,
+                                                         padding=1))
+        self.b5 = nn.Sequential(_cbr(in_c, c5r, 1), _cbr(c5r, c5, 5,
+                                                         padding=2))
+        self.bp = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _cbr(in_c, proj, 1))
+
+    def forward(self, x):
+        return T.concat([self.b1(x), self.b3(x), self.b5(x), self.bp(x)],
+                        axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """(reference: googlenet.py GoogLeNet). forward returns (main, aux1,
+    aux2) like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _cbr(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _cbr(64, 64, 1), _cbr(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            # aux heads (train-time; reference keeps them in forward)
+            self.aux1 = nn.Sequential(nn.AdaptiveAvgPool2D(4),
+                                      _cbr(512, 128, 1))
+            self.aux1_fc = nn.Sequential(nn.Linear(128 * 16, 1024),
+                                         nn.ReLU(), nn.Dropout(0.7),
+                                         nn.Linear(1024, num_classes))
+            self.aux2 = nn.Sequential(nn.AdaptiveAvgPool2D(4),
+                                      _cbr(528, 128, 1))
+            self.aux2_fc = nn.Sequential(nn.Linear(128 * 16, 1024),
+                                         nn.ReLU(), nn.Dropout(0.7),
+                                         nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        aux1 = None
+        if self.num_classes > 0:
+            aux1 = self.aux1_fc(T.flatten(self.aux1(x), 1))
+        x = self.i4d(self.i4c(self.i4b(x)))
+        aux2 = None
+        if self.num_classes > 0:
+            aux2 = self.aux2_fc(T.flatten(self.aux2(x), 1))
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(T.flatten(x, 1)))
+        return x, aux1, aux2
+
+
+def googlenet(pretrained=False, **kwargs):
+    from paddle_tpu.vision.models.densenet import _no_pretrained
+    _no_pretrained(pretrained)
+    return GoogLeNet(**kwargs)
+
+
+class _IncA(nn.Layer):
+    def __init__(self, in_c, pool_features):
+        super().__init__()
+        self.b1 = _cbr(in_c, 64, 1)
+        self.b5 = nn.Sequential(_cbr(in_c, 48, 1), _cbr(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_cbr(in_c, 64, 1),
+                                _cbr(64, 96, 3, padding=1),
+                                _cbr(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _cbr(in_c, pool_features, 1))
+
+    def forward(self, x):
+        return T.concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], 1)
+
+
+class _IncReduceA(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _cbr(in_c, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_cbr(in_c, 64, 1),
+                                 _cbr(64, 96, 3, padding=1),
+                                 _cbr(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return T.concat([self.b3(x), self.b3d(x), self.pool(x)], 1)
+
+
+class _IncB(nn.Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _cbr(in_c, 192, 1)
+        self.b7 = nn.Sequential(_cbr(in_c, c7, 1),
+                                _cbr(c7, c7, (1, 7), padding=(0, 3)),
+                                _cbr(c7, 192, (7, 1), padding=(3, 0)))
+        self.b77 = nn.Sequential(_cbr(in_c, c7, 1),
+                                 _cbr(c7, c7, (7, 1), padding=(3, 0)),
+                                 _cbr(c7, c7, (1, 7), padding=(0, 3)),
+                                 _cbr(c7, c7, (7, 1), padding=(3, 0)),
+                                 _cbr(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _cbr(in_c, 192, 1))
+
+    def forward(self, x):
+        return T.concat([self.b1(x), self.b7(x), self.b77(x), self.bp(x)], 1)
+
+
+class _IncReduceB(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(_cbr(in_c, 192, 1), _cbr(192, 320, 3,
+                                                         stride=2))
+        self.b7 = nn.Sequential(_cbr(in_c, 192, 1),
+                                _cbr(192, 192, (1, 7), padding=(0, 3)),
+                                _cbr(192, 192, (7, 1), padding=(3, 0)),
+                                _cbr(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return T.concat([self.b3(x), self.b7(x), self.pool(x)], 1)
+
+
+class _IncC(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _cbr(in_c, 320, 1)
+        self.b3_stem = _cbr(in_c, 384, 1)
+        self.b3_a = _cbr(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _cbr(384, 384, (3, 1), padding=(1, 0))
+        self.b33_stem = nn.Sequential(_cbr(in_c, 448, 1),
+                                      _cbr(448, 384, 3, padding=1))
+        self.b33_a = _cbr(384, 384, (1, 3), padding=(0, 1))
+        self.b33_b = _cbr(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _cbr(in_c, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        s2 = self.b33_stem(x)
+        return T.concat([self.b1(x), self.b3_a(s), self.b3_b(s),
+                         self.b33_a(s2), self.b33_b(s2), self.bp(x)], 1)
+
+
+class InceptionV3(nn.Layer):
+    """(reference: inceptionv3.py InceptionV3; input 299x299)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _cbr(3, 32, 3, stride=2), _cbr(32, 32, 3),
+            _cbr(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _cbr(64, 80, 1), _cbr(80, 192, 3), nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncReduceA(288),
+            _IncB(768, 128), _IncB(768, 160), _IncB(768, 160),
+            _IncB(768, 192),
+            _IncReduceB(768),
+            _IncC(1280), _IncC(2048))
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(T.flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    from paddle_tpu.vision.models.densenet import _no_pretrained
+    _no_pretrained(pretrained)
+    return InceptionV3(**kwargs)

@@ -1,0 +1,128 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+from paddle_tpu import tensor as T
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+           "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512),
+    0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+_REPEATS = (4, 8, 4)
+
+
+def _channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = T.reshape(x, [n, groups, c // groups, h, w])
+    x = T.transpose(x, [0, 2, 1, 3, 4])
+    return T.reshape(x, [n, c, h, w])
+
+
+def _conv_bn(in_c, out_c, k, stride, groups=1, act=None):
+    layers = [nn.Conv2D(in_c, out_c, k, stride=stride,
+                        padding=(k - 1) // 2, groups=groups,
+                        bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act is not None:
+        layers.append(act())
+    return nn.Sequential(*layers)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act):
+        super().__init__()
+        self.stride = stride
+        branch = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn(in_c // 2, branch, 1, 1, act=act),
+                _conv_bn(branch, branch, 3, 1, groups=branch),
+                _conv_bn(branch, branch, 1, 1, act=act))
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn(in_c, in_c, 3, stride, groups=in_c),
+                _conv_bn(in_c, branch, 1, 1, act=act))
+            self.branch2 = nn.Sequential(
+                _conv_bn(in_c, branch, 1, 1, act=act),
+                _conv_bn(branch, branch, 3, stride, groups=branch),
+                _conv_bn(branch, branch, 1, 1, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1 = x[:, :half]
+            x2 = x[:, half:]
+            out = T.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = T.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"scale must be one of {sorted(_STAGE_OUT)}")
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
+        outs = _STAGE_OUT[scale]
+        self.conv1 = _conv_bn(3, outs[0], 3, 2, act=act_layer)
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        in_c = outs[0]
+        for si, reps in enumerate(_REPEATS):
+            out_c = outs[si + 1]
+            for i in range(reps):
+                blocks.append(_InvertedResidual(
+                    in_c, out_c, 2 if i == 0 else 1, act_layer))
+                in_c = out_c
+        self.blocks = nn.Sequential(*blocks)
+        self.conv_last = _conv_bn(in_c, outs[-1], 1, 1, act=act_layer)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(outs[-1], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        x = self.conv_last(self.blocks(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = T.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def _no_pretrained(pretrained):
+    from paddle_tpu.vision.models.densenet import _no_pretrained as f
+    f(pretrained)
+
+
+def _make(scale, act="relu", suffix=None):
+    def ctor(pretrained=False, **kwargs):
+        _no_pretrained(pretrained)
+        return ShuffleNetV2(scale=scale, act=act, **kwargs)
+    ctor.__name__ = suffix or f"shufflenet_v2_x{scale}"
+    return ctor
+
+
+shufflenet_v2_x0_25 = _make(0.25)
+shufflenet_v2_x0_33 = _make(0.33)
+shufflenet_v2_x0_5 = _make(0.5)
+shufflenet_v2_x1_0 = _make(1.0)
+shufflenet_v2_x1_5 = _make(1.5)
+shufflenet_v2_x2_0 = _make(2.0)
+shufflenet_v2_swish = _make(1.0, act="swish", suffix="shufflenet_v2_swish")

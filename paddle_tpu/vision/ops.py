@@ -9,6 +9,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer as _Layer
 
 __all__ = ["nms", "roi_align", "roi_pool", "box_area", "box_iou"]
 
@@ -147,3 +148,488 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
                 cells.append(sub.max((1, 2)))
         outs.append(jnp.stack(cells, 1).reshape(C, ph, pw))
     return Tensor(jnp.stack(outs))
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution (reference: vision/ops.py deform_conv2d ->
+# CUDA kernel phi/kernels/gpu/deformable_conv_kernel.cu; here: offset
+# sampling IS grid_sample-style bilinear gathers, which XLA fuses)
+# ---------------------------------------------------------------------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """x: (N, Cin, H, W); offset: (N, 2*dg*kh*kw, Ho, Wo);
+    weight: (Cout, Cin/g, kh, kw); mask (v2): (N, dg*kh*kw, Ho, Wo)."""
+    from paddle_tpu.core.dispatch import dispatch, OpDef
+
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    # im2col per kernel tap: bilinear-gather each tap's samples, then one
+    # big matmul against the reshaped weights (MXU-friendly)
+    def f2(xa, off, w, b, m):
+        n, cin, h, wd = xa.shape
+        cout, cin_g, kh, kw = w.shape
+        ho = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        wo = (wd + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        xp = jnp.pad(xa, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+        hp, wp = xp.shape[2], xp.shape[3]
+        off_r = off.reshape(n, deformable_groups, kh * kw, 2, ho, wo)
+        m_r = (m.reshape(n, deformable_groups, kh * kw, ho, wo)
+               if m is not None else None)
+        oy = (jnp.arange(ho) * st[0])[:, None]
+        ox = (jnp.arange(wo) * st[1])[None, :]
+        cg = cin // deformable_groups
+        cols = []
+        for t in range(kh * kw):
+            ki, kj = t // kw, t % kw
+            sy = oy + ki * dl[0] + off_r[:, :, t, 0]       # (n, dg, ho, wo)
+            sx = ox + kj * dl[1] + off_r[:, :, t, 1]
+            y0 = jnp.floor(sy)
+            x0 = jnp.floor(sx)
+            wy = (sy - y0)[..., None]
+            wx = (sx - x0)[..., None]
+
+            def gat(yy, xx):
+                inb = ((yy >= 0) & (yy < hp) & (xx >= 0) & (xx < wp))
+                yc = jnp.clip(yy.astype(jnp.int32), 0, hp - 1)
+                xc = jnp.clip(xx.astype(jnp.int32), 0, wp - 1)
+                xg = xp.reshape(n, deformable_groups, cg, hp, wp)
+                xg = jnp.moveaxis(xg, 2, 4)                # n,dg,hp,wp,cg
+                bidx = jnp.arange(n)[:, None, None, None]
+                gidx = jnp.arange(deformable_groups)[None, :, None, None]
+                v = xg[bidx, gidx, yc, xc]                 # n,dg,ho,wo,cg
+                return v * inb[..., None]
+
+            val = (gat(y0, x0) * (1 - wy) * (1 - wx)
+                   + gat(y0, x0 + 1) * (1 - wy) * wx
+                   + gat(y0 + 1, x0) * wy * (1 - wx)
+                   + gat(y0 + 1, x0 + 1) * wy * wx)
+            if m_r is not None:
+                val = val * m_r[:, :, t][..., None]
+            cols.append(val)                               # n,dg,ho,wo,cg
+        col = jnp.stack(cols, axis=-2)                 # n,dg,ho,wo,t,cg
+        # channel order must match the weight's: original cin order is
+        # [dg, cg] contiguous, so arrange (tap, dg, cg) and contract taps
+        # and channels together
+        col = jnp.moveaxis(col, 1, 4)                  # n,ho,wo,t,dg,cg
+        col = col.reshape(n, ho, wo, kh * kw, cin)
+        col_g = col.reshape(n, ho, wo, kh * kw, groups, cin_g)
+        wg = w.reshape(groups, cout // groups, cin_g, kh, kw)
+        wg = wg.reshape(groups, cout // groups, cin_g, kh * kw)
+        out = jnp.einsum("nhwtgc,goct->ngohw", col_g, wg)
+        out = out.reshape(n, cout, ho, wo)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    return dispatch(OpDef("vision.deform_conv2d", f2),
+                    (x, offset, weight, bias, mask), {})
+
+
+class DeformConv2D(_Layer):
+    """Layer form (reference: vision/ops.py DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from paddle_tpu import nn
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._conv_args = (stride, padding, dilation, deformable_groups,
+                           groups)
+        fan_in = in_channels // groups * ks[0] * ks[1]
+        bound = 1.0 / np.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr,
+            default_initializer=nn.initializer.Uniform(-bound, bound))
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([out_channels], attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        stride, padding, dilation, dg, groups = self._conv_args
+        return deform_conv2d(x, offset, self.weight, self.bias, stride,
+                             padding, dilation, dg, groups, mask)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference: vision/ops.py psroi_pool).
+    x channels = out_channels * ph * pw; each bin pools its own channel
+    group (average pooling within bin)."""
+    xa, ba = _val(x), _val(boxes)
+    ph = pw = output_size if isinstance(output_size, int) else None
+    if ph is None:
+        ph, pw = output_size
+    n, c, h, w = xa.shape
+    out_c = c // (ph * pw)
+    outs = []
+    bi = 0
+    counts = np.asarray(_val(boxes_num)).tolist()
+    for img, cnt in enumerate(counts):
+        for k in range(cnt):
+            x1, y1, x2, y2 = [float(v) for v in np.asarray(ba[bi])]
+            bi += 1
+            rx1, ry1 = x1 * spatial_scale, y1 * spatial_scale
+            rx2, ry2 = x2 * spatial_scale, y2 * spatial_scale
+            bh = max((ry2 - ry1) / ph, 0.1)
+            bw = max((rx2 - rx1) / pw, 0.1)
+            bins = []
+            feat = xa[img].reshape(out_c, ph * pw, h, w)
+            for i in range(ph):
+                row = []
+                for j in range(pw):
+                    y0 = int(np.floor(ry1 + i * bh))
+                    y2b = max(int(np.ceil(ry1 + (i + 1) * bh)), y0 + 1)
+                    x0 = int(np.floor(rx1 + j * bw))
+                    x2b = max(int(np.ceil(rx1 + (j + 1) * bw)), x0 + 1)
+                    y0, y2b = np.clip([y0, y2b], 0, h)
+                    x0, x2b = np.clip([x0, x2b], 0, w)
+                    if y2b <= y0 or x2b <= x0:
+                        row.append(jnp.zeros((out_c,), xa.dtype))
+                    else:
+                        region = feat[:, i * pw + j, y0:y2b, x0:x2b]
+                        row.append(jnp.mean(region, axis=(1, 2)))
+                bins.append(jnp.stack(row, axis=-1))
+            outs.append(jnp.stack(bins, axis=-2))          # (C, ph, pw)
+    return Tensor(jnp.stack(outs) if outs else
+                  jnp.zeros((0, out_c, ph, pw), xa.dtype))
+
+
+class _RoILayerBase(_Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+
+class PSRoIPool(_RoILayerBase):
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+class RoIAlign(_RoILayerBase):
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale)
+
+
+class RoIPool(_RoILayerBase):
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode bboxes against anchors (reference: vision/ops.py
+    box_coder)."""
+    pb, tb = _val(prior_box), _val(target_box)
+    pv = (_val(prior_box_var) if prior_box_var is not None
+          and not isinstance(prior_box_var, (list, tuple))
+          else (jnp.asarray(prior_box_var, jnp.float32)
+                if prior_box_var is not None else None))
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    phh = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + phh * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / phh[None, :]
+        dw = jnp.log(tw[:, None] / pw[None, :])
+        dh = jnp.log(th[:, None] / phh[None, :])
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if pv is not None:
+            out = out / pv.reshape(1, -1, 4) if pv.ndim == 2 else out / pv
+        return Tensor(out)
+    # decode_center_size: target (N, M, 4) deltas against priors
+    d = tb
+    if d.ndim == 2:
+        d = d[:, None, :]
+    if pv is not None:
+        d = d * (pv.reshape(1, 1, 4) if pv.ndim == 1 else pv[None])
+    if axis == 0:
+        pcx_, pcy_, pw_, ph_ = (pcx[None, :], pcy[None, :], pw[None, :],
+                                phh[None, :])
+    else:
+        pcx_, pcy_, pw_, ph_ = (pcx[:, None], pcy[:, None], pw[:, None],
+                                phh[:, None])
+    ocx = pcx_ + d[..., 0] * pw_
+    ocy = pcy_ + d[..., 1] * ph_
+    ow = jnp.exp(d[..., 2]) * pw_
+    oh = jnp.exp(d[..., 3]) * ph_
+    out = jnp.stack([ocx - ow * 0.5, ocy - oh * 0.5,
+                     ocx + ow * 0.5 - norm, ocy + oh * 0.5 - norm], axis=-1)
+    return Tensor(out)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (reference: vision/ops.py prior_box)."""
+    fa, ia = _val(input), _val(image)
+    fh, fw = fa.shape[2], fa.shape[3]
+    ih, iw = ia.shape[2], ia.shape[3]
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = list(aspect_ratios)
+    if flip:
+        ars = ars + [1.0 / a for a in aspect_ratios if a != 1.0]
+    boxes = []
+    for y in range(fh):
+        for x in range(fw):
+            cx = (x + offset) * step_w
+            cy = (y + offset) * step_h
+            cell = []
+            for si, ms in enumerate(min_sizes):
+                for a in ars:
+                    bw = ms * np.sqrt(a) / 2
+                    bh = ms / np.sqrt(a) / 2
+                    cell.append([(cx - bw) / iw, (cy - bh) / ih,
+                                 (cx + bw) / iw, (cy + bh) / ih])
+                if max_sizes:
+                    s = np.sqrt(ms * max_sizes[si])
+                    cell.append([(cx - s / 2) / iw, (cy - s / 2) / ih,
+                                 (cx + s / 2) / iw, (cy + s / 2) / ih])
+            boxes.append(cell)
+    out = np.asarray(boxes, np.float32).reshape(fh, fw, -1, 4)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.tile(np.asarray(variance, np.float32),
+                  (fh, fw, out.shape[2], 1))
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head output to boxes+scores (reference: vision/ops.py
+    yolo_box)."""
+    xa = _val(x)
+    n, c, h, w = xa.shape
+    na = len(anchors) // 2
+    an = np.asarray(anchors, np.float32).reshape(na, 2)
+    ioup = None
+    if iou_aware:
+        # layout (reference kernel yolo_box_op): first na channels are the
+        # IoU predictions, then the regular na*(5+cls) head
+        ioup = 1 / (1 + jnp.exp(-xa[:, :na].reshape(n, na, h, w)))
+        xa = xa[:, na:]
+    pred = xa.reshape(n, na, 5 + class_num, h, w)
+    img = np.asarray(_val(img_size)).reshape(n, 2)
+    gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    sig = lambda t: 1 / (1 + jnp.exp(-t))
+    bx = (sig(pred[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 + gx) / w
+    by = (sig(pred[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 + gy) / h
+    bw = jnp.exp(pred[:, :, 2]) * an[None, :, 0, None, None] / (
+        downsample_ratio * w)
+    bh = jnp.exp(pred[:, :, 3]) * an[None, :, 1, None, None] / (
+        downsample_ratio * h)
+    conf = sig(pred[:, :, 4])
+    if ioup is not None:
+        conf = (conf ** (1 - iou_aware_factor)) * (ioup ** iou_aware_factor)
+    cls = sig(pred[:, :, 5:])
+    scores = cls * conf[:, :, None]
+    ih = img[:, 0].reshape(n, 1, 1, 1)
+    iw = img[:, 1].reshape(n, 1, 1, 1)
+    x1 = (bx - bw / 2) * iw
+    y1 = (by - bh / 2) * ih
+    x2 = (bx + bw / 2) * iw
+    y2 = (by + bh / 2) * ih
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, iw - 1)
+        y1 = jnp.clip(y1, 0, ih - 1)
+        x2 = jnp.clip(x2, 0, iw - 1)
+        y2 = jnp.clip(y2, 0, ih - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+    mask = (conf > conf_thresh)[:, :, :, :, None]
+    scores = jnp.moveaxis(scores, 2, -1) * mask
+    scores = scores.reshape(n, -1, class_num)
+    return Tensor(boxes), Tensor(scores)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    raise NotImplementedError(
+        "yolo_loss: compose yolo_box decode with the generic detection "
+        "losses (bce/iou) — the fused CUDA training loss has no TPU "
+        "equivalent; PaddleDetection-style models should compute the loss "
+        "from yolo_box outputs")
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference: vision/ops.py matrix_nms, SOLOv2) — decayed
+    scores instead of hard suppression; fully vectorized."""
+    ba = np.asarray(_val(bboxes))
+    sa = np.asarray(_val(scores))
+    n, c, m = sa.shape
+    all_out, all_idx, rois_num = [], [], []
+    for b in range(n):
+        dets = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            sc = sa[b, cls]
+            keep = np.nonzero(sc > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[keep])][:nms_top_k]
+            bx = ba[b, order]
+            ss = sc[order]
+            ious = np.asarray(_iou_matrix(jnp.asarray(bx), jnp.asarray(bx)))
+            ious = np.triu(ious, 1)
+            ious_cmax = ious.max(0)
+            if use_gaussian:
+                decay = np.exp(-(ious ** 2 - ious_cmax[None, :] ** 2)
+                               / gaussian_sigma).min(0)
+            else:
+                decay = ((1 - ious) / (1 - ious_cmax[None, :])).min(0)
+            dec = ss * decay
+            for i, od in enumerate(order):
+                if dec[i] >= post_threshold:
+                    dets.append((cls, dec[i], *bx[i], b * c * m + cls * m
+                                 + od))
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k]
+        rois_num.append(len(dets))
+        for d in dets:
+            all_out.append(d[:6])
+            all_idx.append(d[6])
+    out = Tensor(jnp.asarray(np.asarray(all_out, np.float32).reshape(
+        -1, 6)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(all_idx, np.int32))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(rois_num, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference: vision/ops.py
+    distribute_fpn_proposals)."""
+    rois = np.asarray(_val(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(ws * hs, 1e-6))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi, restore = [], np.zeros(len(rois), np.int32)
+    order = []
+    for L in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == L)[0]
+        multi.append(Tensor(jnp.asarray(rois[idx])))
+        order.extend(idx.tolist())
+    restore[np.asarray(order, np.int32)] = np.arange(len(rois), dtype=np.int32)
+    nums = [Tensor(jnp.asarray(np.asarray([len(np.nonzero(lvl == L)[0])],
+                                          np.int32)))
+            for L in range(min_level, max_level + 1)]
+    return multi, Tensor(jnp.asarray(restore.reshape(-1, 1))), nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference: vision/ops.py
+    generate_proposals): decode deltas on anchors -> clip -> filter small
+    -> NMS."""
+    sa = np.asarray(_val(scores))          # (N, A, H, W)
+    da = np.asarray(_val(bbox_deltas))     # (N, 4A, H, W)
+    an = np.asarray(_val(anchors)).reshape(-1, 4)
+    va = np.asarray(_val(variances)).reshape(-1, 4)
+    ims = np.asarray(_val(img_size))
+    n = sa.shape[0]
+    outs, nums, out_scores = [], [], []
+    for b in range(n):
+        s = sa[b].transpose(1, 2, 0).ravel()
+        d = da[b].reshape(-1, 4, sa.shape[2], sa.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, A = s[order], d[order], an[order % len(an)] \
+            if len(an) != len(s) else an[order]
+        V = va[order % len(va)] if len(va) != len(s) else va[order]
+        aw = A[:, 2] - A[:, 0] + (1.0 if pixel_offset else 0.0)
+        ah = A[:, 3] - A[:, 1] + (1.0 if pixel_offset else 0.0)
+        acx = A[:, 0] + aw / 2
+        acy = A[:, 1] + ah / 2
+        cx = acx + d[:, 0] * V[:, 0] * aw
+        cy = acy + d[:, 1] * V[:, 1] * ah
+        w = aw * np.exp(np.minimum(d[:, 2] * V[:, 2], 10))
+        h = ah * np.exp(np.minimum(d[:, 3] * V[:, 3], 10))
+        boxes = np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                         axis=1)
+        ih, iw = ims[b, 0], ims[b, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih)
+        keep = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        if len(boxes):
+            kept = nms(Tensor(jnp.asarray(boxes)), nms_thresh,
+                       Tensor(jnp.asarray(s)), top_k=post_nms_top_n)
+            kidx = np.asarray(kept._value)
+            boxes, s = boxes[kidx], s[kidx]
+        outs.append(boxes)
+        out_scores.append(s)
+        nums.append(len(boxes))
+    rois = Tensor(jnp.asarray(np.concatenate(outs).astype(np.float32)))
+    rscores = Tensor(jnp.asarray(np.concatenate(out_scores)
+                                 .astype(np.float32)))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois, rscores
+
+
+def read_file(path, name=None):
+    with open(path, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """(reference: vision/ops.py decode_jpeg — nvjpeg). Host-side PIL."""
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError("decode_jpeg needs Pillow on the host") from e
+    import io as _io
+    raw = bytes(np.asarray(_val(x)).astype(np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+__all__ += ["deform_conv2d", "DeformConv2D", "psroi_pool", "PSRoIPool",
+            "RoIAlign", "RoIPool", "box_coder", "prior_box", "yolo_box",
+            "yolo_loss", "matrix_nms", "distribute_fpn_proposals",
+            "generate_proposals", "read_file", "decode_jpeg"]

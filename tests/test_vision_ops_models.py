@@ -1,0 +1,263 @@
+"""New vision ops + model families (reference: python/paddle/vision/ops.py,
+models/{densenet,shufflenetv2,googlenet,inceptionv3}.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+from paddle_tpu.vision import models as M
+
+
+def test_deform_conv2d_zero_offset_matches_conv():
+    # with zero offsets, deformable conv IS a regular convolution
+    from paddle_tpu.nn import functional as F
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+    w = rng.randn(6, 4, 3, 3).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+    offset = np.zeros((2, 2 * 9, 8, 8), np.float32)
+    ours = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                           paddle.to_tensor(w), paddle.to_tensor(b),
+                           padding=1).numpy()
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                   paddle.to_tensor(b), padding=1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_deform_conv2d_random_offset_matches_numpy():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    offset = (rng.randn(1, 18, 6, 6) * 0.5).astype(np.float32)
+    mask = rng.rand(1, 9, 6, 6).astype(np.float32)
+    ours = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                           paddle.to_tensor(w), padding=1,
+                           mask=paddle.to_tensor(mask)).numpy()
+
+    # naive numpy reference (torchvision deform_conv2d v2 semantics)
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    hp, wp = xp.shape[2:]
+    off = offset.reshape(1, 9, 2, 6, 6)
+    m = mask.reshape(1, 9, 6, 6)
+    ref = np.zeros((1, 3, 6, 6), np.float32)
+    for oy in range(6):
+        for ox in range(6):
+            acc = np.zeros((3,), np.float32)
+            for t in range(9):
+                ki, kj = t // 3, t % 3
+                sy = oy + ki + off[0, t, 0, oy, ox]
+                sx = ox + kj + off[0, t, 1, oy, ox]
+                y0, x0 = int(np.floor(sy)), int(np.floor(sx))
+                wy, wx = sy - y0, sx - x0
+
+                def px(yy, xx):
+                    if 0 <= yy < hp and 0 <= xx < wp:
+                        return xp[0, :, yy, xx]
+                    return np.zeros((2,), np.float32)
+                val = (px(y0, x0) * (1 - wy) * (1 - wx)
+                       + px(y0, x0 + 1) * (1 - wy) * wx
+                       + px(y0 + 1, x0) * wy * (1 - wx)
+                       + px(y0 + 1, x0 + 1) * wy * wx)
+                val = val * m[0, t, oy, ox]
+                acc += (w[:, :, ki, kj] * val[None, :]).sum(1)
+            ref[0, :, oy, ox] = acc
+    np.testing.assert_allclose(ours, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_box_coder_decode_roundtrip():
+    priors = np.array([[0., 0., 10., 10.], [5., 5., 15., 20.]], np.float32)
+    deltas = np.zeros((2, 1, 4), np.float32)
+    out = V.box_coder(paddle.to_tensor(priors), [1., 1., 1., 1.],
+                      paddle.to_tensor(deltas),
+                      code_type="decode_center_size", axis=1).numpy()
+    np.testing.assert_allclose(out[:, 0], priors, atol=1e-4)
+
+
+def test_prior_box_shapes():
+    feat = paddle.zeros([1, 8, 4, 4])
+    img = paddle.zeros([1, 3, 32, 32])
+    boxes, var = V.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                             aspect_ratios=[1.0, 2.0], clip=True)
+    assert boxes.shape[0] == 4 and boxes.shape[1] == 4
+    assert boxes.shape[2] == 3  # 2 ars + 1 max_size box
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_yolo_box_decode():
+    rng = np.random.RandomState(2)
+    cls = 3
+    x = rng.randn(1, 2 * (5 + cls), 4, 4).astype(np.float32)
+    boxes, scores = V.yolo_box(paddle.to_tensor(x),
+                               paddle.to_tensor(np.array([[128, 128]],
+                                                         np.int32)),
+                               anchors=[10, 13, 16, 30], class_num=cls)
+    assert boxes.shape == [1, 32, 4]
+    assert scores.shape == [1, 32, 3]
+    assert np.isfinite(boxes.numpy()).all()
+
+
+def test_matrix_nms():
+    boxes = np.array([[[0., 0., 10., 10.], [0., 0., 9., 9.],
+                       [20., 20., 30., 30.]]], np.float32)
+    scores = np.array([[[0.9, 0.85, 0.7]]], np.float32)  # 1 class
+    out, idx, num = V.matrix_nms(paddle.to_tensor(boxes),
+                                 paddle.to_tensor(scores),
+                                 score_threshold=0.1, post_threshold=0.1,
+                                 nms_top_k=10, keep_top_k=5,
+                                 background_label=-1, return_index=True)
+    assert int(num.numpy()[0]) >= 2  # both clusters survive
+    assert out.shape[1] == 6
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0., 0., 10., 10.],      # small -> low level
+                     [0., 0., 300., 300.]], np.float32)  # large -> high
+    multi, restore, nums = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224)
+    sizes = [m.shape[0] for m in multi]
+    assert sum(sizes) == 2
+    # small box -> lowest level; 300px box -> refer level (4) bucket
+    assert sizes[0] == 1 and sizes[2] == 1
+    # restore index maps concatenated-multi order back to input order
+    r = restore.numpy().ravel()
+    assert sorted(r.tolist()) == [0, 1]
+
+
+def test_generate_proposals():
+    rng = np.random.RandomState(3)
+    scores = rng.rand(1, 3, 4, 4).astype(np.float32)
+    deltas = (rng.randn(1, 12, 4, 4) * 0.1).astype(np.float32)
+    anchors = rng.rand(4 * 4 * 3, 4).astype(np.float32) * 10
+    anchors[:, 2:] += anchors[:, :2] + 5
+    var = np.ones((4 * 4 * 3, 4), np.float32)
+    rois, rscores, num = V.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(np.array([[64, 64]], np.float32)),
+        paddle.to_tensor(anchors), paddle.to_tensor(var),
+        post_nms_top_n=10, return_rois_num=True)
+    assert rois.shape[1] == 4
+    assert int(num.numpy()[0]) == rois.shape[0] <= 10
+
+
+def test_psroi_pool():
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 2 * 2 * 2, 8, 8).astype(np.float32)  # C=2, bins 2x2
+    boxes = np.array([[0., 0., 7., 7.]], np.float32)
+    out = V.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                       paddle.to_tensor(np.array([1], np.int32)), 2)
+    assert out.shape == [1, 2, 2, 2]
+
+
+def test_roi_layers():
+    rng = np.random.RandomState(5)
+    x = paddle.to_tensor(rng.randn(1, 3, 8, 8).astype(np.float32))
+    boxes = paddle.to_tensor(np.array([[0., 0., 7., 7.]], np.float32))
+    bn = paddle.to_tensor(np.array([1], np.int32))
+    lay = V.RoIAlign(2)
+    assert lay(x, boxes, bn).shape == [1, 3, 2, 2]
+    lay2 = V.RoIPool(2)
+    assert lay2(x, boxes, bn).shape == [1, 3, 2, 2]
+
+
+@pytest.mark.parametrize("ctor,cls", [
+    (lambda: M.densenet121(num_classes=10), "DenseNet"),
+    (lambda: M.shufflenet_v2_x0_25(num_classes=10), "ShuffleNetV2"),
+])
+def test_new_model_families_forward(ctor, cls):
+    model = ctor()
+    model.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(6).randn(1, 3, 64, 64).astype(np.float32))
+    out = model(x)
+    assert out.shape == [1, 10]
+
+
+def test_googlenet_aux_heads():
+    model = M.googlenet(num_classes=7)
+    model.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(7).randn(1, 3, 96, 96).astype(np.float32))
+    main, aux1, aux2 = model(x)
+    assert main.shape == [1, 7] and aux1.shape == [1, 7]
+
+
+def test_inception_v3_forward():
+    model = M.inception_v3(num_classes=5)
+    model.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(8).randn(1, 3, 299, 299).astype(np.float32))
+    out = model(x)
+    assert out.shape == [1, 5]
+
+
+def test_linear_lr_schedule():
+    import paddle_tpu.optimizer.lr as lrmod
+    sched = lrmod.LinearLR(0.1, total_steps=4, start_factor=0.5)
+    vals = []
+    for _ in range(5):
+        vals.append(float(sched()))
+        sched.step()
+    np.testing.assert_allclose(vals[0], 0.05, rtol=1e-6)
+    np.testing.assert_allclose(vals[4], 0.1, rtol=1e-6)
+
+
+def test_device_shims():
+    from paddle_tpu import device
+    assert "cpu" in device.get_all_device_type() or \
+        "tpu" in device.get_all_device_type()
+    s = device.Stream()
+    with device.stream_guard(s) as cur:
+        assert device.current_stream() is s
+    assert device.get_cudnn_version() is None
+
+
+def test_deform_conv2d_group_combos_match_conv():
+    from paddle_tpu.nn import functional as F
+    rng = np.random.RandomState(9)
+    x = rng.randn(1, 4, 6, 6).astype(np.float32)
+    offset = np.zeros((1, 18, 6, 6), np.float32)
+    for dg, g in [(2, 1), (1, 2), (2, 2), (4, 1)]:
+        w = rng.randn(4, 4 // g, 3, 3).astype(np.float32)
+        off = np.zeros((1, dg * 18, 6, 6), np.float32)
+        ours = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                               paddle.to_tensor(w), padding=1,
+                               deformable_groups=dg, groups=g).numpy()
+        ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                       padding=1, groups=g).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-3, atol=1e-4,
+                                   err_msg=f"dg={dg} g={g}")
+
+
+def test_roi_layers_are_real_layers():
+    import pickle
+    lay = V.RoIAlign(2)
+    assert isinstance(lay, V.RoIAlign)
+    dc = V.DeformConv2D(2, 2, 3)
+    assert isinstance(dc, V.DeformConv2D)
+    assert any("weight" in n for n, _ in dc.named_parameters())
+
+
+def test_yolo_box_iou_aware():
+    rng = np.random.RandomState(10)
+    cls, na = 2, 2
+    x = rng.randn(1, na * (6 + cls), 4, 4).astype(np.float32)
+    boxes, scores = V.yolo_box(paddle.to_tensor(x),
+                               paddle.to_tensor(np.array([[64, 64]],
+                                                         np.int32)),
+                               anchors=[10, 13, 16, 30], class_num=cls,
+                               iou_aware=True, iou_aware_factor=0.5)
+    assert boxes.shape == [1, 32, 4] and scores.shape == [1, 32, cls]
+    assert np.isfinite(scores.numpy()).all()
+
+
+def test_image_backend_respected(tmp_path):
+    from PIL import Image
+    import paddle_tpu.vision as vision
+    p = str(tmp_path / "img.png")
+    Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(p)
+    vision.set_image_backend("pil")
+    assert isinstance(vision.image_load(p), Image.Image)
+    vision.set_image_backend("cv2")
+    assert isinstance(vision.image_load(p), np.ndarray)
