@@ -74,6 +74,9 @@ from paddle_tpu.device import (  # noqa: F401
     set_device, get_device, is_compiled_with_cuda, is_compiled_with_xpu,
     is_compiled_with_rocm, is_compiled_with_custom_device,
 )
+from paddle_tpu.nn import ParamAttr  # noqa: F401
+import numpy as _np
+dtype = _np.dtype  # paddle.dtype: dtypes are numpy dtypes in this build
 
 
 def __getattr__(name):
@@ -93,6 +96,10 @@ def __getattr__(name):
                 f"module 'paddle_tpu' has no attribute {name!r}") from e
         globals()[name] = mod
         return mod
+    if name == "DataParallel":
+        from paddle_tpu.distributed.parallel import DataParallel
+        globals()["DataParallel"] = DataParallel
+        return DataParallel
     if name == "Model":
         from paddle_tpu.hapi import Model
         globals()["Model"] = Model
@@ -102,6 +109,125 @@ def __getattr__(name):
         globals()["callbacks"] = callbacks
         return callbacks
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+# -- remaining top-level reference surface ---------------------------------
+
+from paddle_tpu.device import _Place as _PlaceBase  # noqa: E402
+
+
+class CPUPlace(_PlaceBase):
+    def __init__(self):
+        super().__init__("cpu")
+
+
+class CUDAPlace(_PlaceBase):
+    def __init__(self, dev_id=0):
+        super().__init__("gpu", dev_id)
+
+
+class CUDAPinnedPlace(_PlaceBase):
+    def __init__(self):
+        super().__init__("gpu_pinned")
+
+
+class LazyGuard:
+    """(reference: python/paddle/nn/initializer/lazy_init.py LazyGuard) —
+    eager-initialized parameters make lazy init a no-op context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def finfo(dtype):
+    import jax.numpy as _jnp
+    from paddle_tpu.core.dtype import convert_dtype
+    return _jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    import jax.numpy as _jnp
+    from paddle_tpu.core.dtype import convert_dtype
+    return _jnp.iinfo(convert_dtype(dtype))
+
+
+def is_grad_enabled():
+    from paddle_tpu.core.tape import grad_enabled
+    return grad_enabled()
+
+
+def tolist(x):
+    return x.numpy().tolist()
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader combinator (reference: python/paddle/batch.py)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances (reference: tensor/linalg.py pdist)."""
+    import numpy as _np
+    from paddle_tpu import tensor as _T
+    full = cdist(x, x, p=p)
+    n = x.shape[0]
+    iu = _np.triu_indices(n, 1)
+    return _T.gather_nd(full, _T.to_tensor(
+        _np.stack(iu, axis=1).astype(_np.int32)))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """(reference: tensor/math.py combinations)."""
+    import itertools as _it
+    import numpy as _np
+    from paddle_tpu import tensor as _T
+    n = x.shape[0]
+    idx = (_it.combinations_with_replacement(range(n), r)
+           if with_replacement else _it.combinations(range(n), r))
+    idx = _np.asarray(list(idx), _np.int32).reshape(-1, r)
+    cols = [index_select(x, _T.to_tensor(idx[:, j]), axis=0)
+            for j in range(r)]
+    return _T.stack(cols, axis=1)
+
+
+def standard_gamma(x, name=None):
+    """Sample Gamma(alpha=x, 1) (reference: tensor/random.py
+    standard_gamma)."""
+    import jax as _jax
+    from paddle_tpu.core.random import next_key
+    from paddle_tpu.core.tensor import Tensor as _T
+    arr = x._value if isinstance(x, _T) else x
+    return _T(_jax.random.gamma(next_key(), arr))
+
+
+def check_shape(x):
+    return list(x.shape)
+
+
+def disable_signal_handler():
+    return None
+
+
+def get_cuda_rng_state():
+    from paddle_tpu.core.random import get_rng_state
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from paddle_tpu.core.random import set_rng_state
+    return set_rng_state(state)
 
 
 def in_dynamic_mode():

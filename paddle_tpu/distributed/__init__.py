@@ -21,6 +21,8 @@ from paddle_tpu.distributed.collective import (  # noqa: F401
     broadcast, reduce, reduce_scatter, alltoall, scatter, barrier, send,
     recv, psum, pmean, ppermute,
 )
+from paddle_tpu.distributed.compat import *  # noqa: F401,F403
+from paddle_tpu.distributed.parallel import DataParallel  # noqa: F401
 from paddle_tpu.distributed.env import (  # noqa: F401
     init_parallel_env, is_initialized, get_rank, get_world_size,
     ParallelEnv,
@@ -32,7 +34,7 @@ all_to_all = alltoall  # torch-style alias the reference also exposes
 def __getattr__(name):
     import importlib
     if name in ("fleet", "checkpoint", "pipeline", "launch", "parallel",
-                "sharding", "elastic", "auto_tuner"):
+                "sharding", "elastic", "auto_tuner", "rpc"):
         mod = importlib.import_module(f"paddle_tpu.distributed.{name}")
         globals()[name] = mod
         return mod

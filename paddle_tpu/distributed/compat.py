@@ -1,0 +1,207 @@
+"""Remaining paddle.distributed surface (reference:
+python/paddle/distributed/__init__.py imports): semi-auto static entries,
+PS dataset stubs, rpc/gloo shims, misc helpers.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "is_available", "DistAttr", "Strategy", "DistModel", "to_static",
+    "save_state_dict", "load_state_dict", "shard_dataloader", "shard_op",
+    "shard_scaler", "split", "gloo_init_parallel_env", "gloo_barrier",
+    "gloo_release", "InMemoryDataset", "QueueDataset", "BoxPSDataset",
+    "ProbabilityEntry", "CountFilterEntry", "ShowClickEntry",
+]
+
+
+def is_available():
+    """(reference: distributed/__init__.py is_available)."""
+    return True
+
+
+class DistAttr:
+    """Tensor distribution attribute (reference:
+    phi/core/distributed/auto_parallel/dist_attr.h:81 TensorDistAttr;
+    python surface auto_parallel/api.py DistAttr). Thin record — the live
+    sharding is carried by the jax.Array's NamedSharding."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs or []
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"specs={self.sharding_specs})")
+
+
+class Strategy:
+    """Semi-auto training strategy (reference: auto_parallel/strategy.py).
+    Typed knobs only; execution is GSPMD."""
+
+    def __init__(self, config=None):
+        cfg = config or {}
+        self.sharding = cfg.get("sharding", {})
+        self.gradient_merge = cfg.get("gradient_merge", {})
+        self.pipeline = cfg.get("pipeline", {})
+        self.amp = cfg.get("amp", {})
+
+
+class DistModel:
+    """(reference: auto_parallel/api.py DistModel — the to_static product).
+    Wraps (model, loss, optimizer) into a compiled-step callable via
+    paddle_tpu.parallel.Trainer."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        self.network = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._trainer = None
+        self._mode = "train"
+
+    def train(self):
+        self._mode = "train"
+
+    def eval(self):
+        self._mode = "eval"
+
+    def __call__(self, *args):
+        if self._mode == "eval" or self._optimizer is None:
+            out = self.network(*args)
+            if self._loss is not None and len(args) >= 2:
+                return self._loss(out, args[-1])
+            return out
+        from paddle_tpu.parallel import Trainer
+        if self._trainer is None:
+            from paddle_tpu.distributed.mesh import get_mesh
+            mesh = get_mesh()
+            self._trainer = Trainer(self.network, self._optimizer,
+                                    mesh=mesh.jax_mesh if mesh else None)
+        # args: (input, label) convention like the reference examples
+        batch = {"input_ids": args[0], "labels": args[-1]}
+        return self._trainer.step(batch)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """(reference: auto_parallel/api.py:1611 to_static)."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+def save_state_dict(state_dict, path, **kw):
+    from paddle_tpu.distributed import checkpoint as ckpt
+    return ckpt.save_state_dict(state_dict, path, **kw)
+
+
+def load_state_dict(state_dict, path, **kw):
+    from paddle_tpu.distributed import checkpoint as ckpt
+    return ckpt.load_state_dict(state_dict, path, **kw)
+
+
+def shard_dataloader(dataloader, meshes=None, shard_dims=None,
+                     input_keys=None):
+    """(reference: auto_parallel/api.py shard_dataloader). Single-
+    controller jax feeds per-host batches already; the loader is returned
+    unchanged with a marker for Trainer's batch sharding."""
+    dataloader._shard_dims = shard_dims
+    return dataloader
+
+
+def shard_op(op_fn, mesh, in_placements=None, out_placements=None):
+    """(reference: auto_parallel/api.py shard_op) — constrain an op's
+    outputs onto the mesh."""
+    import jax
+    from jax.sharding import NamedSharding
+    from paddle_tpu.distributed.placement import placements_to_spec
+    from paddle_tpu.core.tensor import Tensor
+
+    def wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        if out_placements is not None and isinstance(out, Tensor):
+            spec = placements_to_spec(out_placements, mesh, ndim=out.ndim)
+            out._value = jax.lax.with_sharding_constraint(
+                out._value, NamedSharding(mesh.jax_mesh, spec))
+        return out
+    return wrapped
+
+
+def shard_scaler(scaler):
+    """(reference: auto_parallel/api.py shard_scaler) — loss scaling state
+    is replicated scalars under GSPMD; nothing to shard."""
+    return scaler
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style split layer builder (reference:
+    python/paddle/distributed/collective.py split). Maps to the mpu
+    layers, which express the split as GSPMD shardings."""
+    from paddle_tpu.distributed.fleet.layers import (ColumnParallelLinear,
+                                                     RowParallelLinear,
+                                                     VocabParallelEmbedding)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation == "linear":
+        if axis == 1:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        else:
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False)
+        return layer(x)
+    raise ValueError(f"unknown operation {operation!r}")
+
+
+# -- gloo CPU shims (reference: gloo bootstrap for CPU-only runs) ----------
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    return None  # single-controller jax needs no gloo bootstrap
+
+
+def gloo_barrier():
+    return None
+
+
+def gloo_release():
+    return None
+
+
+# -- parameter-server surfaces (OUT OF SCOPE per SURVEY.md §2.5: recsys
+# CPU/GPU-hybrid PS is documented-only; these raise with that pointer) ----
+
+class _PSOnly:
+    _NAME = "?"
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            f"{self._NAME} belongs to the brpc parameter-server stack "
+            f"(reference paddle/fluid/distributed/ps/), which SURVEY.md "
+            f"§2.5 scopes out of the TPU rebuild; use paddle_tpu.io "
+            f"datasets + GSPMD data parallelism instead")
+
+
+class InMemoryDataset(_PSOnly):
+    _NAME = "InMemoryDataset"
+
+
+class QueueDataset(_PSOnly):
+    _NAME = "QueueDataset"
+
+
+class BoxPSDataset(_PSOnly):
+    _NAME = "BoxPSDataset"
+
+
+class ProbabilityEntry(_PSOnly):
+    _NAME = "ProbabilityEntry"
+
+
+class CountFilterEntry(_PSOnly):
+    _NAME = "CountFilterEntry"
+
+
+class ShowClickEntry(_PSOnly):
+    _NAME = "ShowClickEntry"

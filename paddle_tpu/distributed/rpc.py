@@ -1,0 +1,66 @@
+"""`paddle.distributed.rpc` shim (reference: python/paddle/distributed/
+rpc/ over the brpc agent — SURVEY.md §2.5 'thin equivalent only if
+needed'). Single-process: sync/async RPC execute locally; multi-host
+users should route work through the jax.distributed coordination service
+or an external RPC system.
+"""
+from __future__ import annotations
+
+import concurrent.futures as _fut
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info"]
+
+_state = {"name": None, "rank": 0, "world_size": 1,
+          "pool": None}
+
+
+class WorkerInfo:
+    def __init__(self, name, rank):
+        self.name, self.rank = name, rank
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name}, rank={self.rank})"
+
+
+def init_rpc(name, rank=0, world_size=1, master_endpoint=None):
+    if world_size > 1:
+        raise NotImplementedError(
+            "multi-host rpc is not part of the TPU rebuild (SURVEY.md "
+            "§2.5); use jax.distributed / paddle_tpu.distributed.launch")
+    _state.update(name=name, rank=rank, world_size=world_size,
+                  pool=_fut.ThreadPoolExecutor(max_workers=4))
+
+
+def _check():
+    if _state["pool"] is None:
+        raise RuntimeError("call init_rpc first")
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=-1):
+    _check()
+    return fn(*(args or ()), **(kwargs or {}))
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=-1):
+    _check()
+    return _state["pool"].submit(fn, *(args or ()), **(kwargs or {}))
+
+
+def shutdown():
+    if _state["pool"] is not None:
+        _state["pool"].shutdown()
+        _state["pool"] = None
+
+
+def get_worker_info(name=None):
+    return WorkerInfo(name or _state["name"], _state["rank"])
+
+
+def get_current_worker_info():
+    return WorkerInfo(_state["name"], _state["rank"])
+
+
+def get_all_worker_infos():
+    return [get_current_worker_info()]

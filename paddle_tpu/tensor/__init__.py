@@ -276,6 +276,7 @@ def _patch():
         "gammaln", "gcd", "hypot", "i0", "lcm", "lgamma", "log", "log10",
         "log1p", "log2", "logit", "mod", "nan_to_num", "neg", "polygamma",
         "sigmoid", "sin", "sinh", "sqrt", "tan", "trunc", "tril", "triu",
+        "erf", "expm1", "square", "t",
         "equal", "not_equal", "greater_equal", "greater_than",
         "less_equal", "less_than", "logical_and", "logical_not",
         "logical_or", "logical_xor", "bitwise_and", "bitwise_not",

@@ -394,15 +394,34 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
             cy = (y + offset) * step_h
             cell = []
             for si, ms in enumerate(min_sizes):
-                for a in ars:
-                    bw = ms * np.sqrt(a) / 2
-                    bh = ms / np.sqrt(a) / 2
+                def _min_box():
+                    bw = bh = ms / 2
                     cell.append([(cx - bw) / iw, (cy - bh) / ih,
                                  (cx + bw) / iw, (cy + bh) / ih])
-                if max_sizes:
-                    s = np.sqrt(ms * max_sizes[si])
-                    cell.append([(cx - s / 2) / iw, (cy - s / 2) / ih,
-                                 (cx + s / 2) / iw, (cy + s / 2) / ih])
+
+                def _max_box():
+                    if max_sizes:
+                        s = np.sqrt(ms * max_sizes[si])
+                        cell.append([(cx - s / 2) / iw, (cy - s / 2) / ih,
+                                     (cx + s / 2) / iw, (cy + s / 2) / ih])
+
+                def _ar_boxes(skip_one):
+                    for a in ars:
+                        if skip_one and abs(a - 1.0) < 1e-6:
+                            continue
+                        bw = ms * np.sqrt(a) / 2
+                        bh = ms / np.sqrt(a) / 2
+                        cell.append([(cx - bw) / iw, (cy - bh) / ih,
+                                     (cx + bw) / iw, (cy + bh) / ih])
+
+                if min_max_aspect_ratios_order:
+                    # reference flag: [min, max, other-ars]
+                    _min_box()
+                    _max_box()
+                    _ar_boxes(skip_one=True)
+                else:
+                    _ar_boxes(skip_one=False)
+                    _max_box()
             boxes.append(cell)
     out = np.asarray(boxes, np.float32).reshape(fh, fw, -1, 4)
     if clip:
@@ -454,9 +473,10 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
         y1 = jnp.clip(y1, 0, ih - 1)
         x2 = jnp.clip(x2, 0, iw - 1)
         y2 = jnp.clip(y2, 0, ih - 1)
-    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
-    mask = (conf > conf_thresh)[:, :, :, :, None]
-    scores = jnp.moveaxis(scores, 2, -1) * mask
+    keep = (conf > conf_thresh)[:, :, :, :, None]
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep
+    boxes = boxes.reshape(n, -1, 4)
+    scores = jnp.moveaxis(scores, 2, -1) * keep
     scores = scores.reshape(n, -1, class_num)
     return Tensor(boxes), Tensor(scores)
 
@@ -496,11 +516,18 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
             ious = np.asarray(_iou_matrix(jnp.asarray(bx), jnp.asarray(bx)))
             ious = np.triu(ious, 1)
             ious_cmax = ious.max(0)
-            if use_gaussian:
-                decay = np.exp(-(ious ** 2 - ious_cmax[None, :] ** 2)
-                               / gaussian_sigma).min(0)
-            else:
-                decay = ((1 - ious) / (1 - ious_cmax[None, :])).min(0)
+            # decay_j = min_i f(iou_ij, cmax_i): the compensation term is
+            # the HIGHER-scored box i's cmax (reference kernel
+            # matrix_nms_kernel.cc:64 decay_score)
+            comp = ious_cmax[:, None]
+            with np.errstate(divide="ignore", invalid="ignore"):
+                if use_gaussian:
+                    dmat = np.exp((comp ** 2 - ious ** 2) * gaussian_sigma)
+                else:
+                    dmat = (1.0 - ious) / (1.0 - comp)
+            # only pairs where i outranks j (upper triangle) decay j
+            dmat = np.where(np.triu(np.ones_like(dmat), 1) > 0, dmat, 1.0)
+            decay = dmat.min(0)
             dec = ss * decay
             for i, od in enumerate(order):
                 if dec[i] >= post_threshold:
@@ -534,16 +561,25 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     scale = np.sqrt(np.maximum(ws * hs, 1e-6))
     lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
     lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
-    multi, restore = [], np.zeros(len(rois), np.int32)
-    order = []
+    if rois_num is not None:
+        per_img = np.asarray(_val(rois_num)).ravel().tolist()
+    else:
+        per_img = [len(rois)]
+    img_of = np.repeat(np.arange(len(per_img)), per_img)
+    multi, order, nums = [], [], []
     for L in range(min_level, max_level + 1):
+        # within a level, keep image-major order and report per-image
+        # counts (reference: distribute_fpn_proposals rois_num path)
         idx = np.nonzero(lvl == L)[0]
+        idx = idx[np.argsort(img_of[idx], kind="stable")]
         multi.append(Tensor(jnp.asarray(rois[idx])))
         order.extend(idx.tolist())
-    restore[np.asarray(order, np.int32)] = np.arange(len(rois), dtype=np.int32)
-    nums = [Tensor(jnp.asarray(np.asarray([len(np.nonzero(lvl == L)[0])],
-                                          np.int32)))
-            for L in range(min_level, max_level + 1)]
+        nums.append(Tensor(jnp.asarray(np.asarray(
+            [int((img_of[idx] == im).sum()) for im in
+             range(len(per_img))], np.int32))))
+    restore = np.zeros(len(rois), np.int32)
+    restore[np.asarray(order, np.int32)] = np.arange(len(rois),
+                                                     dtype=np.int32)
     return multi, Tensor(jnp.asarray(restore.reshape(-1, 1))), nums
 
 

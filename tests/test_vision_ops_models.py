@@ -109,6 +109,12 @@ def test_matrix_nms():
                                  background_label=-1, return_index=True)
     assert int(num.numpy()[0]) >= 2  # both clusters survive
     assert out.shape[1] == 6
+    o = out.numpy()
+    # the overlapping duplicate's score decays; the far box keeps its own
+    decayed = {round(v, 3) for v in o[:, 1].tolist()}
+    assert 0.9 in decayed and 0.7 in decayed
+    dup = [v for v in o[:, 1] if 0 < v < 0.7]
+    assert dup, "duplicate box must be decayed below the far box"
 
 
 def test_distribute_fpn_proposals():
@@ -261,3 +267,47 @@ def test_image_backend_respected(tmp_path):
     assert isinstance(vision.image_load(p), Image.Image)
     vision.set_image_backend("cv2")
     assert isinstance(vision.image_load(p), np.ndarray)
+
+
+
+def test_prior_box_min_max_order():
+    feat = paddle.zeros([1, 8, 1, 1])
+    img = paddle.zeros([1, 3, 32, 32])
+    b1, _ = V.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                        aspect_ratios=[1.0, 2.0],
+                        min_max_aspect_ratios_order=True)
+    b2, _ = V.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                        aspect_ratios=[1.0, 2.0],
+                        min_max_aspect_ratios_order=False)
+    a1, a2 = b1.numpy().reshape(-1, 4), b2.numpy().reshape(-1, 4)
+    assert a1.shape == a2.shape
+    # same box set, different ordering
+    assert not np.allclose(a1, a2)
+    assert np.allclose(sorted(map(tuple, a1)), sorted(map(tuple, a2)))
+
+
+def test_distribute_fpn_rois_num():
+    rois = np.array([[0., 0., 10., 10.], [0., 0., 300., 300.],
+                     [0., 0., 12., 12.]], np.float32)
+    multi, restore, nums = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224,
+        rois_num=paddle.to_tensor(np.array([2, 1], np.int32)))
+    # level 2 holds the two small boxes: one from each image
+    assert nums[0].numpy().tolist() == [1, 1]
+    assert nums[2].numpy().tolist() == [1, 0]
+
+
+def test_yolo_box_zeroes_low_conf_boxes():
+    x = np.full((1, 2 * 8, 2, 2), -10.0, np.float32)  # conf sigmoid ~ 0
+    boxes, scores = V.yolo_box(paddle.to_tensor(x),
+                               paddle.to_tensor(np.array([[32, 32]],
+                                                         np.int32)),
+                               anchors=[10, 13, 16, 30], class_num=3,
+                               conf_thresh=0.5)
+    assert np.allclose(boxes.numpy(), 0.0)
+
+
+def test_data_parallel_is_class():
+    import paddle_tpu
+    assert isinstance(paddle_tpu.DataParallel(paddle.nn.Linear(2, 2)),
+                      paddle_tpu.DataParallel)
