@@ -1,1 +1,3 @@
 from paddle_tpu.incubate.nn import functional  # noqa: F401
+
+from paddle_tpu.incubate.nn.layer import *  # noqa: F401,F403
