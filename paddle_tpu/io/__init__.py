@@ -352,3 +352,19 @@ class DataLoader:
 
 def get_worker_info():
     return None
+
+
+class SubsetRandomSampler(Sampler):
+    """Sample randomly from a fixed index subset (reference:
+    io/dataloader/sampler.py SubsetRandomSampler)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import numpy as _np
+        perm = _np.random.permutation(len(self.indices))
+        return iter([self.indices[i] for i in perm])
+
+    def __len__(self):
+        return len(self.indices)

@@ -95,3 +95,17 @@ def load(path, **configs):
         state = {k: v._value if isinstance(v, Tensor) else np.asarray(v)
                  for k, v in sd.items()}
     return TranslatedLayer(exported, state)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """(reference: jit/dy2static/logging_utils.py set_verbosity) — maps to
+    a flag read by the tracing bridge."""
+    from paddle_tpu.core import flags
+    flags.set_flags({"FLAGS_jit_verbosity": int(level)})
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """(reference: logging_utils.py set_code_level). Tracing produces
+    jaxprs, not transformed source; the level is recorded for parity."""
+    from paddle_tpu.core import flags
+    flags.set_flags({"FLAGS_jit_code_level": int(level)})

@@ -88,3 +88,27 @@ def test_dist_to_static_eval_path():
     dm.eval()
     out = dm(paddle.to_tensor(np.ones((2, 4), np.float32)))
     assert out.shape == [2, 2]
+
+
+def test_io_jit_surface_complete():
+    import importlib
+    for ref_path, mod_name in [
+            ('/root/reference/python/paddle/io/__init__.py',
+             'paddle_tpu.io'),
+            ('/root/reference/python/paddle/jit/__init__.py',
+             'paddle_tpu.jit'),
+            ('/root/reference/python/paddle/amp/__init__.py',
+             'paddle_tpu.amp')]:
+        ref = open(ref_path).read()
+        m = re.search(r"__all__\s*=\s*\[(.*?)\]", ref, re.S)
+        names = set(re.findall(r"'([\w]+)'", m.group(1)))
+        mod = importlib.import_module(mod_name)
+        missing = sorted(n for n in names if not hasattr(mod, n))
+        assert not missing, (mod_name, missing)
+
+
+def test_subset_random_sampler():
+    from paddle_tpu.io import SubsetRandomSampler
+    s = SubsetRandomSampler([3, 5, 9])
+    got = sorted(list(iter(s)))
+    assert got == [3, 5, 9] and len(s) == 3
