@@ -362,9 +362,9 @@ class SubsetRandomSampler(Sampler):
         self.indices = list(indices)
 
     def __iter__(self):
-        import numpy as _np
-        perm = _np.random.permutation(len(self.indices))
-        return iter([self.indices[i] for i in perm])
+        rng = np.random.default_rng()
+        return iter([self.indices[i]
+                     for i in rng.permutation(len(self.indices))])
 
     def __len__(self):
         return len(self.indices)

@@ -98,8 +98,10 @@ def load(path, **configs):
 
 
 def set_verbosity(level=0, also_to_stdout=False):
-    """(reference: jit/dy2static/logging_utils.py set_verbosity) — maps to
-    a flag read by the tracing bridge."""
+    """(reference: jit/dy2static/logging_utils.py set_verbosity). Recorded
+    for parity: jit tracing emits jaxprs, not transformed source, so there
+    is no transform log to verbose-print; the flag is queryable via
+    paddle.get_flags."""
     from paddle_tpu.core import flags
     flags.set_flags({"FLAGS_jit_verbosity": int(level)})
 
