@@ -125,6 +125,16 @@ def random_split(dataset, lengths, generator=None):
 # ---------------------------------------------------------------------------
 # Samplers (reference: io/dataloader/sampler.py, batch_sampler.py)
 # ---------------------------------------------------------------------------
+def _seeded_rng():
+    """numpy Generator derived from the framework RNG so
+    paddle_tpu.seed(...) makes sampler order reproducible while staying
+    isolated from numpy's global state."""
+    import jax as _jax
+    key = next_key()
+    data = _jax.random.key_data(key)
+    return np.random.default_rng(int(np.asarray(data).ravel()[-1]))
+
+
 class Sampler:
     def __init__(self, data_source=None):
         self.data_source = data_source
@@ -154,7 +164,7 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
-        rng = np.random.default_rng()
+        rng = _seeded_rng()
         if self.replacement:
             return iter(rng.integers(0, n, self.num_samples).tolist())
         return iter(rng.permutation(n)[: self.num_samples].tolist())
@@ -362,7 +372,7 @@ class SubsetRandomSampler(Sampler):
         self.indices = list(indices)
 
     def __iter__(self):
-        rng = np.random.default_rng()
+        rng = _seeded_rng()
         return iter([self.indices[i]
                      for i in rng.permutation(len(self.indices))])
 

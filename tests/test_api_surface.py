@@ -112,3 +112,14 @@ def test_subset_random_sampler():
     s = SubsetRandomSampler([3, 5, 9])
     got = sorted(list(iter(s)))
     assert got == [3, 5, 9] and len(s) == 3
+
+
+def test_samplers_reproducible_with_framework_seed():
+    from paddle_tpu.io import SubsetRandomSampler
+    paddle.seed(42)
+    a = list(iter(SubsetRandomSampler(list(range(20)))))
+    paddle.seed(42)
+    b = list(iter(SubsetRandomSampler(list(range(20)))))
+    assert a == b
+    c = list(iter(SubsetRandomSampler(list(range(20)))))
+    assert a != c  # subsequent epochs reshuffle

@@ -1,0 +1,34 @@
+"""tools/op_bench.py CI gate (reference: tools/ci_op_benchmark.sh +
+check_op_benchmark_result.py contract)."""
+import json
+import subprocess
+import sys
+
+
+def test_op_bench_run_and_check(tmp_path):
+    base = tmp_path / "base.json"
+    out = subprocess.run(
+        [sys.executable, "tools/op_bench.py", "run", "--out", str(base),
+         "--ops", "add,reduce_sum"],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert out.returncode == 0, out.stderr
+    rec = json.load(open(base))
+    assert "add" in rec["ops"] and rec["ops"]["add"]["ms"] > 0
+
+    # identical files pass the gate
+    ok = subprocess.run(
+        [sys.executable, "tools/op_bench.py", "check", "--base", str(base),
+         "--new", str(base)], capture_output=True, text=True,
+        cwd="/root/repo")
+    assert ok.returncode == 0 and "within threshold" in ok.stdout
+
+    # an injected regression fails it
+    slow = dict(rec)
+    slow["ops"] = {k: {**v, "ms": v["ms"] * 2} for k, v in rec["ops"].items()}
+    slow_p = tmp_path / "slow.json"
+    json.dump(slow, open(slow_p, "w"))
+    bad = subprocess.run(
+        [sys.executable, "tools/op_bench.py", "check", "--base", str(base),
+         "--new", str(slow_p)], capture_output=True, text=True,
+        cwd="/root/repo")
+    assert bad.returncode == 1 and "REGRESSION" in bad.stdout

@@ -158,12 +158,13 @@ class TestModels:
         ds = datasets.FakeData(num_samples=64, image_shape=(1, 28, 28),
                                num_classes=4)
         model = paddle.Model(models.LeNet(num_classes=4))
-        opt = paddle.optimizer.Adam(learning_rate=0.01,
+        # lr 3e-3: 1e-2 oscillates for some seeds on this tiny set
+        opt = paddle.optimizer.Adam(learning_rate=0.003,
                                     parameters=model.parameters())
         model.prepare(opt, paddle.nn.CrossEntropyLoss(),
                       paddle.metric.Accuracy())
-        hist = model.fit(ds, epochs=2, batch_size=16, verbose=0)
-        assert hist["loss"][-1] < hist["loss"][0]
+        hist = model.fit(ds, epochs=5, batch_size=16, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0] * 0.5, hist["loss"]
 
     def test_pretrained_raises(self):
         with pytest.raises(RuntimeError):

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Op micro-benchmark runner with regression gating (reference:
+tools/ci_op_benchmark.sh + tools/check_op_benchmark_result.py — the
+reference CI runs op benchmarks per PR and fails on relative
+regressions; this is the paddle_tpu equivalent over the defop registry).
+
+Usage:
+  python tools/op_bench.py run  [--out results.json] [--ops add,matmul]
+  python tools/op_bench.py check --base base.json --new results.json \
+      [--threshold 0.15]
+
+`run` times a curated set of representative ops on the current backend
+and writes {op: {shape, ms}} JSON. `check` compares two result files and
+exits 1 if any op slowed down by more than the threshold (the reference's
+check_op_benchmark_result.py contract).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable as `python tools/op_bench.py` from the repo root without install
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _cases():
+    import numpy as np
+    import paddle_tpu as paddle
+
+    rng = np.random.RandomState(0)
+    a2 = paddle.to_tensor(rng.randn(1024, 1024).astype(np.float32))
+    b2 = paddle.to_tensor(rng.randn(1024, 1024).astype(np.float32))
+    v = paddle.to_tensor(rng.randn(4, 512, 1024).astype(np.float32))
+    ids = paddle.to_tensor(rng.randint(0, 32000, (8, 512)))
+    emb_w = paddle.to_tensor(rng.randn(32000, 256).astype(np.float32))
+    q = paddle.to_tensor(rng.randn(2, 512, 8, 64).astype(np.float32))
+
+    from paddle_tpu.nn import functional as F
+    return {
+        "matmul_1k": ("1024x1024 @ 1024x1024",
+                      lambda: paddle.matmul(a2, b2)),
+        "add": ("1024x1024 + 1024x1024", lambda: a2 + b2),
+        "softmax": ("(4,512,1024) softmax", lambda: F.softmax(v, axis=-1)),
+        "layer_norm": ("(4,512,1024) layer_norm",
+                       lambda: F.layer_norm(v, [1024])),
+        "gelu": ("(4,512,1024) gelu", lambda: F.gelu(v)),
+        "embedding": ("(8,512) gather of (32000,256)",
+                      lambda: F.embedding(ids, emb_w)),
+        "sdpa_causal": ("(2,512,8,64) causal attention",
+                        lambda: F.scaled_dot_product_attention(
+                            q, q, q, is_causal=True)),
+        "reduce_sum": ("(4,512,1024) sum", lambda: v.sum()),
+        "transpose": ("(4,512,1024) transpose",
+                      lambda: paddle.transpose(v, [0, 2, 1])),
+        "cumsum": ("(4,512,1024) cumsum",
+                   lambda: paddle.cumsum(v, axis=-1)),
+    }
+
+
+def _time_one(fn, warmup=2, iters=10):
+    import numpy as np
+    out = None
+    for _ in range(warmup):
+        out = fn()
+    leaf = out[0] if isinstance(out, (tuple, list)) else out
+    np.asarray(leaf._value)  # sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    leaf = out[0] if isinstance(out, (tuple, list)) else out
+    np.asarray(leaf._value)
+    return (time.perf_counter() - t0) / iters * 1000.0
+
+
+def cmd_run(args):
+    import jax
+    cases = _cases()
+    selected = (set(args.ops.split(",")) if args.ops else set(cases))
+    results = {"device": str(jax.devices()[0]), "ops": {}}
+    for name, (desc, fn) in cases.items():
+        if name not in selected:
+            continue
+        ms = _time_one(fn)
+        results["ops"][name] = {"shape": desc, "ms": round(ms, 4)}
+        print(f"{name:14s} {ms:8.3f} ms   ({desc})")
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"wrote {args.out}")
+    return 0
+
+
+def cmd_check(args):
+    base = json.load(open(args.base))["ops"]
+    new = json.load(open(args.new))["ops"]
+    failures = []
+    for name, rec in new.items():
+        if name not in base:
+            continue
+        ratio = rec["ms"] / max(base[name]["ms"], 1e-9)
+        status = "OK"
+        if ratio > 1 + args.threshold:
+            status = "REGRESSION"
+            failures.append((name, ratio))
+        print(f"{name:14s} base={base[name]['ms']:8.3f} "
+              f"new={rec['ms']:8.3f} x{ratio:5.2f}  {status}")
+    if failures:
+        print(f"FAILED: {len(failures)} op(s) regressed beyond "
+              f"{args.threshold:.0%}: "
+              + ", ".join(f"{n} (x{r:.2f})" for n, r in failures))
+        return 1
+    print("all ops within threshold")
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="op_bench")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pr = sub.add_parser("run")
+    pr.add_argument("--out", default="op_bench_results.json")
+    pr.add_argument("--ops", default=None)
+    pc = sub.add_parser("check")
+    pc.add_argument("--base", required=True)
+    pc.add_argument("--new", required=True)
+    pc.add_argument("--threshold", type=float, default=0.15)
+    args = p.parse_args(argv)
+    return cmd_run(args) if args.cmd == "run" else cmd_check(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
