@@ -18,6 +18,8 @@ from paddle_tpu.distributed.fleet.topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup)
 from paddle_tpu.distributed.fleet import layers  # noqa: F401
 from paddle_tpu.distributed.fleet.strategy import DistributedStrategy  # noqa: F401
+from paddle_tpu.distributed.fleet import utils  # noqa: F401
+from paddle_tpu.distributed.recompute import recompute  # noqa: F401
 
 __all__ = ["init", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",

@@ -1,0 +1,20 @@
+"""fleet.utils (reference: python/paddle/distributed/fleet/utils/
+__init__.py — recompute re-export + hybrid parallel helpers).
+"""
+from paddle_tpu.distributed.recompute import recompute  # noqa: F401
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """(reference: fleet/utils/__init__.py recompute_sequential) — apply
+    recompute over a Sequential's sublayers in segments. Each segment is
+    wrapped as a Layer (not a closure) so recompute() sees the segment's
+    parameters and gradients flow to them."""
+    from paddle_tpu import nn
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    seg_size = max(len(layers) // max(segments, 1), 1)
+    x = args[0] if len(args) == 1 else args
+    for i in range(0, len(layers), seg_size):
+        seg = nn.Sequential(*layers[i:i + seg_size])
+        x = recompute(seg, x)
+    return x

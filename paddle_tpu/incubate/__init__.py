@@ -9,3 +9,127 @@ from paddle_tpu.incubate import nn  # noqa: F401
 from paddle_tpu.incubate import asp  # noqa: F401
 from paddle_tpu.incubate import optimizer  # noqa: F401
 from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage  # noqa: F401
+
+
+# legacy graph-op aliases (reference: incubate/__init__.py re-exports of
+# the pre-paddle.geometric API)
+from paddle_tpu.geometric import (  # noqa: F401,E402
+    segment_sum, segment_mean, segment_min, segment_max)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    from paddle_tpu.geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, flag_buffer_hashtable=False,
+                  name=None):
+    from paddle_tpu.geometric import reindex_graph
+    return reindex_graph(x, neighbors, count)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from paddle_tpu.geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference:
+    incubate/operators/graph_khop_sampler.py:123 — returns
+    (edge_src, edge_dst, sample_index, reindex_nodes): locally-reindexed
+    edges over the union subgraph, the union's global node ids, and the
+    local ids of the seed nodes)."""
+    if return_eids:
+        raise NotImplementedError("return_eids unsupported in khop sampler")
+    from paddle_tpu.geometric import sample_neighbors
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+
+    seeds = np.asarray(input_nodes._value
+                       if isinstance(input_nodes, Tensor)
+                       else input_nodes).ravel()
+    seen = dict((int(n), i) for i, n in enumerate(seeds))
+    union = list(seeds)
+    frontier = seeds
+    src_g, dst_g = [], []
+    for k in sample_sizes:
+        if len(frontier) == 0:
+            break
+        nb, cnt = sample_neighbors(row, colptr,
+                                   Tensor(jnp.asarray(frontier,
+                                                      jnp.int32)),
+                                   sample_size=k)
+        nb_np = np.asarray(nb._value)
+        cnt_np = np.asarray(cnt._value)
+        dst_np = np.repeat(frontier, cnt_np)
+        src_g.append(nb_np)
+        dst_g.append(dst_np)
+        nxt = []
+        for n in nb_np:
+            n = int(n)
+            if n not in seen:
+                seen[n] = len(union)
+                union.append(n)
+                nxt.append(n)
+        # next frontier: only NEW nodes (reference khop semantics —
+        # already-visited nodes are not re-expanded)
+        frontier = np.asarray(nxt, seeds.dtype)
+    all_src = (np.concatenate(src_g) if src_g
+               else np.zeros(0, np.int64))
+    all_dst = (np.concatenate(dst_g) if dst_g
+               else np.zeros(0, np.int64))
+    edge_src = np.asarray([seen[int(n)] for n in all_src], np.int32)
+    edge_dst = np.asarray([seen[int(n)] for n in all_dst], np.int32)
+    sample_index = np.asarray(union, np.int32)
+    reindex_nodes = np.arange(len(seeds), dtype=np.int32)
+    return (Tensor(jnp.asarray(edge_src)), Tensor(jnp.asarray(edge_dst)),
+            Tensor(jnp.asarray(sample_index)),
+            Tensor(jnp.asarray(reindex_nodes)))
+
+
+def identity_loss(x, reduction="none"):
+    """(reference: incubate/nn/functional/identity_loss.py — marks a
+    tensor as the loss for IPU; on TPU it is reduce-or-pass-through)."""
+    from paddle_tpu import tensor as T
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("mean", 1):
+        return T.mean(x)
+    if reduction in ("sum", 0):
+        return T.sum(x)
+    raise ValueError(f"unknown reduction {reduction!r}: expected "
+                     f"sum/mean/none (0/1/2)")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """(reference: incubate/operators/softmax_mask_fuse.py — fused
+    softmax(x + mask); XLA fuses the composition)."""
+    from paddle_tpu.core.dispatch import dispatch, OpDef
+    import jax
+    return dispatch(OpDef("softmax_mask_fuse",
+                          lambda a, m: jax.nn.softmax(a + m, axis=-1)),
+                    (x, mask), {})
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """(reference: softmax_mask_fuse_upper_triangle — causal-masked
+    softmax without an explicit mask tensor)."""
+    from paddle_tpu.core.dispatch import dispatch, OpDef
+    import jax
+    import jax.numpy as jnp
+
+    def f(a):
+        s = a.shape[-1]
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(jnp.where(causal, a, -1e9), axis=-1)
+    return dispatch(OpDef("softmax_mask_fuse_upper_triangle", f), (x,), {})

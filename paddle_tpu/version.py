@@ -1,0 +1,23 @@
+"""paddle.version (reference: generated python/paddle/version/__init__.py)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "tpu-native-rebuild"
+cuda_version = "False"
+cudnn_version = "False"
+istaged = False
+
+
+def show():
+    print(f"paddle_tpu {full_version} (commit {commit}); cuda: off, "
+          f"backend: XLA/TPU")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -79,6 +79,11 @@ def cmd_run(args):
     import jax
     cases = _cases()
     selected = (set(args.ops.split(",")) if args.ops else set(cases))
+    unknown = selected - set(cases)
+    if unknown:
+        print(f"unknown op(s): {sorted(unknown)}; available: "
+              f"{sorted(cases)}")
+        return 2
     results = {"device": str(jax.devices()[0]), "ops": {}}
     for name, (desc, fn) in cases.items():
         if name not in selected:
@@ -95,6 +100,15 @@ def cmd_run(args):
 def cmd_check(args):
     base = json.load(open(args.base))["ops"]
     new = json.load(open(args.new))["ops"]
+    common = set(base) & set(new)
+    if not common:
+        print("FAILED: no ops in common between base and new results — "
+              "the gate would be vacuous")
+        return 1
+    dropped = sorted(set(base) - set(new))
+    if dropped:
+        print(f"WARNING: ops present in base but missing from new "
+              f"(renamed/removed?): {dropped}")
     failures = []
     for name, rec in new.items():
         if name not in base:
