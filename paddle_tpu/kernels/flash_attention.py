@@ -1,18 +1,32 @@
-"""FlashAttention for TPU (Pallas).
+"""FlashAttention for TPU (Pallas), forward + backward kernels.
 
 Replaces the reference's vendored FA2 CUDA library (reference:
 third_party/flashattn + paddle/phi/kernels/gpu/flash_attn_kernel.cu,
 python surface python/paddle/nn/functional/flash_attention.py) with a
-TPU-native pair:
+TPU-native implementation:
 
 - forward: a Pallas kernel — one grid cell per (batch, head, q-block),
   online-softmax accumulation over k/v blocks streamed through VMEM, MXU
   matmuls in f32 accumulation. Causal cells whose k-block lies entirely
-  above the diagonal are skipped via the loop bound.
-- backward: rematerialising chunked attention (lax.scan over k/v blocks
-  with jax.checkpoint per block) differentiated by JAX AD. Exact same math
-  as the forward, O(S·D) residual memory — the FA2 recompute strategy
-  expressed as a program transform instead of a second handwritten kernel.
+  above the diagonal are skipped via the loop bound. Also emits the
+  row logsumexp (LSE) for the backward pass, lane-replicated to 128
+  (the TPU min tile width) like jax's reference TPU kernel.
+- backward: two Pallas kernels in FA2 style —
+    dq: grid (b, h, q-block); recompute p from q,k and the saved LSE,
+        ds = p * (dO·vT - delta), accumulate dq += ds @ k.
+    dkv: grid (b, h, k-block); loop over q-blocks at/below the diagonal,
+        dv += p^T·dO and dk += ds^T·q with f32 accumulators carried
+        through the loop.
+  delta = rowsum(dO * O) is precomputed in XLA (one fused pass).
+- CPU fallback (and the bwd-of-bwd path): rematerialising chunked
+  attention (lax.scan over k/v blocks with jax.checkpoint) differentiated
+  by JAX AD — exact same math with O(S·D) residual memory.
+
+Known limit: each grid cell streams the full opposing sequence through
+VMEM (k/v in the forward; q/dO/lse/delta in dkv), which bounds single-call
+seq length to VMEM/~1.5KB (bf16 d=64: ~10K tokens). Longer sequences go
+through the ring/context-parallel path (distributed/context_parallel.py),
+which shards the sequence before the kernel sees it.
 
 Layouts: public entry takes paddle's (batch, seq, heads, head_dim).
 """
@@ -27,6 +41,17 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+_LANES = 128  # TPU min tile width; LSE/delta are lane-replicated to this
+
+
+def _prec(dtype):
+    """MXU precision: bf16/f16 operands use the native one-pass mode (full
+    rate, f32 accumulation); f32 operands keep exact f32. The package-global
+    'highest' default would emulate bf16 matmuls in f32 at a fraction of
+    the rate."""
+    return (jax.lax.Precision.DEFAULT
+            if dtype in (jnp.bfloat16, jnp.float16)
+            else jax.lax.Precision.HIGHEST)
 
 
 def _pick_block(seq, target):
@@ -39,23 +64,19 @@ def _pick_block(seq, target):
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_k,
-                kv_valid):
-    # k arrives pre-transposed as (1, 1, d, sk) so the q @ k dot uses the
-    # standard (1),(0) contraction — Mosaic only lowers bf16 matmuls in
-    # that form
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                block_k, kv_valid):
+    # lse_ref is None on the inference path (save_lse=False): the LSE
+    # write is only needed as the backward's softmax residual
+    # k arrives pre-transposed as (1, 1, d, sk): the (1),(0) contraction is
+    # the fastest Mosaic form for the hot q @ k dot. ((1,),(1,)) also
+    # lowers for bf16 — the backward kernels use it (verified on v5e).
     bq, d = q_ref.shape[2], q_ref.shape[3]
     kv_pad = k_ref.shape[3]
     iq = pl.program_id(2)
 
-    # keep operands in the input dtype (bf16): the MXU multiplies bf16 at
-    # full rate with f32 accumulation; upcasting operands to f32 halves
-    # throughput. f32 inputs keep HIGHEST precision (exact f32) — only
-    # bf16/f16 operands use the native one-pass mode.
     q = (q_ref[0, 0] * jnp.asarray(sm_scale, q_ref.dtype))
-    prec = (jax.lax.Precision.DEFAULT
-            if q_ref.dtype in (jnp.bfloat16, jnp.float16)
-            else jax.lax.Precision.HIGHEST)
+    prec = _prec(q_ref.dtype)
 
     nk_total = kv_pad // block_k
     if causal:
@@ -97,11 +118,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_k,
     acc0 = jnp.zeros((bq, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if lse_ref is not None:
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))
+        lse_ref[0, 0] = jnp.broadcast_to(lse, (bq, _LANES))
 
 
 def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=512, block_k=512,
-                      interpret=False):
-    """q,k,v: (B, H, S, D) with equal head counts. Returns (B, H, Sq, D)."""
+                      interpret=False, save_lse=True):
+    """q,k,v: (B, H, S, D) with equal head counts.
+    Returns (out (B,H,Sq,D), lse (B,H,Sq_pad,128) f32 | None)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq = min(block_q, sq)
@@ -118,24 +143,199 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=512, block_k=512,
     kt = jnp.swapaxes(k, 2, 3)   # (b, h, d, sk): XLA fuses the transpose
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
                                causal=causal, block_k=bk, kv_valid=sk)
-    out = pl.pallas_call(
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0))
+    out_specs = [qspec]
+    out_shape = [jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype)]
+    if save_lse:
+        out_specs.append(pl.BlockSpec((1, 1, bq, _LANES),
+                                      lambda bi, hi, qi: (bi, hi, qi, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b, h, sq_p, _LANES), jnp.float32))
+    else:
+        kernel = functools.partial(
+            lambda q_ref, k_ref, v_ref, o_ref, kern: kern(
+                q_ref, k_ref, v_ref, o_ref, None), kern=kernel)
+    outs = pl.pallas_call(
         kernel,
         grid=(b, h, sq_p // bq),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            qspec,
             pl.BlockSpec((1, 1, d, sk_p), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, sk_p, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, d),
-                               lambda bi, hi, qi: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(q, kt, v)
-    return out[:, :, :sq, :]
+    out = outs[0]
+    lse = outs[1] if save_lse else None
+    return out[:, :, :sq, :], lse
 
 
 # ---------------------------------------------------------------------------
-# Chunked (blockwise) attention in pure jax — backward path + CPU fallback
+# Pallas backward kernels (FA2: recompute p from LSE, no O(S^2) residuals)
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, sm_scale, causal, block_k, kv_valid):
+    bq, d = q_ref.shape[2], q_ref.shape[3]
+    kv_pad = k_ref.shape[2]
+    iq = pl.program_id(2)
+
+    q = (q_ref[0, 0] * jnp.asarray(sm_scale, q_ref.dtype))
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0, :, :1]                     # (bq, 1) f32
+    delta = delta_ref[0, 0, :, :1]                 # (bq, 1) f32
+    prec = _prec(q_ref.dtype)
+
+    nk_total = kv_pad // block_k
+    if causal:
+        nk = jnp.minimum(((iq + 1) * bq + block_k - 1) // block_k, nk_total)
+    else:
+        nk = nk_total
+
+    def body(j, acc):
+        kj = k_ref[0, 0, pl.ds(j * block_k, block_k), :]   # (bk, d)
+        vj = v_ref[0, 0, pl.ds(j * block_k, block_k), :]   # (bk, d)
+        s = jax.lax.dot_general(
+            q, kj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (bq, bk)
+        col = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1) \
+            + j * block_k
+        valid = col < kv_valid
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0) \
+                + iq * bq
+            valid = jnp.logical_and(valid, col <= row)
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                    # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, vj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (bq, bk)
+        ds = p * (dp - delta) * sm_scale
+        return acc + jax.lax.dot_general(
+            ds.astype(kj.dtype), kj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (bq, d)
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    acc = jax.lax.fori_loop(0, nk, body, acc0)
+    dq_ref[0, 0] = acc.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, sm_scale, causal, block_q,
+                    q_valid, kv_valid):
+    bk, d = k_ref.shape[2], k_ref.shape[3]
+    q_pad = q_ref.shape[2]
+    ik = pl.program_id(2)
+
+    k = k_ref[0, 0]                                # (bk, d)
+    v = v_ref[0, 0]                                # (bk, d)
+    prec = _prec(q_ref.dtype)
+
+    nq_total = q_pad // block_q
+    if causal:
+        # first q-block whose rows reach this k-block's columns
+        j0 = (ik * bk) // block_q
+    else:
+        j0 = 0
+
+    def body(j, carry):
+        dk_acc, dv_acc = carry
+        qj = (q_ref[0, 0, pl.ds(j * block_q, block_q), :]
+              * jnp.asarray(sm_scale, q_ref.dtype))             # (bq, d)
+        doj = do_ref[0, 0, pl.ds(j * block_q, block_q), :]      # (bq, d)
+        lse = lse_ref[0, 0, pl.ds(j * block_q, block_q), :1]    # (bq, 1)
+        delta = delta_ref[0, 0, pl.ds(j * block_q, block_q), :1]
+        s = jax.lax.dot_general(
+            qj, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (bq, bk)
+        col = jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1) \
+            + ik * bk
+        row = jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0) \
+            + j * block_q
+        valid = jnp.logical_and(col < kv_valid, row < q_valid)
+        if causal:
+            valid = jnp.logical_and(valid, col <= row)
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                    # (bq, bk)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p.T.astype(doj.dtype), doj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (bk, d)
+        dp = jax.lax.dot_general(
+            doj, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (bq, bk)
+        ds = p * (dp - delta) * sm_scale                         # (bq, bk)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds.T.astype(qj.dtype), qj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (bk, d)
+        return dk_acc, dv_acc
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(j0, nq_total, body, (z, z))
+    # undo the sm_scale folded into qj when accumulating dk (dk = ds^T @ q,
+    # with q unscaled; qj above was pre-scaled for the s recompute)
+    dk_ref[0, 0] = (dk_acc / sm_scale).astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
+                      block_q=512, block_k=512, interpret=False):
+    """FA2 backward. q,k,v,o,g: (B,H,S,D); lse: (B,H,Sq_pad,128) f32."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    sq_p = (sq + bq - 1) // bq * bq
+    sk_p = (sk + bk - 1) // bk * bk
+
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (_LANES,))
+    if sq_p != sq:
+        pad = ((0, 0), (0, 0), (0, sq_p - sq), (0, 0))
+        q = jnp.pad(q, pad)
+        g = jnp.pad(g, pad)
+        delta = jnp.pad(delta, pad)
+    if sk_p != sk:
+        pad = ((0, 0), (0, 0), (0, sk_p - sk), (0, 0))
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0))
+    kfull = pl.BlockSpec((1, 1, sk_p, d), lambda bi, hi, qi: (bi, hi, 0, 0))
+    lspec = pl.BlockSpec((1, 1, bq, _LANES),
+                         lambda bi, hi, qi: (bi, hi, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_k=bk, kv_valid=sk),
+        grid=(b, h, sq_p // bq),
+        in_specs=[qspec, kfull, kfull, qspec, lspec, lspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    kspec = pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi, ki, 0))
+    qfull = pl.BlockSpec((1, 1, sq_p, d), lambda bi, hi, ki: (bi, hi, 0, 0))
+    lfull = pl.BlockSpec((1, 1, sq_p, _LANES),
+                         lambda bi, hi, ki: (bi, hi, 0, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, q_valid=sq, kv_valid=sk),
+        grid=(b, h, sk_p // bk),
+        in_specs=[qfull, kspec, kspec, qfull, lfull, lfull],
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk_p, d), v.dtype)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    return (dq[:, :, :sq, :], dk[:, :, :sk, :], dv[:, :, :sk, :])
+
+
+# ---------------------------------------------------------------------------
+# Chunked (blockwise) attention in pure jax — CPU fallback path
 # ---------------------------------------------------------------------------
 
 def _chunked_attention(q, k, v, causal, sm_scale, block_q=512, block_k=512):
@@ -158,9 +358,7 @@ def _chunked_attention(q, k, v, causal, sm_scale, block_q=512, block_k=512):
 
     @jax.checkpoint
     def block(qi, kj, vj, iq, jk):
-        prec = (jax.lax.Precision.DEFAULT
-                if qi.dtype in (jnp.bfloat16, jnp.float16)
-                else jax.lax.Precision.HIGHEST)
+        prec = _prec(qi.dtype)
         qf = qi * jnp.asarray(sm_scale, qi.dtype)
         s = jnp.einsum("...qd,...kd->...qk", qf, kj,
                        preferred_element_type=jnp.float32,
@@ -216,16 +414,23 @@ def _on_tpu():
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, causal, sm_scale):
     if _on_tpu():
-        return _flash_fwd_pallas(q, k, v, causal, sm_scale)
+        return _flash_fwd_pallas(q, k, v, causal, sm_scale,
+                                 save_lse=False)[0]
     return _chunked_attention(q, k, v, causal, sm_scale)
 
 
 def _flash_fwd_rule(q, k, v, causal, sm_scale):
-    return _flash(q, k, v, causal, sm_scale), (q, k, v)
+    if _on_tpu():
+        out, lse = _flash_fwd_pallas(q, k, v, causal, sm_scale)
+        return out, (q, k, v, out, lse)
+    return _chunked_attention(q, k, v, causal, sm_scale), (q, k, v, None,
+                                                          None)
 
 
 def _flash_bwd_rule(causal, sm_scale, res, g):
-    q, k, v = res
+    q, k, v, o, lse = res
+    if lse is not None:
+        return _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale)
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _chunked_attention(q_, k_, v_, causal, sm_scale),
         q, k, v)

@@ -52,8 +52,8 @@ def test_pallas_kernel_interpret(causal):
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.repeat(jnp.swapaxes(k, 1, 2), 2, axis=1)
     vh = jnp.repeat(jnp.swapaxes(v, 1, 2), 2, axis=1)
-    out = fa._flash_fwd_pallas(qh, kh, vh, causal, 1.0 / np.sqrt(32),
-                               block_q=32, block_k=32, interpret=True)
+    out, _ = fa._flash_fwd_pallas(qh, kh, vh, causal, 1.0 / np.sqrt(32),
+                                  block_q=32, block_k=32, interpret=True)
     np.testing.assert_allclose(np.asarray(jnp.swapaxes(ref, 1, 2)),
                                np.asarray(out), rtol=1e-5, atol=1e-5)
 
@@ -65,10 +65,54 @@ def test_pallas_kernel_ragged_seq_interpret():
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.repeat(jnp.swapaxes(k, 1, 2), 2, axis=1)
     vh = jnp.repeat(jnp.swapaxes(v, 1, 2), 2, axis=1)
-    out = fa._flash_fwd_pallas(qh, kh, vh, True, 1.0 / np.sqrt(32),
-                               block_q=32, block_k=32, interpret=True)
+    out, _ = fa._flash_fwd_pallas(qh, kh, vh, True, 1.0 / np.sqrt(32),
+                                  block_q=32, block_k=32, interpret=True)
     np.testing.assert_allclose(np.asarray(jnp.swapaxes(ref, 1, 2)),
                                np.asarray(out), rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_fwd_no_lse_interpret():
+    """The inference path (save_lse=False) must match the training path."""
+    q, k, v = _qkv(s=64)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.repeat(jnp.swapaxes(k, 1, 2), 2, axis=1)
+    vh = jnp.repeat(jnp.swapaxes(v, 1, 2), 2, axis=1)
+    sm = 1.0 / np.sqrt(32)
+    o1, lse = fa._flash_fwd_pallas(qh, kh, vh, True, sm, block_q=32,
+                                   block_k=32, interpret=True)
+    o2, no_lse = fa._flash_fwd_pallas(qh, kh, vh, True, sm, block_q=32,
+                                      block_k=32, interpret=True,
+                                      save_lse=False)
+    assert lse is not None and no_lse is None
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("s", [64, 50])
+def test_pallas_bwd_kernels_interpret(causal, s):
+    """dq/dkv Pallas kernels vs jax AD of reference attention, on CPU via
+    the Pallas interpreter (covers padding + causal masking)."""
+    q, k, v = _qkv(s=s)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.repeat(jnp.swapaxes(k, 1, 2), 2, axis=1)
+    vh = jnp.repeat(jnp.swapaxes(v, 1, 2), 2, axis=1)
+    sm = 1.0 / np.sqrt(32)
+
+    out, lse = fa._flash_fwd_pallas(qh, kh, vh, causal, sm,
+                                    block_q=32, block_k=32, interpret=True)
+    g = jnp.ones_like(out) * 0.3
+    dq, dk, dv = fa._flash_bwd_pallas(qh, kh, vh, out, lse, g, causal, sm,
+                                      block_q=32, block_k=32, interpret=True)
+
+    def ref_loss(qh, kh, vh):
+        r = _sdpa_ref(jnp.swapaxes(qh, 1, 2), jnp.swapaxes(kh, 1, 2),
+                      jnp.swapaxes(vh, 1, 2), is_causal=causal)
+        return jnp.sum(jnp.swapaxes(r, 1, 2) * g)
+
+    rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(qh, kh, vh)
+    for a, b in zip((dq, dk, dv), (rq, rk, rv)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
 
 
 def test_bf16_fwd():
