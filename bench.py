@@ -89,13 +89,14 @@ def main():
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     data = {"input_ids": ids, "labels": ids}
 
-    trainer.step(data)  # compile + warmup
-    jax.block_until_ready(trainer.params)
+    # warmup + compile; float() forces a real device sync (through the
+    # axon tunnel jax.block_until_ready returns before execution finishes)
+    float(trainer.step(data))
 
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = trainer.step(data)
-    jax.block_until_ready(trainer.params)
+    loss = float(loss)  # sync: the last step's outputs close the chain
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * steps / dt

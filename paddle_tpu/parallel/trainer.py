@@ -198,9 +198,12 @@ class Trainer:
         return jax.jit(step, donate_argnums=donate)
 
     # -- public API --------------------------------------------------------
-    def step(self, batch: dict) -> float:
+    def step(self, batch: dict) -> Tensor:
         """One optimizer step on `batch` (dict of np/jax arrays or Tensors).
-        Returns the scalar loss."""
+        Returns the scalar loss as a lazy Tensor: steps dispatch
+        asynchronously and only reading the value (float()/numpy()) blocks.
+        Through the axon tunnel a per-step host sync costs ~100ms, so the
+        old eager float() here serialized dispatch against execution."""
         batch = {k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
                  for k, v in batch.items()}
         if self.mesh is not None:
@@ -217,7 +220,7 @@ class Trainer:
         loss, self.params, self.opt_state = self._step_fn(
             self.params, self.opt_state, lr, batch)
         self.optimizer._step_count += 1
-        return float(loss)
+        return Tensor(loss, stop_gradient=True)
 
     def _lr_value(self):
         return self.optimizer._lr_value()
