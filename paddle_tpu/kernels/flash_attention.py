@@ -43,6 +43,14 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 _LANES = 128  # TPU min tile width; LSE/delta are lane-replicated to this
 
+# Tuning knobs (swept on v5e: (512,512) best in the full train step; larger
+# q-blocks win in kernel isolation but lose in context)
+import os as _os
+_BLOCK_Q = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK_Q", 512))
+_BLOCK_K = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK_K", 512))
+_BLOCK_Q_BWD = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK_Q_BWD", 512))
+_BLOCK_K_BWD = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK_K_BWD", 512))
+
 
 def _prec(dtype):
     """MXU precision: bf16/f16 operands use the native one-pass mode (full
@@ -65,9 +73,12 @@ def _pick_block(seq, target):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
-                block_k, kv_valid):
+                block_k, kv_valid, seg_len=None):
     # lse_ref is None on the inference path (save_lse=False): the LSE
-    # write is only needed as the backward's softmax residual
+    # write is only needed as the backward's softmax residual.
+    # seg_len: GQA fold — the q axis is G concatenated length-seg_len
+    # segments (one per q-head sharing this kv head); causal masking is
+    # per-segment (row mod seg_len).
     # k arrives pre-transposed as (1, 1, d, sk): the (1),(0) contraction is
     # the fastest Mosaic form for the hot q @ k dot. ((1,),(1,)) also
     # lowers for bf16 — the backward kernels use it (verified on v5e).
@@ -80,12 +91,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
 
     nk_total = kv_pad // block_k
     if causal:
-        # number of k-blocks touching rows [iq*bq, (iq+1)*bq)
-        nk = jnp.minimum(((iq + 1) * bq + block_k - 1) // block_k, nk_total)
+        # number of k-blocks touching this q-block's (segment-local) rows
+        start = iq * bq
+        if seg_len is not None:
+            start = start % seg_len
+        nk = jnp.minimum((start + bq + block_k - 1) // block_k, nk_total)
+        # blocks fully below the diagonal (and inside valid kv) need no
+        # element mask at all — pure MXU + softmax
+        n_full = jnp.minimum(start // block_k, kv_valid // block_k)
     else:
         nk = nk_total
+        n_full = kv_valid // block_k
 
-    def body(j, carry):
+    def body(j, carry, masked=True):
         m, l, acc = carry
         kj = k_ref[0, 0, :, pl.ds(j * block_k, block_k)]   # (d, bk)
         vj = v_ref[0, 0, pl.ds(j * block_k, block_k), :]   # (bk, d)
@@ -96,14 +114,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         # bf16: the package-global 'highest' would force an f32-contract
         # form Mosaic can't lower; bf16 inputs with f32 accumulation IS
         # the full-rate MXU mode
-        col = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1) \
-            + j * block_k
-        valid = col < kv_valid
-        if causal:
-            row = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0) \
-                + iq * bq
-            valid = jnp.logical_and(valid, col <= row)
-        s = jnp.where(valid, s, _NEG_INF)
+        if masked:
+            col = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1) \
+                + j * block_k
+            valid = col < kv_valid
+            if causal:
+                row = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k),
+                                               0) + start
+                valid = jnp.logical_and(valid, col <= row)
+            s = jnp.where(valid, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -116,21 +135,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    carry = jax.lax.fori_loop(
+        0, n_full, functools.partial(body, masked=False), (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(n_full, nk, body, carry)
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     if lse_ref is not None:
         lse = m + jnp.log(jnp.maximum(l, 1e-30))
         lse_ref[0, 0] = jnp.broadcast_to(lse, (bq, _LANES))
 
 
-def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=512, block_k=512,
-                      interpret=False, save_lse=True):
-    """q,k,v: (B, H, S, D) with equal head counts.
+def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
+                      interpret=False, save_lse=True, seg_len=None):
+    """q,k,v: (B, H, S, D) with equal head counts. seg_len: the q axis is
+    G concatenated segments of this length (GQA fold; requires block
+    alignment — callers gate on it).
     Returns (out (B,H,Sq,D), lse (B,H,Sq_pad,128) f32 | None)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+    bq = min(block_q or _BLOCK_Q, sq)
+    bk = min(block_k or _BLOCK_K, sk)
+    if seg_len is not None:
+        assert sq % seg_len == 0 and seg_len % bq == 0, (sq, seg_len, bq)
     # pad seqs to block multiples
     sq_p = (sq + bq - 1) // bq * bq
     sk_p = (sk + bk - 1) // bk * bk
@@ -142,7 +167,8 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=512, block_k=512,
 
     kt = jnp.swapaxes(k, 2, 3)   # (b, h, d, sk): XLA fuses the transpose
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
-                               causal=causal, block_k=bk, kv_valid=sk)
+                               causal=causal, block_k=bk, kv_valid=sk,
+                               seg_len=seg_len)
     qspec = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0))
     out_specs = [qspec]
     out_shape = [jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype)]
@@ -177,7 +203,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=512, block_k=512,
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, sm_scale, causal, block_k, kv_valid):
+                   *, sm_scale, causal, block_k, kv_valid, seg_len=None):
     bq, d = q_ref.shape[2], q_ref.shape[3]
     kv_pad = k_ref.shape[2]
     iq = pl.program_id(2)
@@ -190,24 +216,30 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     nk_total = kv_pad // block_k
     if causal:
-        nk = jnp.minimum(((iq + 1) * bq + block_k - 1) // block_k, nk_total)
+        start = iq * bq
+        if seg_len is not None:
+            start = start % seg_len
+        nk = jnp.minimum((start + bq + block_k - 1) // block_k, nk_total)
+        n_full = jnp.minimum(start // block_k, kv_valid // block_k)
     else:
         nk = nk_total
+        n_full = kv_valid // block_k
 
-    def body(j, acc):
+    def body(j, acc, masked=True):
         kj = k_ref[0, 0, pl.ds(j * block_k, block_k), :]   # (bk, d)
         vj = v_ref[0, 0, pl.ds(j * block_k, block_k), :]   # (bk, d)
         s = jax.lax.dot_general(
             q, kj, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (bq, bk)
-        col = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1) \
-            + j * block_k
-        valid = col < kv_valid
-        if causal:
-            row = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0) \
-                + iq * bq
-            valid = jnp.logical_and(valid, col <= row)
-        s = jnp.where(valid, s, _NEG_INF)
+        if masked:
+            col = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1) \
+                + j * block_k
+            valid = col < kv_valid
+            if causal:
+                row = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k),
+                                               0) + start
+                valid = jnp.logical_and(valid, col <= row)
+            s = jnp.where(valid, s, _NEG_INF)
         p = jnp.exp(s - lse)                                    # (bq, bk)
         dp = jax.lax.dot_general(
             do, vj, (((1,), (1,)), ((), ())),
@@ -218,79 +250,114 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32, precision=prec)  # (bq, d)
 
     acc0 = jnp.zeros((bq, d), jnp.float32)
-    acc = jax.lax.fori_loop(0, nk, body, acc0)
+    acc = jax.lax.fori_loop(0, n_full,
+                            functools.partial(body, masked=False), acc0)
+    acc = jax.lax.fori_loop(n_full, nk, body, acc)
     dq_ref[0, 0] = acc.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, sm_scale, causal, block_q,
-                    q_valid, kv_valid):
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
+                    nq_total, q_valid, kv_valid, seg_len=None):
+    # Grid (b, h, ik, jq): jq (fastest axis) streams q/do/lse/delta blocks
+    # while k/v stay resident (same block index => Pallas skips the DMA);
+    # dk/dv accumulate in VMEM scratch and store once at the last jq.
+    # This keeps per-cell VMEM O(bq + bk) — a flat q stream would need the
+    # whole (folded) q/lse/delta per cell and overflows VMEM.
     bk, d = k_ref.shape[2], k_ref.shape[3]
-    q_pad = q_ref.shape[2]
+    bq = q_ref.shape[2]
     ik = pl.program_id(2)
+    jq = pl.program_id(3)
 
-    k = k_ref[0, 0]                                # (bk, d)
-    v = v_ref[0, 0]                                # (bk, d)
-    prec = _prec(q_ref.dtype)
+    @pl.when(jq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    nq_total = q_pad // block_q
+    # segment-local start row of this q block (GQA fold: causality is per
+    # length-seg_len segment)
+    start = jq * bq
+    if seg_len is not None:
+        start = start % seg_len
+    run = (start + bq - 1 >= ik * bk) if causal else True
+    # cells with every (row, col) pair valid skip the element mask
+    full = jnp.logical_and((ik + 1) * bk <= kv_valid,
+                           (jq + 1) * bq <= q_valid)
     if causal:
-        # first q-block whose rows reach this k-block's columns
-        j0 = (ik * bk) // block_q
-    else:
-        j0 = 0
+        full = jnp.logical_and(full, (ik + 1) * bk - 1 <= start)
 
-    def body(j, carry):
-        dk_acc, dv_acc = carry
-        qj = (q_ref[0, 0, pl.ds(j * block_q, block_q), :]
-              * jnp.asarray(sm_scale, q_ref.dtype))             # (bq, d)
-        doj = do_ref[0, 0, pl.ds(j * block_q, block_q), :]      # (bq, d)
-        lse = lse_ref[0, 0, pl.ds(j * block_q, block_q), :1]    # (bq, 1)
-        delta = delta_ref[0, 0, pl.ds(j * block_q, block_q), :1]
+    def _compute(masked):
+        prec = _prec(q_ref.dtype)
+        k = k_ref[0, 0]                                         # (bk, d)
+        v = v_ref[0, 0]                                         # (bk, d)
+        qj = (q_ref[0, 0] * jnp.asarray(sm_scale, q_ref.dtype))  # (bq, d)
+        doj = do_ref[0, 0]                                      # (bq, d)
+        lse = lse_ref[0, 0, :, :1]                              # (bq, 1)
+        delta = delta_ref[0, 0, :, :1]
         s = jax.lax.dot_general(
             qj, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (bq, bk)
-        col = jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1) \
-            + ik * bk
-        row = jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0) \
-            + j * block_q
-        valid = jnp.logical_and(col < kv_valid, row < q_valid)
-        if causal:
-            valid = jnp.logical_and(valid, col <= row)
-        s = jnp.where(valid, s, _NEG_INF)
+        if masked:
+            col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) \
+                + ik * bk
+            row_g = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) \
+                + jq * bq
+            valid = jnp.logical_and(col < kv_valid, row_g < q_valid)
+            if causal:
+                row_c = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) \
+                    + start
+                valid = jnp.logical_and(valid, col <= row_c)
+            s = jnp.where(valid, s, _NEG_INF)
         p = jnp.exp(s - lse)                                    # (bq, bk)
-        dv_acc = dv_acc + jax.lax.dot_general(
+        dv_scr[...] += jax.lax.dot_general(
             p.T.astype(doj.dtype), doj, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (bk, d)
         dp = jax.lax.dot_general(
             doj, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (bq, bk)
         ds = p * (dp - delta) * sm_scale                         # (bq, bk)
-        dk_acc = dk_acc + jax.lax.dot_general(
+        dk_scr[...] += jax.lax.dot_general(
             ds.T.astype(qj.dtype), qj, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (bk, d)
-        return dk_acc, dv_acc
 
-    z = jnp.zeros((bk, d), jnp.float32)
-    dk_acc, dv_acc = jax.lax.fori_loop(j0, nq_total, body, (z, z))
-    # undo the sm_scale folded into qj when accumulating dk (dk = ds^T @ q,
-    # with q unscaled; qj above was pre-scaled for the s recompute)
-    dk_ref[0, 0] = (dk_acc / sm_scale).astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv_acc.astype(dv_ref.dtype)
+    @pl.when(jnp.logical_and(run, full))
+    def _compute_unmasked():
+        _compute(False)
+
+    @pl.when(jnp.logical_and(run, jnp.logical_not(full)))
+    def _compute_masked():
+        _compute(True)
+
+    @pl.when(jq == nq_total - 1)
+    def _store():
+        # undo the sm_scale folded into qj when accumulating dk (dk =
+        # ds^T @ q with q unscaled; qj above was pre-scaled for s)
+        dk_ref[0, 0] = (dk_scr[...] / sm_scale).astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
-                      block_q=512, block_k=512, interpret=False):
+                      block_q=None, block_k=None, interpret=False,
+                      seg_len=None):
     """FA2 backward. q,k,v,o,g: (B,H,S,D); lse: (B,H,Sq_pad,128) f32."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
+    bq = min(block_q or _BLOCK_Q_BWD, sq)
+    bk = min(block_k or _BLOCK_K_BWD, sk)
+    if seg_len is not None:
+        assert sq % seg_len == 0 and seg_len % bq == 0, (sq, seg_len, bq)
     sq_p = (sq + bq - 1) // bq * bq
     sk_p = (sk + bk - 1) // bk * bk
 
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (_LANES,))
+    # lse was padded with the FORWARD block size; reconcile to ours
+    # (padded rows are masked in dkv and sliced off dq, values don't matter)
+    if lse.shape[2] > sq_p:
+        lse = lse[:, :, :sq_p]
+    elif lse.shape[2] < sq_p:
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, sq_p - lse.shape[2]),
+                            (0, 0)))
     if sq_p != sq:
         pad = ((0, 0), (0, 0), (0, sq_p - sq), (0, 0))
         q = jnp.pad(q, pad)
@@ -308,7 +375,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_k=bk, kv_valid=sk),
+                          block_k=bk, kv_valid=sk, seg_len=seg_len),
         grid=(b, h, sq_p // bq),
         in_specs=[qspec, kfull, kfull, qspec, lspec, lspec],
         out_specs=qspec,
@@ -316,18 +383,24 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
         interpret=interpret,
     )(q, k, v, g, lse, delta)
 
-    kspec = pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki: (bi, hi, ki, 0))
-    qfull = pl.BlockSpec((1, 1, sq_p, d), lambda bi, hi, ki: (bi, hi, 0, 0))
-    lfull = pl.BlockSpec((1, 1, sq_p, _LANES),
-                         lambda bi, hi, ki: (bi, hi, 0, 0))
+    nq_total = sq_p // bq
+    kspec4 = pl.BlockSpec((1, 1, bk, d),
+                          lambda bi, hi, ki, qi: (bi, hi, ki, 0))
+    qspec4 = pl.BlockSpec((1, 1, bq, d),
+                          lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+    lspec4 = pl.BlockSpec((1, 1, bq, _LANES),
+                          lambda bi, hi, ki, qi: (bi, hi, qi, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=bq, q_valid=sq, kv_valid=sk),
-        grid=(b, h, sk_p // bk),
-        in_specs=[qfull, kspec, kspec, qfull, lfull, lfull],
-        out_specs=[kspec, kspec],
+                          nq_total=nq_total, q_valid=sq, kv_valid=sk,
+                          seg_len=seg_len),
+        grid=(b, h, sk_p // bk, nq_total),
+        in_specs=[qspec4, kspec4, kspec4, qspec4, lspec4, lspec4],
+        out_specs=[kspec4, kspec4],
         out_shape=[jax.ShapeDtypeStruct((b, h, sk_p, d), k.dtype),
                    jax.ShapeDtypeStruct((b, h, sk_p, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, g, lse, delta)
 
@@ -411,26 +484,30 @@ def _on_tpu():
         return False
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, causal, sm_scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, sm_scale, seg_len):
     if _on_tpu():
         return _flash_fwd_pallas(q, k, v, causal, sm_scale,
-                                 save_lse=False)[0]
+                                 save_lse=False, seg_len=seg_len)[0]
+    assert seg_len is None  # the GQA fold is only taken on the TPU path
     return _chunked_attention(q, k, v, causal, sm_scale)
 
 
-def _flash_fwd_rule(q, k, v, causal, sm_scale):
+def _flash_fwd_rule(q, k, v, causal, sm_scale, seg_len):
     if _on_tpu():
-        out, lse = _flash_fwd_pallas(q, k, v, causal, sm_scale)
+        out, lse = _flash_fwd_pallas(q, k, v, causal, sm_scale,
+                                     seg_len=seg_len)
         return out, (q, k, v, out, lse)
+    assert seg_len is None
     return _chunked_attention(q, k, v, causal, sm_scale), (q, k, v, None,
                                                           None)
 
 
-def _flash_bwd_rule(causal, sm_scale, res, g):
+def _flash_bwd_rule(causal, sm_scale, seg_len, res, g):
     q, k, v, o, lse = res
     if lse is not None:
-        return _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale)
+        return _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
+                                 seg_len=seg_len)
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _chunked_attention(q_, k_, v_, causal, sm_scale),
         q, k, v)
@@ -441,15 +518,30 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None):
-    """(B, H, S, D) entry. GQA: kv head count may divide q head count."""
+    """(B, H, S, D) entry. GQA: kv head count may divide q head count.
+
+    On TPU, GQA takes the fold path: q (B, G*Hk, S, D) is bitcast to
+    (B, Hk, G*S, D) — adjacent q-heads share a kv head — so the kernels
+    stream each kv head once instead of G repeated copies, and dk/dv come
+    out per-kv-head directly (no XLA group-reduction). Requires the
+    segment length S to align with the q block sizes; otherwise falls
+    back to jnp.repeat of k/v.
+    """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     hq, hk = q.shape[1], k.shape[1]
     if hk != hq:
         rep = hq // hk
+        b, _, s, d = q.shape
+        bq_f = min(_BLOCK_Q, rep * s)
+        bq_b = min(_BLOCK_Q_BWD, rep * s)
+        if _on_tpu() and hq % hk == 0 and s % bq_f == 0 and s % bq_b == 0:
+            qf = q.reshape(b, hk, rep * s, d)
+            out = _flash(qf, k, v, causal, sm_scale, s)
+            return out.reshape(b, hq, s, d)
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
-    return _flash(q, k, v, causal, sm_scale)
+    return _flash(q, k, v, causal, sm_scale, None)
 
 
 def flash_attention_bshd(q, k, v, causal=False, sm_scale=None):

@@ -115,6 +115,51 @@ def test_pallas_bwd_kernels_interpret(causal, s):
                                    rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_pallas_gqa_fold_interpret(causal):
+    """GQA fold (q bitcast to (B, Hk, G*S, D) + segment-local causal mask)
+    must match the repeat-k/v path, forward and backward."""
+    b, s, hq, hk, d = 2, 64, 4, 2, 32
+    q, k, v = _qkv(b=b, s=s, hq=hq, hk=hk, d=d)
+    qh = jnp.swapaxes(q, 1, 2)          # (b, hq, s, d)
+    kh = jnp.swapaxes(k, 1, 2)          # (b, hk, s, d)
+    vh = jnp.swapaxes(v, 1, 2)
+    rep = hq // hk
+    sm = 1.0 / np.sqrt(d)
+
+    qf = qh.reshape(b, hk, rep * s, d)
+    out_f, lse = fa._flash_fwd_pallas(qf, kh, vh, causal, sm, block_q=32,
+                                      block_k=32, interpret=True, seg_len=s)
+    out_fold = out_f.reshape(b, hq, s, d)
+
+    krep = jnp.repeat(kh, rep, axis=1)
+    vrep = jnp.repeat(vh, rep, axis=1)
+    out_rep, _ = fa._flash_fwd_pallas(qh, krep, vrep, causal, sm,
+                                      block_q=32, block_k=32,
+                                      interpret=True)
+    np.testing.assert_allclose(np.asarray(out_fold), np.asarray(out_rep),
+                               rtol=1e-5, atol=1e-5)
+
+    g = jnp.ones_like(out_f) * 0.3
+    dq_f, dk_f, dv_f = fa._flash_bwd_pallas(
+        qf, kh, vh, out_f, lse, g, causal, sm, block_q=32, block_k=32,
+        interpret=True, seg_len=s)
+
+    def ref_loss(qh, kh, vh):
+        r = _sdpa_ref(jnp.swapaxes(qh, 1, 2), jnp.swapaxes(kh, 1, 2),
+                      jnp.swapaxes(vh, 1, 2), is_causal=causal)
+        return jnp.sum(jnp.swapaxes(r, 1, 2)
+                       * g.reshape(b, hq, s, d))
+
+    rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(qh, kh, vh)
+    np.testing.assert_allclose(np.asarray(dq_f.reshape(b, hq, s, d)),
+                               np.asarray(rq), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dk_f), np.asarray(rk),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dv_f), np.asarray(rv),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_bf16_fwd():
     q, k, v = _qkv(dtype=jnp.bfloat16)
     ref = _sdpa_ref(q, k, v, is_causal=True)
