@@ -3,13 +3,18 @@ python/paddle/distributed/checkpoint/: save_state_dict.py:104 — per-rank
 local shard files + global metadata; load_state_dict.py:377 — overlap
 computation between saved shards and target placements; metadata.py).
 
-TPU-native layout: each HOST (jax process) writes one `.npz` holding the
-addressable shards of every tensor, plus — on the coordinator — one
-`metadata.json` mapping tensor name -> global shape/dtype + shard table
-[{offsets, shape, file, key}]. Load never needs collectives: every target
-shard is assembled host-side from the overlapping saved pieces (the same
-slice-overlap algorithm as the reference's load_state_dict), then placed
-with jax.make_array_from_callback under the target NamedSharding — so a
+TPU-native layout: each HOST (jax process) writes one `shards_{pid}.npz`
+holding the addressable shards of every tensor plus one `table_{pid}.json`
+mapping tensor name -> global shape/dtype + shard entries [{offsets,
+sizes, file, key}]; the coordinator writes a tiny `metadata.json`
+recording the expected process_count. When the jax coordination service
+is up (multi-host), save() ends with a barrier so it returns only once
+every host's files exist — the service plays the role of the reference's
+TCPStore rendezvous. Load merges every table (validating the set is
+complete) and never needs collectives: every target shard is assembled
+host-side from the overlapping saved pieces (the same slice-overlap
+algorithm as the reference's load_state_dict), then placed with
+jax.make_array_from_callback under the target NamedSharding — so a
 checkpoint written on one mesh/placement restores onto ANY other.
 Plain (unsharded) tensors round-trip as single-shard entries.
 """
@@ -100,15 +105,91 @@ def save_state_dict(state_dict, path, process_group=None,
                                         "file": fname, "key": key})
         meta[name] = entry
     np.savez(os.path.join(path, fname), **payload)
+    with open(os.path.join(path, f"table_{pid}.json"), "w") as f:
+        json.dump(meta, f, indent=1)
 
-    if pid == coordinator_rank or jax.process_count() == 1:
-        # multi-host: every host's shard table must reach the coordinator;
-        # with jax.distributed this rides the coordination service. In the
-        # single-controller case (and tests) all shards are addressable
-        # locally, so the local table IS the global table.
+    if pid == coordinator_rank:
         with open(os.path.join(path, _META), "w") as f:
-            json.dump({"state_dict_metadata": meta,
-                       "process_count": jax.process_count()}, f, indent=1)
+            json.dump({"process_count": jax.process_count()}, f, indent=1)
+
+    _save_barrier(path)
+
+
+_barrier_seq = 0
+
+
+def _save_barrier(path, timeout_ms=600_000):
+    """Block until every host finished writing (coordination-service
+    barrier — the jax.distributed analog of the reference's TCPStore
+    rendezvous). No-op single-host or when the service isn't up."""
+    if jax.process_count() == 1:
+        return
+    try:
+        from jax._src import distributed as _dist
+        client = _dist.global_state.client
+    except Exception:
+        client = None
+    if client is None:
+        return
+    # barrier ids are single-use in the coordination service: a counter
+    # keeps repeated saves to the same directory from colliding (save is
+    # collective, so every host's counter advances in lockstep)
+    global _barrier_seq
+    _barrier_seq += 1
+    tag = f"ckpt_save:{os.path.abspath(path)}:{_barrier_seq}"
+    client.wait_at_barrier(tag, timeout_in_ms=timeout_ms)
+
+
+def _merged_tables(path):
+    """Union of every host's shard table, with completeness checking."""
+    try:
+        with open(os.path.join(path, _META)) as f:
+            info = json.load(f)
+    except FileNotFoundError:
+        info = {}
+    if "state_dict_metadata" in info:   # pre-table single-file format
+        return info["state_dict_metadata"]
+    expect = info.get("process_count")
+    if expect is not None:
+        # read EXACTLY this save's tables: a previous save into the same
+        # directory by more hosts leaves stale table_{i}.json files behind
+        # which must not be merged in
+        tables = [f"table_{p}.json" for p in range(expect)]
+        missing = [fn for fn in tables
+                   if not os.path.exists(os.path.join(path, fn))]
+        if missing:
+            raise ValueError(
+                f"checkpoint {path!r} incomplete: missing {missing} "
+                f"of {expect} host tables (a host's save did not finish?)")
+    else:
+        tables = sorted(
+            fn for fn in os.listdir(path)
+            if fn.startswith("table_") and fn.endswith(".json"))
+    if not tables:
+        raise FileNotFoundError(f"no shard tables in checkpoint {path!r}")
+    merged = {}
+    for fn in tables:
+        with open(os.path.join(path, fn)) as f:
+            tbl = json.load(f)
+        for name, entry in tbl.items():
+            if name not in merged:
+                merged[name] = {"shape": entry["shape"],
+                                "dtype": entry["dtype"], "shards": [],
+                                "_seen": set()}
+            tgt = merged[name]
+            if list(entry["shape"]) != list(tgt["shape"]):
+                raise ValueError(
+                    f"{name}: host tables disagree on global shape "
+                    f"({entry['shape']} vs {tgt['shape']})")
+            for sh in entry["shards"]:
+                box = tuple(sh["offsets"] + sh["sizes"])
+                if box in tgt["_seen"]:   # replicated across hosts
+                    continue
+                tgt["_seen"].add(box)
+                tgt["shards"].append(sh)
+    for entry in merged.values():
+        entry.pop("_seen")
+    return merged
 
 
 def _overlap(t_offs, t_sizes, s_offs, s_sizes):
@@ -129,8 +210,7 @@ def load_state_dict(state_dict, path, process_group=None,
     """Fill `state_dict`'s tensors from a sharded checkpoint, resharding
     to each tensor's CURRENT sharding (reference:
     checkpoint/load_state_dict.py:377 — compute_overlap + read slices)."""
-    with open(os.path.join(path, _META)) as f:
-        meta = json.load(f)["state_dict_metadata"]
+    meta = _merged_tables(path)
 
     files = {}
 
