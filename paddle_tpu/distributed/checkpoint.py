@@ -72,6 +72,18 @@ def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, async_save=False):
     """Write each host's addressable shards + global metadata (reference:
     checkpoint/save_state_dict.py:104)."""
+    try:
+        _save_state_dict_files(state_dict, path, coordinator_rank)
+    finally:
+        # ALWAYS reach the barrier, even when writing failed: barrier tags
+        # are sequence-numbered per process, so a host that skipped one
+        # barrier would desynchronize every later save (each host waiting
+        # on a different tag until timeout). A failed write surfaces via
+        # the raise below *and* as a missing table at load time.
+        _save_barrier(path)
+
+
+def _save_state_dict_files(state_dict, path, coordinator_rank):
     os.makedirs(path, exist_ok=True)
     flat = _flatten_state(state_dict)
     pid = jax.process_index()
@@ -111,8 +123,6 @@ def save_state_dict(state_dict, path, process_group=None,
     if pid == coordinator_rank:
         with open(os.path.join(path, _META), "w") as f:
             json.dump({"process_count": jax.process_count()}, f, indent=1)
-
-    _save_barrier(path)
 
 
 _barrier_seq = 0

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os as _os
 
 import jax
 import jax.numpy as jnp
@@ -41,11 +42,14 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
-_LANES = 128  # TPU min tile width; LSE/delta are lane-replicated to this
+_LOG2E = 1.4426950408889634  # kernels exponentiate in base 2: exp(x) = exp2(x*log2e)
+# LSE/delta lane replication width. 128 = native lane tile. Measured on
+# v5e: narrowing to 8 (16x less HBM bytes) is ~3% SLOWER end-to-end —
+# sub-lane-width f32 tiles DMA less efficiently than full 128-lane rows.
+_LANES = int(_os.environ.get("PADDLE_TPU_FLASH_LSE_LANES", 128))
 
 # Tuning knobs (swept on v5e: (512,512) best in the full train step; larger
 # q-blocks win in kernel isolation but lose in context)
-import os as _os
 _BLOCK_Q = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK_Q", 512))
 _BLOCK_K = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK_K", 512))
 _BLOCK_Q_BWD = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK_Q_BWD", 512))
@@ -86,7 +90,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     kv_pad = k_ref.shape[3]
     iq = pl.program_id(2)
 
-    q = (q_ref[0, 0] * jnp.asarray(sm_scale, q_ref.dtype))
+    # fold log2(e) into the scale once on (bq, d) instead of an extra
+    # multiply on every (bq, sk) score: all exponentials below are exp2,
+    # and the saved LSE is base-2
+    q = (q_ref[0, 0] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype))
     prec = _prec(q_ref.dtype)
 
     nk_total = kv_pad // block_k
@@ -124,8 +131,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
                 valid = jnp.logical_and(valid, col <= row)
             s = jnp.where(valid, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
             p.astype(vj.dtype), vj, (((1,), (0,)), ((), ())),
@@ -140,7 +147,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     m, l, acc = jax.lax.fori_loop(n_full, nk, body, carry)
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     if lse_ref is not None:
-        lse = m + jnp.log(jnp.maximum(l, 1e-30))
+        lse = m + jnp.log2(jnp.maximum(l, 1e-30))   # base-2, matches bwd
         lse_ref[0, 0] = jnp.broadcast_to(lse, (bq, _LANES))
 
 
@@ -208,7 +215,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     kv_pad = k_ref.shape[2]
     iq = pl.program_id(2)
 
-    q = (q_ref[0, 0] * jnp.asarray(sm_scale, q_ref.dtype))
+    q = (q_ref[0, 0] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype))
     do = do_ref[0, 0]
     lse = lse_ref[0, 0, :, :1]                     # (bq, 1) f32
     delta = delta_ref[0, 0, :, :1]                 # (bq, 1) f32
@@ -240,7 +247,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                                0) + start
                 valid = jnp.logical_and(valid, col <= row)
             s = jnp.where(valid, s, _NEG_INF)
-        p = jnp.exp(s - lse)                                    # (bq, bk)
+        p = jnp.exp2(s - lse)                                   # (bq, bk)
         dp = jax.lax.dot_general(
             do, vj, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (bq, bk)
@@ -287,37 +294,42 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         full = jnp.logical_and(full, (ik + 1) * bk - 1 <= start)
 
     def _compute(masked):
+        # everything in the TRANSPOSED (bk, bq) orientation: sT = k·qT,
+        # so dv = pT·do and dk = dsT·q contract directly with no (bq,bk)
+        # transposes on the hot path (only the (bq,1) lse/delta vectors
+        # get relaid out to (1,bq))
         prec = _prec(q_ref.dtype)
         k = k_ref[0, 0]                                         # (bk, d)
         v = v_ref[0, 0]                                         # (bk, d)
-        qj = (q_ref[0, 0] * jnp.asarray(sm_scale, q_ref.dtype))  # (bq, d)
+        qj = (q_ref[0, 0]
+              * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype))    # (bq, d)
         doj = do_ref[0, 0]                                      # (bq, d)
-        lse = lse_ref[0, 0, :, :1]                              # (bq, 1)
-        delta = delta_ref[0, 0, :, :1]
-        s = jax.lax.dot_general(
-            qj, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32, precision=prec)  # (bq, bk)
+        lse_t = lse_ref[0, 0, :, :1].T                          # (1, bq)
+        delta_t = delta_ref[0, 0, :, :1].T                      # (1, bq)
+        s_t = jax.lax.dot_general(
+            k, qj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (bk, bq)
         if masked:
-            col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) \
+            col = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0) \
                 + ik * bk
-            row_g = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) \
+            row_g = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1) \
                 + jq * bq
             valid = jnp.logical_and(col < kv_valid, row_g < q_valid)
             if causal:
-                row_c = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) \
+                row_c = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1) \
                     + start
                 valid = jnp.logical_and(valid, col <= row_c)
-            s = jnp.where(valid, s, _NEG_INF)
-        p = jnp.exp(s - lse)                                    # (bq, bk)
+            s_t = jnp.where(valid, s_t, _NEG_INF)
+        p_t = jnp.exp2(s_t - lse_t)                             # (bk, bq)
         dv_scr[...] += jax.lax.dot_general(
-            p.T.astype(doj.dtype), doj, (((1,), (0,)), ((), ())),
+            p_t.astype(doj.dtype), doj, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (bk, d)
-        dp = jax.lax.dot_general(
-            doj, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32, precision=prec)  # (bq, bk)
-        ds = p * (dp - delta) * sm_scale                         # (bq, bk)
+        dp_t = jax.lax.dot_general(
+            v, doj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (bk, bq)
+        ds_t = p_t * (dp_t - delta_t) * sm_scale                 # (bk, bq)
         dk_scr[...] += jax.lax.dot_general(
-            ds.T.astype(qj.dtype), qj, (((1,), (0,)), ((), ())),
+            ds_t.astype(qj.dtype), qj, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (bk, d)
 
     @pl.when(jnp.logical_and(run, full))
@@ -330,9 +342,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(jq == nq_total - 1)
     def _store():
-        # undo the sm_scale folded into qj when accumulating dk (dk =
-        # ds^T @ q with q unscaled; qj above was pre-scaled for s)
-        dk_ref[0, 0] = (dk_scr[...] / sm_scale).astype(dk_ref.dtype)
+        # undo the sm_scale*log2e folded into qj when accumulating dk
+        # (dk = ds^T @ q with q unscaled; qj above was pre-scaled for s)
+        dk_ref[0, 0] = (dk_scr[...] / (sm_scale * _LOG2E)).astype(
+            dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
