@@ -22,10 +22,13 @@ TPU-native implementation:
   attention (lax.scan over k/v blocks with jax.checkpoint) differentiated
   by JAX AD — exact same math with O(S·D) residual memory.
 
-Known limit: each grid cell streams the full opposing sequence through
-VMEM (k/v in the forward; q/dO/lse/delta in dkv), which bounds single-call
-seq length to VMEM/~1.5KB (bf16 d=64: ~10K tokens). Longer sequences go
-through the ring/context-parallel path (distributed/context_parallel.py),
+Two kernel layouts per direction, selected by kv size: below
+_KV_VMEM_BYTES the whole k/v sits in VMEM per (b, h) (fastest — one
+fetch, no per-block grid overhead); above it, 4D-grid variants stream
+one k/v block per grid step with the softmax state / accumulators in
+VMEM scratch, so single-chip sequence length is bounded by HBM only
+(verified: 32K tokens trains on one 16G v5e). Multi-chip long context
+goes through ring/context-parallel (distributed/context_parallel.py),
 which shards the sequence before the kernel sees it.
 
 Layouts: public entry takes paddle's (batch, seq, heads, head_dim).
@@ -151,11 +154,115 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         lse_ref[0, 0] = jnp.broadcast_to(lse, (bq, _LANES))
 
 
+def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                       acc_scr, *, sm_scale, causal, kv_valid, nk_total,
+                       seg_len=None):
+    """4D-grid forward: grid (b, h, iq, jk) streams one k/v block per step
+    with the softmax state in VMEM scratch. Used when whole-k/v no longer
+    fits the per-kernel VMEM budget (long sequences); the 3D variant above
+    is faster at short kv (k/v fetched once per (b,h), no per-block grid
+    overhead)."""
+    bq, d = q_ref.shape[2], q_ref.shape[3]
+    bk = k_ref.shape[3]
+    iq = pl.program_id(2)
+    jk = pl.program_id(3)
+
+    @pl.when(jk == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    start = iq * bq
+    if seg_len is not None:
+        start = start % seg_len
+    run = (jk * bk <= start + bq - 1) if causal else True
+    full = (jk + 1) * bk <= kv_valid
+    if causal:
+        full = jnp.logical_and(full, (jk + 1) * bk - 1 <= start)
+
+    prec = _prec(q_ref.dtype)
+
+    def compute(masked):
+        q = (q_ref[0, 0] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype))
+        kj = k_ref[0, 0]                                   # (d, bk)
+        vj = v_ref[0, 0]                                   # (bk, d)
+        s = jax.lax.dot_general(
+            q, kj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        if masked:
+            col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) \
+                + jk * bk
+            valid = col < kv_valid
+            if causal:
+                row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) \
+                    + start
+                valid = jnp.logical_and(valid, col <= row)
+            s = jnp.where(valid, s, _NEG_INF)
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p.astype(vj.dtype), vj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(jnp.logical_and(run, full))
+    def _unmasked():
+        compute(False)
+
+    @pl.when(jnp.logical_and(run, jnp.logical_not(full)))
+    def _masked():
+        compute(True)
+
+    @pl.when(jk == nk_total - 1)
+    def _store():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse = m_scr[:, :1] + jnp.log2(l)
+            lse_ref[0, 0] = jnp.broadcast_to(lse, (bq, _LANES))
+
+
+# whole-k/v per grid cell is faster but caps kv length; beyond this byte
+# budget (k+v resident per kernel) the streamed 4D-grid variants kick in
+_KV_VMEM_BYTES = int(_os.environ.get("PADDLE_TPU_FLASH_KV_VMEM",
+                                     6 * 1024 * 1024))
+
+
+def _auto_stream_kv(sk_p, d, itemsize):
+    """True when whole-k/v per (b, h) would exceed the VMEM budget (k and
+    v each sk_p*d elements). Shared by fwd and bwd so both directions
+    always pick the same kernel layout."""
+    return sk_p * d * 2 * itemsize > _KV_VMEM_BYTES
+
+
+def _ki_clamp(bq, bk, causal, seg_len):
+    """For streamed k/v block index maps: clamp ki to the last block this
+    q-row actually needs (causal), so above-diagonal grid steps revisit
+    the previous block — Pallas elides the DMA for a repeated index —
+    instead of fetching data the kernel body then skips."""
+    def clamp(qi, ki):
+        if not causal:
+            return ki
+        start = qi * bq
+        if seg_len is not None:
+            start = start % seg_len
+        return jnp.minimum(ki, (start + bq - 1) // bk)
+    return clamp
+
+
 def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
-                      interpret=False, save_lse=True, seg_len=None):
+                      interpret=False, save_lse=True, seg_len=None,
+                      stream_kv=None):
     """q,k,v: (B, H, S, D) with equal head counts. seg_len: the q axis is
     G concatenated segments of this length (GQA fold; requires block
-    alignment — callers gate on it).
+    alignment — callers gate on it). stream_kv: force (True) / forbid
+    (False) the 4D streamed-kv kernel; None = auto by kv size.
     Returns (out (B,H,Sq,D), lse (B,H,Sq_pad,128) f32 | None)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -173,31 +280,63 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
         v = jnp.pad(v, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
 
     kt = jnp.swapaxes(k, 2, 3)   # (b, h, d, sk): XLA fuses the transpose
-    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
-                               causal=causal, block_k=bk, kv_valid=sk,
-                               seg_len=seg_len)
-    qspec = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0))
+    if stream_kv is None:
+        stream_kv = _auto_stream_kv(sk_p, d, k.dtype.itemsize)
+
+    if stream_kv:
+        kernel = functools.partial(
+            _fwd_kernel_stream, sm_scale=sm_scale, causal=causal,
+            kv_valid=sk, nk_total=sk_p // bk, seg_len=seg_len)
+        qspec = pl.BlockSpec((1, 1, bq, d),
+                             lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+        grid = (b, h, sq_p // bq, sk_p // bk)
+        clamp = _ki_clamp(bq, bk, causal, seg_len)
+        in_specs = [
+            qspec,
+            pl.BlockSpec((1, 1, d, bk),
+                         lambda bi, hi, qi, ki: (bi, hi, 0, clamp(qi, ki))),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, qi, ki: (bi, hi, clamp(qi, ki), 0)),
+        ]
+        lspec = pl.BlockSpec((1, 1, bq, _LANES),
+                             lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+        scratch = [pltpu.VMEM((bq, _LANES), jnp.float32),
+                   pltpu.VMEM((bq, _LANES), jnp.float32),
+                   pltpu.VMEM((bq, d), jnp.float32)]
+    else:
+        kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
+                                   causal=causal, block_k=bk, kv_valid=sk,
+                                   seg_len=seg_len)
+        qspec = pl.BlockSpec((1, 1, bq, d),
+                             lambda bi, hi, qi: (bi, hi, qi, 0))
+        grid = (b, h, sq_p // bq)
+        in_specs = [
+            qspec,
+            pl.BlockSpec((1, 1, d, sk_p),
+                         lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sk_p, d),
+                         lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ]
+        lspec = pl.BlockSpec((1, 1, bq, _LANES),
+                             lambda bi, hi, qi: (bi, hi, qi, 0))
+        scratch = []
     out_specs = [qspec]
     out_shape = [jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype)]
     if save_lse:
-        out_specs.append(pl.BlockSpec((1, 1, bq, _LANES),
-                                      lambda bi, hi, qi: (bi, hi, qi, 0)))
+        out_specs.append(lspec)
         out_shape.append(
             jax.ShapeDtypeStruct((b, h, sq_p, _LANES), jnp.float32))
     else:
         kernel = functools.partial(
-            lambda q_ref, k_ref, v_ref, o_ref, kern: kern(
-                q_ref, k_ref, v_ref, o_ref, None), kern=kernel)
+            lambda q_ref, k_ref, v_ref, o_ref, *scr, kern: kern(
+                q_ref, k_ref, v_ref, o_ref, None, *scr), kern=kernel)
     outs = pl.pallas_call(
         kernel,
-        grid=(b, h, sq_p // bq),
-        in_specs=[
-            qspec,
-            pl.BlockSpec((1, 1, d, sk_p), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, sk_p, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-        ],
+        grid=grid,
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
+        scratch_shapes=scratch,
         interpret=interpret,
     )(q, kt, v)
     out = outs[0]
@@ -261,6 +400,72 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                             functools.partial(body, masked=False), acc0)
     acc = jax.lax.fori_loop(n_full, nk, body, acc)
     dq_ref[0, 0] = acc.astype(dq_ref.dtype)
+
+
+def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dq_ref, acc_scr, *, sm_scale, causal, kv_valid,
+                          nk_total, seg_len=None):
+    """4D-grid dq: grid (b, h, iq, jk) streams one k/v block per step,
+    dq accumulates in VMEM scratch (long-kv counterpart of
+    _bwd_dq_kernel, same reasoning as _fwd_kernel_stream)."""
+    bq, d = q_ref.shape[2], q_ref.shape[3]
+    bk = k_ref.shape[2]
+    iq = pl.program_id(2)
+    jk = pl.program_id(3)
+
+    @pl.when(jk == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    start = iq * bq
+    if seg_len is not None:
+        start = start % seg_len
+    run = (jk * bk <= start + bq - 1) if causal else True
+    full = (jk + 1) * bk <= kv_valid
+    if causal:
+        full = jnp.logical_and(full, (jk + 1) * bk - 1 <= start)
+
+    prec = _prec(q_ref.dtype)
+
+    def compute(masked):
+        q = (q_ref[0, 0] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype))
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0, :, :1]
+        delta = delta_ref[0, 0, :, :1]
+        kj = k_ref[0, 0]                                   # (bk, d)
+        vj = v_ref[0, 0]                                   # (bk, d)
+        s = jax.lax.dot_general(
+            q, kj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        if masked:
+            col = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) \
+                + jk * bk
+            valid = col < kv_valid
+            if causal:
+                row = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) \
+                    + start
+                valid = jnp.logical_and(valid, col <= row)
+            s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp2(s - lse)
+        dp = jax.lax.dot_general(
+            do, vj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        ds = p * (dp - delta) * sm_scale
+        acc_scr[...] += jax.lax.dot_general(
+            ds.astype(kj.dtype), kj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+
+    @pl.when(jnp.logical_and(run, full))
+    def _unmasked():
+        compute(False)
+
+    @pl.when(jnp.logical_and(run, jnp.logical_not(full)))
+    def _masked():
+        compute(True)
+
+    @pl.when(jk == nk_total - 1)
+    def _store():
+        dq_ref[0, 0] = acc_scr[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -351,7 +556,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
                       block_q=None, block_k=None, interpret=False,
-                      seg_len=None):
+                      seg_len=None, stream_kv=None):
     """FA2 backward. q,k,v,o,g: (B,H,S,D); lse: (B,H,Sq_pad,128) f32."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -381,20 +586,46 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
         k = jnp.pad(k, pad)
         v = jnp.pad(v, pad)
 
-    qspec = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0))
-    kfull = pl.BlockSpec((1, 1, sk_p, d), lambda bi, hi, qi: (bi, hi, 0, 0))
-    lspec = pl.BlockSpec((1, 1, bq, _LANES),
-                         lambda bi, hi, qi: (bi, hi, qi, 0))
+    if stream_kv is None:
+        stream_kv = _auto_stream_kv(sk_p, d, k.dtype.itemsize)
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_k=bk, kv_valid=sk, seg_len=seg_len),
-        grid=(b, h, sq_p // bq),
-        in_specs=[qspec, kfull, kfull, qspec, lspec, lspec],
-        out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
-        interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    if stream_kv:
+        clamp = _ki_clamp(bq, bk, causal, seg_len)
+        qspec4q = pl.BlockSpec((1, 1, bq, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+        kspec4q = pl.BlockSpec((1, 1, bk, d),
+                               lambda bi, hi, qi, ki: (bi, hi,
+                                                       clamp(qi, ki), 0))
+        lspec4q = pl.BlockSpec((1, 1, bq, _LANES),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel_stream, sm_scale=sm_scale,
+                              causal=causal, kv_valid=sk,
+                              nk_total=sk_p // bk, seg_len=seg_len),
+            grid=(b, h, sq_p // bq, sk_p // bk),
+            in_specs=[qspec4q, kspec4q, kspec4q, qspec4q, lspec4q, lspec4q],
+            out_specs=qspec4q,
+            out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+            interpret=interpret,
+        )(q, k, v, g, lse, delta)
+    else:
+        qspec = pl.BlockSpec((1, 1, bq, d),
+                             lambda bi, hi, qi: (bi, hi, qi, 0))
+        kfull = pl.BlockSpec((1, 1, sk_p, d),
+                             lambda bi, hi, qi: (bi, hi, 0, 0))
+        lspec = pl.BlockSpec((1, 1, bq, _LANES),
+                             lambda bi, hi, qi: (bi, hi, qi, 0))
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
+                              causal=causal, block_k=bk, kv_valid=sk,
+                              seg_len=seg_len),
+            grid=(b, h, sq_p // bq),
+            in_specs=[qspec, kfull, kfull, qspec, lspec, lspec],
+            out_specs=qspec,
+            out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+            interpret=interpret,
+        )(q, k, v, g, lse, delta)
 
     nq_total = sq_p // bq
     kspec4 = pl.BlockSpec((1, 1, bk, d),

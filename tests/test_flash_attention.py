@@ -160,6 +160,52 @@ def test_pallas_gqa_fold_interpret(causal):
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("s,fold", [(96, False), (80, False), (64, True)])
+def test_pallas_streamed_kv_interpret(causal, s, fold):
+    """The 4D streamed-kv kernels (long-sequence path) must match the
+    whole-kv kernels: block-aligned, ragged (kv-padding mask branch), and
+    GQA-folded (seg_len segment wrap) shapes."""
+    q, k, v = _qkv(s=s)
+    sm = 1.0 / np.sqrt(32)
+    if fold:
+        qh = jnp.swapaxes(q, 1, 2).reshape(2, 2, 2 * s, 32)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        seg = s
+    else:
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.repeat(jnp.swapaxes(k, 1, 2), 2, axis=1)
+        vh = jnp.repeat(jnp.swapaxes(v, 1, 2), 2, axis=1)
+        seg = None
+
+    o_res, lse_res = fa._flash_fwd_pallas(qh, kh, vh, causal, sm,
+                                          block_q=32, block_k=32,
+                                          interpret=True, stream_kv=False,
+                                          seg_len=seg)
+    o_str, lse_str = fa._flash_fwd_pallas(qh, kh, vh, causal, sm,
+                                          block_q=32, block_k=32,
+                                          interpret=True, stream_kv=True,
+                                          seg_len=seg)
+    np.testing.assert_allclose(np.asarray(o_res), np.asarray(o_str),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(lse_res), np.asarray(lse_str),
+                               rtol=1e-6, atol=1e-6)
+
+    g = jnp.ones_like(o_res) * 0.3
+    grads_res = fa._flash_bwd_pallas(qh, kh, vh, o_res, lse_res, g, causal,
+                                     sm, block_q=32, block_k=32,
+                                     interpret=True, stream_kv=False,
+                                     seg_len=seg)
+    grads_str = fa._flash_bwd_pallas(qh, kh, vh, o_str, lse_str, g, causal,
+                                     sm, block_q=32, block_k=32,
+                                     interpret=True, stream_kv=True,
+                                     seg_len=seg)
+    for a, b in zip(grads_res, grads_str):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+
 def test_bf16_fwd():
     q, k, v = _qkv(dtype=jnp.bfloat16)
     ref = _sdpa_ref(q, k, v, is_causal=True)
