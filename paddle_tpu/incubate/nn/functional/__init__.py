@@ -322,15 +322,123 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
                               seq_lens_decoder, seq_lens_this_time,
                               padding_offsets=None, cum_offsets=None,
                               cu_seqlens_q=None, cu_seqlens_k=None,
-                              block_tables=None, **kw):
-    """Paged/block KV-cache attention (reference:
-    block_multihead_attention.py + block_multi_head_attention_kernel.cu).
-    The paged-KV layout exists to fight fragmentation in CUDA serving;
-    XLA serving uses static ring caches, so this surface delegates to
-    masked_multihead_attention semantics per step. Provided for API
-    parity; high-throughput TPU serving should use the static-cache path
-    (paddle_tpu.nn.functional.flash_attention + ring buffers)."""
-    raise NotImplementedError(
-        "block (paged) KV caches are CUDA-serving-specific; on TPU use "
-        "masked_multihead_attention with a static ring cache, or "
-        "flash_attention over the full prefix")
+                              block_tables=None, pre_key_cache=None,
+                              pre_value_cache=None,
+                              cache_k_quant_scales=None,
+                              cache_v_quant_scales=None,
+                              cache_k_dequant_scales=None,
+                              cache_v_dequant_scales=None,
+                              qkv_out_scale=None, qkv_bias=None,
+                              out_shift=None, out_smooth=None,
+                              rope_emb=None, mask=None, tgt_mask=None,
+                              max_seq_len=-1, block_size=64, **kw):
+    """Paged (block) KV-cache attention (reference: incubate/nn/functional/
+    block_multihead_attention.py; CUDA kernel
+    block_multi_head_attention_kernel.cu). TPU-native reimplementation:
+
+    - caches are (max_block_num, kv_heads, block_size, head_dim) page
+      pools; `block_tables` (batch, blocks_per_seq) maps logical pages to
+      physical ones. New k/v tokens scatter into their pages in one
+      vectorized `.at[...].set`; attention gathers each sequence's pages
+      with one take along the page axis (XLA turns both into dynamic
+      slices — no fragmentation problem to fight on TPU, but the paged
+      API keeps serving-stack parity).
+    - both phases of the reference contract: prefill rows
+      (seq_lens_encoder > 0, seq_lens_this_time tokens each, causal) and
+      decode rows (one token appended at seq_lens_decoder).
+    - returns (out, qkv, key_cache, value_cache) like the reference.
+
+    Cache quantization args are CUDA-layout-specific and unsupported.
+    """
+    import math
+    import numpy as _np
+    from paddle_tpu.core.tensor import Tensor as _T
+
+    if any(a is not None for a in (cache_k_quant_scales,
+                                   cache_v_quant_scales,
+                                   cache_k_dequant_scales,
+                                   cache_v_dequant_scales, qkv_out_scale,
+                                   out_shift, out_smooth)):
+        raise NotImplementedError(
+            "cache quant/dequant scales are CUDA-serving-specific")
+    if any(a is not None for a in (pre_key_cache, pre_value_cache,
+                                   tgt_mask)):
+        raise NotImplementedError(
+            "pre_key_cache/pre_value_cache/tgt_mask are not supported; "
+            "prepend prefix tokens through the paged cache itself")
+    if rope_emb is not None:
+        raise NotImplementedError(
+            "apply rotary embedding before block_multihead_attention on "
+            "TPU (fused_rotary_position_embedding)")
+
+    def _a(x):
+        return x._value if isinstance(x, _T) else jnp.asarray(x)
+
+    qkv_a = _a(qkv)
+    kc = _a(key_cache)
+    vc = _a(value_cache)
+    bt = _np.asarray(_a(block_tables))
+    enc = _np.asarray(_a(seq_lens_encoder)).reshape(-1)
+    dec = _np.asarray(_a(seq_lens_decoder)).reshape(-1)
+    this = _np.asarray(_a(seq_lens_this_time)).reshape(-1)
+    if qkv_bias is not None:
+        qkv_a = qkv_a + _a(qkv_bias)
+
+    bsz = this.shape[0]
+    mb, hk, bs, d = kc.shape
+    hq = qkv_a.shape[-1] // d - 2 * hk
+    tok = qkv_a.shape[0]
+    q, k, v = jnp.split(qkv_a, [hq * d, (hq + hk) * d], axis=-1)
+    q = q.reshape(tok, hq, d)
+    k = k.reshape(tok, hk, d)
+    v = v.reshape(tok, hk, d)
+
+    # host-side token bookkeeping (serving drives this eagerly, like the
+    # reference's launcher-side get_padding_offset helper)
+    sid = _np.repeat(_np.arange(bsz), this)            # (tok,) seq of token
+    local = _np.concatenate([_np.arange(n) for n in this]) \
+        if tok else _np.zeros((0,), _np.int64)
+    # write start per seq: seq_lens_decoder is the already-cached prefix
+    # length for BOTH phases (0 for a fresh prefill; chunked prefill with
+    # an existing prefix appends after it)
+    base = dec
+    pos = base[sid] + local                            # global cache pos
+    phys = bt[sid, pos // bs]                          # physical page id
+    off = pos % bs
+
+    kc = kc.at[phys, :, off, :].set(k.astype(kc.dtype))
+    vc = vc.at[phys, :, off, :].set(v.astype(vc.dtype))
+
+    # gather each sequence's pages -> (bsz, hk, L, d), L = pages * bs
+    ks = jnp.moveaxis(kc[bt], 2, 1).reshape(bsz, hk, -1, d)
+    vs = jnp.moveaxis(vc[bt], 2, 1).reshape(bsz, hk, -1, d)
+    L = ks.shape[2]
+    if hq != hk:
+        ks = jnp.repeat(ks, hq // hk, axis=1)
+        vs = jnp.repeat(vs, hq // hk, axis=1)
+
+    # pad tokens to (bsz, m, hq, d) and attend with per-token prefix mask
+    m = int(this.max()) if tok else 0
+    qp = jnp.zeros((bsz, m, hq, d), q.dtype)
+    qp = qp.at[sid, local].set(q)
+    scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bmhd,bhld->bhml", qp.astype(jnp.float32),
+                   ks.astype(jnp.float32)) * scale
+    qpos = jnp.asarray(base)[:, None] + jnp.arange(m)[None, :]  # (bsz, m)
+    col = jnp.arange(L)
+    valid = col[None, None, None, :] <= qpos[:, None, :, None]
+    if mask is not None:
+        mask_a = _a(mask)  # additive, (bsz, 1|hq, m, =<L) reference layout
+        s = s + mask_a[..., :m, :L].astype(jnp.float32)
+    s = jnp.where(valid, s, -1e9)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhml,bhld->bmhd", p, vs.astype(jnp.float32))
+    out = o[sid, local].reshape(tok, hq * d).astype(qkv_a.dtype)
+
+    if isinstance(key_cache, _T):
+        key_cache._value = kc
+    if isinstance(value_cache, _T):
+        value_cache._value = vc
+    return (_T(out), _T(qkv_a), _T(kc) if not isinstance(key_cache, _T)
+            else key_cache,
+            _T(vc) if not isinstance(value_cache, _T) else value_cache)

@@ -124,9 +124,80 @@ def test_masked_multihead_attention_decode_step():
     np.testing.assert_allclose(nc[0][:, :, :3, :], cache[0][:, :, :3, :])
 
 
-def test_block_multihead_attention_raises_helpfully():
-    with pytest.raises(NotImplementedError, match="ring cache"):
-        IF.block_multihead_attention(None, None, None, None, None, None)
+def _naive_causal(q, k, v):
+    """(B, H, S, D) causal reference."""
+    import jax.numpy as jnp
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    mask = np.tril(np.ones(s.shape[-2:], bool))
+    s = np.where(mask, s, -1e9)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def test_block_multihead_attention_prefill_and_decode():
+    """Paged-KV attention vs naive causal attention (reference:
+    test/legacy_test/test_block_multihead_attention.py): prefill writes
+    pages + causal attn; a decode step appends one token per seq."""
+    rng = np.random.RandomState(7)
+    bsz, s, hq, hk, d, bs = 2, 8, 4, 2, 16, 4
+    max_blocks = 8
+    q = rng.randn(bsz, hq, s, d).astype(np.float32)
+    k = rng.randn(bsz, hk, s, d).astype(np.float32)
+    v = rng.randn(bsz, hk, s, d).astype(np.float32)
+
+    tok = bsz * s
+    qkv = np.concatenate([
+        q.transpose(0, 2, 1, 3).reshape(tok, hq * d),
+        k.transpose(0, 2, 1, 3).reshape(tok, hk * d),
+        v.transpose(0, 2, 1, 3).reshape(tok, hk * d)], axis=1)
+
+    cache_k = paddle.to_tensor(np.zeros((max_blocks, hk, bs, d), np.float32))
+    cache_v = paddle.to_tensor(np.zeros((max_blocks, hk, bs, d), np.float32))
+    block_tables = np.array([[0, 1, 2, -1], [3, 4, 5, -1]], np.int32)
+
+    out, _, cache_k, cache_v = IF.block_multihead_attention(
+        paddle.to_tensor(qkv), cache_k, cache_v,
+        np.array([s, s], np.int32),        # seq_lens_encoder (prefill)
+        np.array([0, 0], np.int32),        # seq_lens_decoder
+        np.array([s, s], np.int32),        # seq_lens_this_time
+        block_tables=block_tables, block_size=bs)
+
+    krep = np.repeat(k, hq // hk, axis=1)
+    vrep = np.repeat(v, hq // hk, axis=1)
+    ref = _naive_causal(q, krep, vrep)            # (b, hq, s, d)
+    ref_tok = ref.transpose(0, 2, 1, 3).reshape(tok, hq * d)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref_tok,
+                               rtol=2e-5, atol=2e-5)
+
+    # ---- decode: one new token per sequence at position s ----
+    q2 = rng.randn(bsz, hq, 1, d).astype(np.float32)
+    k2 = rng.randn(bsz, hk, 1, d).astype(np.float32)
+    v2 = rng.randn(bsz, hk, 1, d).astype(np.float32)
+    qkv2 = np.concatenate([
+        q2.transpose(0, 2, 1, 3).reshape(bsz, hq * d),
+        k2.transpose(0, 2, 1, 3).reshape(bsz, hk * d),
+        v2.transpose(0, 2, 1, 3).reshape(bsz, hk * d)], axis=1)
+    out2, _, cache_k, cache_v = IF.block_multihead_attention(
+        paddle.to_tensor(qkv2), cache_k, cache_v,
+        np.array([0, 0], np.int32),
+        np.array([s, s], np.int32),        # decode at position s
+        np.array([1, 1], np.int32),
+        block_tables=block_tables, block_size=bs)
+
+    qf = np.concatenate([q, q2], axis=2)
+    kf = np.repeat(np.concatenate([k, k2], axis=2), hq // hk, axis=1)
+    vf = np.repeat(np.concatenate([v, v2], axis=2), hq // hk, axis=1)
+    ref2 = _naive_causal(qf, kf, vf)[:, :, -1]    # (b, hq, d) last token
+    np.testing.assert_allclose(
+        np.asarray(out2.numpy()), ref2.reshape(bsz, hq * d),
+        rtol=2e-5, atol=2e-5)
+
+
+def test_block_multihead_attention_quant_unsupported():
+    with pytest.raises(NotImplementedError, match="quant"):
+        IF.block_multihead_attention(None, None, None, None, None, None,
+                                     cache_k_quant_scales=1)
 
 
 def test_variable_length_attention_scale():

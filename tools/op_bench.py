@@ -57,7 +57,53 @@ def _cases():
                       lambda: paddle.transpose(v, [0, 2, 1])),
         "cumsum": ("(4,512,1024) cumsum",
                    lambda: paddle.cumsum(v, axis=-1)),
+        "flash_fwd": ("(2,2048,8|2,64) bf16 causal GQA flash fwd",
+                      _flash_fwd_case(rng)),
+        "flash_fwd_bwd": ("(2,2048,8|2,64) bf16 causal GQA flash fwd+bwd",
+                          _flash_bwd_case(rng)),
     }
+
+
+def _flash_qkv(rng):
+    import jax.numpy as jnp
+    q = jnp.asarray(rng.randn(2, 8, 2048, 64), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(2, 2, 2048, 64), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(2, 2, 2048, 64), jnp.bfloat16)
+    return q, k, v
+
+
+def _flash_fwd_case(rng):
+    import jax
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.kernels.flash_attention import flash_attention_bhsd
+    q, k, v = _flash_qkv(rng)
+    f = jax.jit(lambda q, k, v: flash_attention_bhsd(q, k, v, causal=True))
+
+    def run():
+        # precision context must surround the TRACING call (first run),
+        # not jit construction, to reach dots without explicit precision
+        with jax.default_matmul_precision("default"):
+            return Tensor(f(q, k, v))
+    return run
+
+
+def _flash_bwd_case(rng):
+    import jax
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.kernels.flash_attention import flash_attention_bhsd
+    q, k, v = _flash_qkv(rng)
+
+    def loss(q, k, v):
+        import jax.numpy as jnp
+        return flash_attention_bhsd(q, k, v, causal=True).astype(
+            jnp.float32).sum()
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    def run():
+        with jax.default_matmul_precision("default"):
+            return Tensor(g(q, k, v)[0])
+    return run
 
 
 def _time_one(fn, warmup=2, iters=10):
