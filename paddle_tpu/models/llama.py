@@ -207,13 +207,18 @@ class LlamaForCausalLM(nn.Layer):
         logits = self.logits(h)
         if labels is None:
             return logits
-        # next-token prediction: logits[:, :-1] vs labels[:, 1:]
-        shift_logits = logits[:, :-1, :]
-        shift_labels = labels[:, 1:]
+        # next-token prediction: position t scores labels[t+1]. Shift the
+        # LABELS (tiny) and mark the last position ignore_index instead of
+        # slicing logits[:, :-1] — at (B*S, vocab) that slice is a
+        # multi-hundred-MB copy XLA materializes before the loss.
+        # cross_entropy's mean already excludes ignored positions.
+        b = labels.shape[0]
+        shifted = T.concat(
+            [labels[:, 1:], T.full([b, 1], -100, labels.dtype)], axis=1)
         loss = F.cross_entropy(
-            T.reshape(shift_logits, [-1, self.config.vocab_size]),
-            T.reshape(shift_labels, [-1]),
-            reduction="mean")
+            T.reshape(logits, [-1, self.config.vocab_size]),
+            T.reshape(shifted, [-1]),
+            ignore_index=-100, reduction="mean")
         return loss, logits
 
 
