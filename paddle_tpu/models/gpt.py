@@ -116,9 +116,6 @@ class GPTForCausalLM(nn.Layer):
         logits = self.logits(hidden)
         if labels is None:
             return logits
-        shift_logits = logits[:, :-1, :]
-        shift_labels = labels[:, 1:]
-        loss = nn.functional.cross_entropy(
-            T.reshape(shift_logits, [-1, shift_logits.shape[-1]]),
-            T.reshape(shift_labels, [-1]))
+        from paddle_tpu.models.llama import next_token_loss
+        loss = next_token_loss(logits, labels, logits.shape[-1])
         return loss, logits

@@ -63,6 +63,22 @@ class LlamaConfig:
         return self.hidden_size // self.num_attention_heads
 
 
+def next_token_loss(logits, labels, vocab_size):
+    """Shifted next-token cross entropy: position t scores labels[t+1].
+    Shifts the LABELS (tiny) and marks the final position ignore_index
+    instead of slicing logits[:, :-1] — at (B*S, vocab) that slice is a
+    multi-hundred-MB copy XLA materializes before the loss.
+    cross_entropy's mean already excludes ignored positions (and any
+    user-supplied -100 padding)."""
+    b = labels.shape[0]
+    shifted = T.concat(
+        [labels[:, 1:], T.full([b, 1], -100, labels.dtype)], axis=1)
+    return F.cross_entropy(
+        T.reshape(logits, [-1, vocab_size]),
+        T.reshape(shifted, [-1]),
+        ignore_index=-100, reduction="mean")
+
+
 def llama3_8b_config(**overrides) -> LlamaConfig:
     return LlamaConfig(**overrides)
 
@@ -207,18 +223,7 @@ class LlamaForCausalLM(nn.Layer):
         logits = self.logits(h)
         if labels is None:
             return logits
-        # next-token prediction: position t scores labels[t+1]. Shift the
-        # LABELS (tiny) and mark the last position ignore_index instead of
-        # slicing logits[:, :-1] — at (B*S, vocab) that slice is a
-        # multi-hundred-MB copy XLA materializes before the loss.
-        # cross_entropy's mean already excludes ignored positions.
-        b = labels.shape[0]
-        shifted = T.concat(
-            [labels[:, 1:], T.full([b, 1], -100, labels.dtype)], axis=1)
-        loss = F.cross_entropy(
-            T.reshape(logits, [-1, self.config.vocab_size]),
-            T.reshape(shifted, [-1]),
-            ignore_index=-100, reduction="mean")
+        loss = next_token_loss(logits, labels, self.config.vocab_size)
         return loss, logits
 
 

@@ -147,11 +147,8 @@ class Qwen2MoeForCausalLM(nn.Layer):
         logits = self.lm_head(h)
         if labels is None:
             return logits
-        shift_logits = logits[:, :-1, :]
-        shift_labels = labels[:, 1:]
-        loss = F.cross_entropy(
-            T.reshape(shift_logits, [-1, self.config.vocab_size]),
-            T.reshape(shift_labels, [-1]), reduction="mean")
+        from paddle_tpu.models.llama import next_token_loss
+        loss = next_token_loss(logits, labels, self.config.vocab_size)
         auxes = self.model.aux_losses()
         if auxes:
             total_aux = auxes[0]

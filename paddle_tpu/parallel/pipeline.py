@@ -221,13 +221,21 @@ class PipelineTrainer(Trainer):
         logits = self._head_logits(other, h)
         if labels is None:
             return jnp.zeros((), jnp.float32)
-        shift_logits = logits[:, :-1, :].astype(jnp.float32)
-        shift_labels = labels[:, 1:]
-        logz = jax.nn.logsumexp(shift_logits, axis=-1)
+        # shift the labels, not the logits: slicing logits[:, :-1] copies
+        # the (B*S, vocab) tensor (see models/llama.py next_token_loss).
+        # Final position and user -100 padding are masked out of the mean.
+        lf = logits.astype(jnp.float32)
+        shifted = jnp.concatenate(
+            [labels[:, 1:],
+             jnp.full((labels.shape[0], 1), -100, labels.dtype)], axis=1)
+        keep = shifted != -100
+        logz = jax.nn.logsumexp(lf, axis=-1)
         tgt = jnp.take_along_axis(
-            shift_logits, shift_labels[..., None].astype(jnp.int32),
+            lf, jnp.where(keep, shifted, 0)[..., None].astype(jnp.int32),
             axis=-1)[..., 0]
-        return jnp.mean(logz - tgt).astype(jnp.float32)
+        per = (logz - tgt) * keep
+        return (per.sum()
+                / jnp.maximum(keep.sum(), 1)).astype(jnp.float32)
 
     def _embed_prefix(self):
         for n in self.params:
