@@ -126,11 +126,94 @@ def is_pylayer_supported():
     return True
 
 
-def hessian(func, xs, batch_axis=None):
-    raise NotImplementedError(
-        "Use paddle_tpu.jit: jax.hessian over a traced function.")
+def _functionalize(func, xs):
+    """Adapt a Tensor->Tensor function (and its Tensor inputs) to arrays
+    for jax functional transforms."""
+    xs_list = list(xs) if isinstance(xs, (list, tuple)) else [xs]
+    arrs = tuple(x._value if isinstance(x, Tensor) else x for x in xs_list)
+
+    def fn(*a):
+        out = func(*[Tensor(v, stop_gradient=False) for v in a])
+        return out._value if isinstance(out, Tensor) else out
+
+    single = not isinstance(xs, (list, tuple))
+    return fn, arrs, single
 
 
-def jacobian(func, xs, batch_axis=None):
-    raise NotImplementedError(
-        "Use paddle_tpu.jit: jax.jacobian over a traced function.")
+def _tape_jacobian(ys, xs):
+    """Row-by-row jacobian of a COMPUTED Tensor vs its inputs through the
+    eager tape (grad_outputs = basis vectors, graph retained)."""
+    from paddle_tpu.core.tape import grad as tape_grad
+
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    y = ys._value
+    n = 1
+    for d in y.shape:
+        n *= int(d)
+    rows = [[] for _ in xs_list]
+    for i in range(n):
+        seed = jnp.zeros((n,), y.dtype).at[i].set(1.0).reshape(y.shape)
+        gs = tape_grad(ys, xs_list, grad_outputs=Tensor(seed),
+                       retain_graph=True, allow_unused=True)
+        for slot, g in zip(rows, gs):
+            slot.append(None if g is None else g._value)
+    outs = []
+    for x, row in zip(xs_list, rows):
+        row = [jnp.zeros_like(x._value) if r is None else r for r in row]
+        jac = jnp.stack([r.reshape(-1) for r in row]
+                        ).reshape(tuple(y.shape) + tuple(x._value.shape))
+        outs.append(Tensor(jac))
+    return outs[0] if single else type(xs)(outs)
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """d ys / d xs (reference: python/paddle/autograd/autograd.py:450).
+
+    Two forms:
+    - reference-parity: `ys` is a COMPUTED Tensor — rows come from the
+      eager tape (one vjp per output element, like the reference's lazy
+      Jacobian rows). batch_axis is not supported in this form.
+    - TPU-native extension: `ys` is a CALLABLE f(xs) — the whole Jacobian
+      is one traced jax.jacrev (fast, jit-compatible); batch_axis=0 vmaps
+      it per sample.
+    """
+    if not callable(ys):
+        if batch_axis is not None:
+            raise NotImplementedError(
+                "batch_axis requires the callable form: "
+                "autograd.jacobian(lambda x: ..., xs, batch_axis=0)")
+        return _tape_jacobian(ys, xs)
+    fn, arrs, single = _functionalize(ys, xs)
+    argnums = 0 if single else tuple(range(len(arrs)))
+    jac = jax.jacrev(fn, argnums=argnums)
+    if batch_axis is not None:
+        if batch_axis != 0:
+            raise ValueError("batch_axis must be None or 0")
+        jac = jax.vmap(jac)
+    out = jac(*arrs)
+    return (Tensor(out) if single
+            else type(xs)(Tensor(o) for o in out))
+
+
+def hessian(ys, xs, batch_axis=None):
+    """d^2 ys / d xs^2 for scalar ys (reference:
+    python/paddle/autograd/autograd.py:544), via jax.hessian. Requires
+    the CALLABLE form — the eager tape does not support double grad
+    (create_graph); pass the function, not the computed Tensor."""
+    if not callable(ys):
+        raise NotImplementedError(
+            "hessian needs second-order autodiff, which the eager tape "
+            "does not provide; pass a callable instead: "
+            "autograd.hessian(lambda x: f(x), xs)")
+    fn, arrs, single = _functionalize(ys, xs)
+    argnums = 0 if single else tuple(range(len(arrs)))
+    hes = jax.hessian(fn, argnums=argnums)
+    if batch_axis is not None:
+        if batch_axis != 0:
+            raise ValueError("batch_axis must be None or 0")
+        hes = jax.vmap(hes)
+    out = hes(*arrs)
+    if single:
+        return Tensor(out)
+    return type(xs)(type(xs)(Tensor(c) for c in row) for row in out)

@@ -201,6 +201,9 @@ def upsample(x, size=None, scale_factor=None, mode="nearest",
 
 @defop("pixel_shuffle")
 def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"data_format must be NCHW or NHWC, got "
+                         f"{data_format!r}")
     r = upscale_factor
     if data_format == "NCHW":
         n, c, h, w = x.shape
@@ -210,30 +213,46 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
         return x.reshape(n, oc, h * r, w * r)
     n, h, w, c = x.shape
     oc = c // (r * r)
-    x = x.reshape(n, h, w, r, r, oc)
-    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    # input channels interpreted (oc, rh, rw), matching the reference's
+    # NHWC reshape + axis {0,1,4,2,5,3} (pixel_shuffle_kernel_impl.h:42)
+    x = x.reshape(n, h, w, oc, r, r)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
     return x.reshape(n, h * r, w * r, oc)
 
 
 @defop("pixel_unshuffle")
 def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"data_format must be NCHW or NHWC, got "
+                         f"{data_format!r}")
     r = downscale_factor
     if data_format == "NCHW":
         n, c, h, w = x.shape
         x = x.reshape(n, c, h // r, r, w // r, r)
         x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
         return x.reshape(n, c * r * r, h // r, w // r)
-    raise NotImplementedError
+    n, h, w, c = x.shape                       # NHWC
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    # out channels ordered (c, rh, rw), matching the reference's NHWC
+    # transpose axis {0,1,3,5,2,4} (pixel_unshuffle_kernel_impl.h:43)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return x.reshape(n, h // r, w // r, c * r * r)
 
 
 @defop("channel_shuffle")
 def channel_shuffle(x, groups, data_format="NCHW"):
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"data_format must be NCHW or NHWC, got "
+                         f"{data_format!r}")
     if data_format == "NCHW":
         n, c, h, w = x.shape
         x = x.reshape(n, groups, c // groups, h, w)
         x = jnp.swapaxes(x, 1, 2)
         return x.reshape(n, c, h, w)
-    raise NotImplementedError
+    n, h, w, c = x.shape                       # NHWC
+    x = x.reshape(n, h, w, groups, c // groups)
+    x = jnp.swapaxes(x, 3, 4)
+    return x.reshape(n, h, w, c)
 
 
 # ---------------------------------------------------------------------------

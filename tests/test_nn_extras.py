@@ -321,3 +321,32 @@ def test_grid_sample_reflection_matches_torch():
         torch.tensor(x), torch.tensor(grid), mode="bilinear",
         padding_mode="reflection", align_corners=True).numpy()
     np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pixel_shuffle_nhwc_matches_nchw():
+    """NHWC channel ordering must match the reference kernels
+    (pixel_shuffle_kernel_impl.h / pixel_unshuffle_kernel_impl.h /
+    channel_shuffle_kernel_impl.h): cross-check every NHWC op against its
+    NCHW counterpart through layout transposes, plus round-trips."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import functional as F
+    rng = np.random.RandomState(0)
+    x_nchw = rng.randn(2, 8, 4, 6).astype(np.float32)   # c=8, r=2
+    t = paddle.to_tensor
+
+    def nchw2nhwc(a):
+        return np.transpose(a, (0, 2, 3, 1))
+
+    for op, arg in ((F.pixel_shuffle, 2), (F.pixel_unshuffle, 2),
+                    (F.channel_shuffle, 4)):
+        ref = np.asarray(op(t(x_nchw), arg).numpy())
+        got = np.asarray(op(t(nchw2nhwc(x_nchw)), arg,
+                            data_format="NHWC").numpy())
+        np.testing.assert_allclose(got, nchw2nhwc(ref), rtol=0, atol=0)
+
+    # round-trip in NHWC
+    xh = t(nchw2nhwc(x_nchw))
+    back = F.pixel_shuffle(F.pixel_unshuffle(xh, 2, data_format="NHWC"),
+                           2, data_format="NHWC")
+    np.testing.assert_allclose(np.asarray(back.numpy()),
+                               nchw2nhwc(x_nchw), rtol=0, atol=0)
