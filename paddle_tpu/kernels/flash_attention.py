@@ -9,9 +9,13 @@ TPU-native implementation:
   online-softmax accumulation over k/v blocks streamed through VMEM, MXU
   matmuls in f32 accumulation. Causal cells whose k-block lies entirely
   above the diagonal are skipped via the loop bound. Also emits the
-  row logsumexp (LSE) for the backward pass, lane-replicated to 128
-  (the TPU min tile width) like jax's reference TPU kernel.
-- backward: two Pallas kernels in FA2 style —
+  row logsumexp (LSE) for the backward pass, lane-replicated to
+  _lanes_for() width (8 when the fused backward consumes it, else 128).
+- backward, small kv (the common training shape after the GQA fold):
+  ONE fused Pallas kernel — grid (b, h, q-block), k/v + full-kv f32
+  dk/dv scratch VMEM-resident — produces dq, dk and dv from a single
+  softmax recompute (_bwd_fused_kernel).
+- backward, larger kv: two Pallas kernels in FA2 style —
     dq: grid (b, h, q-block); recompute p from q,k and the saved LSE,
         ds = p * (dO·vT - delta), accumulate dq += ds @ k.
     dkv: grid (b, h, k-block); loop over q-blocks at/below the diagonal,
@@ -46,10 +50,21 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 _LOG2E = 1.4426950408889634  # kernels exponentiate in base 2: exp(x) = exp2(x*log2e)
-# LSE/delta lane replication width. 128 = native lane tile. Measured on
-# v5e: narrowing to 8 (16x less HBM bytes) is ~3% SLOWER end-to-end —
-# sub-lane-width f32 tiles DMA less efficiently than full 128-lane rows.
-_LANES = int(_os.environ.get("PADDLE_TPU_FLASH_LSE_LANES", 128))
+# LSE/delta lane replication width, chosen per call by _lanes_for():
+# 8 (min f32 tile) when the fused backward will run — it reads each
+# lse/delta block exactly ONCE per (b, h) sweep, so narrow blocks just
+# cut HBM bytes and the XLA delta broadcast 16x; 128 (native lane tile)
+# for the dq/dkv pair and streamed-kv paths, which RE-read lse/delta
+# across the kv grid axis — there round 1 measured 8 lanes ~3% slower
+# (many small narrow DMAs). Env var overrides both.
+_LANES_ENV = _os.environ.get("PADDLE_TPU_FLASH_LSE_LANES")
+if _LANES_ENV is not None:
+    _LANES_ENV = int(_LANES_ENV)
+    if _LANES_ENV < 8 or _LANES_ENV % 8:
+        raise ValueError(
+            f"PADDLE_TPU_FLASH_LSE_LANES={_LANES_ENV}: must be a multiple "
+            "of 8 (the f32 sublane tile) — smaller/unaligned values fail "
+            "Mosaic lowering at runtime")
 
 # Tuning knobs (swept on v5e: (512,512) best in the full train step; larger
 # q-blocks win in kernel isolation but lose in context)
@@ -151,7 +166,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     if lse_ref is not None:
         lse = m + jnp.log2(jnp.maximum(l, 1e-30))   # base-2, matches bwd
-        lse_ref[0, 0] = jnp.broadcast_to(lse, (bq, _LANES))
+        lse_ref[0, 0] = jnp.broadcast_to(lse, (bq, lse_ref.shape[3]))
 
 
 def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
@@ -225,7 +240,7 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
         if lse_ref is not None:
             lse = m_scr[:, :1] + jnp.log2(l)
-            lse_ref[0, 0] = jnp.broadcast_to(lse, (bq, _LANES))
+            lse_ref[0, 0] = jnp.broadcast_to(lse, (bq, lse_ref.shape[3]))
 
 
 # whole-k/v per grid cell is faster but caps kv length; beyond this byte
@@ -239,6 +254,19 @@ def _auto_stream_kv(sk_p, d, itemsize):
     v each sk_p*d elements). Shared by fwd and bwd so both directions
     always pick the same kernel layout."""
     return sk_p * d * 2 * itemsize > _KV_VMEM_BYTES
+
+
+def _lanes_for(sk_p, d, itemsize):
+    """LSE/delta lane width for the given kv size: 8 when the fused
+    backward will consume them (each block read once), 128 for the
+    dq/dkv-pair and streamed paths that re-read them per kv block (see
+    the comment at _LANES_ENV). fwd and bwd derive the same answer from
+    the same shapes, and bwd additionally follows lse.shape[-1]."""
+    if _LANES_ENV is not None:
+        return _LANES_ENV
+    fused = (not _auto_stream_kv(sk_p, d, itemsize)
+             and sk_p * d * 2 * itemsize <= _FUSED_KV_BYTES)
+    return 8 if fused else 128
 
 
 def _ki_clamp(bq, bk, causal, seg_len):
@@ -282,6 +310,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
     kt = jnp.swapaxes(k, 2, 3)   # (b, h, d, sk): XLA fuses the transpose
     if stream_kv is None:
         stream_kv = _auto_stream_kv(sk_p, d, k.dtype.itemsize)
+    lanes = _lanes_for(sk_p, d, k.dtype.itemsize)
 
     if stream_kv:
         kernel = functools.partial(
@@ -298,10 +327,10 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
             pl.BlockSpec((1, 1, bk, d),
                          lambda bi, hi, qi, ki: (bi, hi, clamp(qi, ki), 0)),
         ]
-        lspec = pl.BlockSpec((1, 1, bq, _LANES),
+        lspec = pl.BlockSpec((1, 1, bq, lanes),
                              lambda bi, hi, qi, ki: (bi, hi, qi, 0))
-        scratch = [pltpu.VMEM((bq, _LANES), jnp.float32),
-                   pltpu.VMEM((bq, _LANES), jnp.float32),
+        scratch = [pltpu.VMEM((bq, lanes), jnp.float32),
+                   pltpu.VMEM((bq, lanes), jnp.float32),
                    pltpu.VMEM((bq, d), jnp.float32)]
     else:
         kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
@@ -317,7 +346,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
             pl.BlockSpec((1, 1, sk_p, d),
                          lambda bi, hi, qi: (bi, hi, 0, 0)),
         ]
-        lspec = pl.BlockSpec((1, 1, bq, _LANES),
+        lspec = pl.BlockSpec((1, 1, bq, lanes),
                              lambda bi, hi, qi: (bi, hi, qi, 0))
         scratch = []
     out_specs = [qspec]
@@ -325,7 +354,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
     if save_lse:
         out_specs.append(lspec)
         out_shape.append(
-            jax.ShapeDtypeStruct((b, h, sq_p, _LANES), jnp.float32))
+            jax.ShapeDtypeStruct((b, h, sq_p, lanes), jnp.float32))
     else:
         kernel = functools.partial(
             lambda q_ref, k_ref, v_ref, o_ref, *scr, kern: kern(
@@ -554,10 +583,115 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                      sm_scale, causal, block_k, q_valid, kv_valid,
+                      nq_total, seg_len=None):
+    """Single-pass FA2 backward: dq, dk and dv from ONE softmax recompute.
+
+    Grid (b, h, jq). Per (b, h): k/v stay VMEM-resident (constant block
+    index => one DMA); q/do and the narrow (8-lane) lse/delta stream per
+    q-block — each block is read exactly once per (b, h) sweep, so this
+    costs the same HBM bytes as keeping them resident. dq accumulates in
+    the fori_loop carry and writes per cell; dk/dv accumulate across the
+    whole jq sweep in full-kv f32 scratch and store once at the last jq
+    (the dk/dv output block index is constant per (b, h), so Pallas
+    flushes it exactly once).
+
+    vs the round-1 dq+dkv kernel pair this halves the softmax recompute
+    (the dominant VPU cost: ds is shared by dk AND dq), reads each
+    lse/delta element once instead of once per kv block, and needs no
+    extra matmul for dq beyond ds_t @ k (ds is already in registers).
+    Everything runs in the transposed (bk, bq) orientation so no
+    (bq, bk) block ever needs a transpose.
+    """
+    bq, d = q_ref.shape[2], q_ref.shape[3]
+    kv_pad = k_ref.shape[2]
+    jq = pl.program_id(2)
+
+    @pl.when(jq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    qj = q_ref[0, 0] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype)  # (bq,d)
+    doj = do_ref[0, 0]                                              # (bq,d)
+    lse_t = lse_ref[0, 0, :, :1].T                                  # (1,bq)
+    delta_t = delta_ref[0, 0, :, :1].T                              # (1,bq)
+    prec = _prec(q_ref.dtype)
+
+    start_g = jq * bq                    # global row (q_valid mask)
+    start = start_g % seg_len if seg_len is not None else start_g
+    nk_total = kv_pad // block_k
+    if causal:
+        nk = jnp.minimum((start + bq + block_k - 1) // block_k, nk_total)
+        n_full = jnp.minimum(start // block_k, kv_valid // block_k)
+    else:
+        nk = nk_total
+        n_full = kv_valid // block_k
+    # rows past q_valid must not contribute to dk/dv: no mask-free blocks
+    # unless every row of this q-block is valid
+    n_full = jnp.where((jq + 1) * bq <= q_valid, n_full, 0)
+
+    def body(j, dq_acc, masked=True):
+        kj = k_ref[0, 0, pl.ds(j * block_k, block_k), :]   # (bk, d)
+        vj = v_ref[0, 0, pl.ds(j * block_k, block_k), :]   # (bk, d)
+        s_t = jax.lax.dot_general(
+            kj, qj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (bk,bq)
+        if masked:
+            col = jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, bq), 0) + j * block_k
+            row_g = jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, bq), 1) + start_g
+            valid = jnp.logical_and(col < kv_valid, row_g < q_valid)
+            if causal:
+                row_c = jax.lax.broadcasted_iota(
+                    jnp.int32, (block_k, bq), 1) + start
+                valid = jnp.logical_and(valid, col <= row_c)
+            s_t = jnp.where(valid, s_t, _NEG_INF)
+        p_t = jnp.exp2(s_t - lse_t)                              # (bk,bq)
+        dv_scr[pl.ds(j * block_k, block_k)] += jax.lax.dot_general(
+            p_t.astype(doj.dtype), doj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (bk,d)
+        dp_t = jax.lax.dot_general(
+            vj, doj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (bk,bq)
+        ds_t = p_t * (dp_t - delta_t) * sm_scale                 # true ds^T
+        ds_lp = ds_t.astype(qj.dtype)
+        dk_scr[pl.ds(j * block_k, block_k)] += jax.lax.dot_general(
+            ds_lp, qj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (bk,d)
+        return dq_acc + jax.lax.dot_general(
+            ds_lp, kj, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)  # (bq,d)
+
+    dq0 = jnp.zeros((bq, d), jnp.float32)
+    dq_acc = jax.lax.fori_loop(0, n_full,
+                               functools.partial(body, masked=False), dq0)
+    dq_acc = jax.lax.fori_loop(n_full, nk, body, dq_acc)
+    dq_ref[0, 0] = dq_acc.astype(dq_ref.dtype)
+
+    @pl.when(jq == nq_total - 1)
+    def _store():
+        # dk accumulated against the log2e/sm_scale-prescaled q; undo it
+        dk_ref[0, 0] = (dk_scr[...] / (sm_scale * _LOG2E)).astype(
+            dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+# fused single-kernel backward needs k+v resident AND full-kv f32 dk/dv
+# scratch (2x k+v bytes in f32) in VMEM; above this k+v byte budget fall
+# back to the round-1 dq + dkv kernel pair
+_FUSED_KV_BYTES = int(_os.environ.get("PADDLE_TPU_FLASH_FUSED_KV",
+                                      2 * 1024 * 1024))
+
+
 def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
                       block_q=None, block_k=None, interpret=False,
-                      seg_len=None, stream_kv=None):
-    """FA2 backward. q,k,v,o,g: (B,H,S,D); lse: (B,H,Sq_pad,128) f32."""
+                      seg_len=None, stream_kv=None, fused=None):
+    """FA2 backward. q,k,v,o,g: (B,H,S,D); lse: (B,H,Sq_pad,lanes) f32
+    (lane width set by the forward via _lanes_for)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq = min(block_q or _BLOCK_Q_BWD, sq)
@@ -568,7 +702,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
     sk_p = (sk + bk - 1) // bk * bk
 
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], delta.shape + (_LANES,))
+    lanes = lse.shape[3]
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (lanes,))
     # lse was padded with the FORWARD block size; reconcile to ours
     # (padded rows are masked in dkv and sliced off dq, values don't matter)
     if lse.shape[2] > sq_p:
@@ -588,6 +723,41 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
 
     if stream_kv is None:
         stream_kv = _auto_stream_kv(sk_p, d, k.dtype.itemsize)
+    if fused is None:
+        fused = (not stream_kv
+                 and sk_p * d * 2 * k.dtype.itemsize <= _FUSED_KV_BYTES)
+    elif fused and stream_kv:
+        raise ValueError(
+            "fused=True requires the whole-kv layout but stream_kv "
+            "resolved True for this kv size; pass stream_kv=False or "
+            "raise PADDLE_TPU_FLASH_KV_VMEM")
+
+    if fused:
+        qspec = pl.BlockSpec((1, 1, bq, d),
+                             lambda bi, hi, qi: (bi, hi, qi, 0))
+        kres = pl.BlockSpec((1, 1, sk_p, d),
+                            lambda bi, hi, qi: (bi, hi, 0, 0))
+        # lse/delta stream per q-block: each block is read exactly once
+        # per (b, h) sweep, so streaming costs the same HBM bytes as
+        # whole-resident, without dynamic sublane slicing in-kernel
+        lres = pl.BlockSpec((1, 1, bq, lanes),
+                            lambda bi, hi, qi: (bi, hi, qi, 0))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, sm_scale=sm_scale,
+                              causal=causal, block_k=bk, q_valid=sq,
+                              kv_valid=sk, nq_total=sq_p // bq,
+                              seg_len=seg_len),
+            grid=(b, h, sq_p // bq),
+            in_specs=[qspec, kres, kres, qspec, lres, lres],
+            out_specs=[qspec, kres, kres],
+            out_shape=[jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+                       jax.ShapeDtypeStruct((b, h, sk_p, d), k.dtype),
+                       jax.ShapeDtypeStruct((b, h, sk_p, d), v.dtype)],
+            scratch_shapes=[pltpu.VMEM((sk_p, d), jnp.float32),
+                            pltpu.VMEM((sk_p, d), jnp.float32)],
+            interpret=interpret,
+        )(q, k, v, g, lse, delta)
+        return (dq[:, :, :sq, :], dk[:, :, :sk, :], dv[:, :, :sk, :])
 
     if stream_kv:
         clamp = _ki_clamp(bq, bk, causal, seg_len)
@@ -596,7 +766,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
         kspec4q = pl.BlockSpec((1, 1, bk, d),
                                lambda bi, hi, qi, ki: (bi, hi,
                                                        clamp(qi, ki), 0))
-        lspec4q = pl.BlockSpec((1, 1, bq, _LANES),
+        lspec4q = pl.BlockSpec((1, 1, bq, lanes),
                                lambda bi, hi, qi, ki: (bi, hi, qi, 0))
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel_stream, sm_scale=sm_scale,
@@ -614,7 +784,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
                              lambda bi, hi, qi: (bi, hi, qi, 0))
         kfull = pl.BlockSpec((1, 1, sk_p, d),
                              lambda bi, hi, qi: (bi, hi, 0, 0))
-        lspec = pl.BlockSpec((1, 1, bq, _LANES),
+        lspec = pl.BlockSpec((1, 1, bq, lanes),
                              lambda bi, hi, qi: (bi, hi, qi, 0))
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
@@ -632,7 +802,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
                           lambda bi, hi, ki, qi: (bi, hi, ki, 0))
     qspec4 = pl.BlockSpec((1, 1, bq, d),
                           lambda bi, hi, ki, qi: (bi, hi, qi, 0))
-    lspec4 = pl.BlockSpec((1, 1, bq, _LANES),
+    lspec4 = pl.BlockSpec((1, 1, bq, lanes),
                           lambda bi, hi, ki, qi: (bi, hi, qi, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,

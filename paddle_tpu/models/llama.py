@@ -52,6 +52,16 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     initializer_range: float = 0.02
     use_flash_attention: bool = False
+    # single (d, d + 2*kv) qkv matmul / single (d, 2*f) gate-up matmul
+    # (PaddleNLP LlamaConfig.fuse_attention_qkv / fuse_attention_ffn):
+    # fewer, larger MXU matmuls and one fused dW in the backward.
+    # CAVEAT under tensor parallel: the fused output dim is sharded
+    # contiguously over 'mp', so the q/k/v (or gate/up) split boundaries
+    # cut mid-shard and GSPMD inserts a reshard per layer — prefer the
+    # unfused projections on mp>1 meshes until a per-rank-interleaved
+    # fused layout exists (PaddleNLP interleaves the fused weight).
+    fuse_attention_qkv: bool = False
+    fuse_attention_ffn: bool = False
     # rerun each decoder layer's forward during backward instead of saving
     # activations (fleet.utils.recompute equivalent -> jax.checkpoint)
     recompute: bool = False
@@ -105,17 +115,29 @@ class LlamaAttention(nn.Layer):
         kv_out = config.num_key_value_heads * hd
         init = nn.initializer.Normal(0.0, config.initializer_range)
         attr = paddle_tpu.nn.ParamAttr(initializer=init)
-        self.q_proj = nn.Linear(d, d, weight_attr=attr, bias_attr=False)
-        self.k_proj = nn.Linear(d, kv_out, weight_attr=attr, bias_attr=False)
-        self.v_proj = nn.Linear(d, kv_out, weight_attr=attr, bias_attr=False)
+        if config.fuse_attention_qkv:
+            self.qkv_proj = nn.Linear(d, d + 2 * kv_out, weight_attr=attr,
+                                      bias_attr=False)
+        else:
+            self.q_proj = nn.Linear(d, d, weight_attr=attr, bias_attr=False)
+            self.k_proj = nn.Linear(d, kv_out, weight_attr=attr,
+                                    bias_attr=False)
+            self.v_proj = nn.Linear(d, kv_out, weight_attr=attr,
+                                    bias_attr=False)
         self.o_proj = nn.Linear(d, d, weight_attr=attr, bias_attr=False)
 
     def forward(self, hidden_states, position_ids=None, attn_mask=None):
         cfg = self.config
         b, s = hidden_states.shape[0], hidden_states.shape[1]
-        q = self.q_proj(hidden_states)
-        k = self.k_proj(hidden_states)
-        v = self.v_proj(hidden_states)
+        if cfg.fuse_attention_qkv:
+            kv_out = cfg.num_key_value_heads * cfg.head_dim
+            qkv = self.qkv_proj(hidden_states)
+            q, k, v = T.split(qkv, [cfg.hidden_size, kv_out, kv_out],
+                              axis=-1)
+        else:
+            q = self.q_proj(hidden_states)
+            k = self.k_proj(hidden_states)
+            v = self.v_proj(hidden_states)
         q = T.reshape(q, [b, s, cfg.num_attention_heads, cfg.head_dim])
         k = T.reshape(k, [b, s, cfg.num_key_value_heads, cfg.head_dim])
         v = T.reshape(v, [b, s, cfg.num_key_value_heads, cfg.head_dim])
@@ -139,11 +161,22 @@ class LlamaMLP(nn.Layer):
         d, f = config.hidden_size, config.intermediate_size
         init = nn.initializer.Normal(0.0, config.initializer_range)
         attr = paddle_tpu.nn.ParamAttr(initializer=init)
-        self.gate_proj = nn.Linear(d, f, weight_attr=attr, bias_attr=False)
-        self.up_proj = nn.Linear(d, f, weight_attr=attr, bias_attr=False)
+        self.fuse_ffn = config.fuse_attention_ffn
+        if self.fuse_ffn:
+            self.gate_up_fused_proj = nn.Linear(d, 2 * f, weight_attr=attr,
+                                                bias_attr=False)
+        else:
+            self.gate_proj = nn.Linear(d, f, weight_attr=attr,
+                                       bias_attr=False)
+            self.up_proj = nn.Linear(d, f, weight_attr=attr,
+                                     bias_attr=False)
         self.down_proj = nn.Linear(f, d, weight_attr=attr, bias_attr=False)
 
     def forward(self, x):
+        if self.fuse_ffn:
+            # swiglu(x) splits the fused gate-up output in half (phi
+            # SwiGLU kernel semantics)
+            return self.down_proj(swiglu(self.gate_up_fused_proj(x)))
         return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
 
 

@@ -66,7 +66,8 @@ def llama_sharding_plan(mesh_axes: Sequence[str]) -> ShardingPlan:
     ep = _axis(mesh_axes, "ep")
     return ShardingPlan([
         (r"embed_tokens\.weight$", P(mp, fsdp)),
-        (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)\.weight$", P(fsdp, mp)),
+        (r"(q_proj|k_proj|v_proj|gate_proj|up_proj"
+         r"|qkv_proj|gate_up_fused_proj)\.weight$", P(fsdp, mp)),
         (r"(o_proj|down_proj)\.weight$", P(mp, fsdp)),
         (r"lm_head\.weight$", P(fsdp, mp)),
         # MoE: stacked (E, d_in, d_out) expert weights, expert dim on 'ep'

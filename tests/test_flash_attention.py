@@ -206,6 +206,40 @@ def test_pallas_streamed_kv_interpret(causal, s, fold):
                                    rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("s,fold", [(64, False), (50, False), (64, True)])
+def test_pallas_fused_bwd_matches_pair_interpret(causal, s, fold):
+    """The fused single-kernel backward (dq+dk+dv, one softmax recompute)
+    must match the dq/dkv kernel pair: aligned, ragged and GQA-folded."""
+    q, k, v = _qkv(s=s)
+    sm = 1.0 / np.sqrt(32)
+    if fold:
+        qh = jnp.swapaxes(q, 1, 2).reshape(2, 2, 2 * s, 32)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        seg = s
+    else:
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.repeat(jnp.swapaxes(k, 1, 2), 2, axis=1)
+        vh = jnp.repeat(jnp.swapaxes(v, 1, 2), 2, axis=1)
+        seg = None
+
+    out, lse = fa._flash_fwd_pallas(qh, kh, vh, causal, sm, block_q=32,
+                                    block_k=32, interpret=True, seg_len=seg)
+    g = jnp.ones_like(out) * 0.3
+    grads_fused = fa._flash_bwd_pallas(qh, kh, vh, out, lse, g, causal, sm,
+                                       block_q=32, block_k=32,
+                                       interpret=True, seg_len=seg,
+                                       fused=True)
+    grads_pair = fa._flash_bwd_pallas(qh, kh, vh, out, lse, g, causal, sm,
+                                      block_q=32, block_k=32,
+                                      interpret=True, seg_len=seg,
+                                      fused=False)
+    for a, b in zip(grads_fused, grads_pair):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+
 def test_bf16_fwd():
     q, k, v = _qkv(dtype=jnp.bfloat16)
     ref = _sdpa_ref(q, k, v, is_causal=True)

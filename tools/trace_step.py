@@ -1,0 +1,109 @@
+"""Dev tool: capture an XLA profiler trace of the bench train step and
+print a per-op-category device-time breakdown.
+
+Usage: python tools/trace_step.py [outdir]
+The trace (tensorboard format) lands in outdir (default /tmp/ptpu_trace);
+the summary groups device events by HLO op-name prefix so the glue
+(copies/reshapes/broadcasts) is visible next to matmuls and the Pallas
+attention kernels.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_step():
+    import numpy as np
+    import paddle_tpu
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models import LlamaForCausalLM, LlamaConfig
+    from paddle_tpu.parallel import Trainer, TrainStepConfig
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=1280, intermediate_size=3584,
+        num_hidden_layers=16, num_attention_heads=20,
+        num_key_value_heads=4, max_position_embeddings=2048,
+        rope_theta=10000.0, seq_length=2048, recompute=False,
+        use_flash_attention=True,
+        fuse_attention_qkv=True, fuse_attention_ffn=False)
+    paddle_tpu.seed(0)
+    model = LlamaForCausalLM(cfg)
+    optimizer = opt.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(), weight_decay=0.01)
+    trainer = Trainer(model, optimizer,
+                      config=TrainStepConfig(compute_dtype="bfloat16"))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, 2048)).astype(np.int32)
+    data = {"input_ids": ids, "labels": ids}
+    return trainer, data
+
+
+def capture(outdir):
+    import jax
+    trainer, data = build_step()
+    float(trainer.step(data))           # compile + warmup
+    with jax.profiler.trace(outdir):
+        for _ in range(3):
+            loss = trainer.step(data)
+        float(loss)
+
+
+def summarize(outdir, top=40):
+    paths = glob.glob(os.path.join(
+        outdir, "plugins/profile/*/*.trace.json.gz"))
+    if not paths:
+        print("no trace.json.gz found under", outdir)
+        return
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    # device events live on TPU pids; find pids whose name mentions TPU/XLA
+    pid_name = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_name[e["pid"]] = e["args"].get("name", "")
+    dev_pids = {p for p, n in pid_name.items()
+                if "TPU" in n or "/device" in n.lower()}
+    import re
+    tot = defaultdict(float)
+    cnt = defaultdict(int)
+    fam = defaultdict(float)
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        name = e.get("name", "")
+        # skip aggregate lanes: bare step numbers and the jit_step span
+        if re.fullmatch(r"\d+", name) or name.startswith("jit_"):
+            continue
+        us = e.get("dur", 0)
+        tot[name] += us
+        cnt[name] += 1
+        fam[re.sub(r"[.\d]+$", "", name)] += us
+    grand = sum(tot.values())
+    print(f"trace: {path}")
+    print(f"total device op time: {grand/1000:.2f} ms over 3 steps "
+          f"(= {grand/3000:.2f} ms/step)\n")
+    print("-- by op family --")
+    print(f"{'family':48s} {'ms/step':>9s} {'%':>6s}")
+    for name, us in sorted(fam.items(), key=lambda kv: -kv[1])[:25]:
+        print(f"{name[:48]:48s} {us/3000:9.3f} {100*us/grand:5.1f}%")
+    print("\n-- top individual ops --")
+    print(f"{'op':62s} {'ms/step':>9s} {'count':>6s} {'%':>6s}")
+    for name, us in sorted(tot.items(), key=lambda kv: -kv[1])[:top]:
+        print(f"{name[:62]:62s} {us/3000:9.3f} {cnt[name]:6d} "
+              f"{100*us/grand:5.1f}%")
+
+
+if __name__ == "__main__":
+    outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/ptpu_trace"
+    capture(outdir)
+    summarize(outdir)
