@@ -79,7 +79,9 @@ def main():
                 # ffn fusion measured SLOWER here (split defeats the
                 # swiglu epilogue fusion); qkv fusion is neutral-positive
                 fuse_attention_qkv=True, fuse_attention_ffn=False)
-            batch, seq, steps = 4, 2048, 10
+            # b6 > b4 since the fused CE freed the ~1GB f32 log-softmax
+            # residual (b8 still HBM-thrashes)
+            batch, seq, steps = 6, 2048, 10
     else:
         cfg = tiny_llama_config(recompute=True)
         batch, seq, steps = 4, 32, 3
