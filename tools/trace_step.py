@@ -41,7 +41,7 @@ def build_step():
     trainer = Trainer(model, optimizer,
                       config=TrainStepConfig(compute_dtype="bfloat16"))
     rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size, (4, 2048)).astype(np.int32)
+    ids = rng.randint(0, cfg.vocab_size, (6, 2048)).astype(np.int32)
     data = {"input_ids": ids, "labels": ids}
     return trainer, data
 
