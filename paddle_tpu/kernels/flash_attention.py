@@ -128,6 +128,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         nk = nk_total
         n_full = kv_valid // block_k
 
+    # with bq == bk, aligned kv and aligned segments, the only masked
+    # block is the diagonal one and its causal mask is the STATIC lower
+    # triangle — loop-invariant, so Mosaic hoists it out of the masked
+    # loop instead of regenerating j-offset iotas per iteration
+    static_tri = (causal and bq == block_k and kv_valid % block_k == 0
+                  and (seg_len is None or seg_len % block_k == 0))
+
     def body(j, carry, masked=True):
         m, l, acc = carry
         kj = k_ref[0, 0, :, pl.ds(j * block_k, block_k)]   # (d, bk)
@@ -139,7 +146,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         # bf16: the package-global 'highest' would force an f32-contract
         # form Mosaic can't lower; bf16 inputs with f32 accumulation IS
         # the full-rate MXU mode
-        if masked:
+        if masked and static_tri:
+            tri = (jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+                   <= jax.lax.broadcasted_iota(jnp.int32, (bq, block_k),
+                                               0))
+            s = jnp.where(tri, s, _NEG_INF)
+        elif masked:
             col = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1) \
                 + j * block_k
             valid = col < kv_valid
@@ -633,13 +645,24 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # unless every row of this q-block is valid
     n_full = jnp.where((jq + 1) * bq <= q_valid, n_full, 0)
 
+    # see _fwd_kernel: on fully-aligned shapes the masked block is the
+    # diagonal one with a STATIC (transposed) triangular mask
+    static_tri = (causal and bq == block_k and kv_valid % block_k == 0
+                  and q_valid % bq == 0
+                  and (seg_len is None or seg_len % block_k == 0))
+
     def body(j, dq_acc, masked=True):
         kj = k_ref[0, 0, pl.ds(j * block_k, block_k), :]   # (bk, d)
         vj = v_ref[0, 0, pl.ds(j * block_k, block_k), :]   # (bk, d)
         s_t = jax.lax.dot_general(
             kj, qj, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (bk,bq)
-        if masked:
+        if masked and static_tri:
+            tri_t = (jax.lax.broadcasted_iota(jnp.int32, (block_k, bq), 0)
+                     <= jax.lax.broadcasted_iota(jnp.int32, (block_k, bq),
+                                                 1))
+            s_t = jnp.where(tri_t, s_t, _NEG_INF)
+        elif masked:
             col = jax.lax.broadcasted_iota(
                 jnp.int32, (block_k, bq), 0) + j * block_k
             row_g = jax.lax.broadcasted_iota(
