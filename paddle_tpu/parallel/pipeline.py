@@ -1,30 +1,55 @@
-"""Pipeline parallelism, compiled (GPipe schedule inside one XLA program).
+"""Pipeline parallelism, compiled (GPipe and 1F1B schedules in one XLA
+program).
 
 The reference implements PP as a Python runtime: PipelineLayer stage
 partitioning + 1F1B/interleave schedulers exchanging activations over NCCL
 p2p (reference: .../meta_parallel/pipeline_parallel.py:440
-forward_backward_pipeline, pp_layers.py:92 SegmentLayers,
-pp_utils/p2p_communication.py:313), plus an actor-based static-mode runtime
-(fleet_executor Carrier/Interceptor, SURVEY.md §2.5).
+forward_backward_pipeline, :906 PipelineParallelWithInterleave,
+pp_layers.py:92 SegmentLayers, pp_utils/p2p_communication.py:313), plus an
+actor-based static-mode runtime (fleet_executor Carrier/Interceptor,
+SURVEY.md §2.5).
 
 TPU-native replacement (SURVEY.md §7 "hardest parts" #2): the schedule is
 DATA, not control flow. The decoder stack's per-layer params are stacked
 with a leading layer dim, reshaped to (stages, layers_per_stage, ...) with
-the stage dim sharded over the mesh's 'pp' axis. One `lax.scan` over
-pipeline ticks runs `vmap(stage_fn)` — XLA partitions the stage dim so each
-pp device computes its own stage — and `jnp.roll` on the stage-sharded
-buffer hands activations to the next stage as an ICI collective-permute.
-Backward is just jax.grad through the scan: XLA schedules the reverse
-pipeline (the 1F1B memory trick is subsumed by per-stage remat).
+the stage dim sharded over the mesh's 'pp' axis. `jnp.roll` on the
+stage-sharded activation buffer hands microbatches to the next stage as an
+ICI collective-permute; `vmap(stage_fn)` over the stage dim becomes
+per-device stage compute under GSPMD.
 
-Bubble fraction is (S-1)/(M+S-1) like GPipe; interleaved/virtual stages
-(reference PipelineParallelWithInterleave) map to circular repeats of the
-same machinery and can cut it further.
+Two schedules:
+
+- "gpipe": one `lax.scan` over M+S-1 forward ticks; backward is jax.grad
+  through the scan (XLA schedules the reverse pipeline). Simple, but the
+  autodiff of the scan saves the full carry at every tick — activation
+  memory grows with M — and the reversed scan drags the dynamic-update
+  chains of the output buffer through AD.
+
+- "1f1b" (default): hand-rolled forward AND backward as three scans —
+  warmup (S-1 forward-only ticks), steady (M ticks, each one Forward for
+  the entering microbatch and one Backward for the leaving one — the
+  classic one-forward-one-backward interleaving), drain (S-1
+  backward-only ticks). Per-stage inputs are saved in a CIRCULAR buffer
+  of depth min(M, 2S-1) — the true 1F1B in-flight bound (reference
+  pipeline_parallel.py:440 keeps at most #warmup+1 activations alive) —
+  and each stage's backward recomputes its forward from the saved input
+  (per-stage remat, same FLOPs as the gpipe+remat path). Wall ticks:
+  (S-1)·F + M·(F+B) + (S-1)·B = the classical (M+S-1)(F+B) pipeline
+  critical path, with no autodiff-of-scan overhead.
+
+Stage partitioning is generic (SegmentLayers equivalent): the trainer
+auto-detects the model's longest LayerList of structurally-identical
+layers (Llama's model.layers, BERT's encoder stack, any custom stack) and
+requires layers % stages == 0 (the stacked (S, k, ...) layout needs equal
+stages; the reference's uneven SegmentLayers split does not map to a
+vmap-able stack). Embedding and loss head are overridable callables for
+non-Llama models.
 """
 from __future__ import annotations
 
 import re
 from dataclasses import dataclass
+from typing import Callable
 
 import numpy as np
 import jax
@@ -39,17 +64,30 @@ from paddle_tpu.parallel.trainer import Trainer, TrainStepConfig, _cast_tree
 STACK_PREFIX = "pipeline.layers::"
 
 
-def _layer_param_names(model):
-    """Group `model.model.layers.<i>.<local>` param names by local name."""
-    pat = re.compile(r"^(.*\.layers)\.(\d+)\.(.+)$")
-    groups: dict[str, dict[int, str]] = {}
-    base = None
-    for name in state_tensors(model):
-        m = pat.match(name)
-        if m:
-            base = m.group(1)
-            groups.setdefault(m.group(3), {})[int(m.group(2))] = name
-    return base, groups
+def detect_layer_stack(model):
+    """Find the pipeline-able layer stack: the longest LayerList (>= 2
+    sublayers) whose sublayers all expose the same parameter structure.
+    Returns (qualified name, LayerList). SegmentLayers equivalent
+    (reference pp_layers.py:92) for arbitrary models."""
+    from paddle_tpu.nn.layer.container import LayerList
+
+    best = None
+    for name, sub in model.named_sublayers():
+        if not isinstance(sub, LayerList) or len(sub) < 2:
+            continue
+        shapes = [
+            tuple(sorted((n, tuple(t._value.shape))
+                         for n, t in state_tensors(l).items()))
+            for l in sub]
+        if any(s != shapes[0] for s in shapes[1:]):
+            continue
+        if best is None or len(sub) > len(best[1]):
+            best = (name, sub)
+    if best is None:
+        raise ValueError(
+            "no pipeline-able LayerList found: the model needs a stack of "
+            ">=2 structurally-identical layers (e.g. decoder layers)")
+    return best
 
 
 class PipelinePlan(ShardingPlan):
@@ -72,24 +110,46 @@ class PipelinePlan(ShardingPlan):
 @dataclass
 class PipelineConfig(TrainStepConfig):
     num_microbatches: int = 4
+    schedule: str = "1f1b"            # "1f1b" | "gpipe"
+
+    def __post_init__(self):
+        if self.schedule not in ("1f1b", "gpipe"):
+            raise ValueError(
+                f"unknown pipeline schedule {self.schedule!r}: "
+                "expected '1f1b' or 'gpipe'")
 
 
 class PipelineTrainer(Trainer):
-    """Trainer whose decoder stack runs under the compiled GPipe schedule.
+    """Trainer whose detected layer stack runs under a compiled pipeline
+    schedule.
 
-    Assumes the model has `model.model.layers` (a list of identical
-    decoder layers, e.g. LlamaForCausalLM), an embedding + final norm +
-    head reachable through the remaining params — which is exactly the
-    split PipelineLayer's SegmentLayers computes for the reference.
+    embed_fn(other_params, batch) -> (B, S, D) hidden states and
+    tail_fn(other_params, h, batch) -> scalar mean loss are overridable;
+    the defaults implement the Llama shape (embed_tokens / final norm /
+    lm_head-or-tied-embedding + shifted next-token CE). NOTE: a custom
+    tail_fn receives one microbatch under schedule='1f1b' and the whole
+    batch under 'gpipe'; 1f1b weights the per-microbatch means equally
+    (mean-of-means), while the default tail normalizes by the GLOBAL
+    valid-token count under both schedules.
     """
 
     def __init__(self, model, optimizer, mesh, plan,
-                 config: PipelineConfig | None = None):
-        self._tpl_layer = model.model.layers[0]
-        base_names, groups = _layer_param_names(model)
-        self._layers_base = base_names
+                 config: PipelineConfig | None = None,
+                 embed_fn: Callable | None = None,
+                 tail_fn: Callable | None = None):
+        base_name, stack = detect_layer_stack(model)
+        self._tpl_layer = stack[0]
+        self._layers_base = base_name
+        self._num_layers = len(stack)
+        pat = re.compile(rf"^{re.escape(base_name)}\.(\d+)\.(.+)$")
+        groups: dict[str, dict[int, str]] = {}
+        for name in state_tensors(model):
+            m = pat.match(name)
+            if m:
+                groups.setdefault(m.group(2), {})[int(m.group(1))] = name
         self._layer_groups = groups
-        self._num_layers = len(model.model.layers)
+        self._embed_fn = embed_fn
+        self._tail_fn = tail_fn
         cfg = config or PipelineConfig()
         super().__init__(model, optimizer, mesh=mesh,
                          plan=PipelinePlan(plan), config=cfg)
@@ -128,102 +188,60 @@ class PipelineTrainer(Trainer):
                 tensors[n]._value = arr
         return self.model
 
-    # -- pipelined loss ----------------------------------------------------
+    # -- shared pipeline machinery ----------------------------------------
+    def _split_params(self, params_c):
+        other = {n: v for n, v in params_c.items()
+                 if not n.startswith(STACK_PREFIX)}
+        stacked = {n[len(STACK_PREFIX):]: v for n, v in params_c.items()
+                   if n.startswith(STACK_PREFIX)}
+        return other, stacked
+
+    def _stage_view(self, stacked, n_pp):
+        """(L, ...) -> (S, k, ...), stage dim sharded over 'pp'."""
+        k = self._num_layers // n_pp
+        return {
+            n: jax.lax.with_sharding_constraint(
+                v.reshape((n_pp, k) + v.shape[1:]),
+                NamedSharding(self.mesh, P("pp")))
+            for n, v in stacked.items()}
+
     def _layer_apply(self, layer_params: dict, h):
-        """One decoder layer, functional (template-layer swap)."""
+        """One stack layer, functional (template-layer swap)."""
         out = functional_call(self._tpl_layer, layer_params,
                               Tensor(h, stop_gradient=False))
         return out._value if isinstance(out, Tensor) else out
 
-    def _loss_from_batch(self, params_c, batch):
-        cfg_m = self.model.config
-        mesh = self.mesh
-        n_pp = mesh.shape["pp"]
-        M = self.config.num_microbatches
-        L = self._num_layers
-        assert L % n_pp == 0, f"{L} layers not divisible by pp={n_pp}"
-        k = L // n_pp
+    def _stage_fwd(self, stage_params, h):
+        def body(hh, one_layer):
+            return self._layer_apply(one_layer, hh), None
+        out, _ = jax.lax.scan(body, h, stage_params)
+        return out
 
-        input_ids = batch["input_ids"]
-        labels = batch.get("labels")
-        B = input_ids.shape[0]
-        assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+    def _module_by_name(self, name):
+        for n, sub in self.model.named_sublayers():
+            if n == name:
+                return sub
+        raise KeyError(name)
 
-        other = {n: v for n, v in params_c.items()
-                 if not n.startswith(STACK_PREFIX)}
-        stacked = {n[len(STACK_PREFIX):]: v
-                   for n, v in params_c.items()
-                   if n.startswith(STACK_PREFIX)}
-        # (L, ...) -> (S, k, ...), stage dim sharded over 'pp'
-        staged = {
-            n: jax.lax.with_sharding_constraint(
-                v.reshape((n_pp, k) + v.shape[1:]),
-                NamedSharding(mesh, P("pp")))
-            for n, v in stacked.items()}
+    # -- default (Llama-shaped) embedding + loss head ----------------------
+    def _default_embed(self, other, batch):
+        prefix = self._embed_prefix()
+        mod = self._module_by_name(prefix)
+        return functional_call(
+            mod, {"weight": other[f"{prefix}.weight"]},
+            Tensor(batch["input_ids"], stop_gradient=True))._value
 
-        # embedding (cheap; ordinary GSPMD)
-        emb = functional_call(
-            self.model.model.embed_tokens,
-            {"weight": other[
-                f"{self._embed_prefix()}.weight"]},
-            Tensor(input_ids, stop_gradient=True))._value
-        D = emb.shape[-1]
-        S_len = emb.shape[1]
-        mb = B // M
-        x_mb = emb.reshape(M, mb, S_len, D)
-
-        dp_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
-        state_spec = P("pp", dp_axes if dp_axes else None)
-
-        def stage_fn(stage_params, h):
-            def body(hh, one_layer):
-                return self._layer_apply(one_layer, hh), None
-            out, _ = jax.lax.scan(body, h, stage_params)
-            return out
-
-        stage_fn = jax.checkpoint(stage_fn)
-
-        def tick(carry, t):
-            state, outputs = carry
-            inject = jax.lax.dynamic_index_in_dim(
-                x_mb, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
-            state = state.at[0].set(
-                jnp.where(t < M, inject, state[0]))
-            state = jax.lax.with_sharding_constraint(
-                state, NamedSharding(mesh, state_spec))
-            y = jax.vmap(stage_fn)(staged_stacked, state)
-            y = jax.lax.with_sharding_constraint(
-                y, NamedSharding(mesh, state_spec))
-            out_t = y[-1]
-            oidx = jnp.clip(t - (n_pp - 1), 0, M - 1)
-            outputs = jax.lax.dynamic_update_index_in_dim(
-                outputs,
-                jnp.where(t >= n_pp - 1,
-                          out_t,
-                          jax.lax.dynamic_index_in_dim(
-                              outputs, oidx, 0, keepdims=False)),
-                oidx, 0)
-            state = jnp.roll(y, 1, axis=0)
-            return (state, outputs), None
-
-        staged_stacked = staged
-        T = M + n_pp - 1
-        state0 = jnp.zeros((n_pp, mb, S_len, D), emb.dtype)
-        outputs0 = jnp.zeros((M, mb, S_len, D), emb.dtype)
-        (_, outputs), _ = jax.lax.scan(
-            tick, (state0, outputs0), jnp.arange(T))
-
-        h = outputs.reshape(B, S_len, D)
-        # final norm + head + shifted CE via the model's own tail
-        norm_w = other[f"{self._norm_prefix()}.weight"]
-        h = functional_call(self.model.model.norm, {"weight": norm_w},
+    def _tail_per_token(self, other, h, batch):
+        """Final norm + head + shifted next-token CE, UNreduced:
+        (per-token loss (B, S) f32, keep mask (B, S))."""
+        norm_prefix = self._norm_prefix()
+        mod = self._module_by_name(norm_prefix)
+        h = functional_call(mod, {"weight": other[f"{norm_prefix}.weight"]},
                             Tensor(h, stop_gradient=False))._value
         logits = self._head_logits(other, h)
-        if labels is None:
-            return jnp.zeros((), jnp.float32)
+        labels = batch["labels"]
         # shift the labels, not the logits: slicing logits[:, :-1] copies
         # the (B*S, vocab) tensor (see models/llama.py next_token_loss).
-        # Final position and user -100 padding are masked out of the mean.
         lf = logits.astype(jnp.float32)
         shifted = jnp.concatenate(
             [labels[:, 1:],
@@ -233,15 +251,41 @@ class PipelineTrainer(Trainer):
         tgt = jnp.take_along_axis(
             lf, jnp.where(keep, shifted, 0)[..., None].astype(jnp.int32),
             axis=-1)[..., 0]
-        per = (logz - tgt) * keep
+        return (logz - tgt) * keep, keep
+
+    def _default_tail(self, other, h, batch):
+        """Global masked mean (gpipe path: whole batch in one call)."""
+        if batch.get("labels") is None:
+            return jnp.zeros((), jnp.float32)
+        per, keep = self._tail_per_token(other, h, batch)
         return (per.sum()
                 / jnp.maximum(keep.sum(), 1)).astype(jnp.float32)
+
+    def _default_tail_sum(self, other, h, batch):
+        """Per-microbatch loss SUM (1f1b path: normalized by the global
+        valid-token count so ragged -100 padding weighs exactly like the
+        gpipe/global-mean path)."""
+        if batch.get("labels") is None:
+            return jnp.zeros((), jnp.float32)
+        per, _ = self._tail_per_token(other, h, batch)
+        return per.sum().astype(jnp.float32)
+
+    def _default_tail_weight(self, batch):
+        """Valid-token count for one microbatch, from labels alone."""
+        labels = batch.get("labels")
+        if labels is None:
+            return jnp.asarray(1.0, jnp.float32)
+        shifted = jnp.concatenate(
+            [labels[:, 1:],
+             jnp.full((labels.shape[0], 1), -100, labels.dtype)], axis=1)
+        return (shifted != -100).sum().astype(jnp.float32)
 
     def _embed_prefix(self):
         for n in self.params:
             if n.endswith("embed_tokens.weight"):
                 return n[: -len(".weight")]
-        raise KeyError("embed_tokens.weight not found")
+        raise KeyError("embed_tokens.weight not found (pass embed_fn= for "
+                       "non-Llama models)")
 
     def _norm_prefix(self):
         cands = [n for n in self.params
@@ -256,3 +300,266 @@ class PipelineTrainer(Trainer):
             return jnp.einsum("bsd,dv->bsv", h, other[name])
         w = other[f"{self._embed_prefix()}.weight"]
         return jnp.einsum("bsd,vd->bsv", h, w)
+
+    # -- gpipe: forward scan, backward via jax.grad ------------------------
+    def _loss_from_batch(self, params_c, batch):
+        mesh = self.mesh
+        n_pp = mesh.shape["pp"]
+        M = self.config.num_microbatches
+        L = self._num_layers
+        assert L % n_pp == 0, f"{L} layers not divisible by pp={n_pp}"
+
+        input_ids = batch["input_ids"]
+        B = input_ids.shape[0]
+        assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+
+        other, stacked = self._split_params(params_c)
+        staged = self._stage_view(stacked, n_pp)
+
+        embed = self._embed_fn or self._default_embed
+        tail = self._tail_fn or self._default_tail
+        emb = embed(other, batch)
+        D = emb.shape[-1]
+        S_len = emb.shape[1]
+        mb = B // M
+        x_mb = emb.reshape(M, mb, S_len, D)
+
+        dp_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+        state_spec = P("pp", dp_axes if dp_axes else None)
+
+        stage_fn = jax.checkpoint(self._stage_fwd)
+
+        def tick(carry, t):
+            state, outputs = carry
+            inject = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+            state = state.at[0].set(
+                jnp.where(t < M, inject, state[0]))
+            state = jax.lax.with_sharding_constraint(
+                state, NamedSharding(mesh, state_spec))
+            y = jax.vmap(stage_fn)(staged, state)
+            y = jax.lax.with_sharding_constraint(
+                y, NamedSharding(mesh, state_spec))
+            out_t = y[-1]
+            oidx = jnp.clip(t - (n_pp - 1), 0, M - 1)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(t >= n_pp - 1,
+                          out_t,
+                          jax.lax.dynamic_index_in_dim(
+                              outputs, oidx, 0, keepdims=False)),
+                oidx, 0)
+            state = jnp.roll(y, 1, axis=0)
+            return (state, outputs), None
+
+        T = M + n_pp - 1
+        state0 = jnp.zeros((n_pp, mb, S_len, D), emb.dtype)
+        outputs0 = jnp.zeros((M, mb, S_len, D), emb.dtype)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (state0, outputs0), jnp.arange(T))
+
+        h = outputs.reshape(B, S_len, D)
+        return tail(other, h, batch)
+
+    # -- 1f1b: hand-rolled warmup / steady / drain scans -------------------
+    def _build_step(self, batch_treedef):
+        if self.config.schedule != "1f1b":
+            return super()._build_step(batch_treedef)
+        if self.config.grad_accum_steps > 1:
+            raise NotImplementedError(
+                "schedule='1f1b' does not compose with grad_accum_steps; "
+                "raise num_microbatches instead (pipeline microbatching "
+                "IS gradient accumulation)")
+
+        def step(params, opt_state, lr, batch):
+            with self._precision_ctx():
+                params_c = _cast_tree(params, self.config.compute_dtype)
+                loss, grads = self._pipeline_1f1b_grads(params_c, batch)
+                return self._apply_update(loss, grads, params, opt_state,
+                                          lr)
+
+        return self._jit_step(step)
+
+    def _pipeline_1f1b_grads(self, params_c, batch):
+        """One-forward-one-backward compiled schedule. Returns
+        (mean loss, grads dict over self.param_names). See module
+        docstring; reference: pipeline_parallel.py:440
+        forward_backward_pipeline (1F1B steady state), here as data —
+        warmup/steady/drain lax.scans with a circular stage-input buffer
+        and per-stage recompute (jax.vjp) in the backward phase."""
+        mesh = self.mesh
+        S = mesh.shape["pp"]
+        M = self.config.num_microbatches
+        L = self._num_layers
+        assert L % S == 0, f"{L} layers not divisible by pp={S}"
+        assert M >= 1
+
+        other, stacked = self._split_params(params_c)
+        staged = self._stage_view(stacked, S)
+        embed = self._embed_fn or self._default_embed
+        if self._tail_fn is not None:
+            # custom tails return a per-microbatch MEAN: weight each
+            # microbatch equally (documented mean-of-means contract)
+            tail_sum = self._tail_fn
+            weight_fn = lambda b: jnp.asarray(1.0, jnp.float32)  # noqa: E731
+        else:
+            tail_sum = self._default_tail_sum
+            weight_fn = self._default_tail_weight
+
+        emb = embed(other, batch)
+        B, S_len, D = emb.shape
+        assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+        mb = B // M
+        x_mb = emb.reshape(M, mb, S_len, D)
+        # only entries with a leading batch dim split into microbatches;
+        # anything else (scalars, (S,) position tables, ...) is passed
+        # whole to every microbatch, matching the gpipe path
+        batch_r = {k: v.reshape((M, mb) + v.shape[1:])
+                   for k, v in batch.items()
+                   if getattr(v, "ndim", 0) >= 1 and v.shape[0] == B}
+        batch_shared = {k: v for k, v in batch.items() if k not in batch_r}
+
+        def mb_batch_at(m):
+            out = {k: jax.lax.dynamic_index_in_dim(v, m, 0, keepdims=False)
+                   for k, v in batch_r.items()}
+            out.update(batch_shared)
+            return out
+
+        # global normalizer: sum of per-microbatch weights (valid-token
+        # counts for the default tail), so 1f1b's loss/grads equal the
+        # gpipe path's GLOBAL masked mean under ragged -100 padding
+        W = sum(weight_fn(mb_batch_at(m)) for m in range(M))
+        W = jnp.maximum(W, 1.0)
+
+        dp = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+        state_spec = P("pp", dp if dp else None)
+        saved_spec = P("pp", None, dp if dp else None)
+        C = min(M, 2 * S - 1)   # 1F1B in-flight bound per stage
+        sidx = jnp.arange(S)
+
+        def shard(x, spec):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec))
+
+        def f_phase(t, state, saved):
+            inject = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            state = state.at[0].set(jnp.where(t < M, inject, state[0]))
+            state = shard(state, state_spec)
+            f_mb = t - sidx
+            valid_f = jnp.logical_and(f_mb >= 0, f_mb < M)
+
+            def save_one(saved_s, h_s, fm, ok):
+                slot = jnp.mod(fm, C)
+                old = jax.lax.dynamic_index_in_dim(saved_s, slot, 0,
+                                                   keepdims=False)
+                return jax.lax.dynamic_update_index_in_dim(
+                    saved_s, jnp.where(ok, h_s, old), slot, 0)
+
+            saved = jax.vmap(save_one)(saved, state, f_mb, valid_f)
+            saved = shard(saved, saved_spec)
+            y = jax.vmap(self._stage_fwd)(staged, state)
+            y = shard(y, state_spec)
+            return jnp.roll(y, 1, axis=0), saved, y
+
+        def b_phase(t, saved, g_in, acc):
+            grads_st, grads_other, g_emb = acc
+            b_mb = t - 2 * (S - 1) + sidx
+            valid_b = jnp.logical_and(b_mb >= 0, b_mb < M)
+
+            def get_one(saved_s, bm):
+                return jax.lax.dynamic_index_in_dim(
+                    saved_s, jnp.mod(bm, C), 0, keepdims=False)
+
+            h_saved = jax.vmap(get_one)(saved, b_mb)
+
+            def one_bwd(stage_params, h_in, g):
+                _, vjp = jax.vjp(self._stage_fwd, stage_params, h_in)
+                gp, gx = vjp(g)
+                return gp, gx
+
+            gp, gx = jax.vmap(one_bwd)(staged, h_saved, g_in)
+
+            def mask_acc(acc_a, g):
+                m = valid_b.reshape((S,) + (1,) * (g.ndim - 1))
+                return acc_a + jnp.where(m, g, 0).astype(acc_a.dtype)
+
+            grads_st = jax.tree.map(mask_acc, grads_st, gp)
+            # stage 0's input cotangent = this microbatch's embedding grad
+            e_idx = jnp.clip(b_mb[0], 0, M - 1)
+            old = jax.lax.dynamic_index_in_dim(g_emb, e_idx, 0,
+                                               keepdims=False)
+            g_emb = jax.lax.dynamic_update_index_in_dim(
+                g_emb, jnp.where(valid_b[0], gx[0].astype(g_emb.dtype),
+                                 old), e_idx, 0)
+            g_next = shard(jnp.roll(gx, -1, axis=0), state_spec)
+            return g_next, (grads_st, grads_other, g_emb)
+
+        def tail_inject(t, y, g_state, acc, loss_acc):
+            """Loss + dL/dh for the microbatch finishing its forward at
+            this steady tick; injected as stage S-1's backward input."""
+            grads_st, grads_other, g_emb = acc
+            m_out = t - (S - 1)          # always valid in steady ticks
+            mb_batch = mb_batch_at(m_out)
+            loss_mb, tail_vjp = jax.vjp(
+                lambda o, h: tail_sum(o, h, mb_batch), other, y[S - 1])
+            g_o, g_h = tail_vjp(1.0 / W)
+            grads_other = jax.tree.map(
+                lambda a, g: a + g.astype(a.dtype), grads_other, g_o)
+            g_state = g_state.at[S - 1].set(g_h.astype(g_state.dtype))
+            return g_state, (grads_st, grads_other, g_emb), \
+                loss_acc + loss_mb / W
+
+        # accumulators
+        grads_st0 = {n: shard(jnp.zeros(v.shape, jnp.float32), P("pp"))
+                     for n, v in staged.items()}
+        grads_other0 = jax.tree.map(
+            lambda v: jnp.zeros(v.shape, jnp.float32), other)
+        g_emb0 = jnp.zeros((M, mb, S_len, D), emb.dtype)
+        state0 = jnp.zeros((S, mb, S_len, D), emb.dtype)
+        saved0 = jnp.zeros((S, C, mb, S_len, D), emb.dtype)
+        g_state0 = jnp.zeros((S, mb, S_len, D), emb.dtype)
+
+        def warm_body(carry, t):
+            state, saved = carry
+            state, saved, _ = f_phase(t, state, saved)
+            return (state, saved), None
+
+        (state, saved), _ = jax.lax.scan(
+            warm_body, (state0, saved0), jnp.arange(S - 1))
+
+        def steady_body(carry, t):
+            state, saved, g_state, acc, loss_acc = carry
+            state, saved, y = f_phase(t, state, saved)
+            g_state, acc, loss_acc = tail_inject(t, y, g_state, acc,
+                                                 loss_acc)
+            g_state, acc = b_phase(t, saved, g_state, acc)
+            return (state, saved, g_state, acc, loss_acc), None
+
+        acc = (grads_st0, grads_other0, g_emb0)
+        carry = (state, saved, g_state0, acc, jnp.zeros((), jnp.float32))
+        carry, _ = jax.lax.scan(steady_body, carry,
+                                jnp.arange(S - 1, M + S - 1))
+        _, saved, g_state, acc, loss = carry
+
+        def drain_body(carry, t):
+            saved, g_state, acc = carry
+            g_state, acc = b_phase(t, saved, g_state, acc)
+            return (saved, g_state, acc), None
+
+        (_, _, acc), _ = jax.lax.scan(
+            drain_body, (saved, g_state, acc),
+            jnp.arange(M + S - 1, M + 2 * (S - 1)))
+        grads_st, grads_other, g_emb = acc
+
+        # embedding backward (outside the scans, one fused vjp)
+        _, evjp = jax.vjp(lambda o: embed(o, batch), other)
+        (g_o_emb,) = evjp(g_emb.reshape(B, S_len, D).astype(emb.dtype))
+        grads_other = jax.tree.map(
+            lambda a, g: a + g.astype(a.dtype), grads_other, g_o_emb)
+
+        grads = {STACK_PREFIX + n: v.reshape((L,) + v.shape[2:])
+                 for n, v in grads_st.items()}
+        grads.update({n: grads_other[n] for n in self.param_names
+                      if not n.startswith(STACK_PREFIX)})
+        return loss, grads

@@ -57,6 +57,21 @@ def _cast_tree(tree, dtype):
         if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
 
 
+def _opt_barrier(grads: dict, cfg) -> dict:
+    """optimization_barrier on grads of cfg.opt_barrier_params-matching
+    names (see TrainStepConfig.opt_barrier_params for the why)."""
+    import os as _os
+    env = _os.environ.get("PADDLE_TPU_OPT_BARRIER")
+    pats = (env.split(",") if env
+            else list(getattr(cfg, "opt_barrier_params", ()) or ()))
+    if not pats:
+        return grads
+    return {n: (jax.lax.optimization_barrier(g)
+                if "1" in pats or any(p in n for p in pats)
+                else g)
+            for n, g in grads.items()}
+
+
 class Trainer:
     """Functional training state + compiled step for (model, optimizer) on
     a mesh. The eager Layer/Optimizer objects remain the API surface
@@ -144,18 +159,7 @@ class Trainer:
             lambda tp, fp, b: loss_for({**fp, **tp}, b))
 
         def step(params, opt_state, lr, batch):
-            # The package-global matmul precision is 'highest' so EAGER f32
-            # numerics match the reference; inside the compiled bf16 train
-            # step that setting would run every bf16 matmul as multi-pass
-            # f32 emulation (several x slower on the MXU). bf16 compute
-            # with f32 accumulation is the intended training numerics.
-            import contextlib
-            low_prec = (cfg.compute_dtype is not None and
-                        jnp.dtype(cfg.compute_dtype) in
-                        (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)))
-            prec_ctx = (jax.default_matmul_precision("default") if low_prec
-                        else contextlib.nullcontext())
-            with prec_ctx:
+            with self._precision_ctx():
                 return _step_inner(params, opt_state, lr, batch)
 
         def _step_inner(params, opt_state, lr, batch):
@@ -182,23 +186,40 @@ class Trainer:
                 grads = jax.tree.map(lambda g: g / n_mb, grads)
             else:
                 loss, grads = grad_fn(train_p, frozen_p, batch)
-            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
-            import os as _os
-            env = _os.environ.get("PADDLE_TPU_OPT_BARRIER")
-            pats = (env.split(",") if env
-                    else list(cfg.opt_barrier_params or ()))
-            if pats:
-                grads = {n: (jax.lax.optimization_barrier(g)
-                             if "1" in pats or any(p in n for p in pats)
-                             else g)
-                         for n, g in grads.items()}
-            new_p, new_s = self.optimizer.apply_gradients_arrays(
-                train_p, grads, opt_state, lr)
-            out_params = dict(params)
-            out_params.update(new_p)
-            return loss, out_params, new_s
+            return self._apply_update(loss, grads, params, opt_state, lr)
 
-        donate = (0, 1) if cfg.donate else ()
+        return self._jit_step(step)
+
+    def _precision_ctx(self):
+        """The package-global matmul precision is 'highest' so EAGER f32
+        numerics match the reference; inside the compiled low-precision
+        train step that setting would run every bf16 matmul as multi-pass
+        f32 emulation (several x slower on the MXU). bf16 compute with
+        f32 accumulation is the intended training numerics."""
+        import contextlib
+        cfg = self.config
+        low_prec = (cfg.compute_dtype is not None and
+                    jnp.dtype(cfg.compute_dtype) in
+                    (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)))
+        return (jax.default_matmul_precision("default") if low_prec
+                else contextlib.nullcontext())
+
+    def _apply_update(self, loss, grads, params, opt_state, lr):
+        """Shared step epilogue: f32 grads + opt barrier + optimizer."""
+        grads = _opt_barrier(
+            jax.tree.map(lambda g: g.astype(jnp.float32), grads),
+            self.config)
+        train_p = {n: params[n] for n in self.param_names}
+        new_p, new_s = self.optimizer.apply_gradients_arrays(
+            train_p, grads, opt_state, lr)
+        out_params = dict(params)
+        out_params.update(new_p)
+        return loss, out_params, new_s
+
+    def _jit_step(self, step):
+        """Shared jit wrapper: donation + param/opt-state shardings."""
+        mesh = self.mesh
+        donate = (0, 1) if self.config.donate else ()
         if mesh is not None:
             pspec = {n: NamedSharding(mesh, self._spec(n))
                      for n in self.params}
