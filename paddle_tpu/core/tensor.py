@@ -223,17 +223,33 @@ class Tensor:
         for i in range(len(self)):
             yield self[i]
 
+    def _concretize(self, caster, what):
+        import jax
+        try:
+            return caster(np.asarray(self._value))
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerBoolConversionError) as e:
+            raise TypeError(
+                f"{what} of a traced Tensor inside @to_static/jit: the "
+                "value is only known at run time. For data-dependent "
+                "control flow use paddle_tpu.jit.cond / "
+                "paddle_tpu.jit.while_loop (or let to_static's AST "
+                "rewrite handle plain `if`/`while` on Tensor "
+                "predicates); for host access move the read outside "
+                "the compiled function.") from e
+
     def __float__(self):
-        return float(np.asarray(self._value))
+        return self._concretize(float, "float()")
 
     def __int__(self):
-        return int(np.asarray(self._value))
+        return self._concretize(int, "int()")
 
     def __bool__(self):
-        return bool(np.asarray(self._value))
+        return self._concretize(bool, "bool()")
 
     def __index__(self):
-        return int(np.asarray(self._value))
+        return self._concretize(int, "index use")
 
     def __format__(self, spec):
         if self.ndim == 0:

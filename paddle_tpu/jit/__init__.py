@@ -17,6 +17,9 @@ import numpy as np
 from paddle_tpu.jit.api import (to_static, not_to_static, StaticFunction,
                                 InputSpec, enable_to_static, ignore_module)
 from paddle_tpu.jit.functional import functional_call, state_arrays, state_tensors
+from paddle_tpu.jit.dy2static import (cond, while_loop, scan,
+                                      Dy2StaticTransformError)
+from paddle_tpu.jit import dy2static
 from paddle_tpu.core.tensor import Tensor
 
 

@@ -76,7 +76,34 @@ class StaticFunction:
         self._input_spec = input_spec
         self._jit_cache = {}
         self._out_treedefs = {}
+        self._traced_fn = None      # set lazily (AST control-flow rewrite)
+        self._fell_back = False
         functools.update_wrapper(self, self._fn)
+
+    def _body_fn(self):
+        """The function actually traced: the dy2static AST rewrite of
+        self._fn when it contains if/while (so Tensor predicates lower to
+        lax.cond/while_loop), else self._fn itself."""
+        if self._traced_fn is None:
+            import warnings
+            from paddle_tpu.jit.dy2static import (ast_transform,
+                                                  Dy2StaticTransformError)
+            raw = getattr(self._fn, "__func__", self._fn)
+            try:
+                new = ast_transform(raw)
+            except Dy2StaticTransformError as e:
+                warnings.warn(
+                    f"to_static: control-flow rewrite of "
+                    f"{getattr(raw, '__qualname__', raw)} failed ({e}); "
+                    "tracing the original body (Tensor-predicate "
+                    "if/while will fall back to eager execution)")
+                new = None
+            if new is not None and self._fn is not raw:
+                # rebind: transformed plain function <- bound method
+                layer = self._fn.__self__
+                new = functools.partial(new, layer)
+            self._traced_fn = new or self._fn
+        return self._traced_fn
 
     # ---- tracing body ----------------------------------------------------
     def _run_traced(self, state, dyn_arrays, key):
@@ -96,15 +123,16 @@ class StaticFunction:
                 ordered.append(l)
         args, kwargs = jax.tree.unflatten(treedef, ordered)
 
+        fn = self._body_fn()
         _tracing.depth = getattr(_tracing, "depth", 0) + 1
         prev = push_tape()
         try:
             with no_grad():
                 if self._layer is not None:
                     with _swapped(self._layer, state):
-                        out = self._fn(*args, **kwargs)
+                        out = fn(*args, **kwargs)
                 else:
-                    out = self._fn(*args, **kwargs)
+                    out = fn(*args, **kwargs)
         finally:
             pop_tape(prev)
             _tracing.depth -= 1
@@ -159,7 +187,11 @@ class StaticFunction:
         need_grad = grad_enabled() and (diff_in or diff_names)
 
         if not need_grad:
-            out_arrays = jitted(state, dyn_vals)
+            try:
+                out_arrays = jitted(state, dyn_vals)
+            except (TypeError, jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerArrayConversionError) as e:
+                return self._graph_break(e, args, kwargs)
             return self._unflatten_out(key, out_arrays)
 
         def g(diff_state, diff_arrs):
@@ -170,9 +202,13 @@ class StaticFunction:
                 dv[p] = a
             return jitted(full_state, dv)
 
-        out_arrays, vjp_fn = jax.vjp(
-            g, {k: state[k] for k in diff_names},
-            [t._value for t in diff_in])
+        try:
+            out_arrays, vjp_fn = jax.vjp(
+                g, {k: state[k] for k in diff_names},
+                [t._value for t in diff_in])
+        except (TypeError, jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError) as e:
+            return self._graph_break(e, args, kwargs)
 
         out = self._unflatten_out(key, out_arrays, stop_gradient=False)
         out_tensors = [o for o in jax.tree.leaves(
@@ -190,6 +226,21 @@ class StaticFunction:
             out_avals=[(a.shape, a.dtype) for a in out_arrays])
         current_tape().record(node)
         return out
+
+    def _graph_break(self, err, args, kwargs):
+        """Whole-function fallback to eager when tracing hits host-side
+        data dependence the rewrite couldn't capture (the coarse
+        equivalent of SOT's per-op graph break, reference
+        opcode_executor.py:303 BreakGraphError)."""
+        if not self._fell_back:
+            import warnings
+            warnings.warn(
+                f"to_static: {getattr(self._fn, '__qualname__', self._fn)}"
+                f" could not be traced into one program ({err}); falling "
+                "back to EAGER execution. Restructure with "
+                "paddle_tpu.jit.cond/while_loop to recover compilation.")
+            self._fell_back = True
+        return self._fn(*args, **kwargs)
 
     def _unflatten_out(self, key, out_arrays, stop_gradient=True):
         td = self._out_treedefs.get(key)

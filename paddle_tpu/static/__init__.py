@@ -171,3 +171,61 @@ def gradients(targets, inputs, target_gradients=None):
 
 def normalize_program(program, feed_vars, fetch_vars):
     return program
+
+
+class _StaticNN:
+    """paddle.static.nn facade (reference: python/paddle/static/nn/
+    control_flow.py cond/while_loop) — the control-flow ops route to the
+    lax-backed implementations in paddle_tpu.jit.dy2static."""
+
+    @staticmethod
+    def cond(pred, true_fn=None, false_fn=None, name=None,
+             return_names=None):
+        from paddle_tpu.jit.dy2static import cond as _cond
+        return _cond(pred, true_fn, false_fn)
+
+    @staticmethod
+    def while_loop(cond, body, loop_vars, is_test=False, name=None):
+        from paddle_tpu.jit.dy2static import while_loop as _wl
+        return _wl(cond, body, loop_vars)
+
+    @staticmethod
+    def case(pred_fn_pairs, default=None, name=None):
+        from paddle_tpu.jit.dy2static import cond as _cond
+        out = default() if default is not None else None
+        for pred, fn in reversed(pred_fn_pairs):
+            prev = out
+            out = _cond(pred, fn, (lambda p=prev: p))
+        return out
+
+    @staticmethod
+    def switch_case(branch_index, branch_fns, default=None, name=None):
+        import jax
+        fns = dict(branch_fns) if not isinstance(branch_fns, dict) else \
+            branch_fns
+        keys = sorted(fns)
+        from paddle_tpu.core.tensor import Tensor
+        idx = branch_index._value if isinstance(branch_index, Tensor) \
+            else branch_index
+        import jax.numpy as jnp
+        # map branch index -> dense position; unknown -> default slot
+        pos = sum(jnp.where(jnp.asarray(idx) == k, i, 0)
+                  for i, k in enumerate(keys))
+        known = sum((jnp.asarray(idx) == k).astype(jnp.int32)
+                    for k in keys)
+        branches = [fns[k] for k in keys]
+        branches.append(default if default is not None else branches[-1])
+        pos = jnp.where(known > 0, pos, len(keys))
+        out = jax.lax.switch(pos.reshape(()),
+                             [lambda f=f: jax.tree.map(
+                                 lambda t: t._value if isinstance(t, Tensor)
+                                 else t, f(),
+                                 is_leaf=lambda x: isinstance(x, Tensor))
+                              for f in branches])
+        return jax.tree.map(
+            lambda a: Tensor(a, stop_gradient=True)
+            if isinstance(a, (jax.Array,)) or hasattr(a, "aval") else a,
+            out)
+
+
+nn = _StaticNN()
