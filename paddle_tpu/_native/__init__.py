@@ -139,6 +139,9 @@ def _declare(lib):
     lib.pt_watchdog_complete.restype = None
     lib.pt_watchdog_complete.argtypes = [c.c_uint64]
     lib.pt_watchdog_expired_count.restype = c.c_int64
+    lib.pt_watchdog_last_expired.restype = None
+    lib.pt_watchdog_last_expired.argtypes = [c.POINTER(u8p),
+                                             c.POINTER(c.c_int64)]
 
 
 def _take_bytes(lib, out_p, out_len):

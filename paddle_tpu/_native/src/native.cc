@@ -888,4 +888,12 @@ PT_EXPORT int64_t pt_watchdog_expired_count() {
   return CommWatchdog::Get().ExpiredCount();
 }
 
+// caller frees *out with pt_free
+PT_EXPORT void pt_watchdog_last_expired(uint8_t** out, int64_t* out_len) {
+  std::string s = CommWatchdog::Get().LastExpired();
+  *out = static_cast<uint8_t*>(malloc(s.size() ? s.size() : 1));
+  memcpy(*out, s.data(), s.size());
+  *out_len = static_cast<int64_t>(s.size());
+}
+
 PT_EXPORT int pt_version() { return 1; }

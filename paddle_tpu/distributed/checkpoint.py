@@ -129,25 +129,40 @@ _barrier_seq = 0
 
 
 def _save_barrier(path, timeout_ms=600_000):
-    """Block until every host finished writing (coordination-service
-    barrier — the jax.distributed analog of the reference's TCPStore
-    rendezvous). No-op single-host or when the service isn't up."""
+    """Block until every host finished writing (the jax.distributed
+    analog of the reference's TCPStore rendezvous). No-op single-host;
+    WARNS when multi-process without a way to synchronize (a silent skip
+    could return before peers finish writing)."""
     if jax.process_count() == 1:
         return
-    try:
-        from jax._src import distributed as _dist
-        client = _dist.global_state.client
-    except Exception:
-        client = None
-    if client is None:
-        return
+    from paddle_tpu.distributed import watchdog
     # barrier ids are single-use in the coordination service: a counter
     # keeps repeated saves to the same directory from colliding (save is
     # collective, so every host's counter advances in lockstep)
     global _barrier_seq
     _barrier_seq += 1
     tag = f"ckpt_save:{os.path.abspath(path)}:{_barrier_seq}"
-    client.wait_at_barrier(tag, timeout_in_ms=timeout_ms)
+    with watchdog.watch(f"checkpoint.save_barrier {tag}", timeout_ms):
+        try:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(tag)
+            return
+        except Exception:
+            pass  # fall through to the raw coordination client
+        try:
+            from jax._src import distributed as _dist
+            client = _dist.global_state.client
+        except Exception:
+            client = None
+        if client is None:
+            import warnings
+            warnings.warn(
+                f"checkpoint save barrier SKIPPED in a "
+                f"{jax.process_count()}-process run (no coordination "
+                "client): save() may return before other hosts finish "
+                "writing")
+            return
+        client.wait_at_barrier(tag, timeout_in_ms=timeout_ms)
 
 
 def _merged_tables(path):
@@ -175,6 +190,16 @@ def _merged_tables(path):
         tables = sorted(
             fn for fn in os.listdir(path)
             if fn.startswith("table_") and fn.endswith(".json"))
+        if tables:
+            # metadata.json with process_count is what defends against
+            # merging STALE tables from an earlier save by more hosts —
+            # without it this glob could silently resurrect them
+            raise ValueError(
+                f"checkpoint {path!r} has {len(tables)} table files but "
+                f"no {_META} with process_count (coordinator crashed "
+                "after tables were written, or the file was deleted); "
+                "refusing to glob-merge possibly-stale tables. Restore "
+                f"{_META} or delete stale table_*.json files.")
     if not tables:
         raise FileNotFoundError(f"no shard tables in checkpoint {path!r}")
     merged = {}

@@ -170,8 +170,21 @@ def _jit_collective(mesh, body, static_arg=None):
         fn = body
     else:
         fn = functools.partial(body, src_local=static_arg)
-    return jax.jit(shard_map(fn, mesh=mesh, in_specs=P(_AXIS),
-                             out_specs=P(_AXIS)))
+    jitted = jax.jit(shard_map(fn, mesh=mesh, in_specs=P(_AXIS),
+                               out_specs=P(_AXIS)))
+
+    def run(*args):
+        # every eager collective registers with the hang watchdog for its
+        # whole dispatch+execution (reference: comm_task_manager.cc
+        # CommTask per NCCL op); block so completion is observable
+        from paddle_tpu.distributed import watchdog
+        name = getattr(body, "__name__", "collective")
+        with watchdog.watch(f"collective/{name} mesh={dict(mesh.shape)}"):
+            out = jitted(*args)
+            jax.block_until_ready(out)
+        return out
+
+    return run
 
 
 def _reduce_body(op):

@@ -15,9 +15,11 @@ from __future__ import annotations
 import json
 import os
 import signal
+import threading
 import time
 
-__all__ = ["ElasticManager", "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
+__all__ = ["ElasticManager", "StoreHeartbeat", "safe_barrier",
+           "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
 
 ELASTIC_AUTO_PARALLEL_EXIT_CODE = 101  # reference manager.py same code
 
@@ -106,3 +108,112 @@ class ElasticManager:
                 signal.signal(s, h)
             except ValueError:
                 pass
+        if getattr(self, "_heartbeat", None) is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
+
+    # -- membership (store heartbeat) --------------------------------------
+    def attach_store(self, store, rank, world_size, interval=2.0,
+                     grace=None):
+        """Start a store-backed membership heartbeat so a DEAD rank is
+        detected (reference: elastic/manager.py:598 etcd watch_node —
+        here the TCPStore plays etcd). Returns the StoreHeartbeat."""
+        self._heartbeat = StoreHeartbeat(store, rank, world_size,
+                                         interval=interval, grace=grace)
+        self._heartbeat.start()
+        return self._heartbeat
+
+    def dead_ranks(self):
+        hb = getattr(self, "_heartbeat", None)
+        return hb.stale_ranks() if hb is not None else []
+
+
+class StoreHeartbeat:
+    """Each rank beats `hb/{rank}` in the store every `interval` seconds;
+    `stale_ranks()` names peers silent for longer than `grace` (default
+    3x interval). The reference watches etcd member nodes the same way
+    (elastic/manager.py:126,598)."""
+
+    def __init__(self, store, rank, world_size, interval=2.0, grace=None):
+        self.store = store
+        # the beat thread gets its OWN client connection: a blocking
+        # wait() (barrier) on the shared client's socket would otherwise
+        # starve the heartbeat and make THIS rank look dead
+        self._beat_store = self._clone_client(store)
+        self.rank = rank
+        self.world_size = world_size
+        self.interval = interval
+        self.grace = grace if grace is not None else 3.0 * interval
+        self._stop = False
+        self._thread = None
+
+    @staticmethod
+    def _clone_client(store):
+        try:
+            from paddle_tpu.distributed.store import TCPStore
+            if isinstance(store, TCPStore):
+                return TCPStore(store.host, store.port, is_master=False,
+                                timeout=store._timeout,
+                                world_size=store.world_size,
+                                prefix=store._prefix)
+        except Exception:
+            pass
+        return store
+
+    def start(self):
+        self.beat()
+        self._thread = threading.Thread(target=self._loop,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def beat(self):
+        self._beat_store.set(f"hb/{self.rank}", repr(time.time()).encode())
+
+    def _loop(self):
+        while not self._stop:
+            time.sleep(self.interval)
+            if self._stop:
+                return
+            try:
+                self.beat()
+            except Exception:
+                return          # store gone: the job is ending anyway
+
+    def stop(self):
+        self._stop = True
+        if self._beat_store is not self.store:
+            try:
+                self._beat_store.close()
+            except Exception:
+                pass
+
+    def stale_ranks(self):
+        """Ranks whose last beat is older than `grace` (or missing)."""
+        now = time.time()
+        stale = []
+        for r in range(self.world_size):
+            try:
+                t = float(self.store.get(f"hb/{r}").decode())
+            except Exception:
+                stale.append(r)
+                continue
+            if now - t > self.grace:
+                stale.append(r)
+        return stale
+
+
+def safe_barrier(store, name, rank, world_size, timeout, heartbeat=None):
+    """store.barrier that, on timeout, consults the membership heartbeat
+    and aborts with the DEAD ranks named — the survivor-side diagnostic
+    the reference's comm_task_manager + elastic watch provide together."""
+    try:
+        store.barrier(name, rank, world_size, timeout=timeout)
+    except RuntimeError as e:
+        dead = heartbeat.stale_ranks() if heartbeat is not None else []
+        if dead:
+            raise RuntimeError(
+                f"barrier '{name}' aborted on rank {rank}: rank(s) "
+                f"{dead} stopped heartbeating (dead or hung); "
+                "restart from the last checkpoint") from e
+        raise

@@ -392,6 +392,7 @@ class TCPStore(Store):
         its own done-key, so calling barrier("epoch", ...) every epoch
         re-synchronizes instead of falling through on the stale done flag.
         """
+        from paddle_tpu.distributed import watchdog
         ws = world_size or self.world_size
         if not ws:
             raise ValueError("barrier needs world_size")
@@ -400,7 +401,21 @@ class TCPStore(Store):
         done_key = f"barrier/{name}/done/{round_idx}"
         if n % ws == 0:
             self.set(done_key, b"1")
-        self.wait(done_key, timeout)
+        tmo_ms = int((timeout or self._timeout) * 1000)
+        with watchdog.watch(f"store.barrier/{name} rank={rank}", tmo_ms):
+            try:
+                self.wait(done_key, timeout)
+            except Exception as e:
+                try:
+                    arrived = int(self.get(
+                        f"barrier/{name}/count").decode())
+                except Exception:
+                    arrived = n
+                raise RuntimeError(
+                    f"store barrier '{name}' timed out on rank {rank}: "
+                    f"{arrived % ws or ws}/{ws} ranks arrived in round "
+                    f"{round_idx} — a peer is dead or hung "
+                    f"(original: {e})") from e
 
     def close(self):
         if self._native_client and self._client:

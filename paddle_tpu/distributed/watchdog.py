@@ -1,0 +1,178 @@
+"""Collective/barrier hang detection, wired into the runtime.
+
+Reference: paddle/phi/core/distributed/comm_task_manager.cc (the async
+CommTaskManager loop that watches every NCCL collective's start/end
+events and aborts the communicator with a diagnostic on timeout) +
+nccl_comm_task.cc:148-186.
+
+TPU-native split of the job:
+- the NATIVE CommWatchdog (_native/src/native.cc) is the async detector:
+  registered ops that blow their deadline are counted and reported from
+  its poller thread (stderr + queryable state) even while the python
+  thread is stuck inside a blocking wait;
+- the python side wraps every store barrier/wait, eager collective and
+  checkpoint save-barrier in `watch(...)`, and the polling waits consult
+  `expired()` so the SURVIVOR aborts with the op name/rank instead of
+  hanging forever (the reference aborts the NCCL communicator; here the
+  blocked op raises).
+
+Falls back to a pure-python deadline registry when the native library is
+unavailable (same semantics, python poller).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+__all__ = ["watch", "enable", "disable", "expired_count", "last_expired",
+           "default_timeout_ms", "CommTimeoutError"]
+
+
+class CommTimeoutError(RuntimeError):
+    pass
+
+
+def default_timeout_ms() -> int:
+    # reference default: 30 min NCCL comm timeout (distributed_strategy)
+    return int(os.environ.get("PADDLE_TPU_COMM_TIMEOUT_MS", 30 * 60000))
+
+
+class _PyWatchdog:
+    """Pure-python fallback: same registry + poller as the native one."""
+
+    def __init__(self):
+        self._ops = {}
+        self._next = 1
+        self._expired = 0
+        self._last = ""
+        self._lock = threading.Lock()
+        self._thread = None
+        self._running = False
+
+    def start(self, poll_ms):
+        with self._lock:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, args=(poll_ms / 1000.0,), daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._running = False
+
+    def register(self, desc, timeout_ms):
+        with self._lock:
+            i = self._next
+            self._next += 1
+            self._ops[i] = [desc, time.monotonic() + timeout_ms / 1000.0,
+                            False]
+            return i
+
+    def complete(self, i):
+        with self._lock:
+            self._ops.pop(i, None)
+
+    def expired_count(self):
+        with self._lock:
+            return self._expired
+
+    def last_expired(self):
+        with self._lock:
+            return self._last
+
+    def _loop(self, poll_s):
+        import sys
+        while self._running:
+            time.sleep(poll_s)
+            now = time.monotonic()
+            with self._lock:
+                for op in self._ops.values():
+                    if not op[2] and now > op[1]:
+                        op[2] = True
+                        self._expired += 1
+                        self._last = op[0]
+                        print(f"[paddle_tpu watchdog] collective op "
+                              f"'{op[0]}' exceeded its timeout; the job "
+                              "may be hung (rank desync or network "
+                              "failure).", file=sys.stderr)
+
+
+_py = _PyWatchdog()
+_native_lib = None
+_started = False
+
+
+def _lib():
+    global _native_lib
+    if _native_lib is None:
+        try:
+            from paddle_tpu import _native
+            _native_lib = _native.load() if _native.available() else False
+        except Exception:
+            _native_lib = False
+    return _native_lib
+
+
+def enable(poll_ms: int = 1000):
+    """Start the watchdog poller (native if built, else python)."""
+    global _started
+    lib = _lib()
+    if lib:
+        lib.pt_watchdog_start(poll_ms)
+    else:
+        _py.start(poll_ms)
+    _started = True
+
+
+def disable():
+    global _started
+    lib = _lib()
+    if lib:
+        lib.pt_watchdog_stop()
+    else:
+        _py.stop()
+    _started = False
+
+
+def expired_count() -> int:
+    lib = _lib()
+    if lib:
+        return int(lib.pt_watchdog_expired_count())
+    return _py.expired_count()
+
+
+def last_expired() -> str:
+    lib = _lib()
+    if lib:
+        from paddle_tpu._native import _take_bytes
+        import ctypes
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        ln = ctypes.c_int64()
+        lib.pt_watchdog_last_expired(ctypes.byref(out), ctypes.byref(ln))
+        return _take_bytes(lib, out, ln).decode()
+    return _py.last_expired()
+
+
+@contextmanager
+def watch(desc: str, timeout_ms: int | None = None):
+    """Register `desc` with the hang detector for the duration of the
+    wrapped operation. Used around every store barrier/wait, eager
+    collective dispatch, and checkpoint save barrier."""
+    if not _started:
+        enable()
+    tmo = timeout_ms or default_timeout_ms()
+    lib = _lib()
+    if lib:
+        op_id = lib.pt_watchdog_register(desc.encode(), tmo)
+    else:
+        op_id = _py.register(desc, tmo)
+    try:
+        yield
+    finally:
+        if lib:
+            lib.pt_watchdog_complete(op_id)
+        else:
+            _py.complete(op_id)

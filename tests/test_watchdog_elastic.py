@@ -1,0 +1,111 @@
+"""Hang/failure detection wiring (reference:
+paddle/phi/core/distributed/comm_task_manager.cc per-collective watch +
+abort, fleet/elastic/manager.py:598 etcd membership watch): the watchdog
+observes store barriers and eager collectives, and a dead rank is
+detected by the store heartbeat so the SURVIVOR aborts a barrier with an
+actionable diagnostic instead of hanging."""
+import multiprocessing as mp
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import watchdog
+from paddle_tpu.distributed.elastic import (ElasticManager, StoreHeartbeat,
+                                            safe_barrier)
+from paddle_tpu.distributed.store import TCPStore
+
+
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_watchdog_expires_and_completes():
+    watchdog.enable(poll_ms=50)
+    with watchdog.watch("quick-op", timeout_ms=10_000):
+        pass                                     # completes in time
+    before = watchdog.expired_count()
+    with watchdog.watch("slow-op rank=0", timeout_ms=50):
+        time.sleep(0.4)                          # blows the deadline
+    assert watchdog.expired_count() == before + 1
+    assert "slow-op" in watchdog.last_expired()
+
+
+def test_collective_registers_with_watchdog():
+    import paddle_tpu
+    import paddle_tpu.distributed as dist
+
+    watchdog.enable(poll_ms=50)
+    before = watchdog.expired_count()
+    t = paddle_tpu.to_tensor(np.arange(8, dtype="float32"))
+    dist.all_reduce(t)                           # 8-device CPU mesh
+    # completes well inside the default timeout: no new expirations
+    assert watchdog.expired_count() == before
+
+
+def _dead_rank(port, ready):
+    """Fake rank 1: heartbeats once, then DIES before the barrier."""
+    store = TCPStore("127.0.0.1", port, is_master=False, world_size=2)
+    hb = StoreHeartbeat(store, rank=1, world_size=2, interval=0.2)
+    hb.beat()
+    ready.set()
+    # exits without ever calling barrier => rank is dead
+
+
+def test_dead_rank_mid_barrier_aborts_survivor():
+    """VERDICT item 7 criterion: kill a fake rank mid-barrier; the
+    survivor aborts with a diagnostic naming the dead rank, within the
+    timeout."""
+    port = _free_port()
+    store = TCPStore("127.0.0.1", port, is_master=True, world_size=2)
+    try:
+        ctx = mp.get_context("fork")
+        ready = ctx.Event()
+        p = ctx.Process(target=_dead_rank, args=(port, ready), daemon=True)
+        p.start()
+        assert ready.wait(timeout=10)
+        p.join(timeout=10)                       # rank 1 is now dead
+
+        hb = StoreHeartbeat(store, rank=0, world_size=2,
+                            interval=0.2, grace=0.8)
+        hb.start()
+        time.sleep(1.0)                          # let rank 1's beat expire
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError,
+                           match=r"rank\(s\) \[1\] stopped heartbeating"):
+            safe_barrier(store, "trainsync", rank=0, world_size=2,
+                         timeout=2.0, heartbeat=hb)
+        assert time.perf_counter() - t0 < 10.0   # aborted, not hung
+        hb.stop()
+    finally:
+        store.close()
+
+
+def test_elastic_manager_membership():
+    port = _free_port()
+    store = TCPStore("127.0.0.1", port, is_master=True, world_size=2)
+    try:
+        em = ElasticManager()
+        em.attach_store(store, rank=0, world_size=2,
+                        interval=0.2, grace=0.8)
+        # rank 1 never joined: immediately stale
+        assert em.dead_ranks() == [1]
+        # once rank 1 beats, membership is clean
+        StoreHeartbeat(store, rank=1, world_size=2).beat()
+        assert em.dead_ranks() == []
+        em.close()
+    finally:
+        store.close()
+
+
+def test_store_barrier_timeout_diagnostic():
+    port = _free_port()
+    store = TCPStore("127.0.0.1", port, is_master=True, world_size=2)
+    try:
+        with pytest.raises(RuntimeError, match="1/2 ranks arrived"):
+            store.barrier("lonely", rank=0, world_size=2, timeout=1.0)
+    finally:
+        store.close()
