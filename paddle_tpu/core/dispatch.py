@@ -149,6 +149,7 @@ def dispatch(op: OpDef, args, kwargs):
         outputs=wrapped,
         vjp_fn=vjp_fn,
         out_avals=[(o.shape, o.dtype) for o in out_flat],
+        replay_fn=g,   # re-linearization hook for create_graph=True
     )
     current_tape().record(node)
     if OP_STATS_HOOK is not None:

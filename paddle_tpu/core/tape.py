@@ -37,15 +37,21 @@ class TapeNode:
     forward tensors die (eager autograd_meta shared_ptr ownership)."""
 
     __slots__ = ("inputs", "out_refs", "out_uids", "vjp_fn", "out_avals",
-                 "name")
+                 "name", "replay_fn")
 
-    def __init__(self, name, inputs, outputs, vjp_fn, out_avals):
+    def __init__(self, name, inputs, outputs, vjp_fn, out_avals,
+                 replay_fn=None):
         self.name = name
         self.inputs = inputs      # list[Tensor] (only those requiring grad)
         self.out_refs = [weakref.ref(o) for o in outputs]
         self.out_uids = [o._uid for o in outputs]
         self.vjp_fn = vjp_fn      # callable(cotangents tuple) -> input grads
         self.out_avals = out_avals  # [(shape, dtype)] to build zero cotangents
+        # pure function(*input_arrays) -> flat outputs, same args as
+        # `inputs`: lets create_graph=True re-linearize the op so the vjp
+        # REPLAY is recorded on the tape (vjp-of-vjp; reference
+        # backward.cc:440 create_graph / general_grad.h)
+        self.replay_fn = replay_fn
 
     def alive(self):
         return any(r() is not None for r in self.out_refs)
@@ -180,17 +186,33 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, allow_unused=False):
     """paddle.grad equivalent (reference: backward.cc:440 egr::Grad /
-    GeneralGrad subgraph). Returns grads of `inputs` without touching .grad."""
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is not supported on the eager "
-            "tape; use paddle_tpu.jit-traced functions with jax.grad "
-            "composition for higher-order derivatives.")
+    GeneralGrad subgraph). Returns grads of `inputs` without touching .grad.
+
+    create_graph=True records the backward sweep ITSELF on the tape
+    (each node's vjp is re-linearized via its replay_fn and recorded as
+    a new node), so the returned grads are differentiable — enough for
+    gradient-penalty training (WGAN-GP). Higher-order beyond that works
+    the same way, recursively."""
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    grads = _seed_grads(outputs, grad_outputs)
     tape = current_tape()
     wanted = {t._uid for t in inputs}
+    if create_graph:
+        if retain_graph is None:
+            retain_graph = True
+        result_map = _sweep_create_graph(
+            tape, _seed_grad_tensors(outputs, grad_outputs), wanted)
+        out = []
+        for t in inputs:
+            g = result_map.get(t._uid)
+            if g is None and not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears to not "
+                    "have been used in the graph (set allow_unused=True "
+                    "to allow this).")
+            out.append(g)
+        return out
+    grads = _seed_grads(outputs, grad_outputs)
     visited = set()
     result_map = _sweep(tape, grads, accumulate_leaves=False, wanted=wanted,
                         visited=visited)
@@ -205,6 +227,113 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                 "used in the graph (set allow_unused=True to allow this).")
         out.append(None if g is None else _wrap(g))
     return out
+
+
+def _seed_grad_tensors(tensors, grad_tensors):
+    """Seeds as Tensors (create_graph mode: the whole sweep stays on
+    Tensors so every step is recordable)."""
+    from paddle_tpu.core.tensor import Tensor
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    grads = {}
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            g = Tensor(jnp.ones(t.shape, t._value.dtype),
+                       stop_gradient=True)
+        elif not isinstance(g, Tensor):
+            g = Tensor(jnp.asarray(g), stop_gradient=True)
+        prev = grads.get(t._uid)
+        grads[t._uid] = g if prev is None else prev + g
+    return grads
+
+
+def _record_replay(node, cot_tensors, cot_consts):
+    """Apply `node`'s vjp as a RECORDED op: re-linearize replay_fn at the
+    node's saved inputs and vjp with the (Tensor) cotangents, so the
+    result is itself differentiable wrt both the forward inputs (through
+    the re-linearization residuals) and the cotangent chain."""
+    from paddle_tpu.core.tensor import Tensor
+    n_in = len(node.inputs)
+    in_ts = list(node.inputs) + list(cot_tensors)
+    arrays = [t._value for t in in_ts]
+
+    def f(*arrs):
+        _, vjp2 = jax.vjp(node.replay_fn, *arrs[:n_in])
+        cots = []
+        it = iter(arrs[n_in:])
+        for c in cot_consts:
+            cots.append(next(it) if c is None else c)
+        return tuple(vjp2(tuple(cots)))
+
+    diff_pos = [i for i, t in enumerate(in_ts)
+                if not t.stop_gradient
+                and jnp.issubdtype(t._value.dtype, jnp.inexact)]
+
+    def f_diff(*diff_arrays):
+        av = list(arrays)
+        for i, a in zip(diff_pos, diff_arrays):
+            av[i] = a
+        return f(*av)
+
+    out_flat, vjp3 = jax.vjp(f_diff, *[arrays[i] for i in diff_pos])
+    wrapped = [Tensor(a, stop_gradient=not diff_pos) for a in out_flat]
+    if diff_pos:
+        node2 = TapeNode(
+            "grad:" + node.name,
+            inputs=[in_ts[i] for i in diff_pos],
+            outputs=wrapped, vjp_fn=vjp3,
+            out_avals=[(a.shape, a.dtype) for a in out_flat],
+            replay_fn=f_diff)     # third-and-higher order recurse
+        current_tape().record(node2)
+    return wrapped
+
+
+def _sweep_create_graph(tape, grads, wanted):
+    """Reverse sweep where every vjp application is RECORDED (grads are
+    Tensors). Mirrors _sweep; nodes lacking a replay_fn (recompute /
+    to_static regions) cannot contribute re-differentiable grads and
+    raise rather than silently returning wrong second derivatives."""
+    from paddle_tpu.core.tensor import Tensor
+
+    result: dict[int, Tensor] = {}
+    nodes = list(tape.nodes)   # replay RECORDS new nodes; fixed snapshot
+    for node in reversed(nodes):
+        if not any(uid in grads for uid in node.out_uids):
+            continue
+        if node.replay_fn is None:
+            raise NotImplementedError(
+                f"create_graph=True cannot differentiate through the "
+                f"'{node.name}' region (no replay function recorded); "
+                "compute the gradient penalty outside recompute/"
+                "to_static wrappers or via jax.grad composition.")
+        cot_tensors, cot_consts = [], []
+        for uid, (shape, dtype) in zip(node.out_uids, node.out_avals):
+            g = grads.get(uid)
+            if not jnp.issubdtype(dtype, jnp.inexact):
+                cot_consts.append(_zero_cotangent(shape, dtype))
+                continue
+            if g is None:
+                g = Tensor(jnp.zeros(shape, dtype), stop_gradient=True)
+            cot_tensors.append(g)
+            cot_consts.append(None)
+        in_grads = _record_replay(node, cot_tensors, cot_consts)
+        for t, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            for hook in getattr(t, "_grad_hooks", ()):
+                res = hook(g)
+                if res is not None:
+                    g = res if isinstance(res, Tensor) else Tensor(
+                        jnp.asarray(res), stop_gradient=False)
+            if t._uid in grads:
+                grads[t._uid] = grads[t._uid] + g
+            else:
+                grads[t._uid] = g
+            if t._uid in wanted:
+                result[t._uid] = grads[t._uid]
+    return result
 
 
 def _seed_grads(tensors, grad_tensors):

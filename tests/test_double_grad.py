@@ -1,0 +1,109 @@
+"""Eager double grad / create_graph=True (reference:
+paddle/fluid/eager/backward.cc:440 egr::Grad create_graph,
+general_grad.h; tests test_imperative_double_grad.py). The vjp replay is
+recorded on the tape, so gradient-penalty training works in eager —
+verified against pure-jax grad composition."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.tensor as T
+from paddle_tpu.autograd import grad
+
+
+def test_second_derivative_scalar_chain():
+    x = paddle.to_tensor(np.array([2.0, -1.5], "float32"))
+    x.stop_gradient = False
+    y = x * x * x
+    (g1,) = grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), 3 * np.array([4.0, 2.25]),
+                               rtol=1e-6)
+    (g2,) = grad(T.sum(g1), [x])
+    np.testing.assert_allclose(g2.numpy(), 6 * np.array([2.0, -1.5]),
+                               rtol=1e-6)
+
+
+def test_triple_grad_recursion():
+    x = paddle.to_tensor(np.array(1.7, "float32"))
+    x.stop_gradient = False
+    y = x * x * x * x                     # y = x^4
+    (g1,) = grad(y, [x], create_graph=True)
+    (g2,) = grad(g1, [x], create_graph=True)
+    (g3,) = grad(g2, [x])
+    np.testing.assert_allclose(float(g3), 24 * 1.7, rtol=1e-5)
+
+
+def test_wgan_gp_gradient_penalty_matches_jax():
+    """VERDICT item 8 criterion: WGAN-GP-style grad-penalty training in
+    eager, cross-checked against jax.grad-of-grad on the same math."""
+    paddle.seed(3)
+    d = nn.Sequential(nn.Linear(6, 16), nn.Tanh(), nn.Linear(16, 1))
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(8, 6).astype("float32")
+
+    # ---- eager paddle_tpu path ----------------------------------------
+    x = paddle.to_tensor(x_np)
+    x.stop_gradient = False
+    out = d(x)
+    (gx,) = grad(T.sum(out), [x], create_graph=True)
+    gp = T.mean((T.sqrt(T.sum(gx * gx, axis=1) + 1e-12) - 1.0) ** 2)
+    loss = T.mean(out) + 10.0 * gp
+    loss.backward()
+    grads_eager = {n: p.grad.numpy() for n, p in d.named_parameters()}
+    assert all(np.isfinite(v).all() for v in grads_eager.values())
+
+    # ---- pure jax reference on identical params -----------------------
+    params = {n: jnp.asarray(p.numpy()) for n, p in d.named_parameters()}
+
+    def fwd(params, xs):
+        h = xs @ params["0.weight"] + params["0.bias"]
+        h = jnp.tanh(h)
+        return h @ params["2.weight"] + params["2.bias"]
+
+    def loss_fn(params):
+        gx = jax.grad(lambda xs: jnp.sum(fwd(params, xs)))(
+            jnp.asarray(x_np))
+        gp = jnp.mean(
+            (jnp.sqrt(jnp.sum(gx * gx, axis=1) + 1e-12) - 1.0) ** 2)
+        return jnp.mean(fwd(params, jnp.asarray(x_np))) + 10.0 * gp
+
+    grads_jax = jax.grad(loss_fn)(params)
+    for n in grads_eager:
+        np.testing.assert_allclose(
+            grads_eager[n], np.asarray(grads_jax[n]), rtol=2e-4,
+            atol=2e-5)
+
+    # the penalty actually contributes: grads differ from the no-gp loss
+    d2 = nn.Sequential(nn.Linear(6, 16), nn.Tanh(), nn.Linear(16, 1))
+    d2.set_state_dict(d.state_dict())
+    x2 = paddle.to_tensor(x_np)
+    l2 = T.mean(d2(x2))
+    l2.backward()
+    base = d2[0].weight.grad.numpy()
+    assert not np.allclose(grads_eager["0.weight"], base)
+
+
+def test_grad_outputs_chain_through_cotangents():
+    """Second-order terms flowing through the COTANGENT chain (not just
+    the re-linearization residuals) must be captured."""
+    x = paddle.to_tensor(np.array(0.8, "float32"))
+    x.stop_gradient = False
+    y = T.exp(x)                      # dy/dx = e^x
+    (g1,) = grad(y, [x], create_graph=True)
+    z = g1 * g1                       # z = e^{2x}, dz/dx = 2 e^{2x}
+    (g2,) = grad(z, [x])
+    np.testing.assert_allclose(float(g2), 2 * np.exp(2 * 0.8), rtol=1e-5)
+
+
+def test_create_graph_through_recompute_raises():
+    from paddle_tpu.distributed.recompute import recompute
+
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    x.stop_gradient = False
+    y = recompute(lin, x)
+    with pytest.raises(NotImplementedError, match="recompute"):
+        grad(T.sum(y), [x], create_graph=True)
