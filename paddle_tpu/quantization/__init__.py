@@ -20,18 +20,26 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.nn.layer.layers import Layer
 
 __all__ = ["QuantConfig", "BaseQuanter", "BaseObserver", "quanter",
-           "QAT", "PTQ"]
+           "QAT", "PTQ", "HistObserver", "KLObserver", "AbsmaxObserver",
+           "AbsMaxChannelWiseWeightObserver", "FrozenFakeQuanter"]
 
 
 def _op(name, fn, *tensors):
     return dispatch(OpDef("quant." + name, fn), tensors, {})
 
 
-def _fake_quant_ste(x, scale, bit_length=8):
-    """Simulated quantization with straight-through gradients."""
+def _fake_quant_ste(x, scale, bit_length=8, quant_axis=-1):
+    """Simulated quantization with straight-through gradients. `scale`
+    may be a scalar (per-tensor) or a vector broadcast on `quant_axis`
+    (per-channel weight quant, reference quanters/abs_max.py
+    quant_axis)."""
     bnd = float(2 ** (bit_length - 1) - 1)
 
     def f(xv, sv):
+        if sv.ndim == 1 and xv.ndim > 1:
+            shape = [1] * xv.ndim
+            shape[quant_axis] = sv.shape[0]
+            sv = sv.reshape(shape)
         s = jnp.maximum(sv, 1e-9)
         q = jnp.clip(jnp.round(xv / s * bnd), -bnd, bnd) * s / bnd
         # STE: identity gradient within range
@@ -160,6 +168,172 @@ class AbsmaxObserverLayer(BaseObserver):
 
 def AbsmaxObserver(quant_bits=8):
     return QuanterFactory(AbsmaxObserverLayer, quant_bits=quant_bits)
+
+
+class HistObserverLayer(BaseObserver):
+    """Histogram percentile observer (reference: observers/hist.py
+    PercentHistObserver): accumulates an |x| histogram over calibration
+    batches — re-binning when the range grows — and calibrates the scale
+    at the `percent` quantile instead of the raw absmax, which clips
+    outliers that would otherwise waste the int8 range."""
+
+    def __init__(self, quant_bits=8, bins=2048, percent=0.99999):
+        super().__init__()
+        import numpy as np
+        self._bit_length = quant_bits
+        self._bins = bins
+        self._percent = percent
+        self._hist = np.zeros(bins, np.float64)
+        self._max = 0.0
+
+    def forward(self, x):
+        import numpy as np
+        try:
+            a = np.abs(np.asarray(x._value, np.float32)).ravel()
+        except Exception:
+            return x        # under tracing: calibration is eager-only
+        m = float(a.max()) if a.size else 0.0
+        if m > self._max:
+            if self._max > 0.0:   # re-bin old counts into the new range
+                old = self._hist
+                self._hist = np.zeros(self._bins, np.float64)
+                centers = (np.arange(self._bins) + 0.5) * (
+                    self._max / self._bins)
+                idx = np.minimum(
+                    (centers / m * self._bins).astype(int),
+                    self._bins - 1)
+                np.add.at(self._hist, idx, old)
+            self._max = m
+        if self._max > 0.0:
+            h, _ = np.histogram(a, bins=self._bins,
+                                range=(0.0, self._max))
+            self._hist += h
+        return x
+
+    def scales(self):
+        import numpy as np
+        if self._max == 0.0 or self._hist.sum() == 0:
+            return Tensor(jnp.zeros((), jnp.float32))
+        c = np.cumsum(self._hist) / self._hist.sum()
+        i = int(np.searchsorted(c, self._percent))
+        t = (i + 1) / self._bins * self._max
+        return Tensor(jnp.asarray(t, jnp.float32))
+
+    def bit_length(self):
+        return self._bit_length
+
+
+def HistObserver(quant_bits=8, bins_count=2048, percent=0.99999):
+    return QuanterFactory(HistObserverLayer, quant_bits=quant_bits,
+                          bins=bins_count, percent=percent)
+
+
+class KLObserverLayer(HistObserverLayer):
+    """KL-divergence calibration (reference: observers/kl.py): choose the
+    clip threshold whose int8-quantized distribution has minimal KL
+    divergence from the observed one (the TensorRT calibration recipe)."""
+
+    def __init__(self, quant_bits=8, bins=2048):
+        super().__init__(quant_bits=quant_bits, bins=bins)
+
+    def scales(self):
+        import numpy as np
+        hist = self._hist
+        if self._max == 0.0 or hist.sum() == 0:
+            return Tensor(jnp.zeros((), jnp.float32))
+        levels = 2 ** (self._bit_length - 1)   # 128 for int8
+        best_i, best_kl = self._bins, float("inf")
+        total = hist.sum()
+        for i in range(levels, self._bins + 1, max(1, self._bins // 256)):
+            p = hist[:i].copy()
+            p[-1] += hist[i:].sum()            # clip tail into last bin
+            if p.sum() == 0:
+                continue
+            # quantize p into `levels` buckets, expand back uniformly
+            chunks = np.array_split(p, levels)
+            q = np.concatenate([
+                np.full(len(ch), ch.sum() / max((ch > 0).sum(), 1))
+                * (ch > 0) for ch in chunks])
+            pn = p / total
+            qn = q / max(q.sum(), 1e-12)
+            mask = pn > 0
+            kl = float(np.sum(pn[mask] * np.log(
+                pn[mask] / np.maximum(qn[mask], 1e-12))))
+            if kl < best_kl:
+                best_kl, best_i = kl, i
+        t = (best_i + 0.5) / self._bins * self._max
+        return Tensor(jnp.asarray(min(t, self._max), jnp.float32))
+
+
+def KLObserver(quant_bits=8, bins_count=2048):
+    return QuanterFactory(KLObserverLayer, quant_bits=quant_bits,
+                          bins=bins_count)
+
+
+class AbsMaxChannelWiseWeightObserverLayer(BaseObserver):
+    """Per-channel weight observer (reference:
+    observers/abs_max_weight.py AbsMaxChannelWiseWeightObserver): one
+    scale per output channel along `quant_axis` (paddle layouts: 1 for
+    Linear's (in, out) weight, 0 for Conv2D's (out, in, kh, kw))."""
+
+    def __init__(self, quant_bits=8, quant_axis=None):
+        super().__init__()
+        self._bit_length = quant_bits
+        self._axis = quant_axis
+        self._scales = None
+
+    def forward(self, x):
+        v = x._value if isinstance(x, Tensor) else x
+        axis = self._axis
+        if axis is None:
+            axis = 1 if v.ndim == 2 else 0
+        self._resolved_axis = axis
+        red = tuple(i for i in range(v.ndim) if i != axis)
+        try:
+            self._scales = jnp.max(jnp.abs(v), axis=red)
+        except jax.errors.ConcretizationTypeError:
+            pass
+        return x
+
+    def scales(self):
+        return Tensor(self._scales)
+
+    def quant_axis(self):
+        return getattr(self, "_resolved_axis", self._axis or 0)
+
+    def bit_length(self):
+        return self._bit_length
+
+
+def AbsMaxChannelWiseWeightObserver(quant_bits=8, quant_axis=None):
+    return QuanterFactory(AbsMaxChannelWiseWeightObserverLayer,
+                          quant_bits=quant_bits, quant_axis=quant_axis)
+
+
+class FrozenFakeQuanter(BaseQuanter):
+    """Calibrated scales frozen into a fake q/dq op — what PTQ.convert
+    installs; exportable (jit.save lowers the round/clip/scale program
+    into the StableHLO module the Predictor then serves)."""
+
+    def __init__(self, scale, bit_length=8, quant_axis=-1):
+        super().__init__()
+        self.register_buffer("scale", scale if isinstance(scale, Tensor)
+                             else Tensor(jnp.asarray(scale, jnp.float32)))
+        self._bit_length = bit_length
+        self._axis = quant_axis
+
+    def forward(self, x):
+        return _fake_quant_ste(x, self.scale, self._bit_length,
+                               self._axis)
+
+    def scales(self):
+        return self.scale
+
+    def bit_length(self):
+        return self._bit_length
+
+    def quant_axis(self):
+        return self._axis
 
 
 # -- quanted layer wrappers (reference: nn/quant/ + wrapper.py) -------------
@@ -350,10 +524,10 @@ class PTQ(_Quantization):
             if isinstance(lay, (QuantedLinear, QuantedConv2D)):
                 for attr in ("weight_quanter", "activation_quanter"):
                     q = getattr(lay, attr)
-                    if isinstance(q, AbsmaxObserverLayer):
-                        fq = FakeQuanterWithAbsMaxObserverLayer(
-                            bit_length=q.bit_length())
-                        fq.scale._value = q.max_value._value
+                    if isinstance(q, BaseObserver):
+                        fq = FrozenFakeQuanter(q.scales(),
+                                               q.bit_length(),
+                                               q.quant_axis())
                         fq.eval()
                         setattr(lay, attr, fq)
         return model
