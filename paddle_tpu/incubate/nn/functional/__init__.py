@@ -377,6 +377,18 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     qkv_a = _a(qkv)
     kc = _a(key_cache)
     vc = _a(value_cache)
+    # EAGER-ONLY: the page/token bookkeeping below runs on host numpy
+    # (the reference's serving launcher drives this op eagerly too);
+    # under jit the seq-lens are tracers and there is no graph to build
+    if any(isinstance(_a(t), jax.core.Tracer)
+           for t in (block_tables, seq_lens_encoder, seq_lens_decoder,
+                     seq_lens_this_time)):
+        raise TypeError(
+            "block_multihead_attention is eager-only: its paged-KV "
+            "bookkeeping (block tables, sequence lengths) runs on the "
+            "host and cannot be traced under jit/to_static. Call it "
+            "outside the compiled function (serving loops drive it "
+            "eagerly, like the reference).")
     bt = _np.asarray(_a(block_tables))
     enc = _np.asarray(_a(seq_lens_encoder)).reshape(-1)
     dec = _np.asarray(_a(seq_lens_decoder)).reshape(-1)
