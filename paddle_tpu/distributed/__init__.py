@@ -34,7 +34,8 @@ all_to_all = alltoall  # torch-style alias the reference also exposes
 def __getattr__(name):
     import importlib
     if name in ("fleet", "checkpoint", "pipeline", "launch", "parallel",
-                "sharding", "elastic", "auto_tuner", "rpc"):
+                "sharding", "elastic", "auto_tuner", "rpc",
+                "auto_parallel", "watchdog"):
         mod = importlib.import_module(f"paddle_tpu.distributed.{name}")
         globals()[name] = mod
         return mod

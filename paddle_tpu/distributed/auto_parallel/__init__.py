@@ -1,0 +1,17 @@
+"""Auto-parallel (reference: python/paddle/distributed/auto_parallel/):
+the static Engine + planner. `Engine` plans a mesh with the analytic
+cost model, completes a sharding plan from the model structure, and
+compiles the hybrid-parallel step via paddle_tpu.parallel."""
+from paddle_tpu.distributed.auto_parallel.engine import (Engine, Strategy,
+                                                         plan_mesh,
+                                                         complete_plan)
+from paddle_tpu.distributed.auto_parallel import engine as _engine
+
+
+class _StaticNS:
+    engine = _engine
+
+
+static = _StaticNS()
+
+__all__ = ["Engine", "Strategy", "plan_mesh", "complete_plan", "static"]

@@ -1,0 +1,296 @@
+"""Auto-parallel static Engine (reference:
+python/paddle/distributed/auto_parallel/static/engine.py:61 Engine —
+prepare/fit/evaluate/predict/cost over a planned distributed program;
+the Completer (completion.py) infers per-tensor dist attributes and the
+tuner/cost model (tuner/, cost/) picks the process mesh).
+
+TPU-native collapse: "completion" is a name->PartitionSpec plan derived
+from the model STRUCTURE (GSPMD propagates everything downstream, so
+only parameter annotations are needed — the reference completes every
+tensor in the program); the planner ranks candidate (dp, fsdp, mp, pp)
+meshes with the same analytic roofline the auto_tuner uses, WITHOUT
+launching trials; execution is the compiled Trainer/PipelineTrainer
+step. Engine.cost() exposes the estimate like the reference's
+Engine.cost interface.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel.plan import ShardingPlan
+
+
+@dataclass
+class Strategy:
+    """(reference: auto_parallel/strategy.py Strategy). `auto_mode`
+    'semi' uses the degrees given below; 'full' lets plan_mesh pick."""
+    auto_mode: str = "full"          # 'full' | 'semi'
+    dp_degree: int = 1
+    fsdp_degree: int = 1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    num_microbatches: int = 4
+    compute_dtype: str = "bfloat16"
+    grad_accum_steps: int = 1
+    extra: dict = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# mesh planning (tuner/cost equivalent, trial-free)
+# ---------------------------------------------------------------------------
+
+def _model_stats(model):
+    from paddle_tpu.jit.functional import state_tensors
+    n_params = 0
+    for t in state_tensors(model).values():
+        n_params += int(np.prod(t._value.shape))
+    return n_params
+
+
+def plan_mesh(model, n_devices, tuner_cfg=None):
+    """Pick (dp, fsdp, mp, pp) for `n_devices` by ranking every feasible
+    factorization with the auto_tuner's analytic cost model (reference:
+    tuner/parallel_tuner.py + cost/estimate_cost — here no trials, pure
+    estimate). Returns (axes dict, ranked candidates)."""
+    from paddle_tpu.distributed.auto_tuner import (
+        default_candidates, prune_candidates, _cost)
+
+    cfg = dict(tuner_cfg or {})
+    cfg.setdefault("num_devices", n_devices)
+    cfg.setdefault("model_params", _model_stats(model))
+    stack = _detect_stack(model)
+    if stack is not None:
+        cfg.setdefault("num_layers", len(stack[1]))
+    cands = default_candidates(cfg)
+    kept, _ = prune_candidates(cands, cfg)
+    if not kept:
+        kept = [{"dp_degree": n_devices, "mp_degree": 1, "pp_degree": 1,
+                 "sharding_degree": 1, "micro_batch_size":
+                 cfg.get("micro_batch_size", 1)}]
+    ranked = sorted(kept, key=lambda c: _cost(c, cfg))
+    best = ranked[0]
+    axes = {}
+    if best["pp_degree"] > 1:
+        axes["pp"] = best["pp_degree"]
+    if best["dp_degree"] > 1:
+        axes["dp"] = best["dp_degree"]
+    if best.get("sharding_degree", 1) > 1:
+        axes["fsdp"] = best["sharding_degree"]
+    if best["mp_degree"] > 1:
+        axes["mp"] = best["mp_degree"]
+    if not axes:
+        axes["dp"] = n_devices
+    return axes, ranked
+
+
+def _detect_stack(model):
+    try:
+        from paddle_tpu.parallel.pipeline import detect_layer_stack
+        return detect_layer_stack(model)
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# plan completion (Completer equivalent)
+# ---------------------------------------------------------------------------
+
+class NamePlan(ShardingPlan):
+    """Exact param-name -> PartitionSpec plan (completion output)."""
+
+    def __init__(self, table, default=P()):
+        self.table = dict(table)
+        self.default = default
+        self.rules = []
+
+    def spec_for(self, name, ndim=None):
+        return self.table.get(name, self.default)
+
+    def __repr__(self):
+        rows = "\n".join(f"  {k}: {v}" for k, v in self.table.items())
+        return f"NamePlan(\n{rows}\n)"
+
+
+def complete_plan(model, mesh_axes):
+    """Derive Megatron-style parameter shardings from the model's
+    STRUCTURE (the Completer, reference auto_parallel/static/
+    completion.py:132, reduced to what GSPMD needs):
+
+    - nn.Embedding weights: vocab dim over 'mp', feature over 'fsdp'
+      (VocabParallelEmbedding);
+    - within any module that directly owns several nn.Linear sublayers,
+      every Linear but the LAST is column-parallel P(fsdp, mp) and the
+      last is row-parallel P(mp, fsdp) — this matches attention
+      (q/k/v col, o row), transformer MLPs (gate/up col, down row) and
+      BERT blocks without naming conventions;
+    - lone output heads (a Linear whose out_features looks vocab-sized)
+      are column-parallel; 1D params (norms, biases) replicate.
+    """
+    from paddle_tpu import nn
+    mp = "mp" if "mp" in mesh_axes else None
+    fsdp = "fsdp" if "fsdp" in mesh_axes else None
+    table = {}
+
+    emb_dims = set()
+    for name, sub in model.named_sublayers():
+        if isinstance(sub, nn.Embedding):
+            table[f"{name}.weight"] = P(mp, fsdp)
+            emb_dims.add(sub.weight.shape[0])
+
+    for name, sub in model.named_sublayers(include_self=True):
+        linears = [(n, c) for n, c in sub.named_children()
+                   if isinstance(c, nn.Linear)]
+        if len(linears) >= 2:
+            for n, c in linears[:-1]:
+                table.setdefault(f"{name}.{n}.weight" if name else
+                                 f"{n}.weight", P(fsdp, mp))
+            ln, lc = linears[-1]
+            table.setdefault(f"{name}.{ln}.weight" if name else
+                             f"{ln}.weight", P(mp, fsdp))
+        elif len(linears) == 1:
+            n, c = linears[0]
+            full = f"{name}.{n}.weight" if name else f"{n}.weight"
+            out_f = c.weight.shape[1]
+            if out_f in emb_dims or out_f >= 8 * c.weight.shape[0]:
+                table.setdefault(full, P(fsdp, mp))   # vocab head
+    return NamePlan(table)
+
+
+# ---------------------------------------------------------------------------
+# the Engine
+# ---------------------------------------------------------------------------
+
+class Engine:
+    """reference: auto_parallel/static/engine.py:61. prepare() plans the
+    mesh + completes the plan + builds the compiled step; fit/evaluate/
+    predict drive it; cost() returns the analytic estimate."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics
+        self.strategy = strategy or Strategy()
+        self.mesh_axes = None
+        self.plan = None
+        self.trainer = None
+        self._ranked = None
+
+    # -- planning ---------------------------------------------------------
+    def prepare(self, n_devices=None, tuner_cfg=None):
+        from paddle_tpu.distributed.mesh import init_mesh
+        n = n_devices or len(jax.devices())
+        st = self.strategy
+        if st.auto_mode == "semi":
+            axes = {k: v for k, v in
+                    (("pp", st.pp_degree), ("dp", st.dp_degree),
+                     ("fsdp", st.fsdp_degree), ("mp", st.mp_degree))
+                    if v > 1} or {"dp": 1}
+        else:
+            axes, self._ranked = plan_mesh(self.model, n, tuner_cfg)
+        self.mesh_axes = axes
+        self.mesh = init_mesh(axes)
+        self.plan = complete_plan(self.model, axes)
+
+        from paddle_tpu.parallel import Trainer, TrainStepConfig
+        if axes.get("pp", 1) > 1:
+            from paddle_tpu.parallel.pipeline import (PipelineTrainer,
+                                                      PipelineConfig)
+            self.trainer = PipelineTrainer(
+                self.model, self.optimizer, mesh=self.mesh,
+                plan=self.plan,
+                config=PipelineConfig(
+                    compute_dtype=st.compute_dtype,
+                    num_microbatches=st.num_microbatches))
+        else:
+            self.trainer = Trainer(
+                self.model, self.optimizer, mesh=self.mesh.jax_mesh,
+                plan=self.plan,
+                config=TrainStepConfig(
+                    compute_dtype=st.compute_dtype,
+                    grad_accum_steps=st.grad_accum_steps))
+        return self
+
+    # -- execution --------------------------------------------------------
+    def fit(self, train_data, epochs=1, steps_per_epoch=None, verbose=0):
+        if self.trainer is None:
+            self.prepare()
+        losses = []
+        for _ in range(epochs):
+            for i, batch in enumerate(train_data):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
+                losses.append(float(self.trainer.step(
+                    self._as_batch(batch))))
+        return losses
+
+    def evaluate(self, eval_data, steps=None):
+        from paddle_tpu.jit.functional import functional_call
+        from paddle_tpu.core.tensor import Tensor
+        self.trainer.sync_to_model()
+        self.model.eval()
+        tot, n = 0.0, 0
+        try:
+            for i, batch in enumerate(eval_data):
+                if steps is not None and i >= steps:
+                    break
+                b = self._as_batch(batch)
+                out = self.model(
+                    Tensor(b["input_ids"], stop_gradient=True),
+                    labels=Tensor(b["labels"], stop_gradient=True))
+                loss = out[0] if isinstance(out, tuple) else out
+                tot += float(loss)
+                n += 1
+        finally:
+            self.model.train()
+        return tot / max(n, 1)
+
+    def predict(self, data):
+        from paddle_tpu.core.tensor import Tensor
+        self.trainer.sync_to_model()
+        self.model.eval()
+        try:
+            out = [self.model(Tensor(self._as_batch(b)["input_ids"],
+                                     stop_gradient=True))
+                   for b in data]
+        finally:
+            self.model.train()
+        return out
+
+    def cost(self, tuner_cfg=None):
+        """Analytic per-step time + per-chip memory for the prepared
+        config (reference Engine.cost / cost/estimate_cost)."""
+        from paddle_tpu.distributed.auto_tuner import (_cost,
+                                                       _memory_bytes)
+        axes = self.mesh_axes or {}
+        cfg = {
+            "dp_degree": axes.get("dp", 1),
+            "mp_degree": axes.get("mp", 1),
+            "pp_degree": axes.get("pp", 1),
+            "sharding_degree": axes.get("fsdp", 1),
+            "micro_batch_size": (tuner_cfg or {}).get(
+                "micro_batch_size", 1),
+        }
+        tc = dict(tuner_cfg or {})
+        tc.setdefault("num_devices",
+                      int(np.prod(list(axes.values()))) if axes else 1)
+        tc.setdefault("model_params", _model_stats(self.model))
+        return {"step_time_s": _cost(cfg, tc),
+                "memory_bytes_per_chip": _memory_bytes(cfg, tc)}
+
+    @staticmethod
+    def _as_batch(batch):
+        from paddle_tpu.core.tensor import Tensor
+        if isinstance(batch, dict):
+            return {k: (v._value if isinstance(v, Tensor) else v)
+                    for k, v in batch.items()}
+        if isinstance(batch, (tuple, list)) and len(batch) == 2:
+            x, y = batch
+            return {"input_ids": x._value if isinstance(x, Tensor) else x,
+                    "labels": y._value if isinstance(y, Tensor) else y}
+        raise ValueError("batch must be a dict or an (input, label) pair")

@@ -23,6 +23,34 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Test tiers (reference: tools/gen_ut_cmakelists.py run_type tiers):
+# `-m quick` must stay green in <3 min so the round driver can always
+# run it; the full suite's runtime is documented in tests/README.md.
+# Modules dominated by jit-compile-heavy model/e2e runs are `slow`.
+_SLOW_MODULES = {
+    "test_models_llama", "test_models_bert_gpt_dit", "test_pipeline",
+    "test_context_parallel", "test_flash_attention",
+    "test_native_and_profiler", "test_quantization_depth",
+    "test_distributed_sharding", "test_hapi", "test_audio_text_debugging",
+    "test_vision_ops_models", "test_incubate", "test_op_harness",
+    "test_dist_checkpoint", "test_static_inference", "test_moe",
+    "test_sparse", "test_geometric", "test_rnn", "test_watchdog_elastic",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: compile-heavy/e2e tests")
+    config.addinivalue_line("markers", "quick: fast tier (<3 min total)")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = item.module.__name__.rsplit(".", 1)[-1]
+        if mod in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+        else:
+            item.add_marker(pytest.mark.quick)
+
 
 @pytest.fixture(autouse=True)
 def _seed_everything():
