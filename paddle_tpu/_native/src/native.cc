@@ -656,7 +656,12 @@ class CommWatchdog {
   void Start(int64_t poll_ms) {
     std::lock_guard<std::mutex> g(mu_);
     poll_ms_ = poll_ms;
-    if (running_) return;
+    if (running_) {
+      // wake the poller so a NEW (possibly much shorter) poll interval
+      // takes effect now, not after the previous interval elapses
+      cv_.notify_all();
+      return;
+    }
     running_ = true;
     thread_ = std::thread([this] { Loop(); });
   }

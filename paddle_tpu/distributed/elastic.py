@@ -193,8 +193,15 @@ class StoreHeartbeat:
         now = time.time()
         stale = []
         for r in range(self.world_size):
+            key = f"hb/{r}"
             try:
-                t = float(self.store.get(f"hb/{r}").decode())
+                # check() first: a blind get() on a missing key BLOCKS
+                # for the store's full timeout (it waits for the key)
+                if hasattr(self.store, "check") and \
+                        not self.store.check(key):
+                    stale.append(r)
+                    continue
+                t = float(self.store.get(key).decode())
             except Exception:
                 stale.append(r)
                 continue

@@ -32,9 +32,12 @@ _SLOW_MODULES = {
     "test_context_parallel", "test_flash_attention",
     "test_native_and_profiler", "test_quantization_depth",
     "test_distributed_sharding", "test_hapi", "test_audio_text_debugging",
-    "test_vision_ops_models", "test_incubate", "test_op_harness",
-    "test_dist_checkpoint", "test_static_inference", "test_moe",
-    "test_sparse", "test_geometric", "test_rnn", "test_watchdog_elastic",
+    "test_vision_ops_models", "test_vision", "test_incubate",
+    "test_op_harness", "test_dist_checkpoint", "test_static_inference",
+    "test_moe", "test_sparse", "test_geometric", "test_rnn",
+    "test_watchdog_elastic", "test_auto_parallel_engine",
+    "test_nn_optimizer", "test_op_bench_tool", "test_distribution",
+    "test_fleet",
 }
 
 
