@@ -70,9 +70,7 @@ def _rope_cos_sin(seq_len, head_dim, theta, dtype, position_ids=None):
     return jnp.cos(freqs), jnp.sin(freqs)
 
 
-def _apply_rope_neox(x, cos, sin):
-    """NeoX/Llama style: rotate [first half | second half]. x: (B,S,H,D);
-    cos/sin broadcastable (S, D/2) or (B,S,D/2)."""
+def _rope_neox_raw(x, cos, sin):
     d2 = x.shape[-1] // 2
     x1, x2 = x[..., :d2], x[..., d2:]
     if cos.ndim == 2:
@@ -87,6 +85,32 @@ def _apply_rope_neox(x, cos, sin):
     o1 = xf1 * cos - xf2 * sin
     o2 = xf2 * cos + xf1 * sin
     return jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
+
+
+@jax.custom_vjp
+def _apply_rope_neox(x, cos, sin):
+    """NeoX/Llama style: rotate [first half | second half]. x: (B,S,H,D);
+    cos/sin broadcastable (S, D/2) or (B,S,D/2).
+
+    Custom vjp: the backward of a rotation is the INVERSE rotation —
+    the same forward-shaped code on the cotangent with -sin — which
+    avoids the layout-hostile slice/concat transpose chain jax AD
+    generates for the half-split formulation (measured as relayout
+    copies in the step trace)."""
+    return _rope_neox_raw(x, cos, sin)
+
+
+def _rope_fwd(x, cos, sin):
+    return _rope_neox_raw(x, cos, sin), (cos, sin)
+
+
+def _rope_bwd(res, g):
+    cos, sin = res
+    return (_rope_neox_raw(g, cos, -sin), jnp.zeros_like(cos),
+            jnp.zeros_like(sin))
+
+
+_apply_rope_neox.defvjp(_rope_fwd, _rope_bwd)
 
 
 def _apply_rope_interleaved(x, cos, sin):
