@@ -1,36 +1,139 @@
-"""`paddle.distributed.rpc` shim (reference: python/paddle/distributed/
-rpc/ over the brpc agent — SURVEY.md §2.5 'thin equivalent only if
-needed'). Single-process: sync/async RPC execute locally; multi-host
-users should route work through the jax.distributed coordination service
-or an external RPC system.
+"""`paddle.distributed.rpc` (reference: python/paddle/distributed/rpc/
+rpc.py over the C++ brpc RpcAgent — paddle/fluid/distributed/rpc/).
+
+TPU-native: the agent is a small TCP server per worker + the TCPStore as
+the rendezvous (the reference uses a master endpoint the same way).
+Payloads are pickled python callables/results — like the reference, this
+is a TRUSTED-CLUSTER mechanism (training jobs), not a public endpoint.
+Frames are length-prefixed; each request runs on the callee's thread
+pool; exceptions travel back and re-raise at the caller.
 """
 from __future__ import annotations
 
 import concurrent.futures as _fut
+import json
+import pickle
+import socket
+import struct
+import threading
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos",
-           "get_current_worker_info"]
+           "get_current_worker_info", "WorkerInfo"]
 
-_state = {"name": None, "rank": 0, "world_size": 1,
-          "pool": None}
+_state = {"name": None, "rank": 0, "world_size": 1, "pool": None,
+          "server": None, "store": None, "workers": {}}
 
 
 class WorkerInfo:
-    def __init__(self, name, rank):
+    def __init__(self, name, rank, host=None, port=None):
         self.name, self.rank = name, rank
+        self.host, self.port = host, port
 
     def __repr__(self):
         return f"WorkerInfo(name={self.name}, rank={self.rank})"
 
 
+def _send_frame(sock, data: bytes):
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_frame(sock) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        hdr += chunk
+    n = struct.unpack("<Q", hdr)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+class _RpcServer:
+    """Per-worker request server (the brpc agent equivalent)."""
+
+    def __init__(self, pool):
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", 0))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._pool = pool
+        self._running = True
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_one, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_one(self, conn):
+        try:
+            with conn:
+                req = _recv_frame(conn)
+                fn, args, kwargs = pickle.loads(req)
+                try:
+                    result = fn(*args, **kwargs)
+                    payload = pickle.dumps((True, result))
+                except Exception as e:          # noqa: BLE001
+                    import traceback
+                    payload = pickle.dumps(
+                        (False, (e, traceback.format_exc())))
+                _send_frame(conn, payload)
+        except (ConnectionError, OSError):
+            pass
+
+    def stop(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
 def init_rpc(name, rank=0, world_size=1, master_endpoint=None):
-    if world_size > 1:
-        raise NotImplementedError(
-            "multi-host rpc is not part of the TPU rebuild (SURVEY.md "
-            "§2.5); use jax.distributed / paddle_tpu.distributed.launch")
-    _state.update(name=name, rank=rank, world_size=world_size,
-                  pool=_fut.ThreadPoolExecutor(max_workers=4))
+    """Rendezvous through a TCPStore at master_endpoint (rank 0 hosts
+    it), start this worker's agent, and exchange worker addresses
+    (reference: rpc.py init_rpc + MasterEndpoint rendezvous)."""
+    from paddle_tpu.distributed.store import TCPStore
+
+    pool = _fut.ThreadPoolExecutor(max_workers=8)
+    server = _RpcServer(pool)
+    _state.update(name=name, rank=rank, world_size=world_size, pool=pool,
+                  server=server)
+    if world_size == 1 and master_endpoint is None:
+        _state["workers"] = {name: WorkerInfo(name, rank, "127.0.0.1",
+                                              server.port)}
+        return
+
+    host, port = (master_endpoint or "127.0.0.1:0").rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size, prefix="rpc/")
+    _state["store"] = store
+    me = {"name": name, "rank": rank,
+          "host": socket.gethostbyname(socket.gethostname())
+          if host not in ("127.0.0.1", "localhost") else "127.0.0.1",
+          "port": server.port}
+    store.set(f"worker/{rank}", json.dumps(me).encode())
+    workers = {}
+    for r in range(world_size):
+        store.wait(f"worker/{r}")
+        info = json.loads(store.get(f"worker/{r}").decode())
+        workers[info["name"]] = WorkerInfo(info["name"], r, info["host"],
+                                           info["port"])
+    _state["workers"] = workers
 
 
 def _check():
@@ -38,24 +141,63 @@ def _check():
         raise RuntimeError("call init_rpc first")
 
 
+def _target(to) -> WorkerInfo:
+    try:
+        return _state["workers"][to]
+    except KeyError:
+        raise ValueError(
+            f"unknown rpc worker {to!r}; known: "
+            f"{sorted(_state['workers'])}") from None
+
+
+def _invoke(to, fn, args, kwargs, timeout):
+    info = _target(to)
+    if info.name == _state["name"]:
+        return fn(*(args or ()), **(kwargs or {}))
+    with socket.create_connection(
+            (info.host, info.port),
+            timeout=None if timeout in (-1, None) else timeout) as sock:
+        _send_frame(sock, pickle.dumps((fn, args or (), kwargs or {})))
+        ok, payload = pickle.loads(_recv_frame(sock))
+    if ok:
+        return payload
+    exc, tb = payload
+    raise RuntimeError(
+        f"rpc to {to!r} failed: {exc!r}\nremote traceback:\n{tb}")
+
+
 def rpc_sync(to, fn, args=None, kwargs=None, timeout=-1):
     _check()
-    return fn(*(args or ()), **(kwargs or {}))
+    return _invoke(to, fn, args, kwargs, timeout)
 
 
 def rpc_async(to, fn, args=None, kwargs=None, timeout=-1):
     _check()
-    return _state["pool"].submit(fn, *(args or ()), **(kwargs or {}))
+    return _state["pool"].submit(_invoke, to, fn, args, kwargs, timeout)
 
 
 def shutdown():
+    if _state["store"] is not None and _state["world_size"] > 1:
+        try:
+            _state["store"].barrier("rpc_shutdown", _state["rank"],
+                                    _state["world_size"], timeout=60)
+        except Exception:
+            pass
+        _state["store"].close()
+        _state["store"] = None
+    if _state["server"] is not None:
+        _state["server"].stop()
+        _state["server"] = None
     if _state["pool"] is not None:
         _state["pool"].shutdown()
         _state["pool"] = None
+    _state["workers"] = {}
 
 
 def get_worker_info(name=None):
-    return WorkerInfo(name or _state["name"], _state["rank"])
+    if name is None:
+        return get_current_worker_info()
+    return _target(name)
 
 
 def get_current_worker_info():
@@ -63,4 +205,6 @@ def get_current_worker_info():
 
 
 def get_all_worker_infos():
+    if _state["workers"]:
+        return sorted(_state["workers"].values(), key=lambda w: w.rank)
     return [get_current_worker_info()]

@@ -131,8 +131,14 @@ class Predictor:
         n_in = len(args_tree.children()) - 1
         self._in_names = [f"x{i}" for i in range(max(n_in, 0))]
         self._inputs = {n: _IOHandle(n) for n in self._in_names}
-        self._out_names = []
-        self._outputs = {}
+        # output arity is part of the exported signature: name the
+        # handles up front so serving metadata works before first run
+        try:
+            n_out = self._layer._exported.out_tree.num_leaves
+        except Exception:
+            n_out = 0
+        self._out_names = [f"out{i}" for i in range(n_out)]
+        self._outputs = {n: _IOHandle(n) for n in self._out_names}
 
     def get_input_names(self):
         return list(self._in_names)

@@ -1,0 +1,140 @@
+"""HTTP serving wrapper over the Predictor (reference: the C++
+AnalysisPredictor is wrapped by Paddle Serving / paddle_inference_c for
+deployment; here a dependency-free HTTP/JSON server plays that role —
+the exported StableHLO program is the deployment artifact, SURVEY.md
+§2.7).
+
+POST /predict  {"inputs": {name: nested-list | {"data": .., "dtype": ..}}}
+           ->  {"outputs": {name: {"data": .., "dtype": .., "shape": ..}}}
+GET  /health   -> {"status": "ok", "model": ...}
+GET  /metadata -> input/output names of the served program
+
+Requests are serialized through a lock (one XLA executable, one chip);
+batching across HTTP clients is the caller's job (the reference's
+serving stack batches upstream of the predictor too).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+__all__ = ["PredictorServer", "serve"]
+
+
+class PredictorServer:
+    """Serve a Predictor (or any callable dict->dict) over HTTP."""
+
+    def __init__(self, predictor, host="127.0.0.1", port=0,
+                 model_name="model"):
+        self.predictor = predictor
+        self.model_name = model_name
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):      # quiet
+                pass
+
+            def _reply(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    return self._reply(200, {"status": "ok",
+                                             "model": outer.model_name})
+                if self.path == "/metadata":
+                    return self._reply(200, outer.metadata())
+                return self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    return self._reply(404, {"error": "unknown path"})
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n))
+                    out = outer.predict(req.get("inputs", {}))
+                    return self._reply(200, {"outputs": out})
+                except Exception as e:      # noqa: BLE001
+                    return self._reply(400, {"error": str(e)})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self.host = host
+        self._thread = None
+
+    # -- core -------------------------------------------------------------
+    def metadata(self):
+        p = self.predictor
+        if hasattr(p, "get_input_names"):
+            return {"inputs": list(p.get_input_names()),
+                    "outputs": list(p.get_output_names())}
+        return {"inputs": [], "outputs": []}
+
+    @staticmethod
+    def _decode(v):
+        if isinstance(v, dict):
+            return np.asarray(v["data"], dtype=v.get("dtype", "float32"))
+        return np.asarray(v, dtype=np.float32)
+
+    def predict(self, inputs: dict) -> dict:
+        p = self.predictor
+        with self._lock:
+            if hasattr(p, "get_input_names"):
+                names = p.get_input_names()
+                for name in names:
+                    if name not in inputs and len(names) == 1 \
+                            and len(inputs) == 1:
+                        # single-input convenience: accept any key
+                        (v,) = inputs.values()
+                    else:
+                        v = inputs[name]
+                    p.get_input_handle(name).copy_from_cpu(
+                        self._decode(v))
+                p.run()
+                out = {}
+                for name in p.get_output_names():
+                    arr = p.get_output_handle(name).copy_to_cpu()
+                    out[name] = {"data": np.asarray(arr).tolist(),
+                                 "dtype": str(np.asarray(arr).dtype),
+                                 "shape": list(np.asarray(arr).shape)}
+                return out
+            # plain callable over numpy dict
+            res = p({k: self._decode(v) for k, v in inputs.items()})
+            return {k: {"data": np.asarray(v).tolist(),
+                        "dtype": str(np.asarray(v).dtype),
+                        "shape": list(np.asarray(v).shape)}
+                    for k, v in res.items()}
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def serve(model_path, params_path=None, host="127.0.0.1", port=8866,
+          block=True):
+    """One-call deployment: load the exported program into a Predictor
+    and serve it (reference: paddle_inference demo main loops)."""
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(model_path, params_path))
+    srv = PredictorServer(pred, host=host, port=port).start()
+    if block:
+        try:
+            srv._thread.join()
+        except KeyboardInterrupt:
+            srv.stop()
+    return srv
