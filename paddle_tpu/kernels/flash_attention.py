@@ -72,6 +72,11 @@ _BLOCK_Q = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK_Q", 512))
 _BLOCK_K = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK_K", 512))
 _BLOCK_Q_BWD = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK_Q_BWD", 512))
 _BLOCK_K_BWD = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK_K_BWD", 512))
+# streamed-kv (long-sequence) kernels want much larger k blocks: fewer
+# grid steps and fewer lse/delta re-reads. S=16k b1 on v5e measured
+# 9.2k tok/s at bk=512 vs 13.9k at bk=2048.
+_BLOCK_K_STREAM = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK_K_STREAM",
+                                      2048))
 
 
 def _prec(dtype):
@@ -256,9 +261,12 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
 
 
 # whole-k/v per grid cell is faster but caps kv length; beyond this byte
-# budget (k+v resident per kernel) the streamed 4D-grid variants kick in
+# budget (k+v resident per kernel) the streamed 4D-grid variants kick in.
+# 3MB: S=8k (2.1MB k+v at d=64) stays whole-kv, S=16k (4.2MB) streams —
+# the whole-kv dq kernel at 16k measured 17.5M scoped vmem (>16M limit)
+# inside the full remat train step.
 _KV_VMEM_BYTES = int(_os.environ.get("PADDLE_TPU_FLASH_KV_VMEM",
-                                     6 * 1024 * 1024))
+                                     3 * 1024 * 1024))
 
 
 def _auto_stream_kv(sk_p, d, itemsize):
@@ -319,9 +327,16 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
         k = jnp.pad(k, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
 
-    kt = jnp.swapaxes(k, 2, 3)   # (b, h, d, sk): XLA fuses the transpose
     if stream_kv is None:
         stream_kv = _auto_stream_kv(sk_p, d, k.dtype.itemsize)
+    if stream_kv and block_k is None and _BLOCK_K_STREAM > bk:
+        bk = min(_BLOCK_K_STREAM, sk)
+        sk_p = (sk + bk - 1) // bk * bk
+        if sk_p != k.shape[2]:
+            pad = sk_p - sk
+            k = jnp.pad(k[:, :, :sk], ((0, 0), (0, 0), (0, pad), (0, 0)))
+            v = jnp.pad(v[:, :, :sk], ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kt = jnp.swapaxes(k, 2, 3)   # (b, h, d, sk): XLA fuses the transpose
     lanes = _lanes_for(sk_p, d, k.dtype.itemsize)
 
     if stream_kv:
@@ -705,9 +720,11 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 # fused single-kernel backward needs k+v resident AND full-kv f32 dk/dv
 # scratch (2x k+v bytes in f32) in VMEM; above this k+v byte budget fall
-# back to the round-1 dq + dkv kernel pair
+# back to the round-1 dq + dkv kernel pair. 1MB measured safe on v5e
+# (16MB scoped vmem); 2MB compiled standalone but blew the scoped limit
+# inside the full train step at S=8k (co-scheduled ops share VMEM).
 _FUSED_KV_BYTES = int(_os.environ.get("PADDLE_TPU_FLASH_FUSED_KV",
-                                      2 * 1024 * 1024))
+                                      1024 * 1024))
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
@@ -746,6 +763,13 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
 
     if stream_kv is None:
         stream_kv = _auto_stream_kv(sk_p, d, k.dtype.itemsize)
+    if stream_kv and block_k is None and _BLOCK_K_STREAM > bk:
+        bk = min(_BLOCK_K_STREAM, sk)
+        sk_p = (sk + bk - 1) // bk * bk
+        if k.shape[2] != sk_p:     # re-pad from the valid prefix
+            pad = ((0, 0), (0, 0), (0, sk_p - sk), (0, 0))
+            k = jnp.pad(k[:, :, :sk], pad)
+            v = jnp.pad(v[:, :, :sk], pad)
     if fused is None:
         fused = (not stream_kv
                  and sk_p * d * 2 * k.dtype.itemsize <= _FUSED_KV_BYTES)
