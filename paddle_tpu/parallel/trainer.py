@@ -46,6 +46,12 @@ class TrainStepConfig:
     # the big vocab params. Env PADDLE_TPU_OPT_BARRIER overrides
     # (comma-separated substrings, '1' = all, '' = unset -> this field).
     opt_barrier_params: tuple = ("lm_head", "embed_tokens")
+    # keep Adam moments in PINNED HOST memory between steps (reference:
+    # sharding/group_sharded_optimizer_stage2.py offload=True + the
+    # pinned allocator, allocator_facade host-pinned pool): frees
+    # 8 bytes/param of HBM for activations/batch at the cost of a
+    # host<->HBM round trip per step. TPU-native via jax memory kinds.
+    offload_opt_state: bool = False
 
 
 def _cast_tree(tree, dtype):
@@ -55,6 +61,18 @@ def _cast_tree(tree, dtype):
     return jax.tree.map(
         lambda a: a.astype(dt)
         if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+
+def _memories_supported() -> bool:
+    """pinned_host placement works on TPU (verified live); the CPU
+    emulation backend has the memory SPACES but no lowering for the
+    placement custom-call."""
+    try:
+        dev = jax.devices()[0]
+        kinds = {m.kind for m in dev.addressable_memories()}
+        return dev.platform == "tpu" and "pinned_host" in kinds
+    except Exception:
+        return False
 
 
 def _opt_barrier(grads: dict, cfg) -> dict:
@@ -89,6 +107,9 @@ class Trainer:
         self.mesh = mesh
         self.plan = plan
         self.config = config or TrainStepConfig()
+        if getattr(model, "_sharding_offload", False):
+            # group_sharded_parallel(offload=True) hint
+            self.config.offload_opt_state = True
         self._loss_fn = loss_fn
         self._step_fn = None
         self._init_state()
@@ -103,9 +124,42 @@ class Trainer:
             {n: self.params[n] for n in self.param_names})
         if self.mesh is not None and self.plan is not None:
             self._shard_state()
+        if self.config.offload_opt_state:
+            if _memories_supported():
+                self._offload_opt_state()
+            else:
+                import warnings
+                warnings.warn(
+                    "offload_opt_state: this backend has no pinned_host "
+                    "memory space (CPU emulation lacks the placement "
+                    "op); keeping optimizer state in device memory")
+                self.config.offload_opt_state = False
 
     def _spec(self, name):
         return self.plan.spec_for(name)
+
+    def _opt_leaf_sharding(self, name, v, kind=None):
+        """Sharding for one optimizer-state leaf: moments shard like
+        their parameter, scalars replicate; `kind` selects the memory
+        space ('pinned_host' while parked between steps under
+        offload_opt_state, 'device' inside the step)."""
+        if self.mesh is not None:
+            spec = (self._spec(name)
+                    if getattr(v, "ndim", 0) == len(self.params[name].shape)
+                    else P())
+            return NamedSharding(self.mesh, spec, memory_kind=kind)
+        from jax.sharding import SingleDeviceSharding
+        return SingleDeviceSharding(jax.devices()[0], memory_kind=kind)
+
+    def _offload_opt_state(self):
+        """Park moments in pinned host memory (reference:
+        group_sharded_optimizer_stage2.py offload=True; the pinned pool
+        of allocator_facade) — HBM holds them only during the update."""
+        self.opt_state = {
+            n: {k: jax.device_put(
+                v, self._opt_leaf_sharding(n, v, "pinned_host"))
+                for k, v in st.items()}
+            for n, st in self.opt_state.items()}
 
     def _shard_state(self):
         for n in list(self.params):
@@ -115,11 +169,8 @@ class Trainer:
         # (beta_pow) replicate. This is ZeRO sharding of optimizer state
         # (reference: dygraph_sharding_optimizer.py:48) for free.
         for n, st in self.opt_state.items():
-            spec = self._spec(n)
             for k, v in st.items():
-                s = spec if getattr(v, "ndim", 0) == len(
-                    self.params[n].shape) else P()
-                st[k] = jax.device_put(v, NamedSharding(self.mesh, s))
+                st[k] = jax.device_put(v, self._opt_leaf_sharding(n, v))
 
     # -- the compiled step -------------------------------------------------
     def _loss_from_batch(self, params_c, batch):
@@ -209,6 +260,14 @@ class Trainer:
         grads = _opt_barrier(
             jax.tree.map(lambda g: g.astype(jnp.float32), grads),
             self.config)
+        if self.config.offload_opt_state:
+            # pull the parked moments into device memory for the update;
+            # out_shardings park the new state back in pinned host
+            opt_state = {
+                n: {k: jax.device_put(
+                    v, self._opt_leaf_sharding(n, v, "device"))
+                    for k, v in st.items()}
+                for n, st in opt_state.items()}
         train_p = {n: params[n] for n in self.param_names}
         new_p, new_s = self.optimizer.apply_gradients_arrays(
             train_p, grads, opt_state, lr)
@@ -217,16 +276,19 @@ class Trainer:
         return loss, out_params, new_s
 
     def _jit_step(self, step):
-        """Shared jit wrapper: donation + param/opt-state shardings."""
+        """Shared jit wrapper: donation + param/opt-state shardings.
+        Under offload_opt_state the opt-state in/out shardings carry
+        memory_kind='pinned_host', so XLA schedules the H2D prefetch and
+        the D2H writeback of the moments inside the step."""
         mesh = self.mesh
         donate = (0, 1) if self.config.donate else ()
+        park = "pinned_host" if self.config.offload_opt_state else None
+        if park:
+            donate = (0,) if self.config.donate else ()
         if mesh is not None:
             pspec = {n: NamedSharding(mesh, self._spec(n))
                      for n in self.params}
-            sspec = {n: {k: (NamedSharding(mesh, self._spec(n))
-                             if getattr(v, "ndim", 0) == len(
-                                 self.params[n].shape)
-                             else NamedSharding(mesh, P()))
+            sspec = {n: {k: self._opt_leaf_sharding(n, v, park)
                          for k, v in st.items()}
                      for n, st in self.opt_state.items()}
             rep = NamedSharding(mesh, P())
@@ -234,6 +296,13 @@ class Trainer:
                 step, donate_argnums=donate,
                 in_shardings=(pspec, sspec, rep, None),
                 out_shardings=(rep, pspec, sspec))
+        if park:
+            sspec = {n: {k: self._opt_leaf_sharding(n, v, park)
+                         for k, v in st.items()}
+                     for n, st in self.opt_state.items()}
+            return jax.jit(step, donate_argnums=donate,
+                           in_shardings=(None, sspec, None, None),
+                           out_shardings=(None, None, sspec))
         return jax.jit(step, donate_argnums=donate)
 
     # -- public API --------------------------------------------------------

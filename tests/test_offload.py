@@ -1,0 +1,48 @@
+"""Optimizer-state host offload (reference:
+sharding/group_sharded_optimizer_stage2.py offload=True + the pinned
+allocator pool, allocator_facade.h:45). TPU-native via jax memory kinds:
+moments park in pinned_host between steps; the CPU emulation backend has
+no placement lowering, so the flag degrades with a warning."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu.models import LlamaForCausalLM
+from paddle_tpu.models.llama import tiny_llama_config
+from paddle_tpu.parallel import Trainer, TrainStepConfig
+
+
+def test_offload_degrades_gracefully_on_cpu():
+    paddle.seed(1)
+    m = LlamaForCausalLM(tiny_llama_config(num_hidden_layers=2))
+    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        tr = Trainer(m, o, config=TrainStepConfig(
+            compute_dtype=None, offload_opt_state=True))
+    assert any("pinned_host" in str(wi.message) for wi in w)
+    assert tr.config.offload_opt_state is False
+    ids = np.random.RandomState(0).randint(0, 256, (4, 32)).astype(
+        np.int32)
+    l0 = float(tr.step({"input_ids": ids, "labels": ids}))
+    l1 = float(tr.step({"input_ids": ids, "labels": ids}))
+    assert np.isfinite([l0, l1]).all() and l1 < l0
+
+
+def test_group_sharded_offload_hint_reaches_trainer():
+    from paddle_tpu.distributed.mesh import init_mesh, set_mesh
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+    set_mesh(init_mesh({"dp": 8}))
+    paddle.seed(2)
+    m = LlamaForCausalLM(tiny_llama_config(num_hidden_layers=2))
+    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    m2, o2, _ = group_sharded_parallel(m, o, "os_g", offload=True)
+    assert m2._sharding_offload and o2._sharding_offload
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("ignore")
+        tr = Trainer(m2, o2)       # picks the hint up (then CPU-degrades)
+    assert tr.config.offload_opt_state is False   # degraded on CPU
