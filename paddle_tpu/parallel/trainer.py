@@ -324,9 +324,15 @@ class Trainer:
             batch = put
         if self._step_fn is None:
             self._step_fn = self._build_step(None)
-        lr = jnp.asarray(self._lr_value(), jnp.float32)
+        lrv = float(self._lr_value())
+        cache = getattr(self, "_lr_cache", None)
+        if cache is None or cache[0] != lrv:
+            # re-stage the lr scalar only when the schedule moves it: a
+            # fresh host->device transfer every step costs several ms
+            # through the axon dispatch tunnel
+            self._lr_cache = (lrv, jnp.asarray(lrv, jnp.float32))
         loss, self.params, self.opt_state = self._step_fn(
-            self.params, self.opt_state, lr, batch)
+            self.params, self.opt_state, self._lr_cache[1], batch)
         self.optimizer._step_count += 1
         return Tensor(loss, stop_gradient=True)
 

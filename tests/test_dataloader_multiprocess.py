@@ -64,7 +64,7 @@ def test_multiprocess_throughput_gain():
     first batch): forking the JAX-loaded parent costs ~100ms/worker on
     this 1-core box, which a real epoch amortizes but a 48-sample test
     would not."""
-    ds = TransformHeavy(48, ms=8.0)
+    ds = TransformHeavy(48, ms=15.0)
 
     def steady_rate(loader):
         it = iter(loader)
