@@ -7,6 +7,10 @@ import jax.numpy as jnp
 
 from paddle_tpu.core import dtype as dtypes
 from paddle_tpu.core.dispatch import defop
+
+# the public op `slice` (API parity) shadows the builtin at
+# module scope; internal code must use this alias
+_pyslice = __builtins__['slice'] if isinstance(__builtins__, dict) else __builtins__.slice
 from paddle_tpu.core.tensor import Tensor
 
 
@@ -271,9 +275,9 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
 
 @defop("slice_op")
 def _slice(x, axes, starts, ends):
-    idx = [slice(None)] * x.ndim
+    idx = [_pyslice(None)] * x.ndim
     for a, s, e in zip(axes, starts, ends):
-        idx[a] = slice(s, e)
+        idx[a] = _pyslice(s, e)
     return x[tuple(idx)]
 
 
@@ -283,9 +287,9 @@ def slice(x, axes, starts, ends):
 
 @defop("strided_slice_op")
 def _strided_slice(x, axes, starts, ends, strides):
-    idx = [slice(None)] * x.ndim
+    idx = [_pyslice(None)] * x.ndim
     for a, s, e, st in zip(axes, starts, ends, strides):
-        idx[a] = slice(s, e, st)
+        idx[a] = _pyslice(s, e, st)
     return x[tuple(idx)]
 
 
@@ -524,7 +528,8 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
 @defop("crop")
 def crop(x, shape=None, offsets=None):
     offsets = offsets or [0] * x.ndim
-    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    idx = tuple(_pyslice(o, o + s)
+                for o, s in zip(offsets, shape))
     return x[idx]
 
 

@@ -37,6 +37,7 @@ class Spec:
     kwargs: dict = field(default_factory=dict)
     grad: bool = True         # numeric-grad check applies
     jit: bool = True          # jit-parity check applies (False: data-dependent shapes)
+    static: tuple = ()        # positional-arg indices kept static under jit
     bf16: bool = True         # bf16 check applies
     tol: float = 1e-5         # numpy-parity tolerance
     gtol: float = 5e-3        # grad check tolerance (x64)
@@ -134,7 +135,7 @@ SPECS: dict[str, Spec] = {
     "square": unary(np.square),
     "tan": unary(np.tan),
     "tanh": unary(np.tanh),
-    "trunc": unary(np.trunc, grad=False),
+    "trunc": unary(np.trunc, grad=False, bf16=False),
     # ---- unary activations -------------------------------------------
     "relu": unary(lambda x: np.maximum(x, 0), lo=0.2, hi=1.0),
     "relu6": unary(lambda x: np.clip(x, 0, 6), lo=0.2, hi=1.0),
@@ -388,6 +389,226 @@ SPECS: dict[str, Spec] = {
                    grad=False),
 }
 
+
+# ---- round-2 extension: losses / indexing / linalg / misc -------------
+SPECS.update({
+    "mse_loss": binary(lambda a, b: np.mean((a - b) ** 2)),
+    "l1_loss": binary(lambda a, b: np.mean(np.abs(a - b)), lo2=2.0,
+                      hi2=3.0),
+    "smooth_l1_loss": binary(
+        lambda a, b: np.mean(np.where(np.abs(a - b) < 1.0,
+                                      0.5 * (a - b) ** 2,
+                                      np.abs(a - b) - 0.5)),
+        lo2=2.0, hi2=4.0),
+    "bce_with_logits": Spec(
+        lambda rng: [_f((4, 6), -2, 2)(rng),
+                     (_b((4, 6))(rng)).astype("float32")],
+        lambda x, t: np.mean(np.maximum(x, 0) - x * t
+                             + np.log1p(np.exp(-np.abs(x)))),
+        tol=1e-5),
+    "binary_cross_entropy": Spec(
+        lambda rng: [_f((4, 6), 0.1, 0.9)(rng),
+                     (_b((4, 6))(rng)).astype("float32")],
+        lambda p, t: np.mean(-(t * np.log(p) + (1 - t) * np.log(1 - p))),
+        tol=1e-5),
+    "cosine_similarity": Spec(
+        lambda rng: [_f((4, 6))(rng), _f((4, 6))(rng)],
+        lambda a, b: np.sum(a * b, 1) / (np.linalg.norm(a, axis=1)
+                                         * np.linalg.norm(b, axis=1)),
+        tol=1e-5),
+    "pairwise_distance": Spec(
+        lambda rng: [_f((4, 6))(rng), _f((4, 6))(rng), 2.0, 1e-6, False],
+        lambda a, b, p, e, k: np.linalg.norm(a - b + e, axis=1),
+        tol=1e-5, static=(2, 3, 4)),
+    "dist": binary(lambda a, b: np.linalg.norm((a - b).ravel()),
+                   tol=1e-5),
+    "cdist": Spec(lambda rng: [_f((4, 6))(rng), _f((5, 6))(rng)],
+                  lambda a, b: np.linalg.norm(
+                      a[:, None, :] - b[None, :, :], axis=-1),
+                  tol=1e-4),
+    "cov": Spec(lambda rng: [_f((3, 20))(rng)],
+                lambda x: np.cov(x), tol=1e-4),
+    "corrcoef": Spec(lambda rng: [_f((3, 20))(rng)],
+                     lambda x: np.corrcoef(x), tol=1e-4, grad=False),
+    # ---- indexing / scatter ----------------------------------------
+    "topk": Spec(lambda rng: [_f((4, 8))(rng)],
+                 lambda x: (np.sort(x, -1)[:, ::-1][:, :3],
+                            np.argsort(-x, -1, kind="stable")[:, :3]),
+                 kwargs={"k": 3}, grad=False, bf16=False),
+    "kthvalue": Spec(lambda rng: [_f((4, 8))(rng)],
+                     lambda x: (np.sort(x, -1)[:, 1],
+                                np.argsort(x, -1, kind="stable")[:, 1]),
+                     kwargs={"k": 2}, grad=False, bf16=False),
+    "masked_fill": Spec(
+        lambda rng: [_f((4, 6))(rng), _b((4, 6))(rng), 0.5],
+        lambda x, m, v: np.where(m, v, x)),
+    "index_fill": Spec(
+        lambda rng: [_f((6, 4))(rng), np.array([1, 3], "int32"), 0, 9.0],
+        lambda x, i, ax, v: _np_index_fill(x, i, v), static=(2,)),
+    "index_add": Spec(
+        lambda rng: [_f((6, 4))(rng), np.array([1, 3], "int32"), 0,
+                     _f((2, 4))(rng)],
+        lambda x, i, ax, v: _np_index_add(x, i, v), static=(2,)),
+    "index_sample": Spec(
+        lambda rng: [_f((4, 8))(rng), _i((4, 3), 0, 8)(rng)],
+        lambda x, i: np.take_along_axis(x, i, 1)),
+    "gather_nd": Spec(
+        lambda rng: [_f((4, 6))(rng),
+                     np.array([[0, 1], [3, 5]], "int32")],
+        lambda x, i: x[i[:, 0], i[:, 1]]),
+    "scatter": Spec(
+        lambda rng: [_f((6, 4))(rng), np.array([1, 3], "int32"),
+                     _f((2, 4))(rng)],
+        lambda x, i, u: _np_scatter_overwrite(x, i, u)),
+    "scatter_nd_add": Spec(
+        lambda rng: [_f((6, 4))(rng),
+                     np.array([[1], [3]], "int32"), _f((2, 4))(rng)],
+        lambda x, i, u: _np_index_add(x, i[:, 0], u)),
+    "put_along_axis": Spec(
+        lambda rng: [_f((4, 6))(rng), _i((4, 1), 0, 6)(rng).astype(
+            "int64"), _f((4, 1))(rng), 1],
+        lambda a, i, v, ax: _np_put_along(a, i, v), static=(3,)),
+    "select_scatter": Spec(
+        lambda rng: [_f((4, 6))(rng), _f((6,))(rng), 0, 2],
+        lambda x, v, ax, i: _np_select_scatter(x, v, i),
+        static=(2, 3)),
+    "diagonal_scatter": Spec(
+        lambda rng: [_f((5, 5))(rng), _f((5,))(rng)],
+        lambda x, y: _np_diagonal_scatter(x, y)),
+    "masked_scatter": Spec(
+        lambda rng: [np.zeros((2, 4), "float32"),
+                     np.array([[True, False, True, True],
+                               [False, True, False, False]]),
+                     np.arange(8, dtype="float32")],
+        lambda x, m, v: _np_masked_scatter(x, m, v), grad=False),
+    "repeat_interleave": Spec(
+        lambda rng: [_f((3, 4))(rng)],
+        lambda x: np.repeat(x, 2, axis=0), kwargs={"repeats": 2,
+                                                   "axis": 0}),
+    "take": Spec(lambda rng: [_f((4, 6))(rng),
+                              np.array([0, 5, 11], "int32")],
+                 lambda x, i: x.ravel()[i]),
+    "unbind": Spec(lambda rng: [_f((3, 4))(rng)],
+                   lambda x: tuple(x[i] for i in range(3))),
+    "diag_embed": Spec(lambda rng: [_f((3, 4))(rng)],
+                       lambda x: np.stack([np.diag(r) for r in x])),
+    "diagflat": Spec(lambda rng: [_f((6,))(rng)], np.diag),
+    "slice_op": Spec(
+        lambda rng: [_f((4, 6))(rng)],
+        lambda x: x[1:3],
+        kwargs={"axes": (0,), "starts": (1,), "ends": (3,)}),
+    "strided_slice_op": Spec(
+        lambda rng: [_f((4, 6))(rng)],
+        lambda x: x[:, 0:6:2],
+        kwargs={"axes": (1,), "starts": (0,), "ends": (6,),
+                "strides": (2,)}),
+    "crop": Spec(lambda rng: [_f((5, 6))(rng)],
+                 lambda x: x[1:4, 2:6],
+                 kwargs={"shape": (3, 4), "offsets": (1, 2)}),
+    "multiplex": Spec(
+        lambda rng: [np.array([0, 1, 0, 1], "int32"),
+                     _f((4, 3))(rng), _f((4, 3))(rng)],
+        lambda idx, a, b: np.where(idx[:, None] == 0, a, b)),
+    # ---- math long tail --------------------------------------------
+    "glu": Spec(lambda rng: [_f((4, 8))(rng)],
+                lambda x: x[:, :4] * sps.expit(x[:, 4:])),
+    "logit_op_never": None,
+    "polygamma": Spec(lambda rng: [_f((4, 6), 0.5, 3.0)(rng)],
+                      lambda x: sps.polygamma(1, x),
+                      kwargs={"n": 1}, tol=1e-3, gtol=2e-2),
+    "multigammaln": Spec(lambda rng: [_f((4, 6), 3.0, 6.0)(rng)],
+                         lambda x: sps.multigammaln(x, 2)
+                         if np.isscalar(x) else
+                         np.vectorize(lambda v: sps.multigammaln(v, 2))(x),
+                         kwargs={"p": 2}, tol=1e-4),
+    "cumulative_trapezoid": Spec(
+        lambda rng: [_f((8,))(rng)],
+        lambda y: (np.cumsum((y[1:] + y[:-1]) / 2.0)
+                   if not hasattr(np, "trapezoid")
+                   else np.cumsum((y[1:] + y[:-1]) / 2.0))),
+    "quantile": Spec(lambda rng: [_f((20,))(rng)],
+                     lambda x: np.quantile(x, 0.3),
+                     kwargs={"q": 0.3}, tol=1e-5, grad=False),
+    "nanquantile": Spec(lambda rng: [_f((20,))(rng)],
+                        lambda x: np.nanquantile(x, 0.3),
+                        kwargs={"q": 0.3}, tol=1e-5, grad=False),
+    "renorm": Spec(lambda rng: [_f((4, 6))(rng), 2.0, 0, 1.0],
+                   lambda x, p, ax, m: x * np.minimum(
+                       1.0, m / np.maximum(
+                           np.linalg.norm(x.reshape(4, -1), axis=1),
+                           1e-12))[:, None],
+                   tol=1e-4, static=(1, 2, 3)),
+    "angle": Spec(lambda rng: [_f((4, 6), -1, 1)(rng)],
+                  np.angle, grad=False),
+    "conj": unary(np.conj),
+    "real": unary(np.real),
+    "imag": unary(np.imag, grad=False),
+    "sgn": unary(np.sign, lo=0.2, grad=False),
+    "logaddexp2_never": None,
+    # ---- norms / linalg long tail ----------------------------------
+    "vector_norm": unary(lambda x: np.linalg.norm(x.ravel()), tol=1e-5),
+    "norm": unary(lambda x: np.linalg.norm(x.ravel()), tol=1e-5),
+    "matrix_norm": Spec(lambda rng: [_f((4, 6))(rng)],
+                        lambda x: np.linalg.norm(x, "fro"), tol=1e-5),
+    "triangular_solve": Spec(
+        lambda rng: [np.triu(_psd(rng)), _f((4, 2))(rng)],
+        lambda a, b: np.linalg.solve(a, b), tol=1e-3, gtol=2e-2,
+        bf16=False),
+    "cholesky_solve": Spec(
+        lambda rng: [_f((4, 2))(rng),
+                     np.linalg.cholesky(_psd(rng))],
+        lambda b, l: np.linalg.solve(l @ l.T, b), tol=1e-3,
+        grad=False, bf16=False),
+    "pinv": Spec(lambda rng: [_psd(rng)],
+                 lambda a: np.linalg.pinv(a), tol=1e-3, grad=False,
+                 bf16=False),
+    # ---- nn extras --------------------------------------------------
+    "prelu_op": Spec(
+        lambda rng: [_f((2, 3, 4, 4))(rng),
+                     np.array([0.1, 0.2, 0.3], "float32")],
+        lambda x, w: np.where(x > 0, x, w[None, :, None, None] * x)),
+    "pixel_shuffle": Spec(
+        lambda rng: [_f((1, 4, 2, 2))(rng)],
+        lambda x: x.reshape(1, 1, 2, 2, 2, 2).transpose(
+            0, 1, 4, 2, 5, 3).reshape(1, 1, 4, 4),
+        kwargs={"upscale_factor": 2}),
+    "channel_shuffle": Spec(
+        lambda rng: [_f((1, 4, 2, 2))(rng)],
+        lambda x: x.reshape(1, 2, 2, 2, 2).transpose(
+            0, 2, 1, 3, 4).reshape(1, 4, 2, 2),
+        kwargs={"groups": 2}),
+})
+del SPECS["logit_op_never"], SPECS["logaddexp2_never"]
+
+
+def _np_index_fill(x, i, v):
+    o = x.copy(); o[i] = v; return o
+
+
+def _np_index_add(x, i, v):
+    o = x.copy(); np.add.at(o, i, v); return o
+
+
+def _np_scatter_overwrite(x, i, u):
+    o = x.copy(); o[i] = u; return o
+
+
+def _np_put_along(a, i, v):
+    o = a.copy(); np.put_along_axis(o, i, v, 1); return o
+
+
+def _np_select_scatter(x, v, i):
+    o = x.copy(); o[i] = v; return o
+
+
+def _np_diagonal_scatter(x, y):
+    o = x.copy(); np.fill_diagonal(o, y); return o
+
+
+def _np_masked_scatter(x, m, v):
+    o = x.copy(); o[m] = v[: m.sum()]; return o
+
+
 # spmd-note ops get a sharded-parity spec (inputs with a leading dim the
 # mesh divides); run under the conftest's 8 virtual CPU devices
 SHARDED_SPECS: dict[str, Spec] = {
@@ -483,7 +704,16 @@ def test_jit_parity(name):
     op = OP_REGISTRY[name]
     args = _jaxify(spec.make(_rng_for(name)))
     eager = op.fn(*args, **spec.kwargs)
-    jitted = jax.jit(functools.partial(op.fn, **spec.kwargs))(*args)
+    sidx = set(spec.static)
+    dyn = [a for i, a in enumerate(args) if i not in sidx]
+
+    def call(*dynargs):
+        it = iter(dynargs)
+        full = [args[i] if i in sidx else next(it)
+                for i in range(len(args))]
+        return op.fn(*full, **spec.kwargs)
+
+    jitted = jax.jit(call)(*dyn)
     _compare(eager, jitted, 1e-6)
 
 
