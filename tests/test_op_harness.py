@@ -125,7 +125,7 @@ SPECS: dict[str, Spec] = {
     "neg": unary(np.negative),
     "rad2deg": unary(np.rad2deg),
     "reciprocal": unary(np.reciprocal, lo=0.5, hi=2.0),
-    "round": unary(np.round, grad=False),
+    "round": unary(np.round, grad=False, bf16=False),
     "rsqrt": unary(lambda x: 1 / np.sqrt(x), lo=0.5, hi=2.0),
     "sigmoid": unary(sps.expit),
     "sign": unary(np.sign, lo=0.2, hi=1.0, grad=False),
@@ -607,6 +607,113 @@ def _np_diagonal_scatter(x, y):
 
 def _np_masked_scatter(x, m, v):
     o = x.copy(); o[m] = v[: m.sum()]; return o
+
+
+
+# ---- nn compute ops (conv / pool / norm / interpolate) -----------------
+SPECS.update({
+    "conv1d": Spec(
+        lambda rng: [_f((2, 3, 10))(rng), _f((4, 3, 3))(rng)],
+        lambda x, w: _np_conv1d(x, w), tol=1e-4),
+    "avg_pool2d": Spec(
+        lambda rng: [_f((1, 2, 4, 4))(rng), (2, 2), (2, 2),
+                     ((0, 0), (0, 0))],
+        lambda x, k, st, p: x.reshape(1, 2, 2, 2, 2, 2).mean((3, 5)),
+        static=(1, 2, 3), tol=1e-5),
+    "max_pool2d": Spec(
+        lambda rng: [_f((1, 2, 4, 4))(rng), (2, 2), (2, 2),
+                     ((0, 0), (0, 0))],
+        lambda x, k, st, p: x.reshape(1, 2, 2, 2, 2, 2).max((3, 5)),
+        static=(1, 2, 3), grad=False, tol=1e-5),
+    "avg_pool1d": Spec(
+        lambda rng: [_f((1, 2, 8))(rng), (2,), (2,), ((0, 0),)],
+        lambda x, k, st, p: x.reshape(1, 2, 4, 2).mean(-1),
+        static=(1, 2, 3), tol=1e-5),
+    "max_pool1d": Spec(
+        lambda rng: [_f((1, 2, 8))(rng), (2,), (2,), ((0, 0),)],
+        lambda x, k, st, p: x.reshape(1, 2, 4, 2).max(-1),
+        static=(1, 2, 3), grad=False, tol=1e-5),
+    "adaptive_avg_pool2d": Spec(
+        lambda rng: [_f((1, 2, 4, 4))(rng)],
+        lambda x: x.reshape(1, 2, 2, 2, 2, 2).mean((3, 5)),
+        kwargs={"output_size": (2, 2)}, tol=1e-5),
+    "interpolate_op": Spec(
+        lambda rng: [_f((1, 2, 2, 2))(rng)],
+        lambda x: np.repeat(np.repeat(x, 2, 2), 2, 3),
+        kwargs={"size": (4, 4), "mode": "nearest"}),
+    "layer_norm": Spec(
+        lambda rng: [_f((4, 8))(rng), _f((8,), 0.5, 1.5)(rng),
+                     _f((8,))(rng)],
+        lambda x, w, b: ((x - x.mean(-1, keepdims=True))
+                         / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+                         * w + b),
+        tol=1e-4, gtol=5e-2),
+    "group_norm_op": Spec(
+        lambda rng: [_f((2, 4, 3, 3))(rng)],
+        lambda x: _np_group_norm(x, 2),
+        kwargs={"num_groups": 2}, tol=1e-4, gtol=5e-2),
+    "instance_norm_op": Spec(
+        lambda rng: [_f((2, 3, 4, 4))(rng)],
+        lambda x: ((x - x.mean((2, 3), keepdims=True))
+                   / np.sqrt(x.var((2, 3), keepdims=True) + 1e-5)),
+        tol=1e-4, gtol=5e-2),
+    "batch_norm_infer": Spec(
+        lambda rng: [_f((4, 3, 2, 2))(rng), _f((3,))(rng),
+                     _f((3,), 0.5, 1.5)(rng), _f((3,), 0.5, 1.5)(rng),
+                     _f((3,))(rng)],
+        lambda x, m, v, w, b: ((x - m[:, None, None])
+                               / np.sqrt(v[:, None, None] + 1e-5)
+                               * w[:, None, None] + b[:, None, None]),
+        tol=1e-4, gtol=5e-2),
+    "embedding_op": Spec(
+        lambda rng: [_i((4, 3), 0, 10)(rng), _f((10, 6))(rng)],
+        lambda i, w: w[i]),
+    "linear": Spec(
+        lambda rng: [_f((4, 6))(rng), _f((6, 3))(rng), _f((3,))(rng)],
+        lambda x, w, b: x @ w + b, tol=1e-5),
+    "label_smooth_op": Spec(
+        lambda rng: [(_b((4, 5))(rng)).astype("float32")],
+        lambda y: y * 0.9 + 0.1 / 5, kwargs={"epsilon": 0.1}),
+    "nll_loss_op": Spec(
+        lambda rng: [_f((6, 5), -2, 0)(rng),
+                     _i((6,), 0, 5)(rng).astype("int64")],
+        lambda lp, t: -np.mean(lp[np.arange(6), t])),
+    "kl_div_op": Spec(
+        lambda rng: [_f((4, 5), -3, -0.5)(rng),
+                     _f((4, 5), 0.05, 0.5)(rng)],
+        lambda lp, t: np.mean(t * (np.log(t) - lp)), tol=1e-5),
+    "unfold_op": Spec(
+        lambda rng: [_f((1, 2, 4, 4))(rng), (2, 2), (2, 2),
+                     (0, 0), (1, 1)],
+        lambda x, k, st, p, d: _np_unfold_2x2(x),
+        static=(1, 2, 3, 4), tol=1e-5),
+})
+
+
+def _np_conv1d(x, w):
+    b, ci, L = x.shape
+    co, _, kw = w.shape
+    out = np.zeros((b, co, L - kw + 1), "float32")
+    for i in range(L - kw + 1):
+        out[:, :, i] = np.einsum("bck,ock->bo", x[:, :, i:i + kw], w)
+    return out
+
+
+def _np_group_norm(x, g):
+    n, c, h, w = x.shape
+    xr = x.reshape(n, g, c // g, h, w)
+    m = xr.mean((2, 3, 4), keepdims=True)
+    v = xr.var((2, 3, 4), keepdims=True)
+    return ((xr - m) / np.sqrt(v + 1e-5)).reshape(n, c, h, w)
+
+
+def _np_unfold_2x2(x):
+    n, c, h, w = x.shape
+    cols = []
+    for i in range(0, h - 1, 2):
+        for j in range(0, w - 1, 2):
+            cols.append(x[:, :, i:i + 2, j:j + 2].reshape(n, -1))
+    return np.stack(cols, -1)
 
 
 # spmd-note ops get a sharded-parity spec (inputs with a leading dim the
