@@ -657,8 +657,11 @@ class CommWatchdog {
     std::lock_guard<std::mutex> g(mu_);
     poll_ms_ = poll_ms;
     if (running_) {
-      // wake the poller so a NEW (possibly much shorter) poll interval
-      // takes effect now, not after the previous interval elapses
+      // bump the interval epoch AND notify: the predicate form of
+      // wait_for otherwise re-sleeps to its ORIGINAL deadline on a
+      // spurious-looking wake, so a shorter interval would only apply
+      // after the previous (possibly much longer) cycle ends
+      epoch_++;
       cv_.notify_all();
       return;
     }
@@ -707,9 +710,11 @@ class CommWatchdog {
 
   void Loop() {
     std::unique_lock<std::mutex> lk(mu_);
+    uint64_t seen = epoch_;
     while (running_) {
       cv_.wait_for(lk, std::chrono::milliseconds(poll_ms_),
-                   [this] { return !running_; });
+                   [&] { return !running_ || epoch_ != seen; });
+      seen = epoch_;
       if (!running_) break;
       int64_t now = now_ns();
       for (auto& kv : ops_) {
@@ -733,6 +738,7 @@ class CommWatchdog {
   bool running_ = false;
   int64_t poll_ms_ = 1000;
   uint64_t next_id_ = 1;
+  uint64_t epoch_ = 0;
   std::unordered_map<uint64_t, Op> ops_;
   int64_t expired_count_ = 0;
   std::string last_expired_;

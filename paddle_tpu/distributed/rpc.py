@@ -89,8 +89,15 @@ class _RpcServer:
                     payload = pickle.dumps((True, result))
                 except Exception as e:          # noqa: BLE001
                     import traceback
-                    payload = pickle.dumps(
-                        (False, (e, traceback.format_exc())))
+                    tb = traceback.format_exc()
+                    try:
+                        payload = pickle.dumps((False, (e, tb)))
+                    except Exception:
+                        # unpicklable exception: degrade to a string
+                        # representation so the caller still gets a
+                        # reply instead of hanging on a dead connection
+                        payload = pickle.dumps(
+                            (False, (RuntimeError(repr(e)), tb)))
                 _send_frame(conn, payload)
         except (ConnectionError, OSError):
             pass
@@ -177,12 +184,13 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=-1):
 
 
 def shutdown():
-    if _state["store"] is not None and _state["world_size"] > 1:
-        try:
-            _state["store"].barrier("rpc_shutdown", _state["rank"],
-                                    _state["world_size"], timeout=60)
-        except Exception:
-            pass
+    if _state["store"] is not None:
+        if _state["world_size"] > 1:    # barrier only with peers
+            try:
+                _state["store"].barrier("rpc_shutdown", _state["rank"],
+                                        _state["world_size"], timeout=60)
+            except Exception:
+                pass
         _state["store"].close()
         _state["store"] = None
     if _state["server"] is not None:

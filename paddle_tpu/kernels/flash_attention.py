@@ -269,6 +269,18 @@ _KV_VMEM_BYTES = int(_os.environ.get("PADDLE_TPU_FLASH_KV_VMEM",
                                      3 * 1024 * 1024))
 
 
+
+
+def _stream_block_k(sk, d, itemsize):
+    """Streamed-path k-block width: as wide as _BLOCK_K_STREAM allows
+    WITHOUT the per-cell resident k+v block pair exceeding the same
+    VMEM budget that triggered streaming (a flat 2048 at large d or f32
+    would recreate the whole-kv overflow the budget exists to avoid)."""
+    budget_elems = _KV_VMEM_BYTES // (2 * d * itemsize)
+    capped = max(512, (budget_elems // 512) * 512)
+    return min(_BLOCK_K_STREAM, capped, sk)
+
+
 def _auto_stream_kv(sk_p, d, itemsize):
     """True when whole-k/v per (b, h) would exceed the VMEM budget (k and
     v each sk_p*d elements). Shared by fwd and bwd so both directions
@@ -329,13 +341,17 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
 
     if stream_kv is None:
         stream_kv = _auto_stream_kv(sk_p, d, k.dtype.itemsize)
-    if stream_kv and block_k is None and _BLOCK_K_STREAM > bk:
-        bk = min(_BLOCK_K_STREAM, sk)
-        sk_p = (sk + bk - 1) // bk * bk
-        if sk_p != k.shape[2]:
-            pad = sk_p - sk
-            k = jnp.pad(k[:, :, :sk], ((0, 0), (0, 0), (0, pad), (0, 0)))
-            v = jnp.pad(v[:, :, :sk], ((0, 0), (0, 0), (0, pad), (0, 0)))
+    if stream_kv and block_k is None:
+        bk2 = _stream_block_k(sk, d, k.dtype.itemsize)
+        if bk2 > bk:
+            bk = bk2
+            sk_p = (sk + bk - 1) // bk * bk
+            if sk_p != k.shape[2]:
+                pad = sk_p - sk
+                k = jnp.pad(k[:, :, :sk],
+                            ((0, 0), (0, 0), (0, pad), (0, 0)))
+                v = jnp.pad(v[:, :, :sk],
+                            ((0, 0), (0, 0), (0, pad), (0, 0)))
     kt = jnp.swapaxes(k, 2, 3)   # (b, h, d, sk): XLA fuses the transpose
     lanes = _lanes_for(sk_p, d, k.dtype.itemsize)
 
@@ -763,13 +779,15 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
 
     if stream_kv is None:
         stream_kv = _auto_stream_kv(sk_p, d, k.dtype.itemsize)
-    if stream_kv and block_k is None and _BLOCK_K_STREAM > bk:
-        bk = min(_BLOCK_K_STREAM, sk)
-        sk_p = (sk + bk - 1) // bk * bk
-        if k.shape[2] != sk_p:     # re-pad from the valid prefix
-            pad = ((0, 0), (0, 0), (0, sk_p - sk), (0, 0))
-            k = jnp.pad(k[:, :, :sk], pad)
-            v = jnp.pad(v[:, :, :sk], pad)
+    if stream_kv and block_k is None:
+        bk2 = _stream_block_k(sk, d, k.dtype.itemsize)
+        if bk2 > bk:
+            bk = bk2
+            sk_p = (sk + bk - 1) // bk * bk
+            if k.shape[2] != sk_p:     # re-pad from the valid prefix
+                pad = ((0, 0), (0, 0), (0, sk_p - sk), (0, 0))
+                k = jnp.pad(k[:, :, :sk], pad)
+                v = jnp.pad(v[:, :, :sk], pad)
     if fused is None:
         fused = (not stream_kv
                  and sk_p * d * 2 * k.dtype.itemsize <= _FUSED_KV_BYTES)

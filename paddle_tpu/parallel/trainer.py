@@ -106,7 +106,12 @@ class Trainer:
         self.optimizer = optimizer
         self.mesh = mesh
         self.plan = plan
-        self.config = config or TrainStepConfig()
+        import dataclasses
+        # private copy: the trainer mutates offload_opt_state (model
+        # hint / backend fallback) and must not write into a config
+        # object the caller may share across trainers
+        self.config = dataclasses.replace(config) if config is not None \
+            else TrainStepConfig()
         if getattr(model, "_sharding_offload", False):
             # group_sharded_parallel(offload=True) hint
             self.config.offload_opt_state = True

@@ -289,10 +289,9 @@ class AbsMaxChannelWiseWeightObserverLayer(BaseObserver):
             axis = 1 if v.ndim == 2 else 0
         self._resolved_axis = axis
         red = tuple(i for i in range(v.ndim) if i != axis)
-        try:
-            self._scales = jnp.max(jnp.abs(v), axis=red)
-        except jax.errors.ConcretizationTypeError:
-            pass
+        if isinstance(v, jax.core.Tracer):
+            return x      # calibration is an eager-mode activity
+        self._scales = jnp.max(jnp.abs(v), axis=red)
         return x
 
     def scales(self):

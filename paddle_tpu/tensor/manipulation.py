@@ -10,7 +10,8 @@ from paddle_tpu.core.dispatch import defop
 
 # the public op `slice` (API parity) shadows the builtin at
 # module scope; internal code must use this alias
-_pyslice = __builtins__['slice'] if isinstance(__builtins__, dict) else __builtins__.slice
+import builtins as _builtins
+_pyslice = _builtins.slice
 from paddle_tpu.core.tensor import Tensor
 
 
