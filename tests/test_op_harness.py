@@ -716,6 +716,45 @@ def _np_unfold_2x2(x):
     return np.stack(cols, -1)
 
 
+
+SPECS.update({
+    # identity affine grid + bilinear sample must reproduce the input
+    "grid_sample": Spec(
+        lambda rng: [_f((1, 2, 4, 4))(rng), _identity_grid(),
+                     "bilinear", "zeros", True],
+        lambda x, g, m, pm, ac: x, static=(2, 3, 4), tol=1e-5,
+        grad=False),
+    "affine_grid": Spec(
+        lambda rng: [np.eye(2, 3, dtype="float32")[None], 4, 4, True],
+        lambda th, h, w, ac: _identity_grid(), static=(1, 2, 3),
+        tol=1e-5),
+    "fold_op": Spec(
+        lambda rng: [_f((1, 8, 4))(rng), (4, 4), (2, 2), (2, 2),
+                     (0, 0), (1, 1)],
+        lambda x, os, ks, st, p, d: _np_fold_2x2(x),
+        static=(1, 2, 3, 4, 5), tol=1e-5),
+})
+
+
+def _identity_grid():
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    return np.stack([xs, ys], -1)[None].astype("float32")
+
+
+def _np_fold_2x2(cols):
+    # inverse of the non-overlapping 2x2 unfold on a 4x4 canvas
+    n = cols.shape[0]
+    out = np.zeros((n, 2, 4, 4), "float32")
+    idx = 0
+    for i in range(0, 3, 2):
+        for j in range(0, 3, 2):
+            out[:, :, i:i + 2, j:j + 2] += cols[:, :, idx].reshape(
+                n, 2, 2, 2)
+            idx += 1
+    return out
+
+
 # spmd-note ops get a sharded-parity spec (inputs with a leading dim the
 # mesh divides); run under the conftest's 8 virtual CPU devices
 SHARDED_SPECS: dict[str, Spec] = {
