@@ -145,10 +145,20 @@ def _save_barrier(path, timeout_ms=600_000):
     with watchdog.watch(f"checkpoint.save_barrier {tag}", timeout_ms):
         try:
             from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices(tag)
-            return
-        except Exception:
-            pass  # fall through to the raw coordination client
+        except ImportError:
+            multihost_utils = None
+        if multihost_utils is not None:
+            try:
+                sync = multihost_utils.sync_global_devices
+            except AttributeError:
+                sync = None
+            if sync is not None:
+                # a REAL barrier failure must propagate — swallowing it and
+                # falling through to wait_at_barrier(tag) would leave hosts
+                # split across two different barrier mechanisms on the same
+                # tag (desync/timeout)
+                sync(tag)
+                return
         try:
             from jax._src import distributed as _dist
             client = _dist.global_state.client

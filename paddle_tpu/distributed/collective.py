@@ -176,12 +176,18 @@ def _jit_collective(mesh, body, static_arg=None):
     def run(*args):
         # every eager collective registers with the hang watchdog for its
         # whole dispatch+execution (reference: comm_task_manager.cc
-        # CommTask per NCCL op); block so completion is observable
+        # CommTask per NCCL op); completion is observed by the watchdog's
+        # background completer, not a host sync here, so consecutive
+        # eager collectives keep pipelining
         from paddle_tpu.distributed import watchdog
         name = getattr(body, "__name__", "collective")
-        with watchdog.watch(f"collective/{name} mesh={dict(mesh.shape)}"):
+        op = watchdog.begin(f"collective/{name} mesh={dict(mesh.shape)}")
+        try:
             out = jitted(*args)
-            jax.block_until_ready(out)
+        except BaseException:
+            watchdog.end(op)
+            raise
+        watchdog.complete_when_ready(op, out)
         return out
 
     return run

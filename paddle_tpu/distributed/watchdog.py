@@ -156,23 +156,81 @@ def last_expired() -> str:
     return _py.last_expired()
 
 
-@contextmanager
-def watch(desc: str, timeout_ms: int | None = None):
-    """Register `desc` with the hang detector for the duration of the
-    wrapped operation. Used around every store barrier/wait, eager
-    collective dispatch, and checkpoint save barrier."""
+def begin(desc: str, timeout_ms: int | None = None):
+    """Register `desc` with the hang detector; returns an op handle to
+    pass to end() / complete_when_ready()."""
     if not _started:
         enable()
     tmo = timeout_ms or default_timeout_ms()
     lib = _lib()
     if lib:
-        op_id = lib.pt_watchdog_register(desc.encode(), tmo)
+        return ("native", lib.pt_watchdog_register(desc.encode(), tmo), desc)
+    return ("py", _py.register(desc, tmo), desc)
+
+
+def end(op) -> None:
+    kind, op_id, _desc = op
+    if kind == "native":
+        _lib().pt_watchdog_complete(op_id)
     else:
-        op_id = _py.register(desc, tmo)
+        _py.complete(op_id)
+
+
+_completer_lock = threading.Lock()
+_completer_q: "list | None" = None
+_completer_cv = threading.Condition(_completer_lock)
+
+
+def _reset_completer_after_fork():
+    # the child inherits the queue but NOT the completer thread; a stale
+    # non-None queue would enqueue ops nothing ever drains
+    global _completer_q
+    _completer_q = None
+
+
+os.register_at_fork(after_in_child=_reset_completer_after_fork)
+
+
+def _completion_loop():
+    import sys
+    import jax
+    while True:
+        with _completer_cv:
+            while not _completer_q:
+                _completer_cv.wait()
+            op, arrays = _completer_q.pop(0)
+        try:
+            jax.block_until_ready(arrays)
+        except Exception as e:
+            # the caller no longer blocks, so this thread is the only
+            # place a failed collective surfaces — report it (the op is
+            # still "done" for hang detection)
+            print(f"[paddle_tpu watchdog] collective op '{op[2]}' FAILED "
+                  f"on device: {e!r}", file=sys.stderr)
+        end(op)
+
+
+def complete_when_ready(op, arrays) -> None:
+    """Mark `op` complete once `arrays` are device-ready, WITHOUT a host
+    sync on the calling thread — consecutive eager collectives keep their
+    async-dispatch overlap; a background thread observes completion for
+    the hang detector."""
+    global _completer_q
+    with _completer_cv:
+        if _completer_q is None:
+            _completer_q = []
+            threading.Thread(target=_completion_loop, daemon=True).start()
+        _completer_q.append((op, arrays))
+        _completer_cv.notify()
+
+
+@contextmanager
+def watch(desc: str, timeout_ms: int | None = None):
+    """Register `desc` with the hang detector for the duration of the
+    wrapped operation. Used around every store barrier/wait, eager
+    collective dispatch, and checkpoint save barrier."""
+    op = begin(desc, timeout_ms)
     try:
         yield
     finally:
-        if lib:
-            lib.pt_watchdog_complete(op_id)
-        else:
-            _py.complete(op_id)
+        end(op)

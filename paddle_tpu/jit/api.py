@@ -189,7 +189,8 @@ class StaticFunction:
         if not need_grad:
             try:
                 out_arrays = jitted(state, dyn_vals)
-            except (TypeError, jax.errors.ConcretizationTypeError,
+            except (TypeError, UnboundLocalError,
+                    jax.errors.ConcretizationTypeError,
                     jax.errors.TracerArrayConversionError) as e:
                 return self._graph_break(e, args, kwargs)
             return self._unflatten_out(key, out_arrays)
@@ -206,7 +207,8 @@ class StaticFunction:
             out_arrays, vjp_fn = jax.vjp(
                 g, {k: state[k] for k in diff_names},
                 [t._value for t in diff_in])
-        except (TypeError, jax.errors.ConcretizationTypeError,
+        except (TypeError, UnboundLocalError,
+                jax.errors.ConcretizationTypeError,
                 jax.errors.TracerArrayConversionError) as e:
             return self._graph_break(e, args, kwargs)
 

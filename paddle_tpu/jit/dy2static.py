@@ -34,6 +34,7 @@ import functools
 import inspect
 import textwrap
 import warnings
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -46,9 +47,6 @@ __all__ = ["cond", "while_loop", "scan", "convert_ifelse", "convert_while",
 
 class Dy2StaticTransformError(Exception):
     pass
-
-
-_UNDEF = object()    # placeholder for locals not yet bound
 
 
 def _unwrap(x):
@@ -507,7 +505,76 @@ def _uses_ctrl_flow(tree):
     return False
 
 
+def _check_while_carries(fdef):
+    """Reject (at transform time) any `while` whose body assigns a name
+    that is not provably bound before the loop: visit_While makes every
+    body-assigned local a lax.while_loop carry and reads it in the
+    call-site init tuple, so an unbound carry is an UnboundLocalError at
+    runtime with no eager fallback. Raising here instead routes the
+    function through the existing Dy2StaticTransformError fallback
+    (trace the original body)."""
+    a = fdef.args
+    bound = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        bound.add(a.vararg.arg)
+    if a.kwarg:
+        bound.add(a.kwarg.arg)
+    _check_block(fdef.body, bound)
+
+
+def _check_block(stmts, bound):
+    for s in stmts:
+        if isinstance(s, ast.While):
+            carries = _assigned(s.body)
+            missing = sorted(carries - bound)
+            if missing:
+                raise Dy2StaticTransformError(
+                    f"line {s.lineno}: `while` body assigns "
+                    f"{', '.join(missing)} which is not bound before the "
+                    "loop; lax.while_loop carries need an initial value — "
+                    "initialize it before the loop")
+            _check_block(s.body, set(bound) | carries)
+            bound |= carries          # call-site assign rebinds all carries
+        elif isinstance(s, ast.If):
+            bt, bf = set(bound), set(bound)
+            _check_block(s.body, bt)
+            _check_block(s.orelse, bf)
+            # the if-transform's call-site assign binds every name either
+            # branch stores (visit_If `names`)
+            bound |= _assigned(s.body) | _assigned(s.orelse)
+        elif isinstance(s, ast.For):
+            for n in ast.walk(s.target):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                    bound.add(n.id)
+            # lenient: python `for` bodies usually run ≥1 time in traced
+            # code; treat their assignments as binding
+            _check_block(s.body, bound)
+            bound |= _assigned(s.body)
+        elif isinstance(s, ast.With):
+            for item in s.items:
+                if item.optional_vars is not None:
+                    for n in ast.walk(item.optional_vars):
+                        if isinstance(n, ast.Name) and isinstance(
+                                n.ctx, ast.Store):
+                            bound.add(n.id)
+            _check_block(s.body, bound)
+        elif isinstance(s, ast.Try):
+            _check_block(s.body, bound)
+            for h in s.handlers:
+                _check_block(h.body, set(bound))
+            _check_block(s.finalbody, bound)
+        else:
+            # assign/augassign/annassign/import/def/walrus-in-expr — the
+            # same binder the while-transform uses to compute carries
+            bound |= _assigned([s])
+
+
+# fn.__code__ -> None (nothing to transform) | (compiled module, fdef name).
+# Only the SOURCE transform is memoized by code object — closure values are
+# bound per function instance below, so two closures created from the same
+# factory do not share captured values.
 _transform_memo: dict = {}
+_instance_memo: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
 def ast_transform(fn):
@@ -515,32 +582,25 @@ def ast_transform(fn):
     convert_* dispatchers. Returns the transformed function, or None if
     `fn` has no if/while (nothing to do). Raises
     Dy2StaticTransformError for unsupported shapes."""
-    key = getattr(fn, "__code__", None)
-    if key in _transform_memo:
-        return _transform_memo[key]
     try:
-        src = textwrap.dedent(inspect.getsource(fn))
-    except (OSError, TypeError):
-        _transform_memo[key] = None
+        cached = _instance_memo.get(fn)
+    except TypeError:
+        cached = None
+    if cached is not None:
+        return cached
+    key = getattr(fn, "__code__", None)
+    if key not in _transform_memo:
+        _transform_memo[key] = _compile_transform(fn, key)
+    entry = _transform_memo[key]
+    if entry is None:
         return None
-    tree = ast.parse(src)
-    fdef = tree.body[0]
-    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
-        _transform_memo[key] = None
-        return None
-    if not _uses_ctrl_flow(fdef):
-        _transform_memo[key] = None
-        return None
-    fdef.decorator_list = []          # drop @to_static etc.
-    tree = _TailReturnNormalizer().visit(tree)
-    new_tree = _CtrlFlowTransformer().visit(tree)
-    ast.fix_missing_locations(new_tree)
-    code = compile(new_tree, f"<dy2static:{fn.__qualname__}>", "exec")
+    code, fname = entry
 
     glb = dict(fn.__globals__)
     glb["_pt_convert_ifelse"] = convert_ifelse
     glb["_pt_convert_while"] = convert_while
-    # closures: snapshot freevars as globals (cells are read-only here)
+    # closures: snapshot THIS instance's freevars (cells are read-only
+    # here); never shared across instances of the same code object
     if fn.__closure__:
         for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
             try:
@@ -549,7 +609,33 @@ def ast_transform(fn):
                 pass
     loc: dict = {}
     exec(code, glb, loc)
-    new_fn = loc[fdef.name]
-    new_fn = functools.wraps(fn)(new_fn)
-    _transform_memo[key] = new_fn
+    new_fn = functools.wraps(fn)(loc[fname])
+    # wraps() sets new_fn.__wrapped__ = fn: a strong value→key reference
+    # would make every WeakKeyDictionary entry immortal (and pin the
+    # globals snapshot). Drop it so instances are evicted with their fn.
+    del new_fn.__wrapped__
+    try:
+        _instance_memo[fn] = new_fn
+    except TypeError:
+        pass
     return new_fn
+
+
+def _compile_transform(fn, key):
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return None
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    if not _uses_ctrl_flow(fdef):
+        return None
+    _check_while_carries(fdef)
+    fdef.decorator_list = []          # drop @to_static etc.
+    tree = _TailReturnNormalizer().visit(tree)
+    new_tree = _CtrlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    return (compile(new_tree, f"<dy2static:{fn.__qualname__}>", "exec"),
+            fdef.name)

@@ -196,3 +196,72 @@ def test_transform_error_break_in_while():
 
     with pytest.raises(Dy2StaticTransformError, match="break"):
         ast_transform(f)
+
+
+def test_closure_values_not_shared_across_instances():
+    """Two to_static functions built from the same factory code must keep
+    their OWN captured closure values (advisor r2 high: the transform memo
+    baked the first instance's cells into shared globals)."""
+
+    def make(k):
+        def f(x):
+            if T.sum(x) > 0.0:
+                y = x * k
+            else:
+                y = x - k
+            return y
+        return paddle.jit.to_static(f)
+
+    f2, f3 = make(2.0), make(3.0)
+    x = paddle.to_tensor(np.ones((3,), "float32"))
+    np.testing.assert_allclose(f2(x).numpy(), np.full((3,), 2.0))
+    np.testing.assert_allclose(f3(x).numpy(), np.full((3,), 3.0))
+    xn = paddle.to_tensor(np.full((3,), -1.0, "float32"))
+    np.testing.assert_allclose(f3(xn).numpy(), np.full((3,), -4.0))
+
+
+def test_while_body_temp_local_falls_back():
+    """A while body that first-binds a temp local cannot be a
+    lax.while_loop carry (no initial value); the transform must reject it
+    at transform time so the python-bool loop still runs via the
+    untransformed fallback (advisor r2 medium: this used to be an
+    UnboundLocalError with no eager escape)."""
+
+    def f(x):
+        n = 0
+        while n < 3:
+            y = x * 2.0       # temp first bound INSIDE the body
+            x = x + y
+            n = n + 1
+        return x
+
+    from paddle_tpu.jit.dy2static import (ast_transform,
+                                          Dy2StaticTransformError)
+    with pytest.raises(Dy2StaticTransformError, match="initialize"):
+        ast_transform(f)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        sf = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.ones((2,), "float32"))
+        np.testing.assert_allclose(sf(x).numpy(), np.full((2,), 27.0))
+
+
+def test_while_carry_bound_by_if_before_loop():
+    """Names bound by BOTH if-branches (or by the if-transform's call-site
+    assign) before the loop are valid carries."""
+
+    def f(x):
+        if T.sum(x) > 0.0:
+            acc = x * 1.0
+        else:
+            acc = x * -1.0
+        n = paddle.to_tensor(np.array(0.0, "float32"))
+        while T.sum(n) < 2.0:
+            acc = acc + 1.0
+            n = n + 1.0
+        return acc
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.full((2,), -3.0, "float32"))
+    np.testing.assert_allclose(sf(x).numpy(), f(x).numpy())
