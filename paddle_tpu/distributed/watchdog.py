@@ -181,14 +181,21 @@ _completer_q: "list | None" = None
 _completer_cv = threading.Condition(_completer_lock)
 
 
-def _reset_completer_after_fork():
-    # the child inherits the queue but NOT the completer thread; a stale
-    # non-None queue would enqueue ops nothing ever drains
-    global _completer_q
+def _reset_after_fork():
+    """Forked children inherit watchdog STATE but none of its THREADS
+    (poller, completer) — and the native singleton's mutex may have been
+    held mid-poll at fork time, making it unsafe to touch at all. Start
+    the child from scratch on the pure-python fallback: fresh registry
+    (pre-fork ops can never complete in the child), no queue, and
+    _started=False so the child's first begin() starts a live poller."""
+    global _completer_q, _started, _py, _native_lib
     _completer_q = None
+    _started = False
+    _py = _PyWatchdog()
+    _native_lib = False       # do not reuse the possibly-poisoned native
 
 
-os.register_at_fork(after_in_child=_reset_completer_after_fork)
+os.register_at_fork(after_in_child=_reset_after_fork)
 
 
 def _completion_loop():

@@ -111,12 +111,110 @@ class PipelinePlan(ShardingPlan):
 class PipelineConfig(TrainStepConfig):
     num_microbatches: int = 4
     schedule: str = "1f1b"            # "1f1b" | "gpipe"
+    interleave: int = 1               # virtual stages per device (VPP)
 
     def __post_init__(self):
         if self.schedule not in ("1f1b", "gpipe"):
             raise ValueError(
                 f"unknown pipeline schedule {self.schedule!r}: "
                 "expected '1f1b' or 'gpipe'")
+        if self.interleave < 1:
+            raise ValueError("interleave must be >= 1")
+        if self.interleave > 1 and self.schedule != "1f1b":
+            raise ValueError(
+                "interleave (virtual pipeline) requires schedule='1f1b'")
+
+
+def build_interleaved_schedule(S: int, v: int, M: int):
+    """Lockstep tick tables for the interleaved-1F1B (VPP) schedule.
+
+    Layer chunks: the L layers split into S*v chunks; global stage
+    q = l*S + s (device s, local chunk l), so activations make v laps of
+    the same device ring — forward roll(+1) / backward roll(-1) — with
+    the chunk index incrementing at each S-1 -> 0 wrap. Device s's unit
+    order is the Megatron chunk-level order (reference
+    pipeline_parallel.py:906 PipelineParallelWithInterleave /
+    _get_virtual_pp_rank): microbatches in groups of S, each group
+    walking chunks 0..v-1 forward and v-1..0 backward. In lockstep ticks
+    this puts device s's forward unit k at tick k+s and its backward
+    unit b at tick (vS-1)+(S-1-s)+b — which reproduces the Megatron
+    per-device warmup counts 2(S-1-s)+(v-1)S and, at v=1, is exactly the
+    plain-1F1B schedule of `_pipeline_1f1b_grads`.
+
+    Returns (tables, T, warm_end, steady_end, C): per-tick (T, S) arrays
+    f_l/f_slot/f_valid + b_l/b_slot/b_valid (chunk index, saved-input
+    slot, validity for the forward/backward unit of each device), (T,)
+    arrays inject_*/tail_*/emb_* (stage-0 fresh-microbatch injection,
+    stage-(S-1) loss-tail microbatch, stage-0 embedding-cotangent
+    capture), phase boundaries (ticks [0,warm_end) are forward-only,
+    [warm_end,steady_end) mixed, [steady_end,T) backward-only), and C
+    the saved-activation slots per device (greedy reuse; equals the
+    Megatron in-flight bound, <= (v+1)S-1)."""
+    import heapq
+
+    if M % S != 0:
+        raise ValueError(
+            f"interleaved pipeline needs num_microbatches % pp == 0 "
+            f"(got M={M}, pp={S})")
+    Sv = S * v
+    total = M * v                          # units per device
+    T = M * v + (v + 1) * S - 2
+    warm_end = v * S - 1
+    steady_end = M * v + S - 1
+    tab = {
+        "f_l": np.zeros((T, S), np.int32),
+        "f_slot": np.zeros((T, S), np.int32),
+        "f_valid": np.zeros((T, S), bool),
+        "b_l": np.zeros((T, S), np.int32),
+        "b_slot": np.zeros((T, S), np.int32),
+        "b_valid": np.zeros((T, S), bool),
+        "inject_m": np.zeros(T, np.int32),
+        "inject_valid": np.zeros(T, bool),
+        "tail_m": np.zeros(T, np.int32),
+        "tail_valid": np.zeros(T, bool),
+        "emb_m": np.zeros(T, np.int32),
+        "emb_valid": np.zeros(T, bool),
+    }
+    free = [list(range(total)) for _ in range(S)]
+    slot_of: dict = {}
+    high = 0
+    for t in range(T):
+        for s in range(S):               # forwards first (alloc slots)
+            k = t - s
+            if not (0 <= k < total):
+                continue
+            g, j = divmod(k, Sv)
+            l, mloc = divmod(j, S)
+            slot = heapq.heappop(free[s])
+            slot_of[(s, k)] = slot
+            high = max(high, slot + 1)
+            tab["f_l"][t, s] = l
+            tab["f_slot"][t, s] = slot
+            tab["f_valid"][t, s] = True
+            if s == 0 and l == 0:
+                tab["inject_m"][t] = g * S + mloc
+                tab["inject_valid"][t] = True
+            if s == S - 1 and l == v - 1:
+                tab["tail_m"][t] = g * S + mloc
+                tab["tail_valid"][t] = True
+        for s in range(S):               # then backwards (free slots)
+            b = t - (v + 1) * S + s + 2
+            if not (0 <= b < total):
+                continue
+            g, j = divmod(b, Sv)
+            jl, mloc = divmod(j, S)
+            lb = v - 1 - jl
+            k_fwd = g * Sv + lb * S + mloc
+            slot = slot_of.pop((s, k_fwd))
+            heapq.heappush(free[s], slot)
+            tab["b_l"][t, s] = lb
+            tab["b_slot"][t, s] = slot
+            tab["b_valid"][t, s] = True
+            if s == 0 and lb == 0:
+                tab["emb_m"][t] = g * S + mloc
+                tab["emb_valid"][t] = True
+    assert not slot_of, "schedule left un-backwarded units"
+    return tab, T, warm_end, steady_end, high
 
 
 class PipelineTrainer(Trainer):
@@ -189,6 +287,79 @@ class PipelineTrainer(Trainer):
         return self.model
 
     # -- shared pipeline machinery ----------------------------------------
+    def _pipeline_common(self, params_c, batch):
+        """Shared 1F1B/VPP prologue: split params, embed the whole batch,
+        carve it into microbatches, pick the tail/weight fns, and compute
+        the global loss normalizer W (sum of per-microbatch valid-token
+        counts, so ragged -100 padding weighs exactly like the
+        gpipe/global-mean path). Returns a namespace consumed by both
+        schedule implementations — fixes here apply to both."""
+        from types import SimpleNamespace
+
+        mesh = self.mesh
+        M = self.config.num_microbatches
+        other, stacked = self._split_params(params_c)
+        embed = self._embed_fn or self._default_embed
+        if self._tail_fn is not None:
+            # custom tails return a per-microbatch MEAN: weight each
+            # microbatch equally (documented mean-of-means contract)
+            tail_sum = self._tail_fn
+            weight_fn = lambda b: jnp.asarray(1.0, jnp.float32)  # noqa: E731
+        else:
+            tail_sum = self._default_tail_sum
+            weight_fn = self._default_tail_weight
+
+        emb = embed(other, batch)
+        B, S_len, D = emb.shape
+        assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+        mb = B // M
+        x_mb = emb.reshape(M, mb, S_len, D)
+        # only entries with a leading batch dim split into microbatches;
+        # anything else (scalars, (S,) position tables, ...) is passed
+        # whole to every microbatch, matching the gpipe path
+        batch_r = {k: v.reshape((M, mb) + v.shape[1:])
+                   for k, v in batch.items()
+                   if getattr(v, "ndim", 0) >= 1 and v.shape[0] == B}
+        batch_shared = {k: v for k, v in batch.items() if k not in batch_r}
+
+        def mb_batch_at(m):
+            out = {k: jax.lax.dynamic_index_in_dim(v, m, 0, keepdims=False)
+                   for k, v in batch_r.items()}
+            out.update(batch_shared)
+            return out
+
+        W = jnp.maximum(
+            sum(weight_fn(mb_batch_at(m)) for m in range(M)), 1.0)
+
+        dp = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+
+        def shard(x, spec):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec))
+
+        return SimpleNamespace(
+            other=other, stacked=stacked, embed=embed, tail_sum=tail_sum,
+            emb=emb, B=B, S_len=S_len, D=D, mb=mb, x_mb=x_mb,
+            mb_batch_at=mb_batch_at, W=W,
+            state_spec=P("pp", dp if dp else None),
+            saved_spec=P("pp", None, dp if dp else None), shard=shard)
+
+    def _pipeline_epilogue(self, ctx, batch, grads_st, grads_other,
+                           g_emb, unstage):
+        """Shared 1F1B/VPP epilogue: one fused embedding vjp over the
+        whole batch, then grads assembly ((stacked stage grads -> (L, ...)
+        via `unstage`) + non-stack params)."""
+        _, evjp = jax.vjp(lambda o: ctx.embed(o, batch), ctx.other)
+        (g_o_emb,) = evjp(
+            g_emb.reshape(ctx.B, ctx.S_len, ctx.D).astype(ctx.emb.dtype))
+        grads_other = jax.tree.map(
+            lambda a, g: a + g.astype(a.dtype), grads_other, g_o_emb)
+        grads = {STACK_PREFIX + n: unstage(v)
+                 for n, v in grads_st.items()}
+        grads.update({n: grads_other[n] for n in self.param_names
+                      if not n.startswith(STACK_PREFIX)})
+        return grads
+
     def _split_params(self, params_c):
         other = {n: v for n, v in params_c.items()
                  if not n.startswith(STACK_PREFIX)}
@@ -370,11 +541,13 @@ class PipelineTrainer(Trainer):
                 "schedule='1f1b' does not compose with grad_accum_steps; "
                 "raise num_microbatches instead (pipeline microbatching "
                 "IS gradient accumulation)")
+        grads_fn = (self._pipeline_vpp_grads if self.config.interleave > 1
+                    else self._pipeline_1f1b_grads)
 
         def step(params, opt_state, lr, batch):
             with self._precision_ctx():
                 params_c = _cast_tree(params, self.config.compute_dtype)
-                loss, grads = self._pipeline_1f1b_grads(params_c, batch)
+                loss, grads = grads_fn(params_c, batch)
                 return self._apply_update(loss, grads, params, opt_state,
                                           lr)
 
@@ -394,52 +567,15 @@ class PipelineTrainer(Trainer):
         assert L % S == 0, f"{L} layers not divisible by pp={S}"
         assert M >= 1
 
-        other, stacked = self._split_params(params_c)
-        staged = self._stage_view(stacked, S)
-        embed = self._embed_fn or self._default_embed
-        if self._tail_fn is not None:
-            # custom tails return a per-microbatch MEAN: weight each
-            # microbatch equally (documented mean-of-means contract)
-            tail_sum = self._tail_fn
-            weight_fn = lambda b: jnp.asarray(1.0, jnp.float32)  # noqa: E731
-        else:
-            tail_sum = self._default_tail_sum
-            weight_fn = self._default_tail_weight
-
-        emb = embed(other, batch)
-        B, S_len, D = emb.shape
-        assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
-        mb = B // M
-        x_mb = emb.reshape(M, mb, S_len, D)
-        # only entries with a leading batch dim split into microbatches;
-        # anything else (scalars, (S,) position tables, ...) is passed
-        # whole to every microbatch, matching the gpipe path
-        batch_r = {k: v.reshape((M, mb) + v.shape[1:])
-                   for k, v in batch.items()
-                   if getattr(v, "ndim", 0) >= 1 and v.shape[0] == B}
-        batch_shared = {k: v for k, v in batch.items() if k not in batch_r}
-
-        def mb_batch_at(m):
-            out = {k: jax.lax.dynamic_index_in_dim(v, m, 0, keepdims=False)
-                   for k, v in batch_r.items()}
-            out.update(batch_shared)
-            return out
-
-        # global normalizer: sum of per-microbatch weights (valid-token
-        # counts for the default tail), so 1f1b's loss/grads equal the
-        # gpipe path's GLOBAL masked mean under ragged -100 padding
-        W = sum(weight_fn(mb_batch_at(m)) for m in range(M))
-        W = jnp.maximum(W, 1.0)
-
-        dp = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
-        state_spec = P("pp", dp if dp else None)
-        saved_spec = P("pp", None, dp if dp else None)
+        ctx = self._pipeline_common(params_c, batch)
+        other, tail_sum = ctx.other, ctx.tail_sum
+        emb, mb, S_len, D = ctx.emb, ctx.mb, ctx.S_len, ctx.D
+        x_mb, mb_batch_at, W = ctx.x_mb, ctx.mb_batch_at, ctx.W
+        state_spec, saved_spec, shard = (ctx.state_spec, ctx.saved_spec,
+                                         ctx.shard)
+        staged = self._stage_view(ctx.stacked, S)
         C = min(M, 2 * S - 1)   # 1F1B in-flight bound per stage
         sidx = jnp.arange(S)
-
-        def shard(x, spec):
-            return jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, spec))
 
         def f_phase(t, state, saved):
             inject = jax.lax.dynamic_index_in_dim(
@@ -552,14 +688,205 @@ class PipelineTrainer(Trainer):
             jnp.arange(M + S - 1, M + 2 * (S - 1)))
         grads_st, grads_other, g_emb = acc
 
-        # embedding backward (outside the scans, one fused vjp)
-        _, evjp = jax.vjp(lambda o: embed(o, batch), other)
-        (g_o_emb,) = evjp(g_emb.reshape(B, S_len, D).astype(emb.dtype))
-        grads_other = jax.tree.map(
-            lambda a, g: a + g.astype(a.dtype), grads_other, g_o_emb)
+        grads = self._pipeline_epilogue(
+            ctx, batch, grads_st, grads_other, g_emb,
+            unstage=lambda v: v.reshape((L,) + v.shape[2:]))
+        return loss, grads
 
-        grads = {STACK_PREFIX + n: v.reshape((L,) + v.shape[2:])
-                 for n, v in grads_st.items()}
-        grads.update({n: grads_other[n] for n in self.param_names
-                      if not n.startswith(STACK_PREFIX)})
+    # -- interleaved 1F1B (virtual pipeline, VPP) --------------------------
+    def _stage_view_vpp(self, stacked, S, v):
+        """(L, ...) -> (S, v, k, ...): device s holds local chunks l =
+        global stages l*S+s; stage dim sharded over 'pp', each device's v
+        chunks fully local (the per-tick chunk gather is a local
+        dynamic-slice, no cross-device traffic)."""
+        k = self._num_layers // (S * v)
+        out = {}
+        for n, val in stacked.items():
+            r = val.reshape((v, S, k) + val.shape[1:]).swapaxes(0, 1)
+            out[n] = jax.lax.with_sharding_constraint(
+                r, NamedSharding(self.mesh, P("pp")))
+        return out
+
+    def _gather_chunks(self, staged, idx):
+        """Per-stage local chunk select: staged (S, v, k, ...) + idx (S,)
+        -> (S, k, ...)."""
+        pick = jax.vmap(
+            lambda p, i: jax.lax.dynamic_index_in_dim(p, i, 0,
+                                                      keepdims=False))
+        return {n: pick(val, idx) for n, val in staged.items()}
+
+    def _pipeline_vpp_grads(self, params_c, batch):
+        """Interleaved-1F1B (virtual pipeline) compiled schedule
+        (reference: pipeline_parallel.py:906 PipelineParallelWithInterleave
+        — Megatron chunk-level warmup order). Same lockstep-ring machinery
+        as `_pipeline_1f1b_grads`, but each device holds v layer chunks and
+        the per-tick chunk/microbatch/slot choices come from the
+        `build_interleaved_schedule` tick tables (scanned over as xs).
+        Shrinks the pipeline bubble from 2(S-1) full-stage ops to
+        ~(v+1)S chunk ops — the v-fold reduction of the interleave paper —
+        at the cost of a deeper saved-activation buffer ((v+1)S-1 slots vs
+        min(M, 2S-1))."""
+        mesh = self.mesh
+        S = mesh.shape["pp"]
+        v = self.config.interleave
+        M = self.config.num_microbatches
+        L = self._num_layers
+        if L % (S * v) != 0:
+            raise ValueError(
+                f"{L} layers not divisible by pp*interleave={S * v}")
+
+        tab, T, warm_end, steady_end, C = build_interleaved_schedule(
+            S, v, M)
+
+        ctx = self._pipeline_common(params_c, batch)
+        other, tail_sum = ctx.other, ctx.tail_sum
+        emb, mb, S_len, D = ctx.emb, ctx.mb, ctx.S_len, ctx.D
+        x_mb, mb_batch_at, W = ctx.x_mb, ctx.mb_batch_at, ctx.W
+        state_spec, saved_spec, shard = (ctx.state_spec, ctx.saved_spec,
+                                         ctx.shard)
+        staged = self._stage_view_vpp(ctx.stacked, S, v)
+
+        def f_phase(row, state, saved):
+            inject = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(row["inject_m"], 0, M - 1), 0,
+                keepdims=False)
+            state = state.at[0].set(
+                jnp.where(row["inject_valid"], inject, state[0]))
+            state = shard(state, state_spec)
+
+            def save_one(saved_s, h_s, slot, ok):
+                old = jax.lax.dynamic_index_in_dim(saved_s, slot, 0,
+                                                   keepdims=False)
+                return jax.lax.dynamic_update_index_in_dim(
+                    saved_s, jnp.where(ok, h_s, old), slot, 0)
+
+            saved = jax.vmap(save_one)(saved, state, row["f_slot"],
+                                       row["f_valid"])
+            saved = shard(saved, saved_spec)
+            ch = self._gather_chunks(staged, row["f_l"])
+            y = jax.vmap(self._stage_fwd)(ch, state)
+            y = shard(y, state_spec)
+            return jnp.roll(y, 1, axis=0), saved, y
+
+        def b_phase(row, saved, g_in, acc):
+            grads_st, grads_other, g_emb = acc
+
+            def get_one(saved_s, slot):
+                return jax.lax.dynamic_index_in_dim(saved_s, slot, 0,
+                                                    keepdims=False)
+
+            h_saved = jax.vmap(get_one)(saved, row["b_slot"])
+            ch = self._gather_chunks(staged, row["b_l"])
+
+            def one_bwd(stage_params, h_in, g):
+                _, vjp = jax.vjp(self._stage_fwd, stage_params, h_in)
+                gp, gx = vjp(g)
+                return gp, gx
+
+            gp, gx = jax.vmap(one_bwd)(ch, h_saved, g_in)
+            valid = row["b_valid"]
+
+            def scatter_acc(acc_a, g):
+                # acc_a (S, v, k, ...), g (S, k, ...): add into each
+                # stage's chunk row b_l[s], masked by validity
+                def one(a_s, g_s, li, ok):
+                    cur = jax.lax.dynamic_index_in_dim(a_s, li, 0,
+                                                       keepdims=False)
+                    upd = cur + jnp.where(ok, g_s, 0).astype(a_s.dtype)
+                    return jax.lax.dynamic_update_index_in_dim(
+                        a_s, upd, li, 0)
+
+                return jax.vmap(one)(acc_a, g, row["b_l"], valid)
+
+            grads_st = {n: scatter_acc(grads_st[n], gp[n])
+                        for n in grads_st}
+            e_idx = jnp.clip(row["emb_m"], 0, M - 1)
+            old = jax.lax.dynamic_index_in_dim(g_emb, e_idx, 0,
+                                               keepdims=False)
+            g_emb = jax.lax.dynamic_update_index_in_dim(
+                g_emb, jnp.where(row["emb_valid"],
+                                 gx[0].astype(g_emb.dtype), old), e_idx, 0)
+            g_next = shard(jnp.roll(gx, -1, axis=0), state_spec)
+            return g_next, (grads_st, grads_other, g_emb)
+
+        def tail_inject(row, y, g_state, acc, loss_acc):
+            """Loss + dL/dh for a microbatch finishing its LAST chunk at
+            stage S-1 this tick. Under lax.cond on the (replicated)
+            per-tick validity scalar so non-tail steady ticks skip the
+            lm_head/CE compute entirely (~(v-1)/v of steady ticks)."""
+            grads_st, grads_other, g_emb = acc
+
+            def true_fn(ops):
+                y_last, g_state_, grads_other_, loss_ = ops
+                mb_batch = mb_batch_at(jnp.clip(row["tail_m"], 0, M - 1))
+                loss_mb, tail_vjp = jax.vjp(
+                    lambda o, h: tail_sum(o, h, mb_batch), other, y_last)
+                g_o, g_h = tail_vjp(1.0 / W)
+                grads_other_ = jax.tree.map(
+                    lambda a, g: a + g.astype(a.dtype), grads_other_, g_o)
+                g_state_ = g_state_.at[S - 1].set(
+                    g_h.astype(g_state_.dtype))
+                return g_state_, grads_other_, loss_ + loss_mb / W
+
+            def false_fn(ops):
+                _, g_state_, grads_other_, loss_ = ops
+                return g_state_, grads_other_, loss_
+
+            g_state, grads_other, loss_acc = jax.lax.cond(
+                row["tail_valid"], true_fn, false_fn,
+                (y[S - 1], g_state, grads_other, loss_acc))
+            return g_state, (grads_st, grads_other, g_emb), loss_acc
+
+        # accumulators
+        grads_st0 = {n: shard(jnp.zeros(val.shape, jnp.float32), P("pp"))
+                     for n, val in staged.items()}
+        grads_other0 = jax.tree.map(
+            lambda val: jnp.zeros(val.shape, jnp.float32), other)
+        g_emb0 = jnp.zeros((M, mb, S_len, D), emb.dtype)
+        state0 = jnp.zeros((S, mb, S_len, D), emb.dtype)
+        saved0 = jnp.zeros((S, C, mb, S_len, D), emb.dtype)
+        g_state0 = jnp.zeros((S, mb, S_len, D), emb.dtype)
+
+        rows = {n: jnp.asarray(val) for n, val in tab.items()}
+
+        def rows_at(t0, t1):
+            return {n: val[t0:t1] for n, val in rows.items()}
+
+        def warm_body(carry, row):
+            state, saved = carry
+            state, saved, _ = f_phase(row, state, saved)
+            return (state, saved), None
+
+        (state, saved), _ = jax.lax.scan(
+            warm_body, (state0, saved0), rows_at(0, warm_end))
+
+        def steady_body(carry, row):
+            state, saved, g_state, acc, loss_acc = carry
+            state, saved, y = f_phase(row, state, saved)
+            g_state, acc, loss_acc = tail_inject(row, y, g_state, acc,
+                                                 loss_acc)
+            g_state, acc = b_phase(row, saved, g_state, acc)
+            return (state, saved, g_state, acc, loss_acc), None
+
+        acc = (grads_st0, grads_other0, g_emb0)
+        carry = (state, saved, g_state0, acc, jnp.zeros((), jnp.float32))
+        carry, _ = jax.lax.scan(steady_body, carry,
+                                rows_at(warm_end, steady_end))
+        _, saved, g_state, acc, loss = carry
+
+        def drain_body(carry, row):
+            saved, g_state, acc = carry
+            g_state, acc = b_phase(row, saved, g_state, acc)
+            return (saved, g_state, acc), None
+
+        (_, _, acc), _ = jax.lax.scan(
+            drain_body, (saved, g_state, acc), rows_at(steady_end, T))
+        grads_st, grads_other, g_emb = acc
+
+        # unstage (S, v, k, ...) -> (v, S, k, ...) -> (L, ...):
+        # layer (l*S+s)*k + ki
+        grads = self._pipeline_epilogue(
+            ctx, batch, grads_st, grads_other, g_emb,
+            unstage=lambda val: val.swapaxes(0, 1).reshape(
+                (L,) + val.shape[3:]))
         return loss, grads
