@@ -189,10 +189,15 @@ def _reset_after_fork():
     (pre-fork ops can never complete in the child), no queue, and
     _started=False so the child's first begin() starts a live poller."""
     global _completer_q, _started, _py, _native_lib
+    global _completer_lock, _completer_cv
     _completer_q = None
     _started = False
     _py = _PyWatchdog()
     _native_lib = False       # do not reuse the possibly-poisoned native
+    # the completer lock/cv may have been HELD at fork time (completer
+    # thread mid-pop); rebuild them like everything else
+    _completer_lock = threading.Lock()
+    _completer_cv = threading.Condition(_completer_lock)
 
 
 os.register_at_fork(after_in_child=_reset_after_fork)

@@ -163,3 +163,49 @@ def test_dtensor_from_fn_and_unshard():
     assert t.is_dist()
     full = dist.unshard_dtensor(t)
     np.testing.assert_array_equal(full.numpy(), np.ones((8, 4)))
+
+
+def test_northstar_config_compiles_without_involuntary_remat():
+    """The dp x fsdp x mp ring-CP north-star step must compile with ZERO
+    '[SPMD] Involuntary full rematerialization' warnings: the embedding
+    cotangent's fsdp move from batch tile to hidden tile is handled by
+    the two-step reshard in nn/functional/common.py:_vocab_take_op
+    (VERDICT r2 item 2). Runs the compile in a subprocess because the
+    warning is emitted from XLA's C++ stderr."""
+    import subprocess
+    import sys
+
+    code = """
+import numpy as np
+import paddle_tpu
+import paddle_tpu.optimizer as opt
+from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+from paddle_tpu.distributed.mesh import init_mesh
+from paddle_tpu.parallel import Trainer, TrainStepConfig, llama_sharding_plan
+
+mesh = init_mesh({"dp": 2, "fsdp": 2, "mp": 2, "sp": 1})
+cfg = tiny_llama_config(num_hidden_layers=2, recompute=True)
+model = LlamaForCausalLM(cfg)
+optimizer = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+trainer = Trainer(model, optimizer, mesh=mesh,
+                  plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
+                  config=TrainStepConfig(compute_dtype="bfloat16",
+                                         grad_accum_steps=2,
+                                         context_parallel="ring"))
+ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (16, 32))
+loss = trainer.step({"input_ids": ids.astype(np.int32),
+                     "labels": ids.astype(np.int32)})
+assert np.isfinite(float(loss))
+print("COMPILED_OK")
+"""
+    import os
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=600)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    assert "COMPILED_OK" in out
+    assert "Involuntary full rematerialization" not in out, out[-3000:]
