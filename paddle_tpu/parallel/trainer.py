@@ -336,18 +336,19 @@ class Trainer:
             # fresh host->device transfer every step costs several ms
             # through the axon dispatch tunnel
             self._lr_cache = (lrv, jnp.asarray(lrv, jnp.float32))
-        if self.mesh is not None:
-            # enter the mesh context for the (first-call) trace so
-            # sharding-aware custom vjps (e.g. the embedding grad
-            # reshard in nn/functional/common.py) can read the axis names
-            with self.mesh:
-                loss, self.params, self.opt_state = self._step_fn(
-                    self.params, self.opt_state, self._lr_cache[1], batch)
-        else:
+        # enter the mesh context for the (first-call) trace so
+        # sharding-aware custom vjps (e.g. the embedding grad reshard in
+        # nn/functional/common.py) can read the axis names
+        with self._mesh_ctx():
             loss, self.params, self.opt_state = self._step_fn(
                 self.params, self.opt_state, self._lr_cache[1], batch)
         self.optimizer._step_count += 1
         return Tensor(loss, stop_gradient=True)
+
+    def _mesh_ctx(self):
+        import contextlib
+        return self.mesh if self.mesh is not None \
+            else contextlib.nullcontext()
 
     def _lr_value(self):
         return self.optimizer._lr_value()
@@ -357,13 +358,11 @@ class Trainer:
         if self._step_fn is None:
             self._step_fn = self._build_step(None)
         lr = jnp.asarray(self._lr_value(), jnp.float32)
-        if self.mesh is not None:
-            # same mesh context as step(): AOT lowering must see the
-            # ambient mesh or sharding-aware vjps silently degrade
-            with self.mesh:
-                return self._step_fn.lower(self.params, self.opt_state,
-                                           lr, batch)
-        return self._step_fn.lower(self.params, self.opt_state, lr, batch)
+        # same mesh context as step(): AOT lowering must see the ambient
+        # mesh or sharding-aware vjps silently degrade
+        with self._mesh_ctx():
+            return self._step_fn.lower(self.params, self.opt_state, lr,
+                                       batch)
 
     def sync_to_model(self):
         """Write the trainer's param arrays back into the Layer tree (for

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import copy
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -21,7 +23,8 @@ from paddle_tpu.nn.layer.layers import Layer
 
 __all__ = ["QuantConfig", "BaseQuanter", "BaseObserver", "quanter",
            "QAT", "PTQ", "HistObserver", "KLObserver", "AbsmaxObserver",
-           "AbsMaxChannelWiseWeightObserver", "FrozenFakeQuanter"]
+           "AbsMaxChannelWiseWeightObserver", "FrozenFakeQuanter",
+           "QuantizedLinear", "layer_error_report"]
 
 
 def _op(name, fn, *tensors):
@@ -335,6 +338,168 @@ class FrozenFakeQuanter(BaseQuanter):
         return self._axis
 
 
+# -- native int8 execution (reference: phi/kernels/quantize_linear_kernel.h,
+# weight_quantize_kernel.h — real quant kernels, not simulation) ------------
+
+def _round_clip_i8(x, scale, bnd):
+    """x (float) -> int8 codes with the SAME rounding/clip grid the fake
+    quanters use (round-half-even, symmetric +-bnd)."""
+    s = jnp.maximum(scale, 1e-9)
+    return jnp.clip(jnp.round(x / s * bnd), -bnd, bnd).astype(jnp.int8)
+
+
+def _weight_only_matmul(xv, qwv, eff_scale):
+    """W8A16 matmul. On TPU with tile-able shapes this is the fused
+    Pallas kernel (dequant inside the K-loop, 1 byte/weight of HBM
+    traffic); otherwise the XLA fallback (which materializes the bf16
+    weight — correct, but no bandwidth win)."""
+    K, N = qwv.shape
+    if (jax.default_backend() == "tpu" and eff_scale.ndim == 1
+            and xv.dtype in (jnp.bfloat16, jnp.float32)):
+        from paddle_tpu.kernels.quant_matmul import (
+            pick_block_m, weight_only_int8_matmul)
+        M = 1
+        for d in xv.shape[:-1]:
+            M *= d
+        for blk in (512, 256, 128):
+            if K % blk == 0 and N % blk == 0 \
+                    and pick_block_m(M) is not None:
+                return weight_only_int8_matmul(
+                    xv, qwv, eff_scale.astype(jnp.float32),
+                    block_n=blk, block_k=blk,
+                    out_dtype=xv.dtype).astype(xv.dtype)
+    w = qwv.astype(xv.dtype) * eff_scale.astype(xv.dtype)
+    return jnp.matmul(xv, w)
+
+
+class QuantizedLinear(Layer):
+    """Linear with REAL int8 execution — the deployment path the
+    reference implements in quantize_linear_kernel.h / llm.int8-style
+    weight_only kernels, built TPU-native:
+
+    - mode='int8' (W8A8): both operands int8, ONE lax.dot_general with
+      preferred_element_type=int32 — this is the MXU's native int8 path
+      (2x the bf16 peak on v5e) — then a float dequant epilogue
+      out = acc_i32 * (s_x*s_w/bnd^2) + bias that XLA fuses.
+    - mode='weight_only_int8' (W8A16): weights stored int8 (half the HBM
+      of bf16 — decode is weight-bandwidth-bound), dequantized on the fly
+      into a bf16 matmul.
+
+    Weights are quantized ONCE at construction (per-out-channel scales
+    from the calibration observer); activations use the frozen
+    calibration scale. Inference-only: gradients do not flow (use
+    QAT/fake-quant for training)."""
+
+    def __init__(self, layer, w_scale, act_scale=None, bit_length=8,
+                 quant_axis=1, mode="int8"):
+        super().__init__()
+        if mode not in ("int8", "weight_only_int8"):
+            raise ValueError(f"unknown quantized execution mode {mode!r}")
+        if mode == "int8" and act_scale is None:
+            raise ValueError(
+                "mode='int8' needs a calibrated activation scale; "
+                "re-run PTQ with an activation observer or use "
+                "mode='weight_only_int8'")
+        self._mode = mode
+        self._bnd = float(2 ** (bit_length - 1) - 1)
+        w = layer.weight._value.astype(jnp.float32)
+        ws = jnp.asarray(
+            w_scale._value if isinstance(w_scale, Tensor) else w_scale,
+            jnp.float32)
+        if ws.ndim == 1:
+            shape = [1] * w.ndim
+            shape[quant_axis] = ws.shape[0]
+            ws_b = ws.reshape(shape)
+        else:
+            ws_b = ws
+        self.register_buffer(
+            "qweight", Tensor(_round_clip_i8(w, ws_b, self._bnd)))
+        self.register_buffer("w_scale", Tensor(ws))
+        self._quant_axis = quant_axis
+        if act_scale is not None:
+            a = jnp.asarray(
+                act_scale._value if isinstance(act_scale, Tensor)
+                else act_scale, jnp.float32)
+            self.register_buffer("act_scale", Tensor(a))
+        else:
+            self.act_scale = None
+        self.bias = layer.bias
+
+    def forward(self, x):
+        qw = self.qweight._value
+        ws = self.w_scale._value
+        bias = None if self.bias is None else self.bias._value
+        bnd = self._bnd
+        if self._mode == "weight_only_int8":
+            def f(xv, qwv, wsv, *b):
+                out = _weight_only_matmul(xv, qwv, wsv / bnd)
+                return out + b[0].astype(out.dtype) if b else out
+        else:
+            def f(xv, qwv, wsv, sav, *b):
+                xq = _round_clip_i8(xv.astype(jnp.float32), sav, bnd)
+                acc = jax.lax.dot_general(
+                    xq, qwv,
+                    (((xv.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                out = acc.astype(jnp.float32) * (sav * wsv / (bnd * bnd))
+                if b:
+                    out = out + b[0].astype(jnp.float32)
+                return out.astype(xv.dtype)
+        args = [x, Tensor(qw, stop_gradient=True),
+                Tensor(ws, stop_gradient=True)]
+        if self._mode == "int8":
+            args.append(Tensor(self.act_scale._value, stop_gradient=True))
+        if bias is not None:
+            args.append(Tensor(bias, stop_gradient=True))
+        return _op(self._mode + "_linear", f, *args)
+
+
+def layer_error_report(float_model, quant_model, *inputs):
+    """Per-layer output error between a float model and its quantized
+    counterpart (reference: the per-op error dump of
+    analysis/quantization passes). Runs both models on `inputs`, matches
+    quantized layers to their float originals by qualified name, and
+    returns {name: {'mse':, 'max_abs':, 'rel':, 'mode':}} — the per-layer
+    acceptance evidence top-1 agreement can't give."""
+    targets = (QuantizedLinear, QuantedLinear, QuantedConv2D)
+
+    def capture(model, pick):
+        outs, handles = {}, []
+        for name, sub in model.named_sublayers():
+            if pick(sub):
+                def hook(layer, inp, out, _n=name):
+                    outs[_n] = (out[0] if isinstance(out, (tuple, list))
+                                else out)
+                handles.append(sub.register_forward_post_hook(hook))
+        model(*inputs)
+        for h in handles:
+            h.remove()
+        return outs
+
+    from paddle_tpu.nn import Linear, Conv2D
+    f_outs = capture(float_model,
+                     lambda l: isinstance(l, (Linear, Conv2D)))
+    q_outs = capture(quant_model, lambda l: isinstance(l, targets))
+    report = {}
+    subs = dict(quant_model.named_sublayers())
+    for name, q in q_outs.items():
+        ref = f_outs.get(name)
+        if ref is None:
+            continue
+        r = np.asarray(ref.numpy(), np.float32)
+        v = np.asarray(q.numpy(), np.float32)
+        err = v - r
+        denom = float(np.abs(r).mean()) or 1.0
+        sub = subs[name]
+        report[name] = {
+            "mse": float((err ** 2).mean()),
+            "max_abs": float(np.abs(err).max()),
+            "rel": float(np.abs(err).mean() / denom),
+            "mode": getattr(sub, "_mode", "fake"),
+        }
+    return report
+
+
 # -- quanted layer wrappers (reference: nn/quant/ + wrapper.py) -------------
 
 class QuantedLinear(Layer):
@@ -508,8 +673,15 @@ class PTQ(_Quantization):
             return None
         return self._transform(model, make)
 
-    def convert(self, model, inplace=False):
-        """Freeze observed scales into fake-quant layers."""
+    def convert(self, model, inplace=False, execute="fake"):
+        """Freeze observed scales. execute='fake' (default) keeps the
+        simulated q/dq program; execute='int8' / 'weight_only_int8'
+        installs QuantizedLinear layers that run REAL int8 matmuls
+        (reference: quantize_linear_kernel.h). Conv2D always stays
+        fake-quant (int8 conv is not wired; the error report flags it
+        with mode='fake')."""
+        if execute not in ("fake", "int8", "weight_only_int8"):
+            raise ValueError(f"unknown execute mode {execute!r}")
         if not inplace:
             model = copy.deepcopy(model)
         def unwrap(parent):
@@ -519,16 +691,40 @@ class PTQ(_Quantization):
                 else:
                     unwrap(child)
         unwrap(model)
-        for lay in model.sublayers(include_self=True):
-            if isinstance(lay, (QuantedLinear, QuantedConv2D)):
-                for attr in ("weight_quanter", "activation_quanter"):
-                    q = getattr(lay, attr)
-                    if isinstance(q, BaseObserver):
-                        fq = FrozenFakeQuanter(q.scales(),
-                                               q.bit_length(),
-                                               q.quant_axis())
-                        fq.eval()
-                        setattr(lay, attr, fq)
+
+        def freeze(lay):
+            for attr in ("weight_quanter", "activation_quanter"):
+                q = getattr(lay, attr)
+                if isinstance(q, BaseObserver):
+                    fq = FrozenFakeQuanter(q.scales(), q.bit_length(),
+                                           q.quant_axis())
+                    fq.eval()
+                    setattr(lay, attr, fq)
+
+        def walk(parent):
+            for name, child in list(parent.named_children()):
+                if isinstance(child, QuantedLinear) and execute != "fake":
+                    wq = child.weight_quanter
+                    aq = child.activation_quanter
+                    act_scale = (aq.scales()
+                                 if isinstance(aq, (BaseObserver,
+                                                    FrozenFakeQuanter))
+                                 and execute == "int8" else None)
+                    if execute == "int8" and act_scale is None:
+                        freeze(child)   # no act range calibrated
+                        continue
+                    parent.add_sublayer(name, QuantizedLinear(
+                        child._layer, wq.scales(), act_scale,
+                        bit_length=wq.bit_length(),
+                        quant_axis=(wq.quant_axis()
+                                    if wq.quant_axis() not in (None, -1)
+                                    else 1),
+                        mode=execute))
+                elif isinstance(child, (QuantedLinear, QuantedConv2D)):
+                    freeze(child)
+                else:
+                    walk(child)
+        walk(model)
         return model
 
 

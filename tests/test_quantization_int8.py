@@ -1,0 +1,177 @@
+"""Native int8 quantized EXECUTION (reference: paddle/phi/kernels/
+quantize_linear_kernel.h, weight_quantize_kernel.h): real int8
+dot_general with int32 accumulation + dequant epilogue — not fake-quant
+simulation — plus the weight-only-int8 deployment path, per-layer error
+stats, and int8 StableHLO export through the Predictor."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import (
+    PTQ, QuantConfig, HistObserver, AbsMaxChannelWiseWeightObserver,
+    AbsmaxObserver, QuantizedLinear, layer_error_report)
+
+
+def _calibrated_linear_ptq(seed=0, in_f=16, out_f=8, act=True):
+    paddle.seed(seed)
+    rng = np.random.RandomState(seed)
+    model = nn.Sequential(nn.Linear(in_f, out_f))
+    q = PTQ(QuantConfig(
+        activation=HistObserver(percent=1.0) if act else None,
+        weight=AbsMaxChannelWiseWeightObserver()))
+    qmodel = q.quantize(model)
+    calib = [rng.randn(4, in_f).astype("float32") for _ in range(4)]
+    for c in calib:
+        qmodel(paddle.to_tensor(c))
+    return model, q, qmodel, calib
+
+
+def test_int8_matches_fake_quant_numerics():
+    """W8A8 int8 execution computes the same values as the fake-quant
+    simulation (same rounding grid, exact int32 accumulation)."""
+    model, q, qmodel, calib = _calibrated_linear_ptq()
+    fake = q.convert(qmodel, execute="fake")
+    real = q.convert(qmodel, execute="int8")
+    assert isinstance(real[0], QuantizedLinear)
+    x = paddle.to_tensor(calib[0])
+    np.testing.assert_allclose(real(x).numpy(), fake(x).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_int8_program_contains_s8_dot():
+    """The traced program must contain an s8 x s8 -> s32 dot_general —
+    the MXU-native int8 path — not a float matmul on dequantized
+    operands."""
+    import jax
+    model, q, qmodel, calib = _calibrated_linear_ptq()
+    real = q.convert(qmodel, execute="int8")
+    lay = real[0]
+
+    def f(xv):
+        return lay(paddle.Tensor(xv, stop_gradient=True))._value
+
+    txt = str(jax.jit(f).lower(calib[0]).as_text())
+    assert "i8" in txt and ("si8" in txt or "i8>" in txt), txt[-2000:]
+    assert "dot_general" in txt
+    # the dot itself consumes i8 operands
+    import re
+    dots = [l for l in txt.splitlines() if "dot_general" in l]
+    assert any("i8" in l for l in dots), dots
+
+
+def test_weight_only_int8_close_to_float():
+    model, q, qmodel, calib = _calibrated_linear_ptq(act=False)
+    wo = q.convert(qmodel, execute="weight_only_int8")
+    assert isinstance(wo[0], QuantizedLinear)
+    x = paddle.to_tensor(calib[0])
+    ref = model(x).numpy()
+    got = wo(x).numpy()
+    rel = np.abs(got - ref).mean() / np.abs(ref).mean()
+    assert rel < 0.02, rel          # weight-only: tight (no act error)
+    # int8 weights halve the parameter bytes
+    assert wo[0].qweight.numpy().dtype == np.int8
+
+
+def test_int8_requires_activation_scale():
+    # PTQ always injects a default absmax activation observer, so even an
+    # activation=None config converts to real int8
+    model, q, qmodel, calib = _calibrated_linear_ptq(act=False)
+    conv = q.convert(qmodel, execute="int8")
+    assert isinstance(conv[0], QuantizedLinear)
+    with pytest.raises(ValueError, match="activation scale"):
+        QuantizedLinear(nn.Linear(4, 4), np.ones(4, "float32"),
+                        act_scale=None, mode="int8")
+    with pytest.raises(ValueError, match="execution mode"):
+        QuantizedLinear(nn.Linear(4, 4), np.ones(4, "float32"),
+                        act_scale=1.0, mode="int4")
+
+
+@pytest.mark.quick
+def test_ptq_llama_int8_execution_and_export(tmp_path):
+    """VERDICT r2 item 3 criterion: converted PTQ Llama runs REAL int8
+    matmuls at the established >0.9 top-1 parity, with per-layer error
+    stats, exported and served through the Predictor."""
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.models.llama import tiny_llama_config
+
+    paddle.seed(0)
+    cfg = tiny_llama_config(num_hidden_layers=2)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    calib = [rng.randint(0, cfg.vocab_size, (2, 16)).astype("int32")
+             for _ in range(4)]
+    x_eval = paddle.to_tensor(calib[0])
+    float_logits = model(x_eval).numpy()
+
+    q = PTQ(QuantConfig(
+        activation=HistObserver(percent=0.9999),
+        weight=AbsMaxChannelWiseWeightObserver()))
+    qmodel = q.quantize(model)
+    for ids in calib:
+        qmodel(paddle.to_tensor(ids))
+    converted = q.convert(qmodel, execute="int8")
+
+    n_int8 = sum(isinstance(l, QuantizedLinear)
+                 for l in converted.sublayers())
+    assert n_int8 >= 8, n_int8       # q/k/v/o + mlp per layer
+
+    q_logits = converted(x_eval).numpy()
+    agree = (q_logits.argmax(-1) == float_logits.argmax(-1)).mean()
+    assert agree > 0.9, f"top-1 agreement {agree:.3f}"
+
+    # per-layer error stats (the acceptance evidence top-1 can't give)
+    report = layer_error_report(model, converted, x_eval)
+    assert len(report) >= n_int8
+    for name, st in report.items():
+        assert np.isfinite(st["mse"]) and st["rel"] < 0.5, (name, st)
+    assert any(st["mode"] == "int8" for st in report.values())
+
+    # export: the int8 dot lands in the StableHLO the Predictor serves
+    from paddle_tpu.inference import Config, create_predictor
+    path = str(tmp_path / "qllama_i8")
+    paddle.jit.save(converted, path,
+                    input_spec=[paddle.jit.InputSpec((2, 16), "int32")])
+    with open(path + ".pdmodel", "rb") as f:
+        blob = f.read()
+    pred = create_predictor(Config(path + ".pdmodel", path + ".pdiparams"))
+    inp = pred.get_input_handle(pred.get_input_names()[0])
+    inp.copy_from_cpu(calib[0])
+    pred.run()
+    served = pred.get_output_handle(pred.get_output_names()[0])
+    np.testing.assert_allclose(served.copy_to_cpu(), q_logits,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_weight_only_pallas_kernel_parity():
+    """The fused W8A16 Pallas kernel (interpret mode on CPU) matches the
+    XLA dequant-then-matmul reference."""
+    import jax.numpy as jnp
+    from paddle_tpu.kernels.quant_matmul import weight_only_int8_matmul
+
+    rng = np.random.RandomState(0)
+    M, K, N = 8, 256, 256
+    x = jnp.asarray(rng.randn(M, K), jnp.float32)
+    qw = jnp.asarray(rng.randint(-127, 128, (K, N)), jnp.int8)
+    s = jnp.asarray(rng.rand(N).astype("float32") * 0.01)
+    # the kernel computes on bf16 MXU operands with f32 accumulation and
+    # applies the (f32) scale in the epilogue — mirror that exactly
+    ref = np.asarray(
+        jnp.matmul(x.astype(jnp.bfloat16), qw.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32) * s, np.float32)
+    got = np.asarray(weight_only_int8_matmul(
+        x, qw, s, block_m=8, block_n=128, block_k=128,
+        out_dtype=jnp.float32, interpret=True), np.float32)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+    # 3D leading dims route through the same kernel
+    x3 = jnp.asarray(rng.randn(2, 4, K), jnp.float32)
+    ref3 = np.asarray(
+        jnp.einsum("bsk,kn->bsn", x3.astype(jnp.bfloat16),
+                   qw.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32) * s)
+    got3 = np.asarray(weight_only_int8_matmul(
+        x3, qw, s, block_m=8, block_n=128, block_k=128,
+        out_dtype=jnp.float32, interpret=True))
+    np.testing.assert_allclose(got3, ref3, rtol=2e-4, atol=2e-4)
