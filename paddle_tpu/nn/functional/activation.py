@@ -204,11 +204,12 @@ def _gumbel_softmax(x, key, temperature=1.0, hard=False, axis=-1):
     if hard:
         idx = jnp.argmax(y, axis=axis, keepdims=True)
         y_hard = jnp.zeros_like(y)
-        dims = [jnp.broadcast_to(
+        # the axis dim's coordinate IS idx; building an arange broadcast
+        # for it too would try to broadcast (1, C) onto idx's (..., 1)
+        dims = [idx if d == axis % y.ndim else jnp.broadcast_to(
             jnp.arange(y.shape[d]).reshape(
                 [-1 if i == d else 1 for i in range(y.ndim)]), idx.shape)
             for d in range(y.ndim)]
-        dims[axis % y.ndim] = idx
         y_hard = y_hard.at[tuple(dims)].set(1.0)
         # straight-through estimator
         y = jax.lax.stop_gradient(y_hard - y) + y

@@ -407,6 +407,13 @@ class QuantizedLinear(Layer):
             w_scale._value if isinstance(w_scale, Tensor) else w_scale,
             jnp.float32)
         if ws.ndim == 1:
+            if quant_axis not in (1, w.ndim - 1):
+                # the dequant epilogue multiplies AFTER the contraction
+                # over the in dim, so per-channel scales must live on the
+                # out dim; per-in-channel scales cannot be factored out
+                raise ValueError(
+                    "int8 execution needs per-OUT-channel (quant_axis=1) "
+                    f"or per-tensor scales, got quant_axis={quant_axis}")
             shape = [1] * w.ndim
             shape[quant_axis] = ws.shape[0]
             ws_b = ws.reshape(shape)
@@ -701,29 +708,43 @@ class PTQ(_Quantization):
                     fq.eval()
                     setattr(lay, attr, fq)
 
+        def convert_one(child):
+            """Replacement layer for `child`, or None (child frozen or
+            handled in place)."""
+            if isinstance(child, QuantedLinear) and execute != "fake":
+                wq = child.weight_quanter
+                aq = child.activation_quanter
+                act_scale = (aq.scales()
+                             if isinstance(aq, (BaseObserver,
+                                                FrozenFakeQuanter))
+                             and execute == "int8" else None)
+                if execute == "int8" and act_scale is None:
+                    freeze(child)   # no act range calibrated
+                    return None
+                return QuantizedLinear(
+                    child._layer, wq.scales(), act_scale,
+                    bit_length=wq.bit_length(),
+                    quant_axis=(wq.quant_axis()
+                                if wq.quant_axis() not in (None, -1)
+                                else 1),
+                    mode=execute)
+            if isinstance(child, (QuantedLinear, QuantedConv2D)):
+                freeze(child)
+            return None
+
         def walk(parent):
             for name, child in list(parent.named_children()):
-                if isinstance(child, QuantedLinear) and execute != "fake":
-                    wq = child.weight_quanter
-                    aq = child.activation_quanter
-                    act_scale = (aq.scales()
-                                 if isinstance(aq, (BaseObserver,
-                                                    FrozenFakeQuanter))
-                                 and execute == "int8" else None)
-                    if execute == "int8" and act_scale is None:
-                        freeze(child)   # no act range calibrated
-                        continue
-                    parent.add_sublayer(name, QuantizedLinear(
-                        child._layer, wq.scales(), act_scale,
-                        bit_length=wq.bit_length(),
-                        quant_axis=(wq.quant_axis()
-                                    if wq.quant_axis() not in (None, -1)
-                                    else 1),
-                        mode=execute))
-                elif isinstance(child, (QuantedLinear, QuantedConv2D)):
-                    freeze(child)
+                if isinstance(child, (QuantedLinear, QuantedConv2D)):
+                    repl = convert_one(child)
+                    if repl is not None:
+                        parent.add_sublayer(name, repl)
                 else:
                     walk(child)
+
+        if isinstance(model, (QuantedLinear, QuantedConv2D)):
+            # a bare quanted layer passed directly (the old
+            # include_self=True path): convert/freeze the root itself
+            return convert_one(model) or model
         walk(model)
         return model
 

@@ -41,6 +41,9 @@ class Spec:
     bf16: bool = True         # bf16 check applies
     tol: float = 1e-5         # numpy-parity tolerance
     gtol: float = 5e-3        # grad check tolerance (x64)
+    post: Callable | None = None  # canonicalize op+ref outputs before
+    #                               compare (sign-ambiguous decompositions,
+    #                               complex outputs, structure mismatches)
 
 
 def _f(shape, lo=-1.0, hi=1.0):
@@ -829,6 +832,855 @@ def _rng_for(name):
     return np.random.RandomState(abs(hash(name)) % (2 ** 31))
 
 
+# ---------------------------------------------------------------------------
+# Round-3 full-registry coverage (VERDICT r2 item 4): every registered op
+# below gets a Spec; the residue gets an explicit WAIVER naming the
+# dedicated test that covers it. test_registry_fully_covered() fails when
+# a new defop lands with neither.
+# ---------------------------------------------------------------------------
+
+def _c2ri(t):
+    """complex leaves -> stacked (real, imag) so _compare's float64 cast
+    survives."""
+    return jax.tree.map(
+        lambda a: np.stack([np.real(np.asarray(a)),
+                            np.imag(np.asarray(a))])
+        if np.asarray(a).dtype.kind == "c" else np.asarray(a), t)
+
+
+def _np_sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_softplus(x):
+    return np.logaddexp(0.0, x)
+
+
+def _np_logsoftmax(x, axis=-1):
+    m = x - x.max(axis=axis, keepdims=True)
+    return m - np.log(np.exp(m).sum(axis=axis, keepdims=True))
+
+
+def _reduce_np(loss, reduction):
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def _lstm_np(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    g = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    i, f, gg, o = np.split(g, 4, axis=-1)
+    i, f, o = _np_sigmoid(i), _np_sigmoid(f), _np_sigmoid(o)
+    c2 = f * c + i * np.tanh(gg)
+    return o * np.tanh(c2), c2
+
+
+def _gru_np(x, h, w_ih, w_hh, b_ih, b_hh):
+    gi = x @ w_ih.T + b_ih
+    gh = h @ w_hh.T + b_hh
+    ir, iz, in_ = np.split(gi, 3, axis=-1)
+    hr, hz, hn = np.split(gh, 3, axis=-1)
+    r, z = _np_sigmoid(ir + hr), _np_sigmoid(iz + hz)
+    n = np.tanh(in_ + r * hn)
+    return (1 - z) * n + z * h
+
+
+def _rnn_weights(rng, gate_mult, in_f=3, hid=4, b=2):
+    return [rng.randn(b, in_f).astype("float32"),
+            rng.randn(b, hid).astype("float32"),
+            (rng.randn(gate_mult * hid, in_f) * 0.5).astype("float32"),
+            (rng.randn(gate_mult * hid, hid) * 0.5).astype("float32"),
+            (rng.randn(gate_mult * hid) * 0.1).astype("float32"),
+            (rng.randn(gate_mult * hid) * 0.1).astype("float32")]
+
+
+def _lstm_args(rng):
+    x, h, wi, wh, bi, bh = _rnn_weights(rng, 4)
+    c = rng.randn(*h.shape).astype("float32")
+    return [x, h, c, wi, wh, bi, bh]
+
+
+def _rnn_scan_args(rng):
+    x, h, wi, wh, bi, bh = _rnn_weights(rng, 4)
+    c = rng.randn(*h.shape).astype("float32")
+    xt = rng.randn(3, *x.shape).astype("float32")   # (T, B, F)
+    return [xt, (h, c), (wi, wh, bi, bh)]
+
+
+def _rnn_scan_np(xt, init, params):
+    (h, c), (wi, wh, bi, bh) = init, params
+    ys = []
+    for t in range(xt.shape[0]):
+        h, c = _lstm_np(xt[t], h, c, wi, wh, bi, bh)
+        ys.append(h)
+    return np.stack(ys), (h, c)
+
+
+def _conv3d_np(x, w):
+    n, ci, d, hh, ww = x.shape
+    co, _, kd, kh, kw = w.shape
+    od, oh, ow = d - kd + 1, hh - kh + 1, ww - kw + 1
+    out = np.zeros((n, co, od, oh, ow), "float32")
+    for b in range(n):
+        for o in range(co):
+            for z in range(od):
+                for i in range(oh):
+                    for j in range(ow):
+                        out[b, o, z, i, j] = np.sum(
+                            x[b, :, z:z + kd, i:i + kh, j:j + kw] * w[o])
+    return out
+
+
+def _convT_np(x, w, nd):
+    """stride 1, pad 0, groups 1; weight (in_c, out_c, *k)."""
+    sp_in = x.shape[2:]
+    k = w.shape[2:]
+    sp_out = tuple(s + kk - 1 for s, kk in zip(sp_in, k))
+    n, ci = x.shape[:2]
+    co = w.shape[1]
+    out = np.zeros((n, co) + sp_out, "float32")
+    for b in range(n):
+        for c in range(ci):
+            for o in range(co):
+                for pos in np.ndindex(*sp_in):
+                    sl = tuple(slice(p, p + kk) for p, kk in zip(pos, k))
+                    out[(b, o) + sl] += x[(b, c) + pos] * w[c, o]
+    return out
+
+
+def _maxpool_np(x, nd, k=2):
+    sp = x.shape[2:]
+    rs = x.shape[:2] + sum(((s // k, k) for s in sp), ())
+    axes = tuple(3 + 2 * i for i in range(nd))
+    return x.reshape(rs).max(axis=axes)
+
+
+def _maxpool2d_with_idx_np(x, k=2):
+    n, c, h, w = x.shape
+    vals = np.zeros((n, c, h // k, w // k), "float32")
+    idx = np.zeros((n, c, h // k, w // k), "int64")
+    for b in range(n):
+        for ch in range(c):
+            for i in range(h // k):
+                for j in range(w // k):
+                    win = x[b, ch, i * k:(i + 1) * k, j * k:(j + 1) * k]
+                    a = np.argmax(win)
+                    ai, aj = divmod(a, k)
+                    vals[b, ch, i, j] = win[ai, aj]
+                    idx[b, ch, i, j] = (i * k + ai) * w + (j * k + aj)
+    return vals, idx
+
+
+def _unpool_args_nd(nd):
+    def make(rng):
+        sp = (4,) * nd
+        x = rng.randn(1, 2, *(2,) * nd).astype("float32")
+        # valid flat indices: one per 2^nd window, distinct
+        grid = np.stack(np.meshgrid(*[np.arange(2)] * nd,
+                                    indexing="ij"), -1).reshape(-1, nd)
+        idx = np.zeros((1, 2) + (2,) * nd, "int32")
+        for pos, g in zip(np.ndindex(*(2,) * nd), grid):
+            flat = 0
+            for d in range(nd):
+                flat = flat * 4 + (pos[d] * 2 + (g[d] if d < nd else 0))
+            idx[(0, 0) + pos] = flat
+            idx[(0, 1) + pos] = flat
+        return [x, idx]
+    return make
+
+
+def _unpool_np(x, idx, sp):
+    out = np.zeros(x.shape[:2] + (int(np.prod(sp)),), "float32")
+    for b in range(x.shape[0]):
+        for c in range(x.shape[1]):
+            out[b, c][idx[b, c].reshape(-1)] = x[b, c].reshape(-1)
+    return out.reshape(x.shape[:2] + tuple(sp))
+
+
+def _hsig_np(x, w, b, lab, num_classes, code_len):
+    total = np.zeros(x.shape[0])
+    node = lab.astype(np.int64) + num_classes
+    for _ in range(code_len):
+        parent = node // 2
+        live = (node > 1).astype(np.float64)
+        bit = (node % 2).astype(np.float64)
+        idx = np.clip(parent - 1, 0, num_classes - 1)
+        logits = np.einsum("nd,nd->n", x, w[idx]) + b.reshape(-1)[idx]
+        total = total + live * (_np_softplus(logits) - (1 - bit) * logits)
+        node = np.maximum(parent, 1)
+    return total
+
+
+def _mode_np(x, axis=-1):
+    moved = np.moveaxis(x, axis, -1)
+    sh = moved.shape[:-1]
+    vals = np.zeros(sh, "float32")
+    idxs = np.zeros(sh, "int64")
+    for pos in np.ndindex(*sh):
+        row = moved[pos]
+        srt = np.sort(row)
+        best_v, best_len, cur_len = srt[0], 1, 1
+        for i in range(1, len(srt)):
+            cur_len = cur_len + 1 if srt[i] == srt[i - 1] else 1
+            if cur_len > best_len:
+                best_len, best_v = cur_len, srt[i]
+        vals[pos] = best_v
+        order = np.argsort(row, kind="stable")
+        # impl: index into stable argsort at the END of the first longest
+        # run of the sorted axis
+        runs = np.ones(len(srt), int)
+        for i in range(1, len(srt)):
+            if srt[i] == srt[i - 1]:
+                runs[i] = runs[i - 1] + 1
+        best = int(np.argmax(runs))
+        idxs[pos] = order[best]
+    return vals, idxs
+
+
+def _cummax_np(x, op=np.maximum):
+    flat = x.reshape(-1)
+    vals = op.accumulate(flat)
+    ids = np.where(flat == vals, np.arange(len(flat)), -1)
+    ids = np.maximum.accumulate(ids)
+    return vals, ids.astype("int32")
+
+
+def _gather_tree_np(ids, parents):
+    t_max, batch, beam = ids.shape
+    out = np.zeros_like(ids)
+    beams = np.broadcast_to(np.arange(beam), (batch, beam)).copy()
+    for t in range(t_max - 1, -1, -1):
+        out[t] = np.take_along_axis(ids[t], beams, axis=-1)
+        beams = np.take_along_axis(parents[t], beams, axis=-1)
+    return out
+
+
+def _stft_np(x, window, n_fft, hop):
+    nfr = 1 + (x.shape[-1] - n_fft) // hop
+    frames = np.stack([x[..., i * hop:i * hop + n_fft] for i in range(nfr)],
+                      axis=-1)                        # (..., n_fft, F)
+    return np.fft.rfft(frames * window[:, None], axis=-2)
+
+
+def _istft_np(x, window, n_fft, hop):
+    frames = np.fft.irfft(x, n=n_fft, axis=-2) * window[:, None]
+    nfr = x.shape[-1]
+    n = (nfr - 1) * hop + n_fft
+    y = np.zeros(x.shape[:-2] + (n,))
+    env = np.zeros(n)
+    for i in range(nfr):
+        y[..., i * hop:i * hop + n_fft] += frames[..., i]
+        env[i * hop:i * hop + n_fft] += window * window
+    return y / np.where(env > 1e-11, env, 1.0)
+
+
+def _pca_np(x, omega, niter=2):
+    x = x - x.mean(axis=-2, keepdims=True)
+    q, _ = np.linalg.qr(x @ omega)
+    for _ in range(niter):
+        qz, _ = np.linalg.qr(x.T @ q)
+        q, _ = np.linalg.qr(x @ qz)
+    u, s, vh = np.linalg.svd(q.T @ x, full_matrices=False)
+    return q @ u, s, vh.T
+
+
+def _lu_p_np(lu_data, piv1):
+    m = lu_data.shape[-2]
+    perm = np.arange(m)
+    piv = piv1 - 1
+    for i in range(len(piv)):
+        j = piv[i]
+        perm[i], perm[j] = perm[j], perm[i]
+    P = np.eye(m, dtype="float32")[perm]
+    return P.T
+
+
+def _house_np(x, tau):
+    m, n = x.shape
+    Q = np.eye(m)
+    for i in range(n):
+        v = np.where(np.arange(m) == i, 1.0,
+                     np.where(np.arange(m) > i, x[:, i], 0.0))
+        Q = Q @ (np.eye(m) - tau[i] * np.outer(v, v))
+    return Q[:, :n]
+
+
+def _qr_post(out):
+    q, r = [np.asarray(t, "float64") for t in out]
+    d = np.sign(np.diagonal(r, axis1=-2, axis2=-1))
+    d = np.where(d == 0, 1.0, d)
+    return q * d[..., None, :], r * d[..., :, None]
+
+
+def _svd_post(out):
+    u, s, vh = [np.asarray(t, "float64") for t in out]
+    return np.abs(u), s, np.abs(vh)
+
+
+def _eigh_post(out):
+    w, v = [np.asarray(t, "float64") for t in out]
+    return w, np.abs(v)
+
+
+def _eigsort(out):
+    a = np.asarray(out)
+    order = np.lexsort((np.imag(a), np.real(a)))
+    return _c2ri(a[order])
+
+
+_key0 = jax.random.PRNGKey(0)
+
+SPECS.update({
+    # ---- trivial / elementwise ---------------------------------------
+    "sigmoid_act": unary(_np_sigmoid),
+    "tanh_act": unary(np.tanh),
+    "relu_": unary(lambda x: np.maximum(x, 0.0)),
+    "rrelu": Spec(lambda rng: [_f((4, 6))(rng)],
+                  lambda x: np.where(x >= 0, x,
+                                     (0.125 + 1 / 3) / 2 * x),
+                  kwargs=dict(lower=0.125, upper=1 / 3)),
+    "scale": unary(lambda x: 2.0 * x + 1.0,
+                   kwargs=dict(scale=2.0, bias=1.0)),
+    "broadcast_add": binary(lambda x, y: x + y),
+    "addmm": Spec(lambda rng: [_f((4, 6))(rng), _f((4, 5))(rng),
+                               _f((5, 6))(rng)],
+                  lambda i, x, y: 0.5 * i + 2.0 * (x @ y),
+                  kwargs=dict(beta=0.5, alpha=2.0)),
+    "assign": unary(lambda x: x),
+    "clone": unary(lambda x: x),
+    "cast": Spec(lambda rng: [_f((4, 6), -3, 3)(rng)],
+                 lambda x: x.astype("int32"),
+                 kwargs=dict(dtype="int32"), grad=False, bf16=False),
+    "atleast_1d": unary(np.atleast_1d),
+    "atleast_2d": Spec(lambda rng: [_f((5,))(rng)], np.atleast_2d),
+    "atleast_3d": Spec(lambda rng: [_f((5,))(rng)], np.atleast_3d),
+    "allclose": Spec(lambda rng: [_f((4, 6))(rng), _f((4, 6))(rng)],
+                     lambda x, y: np.allclose(x, y),
+                     grad=False, bf16=False),
+    "isclose": Spec(lambda rng: [_f((4, 6))(rng), _f((4, 6))(rng)],
+                    lambda x, y: np.isclose(x, y),
+                    grad=False, bf16=False),
+    "isreal": Spec(lambda rng: [_f((4, 6))(rng)],
+                   lambda x: np.isreal(x), grad=False, bf16=False),
+    "ldexp": Spec(lambda rng: [_f((4, 6))(rng), _i((4, 6), -3, 4)(rng)],
+                  lambda x, y: np.ldexp(x, y), grad=False, bf16=False),
+    "gammainc": Spec(lambda rng: [_f((4, 6), 0.5, 3.0)(rng),
+                                  _f((4, 6), 0.5, 3.0)(rng)],
+                     sps.gammainc, grad=False),
+    "gammaincc": Spec(lambda rng: [_f((4, 6), 0.5, 3.0)(rng),
+                                   _f((4, 6), 0.5, 3.0)(rng)],
+                      sps.gammaincc, grad=False),
+    "einsum": Spec(lambda rng: ["ij,jk->ik", _f((4, 5))(rng),
+                                _f((5, 6))(rng)],
+                   lambda eq, a, b: np.einsum(eq, a, b), static=(0,)),
+    "normalize_op": unary(
+        lambda x: x / np.maximum(
+            np.sqrt((x ** 2).sum(1, keepdims=True)), 1e-12)),
+    "bilinear_op": Spec(
+        lambda rng: [_f((4, 3))(rng), _f((4, 5))(rng),
+                     _f((6, 3, 5))(rng), _f((6,))(rng)],
+        lambda x1, x2, w, b: np.einsum("bi,oij,bj->bo", x1, w, x2) + b),
+    # ---- keyed-stochastic ops at their deterministic settings --------
+    "dropout_op": Spec(lambda rng: [_f((4, 6))(rng), _key0],
+                       lambda x, k: x, kwargs=dict(p=0.0)),
+    "dropout_axis": Spec(lambda rng: [_f((4, 6))(rng), _key0],
+                         lambda x, k: x, kwargs=dict(p=0.0, axis=(0,))),
+    "alpha_dropout_op": Spec(lambda rng: [_f((4, 6))(rng), _key0],
+                             lambda x, k: x, kwargs=dict(p=0.0),
+                             tol=1e-4),
+    # ---- manipulation / indexing -------------------------------------
+    "flatten_op": unary(lambda x: x.reshape(-1),
+                        kwargs=dict(start_axis=0, stop_axis=-1)),
+    "split_op": Spec(lambda rng: [_f((4, 6))(rng)],
+                     lambda x: tuple(np.split(x, [2, 5], axis=1)),
+                     kwargs=dict(sections=[2, 3, -1], axis=1)),
+    "getitem": Spec(lambda rng: [_f((4, 6))(rng)],
+                    lambda x: x[1:3, ::2],
+                    kwargs=dict(idx=(slice(1, 3), slice(None, None, 2)))),
+    "setitem_value": Spec(
+        lambda rng: [_f((4, 6))(rng), slice(0, 2), _f((2, 6))(rng)],
+        lambda x, i, v: np.concatenate([v, x[2:]], 0),
+        static=(1,)),
+    "index_put": Spec(
+        lambda rng: [_f((4, 6))(rng), (np.array([0, 2, 3]),),
+                     _f((3, 6))(rng)],
+        lambda x, ind, v: _index_put_np(x, ind, v)),
+    "slice_scatter": Spec(
+        lambda rng: [_f((4, 6))(rng), _f((4, 2))(rng)],
+        lambda x, v: _slice_scatter_np(x, v),
+        kwargs=dict(axes=[1], starts=[1], ends=[5], strides=[2])),
+    "as_strided": Spec(
+        lambda rng: [_f((24,))(rng)],
+        lambda x: np.stack([[x[1 + i * 2 + j] for j in range(2)]
+                            for i in range(3)]),
+        kwargs=dict(shape=(3, 2), stride=(2, 1), offset=1)),
+    "unfold": Spec(
+        lambda rng: [_f((2, 7))(rng)],
+        lambda x: np.moveaxis(
+            np.moveaxis(x, 1, 0)[np.arange(3)[:, None] * 2
+                                 + np.arange(3)[None, :]], (0, 1), (1, 2)),
+        kwargs=dict(axis=1, size=3, step=2)),
+    "pad_op": Spec(lambda rng: [_f((2, 3, 4, 5))(rng)],
+                   lambda x: np.pad(x, [(0, 0), (0, 0), (2, 3), (1, 0)],
+                                    constant_values=0.5),
+                   kwargs=dict(pad=[1, 0, 2, 3], value=0.5)),
+    "pixel_unshuffle": Spec(
+        lambda rng: [_f((2, 3, 4, 6))(rng)],
+        lambda x: x.reshape(2, 3, 2, 2, 3, 2).transpose(
+            0, 1, 3, 5, 2, 4).reshape(2, 12, 2, 3),
+        kwargs=dict(downscale_factor=2)),
+    "temporal_shift": Spec(
+        lambda rng: [_f((4, 8, 3, 3))(rng)],
+        lambda x: _temporal_shift_np(x, 2, 0.25),
+        kwargs=dict(seg_num=2, shift_ratio=0.25)),
+    "maxout": Spec(lambda rng: [_f((2, 6, 3))(rng)],
+                   lambda x: x.reshape(2, 3, 2, 3).max(axis=2),
+                   kwargs=dict(groups=2)),
+    "frame": Spec(lambda rng: [_f((2, 20))(rng)],
+                  lambda x: np.stack(
+                      [x[..., i * 3:i * 3 + 6] for i in range(5)],
+                      axis=-1),
+                  kwargs=dict(frame_length=6, hop_length=3)),
+    "overlap_add": Spec(
+        lambda rng: [_f((2, 6, 5))(rng)],
+        lambda x: _overlap_add_np(x, 3),
+        kwargs=dict(hop_length=3)),
+    # ---- reductions / search -----------------------------------------
+    "nanmedian": Spec(
+        lambda rng: [np.where(rng.rand(3, 5) < 0.2, np.nan,
+                              rng.randn(3, 5)).astype("float32")],
+        lambda x: np.nanmedian(x, axis=-1),
+        kwargs=dict(axis=-1), grad=False, bf16=False),
+    "cummax": Spec(lambda rng: [_f((4, 6))(rng)],
+                   lambda x: _cummax_np(x, np.maximum),
+                   grad=False, bf16=False),
+    "cummin": Spec(lambda rng: [_f((4, 6))(rng)],
+                   lambda x: _cummin_np(x),
+                   grad=False, bf16=False),
+    "mode_op": Spec(
+        lambda rng: [rng.randint(0, 4, (3, 7)).astype("float32")],
+        lambda x: _mode_np(x), grad=False, bf16=False),
+    "gather_tree": Spec(
+        lambda rng: [_i((4, 2, 3), 0, 9)(rng), _i((4, 2, 3), 0, 3)(rng)],
+        _gather_tree_np, grad=False, bf16=False),
+    # ---- losses -------------------------------------------------------
+    "cosine_embedding": Spec(
+        lambda rng: [_f((4, 6))(rng), _f((4, 6))(rng),
+                     np.array([1, -1, 1, -1], "float32")],
+        lambda a, b, l: _cosine_embedding_np(a, b, l, 0.1),
+        kwargs=dict(margin=0.1)),
+    "dice_loss": Spec(
+        lambda rng: [sps.softmax(rng.randn(3, 5).astype("float32"), -1),
+                     _i((3, 1), 0, 5)(rng).astype("int64")],
+        lambda p, l: _dice_np(p, l, 1e-5), kwargs=dict(epsilon=1e-5)),
+    "gaussian_nll_loss": Spec(
+        lambda rng: [_f((4, 6))(rng), _f((4, 6))(rng),
+                     _f((4, 6), 0.5, 1.5)(rng)],
+        lambda i, l, v: (0.5 * (np.log(np.maximum(v, 1e-6))
+                                + (i - l) ** 2 / np.maximum(v, 1e-6))
+                         ).mean(),
+        kwargs=dict(full=False, epsilon=1e-6, reduction="mean")),
+    "hinge_embedding": Spec(
+        lambda rng: [_f((4, 6))(rng),
+                     np.where(np.arange(24).reshape(4, 6) % 2 == 0,
+                              1.0, -1.0).astype("float32")],
+        lambda x, l: np.where(l == 1.0, x,
+                              np.clip(1.0 - x, 0, None)).mean()),
+    "log_loss_op": Spec(
+        lambda rng: [_f((4, 1), 0.1, 0.9)(rng),
+                     _b((4, 1))(rng).astype("float32")],
+        lambda p, l: -l * np.log(np.clip(p, 1e-4, 1 - 1e-4))
+        - (1 - l) * np.log(1 - np.clip(p, 1e-4, 1 - 1e-4))),
+    "margin_ranking": Spec(
+        lambda rng: [_f((4,))(rng), _f((4,))(rng),
+                     np.array([1, -1, 1, -1], "float32")],
+        lambda a, b, l: np.clip(-l * (a - b) + 0.2, 0, None).mean(),
+        kwargs=dict(margin=0.2)),
+    "soft_margin_loss": Spec(
+        lambda rng: [_f((4, 6))(rng),
+                     np.where(rng.rand(4, 6) > 0.5, 1.0,
+                              -1.0).astype("float32")],
+        lambda x, l: _np_softplus(-l * x).mean(),
+        kwargs=dict(reduction="mean")),
+    "multi_label_soft_margin_loss": Spec(
+        lambda rng: [_f((4, 6))(rng), _b((4, 6))(rng).astype("float32"),
+                     _f((6,), 0.5, 1.5)(rng)],
+        lambda x, l, w: (w * -(l * np.log(_np_sigmoid(x))
+                               + (1 - l) * np.log(_np_sigmoid(-x)))
+                         ).mean(-1).mean(),
+        kwargs=dict(reduction="mean")),
+    "multi_margin_loss": Spec(
+        lambda rng: [_f((4, 5))(rng), _i((4,), 0, 5)(rng).astype("int64"),
+                     1, 1.0, _f((5,), 0.5, 1.5)(rng)],
+        lambda x, l, p, m, w: _multi_margin_np(x, l, w),
+        kwargs=dict(reduction="mean"), static=(2, 3)),
+    "poisson_nll_loss": Spec(
+        lambda rng: [_f((4, 6))(rng), _f((4, 6), 0.0, 3.0)(rng)],
+        lambda i, l: (np.exp(i) - l * i).mean(),
+        kwargs=dict(log_input=True, full=False, epsilon=1e-8,
+                    reduction="mean")),
+    "npair_loss": Spec(
+        lambda rng: [_f((4, 6))(rng), _f((4, 6))(rng),
+                     np.array([0, 1, 0, 2], "int64")],
+        lambda a, p, l: _npair_np(a, p, l, 0.002),
+        kwargs=dict(l2_reg=0.002)),
+    "sigmoid_focal_loss_op": Spec(
+        lambda rng: [_f((4, 6))(rng), _b((4, 6))(rng).astype("float32")],
+        lambda x, l: _focal_np(x, l, 0.25, 2.0),
+        kwargs=dict(alpha=0.25, gamma=2.0, reduction="sum")),
+    "triplet_margin": Spec(
+        lambda rng: [_f((4, 6))(rng), _f((4, 6))(rng), _f((4, 6))(rng)],
+        lambda a, p, n: np.clip(
+            _pdist_np(a, p) - _pdist_np(a, n) + 1.0, 0, None).mean(),
+        gtol=2e-2),
+    "margin_ce": Spec(
+        lambda rng: [_f((4, 5), -0.9, 0.9)(rng),
+                     _i((4,), 0, 5)(rng).astype("int64")],
+        lambda lg, l: _margin_ce_np(lg, l, 1.0, 0.3, 0.2, 8.0),
+        kwargs=dict(margin1=1.0, margin2=0.3, margin3=0.2, scale=8.0,
+                    return_softmax=False, reduction="mean")),
+    "hsigmoid_loss_op": Spec(
+        lambda rng: [_f((3, 5))(rng), _f((4, 5))(rng), _f((4,))(rng),
+                     _i((3,), 0, 4)(rng).astype("int64")],
+        lambda x, w, b, l: _hsig_np(x, w, b, l, 4, 3),
+        kwargs=dict(num_classes=4, code_len=3)),
+    # ---- norm / conv / pooling ---------------------------------------
+    "batch_norm_train": Spec(
+        lambda rng: [_f((4, 3, 5))(rng), _f((3,), 0.5, 1.5)(rng),
+                     _f((3,))(rng)],
+        lambda x, w, b: _bn_np(x, w, b),
+        # impl normalizes in f32 internally: numeric grads are
+        # f32-precision-floored even under the x64 harness
+        gtol=6e-2),
+    "local_response_norm_op": Spec(
+        lambda rng: [_f((2, 6, 4))(rng)],
+        lambda x: _lrn_np(x, 3, 1e-4, 0.75, 1.0),
+        kwargs=dict(size=3)),
+    "conv3d": Spec(
+        lambda rng: [_f((1, 2, 3, 4, 4))(rng), _f((3, 2, 2, 2, 2))(rng)],
+        _conv3d_np, gtol=2e-2),
+    "conv1d_transpose": Spec(
+        lambda rng: [_f((1, 2, 5))(rng), _f((2, 3, 3))(rng)],
+        lambda x, w: _convT_np(x, w, 1), gtol=2e-2),
+    "conv2d_transpose": Spec(
+        lambda rng: [_f((1, 2, 4, 4))(rng), _f((2, 3, 2, 2))(rng)],
+        lambda x, w: _convT_np(x, w, 2), gtol=2e-2),
+    "conv3d_transpose": Spec(
+        lambda rng: [_f((1, 2, 3, 3, 3))(rng), _f((2, 2, 2, 2, 2))(rng)],
+        lambda x, w: _convT_np(x, w, 3), gtol=2e-2),
+    "adaptive_avg_pool1d": Spec(
+        lambda rng: [_f((2, 3, 8))(rng)],
+        lambda x: x.reshape(2, 3, 4, 2).mean(-1),
+        kwargs=dict(output_size=4)),
+    "adaptive_avg_pool3d": Spec(
+        lambda rng: [_f((1, 2, 4, 4, 4))(rng)],
+        lambda x: x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean((3, 5, 7)),
+        kwargs=dict(output_size=(2, 2, 2))),
+    "adaptive_max_pool1d": Spec(
+        lambda rng: [_f((2, 3, 8))(rng)],
+        lambda x: x.reshape(2, 3, 4, 2).max(-1),
+        kwargs=dict(output_size=4)),
+    "adaptive_max_pool2d": Spec(
+        lambda rng: [_f((1, 2, 4, 6))(rng)],
+        lambda x: x.reshape(1, 2, 2, 2, 3, 2).max((3, 5)),
+        kwargs=dict(output_size=(2, 3))),
+    "adaptive_max_pool3d": Spec(
+        lambda rng: [_f((1, 2, 4, 4, 4))(rng)],
+        lambda x: x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((3, 5, 7)),
+        kwargs=dict(output_size=(2, 2, 2))),
+    "avg_pool3d": Spec(
+        lambda rng: [_f((1, 2, 4, 4, 4))(rng)],
+        lambda x: x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean((3, 5, 7)),
+        kwargs=dict(kernel_size=(2, 2, 2), stride=(2, 2, 2),
+                    padding=((0, 0), (0, 0), (0, 0)))),
+    "max_pool3d": Spec(
+        lambda rng: [_f((1, 2, 4, 4, 4))(rng)],
+        lambda x: _maxpool_np(x, 3),
+        kwargs=dict(kernel_size=(2, 2, 2), stride=(2, 2, 2),
+                    padding=((0, 0), (0, 0), (0, 0)))),
+    "max_pool2d_indices": Spec(
+        lambda rng: [_f((1, 2, 4, 4))(rng)],
+        lambda x: _maxpool2d_with_idx_np(x)[1],
+        kwargs=dict(kernel_size=(2, 2), stride=(2, 2),
+                    padding=[(0, 0), (0, 0)]),
+        grad=False, bf16=False),
+    "max_unpool1d": Spec(_unpool_args_nd(1),
+                         lambda x, i: _unpool_np(x, i, (4,)),
+                         kwargs=dict(spatial_out=(4,)), bf16=False),
+    "max_unpool2d": Spec(_unpool_args_nd(2),
+                         lambda x, i: _unpool_np(x, i, (4, 4)),
+                         kwargs=dict(spatial_out=(4, 4)), bf16=False),
+    "max_unpool3d": Spec(_unpool_args_nd(3),
+                         lambda x, i: _unpool_np(x, i, (4, 4, 4)),
+                         kwargs=dict(spatial_out=(4, 4, 4)), bf16=False),
+    # ---- RNN cells ----------------------------------------------------
+    "simple_rnn_cell": Spec(
+        lambda rng: _rnn_weights(rng, 1),
+        lambda x, h, wi, wh, bi, bh: np.tanh(
+            x @ wi.T + bi + h @ wh.T + bh)),
+    "gru_cell": Spec(lambda rng: _rnn_weights(rng, 3), _gru_np),
+    "lstm_cell": Spec(_lstm_args, _lstm_np),
+    "rnn_scan": Spec(_rnn_scan_args, _rnn_scan_np,
+                     kwargs=dict(mode="LSTM"), gtol=2e-2),
+    # ---- linalg -------------------------------------------------------
+    "eigh": Spec(lambda rng: [_psd(rng)], np.linalg.eigh,
+                 grad=False, bf16=False, post=_eigh_post),
+    "eigvalsh": Spec(lambda rng: [_psd(rng)], np.linalg.eigvalsh,
+                     grad=False, bf16=False),
+    "eig": Spec(lambda rng: [_psd(rng)], np.linalg.eig,
+                grad=False, bf16=False, jit=False,
+                post=lambda o: _c2ri(tuple(np.asarray(t) for t in o))),
+    "eigvals": Spec(lambda rng: [_psd(rng)], np.linalg.eigvals,
+                    grad=False, bf16=False, jit=False, post=_eigsort),
+    "qr": Spec(lambda rng: [rng.randn(5, 3).astype("float32")],
+               lambda x: np.linalg.qr(x),
+               grad=False, bf16=False, post=_qr_post, tol=1e-4),
+    "svd": Spec(lambda rng: [rng.randn(5, 3).astype("float32")],
+                lambda x: np.linalg.svd(x, full_matrices=False),
+                grad=False, bf16=False, post=_svd_post, tol=1e-4),
+    "lu": Spec(lambda rng: [_psd(rng)],
+               lambda x: (_scipy_lu(x)[0], _scipy_lu(x)[1] + 1),
+               grad=False, bf16=False, tol=1e-4),
+    "lu_unpack_l_u": Spec(
+        lambda rng: [_scipy_lu(_psd(rng))[0]],
+        lambda lu_d: (np.tril(lu_d, -1) + np.eye(4, dtype="float32"),
+                      np.triu(lu_d)),
+        grad=False, bf16=False),
+    "lu_unpack_p": Spec(
+        lambda rng: list(_lu_p_args(rng)),
+        lambda lu_d, piv: _lu_p_np(lu_d, piv),
+        grad=False, bf16=False),
+    "lstsq": Spec(
+        lambda rng: [rng.randn(6, 3).astype("float32"),
+                     rng.randn(6, 2).astype("float32")],
+        lambda x, y: np.linalg.lstsq(x, y, rcond=None)[0],
+        grad=False, bf16=False, tol=1e-3,
+        post=lambda o: np.asarray(o[0] if isinstance(o, (tuple, list))
+                                  else o, "float64")),
+    "matrix_exp": Spec(lambda rng: [0.3 * _psd(rng)],
+                       lambda x: _expm_np(x),
+                       grad=False, bf16=False, tol=1e-4),
+    "matrix_rank": Spec(lambda rng: [_psd(rng)],
+                        lambda x: np.linalg.matrix_rank(x),
+                        grad=False, bf16=False),
+    "cond_op": Spec(lambda rng: [_psd(rng)],
+                    lambda x: np.linalg.cond(x),
+                    grad=False, bf16=False, tol=1e-3),
+    "householder_product": Spec(
+        lambda rng: [0.3 * rng.randn(4, 3).astype("float32"),
+                     0.3 * rng.rand(3).astype("float32")],
+        _house_np, gtol=2e-2),
+    "pca_lowrank": Spec(
+        lambda rng: [rng.randn(8, 5).astype("float32"),
+                     rng.randn(5, 3).astype("float32")],
+        lambda x, om: _pca_np(x, om),
+        grad=False, bf16=False, post=_svd_post, tol=1e-3),
+    # ---- signal -------------------------------------------------------
+    "stft": Spec(
+        lambda rng: [_f((2, 32))(rng), _hann(8)],
+        lambda x, w: _stft_np(x, w, 8, 4),
+        kwargs=dict(n_fft=8, hop_length=4, win_length=8, center=False,
+                    pad_mode="reflect", normalized=False, onesided=True),
+        grad=False, bf16=False, jit=False, post=_c2ri, tol=1e-4),
+    "istft": Spec(
+        lambda rng: [_stft_np(_f((2, 32))(rng), _hann(8), 8, 4),
+                     _hann(8)],
+        lambda s, w: _istft_np(s, w, 8, 4),
+        kwargs=dict(n_fft=8, hop_length=4, win_length=8, center=False,
+                    normalized=False, onesided=True, length=None,
+                    return_complex=False),
+        grad=False, bf16=False, jit=False, tol=1e-4),
+})
+
+
+def _index_put_np(x, ind, v):
+    out = x.copy()
+    out[ind[0]] = v
+    return out
+
+
+def _slice_scatter_np(x, v):
+    out = x.copy()
+    out[:, 1:5:2] = v[:, :2]
+    return out
+
+
+def _temporal_shift_np(x, seg, ratio):
+    nt, c, h, w = x.shape
+    n = nt // seg
+    xr = x.reshape(n, seg, c, h, w)
+    fc = int(c * ratio)
+    out = np.zeros_like(xr)
+    out[:, :-1, :fc] = xr[:, 1:, :fc]
+    out[:, 1:, fc:2 * fc] = xr[:, :-1, fc:2 * fc]
+    out[:, :, 2 * fc:] = xr[:, :, 2 * fc:]
+    return out.reshape(nt, c, h, w)
+
+
+def _overlap_add_np(x, hop):
+    frames = np.swapaxes(x, -1, -2)       # (..., F, L)
+    F, L = frames.shape[-2:]
+    n = (F - 1) * hop + L
+    out = np.zeros(frames.shape[:-2] + (n,), "float32")
+    for i in range(F):
+        out[..., i * hop:i * hop + L] += frames[..., i, :]
+    return out
+
+
+def _cummin_np(x):
+    flat = x.reshape(-1)
+    vals = np.minimum.accumulate(flat)
+    ids = np.where(flat == vals, np.arange(len(flat)), -1)
+    ids = np.maximum.accumulate(ids)
+    return vals, ids.astype("int32")
+
+
+def _cosine_embedding_np(a, b, l, margin):
+    cos = (a * b).sum(-1) / np.maximum(
+        np.linalg.norm(a, axis=-1) * np.linalg.norm(b, axis=-1), 1e-12)
+    return np.where(l == 1, 1 - cos,
+                    np.clip(cos - margin, 0, None)).mean()
+
+
+def _dice_np(p, l, eps):
+    c = p.shape[-1]
+    lab = np.eye(c, dtype="float32")[l[..., 0]]
+    red = tuple(range(1, p.ndim))
+    inter = (p * lab).sum(red)
+    union = p.sum(red) + lab.sum(red)
+    return (1 - (2 * inter + eps) / (union + eps)).mean()
+
+
+def _multi_margin_np(x, l, w):
+    n, c = x.shape
+    xy = x[np.arange(n), l][:, None]
+    m = np.maximum(1.0 - xy + x, 0.0)
+    m = m * w[l][:, None]
+    mask = 1.0 - np.eye(c)[l]
+    return ((m * mask).sum(-1) / c).mean()
+
+
+def _npair_np(a, p, l, reg):
+    sim = a @ p.T
+    tgt = (l[:, None] == l[None, :]).astype("float64")
+    tgt = tgt / tgt.sum(-1, keepdims=True)
+    ce = -(tgt * _np_logsoftmax(sim)).sum(-1).mean()
+    return ce + reg * ((a * a).sum(-1).mean()
+                       + (p * p).sum(-1).mean()) / 4
+
+
+def _focal_np(x, l, alpha, gamma):
+    p = _np_sigmoid(x)
+    ce = (1 - l) * x + np.log1p(np.exp(-np.abs(x))) + np.clip(-x, 0, None)
+    pt = p * l + (1 - p) * (1 - l)
+    loss = ce * (1 - pt) ** gamma
+    at = alpha * l + (1 - alpha) * (1 - l)
+    return (at * loss).sum()
+
+
+def _pdist_np(a, b, p=2.0, eps=1e-6):
+    return ((np.abs(a - b) + eps) ** p).sum(-1) ** (1.0 / p)
+
+
+def _margin_ce_np(lg, l, m1, m2, m3, s):
+    theta = np.arccos(np.clip(lg, -1.0, 1.0))
+    target = np.cos(m1 * theta + m2) - m3
+    onehot = np.eye(lg.shape[-1])[l]
+    adj = np.where(onehot > 0, target, lg) * s
+    logp = _np_logsoftmax(adj)
+    return (-logp[np.arange(len(l)), l]).mean()
+
+
+def _bn_np(x, w, b, eps=1e-5):
+    axes = (0, 2)
+    mean = x.mean(axes)
+    var = x.var(axes)
+    sh = (1, -1, 1)
+    out = ((x - mean.reshape(sh)) / np.sqrt(var.reshape(sh) + eps)
+           * w.reshape(sh) + b.reshape(sh))
+    return out, mean, var
+
+
+def _lrn_np(x, size, alpha, beta, k):
+    sq = x.astype("float64") ** 2
+    c = x.shape[1]
+    half = size // 2
+    padded = np.pad(sq, [(0, 0), (half, size - 1 - half)]
+                    + [(0, 0)] * (x.ndim - 2))
+    win = sum(padded[:, i:i + c] for i in range(size))
+    return x / (k + alpha * win) ** beta
+
+
+def _scipy_lu(x):
+    import scipy.linalg
+    lu_d, piv = scipy.linalg.lu_factor(x)
+    return lu_d.astype("float32"), piv.astype("int32")
+
+
+def _lu_p_args(rng):
+    lu_d, piv = _scipy_lu(rng.randn(4, 4).astype("float32"))
+    return lu_d, piv + 1
+
+
+def _expm_np(x):
+    import scipy.linalg
+    return scipy.linalg.expm(np.asarray(x, "float64")).astype("float32")
+
+
+def _hann(n):
+    return np.hanning(n + 1)[:n].astype("float32") + 0.0
+
+
+# Every registry op NOT spec'd above must carry an explicit waiver naming
+# the dedicated test that covers it (VERDICT r2 item 4).
+WAIVERS: dict[str, str] = {
+    "flash_attention_op": "full parity/grad suite in "
+                          "tests/test_flash_attention.py",
+    "rnnt_loss": "lattice-loss parity suite in tests/test_nn_extras.py",
+    "fractional_max_pool2d": "pseudo-random pooling sequence checked in "
+                             "tests/test_nn_extras.py",
+    "fractional_max_pool3d": "pseudo-random pooling sequence checked in "
+                             "tests/test_nn_extras.py",
+    "gumbel_softmax_impl": "keyed Gumbel noise is irreducibly stochastic;"
+                           " simplex/one-hot properties in "
+                           "test_gumbel_softmax_properties below",
+}
+
+
+def test_gumbel_softmax_properties():
+    """The waiver-backed property check for the one keyed-stochastic op
+    with no deterministic setting: soft samples lie on the simplex,
+    hard samples are exact one-hots, low temperature concentrates on the
+    argmax."""
+    op = OP_REGISTRY["gumbel_softmax_impl"]
+    x = jnp.asarray(np.random.RandomState(0).randn(64, 5), jnp.float32)
+    soft = op.fn(x, jax.random.PRNGKey(1), temperature=1.0, hard=False)
+    np.testing.assert_allclose(np.asarray(soft).sum(-1), 1.0, rtol=1e-5)
+    assert (np.asarray(soft) >= 0).all()
+    hard = op.fn(x, jax.random.PRNGKey(1), temperature=1.0, hard=True)
+    h = np.asarray(hard)
+    assert ((h == 0) | (h == 1)).all() and (h.sum(-1) == 1).all()
+    cold = op.fn(x, jax.random.PRNGKey(2), temperature=1e-3, hard=False)
+    assert (np.asarray(cold).max(-1) > 0.99).all()
+
+
+def test_registry_fully_covered():
+    """VERDICT r2 item 4: every registered op has a Spec or an explicit
+    waiver — fails the moment a new defop lands with neither."""
+    covered = set(SPECS) | set(SHARDED_SPECS) | set(WAIVERS)
+    missing = sorted(set(OP_REGISTRY) - covered)
+    assert not missing, (
+        f"{len(missing)} registry ops have neither a Spec nor a waiver: "
+        f"{missing}")
+    overlap = sorted(set(SPECS) & set(WAIVERS))
+    assert not overlap, f"ops both spec'd and waived: {overlap}"
+    stale = sorted((set(WAIVERS) | set(SPECS) | set(SHARDED_SPECS))
+                   - set(OP_REGISTRY))
+    assert not stale, f"specs/waivers for unknown ops: {stale}"
+
+
 _spec_ops = sorted(SPECS)
 
 
@@ -839,6 +1691,8 @@ def test_numpy_parity(name):
     args = spec.make(_rng_for(name))
     out = op.fn(*_jaxify(args), **spec.kwargs)
     ref = spec.ref(*args)
+    if spec.post is not None:
+        out, ref = spec.post(out), spec.post(ref)
     _compare(out, ref, spec.tol)
 
 

@@ -175,3 +175,20 @@ def test_weight_only_pallas_kernel_parity():
         x3, qw, s, block_m=8, block_n=128, block_k=128,
         out_dtype=jnp.float32, interpret=True))
     np.testing.assert_allclose(got3, ref3, rtol=2e-4, atol=2e-4)
+
+
+def test_convert_bare_quanted_root_and_quant_axis_guard():
+    """convert() on a bare QuantedLinear root (the include_self path)
+    must convert it, and per-IN-channel scales must be rejected (the
+    dequant epilogue can't factor them out of the contraction)."""
+    model, q, qmodel, calib = _calibrated_linear_ptq()
+    bare = qmodel[0]                      # the QuantedLinear itself
+    conv = q.convert(bare, execute="int8")
+    assert isinstance(conv, QuantizedLinear)
+    x = paddle.to_tensor(calib[0])
+    ref = q.convert(qmodel, execute="int8")(x).numpy()
+    np.testing.assert_allclose(conv(x).numpy(), ref, rtol=1e-6)
+
+    with pytest.raises(ValueError, match="quant_axis"):
+        QuantizedLinear(nn.Linear(4, 6), np.ones(4, "float32"),
+                        act_scale=1.0, quant_axis=0, mode="int8")

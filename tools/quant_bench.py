@@ -17,7 +17,6 @@ from paddle_tpu.models.llama import tiny_llama_config
 from paddle_tpu.quantization import (PTQ, QuantConfig, HistObserver,
                                      AbsMaxChannelWiseWeightObserver,
                                      QuantizedLinear)
-import paddle_tpu.optimizer as opt
 
 paddle.seed(0)
 cfg = tiny_llama_config(num_hidden_layers=12, hidden_size=1024,
