@@ -1,0 +1,193 @@
+"""Runtime kernel autotune cache.
+
+Reference: paddle/phi/kernels/autotune/cache.h:97 (AlgorithmsCache — a
+per-op hash map from a parameter signature to the measured-best
+algorithm) + switch_autotune.cc (step-gated measuring). The TPU-native
+version picks Pallas block configurations instead of cuDNN algorithms:
+
+- `choose(kernel, key, candidates, measure, default)` returns the cached
+  pick for (kernel, key) if present; otherwise, when measuring is
+  possible (real TPU backend, measuring enabled), it times each
+  candidate ONCE via the caller-supplied `measure` callback, caches the
+  winner, and persists the cache to disk — the next process skips the
+  sweep entirely. Off-TPU (or with autotune disabled) it returns
+  `default` — the hand-swept constants that were the only option before.
+- The on-disk cache (JSON, atomic replace) ships SEEDED with the round-2
+  v5e sweep results, so bench-shape calls never pay a sweep.
+
+Env:
+  PADDLE_TPU_AUTOTUNE=0/1    enable measuring (default 1 on TPU)
+  PADDLE_TPU_AUTOTUNE_CACHE  cache file path
+                             (default ~/.cache/paddle_tpu/autotune.json)
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+
+__all__ = ["choose", "get", "put", "cache_path", "clear_memory",
+           "time_fn"]
+
+
+def time_fn(fn, iters: int = 6) -> float:
+    """Median-free simple timer for candidate measurement. Syncs by
+    FETCHING a reduced scalar — through the axon dispatch tunnel
+    jax.block_until_ready returns before execution finishes
+    (BASELINE.md round-3 note), so a value fetch is the only real
+    sync."""
+    import time as _time
+
+    import jax.numpy as jnp
+
+    def _sync(out):
+        leaf = out[0] if isinstance(out, (tuple, list)) else out
+        float(jnp.sum(leaf.astype(jnp.float32)))
+
+    _sync(fn())                    # compile + warm
+    t0 = _time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fn()
+    _sync(out)
+    return (_time.perf_counter() - t0) / iters
+
+_lock = threading.Lock()
+_mem: dict | None = None      # {"kernel|key": config}
+_dirty = False
+
+# Round-2 v5e sweep results (BASELINE.md / NOTES_r2.md): these keys use
+# the same signature format the kernels generate, so the shipped cache
+# covers the bench shapes without a first-run sweep.
+_SEED = {
+    # flash fwd/bwd short-seq: (512, 512) won IN THE FULL TRAIN STEP
+    # (larger q-blocks win in kernel isolation but lose in context).
+    # Keys cover the bench family: 400M llama (20 q-heads / 4 kv -> GQA
+    # fold rep=5, q=5*2048) and 1b (32/4 -> q=8*2048), plus the plain
+    # unfolded shapes.
+    "flash_fwd|q10240_s2048_d64_bf16_c1_g": [512, 512],
+    "flash_bwd|q10240_s2048_d64_bf16_c1_g": [512, 512],
+    "flash_fwd|q16384_s2048_d64_bf16_c1_g": [512, 512],
+    "flash_bwd|q16384_s2048_d64_bf16_c1_g": [512, 512],
+    "flash_fwd|q40960_s8192_d64_bf16_c1_g": [512, 512],
+    "flash_bwd|q40960_s8192_d64_bf16_c1_g": [512, 512],
+    "flash_fwd|q2048_s2048_d64_bf16_c1": [512, 512],
+    "flash_bwd|q2048_s2048_d64_bf16_c1": [512, 512],
+    "flash_fwd|q1024_s1024_d64_bf16_c1": [512, 512],
+    "flash_bwd|q1024_s1024_d64_bf16_c1": [512, 512],
+    # streamed-kv long-seq kernels want WIDE k blocks (16k: 9.2k->13.9k
+    # tok/s; 32k: 5.0k->8.5k); the VMEM cap in _stream_block_k still
+    # applies on top of this target
+    "flash_stream_bk|s8192_bf16": 2048,
+    "flash_stream_bk|s16384_bf16": 2048,
+    "flash_stream_bk|s32768_bf16": 2048,
+}
+
+
+def cache_path() -> str:
+    p = os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE")
+    if p:
+        return p
+    return os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                        "autotune.json")
+
+
+def _load() -> dict:
+    global _mem
+    if _mem is not None:
+        return _mem
+    data = dict(_SEED)
+    try:
+        with open(cache_path()) as f:
+            data.update(json.load(f))
+    except (FileNotFoundError, json.JSONDecodeError, OSError):
+        pass
+    _mem = data
+    return _mem
+
+
+def _persist() -> None:
+    global _dirty
+    if not _dirty:
+        return
+    path = cache_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   prefix=".autotune_")
+        # persist only entries that DIFFER from the shipped seeds —
+        # dumping seeds would permanently shadow improved seeds from a
+        # future package version
+        data = {k: v for k, v in _mem.items() if _SEED.get(k) != v}
+        with os.fdopen(fd, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)          # atomic vs concurrent processes
+        _dirty = False
+    except OSError:
+        pass                           # read-only FS: stay in-memory
+
+
+def clear_memory() -> None:
+    """Drop the in-process cache (tests)."""
+    global _mem, _dirty
+    with _lock:
+        _mem = None
+        _dirty = False
+
+
+def get(kernel: str, key: str):
+    with _lock:
+        v = _load().get(f"{kernel}|{key}")
+        return tuple(v) if isinstance(v, list) else v
+
+
+def put(kernel: str, key: str, config) -> None:
+    global _dirty
+    with _lock:
+        _load()[f"{kernel}|{key}"] = (list(config)
+                                      if isinstance(config, (tuple, list))
+                                      else config)
+        _dirty = True
+        _persist()
+
+
+def _measuring_enabled() -> bool:
+    flag = os.environ.get("PADDLE_TPU_AUTOTUNE")
+    if flag is not None:
+        return flag not in ("0", "false", "False")
+    try:
+        import jax
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def choose(kernel: str, key: str, candidates, measure, default):
+    """Cached pick for (kernel, key); sweep-once via `measure(cfg) ->
+    seconds` when measuring is possible, else `default`.
+
+    `measure` runs each candidate standalone on concrete data of the
+    call's shapes — it is invoked OUTSIDE any trace, so callers may use
+    choose() at trace time (block sizes are static). A candidate that
+    raises is skipped (e.g. a block config Mosaic rejects for this
+    shape)."""
+    cached = get(kernel, key)
+    if cached is not None:
+        return cached
+    if not _measuring_enabled() or measure is None:
+        return default
+    best, best_t = None, float("inf")
+    for cfg in candidates:
+        try:
+            t = measure(cfg)
+        except Exception:
+            continue
+        if t < best_t:
+            best, best_t = cfg, t
+    if best is None:
+        # cache the default so an all-candidates-fail shape is not
+        # re-swept on every trace and every process
+        best = default
+    put(kernel, key, best)
+    return best

@@ -79,6 +79,72 @@ _BLOCK_K_STREAM = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK_K_STREAM",
                                       2048))
 
 
+def _tuned_blocks(which, b, h, sq, sk, d, dtype, causal, seg_len=None):
+    """(bq, bk) for the whole-kv kernels from the runtime autotune cache
+    (reference: phi/kernels/autotune/cache.h AlgorithmsCache). Explicit
+    env vars always win (the old behavior); cached/seeded shapes (the
+    bench family ships pre-seeded) never sweep; a NEW shape on a real
+    TPU is measured once standalone across a NARROW candidate set —
+    narrow deliberately: big q-blocks win in kernel isolation but lose
+    in the full train step (round-2 sweep), so only in-context-safe
+    configs compete — and the winner is persisted to disk."""
+    default = ((_BLOCK_Q, _BLOCK_K) if which == "flash_fwd"
+               else (_BLOCK_Q_BWD, _BLOCK_K_BWD))
+    env_keys = (("PADDLE_TPU_FLASH_BLOCK_Q", "PADDLE_TPU_FLASH_BLOCK_K")
+                if which == "flash_fwd" else
+                ("PADDLE_TPU_FLASH_BLOCK_Q_BWD",
+                 "PADDLE_TPU_FLASH_BLOCK_K_BWD"))
+    if any(k in _os.environ for k in env_keys):
+        return default
+    from paddle_tpu.core import autotune
+    dname = {"bfloat16": "bf16", "float32": "f32",
+             "float16": "f16"}.get(jnp.dtype(dtype).name,
+                                   jnp.dtype(dtype).name)
+    key = (f"q{sq}_s{sk}_d{d}_{dname}_c{int(bool(causal))}"
+           + ("_g" if seg_len is not None else ""))
+    prep: dict = {}
+
+    def measure(cfg):
+        import numpy as np
+        if not prep:
+            rng = np.random.default_rng(0)
+            mb, mh = min(b, 2), min(h, 4)
+            prep["qkv"] = [
+                jnp.asarray(rng.standard_normal((mb, mh, s_, d)), dtype)
+                for s_ in (sq, sk, sk)]
+            if which == "flash_bwd":
+                # explicit blocks: the prep forward must not trigger a
+                # nested flash_fwd sweep
+                o, lse = _flash_fwd_pallas(
+                    *prep["qkv"], causal, 1.0 / math.sqrt(d),
+                    block_q=_BLOCK_Q, block_k=_BLOCK_K, stream_kv=False,
+                    seg_len=seg_len)
+                prep["o"], prep["lse"] = o, lse
+                prep["g"] = jnp.asarray(
+                    np.random.default_rng(1).standard_normal(o.shape),
+                    dtype)
+        mq, mk, mv = prep["qkv"]
+        if which == "flash_fwd":
+            def run():
+                return _flash_fwd_pallas(
+                    mq, mk, mv, causal, 1.0 / math.sqrt(d),
+                    block_q=cfg[0], block_k=cfg[1], stream_kv=False,
+                    seg_len=seg_len)[0]
+        else:
+            def run():
+                return _flash_bwd_pallas(
+                    mq, mk, mv, prep["o"], prep["lse"], prep["g"],
+                    causal, 1.0 / math.sqrt(d), block_q=cfg[0],
+                    block_k=cfg[1], stream_kv=False, seg_len=seg_len)[0]
+        return autotune.time_fn(run)
+
+    cands = [c for c in ((512, 512), (256, 512), (512, 256), (256, 256))
+             if c[0] <= max(sq, 256) and c[1] <= max(sk, 256)
+             and (seg_len is None or seg_len % c[0] == 0)]
+    bq, bk = autotune.choose(which, key, cands, measure, default)
+    return bq, bk
+
+
 def _prec(dtype):
     """MXU precision: bf16/f16 operands use the native one-pass mode (full
     rate, f32 accumulation); f32 operands keep exact f32. The package-global
@@ -271,14 +337,24 @@ _KV_VMEM_BYTES = int(_os.environ.get("PADDLE_TPU_FLASH_KV_VMEM",
 
 
 
-def _stream_block_k(sk, d, itemsize):
-    """Streamed-path k-block width: as wide as _BLOCK_K_STREAM allows
-    WITHOUT the per-cell resident k+v block pair exceeding the same
-    VMEM budget that triggered streaming (a flat 2048 at large d or f32
-    would recreate the whole-kv overflow the budget exists to avoid)."""
+def _stream_block_k(sk, d, itemsize, dtype=None):
+    """Streamed-path k-block width: as wide as the tuned/target width
+    allows WITHOUT the per-cell resident k+v block pair exceeding the
+    same VMEM budget that triggered streaming (a flat 2048 at large d or
+    f32 would recreate the whole-kv overflow the budget exists to
+    avoid). The target comes from the autotune cache (seeded with the
+    round-2 sweep: 2048 at 8k-32k) unless the env var is set."""
+    target = _BLOCK_K_STREAM
+    if "PADDLE_TPU_FLASH_BLOCK_K_STREAM" not in _os.environ:
+        from paddle_tpu.core import autotune
+        name = jnp.dtype(dtype).name if dtype is not None else "bf16"
+        name = {"bfloat16": "bf16", "float32": "f32",
+                "float16": "f16"}.get(name, name)
+        target = autotune.get("flash_stream_bk", f"s{sk}_{name}") \
+            or _BLOCK_K_STREAM
     budget_elems = _KV_VMEM_BYTES // (2 * d * itemsize)
     capped = max(512, (budget_elems // 512) * 512)
-    return min(_BLOCK_K_STREAM, capped, sk)
+    return min(int(target), capped, sk)
 
 
 def _auto_stream_kv(sk_p, d, itemsize):
@@ -326,8 +402,19 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
     Returns (out (B,H,Sq,D), lse (B,H,Sq_pad,128) f32 | None)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq = min(block_q or _BLOCK_Q, sq)
-    bk = min(block_k or _BLOCK_K, sk)
+    will_stream = (stream_kv if stream_kv is not None
+                   else _auto_stream_kv(sk, d, k.dtype.itemsize))
+    if block_q is None and block_k is None and not will_stream:
+        # streamed shapes skip whole-kv tuning entirely: sweeping the
+        # whole-kv kernels at a VMEM-overflowing kv size is exactly what
+        # _auto_stream_kv exists to avoid, and the streamed path picks
+        # its own bk via _stream_block_k
+        tq, tk = _tuned_blocks("flash_fwd", b, h, sq, sk, d, q.dtype,
+                               causal, seg_len)
+    else:
+        tq, tk = block_q or _BLOCK_Q, block_k or _BLOCK_K
+    bq = min(tq, sq)
+    bk = min(tk, sk)
     if seg_len is not None:
         assert sq % seg_len == 0 and seg_len % bq == 0, (sq, seg_len, bq)
     # pad seqs to block multiples
@@ -342,7 +429,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
     if stream_kv is None:
         stream_kv = _auto_stream_kv(sk_p, d, k.dtype.itemsize)
     if stream_kv and block_k is None:
-        bk2 = _stream_block_k(sk, d, k.dtype.itemsize)
+        bk2 = _stream_block_k(sk, d, k.dtype.itemsize, k.dtype)
         if bk2 > bk:
             bk = bk2
             sk_p = (sk + bk - 1) // bk * bk
@@ -750,8 +837,15 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
     (lane width set by the forward via _lanes_for)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq = min(block_q or _BLOCK_Q_BWD, sq)
-    bk = min(block_k or _BLOCK_K_BWD, sk)
+    will_stream = (stream_kv if stream_kv is not None
+                   else _auto_stream_kv(sk, d, k.dtype.itemsize))
+    if block_q is None and block_k is None and not will_stream:
+        tq, tk = _tuned_blocks("flash_bwd", b, h, sq, sk, d, q.dtype,
+                               causal, seg_len)
+    else:
+        tq, tk = block_q or _BLOCK_Q_BWD, block_k or _BLOCK_K_BWD
+    bq = min(tq, sq)
+    bk = min(tk, sk)
     if seg_len is not None:
         assert sq % seg_len == 0 and seg_len % bq == 0, (sq, seg_len, bq)
     sq_p = (sq + bq - 1) // bq * bq
@@ -780,7 +874,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
     if stream_kv is None:
         stream_kv = _auto_stream_kv(sk_p, d, k.dtype.itemsize)
     if stream_kv and block_k is None:
-        bk2 = _stream_block_k(sk, d, k.dtype.itemsize)
+        bk2 = _stream_block_k(sk, d, k.dtype.itemsize, k.dtype)
         if bk2 > bk:
             bk = bk2
             sk_p = (sk + bk - 1) // bk * bk

@@ -407,6 +407,7 @@ class QuantizedLinear(Layer):
             w_scale._value if isinstance(w_scale, Tensor) else w_scale,
             jnp.float32)
         if ws.ndim == 1:
+            quant_axis = quant_axis % w.ndim      # -1 == out dim for 2D
             if quant_axis not in (1, w.ndim - 1):
                 # the dequant epilogue multiplies AFTER the contraction
                 # over the in dim, so per-channel scales must live on the
