@@ -18,8 +18,8 @@ import signal
 import threading
 import time
 
-__all__ = ["ElasticManager", "StoreHeartbeat", "safe_barrier",
-           "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
+__all__ = ["ElasticManager", "ElasticSupervisor", "StoreHeartbeat",
+           "safe_barrier", "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
 
 ELASTIC_AUTO_PARALLEL_EXIT_CODE = 101  # reference manager.py same code
 
@@ -126,6 +126,138 @@ class ElasticManager:
     def dead_ranks(self):
         hb = getattr(self, "_heartbeat", None)
         return hb.stale_ranks() if hb is not None else []
+
+
+class ElasticSupervisor:
+    """Launch-side relaunch agent: the automated loop the reference runs
+    in elastic/manager.py:598 (etcd `watch` detecting changed/missing
+    member nodes) + LauncherInterface (stop all trainers, rewrite
+    trainer env, relaunch).
+
+    The supervisor hosts the rendezvous TCPStore itself, so membership
+    state survives worker death. It spawns one subprocess per rank with
+    the trainer env rewritten for each attempt
+    (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER /
+    PADDLE_ELASTIC_ATTEMPT — attempt-scoped heartbeat keys, so stale
+    beats from a dead attempt never mask a live one), and watches two
+    failure signals:
+      - a worker process exiting nonzero;
+      - a worker's store heartbeat (`a{attempt}/hb/{rank}`) going stale
+        past `grace` — a HUNG worker, which never exits on its own.
+    On either, it kills every worker (the collective world is broken),
+    increments the attempt counter and relaunches; workers resume from
+    their last checkpoint (ElasticManager.run's resume contract). Gives
+    up after `max_restarts` relaunches."""
+
+    def __init__(self, cmd, world_size, env=None, max_restarts=3,
+                 heartbeat_grace=15.0, poll_interval=0.5):
+        self.cmd = list(cmd)
+        self.world_size = world_size
+        self.env = dict(env) if env is not None else dict(os.environ)
+        self.max_restarts = max_restarts
+        self.grace = heartbeat_grace
+        self.poll = poll_interval
+        self.attempt = 0
+        self.restarts = 0
+        from paddle_tpu.distributed.store import TCPStore
+        self._store = TCPStore(is_master=True, world_size=world_size)
+        self._procs: list = []
+
+    # -- workers -----------------------------------------------------------
+    def _spawn_all(self):
+        import subprocess
+        self._procs = []
+        for rank in range(self.world_size):
+            env = dict(self.env)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(self.world_size),
+                "PADDLE_MASTER":
+                    f"{self._store.host}:{self._store.port}",
+                "PADDLE_ELASTIC_ATTEMPT": str(self.attempt),
+            })
+            self._procs.append(subprocess.Popen(
+                self.cmd, env=env,
+                stdout=None if env.get("PADDLE_ELASTIC_VERBOSE")
+                else subprocess.DEVNULL,
+                stderr=subprocess.STDOUT if env.get(
+                    "PADDLE_ELASTIC_VERBOSE") else subprocess.DEVNULL))
+
+    def _kill_all(self):
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 5.0
+        for p in self._procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except Exception:
+                p.kill()
+                try:
+                    p.wait(timeout=2)      # reap: no zombies per restart
+                except Exception:
+                    pass
+        self._procs = []
+
+    def _stale_workers(self):
+        """LIVE ranks whose attempt-scoped heartbeat is stale. A rank
+        that never beat (key missing) is NOT stale — workers may still
+        be importing; staleness needs a beat that then stopped. Ranks
+        whose process already EXITED are skipped: a clean exit-0 rank
+        naturally stops beating while slower peers finish (nonzero exits
+        are caught by the exit-code check, not here)."""
+        now = time.time()
+        stale = []
+        for r in range(self.world_size):
+            if r < len(self._procs) and self._procs[r].poll() is not None:
+                continue
+            key = f"a{self.attempt}/hb/{r}"
+            try:
+                if not self._store.check(key):
+                    continue
+                t = float(self._store.get(key).decode())
+            except Exception:
+                continue
+            if now - t > self.grace:
+                stale.append(r)
+        return stale
+
+    # -- the watch/relaunch loop ------------------------------------------
+    def run(self) -> int:
+        """Supervise until every worker exits 0. Returns the number of
+        relaunches performed. Raises RuntimeError when max_restarts is
+        exhausted."""
+        self._spawn_all()
+        try:
+            while True:
+                time.sleep(self.poll)
+                codes = [p.poll() for p in self._procs]
+                if all(c == 0 for c in codes):
+                    return self.restarts
+                failed = [i for i, c in enumerate(codes)
+                          if c is not None and c != 0]
+                hung = self._stale_workers()
+                if not failed and not hung:
+                    continue
+                self._kill_all()
+                self.restarts += 1
+                if self.restarts > self.max_restarts:
+                    raise RuntimeError(
+                        f"elastic job failed: rank(s) "
+                        f"{sorted(set(failed) | set(hung))} "
+                        f"{'exited nonzero' if failed else 'stopped heartbeating'}"
+                        f" and max_restarts={self.max_restarts} exhausted")
+                self.attempt += 1
+                self._spawn_all()
+        finally:
+            self._kill_all()
+
+    def close(self):
+        self._kill_all()
+        try:
+            self._store.close()
+        except Exception:
+            pass
 
 
 class StoreHeartbeat:

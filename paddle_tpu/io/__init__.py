@@ -300,6 +300,9 @@ class DataLoader:
         self.prefetch_factor = max(2, prefetch_factor)
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        self.persistent_workers = bool(persistent_workers) and \
+            num_workers > 0
+        self._pool = None
         self.iterable_mode = isinstance(dataset, IterableDataset)
         if self.iterable_mode:
             self.batch_sampler = None
@@ -341,7 +344,17 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._batches()
             return
+        if self.persistent_workers:
+            if self._pool is None:
+                self._pool = _PersistentPool(self)
+            yield from self._pool.epoch()
+            return
         yield from _MultiprocessIter(self)
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            pool.shutdown()
 
 
 # ---------------------------------------------------------------------------
@@ -364,7 +377,14 @@ def get_worker_info():
     """Inside a worker process: (id, num_workers, dataset); None in the
     main process (reference: io/dataloader/worker.py get_worker_info).
     IterableDatasets use it to shard their stream per worker."""
-    return _worker_info
+    if _worker_info is not None:
+        return _worker_info
+    # spawn-based persistent workers may import this module only when the
+    # dataset first calls get_worker_info — pick up their local stub
+    from paddle_tpu.io import _worker_main
+    if _worker_main._local_info is not None:
+        return WorkerInfo(*_worker_main._local_info)
+    return None
 
 
 class _WorkerError:
@@ -448,6 +468,147 @@ def _iterable_worker_loop(dataset, collate, batch_size, drop_last,
         result_q.put((wid, None))
     except Exception as e:                  # noqa: BLE001
         result_q.put((wid, _WorkerError(e)))
+
+
+class _PersistentPool:
+    """persistent_workers=True: SPAWNED numpy-only workers that survive
+    across epochs (reference: dataloader_iter.py:358 keeps its workers;
+    round-2 respawned per epoch and forked the JAX-loaded parent).
+
+    spawn, not fork: children boot a fresh python importing only
+    io/_worker_main (stdlib+numpy) plus whatever the dataset's pickle
+    needs — no copy of the parent's JAX runtime. The TPU-claiming
+    sitecustomize is disarmed for the children by scrubbing the axon env
+    around Process.start(). Epoch-tagged results make early-broken
+    epochs safe without a flush handshake: stale (epoch', ...) results
+    are discarded on the next epoch.
+
+    Spawn requires dataset/collate_fn/worker_init_fn to be picklable —
+    a clear error names the offender otherwise."""
+
+    def __init__(self, loader: "DataLoader"):
+        import multiprocessing as mp
+        from paddle_tpu.io import _worker_main as wm
+        self.loader = loader
+        self.W = loader.num_workers
+        self.timeout = loader.timeout or None
+        self.epoch_id = -1
+        self.ctx = mp.get_context("spawn")
+        self.result_q = self.ctx.Queue()
+        collate = (loader.collate_fn
+                   if loader.collate_fn is not default_collate_fn
+                   else None)             # None = worker-side np collate
+        self.workers = []
+        self.index_qs = []
+        import os
+        saved = {k: os.environ.pop(k, None)
+                 for k in ("PALLAS_AXON_POOL_IPS",)}
+        saved_jp = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for w in range(self.W):
+                q = self.ctx.Queue()
+                if loader.iterable_mode:
+                    args = (loader.dataset, collate, loader.batch_size,
+                            loader.drop_last, q, self.result_q, w,
+                            self.W, loader.worker_init_fn)
+                    target = wm.persistent_iterable_worker
+                else:
+                    args = (loader.dataset, collate, q, self.result_q,
+                            w, self.W, loader.worker_init_fn)
+                    target = wm.persistent_map_worker
+                p = self.ctx.Process(target=target, args=args,
+                                     daemon=True)
+                try:
+                    p.start()
+                except Exception as e:
+                    self.shutdown()   # reap workers already started
+                    raise RuntimeError(
+                        "persistent_workers=True spawns fresh workers: "
+                        "dataset/collate_fn/worker_init_fn must be "
+                        f"picklable ({e})") from e
+                self.index_qs.append(q)
+                self.workers.append(p)
+        finally:
+            for k, v in saved.items():
+                if v is not None:
+                    os.environ[k] = v
+            if saved_jp is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = saved_jp
+
+    def _get(self):
+        from paddle_tpu.io import _worker_main as wm
+        while True:
+            item = self.result_q.get(timeout=self.timeout)
+            if item[0] != self.epoch_id:
+                continue                   # stale: early-broken epoch
+            if isinstance(item[2], wm._WorkerFailure):
+                self.shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker failed:\n{item[2].msg}")
+            return item
+
+    def epoch(self):
+        self.epoch_id += 1
+        if self.loader.iterable_mode:
+            yield from self._epoch_iterable()
+        else:
+            yield from self._epoch_map()
+
+    def _epoch_map(self):
+        ld = self.loader
+        e = self.epoch_id
+        if ld.batch_sampler is not None:
+            all_batches = list(ld.batch_sampler)
+        else:
+            all_batches = [[i] for i in range(len(ld.dataset))]
+        n = len(all_batches)
+        ahead = self.W * ld.prefetch_factor
+        dispatched = 0
+        buf = {}
+        for b in range(min(ahead, n)):
+            self.index_qs[b % self.W].put(("job", e, b, all_batches[b]))
+            dispatched += 1
+        for want in range(n):
+            while want not in buf:
+                _, bidx, data = self._get()
+                buf[bidx] = data
+            if dispatched < n:
+                self.index_qs[dispatched % self.W].put(
+                    ("job", e, dispatched, all_batches[dispatched]))
+                dispatched += 1
+            yield _tensorize(buf.pop(want))
+
+    def _epoch_iterable(self):
+        e = self.epoch_id
+        for q in self.index_qs:
+            q.put(("epoch", e))
+        live = set(range(self.W))
+        while live:
+            _, wid, data = self._get()
+            if data is None:
+                live.discard(wid)
+            else:
+                yield _tensorize(data)
+
+    def shutdown(self):
+        for q in self.index_qs:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for p in self.workers:
+            p.join(timeout=2)
+            if p.is_alive():
+                p.terminate()
+        self.workers = []
+        self.index_qs = []
+        # detach from the loader so the NEXT iteration spawns a fresh
+        # pool instead of dispatching into a dead one (IndexError/hang)
+        if getattr(self.loader, "_pool", None) is self:
+            self.loader._pool = None
 
 
 class _MultiprocessIter:

@@ -1,0 +1,116 @@
+"""Persistent DataLoader worker entrypoints (spawn context).
+
+Deliberately imports ONLY stdlib + numpy at module level: spawn children
+unpickle their target from this module, so keeping paddle_tpu/jax out of
+the import graph keeps worker startup to a python+numpy boot (the whole
+point of persistent_workers — the reference's workers likewise persist,
+io/dataloader/dataloader_iter.py:358). A dataset whose pickle references
+paddle_tpu types will still pull the package in; numpy-pure datasets
+stay light.
+
+Protocol (epoch-tagged so early-broken epochs need no flush handshake):
+  map-style:   command ("job", epoch, bidx, idxs) -> result
+               (epoch, bidx, batch | _WorkerFailure); None = shutdown.
+  iterable:    command ("epoch", e) -> stream of (e, wid, batch),
+               terminated by (e, wid, None); None = shutdown.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+
+class _WorkerFailure:
+    def __init__(self, exc):
+        import traceback
+        self.msg = "".join(traceback.format_exception(exc))
+
+
+def _np_collate(batch):
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(_np_collate(list(f)) for f in transposed)
+    if isinstance(sample, dict):
+        return {k: _np_collate([d[k] for d in batch]) for k in sample}
+    # paddle Tensors (lazy import: only when the dataset yields them)
+    t = type(sample).__name__
+    if t == "Tensor":
+        return np.stack([np.asarray(s._value) for s in batch])
+    return batch
+
+
+def _denumpy(tree):
+    """Strip any Tensor leaves a custom collate produced (workers ship
+    numpy over the pipe; the parent re-tensorizes)."""
+    t = type(tree).__name__
+    if t == "Tensor":
+        return np.asarray(tree._value)
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_denumpy(x) for x in tree)
+    if isinstance(tree, dict):
+        return {k: _denumpy(v) for k, v in tree.items()}
+    return tree
+
+
+_local_info = None     # paddle_tpu.io.get_worker_info's spawn fallback
+
+
+def _set_worker_info(wid, nworkers, dataset):
+    # publish locally ALWAYS (paddle_tpu.io.get_worker_info consults this
+    # when it gets imported later, e.g. by a numpy-pure dataset whose
+    # __iter__ calls it mid-stream), and through paddle_tpu.io when that
+    # is already imported (dataset pickle pulled it in)
+    global _local_info
+    import sys
+    _local_info = (wid, nworkers, dataset)
+    io_mod = sys.modules.get("paddle_tpu.io")
+    if io_mod is not None:
+        io_mod._worker_info = io_mod.WorkerInfo(wid, nworkers, dataset)
+
+
+def persistent_map_worker(dataset, collate, index_q, result_q, wid,
+                          nworkers, init_fn):
+    _set_worker_info(wid, nworkers, dataset)
+    if init_fn is not None:
+        init_fn(wid)
+    collate = collate or _np_collate
+    while True:
+        job = index_q.get()
+        if job is None:
+            return
+        _, epoch, bidx, idxs = job
+        try:
+            batch = _denumpy(collate([dataset[i] for i in idxs]))
+            result_q.put((epoch, bidx, batch))
+        except Exception as e:              # noqa: BLE001
+            result_q.put((epoch, bidx, _WorkerFailure(e)))
+
+
+def persistent_iterable_worker(dataset, collate, batch_size, drop_last,
+                               command_q, result_q, wid, nworkers,
+                               init_fn):
+    _set_worker_info(wid, nworkers, dataset)
+    if init_fn is not None:
+        init_fn(wid)
+    collate = collate or _np_collate
+    while True:
+        cmd = command_q.get()
+        if cmd is None:
+            return
+        _, epoch = cmd
+        try:
+            it = iter(dataset)
+            while True:
+                batch = list(itertools.islice(it, batch_size))
+                if not batch or (len(batch) < batch_size and drop_last):
+                    break
+                result_q.put((epoch, wid, _denumpy(collate(batch))))
+            result_q.put((epoch, wid, None))
+        except Exception as e:              # noqa: BLE001
+            result_q.put((epoch, wid, _WorkerFailure(e)))

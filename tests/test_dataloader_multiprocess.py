@@ -124,3 +124,152 @@ def test_worker_init_fn_runs():
                     worker_init_fn=init)
     list(dl)
     assert counter.value == 2
+
+
+class ShardedStream(IterableDataset):
+    """Picklable iterable dataset sharded via get_worker_info (spawn
+    children resolve it through the _worker_main fallback)."""
+
+    def __init__(self, n=32):
+        self.n = n
+
+    def __iter__(self):
+        info = get_worker_info()
+        wid = info.id if info else 0
+        nw = info.num_workers if info else 1
+        for i in range(wid, self.n, nw):
+            yield np.full((2,), float(i), "float32")
+
+
+def _init_fn(wid):
+    import os
+    os.environ["PT_TEST_WID"] = str(wid)
+
+
+def test_persistent_workers_match_inline_across_epochs():
+    """persistent_workers=True: spawned workers survive epochs and keep
+    producing correct, ordered batches."""
+    ds = Indexed(24)
+    inline = [b.numpy() for b in DataLoader(ds, batch_size=4)]
+    dl = DataLoader(ds, batch_size=4, num_workers=2,
+                    persistent_workers=True)
+    try:
+        for _ in range(3):                     # three epochs, same pool
+            got = [b.numpy() for b in dl]
+            assert len(got) == len(inline)
+            for a, b in zip(got, inline):
+                np.testing.assert_array_equal(a, b)
+        assert len(dl._pool.workers) == 2
+        assert all(p.is_alive() for p in dl._pool.workers)
+    finally:
+        dl._pool.shutdown()
+
+
+def test_persistent_epoch2_startup_is_free():
+    """VERDICT r2 item 9 criterion: epoch-2 startup cost ~0 — the spawn
+    boot is paid once, later epochs reuse the live workers."""
+    ds = Indexed(16)
+    dl = DataLoader(ds, batch_size=4, num_workers=2,
+                    persistent_workers=True)
+    try:
+        t0 = time.perf_counter()
+        it = iter(dl)
+        next(it)
+        first_epoch_startup = time.perf_counter() - t0
+        list(it)                               # drain epoch 1
+        t0 = time.perf_counter()
+        it2 = iter(dl)
+        next(it2)
+        second_epoch_startup = time.perf_counter() - t0
+        list(it2)
+        # spawn boot is O(seconds); a live-pool dispatch is O(ms)
+        assert second_epoch_startup < 0.5, second_epoch_startup
+        assert second_epoch_startup < first_epoch_startup / 3, (
+            first_epoch_startup, second_epoch_startup)
+    finally:
+        dl._pool.shutdown()
+
+
+def test_persistent_early_break_then_clean_epoch():
+    """Breaking out mid-epoch must not poison the next epoch (stale
+    epoch-tagged results are discarded)."""
+    ds = Indexed(32)
+    dl = DataLoader(ds, batch_size=4, num_workers=2,
+                    persistent_workers=True)
+    try:
+        it = iter(dl)
+        next(it)
+        next(it)                               # abandon mid-epoch
+        del it
+        inline = [b.numpy() for b in DataLoader(ds, batch_size=4)]
+        got = [b.numpy() for b in dl]
+        assert len(got) == len(inline)
+        for a, b in zip(got, inline):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        dl._pool.shutdown()
+
+
+def test_persistent_iterable_sharding_across_epochs():
+    ds = ShardedStream(24)
+    dl = DataLoader(ds, batch_size=4, num_workers=2,
+                    persistent_workers=True)
+    try:
+        for _ in range(2):
+            seen = np.sort(np.concatenate(
+                [b.numpy().ravel() for b in dl]))
+            np.testing.assert_array_equal(
+                seen, np.repeat(np.arange(24, dtype="float32"), 2))
+    finally:
+        dl._pool.shutdown()
+
+
+def test_persistent_worker_init_fn_and_unpicklable_error():
+    ds = Indexed(8)
+    dl = DataLoader(ds, batch_size=4, num_workers=1,
+                    persistent_workers=True, worker_init_fn=_init_fn)
+    try:
+        assert len([b for b in dl]) == 2
+    finally:
+        dl._pool.shutdown()
+
+    bad = DataLoader(ds, batch_size=4, num_workers=1,
+                     persistent_workers=True,
+                     worker_init_fn=lambda w: None)   # unpicklable
+    with pytest.raises(RuntimeError, match="picklable"):
+        iter(bad).__next__()
+
+
+class FlagFailing(Dataset):
+    """Fails while the flag file exists — lets a test exercise worker
+    failure and then recovery in a fresh pool."""
+
+    def __init__(self, flag):
+        self.flag = flag
+
+    def __getitem__(self, i):
+        import os
+        if i == 5 and os.path.exists(self.flag):
+            raise ValueError("transient failure")
+        return np.float32(i)
+
+    def __len__(self):
+        return 12
+
+
+def test_persistent_pool_recovers_after_worker_error(tmp_path):
+    """A worker error kills the pool with a clear RuntimeError; the NEXT
+    iteration spawns a fresh pool instead of dispatching into the dead
+    one."""
+    flag = str(tmp_path / "fail")
+    open(flag, "w").close()
+    dl = DataLoader(FlagFailing(flag), batch_size=2, num_workers=2,
+                    persistent_workers=True)
+    with pytest.raises(RuntimeError, match="worker failed"):
+        list(dl)
+    assert dl._pool is None            # dead pool detached
+    import os
+    os.remove(flag)
+    got = [float(b.numpy()[0]) for b in dl]
+    assert got == [0.0, 2.0, 4.0, 6.0, 8.0, 10.0]
+    dl._pool.shutdown()

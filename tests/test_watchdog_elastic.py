@@ -109,3 +109,154 @@ def test_store_barrier_timeout_diagnostic():
             store.barrier("lonely", rank=0, world_size=2, timeout=1.0)
     finally:
         store.close()
+
+
+_ELASTIC_WORKER = r'''
+import json
+import os
+import sys
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.tensor as T
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.elastic import ElasticManager, StoreHeartbeat
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+attempt = int(os.environ["PADDLE_ELASTIC_ATTEMPT"])
+ckdir, kill_at, total = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+store = TCPStore(host, int(port), world_size=world, prefix=f"a{attempt}/")
+hb = StoreHeartbeat(store, rank, world, interval=0.3)
+hb.start()
+
+paddle.seed(0)
+net = nn.Linear(8, 1)
+opt_ = paddle.optimizer.SGD(learning_rate=0.05,
+                            parameters=net.parameters())
+rng = np.random.RandomState(0)
+X = rng.randn(64, 8).astype("float32")
+Y = X @ rng.randn(8, 1).astype("float32")
+
+
+def save_fn(step):
+    if rank == 0:
+        paddle.save(net.state_dict(), os.path.join(ckdir, "model.pd"))
+
+
+mgr = ElasticManager(save_fn=save_fn, checkpoint_dir=ckdir)
+start = mgr.last_step() + 1
+if start > 0:
+    net.set_state_dict(paddle.load(os.path.join(ckdir, "model.pd")))
+
+for step in range(start, total):
+    store.barrier(f"step{step}", rank, world, timeout=60)
+    loss = T.mean((net(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2)
+    loss.backward()
+    opt_.step()
+    opt_.clear_grad()
+    if rank == 0:
+        with open(os.path.join(ckdir, "losses.jsonl"), "a") as f:
+            f.write(json.dumps({"step": step, "loss": float(loss)}) + "\n")
+    if rank == 1 and attempt == 0 and step == kill_at:
+        os._exit(17)                       # simulated preemption
+    mgr.checkpoint(step)
+hb.stop()
+os._exit(0)       # skip interpreter teardown: native store/jax threads
+                  # abort on exit in this environment (harmless, but the
+                  # supervisor must see rc 0)
+'''
+
+
+def test_supervisor_relaunches_dead_rank_and_completes(tmp_path):
+    """VERDICT r2 item 8 criterion: the supervisor detects a rank dying
+    mid-training, relaunches the whole job with rewritten env, and the
+    job completes from the last checkpoint with EXACTLY the loss curve
+    an uninterrupted run produces (SGD + fixed seed = deterministic
+    replay)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from paddle_tpu.distributed.elastic import ElasticSupervisor
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_ELASTIC_WORKER)
+    total, kill_at = 8, 4
+
+    # uninterrupted reference run (single rank, fresh dir)
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    import paddle_tpu
+    repo = os.path.dirname(os.path.dirname(
+        os.path.abspath(paddle_tpu.__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({"JAX_PLATFORMS": "cpu", "PADDLE_TRAINER_ID": "0",
+                "PADDLE_TRAINERS_NUM": "1",
+                "PADDLE_ELASTIC_ATTEMPT": "0", "PYTHONPATH": repo})
+    from paddle_tpu.distributed.store import TCPStore
+    ref_store = TCPStore(is_master=True, world_size=1)
+    env["PADDLE_MASTER"] = f"{ref_store.host}:{ref_store.port}"
+    subprocess.run([sys.executable, str(worker), str(ref_dir), "-1",
+                    str(total)], env=env, check=True, timeout=300)
+    ref = {}
+    with open(ref_dir / "losses.jsonl") as f:
+        for line in f:
+            d = json.loads(line)
+            ref[d["step"]] = d["loss"]
+
+    # supervised 2-rank run; rank 1 dies at step 4 on attempt 0
+    job_dir = tmp_path / "job"
+    job_dir.mkdir()
+    sup_env = dict(os.environ)
+    sup_env.pop("PALLAS_AXON_POOL_IPS", None)
+    sup_env["JAX_PLATFORMS"] = "cpu"
+    sup_env["PYTHONPATH"] = repo
+    sup = ElasticSupervisor(
+        [sys.executable, str(worker), str(job_dir), str(kill_at),
+         str(total)],
+        world_size=2, env=sup_env, max_restarts=2, poll_interval=0.3)
+    try:
+        restarts = sup.run()
+    finally:
+        sup.close()
+    assert restarts == 1, restarts
+
+    got = {}
+    with open(job_dir / "losses.jsonl") as f:
+        for line in f:
+            d = json.loads(line)
+            got[d["step"]] = d["loss"]     # resumed steps: last wins
+    assert sorted(got) == list(range(total))
+    for s in range(total):
+        assert abs(got[s] - ref[s]) < 1e-6, (s, got[s], ref[s])
+    # the curve itself is a real training curve
+    assert got[total - 1] < got[0] * 0.9
+
+
+def test_supervisor_exhausts_restarts(tmp_path):
+    """A worker that always fails must exhaust max_restarts and raise
+    with the failing rank named."""
+    import subprocess
+    import sys
+
+    from paddle_tpu.distributed.elastic import ElasticSupervisor
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys; sys.exit(3)\n")
+    sup = ElasticSupervisor([sys.executable, str(bad)], world_size=2,
+                            max_restarts=1, poll_interval=0.2)
+    try:
+        with pytest.raises(RuntimeError, match="max_restarts"):
+            sup.run()
+        assert sup.restarts == 2
+    finally:
+        sup.close()
