@@ -150,15 +150,18 @@ class ElasticSupervisor:
     up after `max_restarts` relaunches."""
 
     def __init__(self, cmd, world_size, env=None, max_restarts=3,
-                 heartbeat_grace=15.0, poll_interval=0.5):
+                 heartbeat_grace=15.0, poll_interval=0.5,
+                 startup_grace=120.0):
         self.cmd = list(cmd)
         self.world_size = world_size
         self.env = dict(env) if env is not None else dict(os.environ)
         self.max_restarts = max_restarts
         self.grace = heartbeat_grace
+        self.startup_grace = startup_grace
         self.poll = poll_interval
         self.attempt = 0
         self.restarts = 0
+        self._spawn_time = 0.0
         from paddle_tpu.distributed.store import TCPStore
         self._store = TCPStore(is_master=True, world_size=world_size)
         self._procs: list = []
@@ -167,6 +170,7 @@ class ElasticSupervisor:
     def _spawn_all(self):
         import subprocess
         self._procs = []
+        self._spawn_time = time.time()
         for rank in range(self.world_size):
             env = dict(self.env)
             env.update({
@@ -214,6 +218,11 @@ class ElasticSupervisor:
             key = f"a{self.attempt}/hb/{r}"
             try:
                 if not self._store.check(key):
+                    # never beat: importing is fine for a while, but a
+                    # rank wedged BEFORE its first beat (import deadlock,
+                    # rendezvous hang) would otherwise never be detected
+                    if now - self._spawn_time > self.startup_grace:
+                        stale.append(r)
                     continue
                 t = float(self._store.get(key).decode())
             except Exception:

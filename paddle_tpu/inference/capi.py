@@ -1,0 +1,271 @@
+"""C API over the Predictor (reference: paddle/fluid/inference/capi_exp/
+pd_inference_api.h — the C surface deployments link against when they
+cannot use C++/Python directly).
+
+TPU-native shape: the runtime IS Python/XLA, so the C shim embeds the
+CPython interpreter (Py_Initialize when standalone; no-op when loaded
+into an existing Python process) and drives
+`paddle_tpu.inference._capi_run` through the stable C API — no pybind11,
+no numpy C API; tensors cross the boundary as raw buffers + shape/dtype
+descriptors, exactly like the reference's PD_Tensor.
+
+`build(out_dir)` compiles the shim with g++ against this interpreter's
+headers and returns the .so path; `header_path()` writes the
+ctypes-consumable header next to it. See tests/test_inference_capi.py
+for the end-to-end drive (build -> ctypes load -> create/run/read)."""
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+import numpy as np
+
+__all__ = ["build", "header_path", "HEADER", "C_SOURCE"]
+
+# dtype codes shared with the C side
+_DTYPES = {0: "float32", 1: "int32", 2: "int64", 3: "float16"}
+_CODES = {v: k for k, v in _DTYPES.items()}
+
+HEADER = """\
+/* paddle_tpu inference C API (reference: pd_inference_api.h).
+ * All functions return 0 on success, -1 on error (PT_LastError has the
+ * message). dtype codes: 0=float32 1=int32 2=int64 3=float16.
+ * Output buffers are owned by the predictor and stay valid until the
+ * next PT_PredictorRun or PT_PredictorDestroy. */
+#ifndef PT_INFERENCE_H
+#define PT_INFERENCE_H
+#include <stdint.h>
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* PT_Predictor;
+
+PT_Predictor PT_PredictorCreate(const char* model_path_prefix);
+void PT_PredictorDestroy(PT_Predictor p);
+int PT_PredictorNumInputs(PT_Predictor p);
+
+/* inputs: n_in buffers; shapes flattened back-to-back, in_ndims[i] dims
+ * each. Returns the number of outputs, or -1. */
+int PT_PredictorRun(PT_Predictor p, const void** in_data,
+                    const int64_t* in_shapes, const int* in_ndims,
+                    const int* in_dtypes, int n_in);
+
+/* read output i after a successful Run; *shape must hold >= 8 dims */
+int PT_PredictorOutput(PT_Predictor p, int i, const void** data,
+                       int64_t* shape, int* ndim, int* dtype);
+
+const char* PT_LastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif
+"""
+
+C_SOURCE = r"""
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+static char pt_err[4096];
+
+static void set_err_from_py(void) {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyObject* s = value ? PyObject_Str(value) : NULL;
+  const char* msg = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+  snprintf(pt_err, sizeof(pt_err), "%s", msg ? msg : "unknown");
+  Py_XDECREF(s);
+  Py_XDECREF(type); Py_XDECREF(value); Py_XDECREF(tb);
+}
+
+const char* PT_LastError(void) { return pt_err; }
+
+/* holder: python list [predictor, last_result_or_None] */
+
+void* PT_PredictorCreate(const char* path) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    /* release the GIL the initializing thread holds, else every PT_*
+     * call from ANY OTHER thread deadlocks in PyGILState_Ensure */
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  void* out = NULL;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference.capi");
+  if (!mod) { set_err_from_py(); goto done; }
+  {
+    PyObject* holder = PyObject_CallMethod(mod, "_capi_create", "s", path);
+    Py_DECREF(mod);
+    if (!holder) { set_err_from_py(); goto done; }
+    out = (void*)holder;            /* owned reference */
+  }
+done:
+  PyGILState_Release(g);
+  return out;
+}
+
+void PT_PredictorDestroy(void* p) {
+  if (!p) return;
+  PyGILState_STATE g = PyGILState_Ensure();
+  Py_DECREF((PyObject*)p);
+  PyGILState_Release(g);
+}
+
+int PT_PredictorNumInputs(void* p) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  int n = -1;
+  PyObject* pred = PyList_GetItem((PyObject*)p, 0);     /* borrowed */
+  PyObject* names = pred ? PyObject_CallMethod(pred, "get_input_names",
+                                               NULL) : NULL;
+  if (names) { n = (int)PyList_Size(names); Py_DECREF(names); }
+  else set_err_from_py();
+  PyGILState_Release(g);
+  return n;
+}
+
+int PT_PredictorRun(void* p, const void** in_data,
+                    const int64_t* in_shapes, const int* in_ndims,
+                    const int* in_dtypes, int n_in) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  int rc = -1;
+  size_t item[4] = {4, 4, 8, 2};    /* bytes per dtype code */
+  PyObject* ins = PyList_New(n_in);
+  const int64_t* sp = in_shapes;
+  for (int i = 0; i < n_in; i++) {
+    int nd = in_ndims[i];
+    int64_t elems = 1;
+    PyObject* shape = PyTuple_New(nd);
+    for (int d = 0; d < nd; d++) {
+      elems *= sp[d];
+      PyTuple_SetItem(shape, d, PyLong_FromLongLong(sp[d]));
+    }
+    sp += nd;
+    PyObject* buf = PyBytes_FromStringAndSize(
+        (const char*)in_data[i], (Py_ssize_t)(elems * item[in_dtypes[i]]));
+    PyObject* t = PyTuple_Pack(3, buf, shape,
+                               PyLong_FromLong(in_dtypes[i]));
+    Py_DECREF(buf); Py_DECREF(shape);
+    PyList_SetItem(ins, i, t);      /* steals t */
+  }
+  {
+    PyObject* mod = PyImport_ImportModule("paddle_tpu.inference.capi");
+    PyObject* res = mod ? PyObject_CallMethod(mod, "_capi_run", "OO",
+                                              (PyObject*)p, ins) : NULL;
+    Py_XDECREF(mod);
+    Py_DECREF(ins);
+    if (!res) { set_err_from_py(); goto done; }
+    /* stash result on the holder; outputs stay alive until next Run */
+    PyList_SetItem((PyObject*)p, 1, res);   /* steals res */
+    rc = (int)PyList_Size(res);
+  }
+done:
+  PyGILState_Release(g);
+  return rc;
+}
+
+int PT_PredictorOutput(void* p, int i, const void** data, int64_t* shape,
+                       int* ndim, int* dtype) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* res = PyList_GetItem((PyObject*)p, 1);      /* borrowed */
+  if (!res || res == Py_None || i >= PyList_Size(res)) {
+    snprintf(pt_err, sizeof(pt_err), "no output %d (run first)", i);
+    goto done;
+  }
+  {
+    PyObject* t = PyList_GetItem(res, i);               /* borrowed */
+    PyObject* buf = PyTuple_GetItem(t, 0);
+    PyObject* shp = PyTuple_GetItem(t, 1);
+    int nd = (int)PyTuple_Size(shp);
+    if (nd > 8) {      /* contract: caller's shape buffer holds 8 dims */
+      snprintf(pt_err, sizeof(pt_err),
+               "output %d has ndim=%d > 8 (unsupported by the C API)",
+               i, nd);
+      goto done;
+    }
+    *data = (const void*)PyBytes_AsString(buf);
+    *ndim = nd;
+    for (int d = 0; d < nd; d++)
+      shape[d] = PyLong_AsLongLong(PyTuple_GetItem(shp, d));
+    *dtype = (int)PyLong_AsLong(PyTuple_GetItem(t, 2));
+    rc = 0;
+  }
+done:
+  PyGILState_Release(g);
+  return rc;
+}
+"""
+
+
+# -- python-side glue the C shim calls --------------------------------------
+
+def _capi_create(path_prefix):
+    """Returns the holder list [predictor, last_result]."""
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(path_prefix + ".pdmodel",
+                                   path_prefix + ".pdiparams"))
+    return [pred, None]
+
+
+def _capi_run(holder, inputs):
+    """inputs: [(bytes, shape tuple, dtype code)]; returns outputs in the
+    same format."""
+    pred = holder[0]
+    arrs = [np.frombuffer(buf, dtype=_DTYPES[code]).reshape(shape)
+            for buf, shape, code in inputs]
+    outs = pred.run(arrs)
+    result = []
+    for o in outs:
+        o = np.ascontiguousarray(o)
+        name = o.dtype.name
+        if name not in _CODES:          # e.g. bf16 logits -> f32 buffers
+            o = o.astype("float32")
+            name = "float32"
+        result.append((o.tobytes(), tuple(int(d) for d in o.shape),
+                       _CODES[name]))
+    return result
+
+
+# -- builder -----------------------------------------------------------------
+
+def header_path(out_dir=None):
+    d = out_dir or _default_dir()
+    os.makedirs(d, exist_ok=True)
+    p = os.path.join(d, "pt_inference.h")
+    with open(p, "w") as f:
+        f.write(HEADER)
+    return p
+
+
+def _default_dir():
+    return os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                        "capi")
+
+
+def build(out_dir=None):
+    """Compile the C shim against this interpreter; returns the .so
+    path. The library resolves CPython symbols from the hosting process
+    when ctypes-loaded into Python, and links libpython for standalone
+    embedding."""
+    d = out_dir or _default_dir()
+    os.makedirs(d, exist_ok=True)
+    src = os.path.join(d, "pt_inference.c")
+    with open(src, "w") as f:
+        f.write(C_SOURCE)
+    header_path(d)
+    so = os.path.join(d, "libpt_inference.so")
+    inc = sysconfig.get_paths()["include"]
+    cmd = ["gcc", "-shared", "-fPIC", "-O2", src, "-I", inc, "-o", so]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_python_version()
+    if libdir and os.path.isdir(libdir):
+        cmd += [f"-L{libdir}", f"-lpython{ver}",
+                f"-Wl,-rpath,{libdir}"]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"C API build failed:\n{proc.stderr}")
+    return so

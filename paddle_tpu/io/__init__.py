@@ -551,11 +551,26 @@ class _PersistentPool:
             return item
 
     def epoch(self):
+        """One epoch generator. Like the reference's persistent loader,
+        creating a new iterator INVALIDATES the previous one (both share
+        the live worker pool; epoch-tagged results keep exactly one
+        consumer unambiguous) — a stale iterator raises instead of
+        silently stealing the new epoch's batches."""
         self.epoch_id += 1
+        e = self.epoch_id
         if self.loader.iterable_mode:
-            yield from self._epoch_iterable()
+            inner = self._epoch_iterable()
         else:
-            yield from self._epoch_map()
+            inner = self._epoch_map()
+        for item in inner:
+            if self.epoch_id != e:
+                raise RuntimeError(
+                    "this DataLoader iterator was invalidated: a newer "
+                    "iterator was created on the same persistent_workers "
+                    "loader (persistent pools support one active epoch; "
+                    "use persistent_workers=False for concurrent "
+                    "iterators)")
+            yield item
 
     def _epoch_map(self):
         ld = self.loader

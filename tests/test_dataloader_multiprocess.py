@@ -273,3 +273,22 @@ def test_persistent_pool_recovers_after_worker_error(tmp_path):
     got = [float(b.numpy()[0]) for b in dl]
     assert got == [0.0, 2.0, 4.0, 6.0, 8.0, 10.0]
     dl._pool.shutdown()
+
+
+def test_persistent_new_iterator_invalidates_old():
+    """A second iterator on a persistent loader takes over the pool; the
+    stale iterator raises instead of silently stealing batches."""
+    ds = Indexed(16)
+    dl = DataLoader(ds, batch_size=4, num_workers=2,
+                    persistent_workers=True)
+    try:
+        it1 = iter(dl)
+        next(it1)
+        it2 = iter(dl)
+        next(it2)
+        with pytest.raises(RuntimeError, match="invalidated"):
+            next(it1)
+        rest = [b.numpy() for b in it2]
+        assert len(rest) == 3
+    finally:
+        dl._pool.shutdown()
