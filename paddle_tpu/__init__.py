@@ -68,6 +68,7 @@ from paddle_tpu import io  # noqa: F401
 from paddle_tpu import jit  # noqa: F401
 from paddle_tpu import metric  # noqa: F401
 from paddle_tpu import device  # noqa: F401
+from paddle_tpu import strings  # noqa: F401
 from paddle_tpu.framework.io_utils import save, load  # noqa: F401
 from paddle_tpu.jit.api import to_static  # noqa: F401
 from paddle_tpu.device import (  # noqa: F401

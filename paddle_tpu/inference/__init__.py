@@ -36,20 +36,29 @@ def _default_exec_cache():
                         "xla_cache")
 
 
-def _enable_exec_cache(cache_dir):
+# The compilation cache is PROCESS-global jax state; track what was
+# applied so an explicit choice is never silently overridden by another
+# predictor's ambient default (last-writer-wins would misroute caches).
+_exec_cache_applied = {"dir": None, "explicit": False}
+
+
+def _enable_exec_cache(cache_dir, explicit):
     """Point JAX's persistent compilation cache at `cache_dir`. The
-    size/compile-time persistence thresholds are zeroed ONLY when the
-    user explicitly asked for the cache (PADDLE_TPU_EXEC_CACHE_DIR /
-    enable_executable_cache) — the ambient default keeps jax's
-    thresholds so trivial executables from unrelated jits in the same
-    process aren't all serialized to disk as a construction side
-    effect."""
+    size/compile-time persistence thresholds are zeroed ONLY on explicit
+    opt-in (PADDLE_TPU_EXEC_CACHE_DIR / enable_executable_cache) — the
+    ambient default keeps jax's thresholds so trivial executables from
+    unrelated jits in the same process aren't all serialized to disk as
+    a construction side effect. An ambient default never overrides a
+    previously applied explicit dir."""
     import os
 
     import jax
+    if not explicit and (_exec_cache_applied["explicit"]
+                         or _exec_cache_applied["dir"] == cache_dir):
+        return
     os.makedirs(cache_dir, exist_ok=True)
     updates = [("jax_compilation_cache_dir", cache_dir)]
-    if os.environ.get("PADDLE_TPU_EXEC_CACHE_DIR"):
+    if explicit:
         updates += [("jax_persistent_cache_min_compile_time_secs", 0),
                     ("jax_persistent_cache_min_entry_size_bytes", 0)]
     for key, val in updates:
@@ -57,6 +66,9 @@ def _enable_exec_cache(cache_dir):
             jax.config.update(key, val)
         except Exception:
             pass                      # knob not present in this jax
+    _exec_cache_applied.update(dir=cache_dir,
+                               explicit=explicit
+                               or _exec_cache_applied["explicit"])
 
 
 class PrecisionType:
@@ -92,6 +104,9 @@ class Config:
         self._device = None
         self._memory_optim = True
         self._exec_cache_dir = _default_exec_cache()
+        import os as _os
+        self._exec_cache_explicit = bool(
+            _os.environ.get("PADDLE_TPU_EXEC_CACHE_DIR"))
 
     def _set_path(self, prog_file):
         p = str(prog_file)
@@ -137,7 +152,11 @@ class Config:
         its analyzed program the same way). Default ON under
         ~/.cache/paddle_tpu/xla_cache; disable with
         PADDLE_TPU_EXEC_CACHE=0."""
-        self._exec_cache_dir = cache_dir or _default_exec_cache()
+        import os
+        self._exec_cache_dir = cache_dir or _default_exec_cache() or \
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "paddle_tpu", "xla_cache")
+        self._exec_cache_explicit = True
 
     def set_cpu_math_library_num_threads(self, n):
         return None
@@ -182,7 +201,8 @@ class Predictor:
         if cfg._path_prefix is None:
             raise ValueError("inference.Config has no model path")
         if cfg._exec_cache_dir:
-            _enable_exec_cache(cfg._exec_cache_dir)
+            _enable_exec_cache(cfg._exec_cache_dir,
+                               getattr(cfg, "_exec_cache_explicit", False))
         from paddle_tpu.jit import load as jit_load
         self._layer = jit_load(cfg._path_prefix)
         # in_tree is ((state, *inputs), {}) — count the positional inputs

@@ -132,6 +132,15 @@ int PT_PredictorRun(void* p, const void** in_data,
   PyGILState_STATE g = PyGILState_Ensure();
   int rc = -1;
   size_t item[4] = {4, 4, 8, 2};    /* bytes per dtype code */
+  for (int i = 0; i < n_in; i++) {
+    if (in_dtypes[i] < 0 || in_dtypes[i] > 3) {
+      snprintf(pt_err, sizeof(pt_err),
+               "input %d: unsupported dtype code %d (0..3)", i,
+               in_dtypes[i]);
+      PyGILState_Release(g);
+      return -1;
+    }
+  }
   PyObject* ins = PyList_New(n_in);
   const int64_t* sp = in_shapes;
   for (int i = 0; i < n_in; i++) {
@@ -171,7 +180,7 @@ int PT_PredictorOutput(void* p, int i, const void** data, int64_t* shape,
   PyGILState_STATE g = PyGILState_Ensure();
   int rc = -1;
   PyObject* res = PyList_GetItem((PyObject*)p, 1);      /* borrowed */
-  if (!res || res == Py_None || i >= PyList_Size(res)) {
+  if (!res || res == Py_None || i < 0 || i >= PyList_Size(res)) {
     snprintf(pt_err, sizeof(pt_err), "no output %d (run first)", i);
     goto done;
   }

@@ -470,6 +470,13 @@ def _iterable_worker_loop(dataset, collate, batch_size, drop_last,
         result_q.put((wid, _WorkerError(e)))
 
 
+_STALE_ITER_MSG = (
+    "this DataLoader iterator was invalidated: a newer iterator was "
+    "created on the same persistent_workers loader (persistent pools "
+    "support one active epoch; use persistent_workers=False for "
+    "concurrent iterators)")
+
+
 class _PersistentPool:
     """persistent_workers=True: SPAWNED numpy-only workers that survive
     across epochs (reference: dataloader_iter.py:358 keeps its workers;
@@ -500,6 +507,7 @@ class _PersistentPool:
                    else None)             # None = worker-side np collate
         self.workers = []
         self.index_qs = []
+        self._stash = []
         import os
         saved = {k: os.environ.pop(k, None)
                  for k in ("PALLAS_AXON_POOL_IPS",)}
@@ -538,12 +546,37 @@ class _PersistentPool:
             else:
                 os.environ["JAX_PLATFORMS"] = saved_jp
 
-    def _get(self):
+    def _get(self, e):
+        """Next result for epoch `e`. Checks invalidation BEFORE and
+        WHILE blocking (a stale iterator must raise, not steal or starve
+        the new epoch), discards results from dead epochs, and stashes
+        results from newer epochs for their own consumer."""
+        import queue as _q
+        import time as _time
         from paddle_tpu.io import _worker_main as wm
+        deadline = (None if self.timeout is None
+                    else _time.monotonic() + self.timeout)
         while True:
-            item = self.result_q.get(timeout=self.timeout)
-            if item[0] != self.epoch_id:
-                continue                   # stale: early-broken epoch
+            if self.epoch_id != e:
+                raise RuntimeError(_STALE_ITER_MSG)
+            item = None
+            for i, st in enumerate(self._stash):
+                if st[0] == e:
+                    item = self._stash.pop(i)
+                    break
+            if item is None:
+                try:
+                    item = self.result_q.get(timeout=0.1)
+                except _q.Empty:
+                    if deadline is not None and \
+                            _time.monotonic() > deadline:
+                        raise
+                    continue
+            if item[0] < e:
+                continue                   # dead epoch: discard
+            if item[0] > e:
+                self._stash.append(item)   # for the newer iterator
+                continue                   # -> invalidation check raises
             if isinstance(item[2], wm._WorkerFailure):
                 self.shutdown()
                 raise RuntimeError(
@@ -558,19 +591,11 @@ class _PersistentPool:
         silently stealing the new epoch's batches."""
         self.epoch_id += 1
         e = self.epoch_id
+        self._stash = [s for s in self._stash if s[0] >= e]
         if self.loader.iterable_mode:
-            inner = self._epoch_iterable()
+            yield from self._epoch_iterable()
         else:
-            inner = self._epoch_map()
-        for item in inner:
-            if self.epoch_id != e:
-                raise RuntimeError(
-                    "this DataLoader iterator was invalidated: a newer "
-                    "iterator was created on the same persistent_workers "
-                    "loader (persistent pools support one active epoch; "
-                    "use persistent_workers=False for concurrent "
-                    "iterators)")
-            yield item
+            yield from self._epoch_map()
 
     def _epoch_map(self):
         ld = self.loader
@@ -587,8 +612,10 @@ class _PersistentPool:
             self.index_qs[b % self.W].put(("job", e, b, all_batches[b]))
             dispatched += 1
         for want in range(n):
+            if self.epoch_id != e:
+                raise RuntimeError(_STALE_ITER_MSG)
             while want not in buf:
-                _, bidx, data = self._get()
+                _, bidx, data = self._get(e)
                 buf[bidx] = data
             if dispatched < n:
                 self.index_qs[dispatched % self.W].put(
@@ -602,7 +629,9 @@ class _PersistentPool:
             q.put(("epoch", e))
         live = set(range(self.W))
         while live:
-            _, wid, data = self._get()
+            if self.epoch_id != e:
+                raise RuntimeError(_STALE_ITER_MSG)
+            _, wid, data = self._get(e)
             if data is None:
                 live.discard(wid)
             else:

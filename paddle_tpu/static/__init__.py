@@ -76,6 +76,13 @@ class Program:
         return self._layer(*args)
 
     def clone(self, for_test=False):
+        if for_test and getattr(self._layer, "training", False):
+            import warnings
+            warnings.warn(
+                "Program.clone(for_test=True) on paddle_tpu does not "
+                "produce a pruned test program; call .eval() on the "
+                "underlying layer to switch dropout/batch-norm to "
+                "inference behavior")
         return self
 
     def global_block(self):
@@ -136,6 +143,12 @@ class Executor:
             return_numpy=True):
         prog = program or _main_program
         feed = feed or {}
+        if getattr(prog, "_layer", None) is None and not feed:
+            # the universal port pattern `exe.run(startup_program)`:
+            # parameter initialization already happened eagerly at layer
+            # construction, so running an empty program is a successful
+            # no-op (NOT an error)
+            return []
         names = getattr(prog, "_feed_names", None)
         if names and len(names) == len(feed) and all(n in feed
                                                      for n in names):
@@ -149,6 +162,16 @@ class Executor:
             args = [Tensor(np.asarray(v)) for v in feed.values()]
         out = prog(*args)
         outs = out if isinstance(out, (list, tuple)) else [out]
+        if fetch_list is not None and len(fetch_list) != len(outs):
+            # the reference selects a SUBSET of graph vars by fetch_list;
+            # here the program returns what its callable returns — a
+            # mismatched fetch arity would silently hand back the wrong
+            # variables, so refuse loudly instead
+            raise ValueError(
+                f"Executor.run: fetch_list has {len(fetch_list)} "
+                f"entries but the program returns {len(outs)} outputs; "
+                "paddle_tpu programs return exactly their callable's "
+                "outputs — make the callable return the fetch targets")
         if return_numpy:
             return [o.numpy() if isinstance(o, Tensor) else np.asarray(o)
                     for o in outs]
@@ -158,8 +181,25 @@ class Executor:
         return None
 
 
+class _Scope:
+    """Honest scope shim: there is no variable scope in the jit-first
+    design (state lives on Layers). Any lookup raises with the porting
+    guidance instead of AttributeError-ing on None."""
+
+    def find_var(self, name):
+        raise NotImplementedError(
+            f"global_scope().find_var({name!r}): paddle_tpu has no "
+            "static variable scope — read parameters from the Layer "
+            "(layer.state_dict()) instead")
+
+    var = find_var
+
+    def __bool__(self):
+        return False      # `if global_scope():` ports treat it as empty
+
+
 def global_scope():
-    return None
+    return _Scope()
 
 
 def gradients(targets, inputs, target_gradients=None):

@@ -1,0 +1,100 @@
+"""String tensors and string ops.
+
+Reference: paddle/phi/kernels/strings/ — strings_empty_kernel.h,
+strings_copy_kernel.h, strings_lower_upper_kernel.h (+ case_utils.h /
+unicode.h for the utf8 path). The reference stores pstring arrays on
+CPU/GPU; TPU has no string support at all, so the TPU-native design
+keeps StringTensor a HOST container (numpy object array of python str)
+with the same op surface. Anything numeric derived from strings
+(lengths, hashes, token ids) crosses to device as int arrays.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "empty", "empty_like", "copy", "lower",
+           "upper", "to_string_tensor"]
+
+
+class StringTensor:
+    """Host-resident string array (reference: phi::StringTensor,
+    paddle/phi/core/string_tensor.h)."""
+
+    def __init__(self, data, name=None):
+        arr = np.asarray(data, dtype=object)
+        flat = [("" if s is None else str(s)) for s in arr.ravel()]
+        self._data = np.asarray(flat, dtype=object).reshape(arr.shape)
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, str):
+            return out
+        return StringTensor(out)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        o = other._data if isinstance(other, StringTensor) else other
+        return np.asarray(self._data == np.asarray(o, dtype=object))
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+    # numeric bridges (lengths/bytes go to device as ints)
+    def lengths(self):
+        """Per-string character counts as an int32 numpy array."""
+        return np.vectorize(len, otypes=[np.int32])(self._data)
+
+
+def to_string_tensor(data, name=None) -> StringTensor:
+    return StringTensor(data, name=name)
+
+
+def empty(shape) -> StringTensor:
+    """reference: strings_empty_kernel.h EmptyKernel."""
+    return StringTensor(np.full(tuple(shape), "", dtype=object))
+
+
+def empty_like(x: StringTensor) -> StringTensor:
+    """reference: strings_empty_kernel.h EmptyLikeKernel."""
+    return empty(x.shape)
+
+
+def copy(x: StringTensor) -> StringTensor:
+    """Deep copy (reference: strings_copy_kernel.h Copy)."""
+    return StringTensor(x.numpy().copy())
+
+
+def _case_map(x, fn, utf8):
+    if not utf8:
+        # ascii-only transform: the reference's non-utf8 kernel touches
+        # only [A-Za-z] bytes (case_utils.h AsciiCaseConverter)
+        def conv(s):
+            return "".join(fn(c) if c.isascii() else c for c in s)
+    else:
+        conv = fn
+    out = np.vectorize(conv, otypes=[object])(x.numpy())
+    return StringTensor(out)
+
+
+def lower(x: StringTensor, use_utf8_encoding: bool = False):
+    """reference: strings_lower_upper_kernel.h StringLowerKernel —
+    ascii byte-wise by default, full unicode when use_utf8_encoding."""
+    return _case_map(x, str.lower, use_utf8_encoding)
+
+
+def upper(x: StringTensor, use_utf8_encoding: bool = False):
+    """reference: strings_lower_upper_kernel.h StringUpperKernel."""
+    return _case_map(x, str.upper, use_utf8_encoding)

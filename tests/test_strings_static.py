@@ -1,0 +1,80 @@
+"""String tensor ops (reference: paddle/phi/kernels/strings/ —
+strings_empty/copy/lower_upper kernels) and the static-facade honesty
+contract (silently-divergent semantics must raise/warn, never return
+wrong results quietly)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import strings
+
+
+def test_string_tensor_basics():
+    st = strings.to_string_tensor([["Hello", "WÖRLD"], ["ßig", ""]])
+    assert st.shape == [2, 2]
+    assert st[0][1] == "WÖRLD"
+    np.testing.assert_array_equal(st.lengths(), [[5, 5], [3, 0]])
+    e = strings.empty((2, 3))
+    assert e.shape == [2, 3] and e[1][2] == ""
+    el = strings.empty_like(st)
+    assert el.shape == st.shape
+
+
+def test_strings_copy_is_deep():
+    st = strings.to_string_tensor(["a", "b"])
+    c = strings.copy(st)
+    c._data[0] = "z"
+    assert st[0] == "a" and c[0] == "z"
+
+
+def test_lower_upper_ascii_vs_utf8():
+    """reference strings_lower_upper_kernel.h: the default kernel is
+    ascii byte-wise; use_utf8_encoding handles full unicode."""
+    st = strings.to_string_tensor(["Hello", "WÖRLD", "ßig"])
+    assert strings.lower(st).tolist() == ["hello", "wÖrld", "ßig"]
+    assert strings.lower(st, use_utf8_encoding=True).tolist() == \
+        ["hello", "wörld", "ßig"]
+    assert strings.upper(st).tolist() == ["HELLO", "WÖRLD", "ßIG"]
+    assert strings.upper(st, use_utf8_encoding=True).tolist() == \
+        ["HELLO", "WÖRLD", "SSIG"]
+
+
+def test_static_startup_run_is_noop():
+    """`exe.run(default_startup_program())` — the universal static port
+    pattern — must succeed as a no-op (params initialize eagerly)."""
+    import paddle_tpu.static as static
+    exe = static.Executor()
+    assert exe.run(static.default_startup_program()) == []
+
+
+def test_static_fetch_arity_mismatch_raises():
+    import paddle_tpu.static as static
+    prog = static.Program()
+    prog._layer = lambda x: (x, x)
+    prog._feed_names = ["a"]
+    exe = static.Executor()
+    with pytest.raises(ValueError, match="fetch_list"):
+        exe.run(prog, feed={"a": np.ones((2,), "float32")},
+                fetch_list=["only_one"])
+    outs = exe.run(prog, feed={"a": np.ones((2,), "float32")},
+                   fetch_list=["f1", "f2"])
+    assert len(outs) == 2
+
+
+def test_static_scope_raises_with_guidance():
+    import paddle_tpu.static as static
+    with pytest.raises(NotImplementedError, match="state_dict"):
+        static.global_scope().find_var("w0")
+    assert not static.global_scope()
+
+
+def test_clone_for_test_warns_on_training_layer():
+    import warnings
+    import paddle_tpu.static as static
+    from paddle_tpu import nn
+    prog = static.Program()
+    prog._layer = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        prog.clone(for_test=True)
+    assert any("eval()" in str(x.message) for x in w)
