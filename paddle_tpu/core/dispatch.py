@@ -39,6 +39,8 @@ class OpDef:
     differentiable: bool = True
     amp_policy: str = "promote"  # 'white' (fp16-friendly), 'black', 'promote'
     spmd_note: str = ""          # documentation of sharding behaviour
+    custom: bool = False         # user-registered (utils.cpp_extension):
+    #                              exempt from the op-harness coverage gate
 
 
 OP_REGISTRY: dict[str, OpDef] = {}

@@ -244,6 +244,23 @@ def fused_dot_product_attention(q, k, v, attn_mask=None, dropout=0.0,
         dropout_p=dropout if training else 0.0, is_causal=causal)
 
 
+@defop("varlen_attn_mask", differentiable=False)
+def _varlen_attn_mask_op(q_lens, kv_lens, sq, sk, causal=False):
+    """Additive (0 / -1e9) ragged-batch attention mask from per-example
+    lengths (reference: the cutlass varlen kernel's implicit masking)."""
+    b = q_lens.shape[0]
+    col = jnp.arange(sk)[None, None, None, :]
+    row = jnp.arange(sq)[None, None, :, None]
+    valid = col < kv_lens.reshape(b, 1, 1, 1)
+    valid = jnp.logical_and(valid, row < q_lens.reshape(b, 1, 1, 1))
+    if causal:
+        valid = jnp.logical_and(valid, col <= row)
+    return jnp.where(valid, 0.0, -1e9).astype(jnp.float32)
+
+
+_varlen_attn_mask = _varlen_attn_mask_op
+
+
 def variable_length_memory_efficient_attention(
         query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
         causal=False, pre_cache_length=0):
@@ -258,22 +275,11 @@ def variable_length_memory_efficient_attention(
         raise NotImplementedError(
             "pre_cache_length is a CUDA-cache detail; prepend the cache to "
             "key/value instead")
-    b = query.shape[0]
     sq = query.shape[2]
     sk = key.shape[2]
     kv_lens = kv_seq_lens if kv_seq_lens is not None else seq_lens
-
-    def build_mask(q_lens_a, kv_lens_a):
-        col = jnp.arange(sk)[None, None, None, :]
-        row = jnp.arange(sq)[None, None, :, None]
-        valid = col < kv_lens_a.reshape(b, 1, 1, 1)
-        valid = jnp.logical_and(valid, row < q_lens_a.reshape(b, 1, 1, 1))
-        if causal:
-            valid = jnp.logical_and(valid, col <= row)
-        return jnp.where(valid, 0.0, -1e9).astype(jnp.float32)
-
-    amask = defop("varlen_attn_mask", differentiable=False)(build_mask)(
-        seq_lens, kv_lens)
+    amask = _varlen_attn_mask(seq_lens, kv_lens, sq=sq, sk=sk,
+                              causal=causal)
     if mask is not None:
         amask = amask + mask
     # (b, h, s, d) -> (b, s, h, d) for the sdpa surface

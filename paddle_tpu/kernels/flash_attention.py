@@ -77,6 +77,10 @@ _BLOCK_K_BWD = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK_K_BWD", 512))
 # 9.2k tok/s at bk=512 vs 13.9k at bk=2048.
 _BLOCK_K_STREAM = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK_K_STREAM",
                                       2048))
+# hand q to the whole-kv forward kernel TRANSPOSED (b, h, d, s) so the
+# producer-side swapaxes fuses instead of XLA inserting a relayout copy
+# at the pallas boundary (A/B flag; see _flash_fwd_pallas)
+_QT = _os.environ.get("PADDLE_TPU_FLASH_QT", "0") in ("1", "true")
 
 
 def _tuned_blocks(which, b, h, sq, sk, d, dtype, causal, seg_len=None):
@@ -166,7 +170,7 @@ def _pick_block(seq, target):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
-                block_k, kv_valid, seg_len=None):
+                block_k, kv_valid, seg_len=None, q_transposed=False):
     # lse_ref is None on the inference path (save_lse=False): the LSE
     # write is only needed as the backward's softmax residual.
     # seg_len: GQA fold — the q axis is G concatenated length-seg_len
@@ -175,7 +179,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     # k arrives pre-transposed as (1, 1, d, sk): the (1),(0) contraction is
     # the fastest Mosaic form for the hot q @ k dot. ((1,),(1,)) also
     # lowers for bf16 — the backward kernels use it (verified on v5e).
-    bq, d = q_ref.shape[2], q_ref.shape[3]
+    if q_transposed:   # q arrives (1, 1, d, bq): XLA's preferred
+        #                activation layout — no boundary relayout copy;
+        #                the score dot consumes the transposed lhs
+        #                directly (contract dim-0/dim-0, no VMEM
+        #                transpose). Measured -2% on v5e (BASELINE.md
+        #                round-3 perf attempts) — off by default, kept
+        #                for re-testing on other TPU generations.
+        bq, d = q_ref.shape[3], q_ref.shape[2]
+    else:
+        bq, d = q_ref.shape[2], q_ref.shape[3]
     kv_pad = k_ref.shape[3]
     iq = pl.program_id(2)
 
@@ -210,10 +223,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
         m, l, acc = carry
         kj = k_ref[0, 0, :, pl.ds(j * block_k, block_k)]   # (d, bk)
         vj = v_ref[0, 0, pl.ds(j * block_k, block_k), :]   # (bk, d)
-        s = jax.lax.dot_general(
-            q, kj, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=prec)                              # (bq, bk) f32
+        if q_transposed:
+            # q is (d, bq): contract both dim-0 — the MXU streams the
+            # transposed lhs natively, no VMEM transpose
+            s = jax.lax.dot_general(
+                q, kj, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=prec)                          # (bq, bk) f32
+        else:
+            s = jax.lax.dot_general(
+                q, kj, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=prec)                          # (bq, bk) f32
         # bf16: the package-global 'highest' would force an f32-contract
         # form Mosaic can't lower; bf16 inputs with f32 accumulation IS
         # the full-rate MXU mode
@@ -463,11 +484,21 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
                    pltpu.VMEM((bq, lanes), jnp.float32),
                    pltpu.VMEM((bq, d), jnp.float32)]
     else:
+        # PADDLE_TPU_FLASH_QT=1: hand q over TRANSPOSED (b, h, d, sq)
+        # so the swapaxes fuses into q's producer instead of XLA
+        # inserting a relayout copy (~5ms/step, NOTES_r2) at the pallas
+        # boundary; the kernel then uses a transposed-lhs dot. Measured
+        # SLOWER than eating the copy on v5e — default off.
+        q_t = _QT
         kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
                                    causal=causal, block_k=bk, kv_valid=sk,
-                                   seg_len=seg_len)
-        qspec = pl.BlockSpec((1, 1, bq, d),
-                             lambda bi, hi, qi: (bi, hi, qi, 0))
+                                   seg_len=seg_len, q_transposed=q_t)
+        qspec = ospec = pl.BlockSpec((1, 1, bq, d),
+                                     lambda bi, hi, qi: (bi, hi, qi, 0))
+        if q_t:
+            q = jnp.swapaxes(q, 2, 3)
+            qspec = pl.BlockSpec((1, 1, d, bq),
+                                 lambda bi, hi, qi: (bi, hi, 0, qi))
         grid = (b, h, sq_p // bq)
         in_specs = [
             qspec,
@@ -479,7 +510,9 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
         lspec = pl.BlockSpec((1, 1, bq, lanes),
                              lambda bi, hi, qi: (bi, hi, qi, 0))
         scratch = []
-    out_specs = [qspec]
+    if stream_kv:
+        ospec = qspec
+    out_specs = [ospec]
     out_shape = [jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype)]
     if save_lse:
         out_specs.append(lspec)

@@ -116,7 +116,9 @@ def register_op(name, forward, backward=None, amp_policy="promote"):
         fwd_rule, bwd_rule = backward
         fn = jax.custom_vjp(forward)
         fn.defvjp(fwd_rule, bwd_rule)
-    return defop(name, amp_policy=amp_policy)(fn)
+    op = defop(name, amp_policy=amp_policy)(fn)
+    OP_REGISTRY[name].custom = True   # user op: exempt from the harness
+    return op
 
 
 def as_host_op(name, host_fn, out_shape_fn, differentiable=False):
@@ -133,4 +135,6 @@ def as_host_op(name, host_fn, out_shape_fn, differentiable=False):
             jax.ShapeDtypeStruct(np.shape(a), a.dtype) for a in arrays])
         return jax.pure_callback(host_fn, out_spec, *arrays)
 
-    return defop(name, differentiable=differentiable)(fn)
+    op = defop(name, differentiable=differentiable)(fn)
+    OP_REGISTRY[name].custom = True   # user op: exempt from the harness
+    return op

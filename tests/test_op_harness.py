@@ -1649,6 +1649,71 @@ WAIVERS: dict[str, str] = {
 }
 
 
+def _rope_neox_np(x, theta=10000.0):
+    b, s, h, d = x.shape
+    inv = 1.0 / theta ** (np.arange(0, d, 2, dtype=np.float64) / d)
+    ang = np.outer(np.arange(s), inv)                  # (S, D/2)
+    cos = np.cos(ang)[None, :, None, :]
+    sin = np.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    return np.concatenate([x1 * cos - x2 * sin,
+                           x2 * cos + x1 * sin], -1).astype("float32")
+
+
+def _np_silu(x):
+    return x * sps.expit(x)
+
+
+# incubate fused ops register lazily on incubate import; the coverage
+# gate imports that module, so they need Specs like everything else
+import paddle_tpu.incubate.nn.functional  # noqa: F401,E402
+
+SPECS.update({
+    "fused_rms_norm": Spec(
+        lambda rng: [_f((2, 5, 8))(rng), _f((8,), 0.5, 1.5)(rng),
+                     _f((8,))(rng)],
+        lambda x, w, b: (x / np.sqrt((x ** 2).mean(-1, keepdims=True)
+                                     + 1e-6)) * w + b,
+        # impl normalizes in f32 internally (amp black): numeric grads
+        # are f32-precision-floored even under the x64 harness
+        gtol=6e-2),
+    "swiglu": Spec(
+        lambda rng: [_f((4, 6))(rng), _f((4, 6))(rng)],
+        lambda x, y: _np_silu(x) * y),
+    "fused_bias_act": Spec(
+        lambda rng: [_f((4, 6))(rng), _f((6,))(rng)],
+        lambda x, b: _gelu_tanh_np(x + b),
+        kwargs=dict(act_method="gelu"), tol=1e-4),
+    "fused_rope": Spec(
+        lambda rng: [_f((2, 8, 3, 8))(rng), _f((2, 8, 3, 8))(rng),
+                     None, None, None, None],
+        lambda q, k, *_: (_rope_neox_np(q), _rope_neox_np(k)),
+        kwargs=dict(use_neox_rotary_style=True, theta=10000.0),
+        tol=1e-4),
+    "varlen_attn_mask": Spec(
+        lambda rng: [np.array([2, 4], "int32"), np.array([3, 4], "int32")],
+        lambda ql, kl: _varlen_mask_np(ql, kl, 4, 4, True),
+        kwargs=dict(sq=4, sk=4, causal=True), grad=False, bf16=False),
+})
+
+
+def _varlen_mask_np(ql, kl, sq, sk, causal):
+    b = len(ql)
+    out = np.full((b, 1, sq, sk), -1e9, "float32")
+    for i in range(b):
+        for r in range(min(ql[i], sq)):
+            for c in range(min(kl[i], sk)):
+                if not causal or c <= r:
+                    out[i, 0, r, c] = 0.0
+    return out
+
+
+def _gelu_tanh_np(x):
+    # jax.nn.gelu defaults to the tanh approximation
+    return 0.5 * x * (1.0 + np.tanh(
+        np.sqrt(2.0 / np.pi) * (x + 0.044715 * x ** 3)))
+
+
 def test_gumbel_softmax_properties():
     """The waiver-backed property check for the one keyed-stochastic op
     with no deterministic setting: soft samples lie on the simplex,
@@ -1667,10 +1732,16 @@ def test_gumbel_softmax_properties():
 
 
 def test_registry_fully_covered():
-    """VERDICT r2 item 4: every registered op has a Spec or an explicit
-    waiver — fails the moment a new defop lands with neither."""
+    """VERDICT r2 item 4: every op SHIPPED by paddle_tpu has a Spec or
+    an explicit waiver — fails the moment a new defop lands with
+    neither. Ops registered at runtime from outside the package (user
+    custom ops via utils.cpp_extension.register_op — other test modules
+    do this under pytest-randomly ordering) are exempt: the contract
+    covers the framework's own surface."""
+    shipped = {n for n, op in OP_REGISTRY.items()
+               if not getattr(op, "custom", False)}
     covered = set(SPECS) | set(SHARDED_SPECS) | set(WAIVERS)
-    missing = sorted(set(OP_REGISTRY) - covered)
+    missing = sorted(shipped - covered)
     assert not missing, (
         f"{len(missing)} registry ops have neither a Spec nor a waiver: "
         f"{missing}")
