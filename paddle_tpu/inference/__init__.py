@@ -230,6 +230,16 @@ class Predictor:
     def get_input_names(self):
         return list(self._in_names)
 
+    def input_shapes(self):
+        """Static shapes of the positional inputs (the exported program
+        is shape-monomorphic; servers use this to pad dynamic batches to
+        the exported leading dim)."""
+        exported = self._layer._exported
+        import jax
+        n_state = len(jax.tree.leaves(self._layer._state))
+        avals = list(exported.in_avals)[n_state:]
+        return [tuple(a.shape) for a in avals]
+
     def get_input_handle(self, name):
         return self._inputs[name]
 

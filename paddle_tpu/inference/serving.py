@@ -9,29 +9,176 @@ POST /predict  {"inputs": {name: nested-list | {"data": .., "dtype": ..}}}
 GET  /health   -> {"status": "ok", "model": ...}
 GET  /metadata -> input/output names of the served program
 
-Requests are serialized through a lock (one XLA executable, one chip);
-batching across HTTP clients is the caller's job (the reference's
-serving stack batches upstream of the predictor too).
+Requests are serialized through a lock (one XLA executable, one chip).
+With dynamic_batching=True the server coalesces concurrent requests
+that share a shape signature into ONE predictor run (the reference's
+Paddle Serving auto-batching, the "batching policy" piece of
+analysis-predictor deployment): each request waits at most
+batch_timeout_ms for co-travellers, the batch is concatenated on dim 0,
+run once, and the split outputs are scattered back to the callers.
 """
 from __future__ import annotations
 
+import collections
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
-__all__ = ["PredictorServer", "serve"]
+__all__ = ["PredictorServer", "DynamicBatcher", "serve"]
+
+
+class UnbatchableRequest(ValueError):
+    """Raised by DynamicBatcher.submit for inputs that cannot join a
+    dim-0 batch; servers fall back to a solo run ONLY for this (a model
+    ValueError must propagate, not trigger a silent second run)."""
+
+
+class _Pending:
+    __slots__ = ("inputs", "n", "event", "result", "error")
+
+    def __init__(self, inputs, n):
+        self.inputs = inputs            # list of np arrays, fixed order
+        self.n = n                      # leading-dim size
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class DynamicBatcher:
+    """Coalesce concurrent single requests into one predictor run.
+
+    run_fn(list_of_arrays) -> list_of_arrays, batching on dim 0. Only
+    requests with identical (shape[1:], dtype) signatures merge; the
+    first request of a batch waits up to `timeout_ms` for co-travellers,
+    bounded by `max_batch` total rows."""
+
+    def __init__(self, run_fn, max_batch=8, timeout_ms=5.0):
+        self.run_fn = run_fn
+        self.max_batch = max_batch
+        self.timeout = timeout_ms / 1000.0
+        self._buf: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self.batches_run = 0            # observability / tests
+        self.requests_served = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _sig(arrays):
+        return tuple((a.shape[1:], str(a.dtype)) for a in arrays)
+
+    def submit(self, arrays):
+        """Blocking: returns the outputs for this request's rows."""
+        arrays = [np.asarray(a) for a in arrays]
+        if not arrays or any(a.ndim == 0 for a in arrays):
+            raise UnbatchableRequest(
+                "dynamic batching needs batched (dim-0) inputs")
+        if any(a.shape[0] != arrays[0].shape[0] for a in arrays):
+            raise UnbatchableRequest(
+                "dynamic batching needs a shared leading dim across all "
+                f"inputs, got {[a.shape for a in arrays]}")
+        p = _Pending(arrays, arrays[0].shape[0])
+        with self._cv:
+            self._buf.append(p)
+            self._cv.notify()
+        p.event.wait()
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def _take_batch(self):
+        with self._cv:
+            while not self._buf and not self._stop:
+                self._cv.wait()
+            if self._stop:
+                return []
+            first = self._buf.popleft()
+        batch = [first]
+        sig = self._sig(first.inputs)
+        rows = first.n
+        deadline = time.monotonic() + self.timeout
+        while rows < self.max_batch:
+            with self._cv:
+                # pull every compatible pending request
+                keep: collections.deque = collections.deque()
+                while self._buf and rows < self.max_batch:
+                    cand = self._buf.popleft()
+                    if self._sig(cand.inputs) == sig \
+                            and rows + cand.n <= self.max_batch:
+                        batch.append(cand)
+                        rows += cand.n
+                    else:
+                        keep.append(cand)
+                keep.extend(self._buf)
+                self._buf = keep
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or rows >= self.max_batch:
+                break
+            with self._cv:
+                self._cv.wait(timeout=remaining)
+        return batch
+
+    def _loop(self):
+        while not self._stop:
+            batch = self._take_batch()
+            if not batch:
+                continue
+            try:
+                n_in = len(batch[0].inputs)
+                merged = [np.concatenate([p.inputs[i] for p in batch], 0)
+                          for i in range(n_in)]
+                outs = self.run_fn(merged)
+                offs = 0
+                for p in batch:
+                    p.result = [np.asarray(o)[offs:offs + p.n]
+                                for o in outs]
+                    offs += p.n
+                self.batches_run += 1
+                self.requests_served += len(batch)
+            except Exception as e:      # noqa: BLE001
+                for p in batch:
+                    p.error = e
+            for p in batch:
+                p.event.set()
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+            pending = list(self._buf)
+            self._buf.clear()
+            self._cv.notify_all()
+        # callers blocked in submit() must not hang across shutdown
+        for p in pending:
+            p.error = RuntimeError("DynamicBatcher stopped")
+            p.event.set()
 
 
 class PredictorServer:
     """Serve a Predictor (or any callable dict->dict) over HTTP."""
 
     def __init__(self, predictor, host="127.0.0.1", port=0,
-                 model_name="model"):
+                 model_name="model", dynamic_batching=False,
+                 max_batch_size=8, batch_timeout_ms=5.0):
         self.predictor = predictor
         self.model_name = model_name
         self._lock = threading.Lock()
+        self.batcher = None
+        # batching needs the handle-free run(list) API; a plain callable
+        # predictor keeps the solo path (its input names don't survive
+        # the array-list hop)
+        if dynamic_batching and hasattr(predictor, "run"):
+            shapes = (predictor.input_shapes()
+                      if hasattr(predictor, "input_shapes") else None)
+            if shapes and shapes[0]:
+                # never merge past the exported leading dim
+                max_batch_size = min(max_batch_size, shapes[0][0])
+            self.batcher = DynamicBatcher(
+                self._run_locked, max_batch=max_batch_size,
+                timeout_ms=batch_timeout_ms)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -84,20 +231,64 @@ class PredictorServer:
             return np.asarray(v["data"], dtype=v.get("dtype", "float32"))
         return np.asarray(v, dtype=np.float32)
 
+    def _run_locked(self, arrays):
+        """list-of-arrays -> list-of-arrays through the predictor, under
+        the executable lock (DynamicBatcher's run_fn). Exported programs
+        are shape-monomorphic, so a merged batch is PADDED up to the
+        exported leading dim and the outputs sliced back — deploy with
+        input_spec batch = max_batch_size."""
+        p = self.predictor
+        rows = int(np.asarray(arrays[0]).shape[0])
+        with self._lock:
+            if hasattr(p, "run"):
+                shapes = (p.input_shapes()
+                          if hasattr(p, "input_shapes") else None)
+                if shapes and shapes[0] and shapes[0][0] > rows:
+                    tgt = shapes[0][0]
+                    arrays = [np.concatenate(
+                        [a, np.zeros((tgt - rows,) + a.shape[1:],
+                                     a.dtype)], 0) for a in arrays]
+                out = p.run(list(arrays))
+                outs = out if isinstance(out, list) else [out]
+                return [np.asarray(o)[:rows] if np.asarray(o).ndim >= 1
+                        and np.asarray(o).shape[0] >= rows else o
+                        for o in outs]
+            res = p({f"x{i}": a for i, a in enumerate(arrays)})
+            return [np.asarray(v) for v in res.values()]
+
+    def _resolve_inputs(self, names, inputs):
+        """Decode request inputs in the program's input order, with the
+        single-input convenience (accept any key when both sides have
+        exactly one)."""
+        arrays = []
+        for name in names:
+            if name not in inputs and len(names) == 1 \
+                    and len(inputs) == 1:
+                (v,) = inputs.values()
+            else:
+                v = inputs[name]
+            arrays.append(self._decode(v))
+        return arrays
+
     def predict(self, inputs: dict) -> dict:
         p = self.predictor
+        if self.batcher is not None and hasattr(p, "get_input_names"):
+            arrays = self._resolve_inputs(p.get_input_names(), inputs)
+            try:
+                outs = self.batcher.submit(arrays)
+            except UnbatchableRequest:
+                outs = None             # solo run below
+            if outs is not None:
+                return {f"out{i}": {"data": np.asarray(a).tolist(),
+                                    "dtype": str(np.asarray(a).dtype),
+                                    "shape": list(np.asarray(a).shape)}
+                        for i, a in enumerate(outs)}
         with self._lock:
             if hasattr(p, "get_input_names"):
                 names = p.get_input_names()
-                for name in names:
-                    if name not in inputs and len(names) == 1 \
-                            and len(inputs) == 1:
-                        # single-input convenience: accept any key
-                        (v,) = inputs.values()
-                    else:
-                        v = inputs[name]
-                    p.get_input_handle(name).copy_from_cpu(
-                        self._decode(v))
+                for name, arr in zip(names,
+                                     self._resolve_inputs(names, inputs)):
+                    p.get_input_handle(name).copy_from_cpu(arr)
                 p.run()
                 out = {}
                 for name in p.get_output_names():
@@ -121,6 +312,8 @@ class PredictorServer:
         return self
 
     def stop(self):
+        if self.batcher is not None:
+            self.batcher.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
 

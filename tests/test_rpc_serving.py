@@ -119,3 +119,133 @@ def test_serving_wrapper_end_to_end(tmp_path):
             base + "/health", timeout=10).read())["status"] == "ok"
     finally:
         srv.stop()
+
+
+def test_dynamic_batcher_coalesces_and_splits():
+    """Concurrent compatible requests merge into ONE run; results split
+    back per-request; incompatible signatures never merge."""
+    import threading
+    import numpy as np
+    from paddle_tpu.inference.serving import DynamicBatcher
+
+    calls = []
+
+    def run_fn(arrays):
+        calls.append(arrays[0].shape)
+        return [arrays[0] * 2.0, arrays[0].sum(-1, keepdims=True)]
+
+    # generous window: coalescing assertions must hold on a loaded box
+    b = DynamicBatcher(run_fn, max_batch=8, timeout_ms=300.0)
+    try:
+        results = {}
+
+        def client(i, rows, width):
+            x = np.full((rows, width), float(i), "float32")
+            results[i] = b.submit([x])
+
+        ts = [threading.Thread(target=client, args=(i, 1, 4))
+              for i in range(4)]
+        ts += [threading.Thread(target=client, args=(10, 2, 6))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        # per-request correctness
+        for i in range(4):
+            np.testing.assert_array_equal(results[i][0],
+                                          np.full((1, 4), 2.0 * i))
+            np.testing.assert_array_equal(results[i][1], [[4.0 * i]])
+        np.testing.assert_array_equal(results[10][0],
+                                      np.full((2, 6), 20.0))
+        # the width-4 requests coalesced; width-6 ran separately
+        assert b.requests_served == 5
+        assert b.batches_run < 5, (b.batches_run, calls)
+        assert any(s[1] == 6 for s in calls) and \
+            any(s[1] == 4 for s in calls)
+    finally:
+        b.stop()
+
+
+def test_dynamic_batcher_error_propagates_to_all():
+    import threading
+    import numpy as np
+    import pytest
+    from paddle_tpu.inference.serving import DynamicBatcher
+
+    def bad(arrays):
+        raise RuntimeError("kaboom")
+
+    b = DynamicBatcher(bad, max_batch=4, timeout_ms=20.0)
+    try:
+        errs = []
+
+        def client():
+            try:
+                b.submit([np.ones((1, 3), "float32")])
+            except RuntimeError as e:
+                errs.append(str(e))
+
+        ts = [threading.Thread(target=client) for _ in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert errs == ["kaboom"] * 3
+    finally:
+        b.stop()
+
+
+def test_serving_dynamic_batching_end_to_end(tmp_path):
+    """HTTP server with dynamic_batching=True: concurrent clients get
+    correct per-request outputs from fewer predictor runs."""
+    import json
+    import threading
+    import urllib.request
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.inference.serving import PredictorServer
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    net.eval()
+    path = str(tmp_path / "m")
+    # export at the max batch: the server pads merged batches up to it
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.jit.InputSpec((8, 4), "float32")])
+    pred = create_predictor(Config(path + ".pdmodel",
+                                   path + ".pdiparams"))
+    assert pred.input_shapes() == [(8, 4)]
+    srv = PredictorServer(pred, model_name="lin", dynamic_batching=True,
+                          max_batch_size=8, batch_timeout_ms=300).start()
+    try:
+        ref_w = net.weight.numpy()
+        ref_b = net.bias.numpy()
+        outs = {}
+
+        def client(i):
+            x = np.full((1, 4), float(i), "float32")
+            body = json.dumps(
+                {"inputs": {"x0": {"data": x.tolist(),
+                                   "dtype": "float32"}}}).encode()
+            r = urllib.request.urlopen(urllib.request.Request(
+                f"http://{srv.host}:{srv.port}/predict", data=body,
+                headers={"Content-Type": "application/json"}), timeout=30)
+            outs[i] = json.loads(r.read())["outputs"]
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert len(outs) == 6
+        for i in range(6):
+            got = np.asarray(outs[i]["out0"]["data"], "float32")
+            exp = np.full((1, 4), float(i), "float32") @ ref_w + ref_b
+            np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-5)
+        assert srv.batcher.requests_served == 6
+        assert srv.batcher.batches_run < 6
+    finally:
+        srv.stop()
