@@ -39,9 +39,7 @@ def _padding(padding, n, stride, kernel, dilation):
 
 
 def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n,
-             channel_last=False):
-    lhs_spec = "N" + ("HWD"[:n] if n <= 3 else "") + "C" if channel_last \
-        else "NC" + "HWD"[:n]
+             channel_last=False, preferred_element_type=None):
     # build dimension spec strings like NCHW / OIHW
     sp = "DHW"[-n:] if n == 3 else ("HW" if n == 2 else "W")
     lhs = ("N" + sp + "C") if channel_last else ("NC" + sp)
@@ -52,7 +50,7 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n,
         x, weight, window_strides=stride, padding=padding,
         lhs_dilation=(1,) * n, rhs_dilation=dilation,
         dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=None)
+        preferred_element_type=preferred_element_type)
     if bias is not None:
         if channel_last:
             y = y + bias.reshape((1,) * (y.ndim - 1) + (-1,))

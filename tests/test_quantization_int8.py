@@ -10,7 +10,7 @@ import paddle_tpu as paddle
 from paddle_tpu import nn
 from paddle_tpu.quantization import (
     PTQ, QuantConfig, HistObserver, AbsMaxChannelWiseWeightObserver,
-    AbsmaxObserver, QuantizedLinear, layer_error_report)
+    AbsmaxObserver, QuantizedLinear, QuantizedConv2D, layer_error_report)
 
 
 def _calibrated_linear_ptq(seed=0, in_f=16, out_f=8, act=True):
@@ -192,3 +192,162 @@ def test_convert_bare_quanted_root_and_quant_axis_guard():
     with pytest.raises(ValueError, match="quant_axis"):
         QuantizedLinear(nn.Linear(4, 6), np.ones(4, "float32"),
                         act_scale=1.0, quant_axis=0, mode="int8")
+
+
+# -- int8 conv execution (QuantedConv2D -> QuantizedConv2D) ------------------
+
+def _calibrated_conv_ptq(seed=0, groups=1, data_format="NCHW", act=True):
+    paddle.seed(seed)
+    rng = np.random.RandomState(seed)
+    model = nn.Sequential(nn.Conv2D(4, 8, 3, stride=2, padding=1,
+                                    groups=groups, data_format=data_format))
+    q = PTQ(QuantConfig(
+        activation=HistObserver(percent=1.0) if act else None,
+        weight=AbsMaxChannelWiseWeightObserver()))
+    qmodel = q.quantize(model)
+    shape = (2, 4, 10, 10) if data_format == "NCHW" else (2, 10, 10, 4)
+    calib = [rng.randn(*shape).astype("float32") for _ in range(4)]
+    for c in calib:
+        qmodel(paddle.to_tensor(c))
+    return model, q, qmodel, calib
+
+
+def test_int8_conv_matches_fake_quant_numerics():
+    """W8A8 conv execution computes the same values as the fake-quant
+    simulation (same rounding grid, exact int32 accumulation)."""
+    model, q, qmodel, calib = _calibrated_conv_ptq()
+    fake = q.convert(qmodel, execute="fake")
+    real = q.convert(qmodel, execute="int8")
+    assert isinstance(real[0], QuantizedConv2D)
+    x = paddle.to_tensor(calib[0])
+    np.testing.assert_allclose(real(x).numpy(), fake(x).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_int8_conv_program_contains_s8_convolution():
+    """The traced program must contain an s8 x s8 -> s32 convolution —
+    not a float conv on dequantized operands."""
+    import jax
+    model, q, qmodel, calib = _calibrated_conv_ptq()
+    real = q.convert(qmodel, execute="int8")
+    lay = real[0]
+
+    def f(xv):
+        return lay(paddle.Tensor(xv, stop_gradient=True))._value
+
+    txt = str(jax.jit(f).lower(calib[0]).as_text())
+    convs = [l for l in txt.splitlines() if "convolution" in l]
+    assert convs and any("i8" in l for l in convs), convs
+
+
+def test_weight_only_int8_conv_close_to_float():
+    model, q, qmodel, calib = _calibrated_conv_ptq(act=False)
+    wo = q.convert(qmodel, execute="weight_only_int8")
+    assert isinstance(wo[0], QuantizedConv2D)
+    x = paddle.to_tensor(calib[0])
+    ref = model(x).numpy()
+    got = wo(x).numpy()
+    rel = np.abs(got - ref).mean() / np.abs(ref).mean()
+    assert rel < 0.02, rel
+    assert wo[0].qweight.numpy().dtype == np.int8
+
+
+@pytest.mark.parametrize("groups", [2, 4])
+def test_int8_conv_grouped(groups):
+    """feature_group_count rides the same int8 path; per-out-channel
+    scales still factor out of each group's contraction."""
+    model, q, qmodel, calib = _calibrated_conv_ptq(groups=groups)
+    fake = q.convert(qmodel, execute="fake")
+    real = q.convert(qmodel, execute="int8")
+    assert isinstance(real[0], QuantizedConv2D)
+    x = paddle.to_tensor(calib[0])
+    np.testing.assert_allclose(real(x).numpy(), fake(x).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_int8_conv_nhwc():
+    model, q, qmodel, calib = _calibrated_conv_ptq(data_format="NHWC")
+    fake = q.convert(qmodel, execute="fake")
+    real = q.convert(qmodel, execute="int8")
+    assert isinstance(real[0], QuantizedConv2D)
+    x = paddle.to_tensor(calib[0])
+    np.testing.assert_allclose(real(x).numpy(), fake(x).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_int8_conv_guards():
+    conv = nn.Conv2D(4, 6, 3)
+    with pytest.raises(ValueError, match="activation scale"):
+        QuantizedConv2D(conv, np.ones(6, "float32"), act_scale=None,
+                        mode="int8")
+    with pytest.raises(ValueError, match="quant_axis"):
+        # per-channel scales on the IN axis cannot be factored out
+        QuantizedConv2D(conv, np.ones(4, "float32"), act_scale=1.0,
+                        quant_axis=1, mode="int8")
+    with pytest.raises(ValueError, match="per-tensor activation"):
+        QuantizedConv2D(conv, np.ones(6, "float32"),
+                        act_scale=np.ones(4, "float32"), mode="int8")
+    with pytest.raises(ValueError, match="execution mode"):
+        QuantizedConv2D(conv, np.ones(6, "float32"), act_scale=1.0,
+                        mode="int4")
+
+
+def test_int8_conv_in_error_report_and_mixed_model():
+    """A conv+linear model converts both layer kinds to real int8 and the
+    per-layer error report tags them with mode='int8'."""
+    paddle.seed(1)
+    rng = np.random.RandomState(1)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(3, 8, 3, padding=1)
+            self.fc = nn.Linear(8 * 6 * 6, 10)
+
+        def forward(self, x):
+            h = paddle.nn.functional.relu(self.conv(x))
+            return self.fc(h.reshape((h.shape[0], -1)))
+
+    model = Net()
+    model.eval()
+    q = PTQ(QuantConfig(activation=AbsmaxObserver(),
+                        weight=AbsMaxChannelWiseWeightObserver()))
+    qmodel = q.quantize(model)
+    calib = [rng.randn(2, 3, 6, 6).astype("float32") for _ in range(4)]
+    for c in calib:
+        qmodel(paddle.to_tensor(c))
+    converted = q.convert(qmodel, execute="int8")
+    kinds = {type(l) for l in converted.sublayers()}
+    assert QuantizedConv2D in kinds and QuantizedLinear in kinds
+    x = paddle.to_tensor(calib[0])
+    report = layer_error_report(model, converted, x)
+    modes = {st["mode"] for st in report.values()}
+    assert modes == {"int8"}, report
+    ref = model(x).numpy()
+    got = converted(x).numpy()
+    assert (got.argmax(-1) == ref.argmax(-1)).mean() > 0.9
+
+
+def test_uncalibrated_act_observer_freezes_to_fake():
+    """An activation observer that only ever saw zeros reports scale 0;
+    convert(execute='int8') must freeze that layer to fake-quant rather
+    than build a QuantizedConv2D/Linear that saturates every activation
+    and outputs bias-only garbage (code-review r3 finding)."""
+    paddle.seed(0)
+    for make in (lambda: nn.Sequential(nn.Conv2D(4, 8, 3)),
+                 lambda: nn.Sequential(nn.Linear(4, 8))):
+        model = make()
+        q = PTQ(QuantConfig(activation=AbsmaxObserver(),
+                            weight=AbsMaxChannelWiseWeightObserver()))
+        qmodel = q.quantize(model)
+        shape = (2, 4, 6, 6) if isinstance(model[0], nn.Conv2D) else (2, 4)
+        qmodel(paddle.to_tensor(np.zeros(shape, "float32")))   # all-zero calib
+        conv = q.convert(qmodel, execute="int8")
+        assert not isinstance(conv[0], (QuantizedConv2D, QuantizedLinear)), \
+            type(conv[0])
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(*shape).astype("float32"))
+        ref = model(x).numpy()
+        got = conv(x).numpy()    # fake-quant path: weights quantized only
+        rel = np.abs(got - ref).mean() / (np.abs(ref).mean() or 1.0)
+        assert rel < 0.1, rel
