@@ -56,6 +56,32 @@ int PT_PredictorRun(PT_Predictor p, const void** in_data,
 int PT_PredictorOutput(PT_Predictor p, int i, const void** data,
                        int64_t* shape, int* ndim, int* dtype);
 
+/* -- autoregressive generation (streaming) --------------------------- */
+
+typedef void* PT_Generator;
+
+/* Invoked once per generated position with tokens[batch] int32 ids.
+ * Return nonzero to cancel the stream. Do not call PT_* functions from
+ * inside the callback. */
+typedef int (*PT_TokenCallback)(const int32_t* tokens, int batch,
+                                int step, void* user);
+
+/* bundle_path_prefix: an export_generation_bundle prefix
+ * (<p>.prefill.pdmodel, <p>.decode.pdmodel, <p>.pdiparams,
+ * <p>.genmeta). */
+PT_Generator PT_GeneratorCreate(const char* bundle_path_prefix);
+void PT_GeneratorDestroy(PT_Generator g);
+
+/* Streams up to max_new_tokens positions, invoking cb per position.
+ * prompt: batch x prompt_len int32 ids (must match the exported bundle
+ * shape). eos_token_id < 0 disables eos; seed < 0 -> unseeded.
+ * Returns the number of generated positions, or -1 (PT_LastError). */
+int PT_GeneratorStream(PT_Generator g, const int32_t* prompt, int batch,
+                       int prompt_len, int max_new_tokens, int do_sample,
+                       double temperature, int top_k, double top_p,
+                       int eos_token_id, long long seed,
+                       PT_TokenCallback cb, void* user);
+
 const char* PT_LastError(void);
 
 #ifdef __cplusplus
@@ -175,6 +201,62 @@ done:
   return rc;
 }
 
+void* PT_GeneratorCreate(const char* path) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  void* out = NULL;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference.capi");
+  if (!mod) { set_err_from_py(); goto done; }
+  {
+    PyObject* holder = PyObject_CallMethod(mod, "_capi_generator_create",
+                                           "s", path);
+    Py_DECREF(mod);
+    if (!holder) { set_err_from_py(); goto done; }
+    out = (void*)holder;
+  }
+done:
+  PyGILState_Release(g);
+  return out;
+}
+
+void PT_GeneratorDestroy(void* g) {
+  if (!g) return;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  Py_DECREF((PyObject*)g);
+  PyGILState_Release(gs);
+}
+
+int PT_GeneratorStream(void* g, const int32_t* prompt, int batch,
+                       int prompt_len, int max_new_tokens, int do_sample,
+                       double temperature, int top_k, double top_p,
+                       int eos_token_id, long long seed,
+                       int (*cb)(const int32_t*, int, int, void*),
+                       void* user) {
+  PyGILState_STATE gs = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* buf = PyBytes_FromStringAndSize(
+      (const char*)prompt, (Py_ssize_t)batch * prompt_len * 4);
+  PyObject* mod = buf ? PyImport_ImportModule("paddle_tpu.inference.capi")
+                      : NULL;
+  PyObject* res = mod ? PyObject_CallMethod(
+      mod, "_capi_generator_stream", "OOiiiididiLKK",
+      (PyObject*)g, buf, batch, prompt_len, max_new_tokens, do_sample,
+      temperature, top_k, top_p, eos_token_id, seed,
+      (unsigned long long)(uintptr_t)cb,
+      (unsigned long long)(uintptr_t)user) : NULL;
+  Py_XDECREF(mod);
+  Py_XDECREF(buf);
+  if (!res) { set_err_from_py(); goto done; }
+  rc = (int)PyLong_AsLong(res);
+  Py_DECREF(res);
+done:
+  PyGILState_Release(gs);
+  return rc;
+}
+
 int PT_PredictorOutput(void* p, int i, const void** data, int64_t* shape,
                        int* ndim, int* dtype) {
   PyGILState_STATE g = PyGILState_Ensure();
@@ -236,6 +318,42 @@ def _capi_run(holder, inputs):
         result.append((o.tobytes(), tuple(int(d) for d in o.shape),
                        _CODES[name]))
     return result
+
+
+def _capi_generator_create(path_prefix):
+    """Holder list [GenerationPredictor] for the C generator surface."""
+    from paddle_tpu.models.generation import GenerationPredictor
+    return [GenerationPredictor(path_prefix)]
+
+
+def _capi_generator_stream(holder, prompt_bytes, batch, prompt_len,
+                           max_new_tokens, do_sample, temperature, top_k,
+                           top_p, eos_token_id, seed, cb_addr, user_addr):
+    """Drive GenerationPredictor.stream, invoking the C callback (raw
+    function-pointer address, called via ctypes) once per generated
+    position. A nonzero callback return cancels the stream. Returns the
+    number of positions streamed."""
+    import ctypes
+
+    gp = holder[0]
+    ids = np.frombuffer(prompt_bytes, "int32").reshape(batch, prompt_len)
+    cb = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.c_int, ctypes.c_void_p)(cb_addr)
+    user = ctypes.c_void_p(user_addr or None)
+    steps = 0
+    for tok in gp.stream(
+            ids, max_new_tokens, do_sample=bool(do_sample),
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_token_id=None if eos_token_id < 0 else eos_token_id,
+            seed=None if seed < 0 else int(seed)):
+        arr = np.ascontiguousarray(tok, "int32")
+        rc = cb(arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                batch, steps, user)
+        steps += 1
+        if rc:
+            break
+    return steps
 
 
 # -- builder -----------------------------------------------------------------

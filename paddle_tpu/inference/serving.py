@@ -6,6 +6,15 @@ the exported StableHLO program is the deployment artifact, SURVEY.md
 
 POST /predict  {"inputs": {name: nested-list | {"data": .., "dtype": ..}}}
            ->  {"outputs": {name: {"data": .., "dtype": .., "shape": ..}}}
+POST /generate {"ids": [[..]], "max_new_tokens": n, "stream": bool,
+                "do_sample"/"temperature"/"top_k"/"top_p"/"eos_token_id"
+                /"seed": ...}
+           ->  stream=false: {"sequences": [[..]]}
+               stream=true: application/x-ndjson chunks, one
+               {"step": i, "tokens": [..]} line per generated position,
+               then {"done": true} — the token-streaming surface
+               (requires a generator: a GenerationPredictor bundle or a
+               cache-capable CausalLM, see models/generation.py)
 GET  /health   -> {"status": "ok", "model": ...}
 GET  /metadata -> input/output names of the served program
 
@@ -162,9 +171,10 @@ class PredictorServer:
 
     def __init__(self, predictor, host="127.0.0.1", port=0,
                  model_name="model", dynamic_batching=False,
-                 max_batch_size=8, batch_timeout_ms=5.0):
+                 max_batch_size=8, batch_timeout_ms=5.0, generator=None):
         self.predictor = predictor
         self.model_name = model_name
+        self.generator = generator
         self._lock = threading.Lock()
         self.batcher = None
         # batching needs the handle-free run(list) API; a plain callable
@@ -182,6 +192,11 @@ class PredictorServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # chunked transfer (the /generate stream) needs HTTP/1.1;
+            # every non-stream reply carries Content-Length, so 1.1
+            # keep-alive semantics stay correct
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):      # quiet
                 pass
 
@@ -193,6 +208,26 @@ class PredictorServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _stream_reply(self, lines):
+                """Chunked application/x-ndjson: one JSON line per chunk,
+                flushed as each token batch is produced."""
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(obj):
+                    data = (json.dumps(obj) + "\n").encode()
+                    self.wfile.write(b"%x\r\n" % len(data) + data
+                                     + b"\r\n")
+                    self.wfile.flush()
+                try:
+                    for obj in lines:
+                        chunk(obj)
+                except Exception as e:      # noqa: BLE001
+                    chunk({"error": str(e)})
+                self.wfile.write(b"0\r\n\r\n")
+
             def do_GET(self):
                 if self.path == "/health":
                     return self._reply(200, {"status": "ok",
@@ -202,6 +237,28 @@ class PredictorServer:
                 return self._reply(404, {"error": "unknown path"})
 
             def do_POST(self):
+                if self.path == "/generate":
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        req = json.loads(self.rfile.read(n))
+                        stream = bool(req.pop("stream", False))
+                        it = outer.generate_steps(req)
+                        if stream:
+                            # pull the first item BEFORE sending the 200
+                            # header so request errors (bad shape, no
+                            # generator) still surface as a real 400
+                            import itertools
+                            first = next(it)
+                            return self._stream_reply(
+                                itertools.chain([first], it))
+                        steps = [obj for obj in it if "tokens" in obj]
+                        return self._reply(200, {
+                            "sequences": [
+                                [s["tokens"][b] for s in steps]
+                                for b in range(len(steps[0]["tokens"]))]
+                            if steps else []})
+                    except Exception as e:      # noqa: BLE001
+                        return self._reply(400, {"error": str(e)})
                 if self.path != "/predict":
                     return self._reply(404, {"error": "unknown path"})
                 try:
@@ -218,6 +275,57 @@ class PredictorServer:
         self._thread = None
 
     # -- core -------------------------------------------------------------
+    _GEN_PARAMS = ("max_new_tokens", "eos_token_id", "pad_token_id",
+                   "do_sample", "temperature", "top_k", "top_p", "seed")
+
+    def generate_steps(self, req):
+        """Yield {"step": i, "tokens": [...]} per generated position,
+        then {"done": True, "steps": n}.
+
+        Compute runs in a PRODUCER thread that holds the executable lock
+        only while generating; this (consumer) iterator just drains a
+        queue. A slow streaming client therefore stalls its own socket
+        writes, never the chip lock — /predict and other /generate
+        requests keep flowing."""
+        if self.generator is None:
+            raise ValueError("this server has no generator "
+                             "(pass generator= to PredictorServer)")
+        ids = np.asarray(req["ids"], "int32")
+        kw = {k: req[k] for k in self._GEN_PARAMS if k in req}
+        g = self.generator
+        if hasattr(g, "stream"):
+            it = g.stream(ids, **kw)
+        else:
+            from paddle_tpu.models.generation import generate_stream
+            it = generate_stream(g, ids, **kw)
+
+        import queue
+        q: queue.Queue = queue.Queue()
+        _END = object()
+
+        def produce():
+            try:
+                with self._lock:
+                    step = 0
+                    for tok in it:
+                        q.put({"step": step,
+                               "tokens": np.asarray(tok).tolist()})
+                        step += 1
+                    q.put({"done": True, "steps": step})
+            except Exception as e:      # noqa: BLE001
+                q.put(e)
+            q.put(_END)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, Exception):
+                raise item
+            yield item
+
     def metadata(self):
         p = self.predictor
         if hasattr(p, "get_input_names"):

@@ -277,6 +277,43 @@ def _read_first(stmts):
                     note(n.id, "store")
             for st in list(s.body) + list(s.orelse):
                 walk_stmt(st)
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            # a nested def BINDS its name (the transformer's own
+            # _pt_true_N/_pt_false_N helpers land here). Decorators,
+            # default values and class bodies evaluate AT the def
+            # statement; the function body's free-variable reads are
+            # deferred to call time but must still be bound in the
+            # extracted scope — count both, minus names the inner
+            # function binds itself.
+            for dec in s.decorator_list:
+                walk_expr(dec)
+            if isinstance(s, ast.ClassDef):
+                for base in list(s.bases) + [kw.value for kw in
+                                             s.keywords]:
+                    walk_expr(base)
+                note(s.name, "store")
+                for st in s.body:        # class bodies run immediately
+                    walk_stmt(st)
+            else:
+                for d in (list(s.args.defaults)
+                          + [d for d in s.args.kw_defaults
+                             if d is not None]):
+                    walk_expr(d)         # defaults run at def time
+                note(s.name, "store")
+                inner = ({a.arg for a in s.args.args}
+                         | {a.arg for a in s.args.kwonlyargs}
+                         | _assigned(s.body) | {s.name})
+                if s.args.vararg:
+                    inner.add(s.args.vararg.arg)
+                if s.args.kwarg:
+                    inner.add(s.args.kwarg.arg)
+                for st in s.body:
+                    for n in ast.walk(st):
+                        if isinstance(n, ast.Name) \
+                                and isinstance(n.ctx, ast.Load) \
+                                and n.id not in inner:
+                            note(n.id, "load")
         else:
             for n in ast.walk(s):
                 if isinstance(n, ast.Name):

@@ -1,0 +1,452 @@
+"""Autoregressive generation with a static KV cache, TPU-first.
+
+Reference surface: PaddleNLP's GenerationMixin (generation/utils.py —
+greedy_search / sample with temperature, top-k, top-p, eos handling,
+use_cache) and the reference's fused decode loops. The TPU design
+differs from the reference's dynamically-growing cache:
+
+- The KV cache is a FIXED-SIZE buffer `(batch, max_len, kv_heads,
+  head_dim)` per layer, written in place with
+  `lax.dynamic_update_slice` at a TRACED position index. Static shapes
+  mean exactly TWO compiles per (batch, prompt_len): one prefill step
+  and one single-token decode step reused for every generated token.
+- Sampling uses the Gumbel-max trick with HOST-generated noise passed
+  into the jitted step as data. Under `jit` a traced-in PRNG key would
+  be baked at trace time (every step would sample identically); noise
+  as an input keeps the step compiled once and the randomness fresh
+  and seedable.
+- The decode loop runs host-side, one jitted step per token. That is a
+  deliberate serving-first choice: each step's token id is fetched to
+  the host anyway (streaming + eos early-exit), so a device-side
+  `lax.while_loop` over the whole sequence would buy nothing and lose
+  the streaming surface.
+
+Models opt in by accepting `caches=`/`cache_index=` in forward and
+returning `(logits, caches)` (LlamaForCausalLM does; see
+models/llama.py). Models without cache support still generate through
+the full-recompute fallback (`use_cache=False`), which re-runs the
+whole prefix per token — fine for tests/small models, quadratic for
+real serving.
+"""
+from __future__ import annotations
+
+import inspect
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu
+from paddle_tpu import tensor as T
+from paddle_tpu.core.dispatch import defop
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["init_kv_cache", "kv_cache_update", "process_logits",
+           "generate", "generate_stream"]
+
+
+@defop("kv_cache_update", differentiable=False,
+       spmd_note="cache batch dim shards with dp; kv-head dim with mp")
+def kv_cache_update(buf, new, index):
+    """Write `new` (b, s, h, d) into the fixed cache buffer at sequence
+    position `index` (traced scalar). lax.dynamic_update_slice keeps the
+    buffer shape static so the decode step compiles once."""
+    index = jnp.asarray(index, jnp.int32).reshape(())
+    zero = jnp.zeros((), jnp.int32)
+    return jax.lax.dynamic_update_slice(
+        buf, new.astype(buf.dtype), (zero, index, zero, zero))
+
+
+def init_kv_cache(model, batch_size, max_len, dtype=None):
+    """Per-layer (k, v) buffers for `model` (a CausalLM exposing
+    .config with num_hidden_layers / num_key_value_heads / head_dim).
+    dtype defaults to the model's parameter dtype."""
+    cfg = model.config
+    n_kv = getattr(cfg, "num_key_value_heads", None) \
+        or cfg.num_attention_heads
+    hd = getattr(cfg, "head_dim", None) \
+        or cfg.hidden_size // cfg.num_attention_heads
+    if dtype is None:
+        dtype = next(iter(model.parameters())).dtype
+    shape = [batch_size, max_len, n_kv, hd]
+    return [(T.zeros(shape, dtype=dtype), T.zeros(shape, dtype=dtype))
+            for _ in range(cfg.num_hidden_layers)]
+
+
+def process_logits(logits, temperature=1.0, top_k=0, top_p=1.0):
+    """Standard logits pipeline (reference: generation/logits_process.py
+    TemperatureLogitsWarper, TopKProcess, TopPProcess). logits: (b, v).
+    Filtered-out entries are set to -1e9 so Gumbel-max never picks
+    them. Pure tensor ops — safe under jit."""
+    if temperature != 1.0:
+        if temperature <= 0:
+            raise ValueError(f"temperature must be > 0, got {temperature}")
+        logits = logits / float(temperature)
+    v = logits.shape[-1]
+    if top_k and 0 < top_k < v:
+        kth = T.topk(logits, top_k, axis=-1)[0][:, -1:]      # (b, 1)
+        logits = T.where(logits < kth,
+                         T.full_like(logits, -1e9), logits)
+    if top_p < 1.0:
+        sorted_logits = T.sort(logits, axis=-1, descending=True)
+        probs = paddle_tpu.nn.functional.softmax(sorted_logits, axis=-1)
+        cum = T.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p
+        # (always keep the top-1 token)
+        keep_sorted = cum - probs < top_p
+        # threshold logit = smallest kept logit per row
+        thresh = T.min(
+            T.where(keep_sorted, sorted_logits,
+                    T.full_like(sorted_logits, float("inf"))),
+            axis=-1, keepdim=True)
+        logits = T.where(logits < thresh,
+                         T.full_like(logits, -1e9), logits)
+    return logits
+
+
+def _select_token(logits, do_sample, temperature, top_k, top_p, noise):
+    """(b, v) logits -> (b,) int32 next ids. Sampling = Gumbel-max over
+    the processed logits with host-supplied noise (see module doc)."""
+    if do_sample:
+        logits = process_logits(logits, temperature, top_k, top_p)
+        logits = logits + noise
+    return T.cast(T.argmax(logits, axis=-1), "int32")
+
+
+def _model_supports_cache(model):
+    try:
+        sig = inspect.signature(type(model).forward)
+    except (TypeError, ValueError):
+        return False
+    return "caches" in sig.parameters
+
+
+def _gumbel(rng, shape):
+    u = rng.uniform(1e-9, 1.0, size=shape).astype("float32")
+    return -np.log(-np.log(u))
+
+
+def generate_stream(model, input_ids, max_new_tokens=32, *,
+                    eos_token_id=None, pad_token_id=0, do_sample=False,
+                    temperature=1.0, top_k=0, top_p=1.0, use_cache=True,
+                    seed=None):
+    """Yield one (batch,) numpy int32 array of token ids per generated
+    position. Sequences that hit `eos_token_id` keep yielding
+    `pad_token_id`; the stream ends early once ALL sequences finished.
+    This iterator is the serving streaming surface (PredictorServer
+    SSE / C API callback ride on it)."""
+    ids = input_ids if isinstance(input_ids, Tensor) \
+        else paddle_tpu.to_tensor(np.asarray(input_ids, "int32"))
+    if ids.dtype not in ("int32", "int64"):
+        raise ValueError(f"input_ids must be integer ids, got {ids.dtype}")
+    b, s = ids.shape[0], ids.shape[1]
+    rng = np.random.RandomState(seed)
+    use_cache = use_cache and _model_supports_cache(model)
+
+    was_training = getattr(model, "training", False)
+    model.eval()
+    try:
+        with paddle_tpu.no_grad():
+            if use_cache:
+                yield from _stream_cached(
+                    model, ids, b, s, max_new_tokens, eos_token_id,
+                    pad_token_id, do_sample, temperature, top_k, top_p,
+                    rng)
+            else:
+                yield from _stream_recompute(
+                    model, ids, b, s, max_new_tokens, eos_token_id,
+                    pad_token_id, do_sample, temperature, top_k, top_p,
+                    rng)
+    finally:
+        if was_training:
+            model.train()
+
+
+def _finish_step(tok, finished, eos_token_id, pad_token_id):
+    """Host-side eos bookkeeping: returns (emitted tokens, finished)."""
+    if eos_token_id is None:
+        return tok, finished
+    tok = np.where(finished, pad_token_id, tok)
+    finished = finished | (tok == eos_token_id)
+    return tok, finished
+
+
+def _stream_cached(model, ids, b, s, max_new_tokens, eos_token_id,
+                   pad_token_id, do_sample, temperature, top_k, top_p,
+                   rng):
+    max_len = s + max_new_tokens
+    caches = init_kv_cache(model, b, max_len)
+    vocab = None
+
+    def prefill(ids_t, caches):
+        pos = T.unsqueeze(T.arange(0, s, dtype="int32"), 0)
+        logits, caches = model(ids_t, position_ids=pos, caches=caches,
+                               cache_index=paddle_tpu.to_tensor(0, dtype="int32"))
+        return logits[:, -1], caches
+
+    def decode(tok_t, index_t, caches, noise_t):
+        pos = T.reshape(index_t, [1, 1])
+        logits, caches = model(T.reshape(tok_t, [b, 1]),
+                               position_ids=pos, caches=caches,
+                               cache_index=index_t)
+        nxt = _select_token(logits[:, -1], do_sample, temperature,
+                            top_k, top_p, noise_t)
+        return nxt, caches
+
+    sf_prefill = paddle_tpu.jit.to_static(prefill)
+    sf_decode = paddle_tpu.jit.to_static(decode)
+
+    last_logits, caches = sf_prefill(ids, caches)
+    vocab = last_logits.shape[-1]
+    noise = paddle_tpu.to_tensor(_gumbel(rng, (b, vocab)))
+    tok_t = _select_token(last_logits, do_sample, temperature, top_k,
+                          top_p, noise)
+    finished = np.zeros((b,), bool)
+    tok = np.asarray(tok_t.numpy(), "int32").reshape(b)
+    tok, finished = _finish_step(tok, finished, eos_token_id,
+                                 pad_token_id)
+    yield tok
+    for step in range(1, max_new_tokens):
+        if finished.all():
+            return
+        index_t = paddle_tpu.to_tensor(s + step - 1, dtype="int32")
+        noise = paddle_tpu.to_tensor(_gumbel(rng, (b, vocab)))
+        tok_t, caches = sf_decode(
+            paddle_tpu.to_tensor(tok.astype("int32")), index_t, caches,
+            noise)
+        tok = np.asarray(tok_t.numpy(), "int32").reshape(b)
+        tok, finished = _finish_step(tok, finished, eos_token_id,
+                                     pad_token_id)
+        yield tok
+
+
+def _stream_recompute(model, ids, b, s, max_new_tokens, eos_token_id,
+                      pad_token_id, do_sample, temperature, top_k, top_p,
+                      rng):
+    """Cache-less fallback: re-run the full prefix per token. Works with
+    ANY CausalLM forward(input_ids)->logits; each step recompiles (the
+    prefix grows), so this is the correctness/compat path, not the
+    serving path."""
+    cur = ids
+    finished = np.zeros((b,), bool)
+    for _ in range(max_new_tokens):
+        if finished.all():
+            return
+        logits = model(cur)
+        if isinstance(logits, tuple):
+            logits = logits[-1]
+        last = logits[:, -1]
+        noise = paddle_tpu.to_tensor(_gumbel(rng, tuple(last.shape)))
+        tok_t = _select_token(last, do_sample, temperature, top_k, top_p,
+                              noise)
+        tok = np.asarray(tok_t.numpy(), "int32").reshape(b)
+        tok, finished = _finish_step(tok, finished, eos_token_id,
+                                     pad_token_id)
+        yield tok
+        cur = T.concat(
+            [cur, paddle_tpu.to_tensor(
+                tok.reshape(b, 1).astype(str(cur.dtype)))], axis=1)
+
+
+def generate(model, input_ids, max_new_tokens=32, **kwargs):
+    """Batch generation: returns an int32 Tensor
+    (batch, prompt_len + n_generated) of prompt + generated ids
+    (n_generated <= max_new_tokens when every sequence hits eos early).
+    Keyword args as in generate_stream."""
+    ids = input_ids if isinstance(input_ids, Tensor) \
+        else paddle_tpu.to_tensor(np.asarray(input_ids, "int32"))
+    steps = list(generate_stream(model, ids, max_new_tokens, **kwargs))
+    prompt = np.asarray(ids.numpy(), "int32")
+    if not steps:
+        return paddle_tpu.to_tensor(prompt)
+    gen = np.stack(steps, axis=1).astype("int32")
+    return paddle_tpu.to_tensor(np.concatenate([prompt, gen], axis=1))
+
+
+# -- deployment bundle: exported prefill + decode programs -------------------
+#
+# jit.save exports ONE program; generation needs TWO (prefill fills the
+# cache from the prompt, the decode step advances one token). The bundle
+# is the serving artifact the PredictorServer /generate endpoint and the
+# C API PT_Generator* surface load — StableHLO + params + a meta json,
+# the same philosophy as the .pdmodel/.pdiparams pair (reference: the
+# inference programs PaddleNLP exports for its fused decode).
+
+def _np_process_logits(logits, temperature, top_k, top_p):
+    """numpy twin of process_logits for loaded-bundle hosts (no model,
+    no tape — the exported programs return raw logits)."""
+    x = np.asarray(logits, "float32")
+    if temperature != 1.0:
+        if temperature <= 0:
+            raise ValueError(f"temperature must be > 0, got {temperature}")
+        x = x / float(temperature)
+    v = x.shape[-1]
+    if top_k and 0 < top_k < v:
+        kth = np.sort(x, axis=-1)[:, -top_k][:, None]
+        x = np.where(x < kth, -1e9, x)
+    if top_p < 1.0:
+        s = np.sort(x, axis=-1)[:, ::-1]
+        e = np.exp(s - s.max(-1, keepdims=True))
+        probs = e / e.sum(-1, keepdims=True)
+        cum = np.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p
+        masked = np.where(keep, s, np.inf)
+        thresh = masked.min(-1, keepdims=True)
+        x = np.where(x < thresh, -1e9, x)
+    return x
+
+
+def _np_select_token(logits, do_sample, temperature, top_k, top_p, rng):
+    x = np.asarray(logits, "float32")
+    if do_sample:
+        x = _np_process_logits(x, temperature, top_k, top_p)
+        x = x + _gumbel(rng, x.shape)
+    return x.argmax(-1).astype("int32")
+
+
+def export_generation_bundle(model, path, batch_size, prompt_len,
+                             max_new_tokens):
+    """Export `model` (cache-capable CausalLM) as a generation bundle:
+    `path.prefill.pdmodel` + `path.decode.pdmodel` (StableHLO via
+    jax.export), `path.pdiparams` (params), `path.genmeta` (shape/config
+    json). Shapes are static: (batch_size, prompt_len) prompts,
+    prompt_len + max_new_tokens cache slots."""
+    import json
+
+    import jax
+
+    from paddle_tpu.core.tape import no_grad
+    from paddle_tpu.jit.functional import _swapped, state_arrays
+
+    if not _model_supports_cache(model):
+        raise ValueError(f"{type(model).__name__} has no caches= support; "
+                         "the bundle needs the KV-cache decode path")
+    cfg = model.config
+    b, s = batch_size, prompt_len
+    max_len = s + max_new_tokens
+    state = state_arrays(model)
+    caches = init_kv_cache(model, b, max_len)
+    cache_avals = [jax.ShapeDtypeStruct(tuple(c._value.shape),
+                                        c._value.dtype)
+                   for kv in caches for c in kv]
+    n_layers = len(caches)
+
+    def pack(flat):
+        return [(Tensor(flat[2 * i]), Tensor(flat[2 * i + 1]))
+                for i in range(n_layers)]
+
+    def prefill_pure(state_, ids, *cache_flat):
+        pos = T.unsqueeze(T.arange(0, s, dtype="int32"), 0)
+        with no_grad(), _swapped(model, state_):
+            logits, new_caches = model(
+                Tensor(ids), position_ids=pos, caches=pack(cache_flat),
+                cache_index=Tensor(jnp.zeros((), jnp.int32)))
+        flat = [c._value for kv in new_caches for c in kv]
+        return (logits[:, -1]._value, *flat)
+
+    def decode_pure(state_, tok, index, *cache_flat):
+        pos = T.reshape(Tensor(index), [1, 1])
+        with no_grad(), _swapped(model, state_):
+            logits, new_caches = model(
+                Tensor(tok), position_ids=pos, caches=pack(cache_flat),
+                cache_index=Tensor(index))
+        flat = [c._value for kv in new_caches for c in kv]
+        return (logits[:, -1]._value, *flat)
+
+    ids_aval = jax.ShapeDtypeStruct((b, s), jnp.int32)
+    tok_aval = jax.ShapeDtypeStruct((b, 1), jnp.int32)
+    idx_aval = jax.ShapeDtypeStruct((), jnp.int32)
+    exp_prefill = jax.export.export(jax.jit(prefill_pure))(
+        state, ids_aval, *cache_avals)
+    exp_decode = jax.export.export(jax.jit(decode_pure))(
+        state, tok_aval, idx_aval, *cache_avals)
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".prefill.pdmodel", "wb") as f:
+        f.write(exp_prefill.serialize())
+    with open(path + ".decode.pdmodel", "wb") as f:
+        f.write(exp_decode.serialize())
+    from paddle_tpu.framework.io_utils import save as _save
+    _save(model.state_dict(), path + ".pdiparams")
+    with open(path + ".genmeta", "w") as f:
+        json.dump({"batch_size": b, "prompt_len": s,
+                   "max_new_tokens": max_new_tokens,
+                   "num_layers": n_layers,
+                   "cache_shape": list(cache_avals[0].shape),
+                   "cache_dtype": str(cache_avals[0].dtype),
+                   "vocab_size": cfg.vocab_size}, f)
+    return path
+
+
+class GenerationPredictor:
+    """Load + drive an exported generation bundle: the serving twin of
+    inference.Predictor for autoregressive decode. stream() yields one
+    (batch,) int32 array per token — the surface the HTTP /generate
+    endpoint and the C API callback ride."""
+
+    def __init__(self, path):
+        import json
+
+        import jax
+
+        with open(path + ".prefill.pdmodel", "rb") as f:
+            self._prefill = jax.export.deserialize(f.read())
+        with open(path + ".decode.pdmodel", "rb") as f:
+            self._decode = jax.export.deserialize(f.read())
+        with open(path + ".genmeta") as f:
+            self.meta = json.load(f)
+        from paddle_tpu.framework.io_utils import load as _load
+        sd = _load(path + ".pdiparams")
+        self._state = {k: (v._value if isinstance(v, Tensor)
+                           else np.asarray(v)) for k, v in sd.items()}
+
+    def stream(self, input_ids, max_new_tokens=None, *, eos_token_id=None,
+               pad_token_id=0, do_sample=False, temperature=1.0, top_k=0,
+               top_p=1.0, seed=None):
+        m = self.meta
+        ids = np.asarray(input_ids, "int32")
+        if ids.shape != (m["batch_size"], m["prompt_len"]):
+            raise ValueError(
+                f"bundle expects prompt shape "
+                f"({m['batch_size']}, {m['prompt_len']}), got {ids.shape}"
+                " — pad/trim client-side (exported programs are "
+                "shape-monomorphic)")
+        steps = max_new_tokens or m["max_new_tokens"]
+        if steps > m["max_new_tokens"]:
+            raise ValueError(
+                f"bundle cache holds {m['max_new_tokens']} new tokens, "
+                f"asked for {steps}")
+        rng = np.random.RandomState(seed)
+        b, s = ids.shape
+        caches = [np.zeros(m["cache_shape"], m["cache_dtype"])
+                  for _ in range(2 * m["num_layers"])]
+        out = self._prefill.call(self._state, ids, *caches)
+        logits, caches = np.asarray(out[0]), list(out[1:])
+        tok = _np_select_token(logits, do_sample, temperature, top_k,
+                               top_p, rng)
+        finished = np.zeros((b,), bool)
+        tok, finished = _finish_step(tok, finished, eos_token_id,
+                                     pad_token_id)
+        yield tok
+        for step in range(1, steps):
+            if finished.all():
+                return
+            out = self._decode.call(
+                self._state, tok.reshape(b, 1).astype("int32"),
+                np.int32(s + step - 1), *caches)
+            logits, caches = np.asarray(out[0]), list(out[1:])
+            tok = _np_select_token(logits, do_sample, temperature, top_k,
+                                   top_p, rng)
+            tok, finished = _finish_step(tok, finished, eos_token_id,
+                                         pad_token_id)
+            yield tok
+
+    def generate(self, input_ids, max_new_tokens=None, **kwargs):
+        steps = list(self.stream(input_ids, max_new_tokens, **kwargs))
+        prompt = np.asarray(input_ids, "int32")
+        if not steps:
+            return prompt
+        return np.concatenate([prompt, np.stack(steps, 1)], axis=1)

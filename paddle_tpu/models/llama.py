@@ -126,7 +126,8 @@ class LlamaAttention(nn.Layer):
                                     bias_attr=False)
         self.o_proj = nn.Linear(d, d, weight_attr=attr, bias_attr=False)
 
-    def forward(self, hidden_states, position_ids=None, attn_mask=None):
+    def forward(self, hidden_states, position_ids=None, attn_mask=None,
+                cache=None, cache_index=None):
         cfg = self.config
         b, s = hidden_states.shape[0], hidden_states.shape[1]
         if cfg.fuse_attention_qkv:
@@ -144,6 +145,33 @@ class LlamaAttention(nn.Layer):
         q, k, _ = fused_rotary_position_embedding(
             q, k, None, position_ids=position_ids,
             rotary_emb_base=cfg.rope_theta)
+        if cache is not None:
+            # incremental decode (models/generation.py): write this
+            # step's k/v into the fixed-size buffer at cache_index,
+            # then attend over the whole buffer under a position mask
+            # (key j visible to query i iff j <= cache_index + i)
+            from paddle_tpu.models.generation import kv_cache_update
+            k_buf = kv_cache_update(cache[0], k, cache_index)
+            v_buf = kv_cache_update(cache[1], v, cache_index)
+            kl = k_buf.shape[1]
+            k_pos = T.arange(0, kl, dtype="int32")
+            q_pos = T.reshape(
+                cache_index + T.arange(0, s, dtype="int32"), [s, 1])
+            mask = T.unsqueeze(
+                T.unsqueeze(T.unsqueeze(k_pos, 0) <= q_pos, 0), 0)
+            if attn_mask is not None:
+                # combine a user padding mask (bool keep-mask or
+                # additive float, broadcastable over (b, h, s, kl))
+                # with the position mask instead of dropping it
+                if "bool" in str(attn_mask.dtype):
+                    mask = T.logical_and(mask, attn_mask)
+                else:
+                    mask = T.cast(mask, "float32") * 1e9 - 1e9 \
+                        + attn_mask
+            out = F.scaled_dot_product_attention(
+                q, k_buf, v_buf, attn_mask=mask)
+            out = T.reshape(out, [b, s, cfg.hidden_size])
+            return self.o_proj(out), (k_buf, v_buf)
         if cfg.use_flash_attention and attn_mask is None:
             out, _ = F.flash_attention(q, k, v, causal=True)
         else:
@@ -190,15 +218,24 @@ class LlamaDecoderLayer(nn.Layer):
         self.post_attention_layernorm = RMSNorm(config.hidden_size,
                                                 epsilon=config.rms_norm_eps)
 
-    def forward(self, hidden_states, position_ids=None, attn_mask=None):
+    def forward(self, hidden_states, position_ids=None, attn_mask=None,
+                cache=None, cache_index=None):
         residual = hidden_states
         h = self.input_layernorm(hidden_states)
-        h = self.self_attn(h, position_ids=position_ids, attn_mask=attn_mask)
+        new_cache = None
+        if cache is not None:
+            h, new_cache = self.self_attn(
+                h, position_ids=position_ids, attn_mask=attn_mask,
+                cache=cache, cache_index=cache_index)
+        else:
+            h = self.self_attn(h, position_ids=position_ids,
+                               attn_mask=attn_mask)
         h = residual + h
         residual = h
         h2 = self.post_attention_layernorm(h)
         h2 = self.mlp(h2)
-        return residual + h2
+        out = residual + h2
+        return out if cache is None else (out, new_cache)
 
 
 class LlamaModel(nn.Layer):
@@ -214,9 +251,18 @@ class LlamaModel(nn.Layer):
              for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids, position_ids=None, attn_mask=None):
+    def forward(self, input_ids, position_ids=None, attn_mask=None,
+                caches=None, cache_index=None):
         from paddle_tpu.distributed.recompute import recompute
         h = self.embed_tokens(input_ids)
+        if caches is not None:
+            new_caches = []
+            for layer, cache in zip(self.layers, caches):
+                h, c = layer(h, position_ids=position_ids,
+                             attn_mask=attn_mask, cache=cache,
+                             cache_index=cache_index)
+                new_caches.append(c)
+            return self.norm(h), new_caches
         for layer in self.layers:
             if self.config.recompute and self.training:
                 h = recompute(layer, h, position_ids=position_ids,
@@ -250,7 +296,15 @@ class LlamaForCausalLM(nn.Layer):
         return self.lm_head(hidden)
 
     def forward(self, input_ids, labels=None, position_ids=None,
-                attn_mask=None):
+                attn_mask=None, caches=None, cache_index=None):
+        if caches is not None:
+            if labels is not None:
+                raise ValueError("KV-cache decode is inference-only; "
+                                 "drop labels or caches")
+            h, caches = self.model(input_ids, position_ids=position_ids,
+                                   attn_mask=attn_mask, caches=caches,
+                                   cache_index=cache_index)
+            return self.logits(h), caches
         h = self.model(input_ids, position_ids=position_ids,
                        attn_mask=attn_mask)
         logits = self.logits(h)
@@ -258,6 +312,12 @@ class LlamaForCausalLM(nn.Layer):
             return logits
         loss = next_token_loss(logits, labels, self.config.vocab_size)
         return loss, logits
+
+    def generate(self, input_ids, max_new_tokens=32, **kwargs):
+        """KV-cache autoregressive generation (PaddleNLP
+        GenerationMixin.generate equivalent; see models/generation.py)."""
+        from paddle_tpu.models.generation import generate
+        return generate(self, input_ids, max_new_tokens, **kwargs)
 
 
 def param_count(config: LlamaConfig) -> int:

@@ -46,6 +46,13 @@ def _sdpa_ref(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
         else:
             scores = scores + attn_mask.astype(scores.dtype)
     probs = jax.nn.softmax(scores, axis=-1).astype(qt.dtype)
+    if is_causal or attn_mask is not None:
+        # a fully-masked query row (e.g. a left-padded position under a
+        # padding mask) softmaxes all -inf to NaN; emit 0 instead, the
+        # flash-kernel convention — NaN here would poison downstream
+        # residuals and any KV cache written from them
+        all_masked = jnp.isneginf(scores).all(-1, keepdims=True)
+        probs = jnp.where(all_masked, 0.0, probs).astype(qt.dtype)
     if dropout_p:
         # layers gate on self.training before passing dropout_p; under jit
         # the key is baked at trace time (fixed mask per compile), matching

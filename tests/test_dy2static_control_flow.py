@@ -265,3 +265,49 @@ def test_while_carry_bound_by_if_before_loop():
     sf = paddle.jit.to_static(f)
     x = paddle.to_tensor(np.full((2,), -3.0, "float32"))
     np.testing.assert_allclose(sf(x).numpy(), f(x).numpy())
+
+
+def test_nested_tail_return_ifs_with_emitted_helpers():
+    """Regression (r3): NESTED tail-return ifs make the transformer emit
+    _pt_true/_pt_false helper defs inside an extracted branch body; the
+    read-before-write analysis must treat a nested def as BINDING its
+    name (and its body's free reads as reads), else the helper name
+    leaks into the call-site parameter tuple -> NameError at runtime."""
+    from paddle_tpu.jit.dy2static import ast_transform
+
+    def f(x, mode=None, extra=None):
+        if mode is not None:
+            if extra is not None:
+                return x * 3.0 + extra
+            return x * 2.0
+        y = x + 1.0
+        if y.sum() > 1e9:           # Tensor predicate -> lax.cond
+            return y * 10.0
+        return y
+
+    g = ast_transform(f)
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    np.testing.assert_allclose(g(x).numpy(), 2.0)
+    np.testing.assert_allclose(g(x, mode="m").numpy(), 2.0 * 1.0)
+    e = paddle.to_tensor(np.ones((2, 2), "float32"))
+    np.testing.assert_allclose(g(x, mode="m", extra=e).numpy(), 4.0)
+
+
+def test_nested_def_default_arg_reads_outer_name():
+    """A nested def's DEFAULT VALUE evaluates at def time: a name it
+    reads must be fed into the extracted tail-return branch function
+    (code-review r3 finding on the nested-def scan)."""
+    from paddle_tpu.jit.dy2static import ast_transform
+
+    def f(x, mode=None):
+        base = x * 2.0
+        if mode is not None:
+            def h(v=base):
+                return v + 1.0
+            return h()
+        return base
+
+    g = ast_transform(f)
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    np.testing.assert_allclose(g(x).numpy(), 2.0)
+    np.testing.assert_allclose(g(x, mode="m").numpy(), 3.0)

@@ -133,3 +133,73 @@ def test_c_api_end_to_end(tmp_path):
         shape=out_shape).copy()
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
     lib.PT_PredictorDestroy(p)
+
+
+def test_c_api_generator_streaming(tmp_path):
+    """PT_GeneratorCreate/Stream: callback receives one token batch per
+    generated position (parity with live generate) and a nonzero
+    callback return cancels the stream."""
+    from paddle_tpu.models import LlamaForCausalLM, generate
+    from paddle_tpu.models.llama import tiny_llama_config
+    from paddle_tpu.models.generation import export_generation_bundle
+    from paddle_tpu.inference import capi
+
+    paddle.seed(0)
+    m = LlamaForCausalLM(tiny_llama_config(num_hidden_layers=2))
+    m.eval()
+    prompt = np.ascontiguousarray(
+        np.random.RandomState(0).randint(0, 256, (2, 8)), dtype=np.int32)
+    path = str(tmp_path / "g")
+    export_generation_bundle(m, path, batch_size=2, prompt_len=8,
+                             max_new_tokens=5)
+    ref = generate(m, paddle.to_tensor(prompt),
+                   max_new_tokens=5).numpy()[:, 8:]
+
+    so = capi.build(str(tmp_path / "capi"))
+    lib = ctypes.CDLL(so)
+    CB = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+                          ctypes.c_int, ctypes.c_int, ctypes.c_void_p)
+    lib.PT_GeneratorCreate.restype = ctypes.c_void_p
+    lib.PT_GeneratorCreate.argtypes = [ctypes.c_char_p]
+    lib.PT_GeneratorDestroy.argtypes = [ctypes.c_void_p]
+    lib.PT_GeneratorStream.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+        ctypes.c_int, ctypes.c_double, ctypes.c_int, ctypes.c_longlong,
+        CB, ctypes.c_void_p]
+    lib.PT_LastError.restype = ctypes.c_char_p
+
+    g = lib.PT_GeneratorCreate(path.encode())
+    assert g, lib.PT_LastError()
+
+    got, steps_seen = [], []
+
+    @CB
+    def on_tok(toks, batch, step, user):
+        got.append([toks[i] for i in range(batch)])
+        steps_seen.append(step)
+        return 0
+
+    pp = prompt.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    n = lib.PT_GeneratorStream(g, pp, 2, 8, 5, 0, 1.0, 0, 1.0, -1, -1,
+                               on_tok, None)
+    assert n == 5, (n, lib.PT_LastError())
+    assert steps_seen == [0, 1, 2, 3, 4]
+    np.testing.assert_array_equal(np.array(got, np.int32).T, ref)
+
+    # cancel from the callback
+    count = []
+
+    @CB
+    def cancel(toks, batch, step, user):
+        count.append(step)
+        return 1 if step >= 1 else 0
+
+    n2 = lib.PT_GeneratorStream(g, pp, 2, 8, 5, 0, 1.0, 0, 1.0, -1, -1,
+                                cancel, None)
+    assert n2 == 2 and count == [0, 1]
+
+    # bad bundle path reports through PT_LastError
+    assert not lib.PT_GeneratorCreate(b"/nonexistent/bundle")
+    assert lib.PT_LastError()
+    lib.PT_GeneratorDestroy(g)

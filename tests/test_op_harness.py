@@ -1746,7 +1746,19 @@ SPECS.update({
         lambda rng: [np.array([2, 4], "int32"), np.array([3, 4], "int32")],
         lambda ql, kl: _varlen_mask_np(ql, kl, 4, 4, True),
         kwargs=dict(sq=4, sk=4, causal=True), grad=False, bf16=False),
+    "kv_cache_update": Spec(
+        lambda rng: [np.zeros((2, 6, 2, 3), "float32"),
+                     rng.randn(2, 2, 2, 3).astype("float32"),
+                     np.int32(3)],
+        lambda buf, new, idx: _kv_cache_update_np(buf, new, idx),
+        grad=False, bf16=False),
 })
+
+
+def _kv_cache_update_np(buf, new, idx):
+    out = buf.copy()
+    out[:, int(idx):int(idx) + new.shape[1]] = new
+    return out
 
 
 def _varlen_mask_np(ql, kl, sq, sk, causal):
