@@ -34,7 +34,7 @@ all_to_all = alltoall  # torch-style alias the reference also exposes
 def __getattr__(name):
     import importlib
     if name in ("fleet", "checkpoint", "pipeline", "launch", "parallel",
-                "sharding", "elastic", "auto_tuner", "rpc",
+                "sharding", "elastic", "auto_tuner", "rpc", "ps",
                 "auto_parallel", "watchdog"):
         mod = importlib.import_module(f"paddle_tpu.distributed.{name}")
         globals()[name] = mod

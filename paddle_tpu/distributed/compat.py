@@ -169,18 +169,22 @@ def gloo_release():
     return None
 
 
-# -- parameter-server surfaces (OUT OF SCOPE per SURVEY.md §2.5: recsys
-# CPU/GPU-hybrid PS is documented-only; these raise with that pointer) ----
+# -- parameter-server dataset surfaces (the brpc-PS *dataset* pipeline
+# stays out of scope per SURVEY.md §2.5; the PS capability core — sparse
+# tables, pull/push, server-side optimizers — lives in
+# paddle_tpu.distributed.ps) ----------------------------------------------
 
 class _PSOnly:
     _NAME = "?"
 
     def __init__(self, *a, **k):
         raise NotImplementedError(
-            f"{self._NAME} belongs to the brpc parameter-server stack "
-            f"(reference paddle/fluid/distributed/ps/), which SURVEY.md "
-            f"§2.5 scopes out of the TPU rebuild; use paddle_tpu.io "
-            f"datasets + GSPMD data parallelism instead")
+            f"{self._NAME} belongs to the brpc parameter-server DATASET "
+            f"pipeline (reference paddle/fluid/distributed/ps/), which "
+            f"SURVEY.md §2.5 scopes out of the TPU rebuild; for sparse "
+            f"embedding tables use paddle_tpu.distributed.ps "
+            f"(PSServer/PSClient/DistributedEmbedding), and paddle_tpu.io "
+            f"datasets + GSPMD data parallelism for the input pipeline")
 
 
 class InMemoryDataset(_PSOnly):

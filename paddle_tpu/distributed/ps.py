@@ -1,0 +1,396 @@
+"""Minimal parameter server for giant sparse embeddings, TPU-native.
+
+Reference: the brpc parameter-server stack
+(`paddle/fluid/distributed/ps/` — `table/common_sparse_table.cc`,
+`ps_client/brpc_ps_client.cc`) behind
+`paddle.distributed.fleet` PS mode and
+`paddle.static.nn.sparse_embedding`: CPU hosts hold sharded sparse
+tables far bigger than accelerator memory; workers PULL the rows a batch
+touches and PUSH sparse gradients back; servers apply the optimizer
+row-wise, asynchronously (Hogwild-style) across workers.
+
+SURVEY.md §2.5 scopes the full recsys PS (accessors, brpc, heter
+pipelines) out of the TPU rebuild; this module provides the CAPABILITY
+CORE with TPU-appropriate structure:
+
+- dense model state stays on device under GSPMD — the PS covers only
+  the huge-embedding tail that cannot live in HBM;
+- tables are host-resident python/numpy shards behind the repo's
+  length-prefixed TCP frame protocol (distributed/rpc.py's wire
+  format, persistent connections);
+- ids route to servers by `id % num_servers` (the reference's default
+  hash sharding); rows materialize lazily on first touch with a
+  deterministic per-id initializer so restarts/replicas agree;
+- server-side optimizers: sgd / adagrad (per-row accumulator slot),
+  applied under a per-table lock; concurrent worker pushes interleave
+  like the reference's async mode;
+- `DistributedEmbedding` is the worker-side layer: forward pulls +
+  dedups rows onto device, backward sums duplicate-id cotangents and
+  pushes one sparse grad per row.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["PSServer", "PSClient", "DistributedEmbedding"]
+
+_MAGIC = 0x9E3779B97F4A7C15     # splitmix64 increment (deterministic init)
+
+
+def _send_frame(sock, data: bytes):
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_frame(sock) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("ps peer closed")
+        hdr += chunk
+    n = struct.unpack("<Q", hdr)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("ps peer closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def _init_row(table_seed: int, row_id: int, dim: int,
+              scale: float) -> np.ndarray:
+    """Deterministic per-id row init (splitmix64-seeded uniform): every
+    server/replica/restart materializes the same row for the same id —
+    the property the reference gets from initializing at table load."""
+    x = (row_id * _MAGIC + table_seed) & 0xFFFFFFFFFFFFFFFF
+    rng = np.random.RandomState([(x >> 32) & 0xFFFFFFFF, x & 0xFFFFFFFF])
+    return rng.uniform(-scale, scale, dim).astype("float32")
+
+
+class _Table:
+    """One sparse table shard: {id -> row} + optimizer slots.
+
+    reference: common_sparse_table.cc stores rows in shard maps with
+    per-row optimizer state; pull_sparse/push_sparse apply the update
+    server-side."""
+
+    def __init__(self, dim, optimizer="adagrad", lr=0.05, init_scale=0.01,
+                 eps=1e-8, seed=0):
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError(f"unknown table optimizer {optimizer!r}")
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.eps = float(eps)
+        self.init_scale = float(init_scale)
+        self.seed = int(seed)
+        self.rows: dict[int, np.ndarray] = {}
+        self.slots: dict[int, np.ndarray] = {}
+        self.lock = threading.Lock()
+
+    def _row(self, i: int) -> np.ndarray:
+        r = self.rows.get(i)
+        if r is None:
+            r = _init_row(self.seed, i, self.dim, self.init_scale)
+            self.rows[i] = r
+        return r
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        with self.lock:
+            return np.stack([self._row(int(i)) for i in ids])
+
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        if grads.shape != (len(ids), self.dim):
+            raise ValueError(
+                f"push grads shape {grads.shape} != ({len(ids)}, "
+                f"{self.dim})")
+        with self.lock:
+            for i, g in zip(ids, grads):
+                i = int(i)
+                row = self._row(i)
+                if self.optimizer == "sgd":
+                    row -= self.lr * g
+                else:                       # adagrad
+                    acc = self.slots.get(i)
+                    if acc is None:
+                        acc = np.zeros(self.dim, "float32")
+                        self.slots[i] = acc
+                    acc += g * g
+                    row -= self.lr * g / (np.sqrt(acc) + self.eps)
+
+    def state(self):
+        with self.lock:
+            # deep-copy: the arrays are mutated IN PLACE by push(); a
+            # shallow snapshot pickled outside the lock could serialize
+            # a torn row mid-update
+            return {"rows": {k: v.copy() for k, v in self.rows.items()},
+                    "slots": {k: v.copy() for k, v in self.slots.items()}}
+
+    def load_state(self, st):
+        with self.lock:
+            self.rows = {int(k): np.asarray(v, "float32")
+                         for k, v in st["rows"].items()}
+            self.slots = {int(k): np.asarray(v, "float32")
+                          for k, v in st["slots"].items()}
+
+
+class PSServer:
+    """One parameter-server process/thread hosting table shards.
+
+    Ops (pickled frames, persistent connection): create_table, pull,
+    push, stats, save, load, ping. Start with `.start()`; endpoint is
+    `host:port`."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._tables: dict[str, _Table] = {}
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host = host
+        self.port = self._sock.getsockname()[1]
+        self.endpoint = f"{self.host}:{self.port}"
+        self._running = False
+        self._thread = None
+
+    # -- op handlers -------------------------------------------------------
+    def _handle(self, op, payload):
+        if op == "ping":
+            return "pong"
+        if op == "create_table":
+            name = payload["name"]
+            if name not in self._tables:   # idempotent across workers
+                cfg = {k: v for k, v in payload.items() if k != "name"}
+                self._tables[name] = _Table(**cfg)
+            return True
+        t = self._tables.get(payload.get("table"))
+        if t is None and op in ("pull", "push", "stats"):
+            raise KeyError(f"no table {payload.get('table')!r}; "
+                           f"known: {sorted(self._tables)}")
+        if op == "pull":
+            return t.pull(payload["ids"])
+        if op == "push":
+            t.push(payload["ids"], payload["grads"])
+            return True
+        if op == "stats":
+            with t.lock:
+                return {"rows": len(t.rows), "dim": t.dim,
+                        "optimizer": t.optimizer}
+        if op == "save":
+            path = payload["path"]
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "wb") as f:
+                pickle.dump({n: tb.state()
+                             for n, tb in self._tables.items()}, f)
+            return True
+        if op == "load":
+            with open(payload["path"], "rb") as f:
+                states = pickle.load(f)
+            for n, st in states.items():
+                if n in self._tables:
+                    self._tables[n].load_state(st)
+            return True
+        raise ValueError(f"unknown ps op {op!r}")
+
+    # -- transport ---------------------------------------------------------
+    def _serve_conn(self, conn):
+        try:
+            with conn:
+                while True:
+                    req = _recv_frame(conn)
+                    op, payload = pickle.loads(req)
+                    try:
+                        _send_frame(conn, pickle.dumps(
+                            (True, self._handle(op, payload))))
+                    except Exception as e:      # noqa: BLE001
+                        import traceback
+                        _send_frame(conn, pickle.dumps(
+                            (False, (repr(e), traceback.format_exc()))))
+        except (ConnectionError, OSError):
+            pass
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def start(self):
+        self._running = True
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class PSClient:
+    """Worker-side client over one or more PSServer endpoints.
+
+    ids route to `endpoints[id % n]` (the reference's hash sharding);
+    pull/push fan out per shard and reassemble in input order."""
+
+    def __init__(self, endpoints):
+        if isinstance(endpoints, str):
+            endpoints = [endpoints]
+        self.endpoints = list(endpoints)
+        self._conns = [None] * len(self.endpoints)
+        self._locks = [threading.Lock() for _ in self.endpoints]
+
+    def _call(self, shard, op, payload):
+        with self._locks[shard]:
+            if self._conns[shard] is None:
+                host, port = self.endpoints[shard].rsplit(":", 1)
+                self._conns[shard] = socket.create_connection(
+                    (host, int(port)), timeout=60)
+            try:
+                _send_frame(self._conns[shard],
+                            pickle.dumps((op, payload)))
+                ok, res = pickle.loads(_recv_frame(self._conns[shard]))
+            except (ConnectionError, OSError):
+                self._conns[shard] = None       # reconnect next call
+                raise
+        if not ok:
+            err, tb = res
+            raise RuntimeError(
+                f"ps server {self.endpoints[shard]} failed: {err}\n"
+                f"remote traceback:\n{tb}")
+        return res
+
+    # -- table lifecycle ---------------------------------------------------
+    def create_table(self, name, dim, optimizer="adagrad", lr=0.05,
+                     init_scale=0.01, seed=0):
+        for s in range(len(self.endpoints)):
+            self._call(s, "create_table",
+                       {"name": name, "dim": dim, "optimizer": optimizer,
+                        "lr": lr, "init_scale": init_scale, "seed": seed})
+
+    def _route(self, ids):
+        ids = np.asarray(ids, "int64").reshape(-1)
+        shard = ids % len(self.endpoints)
+        return ids, shard
+
+    def pull(self, table, ids) -> np.ndarray:
+        ids, shard = self._route(ids)
+        out = None
+        for s in range(len(self.endpoints)):
+            m = shard == s
+            if not m.any():
+                continue
+            rows = self._call(s, "pull", {"table": table, "ids": ids[m]})
+            if out is None:
+                out = np.empty((len(ids), rows.shape[1]), "float32")
+            out[m] = rows
+        return out if out is not None else np.empty((0, 0), "float32")
+
+    def push(self, table, ids, grads):
+        ids, shard = self._route(ids)
+        grads = np.asarray(grads, "float32")
+        for s in range(len(self.endpoints)):
+            m = shard == s
+            if m.any():
+                self._call(s, "push", {"table": table, "ids": ids[m],
+                                       "grads": grads[m]})
+
+    def stats(self, table):
+        return [self._call(s, "stats", {"table": table})
+                for s in range(len(self.endpoints))]
+
+    def save(self, path):
+        """Each shard persists to `path.shard{i}`."""
+        for s in range(len(self.endpoints)):
+            self._call(s, "save", {"path": f"{path}.shard{s}"})
+
+    def load(self, path):
+        for s in range(len(self.endpoints)):
+            self._call(s, "load", {"path": f"{path}.shard{s}"})
+
+    def close(self):
+        for c in self._conns:
+            if c is not None:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+        self._conns = [None] * len(self.endpoints)
+
+
+class DistributedEmbedding:
+    """Worker-side sparse embedding over a PS table (reference:
+    paddle.static.nn.sparse_embedding + the pull/push pair the PS
+    executors insert around it).
+
+    forward(ids) pulls the unique rows the batch touches onto device;
+    backward sums duplicate-id cotangents and pushes ONE sparse grad per
+    row — the server applies its optimizer immediately (async mode).
+    The table's optimizer is server-side: do NOT also hand these rows to
+    a worker optimizer."""
+
+    def __init__(self, client: PSClient, name: str, dim: int,
+                 optimizer="adagrad", lr=0.05, init_scale=0.01, seed=0):
+        from paddle_tpu.core.tensor import Tensor
+        client.create_table(name, dim, optimizer=optimizer, lr=lr,
+                            init_scale=init_scale, seed=seed)
+        self.client = client
+        self.name = name
+        self.dim = int(dim)
+        self.training = True
+        # autograd anchor: PyLayer needs a differentiable INPUT for its
+        # backward to run; the pulled rows themselves enter as data
+        self._gate = Tensor(np.ones((), "float32"), stop_gradient=False)
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        self.training = True
+        return self
+
+    def __call__(self, ids):
+        import paddle_tpu
+        from paddle_tpu.autograd import PyLayer
+        from paddle_tpu.core.tensor import Tensor
+
+        ids_np = np.asarray(
+            ids.numpy() if isinstance(ids, Tensor) else ids, "int64")
+        uniq, inverse = np.unique(ids_np.reshape(-1), return_inverse=True)
+        rows = self.client.pull(self.name, uniq)
+        gathered = rows[inverse].reshape(ids_np.shape + (self.dim,))
+        out_shape = gathered.shape
+        client, name, dim = self.client, self.name, self.dim
+        push = self.training
+
+        class _PullPush(PyLayer):
+            @staticmethod
+            def forward(ctx, gate):
+                emb = paddle_tpu.to_tensor(gathered)
+                return emb * gate
+
+            @staticmethod
+            def backward(ctx, d_out):
+                if push:
+                    g = np.asarray(d_out.numpy(), "float32") \
+                        .reshape(-1, dim)
+                    gsum = np.zeros((len(uniq), dim), "float32")
+                    np.add.at(gsum, inverse, g)
+                    client.push(name, uniq, gsum)
+                return None     # the gate is an anchor, not a weight
+
+        return _PullPush.apply(self._gate)

@@ -302,29 +302,39 @@ class PredictorServer:
         import queue
         q: queue.Queue = queue.Queue()
         _END = object()
+        cancelled = threading.Event()
 
         def produce():
             try:
                 with self._lock:
                     step = 0
                     for tok in it:
+                        if cancelled.is_set():
+                            break       # consumer gone: free the chip
                         q.put({"step": step,
                                "tokens": np.asarray(tok).tolist()})
                         step += 1
-                    q.put({"done": True, "steps": step})
+                    else:
+                        q.put({"done": True, "steps": step})
             except Exception as e:      # noqa: BLE001
                 q.put(e)
             q.put(_END)
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is _END:
-                return
-            if isinstance(item, Exception):
-                raise item
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            # a disconnected /generate client closes this generator;
+            # without the signal the producer would keep decoding (and
+            # holding the chip lock) to max_new_tokens for nobody
+            cancelled.set()
 
     def metadata(self):
         p = self.predictor

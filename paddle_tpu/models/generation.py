@@ -141,6 +141,8 @@ def generate_stream(model, input_ids, max_new_tokens=32, *,
     if ids.dtype not in ("int32", "int64"):
         raise ValueError(f"input_ids must be integer ids, got {ids.dtype}")
     b, s = ids.shape[0], ids.shape[1]
+    if max_new_tokens <= 0:
+        return                      # a 0-token request streams nothing
     rng = np.random.RandomState(seed)
     use_cache = use_cache and _model_supports_cache(model)
 
@@ -172,36 +174,61 @@ def _finish_step(tok, finished, eos_token_id, pad_token_id):
     return tok, finished
 
 
+# compiled prefill/decode step pairs, memoized per model: a serving
+# process pays the XLA trace+compile ONCE per
+# (batch, prompt_len, sampling config), not once per request
+# (StaticFunction._jit_cache is per-instance)
+_STEP_CACHE: "weakref.WeakKeyDictionary" = None     # set below
+
+
+def _compiled_steps(model, b, s, do_sample, temperature, top_k, top_p):
+    global _STEP_CACHE
+    import weakref
+    if _STEP_CACHE is None:
+        _STEP_CACHE = weakref.WeakKeyDictionary()
+    per_model = _STEP_CACHE.setdefault(model, {})
+    key = (b, s, do_sample, temperature, top_k, top_p)
+    if key not in per_model:
+        def prefill(ids_t, caches):
+            pos = T.unsqueeze(T.arange(0, s, dtype="int32"), 0)
+            logits, caches = model(
+                ids_t, position_ids=pos, caches=caches,
+                cache_index=paddle_tpu.to_tensor(0, dtype="int32"))
+            return logits[:, -1], caches
+
+        def decode(tok_t, index_t, caches, noise_t):
+            pos = T.reshape(index_t, [1, 1])
+            logits, caches = model(T.reshape(tok_t, [b, 1]),
+                                   position_ids=pos, caches=caches,
+                                   cache_index=index_t)
+            nxt = _select_token(logits[:, -1], do_sample, temperature,
+                                top_k, top_p, noise_t)
+            return nxt, caches
+
+        per_model[key] = (paddle_tpu.jit.to_static(prefill),
+                          paddle_tpu.jit.to_static(decode))
+    return per_model[key]
+
+
 def _stream_cached(model, ids, b, s, max_new_tokens, eos_token_id,
                    pad_token_id, do_sample, temperature, top_k, top_p,
                    rng):
     max_len = s + max_new_tokens
     caches = init_kv_cache(model, b, max_len)
-    vocab = None
+    sf_prefill, sf_decode = _compiled_steps(
+        model, b, s, do_sample, temperature, top_k, top_p)
 
-    def prefill(ids_t, caches):
-        pos = T.unsqueeze(T.arange(0, s, dtype="int32"), 0)
-        logits, caches = model(ids_t, position_ids=pos, caches=caches,
-                               cache_index=paddle_tpu.to_tensor(0, dtype="int32"))
-        return logits[:, -1], caches
-
-    def decode(tok_t, index_t, caches, noise_t):
-        pos = T.reshape(index_t, [1, 1])
-        logits, caches = model(T.reshape(tok_t, [b, 1]),
-                               position_ids=pos, caches=caches,
-                               cache_index=index_t)
-        nxt = _select_token(logits[:, -1], do_sample, temperature,
-                            top_k, top_p, noise_t)
-        return nxt, caches
-
-    sf_prefill = paddle_tpu.jit.to_static(prefill)
-    sf_decode = paddle_tpu.jit.to_static(decode)
+    def noise_for(vocab):
+        # greedy ignores the noise: feed a scalar zero instead of
+        # generating + transferring a (b, vocab) array per token
+        if not do_sample:
+            return paddle_tpu.to_tensor(np.zeros((), "float32"))
+        return paddle_tpu.to_tensor(_gumbel(rng, (b, vocab)))
 
     last_logits, caches = sf_prefill(ids, caches)
     vocab = last_logits.shape[-1]
-    noise = paddle_tpu.to_tensor(_gumbel(rng, (b, vocab)))
     tok_t = _select_token(last_logits, do_sample, temperature, top_k,
-                          top_p, noise)
+                          top_p, noise_for(vocab))
     finished = np.zeros((b,), bool)
     tok = np.asarray(tok_t.numpy(), "int32").reshape(b)
     tok, finished = _finish_step(tok, finished, eos_token_id,
@@ -211,10 +238,9 @@ def _stream_cached(model, ids, b, s, max_new_tokens, eos_token_id,
         if finished.all():
             return
         index_t = paddle_tpu.to_tensor(s + step - 1, dtype="int32")
-        noise = paddle_tpu.to_tensor(_gumbel(rng, (b, vocab)))
         tok_t, caches = sf_decode(
             paddle_tpu.to_tensor(tok.astype("int32")), index_t, caches,
-            noise)
+            noise_for(vocab))
         tok = np.asarray(tok_t.numpy(), "int32").reshape(b)
         tok, finished = _finish_step(tok, finished, eos_token_id,
                                      pad_token_id)
@@ -414,11 +440,14 @@ class GenerationPredictor:
                 f"({m['batch_size']}, {m['prompt_len']}), got {ids.shape}"
                 " — pad/trim client-side (exported programs are "
                 "shape-monomorphic)")
-        steps = max_new_tokens or m["max_new_tokens"]
+        steps = (m["max_new_tokens"] if max_new_tokens is None
+                 else max_new_tokens)
         if steps > m["max_new_tokens"]:
             raise ValueError(
                 f"bundle cache holds {m['max_new_tokens']} new tokens, "
                 f"asked for {steps}")
+        if steps <= 0:
+            return                  # a 0-token request streams nothing
         rng = np.random.RandomState(seed)
         b, s = ids.shape
         caches = [np.zeros(m["cache_shape"], m["cache_dtype"])

@@ -303,3 +303,57 @@ def test_cache_decode_honors_padding_mask(llama):
                   caches=init_kv_cache(llama, 1, 6),
                   cache_index=paddle.to_tensor(0, "int32"))
     assert np.abs(un.numpy()[:, -1] - logits_m.numpy()[:, -1]).max() > 1e-4
+
+
+def test_zero_max_new_tokens(llama):
+    """max_new_tokens=0 generates nothing on every surface
+    (code-review r3: `or` treated 0 as unset)."""
+    ids = _ids()
+    assert list(generate_stream(llama, ids, 0)) == []
+    out = generate(llama, ids, max_new_tokens=0).numpy()
+    np.testing.assert_array_equal(out, ids)
+
+
+def test_zero_max_new_tokens_bundle(tmp_path, llama):
+    path = str(tmp_path / "z")
+    export_generation_bundle(llama, path, batch_size=2, prompt_len=8,
+                             max_new_tokens=4)
+    gp = GenerationPredictor(path)
+    assert list(gp.stream(_ids(), max_new_tokens=0)) == []
+    np.testing.assert_array_equal(gp.generate(_ids(), max_new_tokens=0),
+                                  _ids())
+
+
+def test_compiled_steps_cached_across_calls(llama):
+    """A second generate() with the same (batch, prompt, sampling)
+    config reuses the SAME compiled prefill/decode pair — serving must
+    not re-trace per request (code-review r3)."""
+    from paddle_tpu.models.generation import _compiled_steps
+    ids = _ids()
+    generate(llama, ids, max_new_tokens=2)
+    pair1 = _compiled_steps(llama, 2, 8, False, 1.0, 0, 1.0)
+    generate(llama, ids, max_new_tokens=3)
+    pair2 = _compiled_steps(llama, 2, 8, False, 1.0, 0, 1.0)
+    assert pair1[0] is pair2[0] and pair1[1] is pair2[1]
+
+
+def test_stream_consumer_disconnect_releases_lock(llama):
+    """Closing the generate_steps consumer (client disconnect) must
+    cancel the producer so the chip lock frees without running the
+    remaining steps (code-review r3)."""
+    import time
+    from paddle_tpu.inference.serving import PredictorServer
+    srv = PredictorServer(lambda d: d, generator=llama)
+    it = srv.generate_steps({"ids": _ids().tolist(),
+                             "max_new_tokens": 200})
+    first = next(it)
+    assert first["step"] == 0
+    it.close()                       # simulated disconnect
+    deadline = time.monotonic() + 30
+    acquired = False
+    while time.monotonic() < deadline:
+        acquired = srv._lock.acquire(timeout=0.5)
+        if acquired:
+            srv._lock.release()
+            break
+    assert acquired, "producer kept the lock after consumer close"

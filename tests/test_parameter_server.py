@@ -1,0 +1,184 @@
+"""Parameter server (reference: paddle/fluid/distributed/ps/
+common_sparse_table.cc + brpc_ps_client.cc behind fleet PS mode and
+paddle.static.nn.sparse_embedding): host-resident sharded sparse tables,
+pull/push with server-side optimizers, async multi-worker updates, and
+the worker-side DistributedEmbedding layer."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.ps import (DistributedEmbedding, PSClient,
+                                       PSServer)
+
+
+@pytest.fixture()
+def cluster():
+    """Two PS shards + a connected client."""
+    servers = [PSServer().start() for _ in range(2)]
+    client = PSClient([s.endpoint for s in servers])
+    yield servers, client
+    client.close()
+    for s in servers:
+        s.stop()
+
+
+def test_pull_deterministic_init_and_sgd_push(cluster):
+    _, c = cluster
+    c.create_table("emb", dim=4, optimizer="sgd", lr=0.5, seed=7)
+    ids = np.array([3, 11, 3, 42])
+    rows = c.pull("emb", ids)
+    assert rows.shape == (4, 4) and rows.dtype == np.float32
+    # same id -> identical row (deterministic lazy init)
+    np.testing.assert_array_equal(rows[0], rows[2])
+    np.testing.assert_array_equal(rows, c.pull("emb", ids))
+    # sgd push applies row -= lr * g exactly
+    g = np.ones((2, 4), "float32")
+    c.push("emb", np.array([3, 11]), g)
+    after = c.pull("emb", np.array([3, 11]))
+    np.testing.assert_allclose(after, rows[:2] - 0.5, rtol=1e-6)
+
+
+def test_sharding_routes_by_id_mod_n(cluster):
+    _, c = cluster
+    c.create_table("t", dim=2)
+    c.pull("t", np.arange(10))          # 5 even ids, 5 odd ids
+    st = c.stats("t")
+    assert [s["rows"] for s in st] == [5, 5]
+    assert all(s["optimizer"] == "adagrad" for s in st)
+
+
+def test_adagrad_accumulates(cluster):
+    _, c = cluster
+    c.create_table("a", dim=3, optimizer="adagrad", lr=1.0, seed=1)
+    i = np.array([8])
+    r0 = c.pull("a", i).copy()
+    g = np.full((1, 3), 2.0, "float32")
+    c.push("a", i, g)
+    r1 = c.pull("a", i)
+    # first step: acc = g^2 -> update = lr*g/(|g|+eps) = sign(g) ~ 1.0
+    np.testing.assert_allclose(r1, r0 - 1.0, rtol=1e-5)
+    c.push("a", i, g)
+    r2 = c.pull("a", i)
+    # second step: acc = 2g^2 -> update = 1/sqrt(2)
+    np.testing.assert_allclose(r2, r1 - 1.0 / np.sqrt(2), rtol=1e-5)
+
+
+def test_save_load_roundtrip(cluster, tmp_path):
+    servers, c = cluster
+    c.create_table("s", dim=4, optimizer="sgd", lr=0.1)
+    ids = np.arange(6)
+    c.push("s", ids, np.ones((6, 4), "float32"))
+    rows = c.pull("s", ids)
+    path = str(tmp_path / "ps_ckpt")
+    c.save(path)
+    assert os.path.exists(path + ".shard0")
+
+    fresh = [PSServer().start() for _ in range(2)]
+    c2 = PSClient([s.endpoint for s in fresh])
+    try:
+        c2.create_table("s", dim=4, optimizer="sgd", lr=0.1)
+        c2.load(path)
+        np.testing.assert_array_equal(c2.pull("s", ids), rows)
+    finally:
+        c2.close()
+        for s in fresh:
+            s.stop()
+
+
+def test_remote_errors_propagate(cluster):
+    _, c = cluster
+    with pytest.raises(RuntimeError, match="no table"):
+        c.pull("nope", np.array([1]))
+    c.create_table("e", dim=4)
+    with pytest.raises(RuntimeError, match="shape"):
+        c.push("e", np.array([1]), np.ones((1, 3), "float32"))
+    with pytest.raises(RuntimeError, match="optimizer"):
+        c.create_table("bad", dim=2, optimizer="lamb")
+
+
+def test_concurrent_worker_pushes_all_land(cluster):
+    """Async (Hogwild) semantics: N workers pushing sgd grads to the
+    same row interleave, and with sgd the final row reflects the SUM of
+    all updates regardless of order."""
+    _, c = cluster
+    c.create_table("w", dim=2, optimizer="sgd", lr=1.0, seed=3)
+    i = np.array([5])
+    base = c.pull("w", i).copy()
+    workers = [PSClient(c.endpoints) for _ in range(3)]
+
+    def work(cl):
+        for _ in range(10):
+            cl.push("w", i, np.ones((1, 2), "float32"))
+
+    ts = [threading.Thread(target=work, args=(w,)) for w in workers]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for w in workers:
+        w.close()
+    np.testing.assert_allclose(c.pull("w", i), base - 30.0, rtol=1e-5)
+
+
+@pytest.mark.quick
+def test_distributed_embedding_trains(cluster):
+    """End-to-end worker: DistributedEmbedding + dense head learns a
+    per-id target; only touched rows change server-side; duplicate ids
+    in a batch contribute summed gradients."""
+    _, c = cluster
+    paddle.seed(0)
+    emb = DistributedEmbedding(c, "feat", dim=8, optimizer="adagrad",
+                               lr=0.2, seed=5)
+    head = nn.Linear(8, 1)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=head.parameters())
+    rng = np.random.RandomState(0)
+    n_ids = 16
+    target = (np.arange(n_ids) % 2).astype("float32")   # id parity
+
+    losses = []
+    for step in range(60):
+        ids = rng.randint(0, n_ids, (32,))
+        y = paddle.to_tensor(target[ids][:, None])
+        out = head(emb(paddle.to_tensor(ids.astype("int64"))))
+        loss = paddle.nn.functional.mse_loss(out, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < 0.05, losses[::10]
+    assert losses[-1] < losses[0] * 0.25
+
+    # untouched ids keep their deterministic init
+    untouched = np.array([1000, 2001])
+    from paddle_tpu.distributed.ps import _init_row
+    got = c.pull("feat", untouched)
+    for j, i in enumerate(untouched):
+        np.testing.assert_array_equal(
+            got[j], _init_row(5, int(i), 8, 0.01))
+
+    # eval mode: backward pushes nothing
+    emb.eval()
+    before = c.pull("feat", np.arange(n_ids))
+    out = head(emb(paddle.to_tensor(np.arange(4, dtype="int64"))))
+    loss = paddle.nn.functional.mse_loss(
+        out, paddle.to_tensor(np.zeros((4, 1), "float32")))
+    loss.backward()
+    np.testing.assert_array_equal(before, c.pull("feat", np.arange(n_ids)))
+
+
+def test_duplicate_ids_sum_gradients(cluster):
+    """A batch [7, 7] must push a single row-7 grad equal to the SUM of
+    both positions' cotangents (reference push_sparse merge)."""
+    _, c = cluster
+    emb = DistributedEmbedding(c, "dup", dim=4, optimizer="sgd", lr=1.0,
+                               seed=2)
+    base = c.pull("dup", np.array([7])).copy()
+    out = emb(paddle.to_tensor(np.array([7, 7], "int64")))
+    out.backward(paddle.to_tensor(np.ones((2, 4), "float32")))
+    after = c.pull("dup", np.array([7]))
+    np.testing.assert_allclose(after, base - 2.0, rtol=1e-5)
