@@ -33,35 +33,17 @@ from __future__ import annotations
 import os
 import pickle
 import socket
-import struct
 import threading
 
 import numpy as np
 
+# one wire protocol for the whole distributed stack: the PS speaks the
+# rpc agent's length-prefixed frames
+from paddle_tpu.distributed.rpc import _recv_frame, _send_frame
+
 __all__ = ["PSServer", "PSClient", "DistributedEmbedding"]
 
 _MAGIC = 0x9E3779B97F4A7C15     # splitmix64 increment (deterministic init)
-
-
-def _send_frame(sock, data: bytes):
-    sock.sendall(struct.pack("<Q", len(data)) + data)
-
-
-def _recv_frame(sock) -> bytes:
-    hdr = b""
-    while len(hdr) < 8:
-        chunk = sock.recv(8 - len(hdr))
-        if not chunk:
-            raise ConnectionError("ps peer closed")
-        hdr += chunk
-    n = struct.unpack("<Q", hdr)[0]
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
-            raise ConnectionError("ps peer closed mid-frame")
-        buf += chunk
-    return bytes(buf)
 
 
 def _init_row(table_seed: int, row_id: int, dim: int,
@@ -150,6 +132,7 @@ class PSServer:
 
     def __init__(self, host="127.0.0.1", port=0):
         self._tables: dict[str, _Table] = {}
+        self._tables_lock = threading.Lock()
         self._sock = socket.socket()
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -166,9 +149,13 @@ class PSServer:
             return "pong"
         if op == "create_table":
             name = payload["name"]
-            if name not in self._tables:   # idempotent across workers
-                cfg = {k: v for k, v in payload.items() if k != "name"}
-                self._tables[name] = _Table(**cfg)
+            cfg = {k: v for k, v in payload.items() if k != "name"}
+            with self._tables_lock:
+                # idempotent across workers — and atomic: a concurrent
+                # second create must NOT replace a table that already
+                # absorbed pushes
+                if name not in self._tables:
+                    self._tables[name] = _Table(**cfg)
             return True
         t = self._tables.get(payload.get("table"))
         if t is None and op in ("pull", "push", "stats"):
@@ -373,7 +360,6 @@ class DistributedEmbedding:
         uniq, inverse = np.unique(ids_np.reshape(-1), return_inverse=True)
         rows = self.client.pull(self.name, uniq)
         gathered = rows[inverse].reshape(ids_np.shape + (self.dim,))
-        out_shape = gathered.shape
         client, name, dim = self.client, self.name, self.dim
         push = self.training
 

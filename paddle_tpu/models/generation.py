@@ -174,19 +174,15 @@ def _finish_step(tok, finished, eos_token_id, pad_token_id):
     return tok, finished
 
 
-# compiled prefill/decode step pairs, memoized per model: a serving
-# process pays the XLA trace+compile ONCE per
+# compiled prefill/decode step pairs, memoized ON the model instance: a
+# serving process pays the XLA trace+compile ONCE per
 # (batch, prompt_len, sampling config), not once per request
-# (StaticFunction._jit_cache is per-instance)
-_STEP_CACHE: "weakref.WeakKeyDictionary" = None     # set below
-
+# (StaticFunction._jit_cache is per-instance). Stored in the model's
+# __dict__ (not a global map) so the cache — whose closures capture the
+# model strongly — dies with the model instead of leaking it.
 
 def _compiled_steps(model, b, s, do_sample, temperature, top_k, top_p):
-    global _STEP_CACHE
-    import weakref
-    if _STEP_CACHE is None:
-        _STEP_CACHE = weakref.WeakKeyDictionary()
-    per_model = _STEP_CACHE.setdefault(model, {})
+    per_model = model.__dict__.setdefault("_gen_step_cache", {})
     key = (b, s, do_sample, temperature, top_k, top_p)
     if key not in per_model:
         def prefill(ids_t, caches):

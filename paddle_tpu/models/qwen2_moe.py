@@ -87,16 +87,24 @@ class Qwen2MoeDecoderLayer(nn.Layer):
         self.post_attention_layernorm = RMSNorm(config.hidden_size,
                                                 epsilon=config.rms_norm_eps)
 
-    def forward(self, h, position_ids=None, attn_mask=None):
+    def forward(self, h, position_ids=None, attn_mask=None, cache=None,
+                cache_index=None):
         res = h
         h = self.input_layernorm(h)
-        h = self.self_attn(h, position_ids=position_ids,
-                           attn_mask=attn_mask)
+        new_cache = None
+        if cache is not None:
+            h, new_cache = self.self_attn(
+                h, position_ids=position_ids, attn_mask=attn_mask,
+                cache=cache, cache_index=cache_index)
+        else:
+            h = self.self_attn(h, position_ids=position_ids,
+                               attn_mask=attn_mask)
         h = res + h
         res = h
         h2 = self.post_attention_layernorm(h)
         h2 = self.mlp(h2)
-        return res + h2
+        out = res + h2
+        return out if cache is None else (out, new_cache)
 
 
 class Qwen2MoeModel(nn.Layer):
@@ -112,9 +120,18 @@ class Qwen2MoeModel(nn.Layer):
              for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids, position_ids=None, attn_mask=None):
+    def forward(self, input_ids, position_ids=None, attn_mask=None,
+                caches=None, cache_index=None):
         from paddle_tpu.distributed.recompute import recompute
         h = self.embed_tokens(input_ids)
+        if caches is not None:
+            new_caches = []
+            for layer, cache in zip(self.layers, caches):
+                h, c = layer(h, position_ids=position_ids,
+                             attn_mask=attn_mask, cache=cache,
+                             cache_index=cache_index)
+                new_caches.append(c)
+            return self.norm(h), new_caches
         for layer in self.layers:
             if self.config.recompute and self.training:
                 h = recompute(layer, h, position_ids=position_ids,
@@ -141,7 +158,15 @@ class Qwen2MoeForCausalLM(nn.Layer):
             bias_attr=False)
 
     def forward(self, input_ids, labels=None, position_ids=None,
-                attn_mask=None):
+                attn_mask=None, caches=None, cache_index=None):
+        if caches is not None:
+            if labels is not None:
+                raise ValueError("KV-cache decode is inference-only; "
+                                 "drop labels or caches")
+            h, caches = self.model(input_ids, position_ids=position_ids,
+                                   attn_mask=attn_mask, caches=caches,
+                                   cache_index=cache_index)
+            return self.lm_head(h), caches
         h = self.model(input_ids, position_ids=position_ids,
                        attn_mask=attn_mask)
         logits = self.lm_head(h)
@@ -156,3 +181,8 @@ class Qwen2MoeForCausalLM(nn.Layer):
                 total_aux = total_aux + a
             loss = loss + self.config.router_aux_loss_coef * total_aux
         return loss, logits
+
+    def generate(self, input_ids, max_new_tokens=32, **kwargs):
+        """KV-cache autoregressive generation (models/generation.py)."""
+        from paddle_tpu.models.generation import generate
+        return generate(self, input_ids, max_new_tokens, **kwargs)
