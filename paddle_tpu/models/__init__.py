@@ -25,5 +25,6 @@ from paddle_tpu.models.dit import (  # noqa: F401
     DiTConfig, DiT, dit_xl_2_config, tiny_dit_config,
 )
 from paddle_tpu.models.generation import (  # noqa: F401
-    generate, generate_stream, init_kv_cache, process_logits,
+    generate, generate_speculative, generate_stream, init_kv_cache,
+    process_logits,
 )

@@ -43,7 +43,7 @@ from paddle_tpu.core.dispatch import defop
 from paddle_tpu.core.tensor import Tensor
 
 __all__ = ["init_kv_cache", "kv_cache_update", "process_logits",
-           "generate", "generate_stream"]
+           "generate", "generate_stream", "generate_speculative"]
 
 
 @defop("kv_cache_update", differentiable=False,
@@ -284,6 +284,143 @@ def generate(model, input_ids, max_new_tokens=32, **kwargs):
         return paddle_tpu.to_tensor(prompt)
     gen = np.stack(steps, axis=1).astype("int32")
     return paddle_tpu.to_tensor(np.concatenate([prompt, gen], axis=1))
+
+
+# -- speculative decoding ----------------------------------------------------
+
+def generate_speculative(target, draft, input_ids, max_new_tokens=32, *,
+                         num_speculative_tokens=4, eos_token_id=None,
+                         stats=None):
+    """Greedy speculative decoding (reference ecosystem: PaddleNLP's
+    inference 'speculate_method' draft-model path; Leviathan et al.):
+    a cheap DRAFT model proposes `num_speculative_tokens` tokens
+    autoregressively; the TARGET model scores the whole block in ONE
+    cache-aware forward and accepts the longest matching prefix plus
+    one corrected/bonus token. Greedy acceptance makes the output
+    EXACTLY the target's own greedy continuation — the draft only
+    changes how many target forwards it takes.
+
+    TPU shape: the verify step is a width-g decode (static shape, one
+    compile) — g tokens enter the MXU together, so acceptance rate
+    directly converts sequential decode steps into one batched-matmul
+    step. Stale cache slots from rejected proposals are safe: the
+    position mask hides them until the next write overwrites the slot.
+
+    batch must be 1 (rows would diverge in acceptance length).
+    Returns int32 ids (1, prompt + generated). Pass a dict as `stats`
+    to receive {'target_forwards', 'generated', 'accepted_drafts'}."""
+    ids = input_ids if isinstance(input_ids, Tensor) \
+        else paddle_tpu.to_tensor(np.asarray(input_ids, "int32"))
+    b, s = ids.shape[0], ids.shape[1]
+    if b != 1:
+        raise ValueError("speculative decoding is batch-1 "
+                         f"(got batch {b}); rows diverge in acceptance")
+    g = int(num_speculative_tokens)
+    if g < 1:
+        raise ValueError("num_speculative_tokens must be >= 1")
+    if not (_model_supports_cache(target) and _model_supports_cache(draft)):
+        raise ValueError("both target and draft need KV-cache support")
+    prompt = np.asarray(ids.numpy(), "int32")
+    if max_new_tokens <= 0:
+        return paddle_tpu.to_tensor(prompt)
+
+    was_t, was_d = getattr(target, "training", False), \
+        getattr(draft, "training", False)
+    target.eval()
+    draft.eval()
+    n_target_fwd = 0
+    try:
+        with paddle_tpu.no_grad():
+            max_len = s + max_new_tokens + g
+            t_caches = init_kv_cache(target, 1, max_len)
+            d_caches = init_kv_cache(draft, 1, max_len)
+            t_prefill, t_decode = _compiled_steps(
+                target, 1, s, False, 1.0, 0, 1.0)
+            d_prefill, d_decode = _compiled_steps(
+                draft, 1, s, False, 1.0, 0, 1.0)
+            t_verify = _compiled_verify(target, 1, g)
+            zero = paddle_tpu.to_tensor(np.zeros((), "float32"))
+
+            last, t_caches = t_prefill(ids, t_caches)
+            n_target_fwd += 1
+            _, d_caches = d_prefill(ids, d_caches)
+            pending = int(np.asarray(last.numpy()).argmax(-1).ravel()[0])
+            out = [pending]
+            p = s                       # both caches hold positions < p
+            accepted_total = 0
+            while len(out) < max_new_tokens and \
+                    (eos_token_id is None or pending != eos_token_id):
+                # draft consumes block[i] at position p+i and proposes
+                # block[i+1]; the final feed (i = g-1) discards its
+                # proposal but is REQUIRED: it writes d_{g-1}'s k/v
+                # into slot p+g-1, which the next round attends when
+                # every proposal gets accepted
+                block = [pending]
+                for i in range(g):
+                    tok_t, d_caches = d_decode(
+                        paddle_tpu.to_tensor(
+                            np.array([block[i]], "int32")),
+                        paddle_tpu.to_tensor(p + i, dtype="int32"),
+                        d_caches, zero)
+                    if i < g - 1:
+                        block.append(
+                            int(np.asarray(tok_t.numpy()).ravel()[0]))
+                # ONE target forward scores the whole block;
+                # preds[i] = target's greedy token AFTER block[:i+1]
+                preds_t, t_caches = t_verify(
+                    paddle_tpu.to_tensor(
+                        np.array([block], "int32")),
+                    paddle_tpu.to_tensor(p, dtype="int32"), t_caches)
+                n_target_fwd += 1
+                preds = np.asarray(preds_t.numpy()).ravel()
+                # accept the longest prefix of proposals the target
+                # agrees with, then emit the target's own next token
+                # (correction on mismatch, bonus when all accepted)
+                n_acc = 0
+                while n_acc < g - 1 and block[n_acc + 1] == int(preds[n_acc]):
+                    n_acc += 1
+                emitted = block[1:1 + n_acc] + [int(preds[n_acc])]
+                accepted_total += n_acc
+                # caches: target holds block[0..g-1] at p..p+g-1, draft
+                # the same — the accepted prefix occupies p..p+n_acc
+                # correctly; stale slots beyond are position-masked
+                # until overwritten. `pending` (the emitted correction/
+                # bonus) enters both caches next round at index p.
+                p += n_acc + 1
+                pending = emitted[-1]
+                out.extend(emitted)
+                if eos_token_id is not None and eos_token_id in emitted:
+                    out = out[:out.index(eos_token_id) + 1]
+                    break
+            out = out[:max_new_tokens]
+    finally:
+        if was_t:
+            target.train()
+        if was_d:
+            draft.train()
+    if stats is not None:
+        stats.update(target_forwards=n_target_fwd,
+                     generated=len(out),
+                     accepted_drafts=accepted_total)
+    return paddle_tpu.to_tensor(
+        np.concatenate([prompt, np.array([out], "int32")], axis=1))
+
+
+def _compiled_verify(model, b, g):
+    """Width-g greedy verify step: feed g tokens at cache position
+    `index`, return the argmax token after EACH of them (b, g)."""
+    per_model = model.__dict__.setdefault("_gen_step_cache", {})
+    key = ("verify", b, g)
+    if key not in per_model:
+        def verify(block_t, index_t, caches):
+            pos = T.reshape(index_t + T.arange(0, g, dtype="int32"),
+                            [1, g])
+            logits, caches = model(block_t, position_ids=pos,
+                                   caches=caches, cache_index=index_t)
+            return T.cast(T.argmax(logits, axis=-1), "int32"), caches
+
+        per_model[key] = paddle_tpu.jit.to_static(verify)
+    return per_model[key]
 
 
 # -- deployment bundle: exported prefill + decode programs -------------------

@@ -415,3 +415,58 @@ def test_step_cache_dies_with_model():
     del m
     gc.collect()
     assert ref() is None, "model (and its compiled steps) leaked"
+
+
+# -- speculative decoding ----------------------------------------------------
+
+def test_speculative_exactly_matches_greedy(llama):
+    """Greedy speculative decoding is a LOSSLESS accelerator: with any
+    draft model the output must equal the target's own greedy
+    continuation token for token."""
+    from paddle_tpu.models import generate_speculative
+    paddle.seed(123)
+    draft = LlamaForCausalLM(tiny_llama_config(num_hidden_layers=1))
+    draft.eval()
+    ids = _ids(b=1)
+    ref = generate(llama, ids, max_new_tokens=12).numpy()
+    stats = {}
+    out = generate_speculative(llama, draft, ids, max_new_tokens=12,
+                               num_speculative_tokens=3,
+                               stats=stats).numpy()
+    np.testing.assert_array_equal(out, ref)
+    assert stats["generated"] == 12
+    assert stats["target_forwards"] >= 1
+
+
+def test_speculative_perfect_draft_saves_target_forwards(llama):
+    """draft == target: every proposal accepted, so the target runs
+    ~new/g forwards instead of `new` sequential decodes."""
+    from paddle_tpu.models import generate_speculative
+    ids = _ids(b=1)
+    ref = generate(llama, ids, max_new_tokens=12).numpy()
+    stats = {}
+    out = generate_speculative(llama, llama, ids, max_new_tokens=12,
+                               num_speculative_tokens=4,
+                               stats=stats).numpy()
+    np.testing.assert_array_equal(out, ref)
+    # prefill + ceil(11 / 4) verify rounds = 4 target forwards
+    assert stats["target_forwards"] <= 5, stats
+    assert stats["accepted_drafts"] >= 8, stats
+
+
+def test_speculative_guards_and_eos(llama):
+    from paddle_tpu.models import generate_speculative
+    paddle.seed(5)
+    draft = LlamaForCausalLM(tiny_llama_config(num_hidden_layers=1))
+    draft.eval()
+    with pytest.raises(ValueError, match="batch-1"):
+        generate_speculative(llama, draft, _ids(b=2), 4)
+    with pytest.raises(ValueError, match="num_speculative"):
+        generate_speculative(llama, draft, _ids(b=1), 4,
+                             num_speculative_tokens=0)
+    # eos: use the first greedy token as eos -> single generated token
+    ids = _ids(b=1)
+    first = int(generate(llama, ids, max_new_tokens=1).numpy()[0, -1])
+    out = generate_speculative(llama, draft, ids, max_new_tokens=8,
+                               eos_token_id=first).numpy()
+    assert out.shape[1] == 9 and out[0, -1] == first
