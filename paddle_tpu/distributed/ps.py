@@ -128,9 +128,22 @@ class PSServer:
 
     Ops (pickled frames, persistent connection): create_table, pull,
     push, stats, save, load, ping. Start with `.start()`; endpoint is
-    `host:port`."""
+    `host:port`.
 
-    def __init__(self, host="127.0.0.1", port=0):
+    TRUST BOUNDARY: frames are python pickles — deserializing one
+    executes arbitrary code, and save/load touch the server's
+    filesystem. This transport is for CO-LOCATED TRUSTED WORKERS ONLY
+    (same machine or a private training network), matching how the
+    reference's brpc PS assumes a closed cluster
+    (reference: paddle/fluid/distributed/ps/service/brpc_ps_server.cc
+    — protobuf over brpc, but no authn either). Defaults bind
+    127.0.0.1; if you bind a routable `host=`, firewall the port.
+    `save_dir=` additionally confines client-supplied save/load paths
+    to one directory server-side."""
+
+    def __init__(self, host="127.0.0.1", port=0, save_dir=None):
+        self._save_dir = (os.path.realpath(save_dir)
+                          if save_dir is not None else None)
         self._tables: dict[str, _Table] = {}
         self._tables_lock = threading.Lock()
         self._sock = socket.socket()
@@ -142,6 +155,18 @@ class PSServer:
         self.endpoint = f"{self.host}:{self.port}"
         self._running = False
         self._thread = None
+
+    def _check_path(self, path):
+        """Confine client-supplied save/load paths to save_dir (when
+        configured): symlink-resolved prefix check."""
+        if self._save_dir is None:
+            return path
+        real = os.path.realpath(path)
+        if real != self._save_dir and \
+                not real.startswith(self._save_dir + os.sep):
+            raise PermissionError(
+                f"ps path {path!r} escapes save_dir {self._save_dir!r}")
+        return real
 
     # -- op handlers -------------------------------------------------------
     def _handle(self, op, payload):
@@ -171,14 +196,14 @@ class PSServer:
                 return {"rows": len(t.rows), "dim": t.dim,
                         "optimizer": t.optimizer}
         if op == "save":
-            path = payload["path"]
+            path = self._check_path(payload["path"])
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             with open(path, "wb") as f:
                 pickle.dump({n: tb.state()
                              for n, tb in self._tables.items()}, f)
             return True
         if op == "load":
-            with open(payload["path"], "rb") as f:
+            with open(self._check_path(payload["path"]), "rb") as f:
                 states = pickle.load(f)
             for n, st in states.items():
                 if n in self._tables:

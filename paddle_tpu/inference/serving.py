@@ -275,8 +275,9 @@ class PredictorServer:
         self._thread = None
 
     # -- core -------------------------------------------------------------
-    _GEN_PARAMS = ("max_new_tokens", "eos_token_id", "pad_token_id",
-                   "do_sample", "temperature", "top_k", "top_p", "seed")
+    _GEN_PARAMS = ("max_new_tokens", "attention_mask", "eos_token_id",
+                   "pad_token_id", "do_sample", "temperature", "top_k",
+                   "top_p", "seed", "tokens_per_fetch")
 
     def generate_steps(self, req):
         """Yield {"step": i, "tokens": [...]} per generated position,
@@ -294,6 +295,9 @@ class PredictorServer:
         kw = {k: req[k] for k in self._GEN_PARAMS if k in req}
         g = self.generator
         if hasattr(g, "stream"):
+            # bundle predictors decode host-side; the device block loop
+            # does not apply there
+            kw.pop("tokens_per_fetch", None)
             it = g.stream(ids, **kw)
         else:
             from paddle_tpu.models.generation import generate_stream

@@ -166,8 +166,14 @@ class LlamaAttention(nn.Layer):
                 if "bool" in str(attn_mask.dtype):
                     mask = T.logical_and(mask, attn_mask)
                 else:
-                    mask = T.cast(mask, "float32") * 1e9 - 1e9 \
-                        + attn_mask
+                    # -inf (not a large-negative) so SDPA's
+                    # fully-masked-row guard (isneginf in _sdpa_ref)
+                    # fires for rows a float mask hides entirely;
+                    # no +inf exists here, so the sum never NaNs
+                    fmask = T.cast(mask, "float32")
+                    mask = T.where(
+                        mask, T.zeros_like(fmask),
+                        T.full_like(fmask, float("-inf"))) + attn_mask
             out = F.scaled_dot_product_attention(
                 q, k_buf, v_buf, attn_mask=mask)
             out = T.reshape(out, [b, s, cfg.hidden_size])

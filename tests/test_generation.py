@@ -331,10 +331,19 @@ def test_compiled_steps_cached_across_calls(llama):
     from paddle_tpu.models.generation import _compiled_steps
     ids = _ids()
     generate(llama, ids, max_new_tokens=2)
-    pair1 = _compiled_steps(llama, 2, 8, False, 1.0, 0, 1.0)
+    pair1 = _compiled_steps(llama, 2, 8, False)
     generate(llama, ids, max_new_tokens=3)
-    pair2 = _compiled_steps(llama, 2, 8, False, 1.0, 0, 1.0)
+    pair2 = _compiled_steps(llama, 2, 8, False)
     assert pair1[0] is pair2[0] and pair1[1] is pair2[1]
+    # sampling configs share ONE compiled pair: the params are traced
+    # inputs, not compile keys (ADVICE r3)
+    generate(llama, ids, max_new_tokens=2, do_sample=True,
+             temperature=0.7, top_k=5, seed=0)
+    s1 = _compiled_steps(llama, 2, 8, True)
+    generate(llama, ids, max_new_tokens=2, do_sample=True,
+             temperature=1.3, top_p=0.9, seed=1)
+    s2 = _compiled_steps(llama, 2, 8, True)
+    assert s1[0] is s2[0] and s1[1] is s2[1]
 
 
 def test_stream_consumer_disconnect_releases_lock(llama):
@@ -470,3 +479,222 @@ def test_speculative_guards_and_eos(llama):
     out = generate_speculative(llama, draft, ids, max_new_tokens=8,
                                eos_token_id=first).numpy()
     assert out.shape[1] == 9 and out[0, -1] == first
+
+
+# -- round 4: attention_mask plumbing, block decode, rejection sampling ------
+
+
+@pytest.mark.quick
+def test_padded_batch_matches_unpadded_rows(llama):
+    """THE mask-plumbing test: a left-padded ragged batch must generate
+    exactly what each row generates unpadded (ADVICE r3 medium —
+    padded prompt positions used to be attended as real context)."""
+    r1 = _ids(b=1, s=8, seed=1)
+    r2 = _ids(b=1, s=5, seed=2)
+    # left-pad row 2 to length 8 with a junk token
+    pad = np.full((1, 3), 7, "int32")
+    batch = np.concatenate(
+        [r1, np.concatenate([pad, r2], axis=1)], axis=0)
+    mask = np.ones((2, 8), "int32")
+    mask[1, :3] = 0
+    out = generate(llama, batch, max_new_tokens=6,
+                   attention_mask=mask).numpy()
+    ref1 = generate(llama, r1, max_new_tokens=6).numpy()
+    ref2 = generate(llama, r2, max_new_tokens=6).numpy()
+    np.testing.assert_array_equal(out[0, 8:], ref1[0, 8:])
+    np.testing.assert_array_equal(out[1, 8:], ref2[0, 5:])
+
+
+def test_padded_recompute_fallback_matches(llama):
+    """attention_mask on the use_cache=False path gives the same tokens
+    as the cached path."""
+    batch = _ids(b=2, s=8, seed=3)
+    mask = np.ones((2, 8), "int32")
+    mask[0, :2] = 0
+    out_c = generate(llama, batch, max_new_tokens=4,
+                     attention_mask=mask).numpy()
+    out_n = generate(llama, batch, max_new_tokens=4,
+                     attention_mask=mask, use_cache=False).numpy()
+    np.testing.assert_array_equal(out_c, out_n)
+
+
+def test_mask_rejected_without_model_support():
+    """A model without attn_mask= cannot silently ignore the mask."""
+    class Bare(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = paddle.nn.Embedding(256, 16)
+            self.head = paddle.nn.Linear(16, 256)
+
+        def forward(self, input_ids):
+            return self.head(self.emb(input_ids))
+
+    paddle.seed(0)
+    m = Bare()
+    m.eval()
+    mask = np.zeros((1, 8), "int32")
+    mask[0, 4:] = 1
+    with pytest.raises(ValueError, match="attn_mask"):
+        list(generate_stream(m, _ids(b=1), 2, attention_mask=mask))
+    # GPT honors the mask on the recompute path (it accepts attn_mask)
+    paddle.seed(0)
+    gpt = GPTForCausalLM(tiny_gpt_config())
+    gpt.eval()
+    out = generate(gpt, _ids(b=1), max_new_tokens=2,
+                   attention_mask=mask).numpy()
+    assert out.shape == (1, 10)
+
+
+@pytest.mark.quick
+def test_block_decode_matches_per_token(llama):
+    """tokens_per_fetch=N (device-side lax.while_loop) must emit the
+    exact per-token stream, greedy and sampled (VERDICT r3 item 3)."""
+    ids = _ids()
+    ref = generate(llama, ids, max_new_tokens=10).numpy()
+    out = generate(llama, ids, max_new_tokens=10,
+                   tokens_per_fetch=4).numpy()
+    np.testing.assert_array_equal(out, ref)
+    refs = generate(llama, ids, max_new_tokens=10, do_sample=True,
+                    temperature=0.8, top_k=20, seed=11).numpy()
+    outs = generate(llama, ids, max_new_tokens=10, do_sample=True,
+                    temperature=0.8, top_k=20, seed=11,
+                    tokens_per_fetch=4).numpy()
+    np.testing.assert_array_equal(outs, refs)
+
+
+def test_block_decode_eos_early_exit(llama):
+    """The while_loop exits at eos: block path and per-token path agree
+    on sequence length and padding."""
+    ids = _ids(b=2)
+    # pick the token the greedy stream actually emits at step 2 so the
+    # early-exit triggers mid-block
+    ref_full = generate(llama, ids, max_new_tokens=8).numpy()
+    eos = int(ref_full[0, 8 + 2])
+    ref = generate(llama, ids, max_new_tokens=8, eos_token_id=eos,
+                   pad_token_id=9).numpy()
+    out = generate(llama, ids, max_new_tokens=8, eos_token_id=eos,
+                   pad_token_id=9, tokens_per_fetch=3).numpy()
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_block_decode_padded_batch(llama):
+    """Block decode composes with attention_mask."""
+    batch = _ids(b=2, s=8, seed=3)
+    mask = np.ones((2, 8), "int32")
+    mask[1, :4] = 0
+    ref = generate(llama, batch, max_new_tokens=6,
+                   attention_mask=mask).numpy()
+    out = generate(llama, batch, max_new_tokens=6, attention_mask=mask,
+                   tokens_per_fetch=6).numpy()
+    np.testing.assert_array_equal(out, ref)
+
+
+@pytest.mark.quick
+def test_traced_sampling_matches_static_pipeline(llama):
+    """The traced logits pipeline (temperature/top_k/top_p as traced
+    scalars) must match process_logits (static params) bit-for-bit on
+    the surviving-token set."""
+    from paddle_tpu.models.generation import _process_logits_traced
+    rng = np.random.RandomState(0)
+    logits = paddle.to_tensor(rng.randn(4, 64).astype("float32"))
+    for (t, k, p) in [(1.0, 0, 1.0), (0.7, 10, 1.0), (1.3, 0, 0.9),
+                      (0.5, 5, 0.8), (2.0, 64, 1.0)]:
+        ref = process_logits(logits, t, k, p).numpy()
+        got = _process_logits_traced(
+            logits, paddle.to_tensor(float(t)),
+            paddle.to_tensor(k, dtype="int32"),
+            paddle.to_tensor(float(p))).numpy()
+        # -1e9-masked set must be identical; surviving values equal
+        np.testing.assert_array_equal(ref <= -1e8, got <= -1e8)
+        keep = ref > -1e8
+        np.testing.assert_allclose(got[keep], ref[keep], rtol=1e-6)
+
+
+def test_speculative_sampling_preserves_target_distribution(llama):
+    """Rejection-sampling spec decode must sample from the target's
+    processed distribution EXACTLY (Leviathan et al. correctness
+    property, VERDICT r3 item 4): empirical first-token frequencies
+    over many seeded runs match the target's softmax probabilities."""
+    from paddle_tpu.models.generation import generate_speculative
+    paddle.seed(7)
+    draft = LlamaForCausalLM(tiny_llama_config(num_hidden_layers=1))
+    draft.eval()
+    ids = _ids(b=1, s=8, seed=4)
+    temp = 1.5          # flatten so several tokens have mass
+    # exact target distribution for the first generated token
+    logits = llama(paddle.to_tensor(ids)).numpy()[0, -1].astype("float64")
+    z = logits / temp
+    pz = np.exp(z - z.max())
+    pz /= pz.sum()
+    trials = 400
+    counts = np.zeros(pz.shape[0])
+    for i in range(trials):
+        out = generate_speculative(
+            llama, draft, ids, max_new_tokens=1, do_sample=True,
+            temperature=temp, num_speculative_tokens=3, seed=i).numpy()
+        counts[out[0, 8]] += 1
+    freq = counts / trials
+    # total-variation distance bound: E[TV] ~ sqrt(2V/(pi*N)) for the
+    # effective support; generous 3x margin keeps flakes out
+    tv = 0.5 * np.abs(freq - pz).sum()
+    eff = float((pz > 1e-3).sum())
+    bound = 3.0 * np.sqrt(2.0 * eff / (np.pi * trials))
+    assert tv < bound, (tv, bound)
+
+
+def test_speculative_sampling_stats_and_eos(llama):
+    """Sampled spec decode keeps the stats surface and the eos
+    truncation contract."""
+    from paddle_tpu.models.generation import generate_speculative
+    paddle.seed(8)
+    draft = LlamaForCausalLM(tiny_llama_config(num_hidden_layers=1))
+    draft.eval()
+    ids = _ids(b=1, s=8, seed=5)
+    stats = {}
+    out = generate_speculative(llama, draft, ids, max_new_tokens=12,
+                               do_sample=True, temperature=0.9,
+                               num_speculative_tokens=4, seed=3,
+                               stats=stats).numpy()
+    assert out.shape[1] <= 20
+    assert stats["generated"] == out.shape[1] - 8
+    assert stats["target_forwards"] >= 2
+    # a perfect draft (same model) accepts nearly everything
+    stats2 = {}
+    generate_speculative(llama, llama, ids, max_new_tokens=12,
+                         do_sample=True, temperature=0.9,
+                         num_speculative_tokens=4, seed=3, stats=stats2)
+    assert stats2["accepted_drafts"] >= stats2["generated"] // 3
+
+
+def test_bundle_honors_attention_mask(tmp_path, llama):
+    """Format-2 bundles thread the padding mask: a left-padded prompt
+    through the exported programs matches live padded generation."""
+    path = str(tmp_path / "m")
+    export_generation_bundle(llama, path, batch_size=2, prompt_len=8,
+                             max_new_tokens=4)
+    meta = json.load(open(path + ".genmeta"))
+    assert meta["format"] == 2 and meta["mask_honored"]
+    batch = _ids(b=2, s=8, seed=3)
+    mask = np.ones((2, 8), "int32")
+    mask[1, :3] = 0
+    gp = GenerationPredictor(path)
+    out = gp.generate(batch, 4, attention_mask=mask)
+    ref = generate(llama, batch, max_new_tokens=4,
+                   attention_mask=mask).numpy()
+    np.testing.assert_array_equal(out, ref)
+
+
+@pytest.mark.quick
+def test_right_padded_mask_rejected(llama):
+    """Right padding is silently wrong (decode would start from a pad
+    embedding); the surface rejects it with guidance (code-review r4)."""
+    mask = np.ones((2, 8), "int32")
+    mask[0, -2:] = 0
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        list(generate_stream(llama, _ids(), 2, attention_mask=mask))
+    # all-ones masks are a no-op everywhere, including models without
+    # attn_mask support on the cached path
+    out = generate(llama, _ids(), max_new_tokens=2,
+                   attention_mask=np.ones((2, 8), "int32")).numpy()
+    ref = generate(llama, _ids(), max_new_tokens=2).numpy()
+    np.testing.assert_array_equal(out, ref)

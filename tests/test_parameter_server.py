@@ -182,3 +182,26 @@ def test_duplicate_ids_sum_gradients(cluster):
     out.backward(paddle.to_tensor(np.ones((2, 4), "float32")))
     after = c.pull("dup", np.array([7]))
     np.testing.assert_allclose(after, base - 2.0, rtol=1e-5)
+
+
+def test_save_dir_confines_paths(tmp_path):
+    """save_dir= rejects client paths escaping the configured directory
+    (ADVICE r3: the PS honored arbitrary client filesystem paths)."""
+    import pytest
+    from paddle_tpu.distributed.ps import PSClient
+    base = tmp_path / "ckpt"
+    base.mkdir()
+    srv = PSServer(save_dir=str(base)).start()
+    try:
+        c = PSClient([srv.endpoint])
+        c.create_table("t", dim=2, optimizer="sgd", lr=0.1)
+        c.pull("t", np.array([1]))
+        inside = str(base / "ok.bin")
+        c.save(inside)
+        assert os.path.exists(inside + ".shard0")
+        with pytest.raises(RuntimeError, match="escapes save_dir"):
+            c.save(str(tmp_path / "outside.bin"))
+        with pytest.raises(RuntimeError, match="escapes save_dir"):
+            c.load(str(tmp_path / "outside.bin"))
+    finally:
+        srv.stop()
