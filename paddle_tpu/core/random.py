@@ -29,9 +29,18 @@ class Generator:
     def manual_seed(self, seed: int):
         with getattr(self, "_lock", contextlib.nullcontext()):
             self._seed = int(seed)
-            self._key = jax.random.key(int(seed))
+            # LAZY: materializing the key runs a jax computation, which
+            # initializes the XLA backend — import paddle_tpu must stay
+            # backend-free or jax.distributed.initialize (which must run
+            # before ANY backend touch) breaks under the launcher
+            self._key = None
             self._counter = 0
         return self
+
+    def _root_key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
 
     def seed(self, seed: int):
         return self.manual_seed(seed)
@@ -44,14 +53,14 @@ class Generator:
         with self._lock:
             c = self._counter
             self._counter += 1
-        return jax.random.fold_in(self._key, c)
+        return jax.random.fold_in(self._root_key(), c)
 
     def get_state(self):
         return (self._seed, self._counter)
 
     def set_state(self, state):
         self._seed, self._counter = int(state[0]), int(state[1])
-        self._key = jax.random.key(self._seed)
+        self._key = None
 
 
 _default_generator = Generator(0)

@@ -50,7 +50,7 @@ def init_parallel_env():
     coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
         "MASTER_ADDR")
     nprocs = _env_int("PADDLE_TRAINERS_NUM", "WORLD_SIZE", default=1)
-    if nprocs > 1:
+    if nprocs > 1 and not _jax_distributed_active():
         port = os.environ.get("MASTER_PORT", "8476")
         addr = coord if coord and ":" in str(coord) else f"{coord}:{port}"
         jax.distributed.initialize(
@@ -58,6 +58,21 @@ def init_parallel_env():
             num_processes=nprocs,
             process_id=_env_int("PADDLE_TRAINER_ID", "RANK", default=0))
     _initialized[0] = True
+
+
+def _jax_distributed_active():
+    """True when jax.distributed.initialize already ran in this process
+    (e.g. the launcher did it before handing control to the script) —
+    a second initialize raises."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    # older jax: fall back to the private global state
+    try:
+        from jax._src import distributed as _jd
+        return _jd.global_state.client is not None
+    except Exception:       # noqa: BLE001 — internal layout moved
+        return False
 
 
 def is_initialized():

@@ -49,6 +49,7 @@ def launch(script, script_args=(), master=None, nnodes=1, rank=-1,
     in-process under __main__."""
     env = os.environ
     env["PADDLE_NNODES"] = str(nnodes)
+    env["PADDLE_TRAINERS_NUM"] = str(nnodes)
     if master:
         env["PADDLE_MASTER"] = master
         # jax.distributed.initialize reads these (or its args); exporting

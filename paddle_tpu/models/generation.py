@@ -237,7 +237,11 @@ def generate_stream(model, input_ids, max_new_tokens=32, *,
     padding). tokens_per_fetch>1 runs that many decode steps inside one
     XLA program (lax.while_loop) per host round-trip — tokens then
     arrive in bursts of up to that size, but the per-token host<->device
-    latency disappears from the decode path."""
+    latency disappears from the decode path. Greedy block decode emits
+    the exact per-token stream; SAMPLED block decode draws its Gumbel
+    noise on device from a seed-derived PRNG key (shipping host noise
+    would cost block*batch*vocab floats per fetch), so it is
+    seed-deterministic but a different stream than tokens_per_fetch=1."""
     ids = input_ids if isinstance(input_ids, Tensor) \
         else paddle_tpu.to_tensor(np.asarray(input_ids, "int32"))
     if ids.dtype not in ("int32", "int64"):
@@ -399,9 +403,14 @@ def _block_impl(model, b, s, n_steps, do_sample, tok_t, index_t, limit_t,
     """Body of the compiled block-decode program. Lives OUTSIDE the
     to_static-wrapped function so the dy2static AST pass never rewrites
     it — the lax.while_loop here is hand-built (the python `if`s branch
-    on build-time constants only)."""
+    on build-time constants only).
+
+    Sampling noise is generated ON DEVICE from a traced PRNG key
+    (fold_in(key, absolute position) per step): shipping host Gumbel
+    noise would cost n_steps*b*vocab floats per fetch — the exact
+    host<->device traffic tokens_per_fetch exists to eliminate."""
     masked = _mask_capable(model)
-    nl = model.config.num_hidden_layers
+    nl = len(caches)
     if masked:
         attn, n_real = _graph_mask(keep_t, caches[0][0].shape[1])
         attn_v, nreal_v = attn._value, n_real._value
@@ -409,7 +418,7 @@ def _block_impl(model, b, s, n_steps, do_sample, tok_t, index_t, limit_t,
     limit_v = limit_t._value
     eos_v, pad_v = eos_t._value, pad_t._value
     if do_sample:
-        noise_v = samp[0]._value
+        key_v = samp[0]._value          # (2,) uint32 raw PRNG key data
         temp_t, topk_t, topp_t = samp[1:]
     cflat = [c._value for kv in caches for c in kv]
 
@@ -428,8 +437,10 @@ def _block_impl(model, b, s, n_steps, do_sample, tok_t, index_t, limit_t,
                            caches=ci, cache_index=index, **kw)
         last = logits[:, -1]
         if do_sample:
-            ni = Tensor(jax.lax.dynamic_index_in_dim(
-                noise_v, i, 0, keepdims=False))
+            step_key = jax.random.fold_in(
+                jax.random.wrap_key_data(key_v), idx0 + i)
+            ni = Tensor(jax.random.gumbel(
+                step_key, (b, last.shape[-1]), jnp.float32))
             x = _process_logits_traced(last, temp_t, topk_t, topp_t)
             nxt = T.cast(T.argmax(x + ni, axis=-1), "int32")
         else:
@@ -508,6 +519,14 @@ def _stream_cached(model, ids, b, s, max_new_tokens, eos_token_id,
             -1 if eos_token_id is None else int(eos_token_id),
             dtype="int32")
         pad_t = paddle_tpu.to_tensor(int(pad_token_id), dtype="int32")
+        # block noise is device-generated from ONE key (2 words instead
+        # of block*b*vocab floats per fetch); fold_in by absolute
+        # position keeps every step's draw distinct and seed-stable
+        block_samp = ()
+        if do_sample:
+            block_seed = int(rng.randint(0, 2 ** 31 - 1))
+            block_samp = (Tensor(jax.random.key_data(
+                jax.random.key(block_seed))), *const_samp)
         produced = 1
         while produced < max_new_tokens and not finished.all():
             limit = min(block, max_new_tokens - produced)
@@ -516,7 +535,7 @@ def _stream_cached(model, ids, b, s, max_new_tokens, eos_token_id,
                 paddle_tpu.to_tensor(s + produced - 1, dtype="int32"),
                 paddle_tpu.to_tensor(limit, dtype="int32"),
                 keep_t, caches, paddle_tpu.to_tensor(finished),
-                eos_t, pad_t, *samp_args(block))
+                eos_t, pad_t, *block_samp)
             n_done = int(np.asarray(n_t.numpy()))
             outb = np.asarray(out_t.numpy(), "int32")
             finished = np.asarray(fin_t.numpy(), bool)
