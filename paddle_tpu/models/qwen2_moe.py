@@ -31,6 +31,9 @@ class Qwen2MoeConfig(LlamaConfig):
     shared_expert_intermediate_size: int = 5632
     capacity_factor: float = 1.25
     router_aux_loss_coef: float = 0.001
+    # dropless dMoE (ragged grouped matmul) instead of GShard capacity
+    # dispatch — zero dropped tokens (nn/layer/moe.py _moe_mlp_dropless)
+    moe_dropless: bool = False
 
 
 def tiny_qwen2_moe_config(**overrides) -> Qwen2MoeConfig:
@@ -54,7 +57,8 @@ class Qwen2MoeSparseBlock(nn.Layer):
             config.hidden_size, config.moe_intermediate_size,
             config.num_experts, top_k=config.num_experts_per_tok,
             capacity_factor=config.capacity_factor,
-            initializer_range=config.initializer_range)
+            initializer_range=config.initializer_range,
+            dropless=config.moe_dropless)
         shared_cfg = LlamaConfig(
             hidden_size=config.hidden_size,
             intermediate_size=config.shared_expert_intermediate_size,

