@@ -46,7 +46,7 @@ def _as_pmesh(jax_mesh):
 
 
 def shard_tensor(data, mesh: ProcessMesh | None = None, placements=None,
-                 dtype=None, stop_gradient=None) -> Tensor:
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
     """Place `data` on `mesh` with `placements`
     (reference: auto_parallel/api.py:126).
 
@@ -75,8 +75,8 @@ def shard_tensor(data, mesh: ProcessMesh | None = None, placements=None,
     return out
 
 
-def reshard(t: Tensor, mesh: ProcessMesh | None = None, placements=None
-            ) -> Tensor:
+def reshard(dist_tensor, mesh: ProcessMesh | None = None,
+            placements=None) -> Tensor:
     """Redistribute a tensor (reference: auto_parallel/api.py:304; reshard
     engine paddle/phi/core/distributed/auto_parallel/reshard/*.cc). XLA picks
     the collective (all-gather for s→r, dynamic-slice for r→s, all-to-all for
@@ -89,6 +89,7 @@ def reshard(t: Tensor, mesh: ProcessMesh | None = None, placements=None
         raise NotImplementedError(
             "reshard to Partial is not supported (Partial is an internal "
             "state the GSPMD partitioner materialises lazily)")
+    t = dist_tensor
     spec = placements_to_spec(placements, mesh, ndim=t._value.ndim)
     arr = jax.device_put(t._value, NamedSharding(mesh.jax_mesh, spec))
     out = Tensor(arr, stop_gradient=t.stop_gradient)

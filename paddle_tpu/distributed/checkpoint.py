@@ -28,9 +28,31 @@ import jax
 
 from paddle_tpu.core.tensor import Tensor
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "finish_async_save",
+           "register_migration"]
 
 _META = "metadata.json"
+
+# checkpoint format version, stamped into metadata.json (reference:
+# paddle/phi/api/yaml/op_version.yaml — the reference versions ops so old
+# checkpoints keep loading; here the FORMAT itself is versioned and
+# migration hooks upgrade old merged tables on load).
+# v1: unstamped (r1-r3 checkpoints); v2: adds format_version stamp.
+_FORMAT_VERSION = 2
+
+# {from_version: fn(merged_tables, info) -> merged_tables} applied in
+# sequence on load until _FORMAT_VERSION is reached
+_MIGRATIONS: dict = {}
+
+
+def register_migration(from_version: int):
+    """Register an upgrade hook for checkpoints written at
+    `from_version`; it receives (merged_tables, metadata_info) and
+    returns upgraded tables."""
+    def deco(fn):
+        _MIGRATIONS[int(from_version)] = fn
+        return fn
+    return deco
 
 
 def _arr(v):
@@ -68,23 +90,91 @@ def _index_to_offsets(index, shape):
     return offs, sizes
 
 
+# one in-flight async save per process (reference:
+# checkpoint/save_state_dict.py:104 async_save); the NEXT save (or an
+# explicit finish_async_save()) is the completion barrier
+_async_thread = None
+_async_error = None
+
+
+def _atexit_finish():
+    """A daemon writer killed at interpreter exit would truncate the
+    run's final checkpoint silently (code-review r4); drain it."""
+    try:
+        finish_async_save()
+    except Exception as e:      # noqa: BLE001 — exit path: report, don't raise
+        import sys
+        print(f"WARNING: async checkpoint save failed at exit: {e!r}",
+              file=sys.stderr)
+
+
+import atexit                                               # noqa: E402
+
+atexit.register(_atexit_finish)
+
+
+def finish_async_save():
+    """Join the in-flight async save, re-raising its failure. Called
+    automatically at the start of every save_state_dict (the
+    "completion barrier on the next save")."""
+    global _async_thread, _async_error
+    t = _async_thread
+    if t is not None:
+        t.join()
+        _async_thread = None
+    err, _async_error = _async_error, None
+    if err is not None:
+        raise RuntimeError("previous async checkpoint save failed") \
+            from err
+
+
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, async_save=False):
     """Write each host's addressable shards + global metadata (reference:
-    checkpoint/save_state_dict.py:104)."""
-    try:
-        _save_state_dict_files(state_dict, path, coordinator_rank)
-    finally:
-        # ALWAYS reach the barrier, even when writing failed: barrier tags
-        # are sequence-numbered per process, so a host that skipped one
-        # barrier would desynchronize every later save (each host waiting
-        # on a different tag until timeout). A failed write surfaces via
-        # the raise below *and* as a missing table at load time.
-        _save_barrier(path)
+    checkpoint/save_state_dict.py:104).
+
+    async_save=True: the device->host snapshot happens NOW (so later
+    optimizer steps — which may donate/replace the arrays — cannot
+    corrupt the checkpoint), but serialization, file writes, and the
+    cross-host barrier run in a background thread; training proceeds
+    meanwhile. The next save (or finish_async_save()) joins it and
+    surfaces any failure."""
+    global _async_thread, _async_error
+    finish_async_save()
+    payload, meta, pid = _snapshot_state(state_dict)
+    if not async_save:
+        try:
+            _write_files(payload, meta, pid, path, coordinator_rank)
+        finally:
+            # ALWAYS reach the barrier, even when writing failed:
+            # barrier tags are sequence-numbered per process, so a host
+            # that skipped one would desynchronize every later save. A
+            # failed write surfaces via the raise *and* as a missing
+            # table at load time.
+            _save_barrier(path)
+        return
+
+    import threading
+
+    def run():
+        global _async_error
+        try:
+            try:
+                _write_files(payload, meta, pid, path, coordinator_rank)
+            finally:
+                _save_barrier(path)
+        except BaseException as e:      # noqa: BLE001
+            _async_error = e
+
+    _async_thread = threading.Thread(target=run, daemon=True,
+                                     name="ckpt-async-save")
+    _async_thread.start()
 
 
-def _save_state_dict_files(state_dict, path, coordinator_rank):
-    os.makedirs(path, exist_ok=True)
+def _snapshot_state(state_dict):
+    """Device->host copy of every addressable shard (the synchronous
+    part of a save: after this returns, the checkpoint content is
+    immune to donation/overwrite by subsequent training steps)."""
     flat = _flatten_state(state_dict)
     pid = jax.process_index()
     fname = f"shards_{pid}.npz"
@@ -116,13 +206,18 @@ def _save_state_dict_files(state_dict, path, coordinator_rank):
                 entry["shards"].append({"offsets": offs, "sizes": sizes,
                                         "file": fname, "key": key})
         meta[name] = entry
-    np.savez(os.path.join(path, fname), **payload)
+    return payload, meta, pid
+
+
+def _write_files(payload, meta, pid, path, coordinator_rank):
+    os.makedirs(path, exist_ok=True)
+    np.savez(os.path.join(path, f"shards_{pid}.npz"), **payload)
     with open(os.path.join(path, f"table_{pid}.json"), "w") as f:
         json.dump(meta, f, indent=1)
-
     if pid == coordinator_rank:
         with open(os.path.join(path, _META), "w") as f:
-            json.dump({"process_count": jax.process_count()}, f, indent=1)
+            json.dump({"process_count": jax.process_count(),
+                       "format_version": _FORMAT_VERSION}, f, indent=1)
 
 
 _barrier_seq = 0
@@ -182,8 +277,14 @@ def _merged_tables(path):
             info = json.load(f)
     except FileNotFoundError:
         info = {}
+    version = int(info.get("format_version", 1))   # unstamped = v1
+    if version > _FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint {path!r} has format_version {version}, newer "
+            f"than this build's {_FORMAT_VERSION}; upgrade paddle_tpu "
+            "to load it")
     if "state_dict_metadata" in info:   # pre-table single-file format
-        return info["state_dict_metadata"]
+        return _migrate(info["state_dict_metadata"], version, info)
     expect = info.get("process_count")
     if expect is not None:
         # read EXACTLY this save's tables: a previous save into the same
@@ -234,6 +335,15 @@ def _merged_tables(path):
                 tgt["shards"].append(sh)
     for entry in merged.values():
         entry.pop("_seen")
+    return _migrate(merged, version, info)
+
+
+def _migrate(merged, version, info):
+    """Upgrade old formats through registered migration hooks (v1 -> v2
+    needs none: the stamp is the only difference)."""
+    for v in range(version, _FORMAT_VERSION):
+        if v in _MIGRATIONS:
+            merged = _MIGRATIONS[v](merged, info)
     return merged
 
 

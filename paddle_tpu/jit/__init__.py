@@ -15,7 +15,8 @@ import jax
 import numpy as np
 
 from paddle_tpu.jit.api import (to_static, not_to_static, StaticFunction,
-                                InputSpec, enable_to_static, ignore_module)
+                                InputSpec, enable_to_static, ignore_module,
+                                explain, compilation_cache_stats)
 from paddle_tpu.jit.functional import functional_call, state_arrays, state_tensors
 from paddle_tpu.jit.dy2static import (cond, while_loop, scan,
                                       Dy2StaticTransformError)

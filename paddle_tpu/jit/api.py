@@ -29,6 +29,12 @@ from paddle_tpu.jit.functional import _swapped, state_tensors
 
 _tracing = threading.local()
 
+# live StaticFunctions, for process-wide cache stats (weak: the registry
+# must not keep a model's compiled steps alive)
+import weakref                                              # noqa: E402
+
+_all_static_functions: "weakref.WeakSet" = weakref.WeakSet()
+
 
 def _in_tracing() -> bool:
     return getattr(_tracing, "depth", 0) > 0
@@ -78,7 +84,18 @@ class StaticFunction:
         self._out_treedefs = {}
         self._traced_fn = None      # set lazily (AST control-flow rewrite)
         self._fell_back = False
+        # guard/retrace observability (reference: SOT guards,
+        # sot/opcode_translator/executor/guards.py — "why did my jit
+        # recompile?"): every call's guard signature is checked against
+        # the seen set; a novel one is a (re)trace event whose CAUSE
+        # (which input's shape/dtype/treedef/static value changed) is
+        # recorded in _retrace_log. jit.explain(fn) renders it.
+        self._seen_sigs = set()
+        self._last_sig = None
+        self._retrace_log = []
+        self._call_count = 0
         functools.update_wrapper(self, self._fn)
+        _all_static_functions.add(self)
 
     def _body_fn(self):
         """The function actually traced: the dy2static AST rewrite of
@@ -168,6 +185,16 @@ class StaticFunction:
         static_leaves = tuple((i, a) for i, a in enumerate(arr_leaves)
                               if i not in set(dyn_idx))
         key = (treedef, static_leaves, dyn_idx, tuple(sg_flags))
+        self._call_count += 1
+        sig = (key, tuple((tuple(arr_leaves[i].shape),
+                           str(arr_leaves[i].dtype)) for i in dyn_idx))
+        if sig not in self._seen_sigs:
+            self._record_retrace(sig, args, kwargs)
+            self._seen_sigs.add(sig)
+        # track EVERY call's signature: a retrace cause must name the
+        # transition from the PREVIOUS CALL the user made, not from the
+        # last novel trace (code-review r4)
+        self._last_sig = sig
         jitted = self._get_jitted(key)
         dyn_vals = [arr_leaves[i] for i in dyn_idx]
 
@@ -229,6 +256,73 @@ class StaticFunction:
         current_tape().record(node)
         return out
 
+    # ---- guard/retrace observability ------------------------------------
+    def _leaf_labels(self, args, kwargs):
+        """Human-readable path per flattened (args, kwargs) leaf."""
+        from jax.tree_util import tree_flatten_with_path, keystr
+        paths, _ = tree_flatten_with_path(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        return [keystr(p) for p, _leaf in paths]
+
+    def _record_retrace(self, sig, args, kwargs):
+        """Classify WHY this call needs a new trace: which guard moved
+        (the reference surfaces this through SOT guard failures,
+        sot/.../guards.py; here the guards are explicit tuples)."""
+        prev = self._last_sig
+        event = {"call": self._call_count, "kind": "first_trace",
+                 "detail": "initial compilation"}
+        if prev is not None:
+            (ptree, pstatic, pdyn_idx, psg), pavals = prev
+            (ntree, nstatic, ndyn_idx, nsg), navals = sig
+            labels = self._leaf_labels(args, kwargs)
+
+            def label(i):
+                return labels[i] if i < len(labels) else f"leaf[{i}]"
+
+            if ptree != ntree:
+                event.update(kind="treedef", detail=(
+                    "input structure changed: "
+                    f"{ptree} -> {ntree}"))
+            elif pstatic != nstatic:
+                changed = [(i, o, n) for (i, o), (j, n)
+                           in zip(pstatic, nstatic) if o != n or i != j] \
+                    or [(None, pstatic, nstatic)]
+                i, o, n = changed[0]
+                event.update(kind="static_value", detail=(
+                    f"static arg {label(i) if i is not None else ''} "
+                    f"changed: {o!r} -> {n!r}"))
+            elif psg != nsg:
+                event.update(kind="stop_gradient", detail=(
+                    f"stop_gradient flags changed: {psg} -> {nsg}"))
+            elif pdyn_idx != ndyn_idx:
+                event.update(kind="treedef", detail=(
+                    f"tensor-leaf positions changed: {pdyn_idx} -> "
+                    f"{ndyn_idx}"))
+            else:
+                for pos, (pa, na) in enumerate(zip(pavals, navals)):
+                    if pa == na:
+                        continue
+                    kind = "dtype" if pa[0] == na[0] else "shape"
+                    event.update(kind=kind, detail=(
+                        f"arg {label(ndyn_idx[pos])}: "
+                        f"{pa[0]}/{pa[1]} -> {na[0]}/{na[1]}"))
+                    break
+        self._retrace_log.append(event)
+
+    def stats(self):
+        """Compilation-cache statistics for this function (reference:
+        the SOT guard/cache introspection surface)."""
+        return {"name": getattr(self._fn, "__qualname__", str(self._fn)),
+                "calls": self._call_count,
+                "traces": len(self._retrace_log),
+                "cache_entries": len(self._seen_sigs),
+                "fell_back": self._fell_back,
+                "retraces": list(self._retrace_log)}
+
+    @property
+    def retrace_log(self):
+        return list(self._retrace_log)
+
     def _graph_break(self, err, args, kwargs):
         """Whole-function fallback to eager when tracing hits host-side
         data dependence the rewrite couldn't capture (the coarse
@@ -276,6 +370,53 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     if function is not None:
         return deco(function)
     return deco
+
+
+def _resolve_static(fn):
+    from paddle_tpu.nn.layer.layers import Layer
+    if isinstance(fn, StaticFunction):
+        return fn
+    if isinstance(fn, Layer) and isinstance(fn.forward, StaticFunction):
+        return fn.forward
+    raise ValueError(
+        f"{fn!r} is not a to_static-compiled function/Layer; wrap it "
+        "with paddle_tpu.jit.to_static first")
+
+
+def explain(fn) -> str:
+    """Render WHY a to_static function (re)compiled: one line per trace
+    event with the guard that moved (shape/dtype/treedef/static value/
+    stop_gradient). The debugging surface the reference provides via
+    SOT guard logs (sot/opcode_translator/executor/guards.py); here the
+    guards are explicit, so the report is exact.
+
+    >>> print(paddle_tpu.jit.explain(model))    # doctest: +SKIP
+    """
+    sf = _resolve_static(fn)
+    st = sf.stats()
+    lines = [f"to_static {st['name']}: {st['calls']} calls, "
+             f"{st['traces']} traces, {st['cache_entries']} cache "
+             f"entries" + (", FELL BACK TO EAGER" if st["fell_back"]
+                           else "")]
+    for i, ev in enumerate(st["retraces"]):
+        lines.append(f"  trace #{i + 1} (call {ev['call']}): "
+                     f"[{ev['kind']}] {ev['detail']}")
+    return "\n".join(lines)
+
+
+def compilation_cache_stats():
+    """Process-wide compilation-cache statistics over every live
+    StaticFunction: total compiled entries, traces, calls, and the
+    per-function breakdown (reference: the executor cache the reference
+    exposes through FLAGS + executor_statistics.cc)."""
+    per_fn = [sf.stats() for sf in list(_all_static_functions)]
+    return {
+        "functions": len(per_fn),
+        "total_calls": sum(s["calls"] for s in per_fn),
+        "total_traces": sum(s["traces"] for s in per_fn),
+        "total_cache_entries": sum(s["cache_entries"] for s in per_fn),
+        "per_function": per_fn,
+    }
 
 
 def not_to_static(fn):

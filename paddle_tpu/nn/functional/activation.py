@@ -8,7 +8,7 @@ from paddle_tpu.core.dispatch import defop
 
 
 @defop("relu")
-def relu(x):
+def relu(x, name=None):
     return jax.nn.relu(x)
 
 
@@ -27,12 +27,12 @@ def relu_(x):
 
 
 @defop("gelu")
-def gelu(x, approximate=False):
+def gelu(x, approximate=False, name=None):
     return jax.nn.gelu(x, approximate=bool(approximate))
 
 
 @defop("silu")
-def silu(x):
+def silu(x, name=None):
     return jax.nn.silu(x)
 
 
@@ -40,7 +40,7 @@ swish = silu
 
 
 @defop("sigmoid_act")
-def sigmoid(x):
+def sigmoid(x, name=None):
     return jax.nn.sigmoid(x)
 
 
@@ -76,7 +76,7 @@ def tanhshrink(x):
 
 
 @defop("leaky_relu")
-def leaky_relu(x, negative_slope=0.01):
+def leaky_relu(x, negative_slope=0.01, name=None):
     return jax.nn.leaky_relu(x, negative_slope)
 
 

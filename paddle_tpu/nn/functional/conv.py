@@ -153,7 +153,7 @@ def _conv2d_transpose(x, weight, bias=None, stride=(1, 1),
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
-                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     output_padding=0, dilation=1, groups=1, output_size=None,
                      data_format="NCHW", name=None):
     return _conv2d_transpose(
         x, weight, bias, stride=_tuple(stride, 2),

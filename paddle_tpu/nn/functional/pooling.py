@@ -47,8 +47,8 @@ def _max_pool2d(x, kernel_size, stride, padding, ceil_mode=False):
                           jax.lax.max, kernel_size, stride, padding, 2)
 
 
-def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               return_mask=False, data_format="NCHW", name=None):
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
     ks = _tuple(kernel_size, 2)
     st = _tuple(stride, 2) if stride is not None else ks
     out = _max_pool2d(x, kernel_size=ks, stride=st,

@@ -121,7 +121,8 @@ class Conv2DTranspose(_ConvNd):
     def forward(self, x, output_size=None):
         return F.conv2d_transpose(x, self.weight, self.bias, self._stride,
                                   self._padding, self._output_padding,
-                                  self._groups, self._dilation,
+                                  groups=self._groups,
+                                  dilation=self._dilation,
                                   data_format=self._data_format)
 
 

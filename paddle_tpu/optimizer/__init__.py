@@ -235,9 +235,13 @@ class Momentum(Optimizer):
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 multi_precision=False, name=None):
+                 multi_precision=False, rescale_grad=1.0,
+                 use_multi_tensor=False, name=None):
         self._momentum = momentum
         self._nesterov = use_nesterov
+        # rescale_grad multiplies incoming grads (reference momentum.py);
+        # use_multi_tensor is implicit under XLA fusion
+        self._rescale_grad = float(rescale_grad)
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision, name)
 
@@ -246,6 +250,8 @@ class Momentum(Optimizer):
 
     def _rule(self, p, g, state, lr, wd):
         g = g.astype(jnp.float32)
+        if self._rescale_grad != 1.0:
+            g = g * self._rescale_grad
         g = g + self._decay_term(p.astype(jnp.float32), wd)
         v = self._momentum * state["velocity"] + g
         if self._nesterov:
@@ -262,7 +268,9 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 amsgrad=False, name=None):
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        # use_multi_tensor: accepted for reference parity; XLA fuses the
+        # whole update program, so multi-tensor batching is implicit
         self._beta1 = beta1
         self._beta2 = beta2
         self._eps = epsilon

@@ -14,20 +14,30 @@ def _mk(arr, dtype=None) -> Tensor:
     return Tensor(arr if dtype is None else arr.astype(dtypes.convert_dtype(dtype)))
 
 
-def zeros(shape, dtype="float32", name=None):
-    return Tensor(jnp.zeros(_shape(shape), dtypes.convert_dtype(dtype)))
+def _dt(dtype):
+    """dtype=None resolves to paddle.get_default_dtype() (reference
+    contract: creation ops honor set_default_dtype)."""
+    if dtype is None:
+        from paddle_tpu.framework import _default_dtype
+        return dtypes.convert_dtype(_default_dtype[0])
+    return dtypes.convert_dtype(dtype)
 
 
-def ones(shape, dtype="float32", name=None):
-    return Tensor(jnp.ones(_shape(shape), dtypes.convert_dtype(dtype)))
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
 
 
-def full(shape, fill_value, dtype="float32", name=None):
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
     fv = fill_value.item() if isinstance(fill_value, Tensor) else fill_value
-    return Tensor(jnp.full(_shape(shape), fv, dtypes.convert_dtype(dtype)))
+    return Tensor(jnp.full(_shape(shape), fv, _dt(dtype)))
 
 
-def empty(shape, dtype="float32", name=None):
+def empty(shape, dtype=None, name=None):
     return zeros(shape, dtype)
 
 
@@ -77,22 +87,20 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
     return Tensor(jnp.arange(start, end, step, dtype=dt))
 
 
-def linspace(start, stop, num, dtype="float32", name=None):
+def linspace(start, stop, num, dtype=None, name=None):
     start = start.item() if isinstance(start, Tensor) else start
     stop = stop.item() if isinstance(stop, Tensor) else stop
     num = int(num.item() if isinstance(num, Tensor) else num)
-    return Tensor(jnp.linspace(start, stop, num,
-                               dtype=dtypes.convert_dtype(dtype)))
+    return Tensor(jnp.linspace(start, stop, num, dtype=_dt(dtype)))
 
 
-def logspace(start, stop, num, base=10.0, dtype="float32", name=None):
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
     return Tensor(jnp.logspace(float(start), float(stop), int(num), base=base,
-                               dtype=dtypes.convert_dtype(dtype)))
+                               dtype=_dt(dtype)))
 
 
-def eye(num_rows, num_columns=None, dtype="float32", name=None):
-    return Tensor(jnp.eye(num_rows, num_columns,
-                          dtype=dtypes.convert_dtype(dtype)))
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
 
 
 @defop("diag")
@@ -116,12 +124,12 @@ def diagflat(x, offset=0):
 
 
 @defop("tril")
-def tril(x, diagonal=0):
+def tril(x, diagonal=0, name=None):
     return jnp.tril(x, k=diagonal)
 
 
 @defop("triu")
-def triu(x, diagonal=0):
+def triu(x, diagonal=0, name=None):
     return jnp.triu(x, k=diagonal)
 
 

@@ -34,12 +34,12 @@ def mm(input, mat2):
 
 
 @defop("bmm", amp_policy="white")
-def bmm(x, y):
+def bmm(x, y, name=None):
     return jnp.matmul(x, y)
 
 
 @defop("dot")
-def dot(x, y):
+def dot(x, y, name=None):
     return jnp.sum(x * y, axis=-1)
 
 
@@ -53,8 +53,8 @@ def _t(x):
     return x.T if x.ndim >= 2 else x
 
 
-def t(x, name=None):
-    return _t(x)
+def t(input, name=None):
+    return _t(input)
 
 
 @defop("cross")

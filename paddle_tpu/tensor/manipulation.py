@@ -304,9 +304,11 @@ def _gather(x, index, axis=0):
     return jnp.take(x, index, axis=axis)
 
 
-def gather(x, index, axis=0, name=None):
+def gather(x, index, axis=None, name=None):
     if isinstance(axis, Tensor):
         axis = int(axis.item())
+    if axis is None:        # reference: axis=None means axis 0
+        axis = 0
     idx = index
     if isinstance(index, Tensor) and index.ndim == 2 and index.shape[1] == 1:
         idx = index.reshape([-1])

@@ -22,22 +22,22 @@ def _unary(name, fn, amp="promote", diff=True):
 
 # ---- binary arithmetic -------------------------------------------------
 @defop("add")
-def add(x, y):
+def add(x, y, name=None):
     return jnp.add(x, y)
 
 
 @defop("subtract")
-def subtract(x, y):
+def subtract(x, y, name=None):
     return jnp.subtract(x, y)
 
 
 @defop("multiply")
-def multiply(x, y):
+def multiply(x, y, name=None):
     return jnp.multiply(x, y)
 
 
 @defop("divide")
-def divide(x, y):
+def divide(x, y, name=None):
     return jnp.true_divide(x, y)
 
 
@@ -56,7 +56,7 @@ floor_mod = mod
 
 
 @defop("pow", amp_policy="black")
-def pow(x, y):
+def pow(x, y, name=None):
     return jnp.power(x, y)
 
 
@@ -143,7 +143,7 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
 
 
 @defop("clip")
-def clip(x, min=None, max=None):
+def clip(x, min=None, max=None, name=None):
     return jnp.clip(x, min, max)
 
 
@@ -159,7 +159,7 @@ def stanh(x, scale_a=0.67, scale_b=1.7159):
 
 # ---- unary -------------------------------------------------------------
 @defop("abs")
-def abs(x):
+def abs(x, name=None):
     return jnp.abs(x)
 
 
@@ -179,7 +179,7 @@ def sgn(x):
 
 
 @defop("exp", amp_policy="black")
-def exp(x):
+def exp(x, name=None):
     return jnp.exp(x)
 
 
@@ -189,7 +189,7 @@ def expm1(x):
 
 
 @defop("log", amp_policy="black")
-def log(x):
+def log(x, name=None):
     return jnp.log(x)
 
 
@@ -209,7 +209,7 @@ def log1p(x):
 
 
 @defop("sqrt")
-def sqrt(x):
+def sqrt(x, name=None):
     return jnp.sqrt(x)
 
 
@@ -311,12 +311,12 @@ def logit(x, eps=None):
 
 
 @defop("floor", differentiable=False)
-def floor(x):
+def floor(x, name=None):
     return jnp.floor(x)
 
 
 @defop("ceil", differentiable=False)
-def ceil(x):
+def ceil(x, name=None):
     return jnp.ceil(x)
 
 
@@ -430,23 +430,23 @@ def _axis(axis):
 
 
 @defop("sum", amp_policy="black")
-def sum(x, axis=None, dtype=None, keepdim=False):
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
     return jnp.sum(x, axis=_axis(axis), dtype=dtypes.convert_dtype(dtype),
                    keepdims=keepdim)
 
 
 @defop("mean", amp_policy="black")
-def mean(x, axis=None, keepdim=False):
+def mean(x, axis=None, keepdim=False, name=None):
     return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
 
 
 @defop("max")
-def max(x, axis=None, keepdim=False):
+def max(x, axis=None, keepdim=False, name=None):
     return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
 
 
 @defop("min")
-def min(x, axis=None, keepdim=False):
+def min(x, axis=None, keepdim=False, name=None):
     return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
 
 
@@ -499,7 +499,7 @@ def nanmean(x, axis=None, keepdim=False):
 
 # ---- cumulative --------------------------------------------------------
 @defop("cumsum", amp_policy="black")
-def cumsum(x, axis=None, dtype=None):
+def cumsum(x, axis=None, dtype=None, name=None):
     return jnp.cumsum(x, axis=axis, dtype=dtypes.convert_dtype(dtype))
 
 

@@ -13,20 +13,20 @@ import jax.numpy as jnp
 from paddle_tpu.core import dtype as dtypes
 from paddle_tpu.core.random import next_key
 from paddle_tpu.core.tensor import Tensor
-from paddle_tpu.tensor.creation import _shape
+from paddle_tpu.tensor.creation import _dt, _shape
 
 
-def rand(shape, dtype="float32", name=None):
+def rand(shape, dtype=None, name=None):
     return Tensor(jax.random.uniform(next_key(), _shape(shape),
-                                     dtypes.convert_dtype(dtype) or jnp.float32))
+                                     _dt(dtype)))
 
 
-def randn(shape, dtype="float32", name=None):
+def randn(shape, dtype=None, name=None):
     return Tensor(jax.random.normal(next_key(), _shape(shape),
-                                    dtypes.convert_dtype(dtype) or jnp.float32))
+                                    _dt(dtype)))
 
 
-def standard_normal(shape, dtype="float32", name=None):
+def standard_normal(shape, dtype=None, name=None):
     return randn(shape, dtype)
 
 
@@ -48,11 +48,10 @@ def normal_(x, mean=0.0, std=1.0, name=None):
     return x
 
 
-def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
     key = jax.random.key(seed) if seed else next_key()
     return Tensor(jax.random.uniform(
-        key, _shape(shape), dtypes.convert_dtype(dtype) or jnp.float32,
-        minval=min, maxval=max))
+        key, _shape(shape), _dt(dtype), minval=min, maxval=max))
 
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
@@ -63,7 +62,7 @@ def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
     return x
 
 
-def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+def randint(low=0, high=None, shape=[1], dtype=None, name=None):
     if high is None:
         low, high = 0, low
     return Tensor(jax.random.randint(

@@ -31,7 +31,7 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
 
 
 @defop("argsort", differentiable=False)
-def argsort(x, axis=-1, descending=False, stable=True):
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
     out = jnp.argsort(x, axis=axis, stable=stable,
                       descending=descending)
     return out
@@ -57,8 +57,10 @@ def _topk(x, k, axis=-1, largest=True, sorted=True):
     return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(jnp.int64)
 
 
-def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
     k = int(k.item()) if isinstance(k, Tensor) else int(k)
+    if axis is None:        # reference: axis=None means the last axis
+        axis = -1
     return _topk(x, k=k, axis=axis, largest=largest, sorted=sorted)
 
 

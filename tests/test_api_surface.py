@@ -199,3 +199,93 @@ def test_identity_loss_validates_reduction():
     assert float(inc.identity_loss(x, "sum").numpy()) == 3.0
     with pytest.raises(ValueError):
         inc.identity_loss(x, "man")
+
+
+# -- round 4: signature/default parity (VERDICT r3 item 10) ------------------
+
+def _load_ref_signatures():
+    import json
+    import os
+    path = os.path.join(os.path.dirname(__file__), "data",
+                        "ref_signatures.json")
+    return json.load(open(path))
+
+
+def _resolve(dotted):
+    obj = paddle
+    for part in dotted.split(".")[1:]:
+        obj = getattr(obj, part)
+    return obj
+
+
+def _signature_drift(dotted, spec):
+    """-> list of drift messages for one API (empty = in parity).
+    Rules: every reference param must exist (unless we take **kwargs),
+    shared params keep the reference's relative order, and literal
+    reference defaults must match ours exactly."""
+    import inspect
+    obj = _resolve(dotted)
+    target = obj.__init__ if spec["kind"] == "cls" and \
+        inspect.isclass(obj) else obj
+    sig = inspect.signature(target)
+    ours = [(p.name, p) for p in sig.parameters.values()
+            if p.name != "self"]
+    our_names = [n for n, _ in ours]
+    our_map = dict(ours)
+    ref_plain = [(n, d) for n, d in spec["params"]
+                 if not n.startswith("*")]
+    has_kw = any(p.kind == p.VAR_KEYWORD for _, p in ours)
+    msgs = []
+    missing = [n for n, _ in ref_plain if n not in our_map and not has_kw]
+    if missing:
+        return [f"missing params {missing} (ours: {our_names})"]
+    shared = [n for n, _ in ref_plain if n in our_map]
+    idxs = [our_names.index(n) for n in shared]
+    if idxs != sorted(idxs):
+        msgs.append(f"param order differs: ref {shared}, ours "
+                    f"{our_names}")
+    for n, d in ref_plain:
+        if d in (None, "<expr>") or n not in our_map:
+            continue
+        p = our_map[n]
+        if p.default is inspect.Parameter.empty:
+            msgs.append(f"param {n}: reference default {d}, ours "
+                        "REQUIRED")
+        elif repr(p.default) != d:
+            msgs.append(f"param {n}: reference default {d}, ours "
+                        f"{p.default!r}")
+    return msgs
+
+
+@pytest.mark.quick
+def test_signature_parity_with_reference():
+    """~120 highest-traffic APIs keep the reference's parameter names,
+    order, and literal defaults (recorded by
+    tools/extract_ref_signatures.py from the reference SOURCE — rerun
+    it if the reference moves). Name parity alone let defaults drift
+    silently (VERDICT r3)."""
+    sigs = _load_ref_signatures()
+    assert len(sigs) >= 100
+    drift = {}
+    for dotted, spec in sorted(sigs.items()):
+        msgs = _signature_drift(dotted, spec)
+        if msgs:
+            drift[dotted] = msgs
+    assert not drift, "\n".join(
+        f"{k}: {'; '.join(v)}" for k, v in drift.items())
+
+
+def test_signature_drift_detection_fires():
+    """The checker actually catches drift: perturb a recorded default
+    and a recorded name, expect complaints."""
+    import copy
+    sigs = _load_ref_signatures()
+    spec = copy.deepcopy(sigs["paddle.nn.functional.softmax"])
+    for p in spec["params"]:
+        if p[0] == "axis":
+            p[1] = "7"              # wrong default
+    assert any("axis" in m for m in
+               _signature_drift("paddle.nn.functional.softmax", spec))
+    spec["params"].insert(0, ["nonexistent_param", None])
+    assert any("missing" in m for m in
+               _signature_drift("paddle.nn.functional.softmax", spec))

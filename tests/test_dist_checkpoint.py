@@ -201,3 +201,128 @@ def test_multihost_stale_tables_ignored(tmp_path):
     sd = {"w": paddle.to_tensor(np.zeros((8, 4), np.float32))}
     ckpt.load_state_dict(sd, str(tmp_path))
     np.testing.assert_array_equal(np.asarray(sd["w"]._value), w)
+
+
+# -- round 4: true async save + format versioning (VERDICT r3 item 8) --------
+
+def test_async_save_overlaps_and_snapshots(tmp_path):
+    """async_save=True returns before files exist (write runs in the
+    background), training-style mutation AFTER the call cannot leak
+    into the checkpoint (device->host snapshot at call time), and the
+    next save joins the previous one."""
+    import os
+    import threading
+    import time
+
+    mesh = _mesh2d()
+    w0 = np.arange(32, dtype=np.float32).reshape(8, 4)
+    t = dist.shard_tensor(w0.copy(), mesh,
+                          [dist.Shard(0), dist.Replicate()])
+
+    # throttle the background writer so the overlap window is visible
+    import paddle_tpu.distributed.checkpoint as C
+    orig_write = C._write_files
+    gate = threading.Event()
+
+    def slow_write(*a, **k):
+        gate.wait(10)
+        return orig_write(*a, **k)
+
+    C._write_files = slow_write
+    try:
+        t0 = time.perf_counter()
+        ckpt.save_state_dict({"w": t}, str(tmp_path), async_save=True)
+        returned_in = time.perf_counter() - t0
+        assert returned_in < 5, "async save blocked on the writer"
+        # "training step": replace the tensor's value AFTER the save
+        t._value = t._value + 100.0
+        assert not os.path.exists(str(tmp_path / "table_0.json"))
+        gate.set()
+        ckpt.finish_async_save()
+    finally:
+        C._write_files = orig_write
+
+    fresh = dist.shard_tensor(np.zeros_like(w0), mesh,
+                              [dist.Shard(0), dist.Replicate()])
+    sd = {"w": fresh}
+    ckpt.load_state_dict(sd, str(tmp_path))
+    # the checkpoint holds the PRE-mutation snapshot
+    np.testing.assert_array_equal(np.asarray(sd["w"]._value), w0)
+
+
+def test_async_save_failure_surfaces_on_next_save(tmp_path):
+    import pytest
+    import paddle_tpu.distributed.checkpoint as C
+    mesh = _mesh2d()
+    t = dist.shard_tensor(np.ones((8, 4), np.float32), mesh,
+                          [dist.Shard(0), dist.Replicate()])
+    orig = C._write_files
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    C._write_files = boom
+    try:
+        ckpt.save_state_dict({"w": t}, str(tmp_path / "a"),
+                             async_save=True)
+        with pytest.raises(RuntimeError, match="async checkpoint save"):
+            ckpt.save_state_dict({"w": t}, str(tmp_path / "b"))
+    finally:
+        C._write_files = orig
+        C._async_error = None
+
+
+def test_format_version_stamped_and_old_format_loads(tmp_path):
+    """New saves stamp format_version; an UNSTAMPED (v1, rounds 1-3)
+    checkpoint still loads; a future version is rejected."""
+    import json
+    import pytest
+    mesh = _mesh2d()
+    w = np.random.RandomState(3).randn(8, 4).astype(np.float32)
+    t = dist.shard_tensor(w, mesh, [dist.Shard(0), dist.Replicate()])
+    ckpt.save_state_dict({"w": t}, str(tmp_path))
+    meta = json.load(open(tmp_path / "metadata.json"))
+    assert meta["format_version"] == 2
+
+    # simulate an old (round-3) checkpoint: strip the stamp
+    del meta["format_version"]
+    json.dump(meta, open(tmp_path / "metadata.json", "w"))
+    sd = {"w": paddle.to_tensor(np.zeros_like(w))}
+    ckpt.load_state_dict(sd, str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(sd["w"]._value), w)
+
+    # a newer-than-supported version refuses with guidance
+    meta["format_version"] = 99
+    json.dump(meta, open(tmp_path / "metadata.json", "w"))
+    with pytest.raises(ValueError, match="newer"):
+        ckpt.load_state_dict({"w": paddle.to_tensor(np.zeros_like(w))},
+                             str(tmp_path))
+
+
+def test_migration_hook_applies(tmp_path):
+    """register_migration upgrades old tables on load (the
+    op_version.yaml analog)."""
+    import json
+    mesh = _mesh2d()
+    w = np.random.RandomState(4).randn(8, 4).astype(np.float32)
+    t = dist.shard_tensor(w, mesh, [dist.Shard(0), dist.Replicate()])
+    ckpt.save_state_dict({"old_name": t}, str(tmp_path))
+    meta = json.load(open(tmp_path / "metadata.json"))
+    del meta["format_version"]      # pretend v1
+    json.dump(meta, open(tmp_path / "metadata.json", "w"))
+
+    import paddle_tpu.distributed.checkpoint as C
+
+    @C.register_migration(1)
+    def rename(tables, info):
+        # v1 stored this tensor under its legacy name
+        return {("new_name" if k == "old_name" else k): v
+                for k, v in tables.items()}
+
+    try:
+        sd = {"new_name": paddle.to_tensor(np.zeros_like(w))}
+        ckpt.load_state_dict(sd, str(tmp_path))
+        np.testing.assert_array_equal(np.asarray(sd["new_name"]._value),
+                                      w)
+    finally:
+        C._MIGRATIONS.pop(1, None)
