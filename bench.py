@@ -10,6 +10,7 @@ repo publishes no absolute numbers — BASELINE.md).
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -79,9 +80,12 @@ def main():
                 # ffn fusion measured SLOWER here (split defeats the
                 # swiglu epilogue fusion); qkv fusion is neutral-positive
                 fuse_attention_qkv=True, fuse_attention_ffn=False)
-            # b6 > b4 since the fused CE freed the ~1GB f32 log-softmax
-            # residual (b8 still HBM-thrashes)
-            batch, seq, steps = 6, 2048, 10
+            # batch history: b6 > b4 after the fused CE freed the ~1GB
+            # f32 log-softmax residual (r2); b7 > b6 after the in-kernel
+            # delta + transposed-lse kernels freed the (b,h,sq,8) f32
+            # arrays (r4; b8 measured neutral, no longer thrashing)
+            batch, seq, steps = int(os.environ.get("PT_BENCH_BATCH", 7)), \
+                2048, 10
     else:
         cfg = tiny_llama_config(recompute=True)
         batch, seq, steps = 4, 32, 3

@@ -162,7 +162,12 @@ def save_state_dict(state_dict, path, process_group=None,
             try:
                 _write_files(payload, meta, pid, path, coordinator_rank)
             finally:
-                _save_barrier(path)
+                # KV-store barrier ONLY: sync_global_devices is a device
+                # all-reduce, and dispatching one from this background
+                # thread would interleave with the main thread's training
+                # collectives in a host-dependent order (cross-host
+                # deadlock, code-review r4)
+                _save_barrier(path, allow_device_sync=False)
         except BaseException as e:      # noqa: BLE001
             _async_error = e
 
@@ -223,7 +228,7 @@ def _write_files(payload, meta, pid, path, coordinator_rank):
 _barrier_seq = 0
 
 
-def _save_barrier(path, timeout_ms=600_000):
+def _save_barrier(path, timeout_ms=600_000, allow_device_sync=True):
     """Block until every host finished writing (the jax.distributed
     analog of the reference's TCPStore rendezvous). No-op single-host;
     WARNS when multi-process without a way to synchronize (a silent skip
@@ -241,6 +246,8 @@ def _save_barrier(path, timeout_ms=600_000):
         try:
             from jax.experimental import multihost_utils
         except ImportError:
+            multihost_utils = None
+        if not allow_device_sync:
             multihost_utils = None
         if multihost_utils is not None:
             try:
@@ -365,6 +372,9 @@ def load_state_dict(state_dict, path, process_group=None,
     """Fill `state_dict`'s tensors from a sharded checkpoint, resharding
     to each tensor's CURRENT sharding (reference:
     checkpoint/load_state_dict.py:377 — compute_overlap + read slices)."""
+    # loading a checkpoint this process just wrote with async_save=True
+    # must wait for the writer (else a half-written directory loads)
+    finish_async_save()
     meta = _merged_tables(path)
 
     files = {}

@@ -9,8 +9,9 @@ TPU-native implementation:
   online-softmax accumulation over k/v blocks streamed through VMEM, MXU
   matmuls in f32 accumulation. Causal cells whose k-block lies entirely
   above the diagonal are skipped via the loop bound. Also emits the
-  row logsumexp (LSE) for the backward pass, lane-replicated to
-  _lanes_for() width (8 when the fused backward consumes it, else 128).
+  row logsumexp (LSE) for the backward pass, stored TRANSPOSED as
+  (b, h, 8, sq) f32 — full (8,128) tiles; a (sq, 8) layout wastes 15/16
+  of every tile's bandwidth on the minor-dim padding (r4 trace).
 - backward, small kv (the common training shape after the GQA fold):
   ONE fused Pallas kernel — grid (b, h, q-block), k/v + full-kv f32
   dk/dv scratch VMEM-resident — produces dq, dk and dv from a single
@@ -50,21 +51,24 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 _LOG2E = 1.4426950408889634  # kernels exponentiate in base 2: exp(x) = exp2(x*log2e)
-# LSE/delta lane replication width, chosen per call by _lanes_for():
-# 8 (min f32 tile) when the fused backward will run — it reads each
-# lse/delta block exactly ONCE per (b, h) sweep, so narrow blocks just
-# cut HBM bytes and the XLA delta broadcast 16x; 128 (native lane tile)
-# for the dq/dkv pair and streamed-kv paths, which RE-read lse/delta
-# across the kv grid axis — there round 1 measured 8 lanes ~3% slower
-# (many small narrow DMAs). Env var overrides both.
-_LANES_ENV = _os.environ.get("PADDLE_TPU_FLASH_LSE_LANES")
-if _LANES_ENV is not None:
-    _LANES_ENV = int(_LANES_ENV)
-    if _LANES_ENV < 8 or _LANES_ENV % 8:
-        raise ValueError(
-            f"PADDLE_TPU_FLASH_LSE_LANES={_LANES_ENV}: must be a multiple "
-            "of 8 (the f32 sublane tile) — smaller/unaligned values fail "
-            "Mosaic lowering at runtime")
+# LSE/delta sublane replication rows in the TRANSPOSED (b, h, rows, sq)
+# layout: 8 = the f32 sublane tile, so every (8, 128) tile is fully
+# used. (The r1-r3 (b, h, sq, lanes) layout padded the 8- or 128-wide
+# minor dim into (8,128) tiles; the r4 trace measured its delta twin
+# broadcasting at 33 GB/s — 4.3 ms/step of layout waste.)
+_LSE_ROWS = 8
+
+# A/B flag: run the softmax exponentials in bf16 (packed VPU rate)
+# instead of f32. Changes numerics by ~1e-3 relative on p; the l/lse
+# accumulations stay f32.
+_BF16_EXP = _os.environ.get("PADDLE_TPU_FLASH_BF16_EXP", "0") in ("1",
+                                                                  "true")
+
+
+def _exp2(x):
+    if _BF16_EXP:
+        return jnp.exp2(x.astype(jnp.bfloat16))
+    return jnp.exp2(x)
 
 # Tuning knobs (swept on v5e: (512,512) best in the full train step; larger
 # q-blocks win in kernel isolation but lose in context)
@@ -253,9 +257,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
                 valid = jnp.logical_and(valid, col <= row)
             s = jnp.where(valid, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp2(s - m_new)
+        p = _exp2(s - m_new)
         alpha = jnp.exp2(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True,
+                                    dtype=jnp.float32)
         acc_new = acc * alpha + jax.lax.dot_general(
             p.astype(vj.dtype), vj, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)
@@ -269,8 +274,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     m, l, acc = jax.lax.fori_loop(n_full, nk, body, carry)
     o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     if lse_ref is not None:
-        lse = m + jnp.log2(jnp.maximum(l, 1e-30))   # base-2, matches bwd
-        lse_ref[0, 0] = jnp.broadcast_to(lse, (bq, lse_ref.shape[3]))
+        # TRANSPOSED lse store (rows, bq): the old (bq, 8) f32 layout
+        # tiled (8,128) wasted 15/16 of every tile's bandwidth (r4
+        # trace: its downstream delta twin broadcast ran at 33 GB/s)
+        lse_t = (m + jnp.log2(jnp.maximum(l, 1e-30))).T   # (1, bq), base-2
+        lse_ref[0, 0] = jnp.broadcast_to(lse_t, (lse_ref.shape[2], bq))
 
 
 def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
@@ -321,9 +329,10 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         m = m_scr[:, :1]
         l = l_scr[:, :1]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp2(s - m_new)
+        p = _exp2(s - m_new)
         alpha = jnp.exp2(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True,
+                                    dtype=jnp.float32)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             p.astype(vj.dtype), vj, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)
@@ -343,8 +352,9 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         l = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
         if lse_ref is not None:
-            lse = m_scr[:, :1] + jnp.log2(l)
-            lse_ref[0, 0] = jnp.broadcast_to(lse, (bq, lse_ref.shape[3]))
+            lse_t = (m_scr[:, :1] + jnp.log2(l)).T            # (1, bq)
+            lse_ref[0, 0] = jnp.broadcast_to(lse_t,
+                                             (lse_ref.shape[2], bq))
 
 
 # whole-k/v per grid cell is faster but caps kv length; beyond this byte
@@ -385,19 +395,6 @@ def _auto_stream_kv(sk_p, d, itemsize):
     return sk_p * d * 2 * itemsize > _KV_VMEM_BYTES
 
 
-def _lanes_for(sk_p, d, itemsize):
-    """LSE/delta lane width for the given kv size: 8 when the fused
-    backward will consume them (each block read once), 128 for the
-    dq/dkv-pair and streamed paths that re-read them per kv block (see
-    the comment at _LANES_ENV). fwd and bwd derive the same answer from
-    the same shapes, and bwd additionally follows lse.shape[-1]."""
-    if _LANES_ENV is not None:
-        return _LANES_ENV
-    fused = (not _auto_stream_kv(sk_p, d, itemsize)
-             and sk_p * d * 2 * itemsize <= _FUSED_KV_BYTES)
-    return 8 if fused else 128
-
-
 def _ki_clamp(bq, bk, causal, seg_len):
     """For streamed k/v block index maps: clamp ki to the last block this
     q-row actually needs (causal), so above-diagonal grid steps revisit
@@ -420,7 +417,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
     G concatenated segments of this length (GQA fold; requires block
     alignment — callers gate on it). stream_kv: force (True) / forbid
     (False) the 4D streamed-kv kernel; None = auto by kv size.
-    Returns (out (B,H,Sq,D), lse (B,H,Sq_pad,128) f32 | None)."""
+    Returns (out (B,H,Sq,D), lse (B,H,8,Sq_pad) f32 TRANSPOSED | None)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     will_stream = (stream_kv if stream_kv is not None
@@ -461,7 +458,6 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
                 v = jnp.pad(v[:, :, :sk],
                             ((0, 0), (0, 0), (0, pad), (0, 0)))
     kt = jnp.swapaxes(k, 2, 3)   # (b, h, d, sk): XLA fuses the transpose
-    lanes = _lanes_for(sk_p, d, k.dtype.itemsize)
 
     if stream_kv:
         kernel = functools.partial(
@@ -478,10 +474,10 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
             pl.BlockSpec((1, 1, bk, d),
                          lambda bi, hi, qi, ki: (bi, hi, clamp(qi, ki), 0)),
         ]
-        lspec = pl.BlockSpec((1, 1, bq, lanes),
-                             lambda bi, hi, qi, ki: (bi, hi, qi, 0))
-        scratch = [pltpu.VMEM((bq, lanes), jnp.float32),
-                   pltpu.VMEM((bq, lanes), jnp.float32),
+        lspec = pl.BlockSpec((1, 1, _LSE_ROWS, bq),
+                             lambda bi, hi, qi, ki: (bi, hi, 0, qi))
+        scratch = [pltpu.VMEM((bq, _LSE_ROWS), jnp.float32),
+                   pltpu.VMEM((bq, _LSE_ROWS), jnp.float32),
                    pltpu.VMEM((bq, d), jnp.float32)]
     else:
         # PADDLE_TPU_FLASH_QT=1: hand q over TRANSPOSED (b, h, d, sq)
@@ -507,8 +503,8 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
             pl.BlockSpec((1, 1, sk_p, d),
                          lambda bi, hi, qi: (bi, hi, 0, 0)),
         ]
-        lspec = pl.BlockSpec((1, 1, bq, lanes),
-                             lambda bi, hi, qi: (bi, hi, qi, 0))
+        lspec = pl.BlockSpec((1, 1, _LSE_ROWS, bq),
+                             lambda bi, hi, qi: (bi, hi, 0, qi))
         scratch = []
     if stream_kv:
         ospec = qspec
@@ -517,7 +513,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, block_q=None, block_k=None,
     if save_lse:
         out_specs.append(lspec)
         out_shape.append(
-            jax.ShapeDtypeStruct((b, h, sq_p, lanes), jnp.float32))
+            jax.ShapeDtypeStruct((b, h, _LSE_ROWS, sq_p), jnp.float32))
     else:
         kernel = functools.partial(
             lambda q_ref, k_ref, v_ref, o_ref, *scr, kern: kern(
@@ -548,8 +544,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     q = (q_ref[0, 0] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype))
     do = do_ref[0, 0]
-    lse = lse_ref[0, 0, :, :1]                     # (bq, 1) f32
-    delta = delta_ref[0, 0, :, :1]                 # (bq, 1) f32
+    lse = lse_ref[0, 0, :1, :].T                   # (bq, 1) f32
+    delta = delta_ref[0, 0, :1, :].T               # (bq, 1) f32
     prec = _prec(q_ref.dtype)
 
     nk_total = kv_pad // block_k
@@ -578,7 +574,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                                0) + start
                 valid = jnp.logical_and(valid, col <= row)
             s = jnp.where(valid, s, _NEG_INF)
-        p = jnp.exp2(s - lse)                                   # (bq, bk)
+        p = _exp2(s - lse)                                   # (bq, bk)
         dp = jax.lax.dot_general(
             do, vj, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (bq, bk)
@@ -622,8 +618,8 @@ def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def compute(masked):
         q = (q_ref[0, 0] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype))
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0, :, :1]
-        delta = delta_ref[0, 0, :, :1]
+        lse = lse_ref[0, 0, :1, :].T
+        delta = delta_ref[0, 0, :1, :].T
         kj = k_ref[0, 0]                                   # (bk, d)
         vj = v_ref[0, 0]                                   # (bk, d)
         s = jax.lax.dot_general(
@@ -638,7 +634,7 @@ def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     + start
                 valid = jnp.logical_and(valid, col <= row)
             s = jnp.where(valid, s, _NEG_INF)
-        p = jnp.exp2(s - lse)
+        p = _exp2(s - lse)
         dp = jax.lax.dot_general(
             do, vj, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)
@@ -701,8 +697,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         qj = (q_ref[0, 0]
               * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype))    # (bq, d)
         doj = do_ref[0, 0]                                      # (bq, d)
-        lse_t = lse_ref[0, 0, :, :1].T                          # (1, bq)
-        delta_t = delta_ref[0, 0, :, :1].T                      # (1, bq)
+        lse_t = lse_ref[0, 0, :1, :]                            # (1, bq)
+        delta_t = delta_ref[0, 0, :1, :]                        # (1, bq)
         s_t = jax.lax.dot_general(
             k, qj, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (bk, bq)
@@ -717,7 +713,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     + start
                 valid = jnp.logical_and(valid, col <= row_c)
             s_t = jnp.where(valid, s_t, _NEG_INF)
-        p_t = jnp.exp2(s_t - lse_t)                             # (bk, bq)
+        p_t = _exp2(s_t - lse_t)                             # (bk, bq)
         dv_scr[...] += jax.lax.dot_general(
             p_t.astype(doj.dtype), doj, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (bk, d)
@@ -746,16 +742,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                       dq_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
                       sm_scale, causal, block_k, q_valid, kv_valid,
                       nq_total, seg_len=None):
     """Single-pass FA2 backward: dq, dk and dv from ONE softmax recompute.
 
     Grid (b, h, jq). Per (b, h): k/v stay VMEM-resident (constant block
-    index => one DMA); q/do and the narrow (8-lane) lse/delta stream per
+    index => one DMA); q/do/o and the transposed (8, bq) lse stream per
     q-block — each block is read exactly once per (b, h) sweep, so this
-    costs the same HBM bytes as keeping them resident. dq accumulates in
+    costs the same HBM bytes as keeping them resident. delta comes from
+    o IN-REGISTER (sum(do*o)), not a materialized array. dq accumulates in
     the fori_loop carry and writes per cell; dk/dv accumulate across the
     whole jq sweep in full-kv f32 scratch and store once at the last jq
     (the dk/dv output block index is constant per (b, h), so Pallas
@@ -779,8 +776,14 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     qj = q_ref[0, 0] * jnp.asarray(sm_scale * _LOG2E, q_ref.dtype)  # (bq,d)
     doj = do_ref[0, 0]                                              # (bq,d)
-    lse_t = lse_ref[0, 0, :, :1].T                                  # (1,bq)
-    delta_t = delta_ref[0, 0, :, :1].T                              # (1,bq)
+    lse_t = lse_ref[0, 0, :1, :]                                    # (1,bq)
+    # delta = sum(do * o) computed IN-REGISTER from the streamed o
+    # block: the old materialized delta was a (b, h, sq, 8) f32 array
+    # whose (8,128) tile padding made its broadcast write run at
+    # ~33 GB/s — 4.3 ms/step of pure layout waste (r4 trace)
+    delta_t = jnp.sum(doj.astype(jnp.float32)
+                      * o_ref[0, 0].astype(jnp.float32),
+                      axis=-1)[None, :]                             # (1,bq)
     prec = _prec(q_ref.dtype)
 
     start_g = jq * bq                    # global row (q_valid mask)
@@ -824,7 +827,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     jnp.int32, (block_k, bq), 1) + start
                 valid = jnp.logical_and(valid, col <= row_c)
             s_t = jnp.where(valid, s_t, _NEG_INF)
-        p_t = jnp.exp2(s_t - lse_t)                              # (bk,bq)
+        p_t = _exp2(s_t - lse_t)                                 # (bk,bq)
         dv_scr[pl.ds(j * block_k, block_k)] += jax.lax.dot_general(
             p_t.astype(doj.dtype), doj, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec)  # (bk,d)
@@ -866,8 +869,8 @@ _FUSED_KV_BYTES = int(_os.environ.get("PADDLE_TPU_FLASH_FUSED_KV",
 def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
                       block_q=None, block_k=None, interpret=False,
                       seg_len=None, stream_kv=None, fused=None):
-    """FA2 backward. q,k,v,o,g: (B,H,S,D); lse: (B,H,Sq_pad,lanes) f32
-    (lane width set by the forward via _lanes_for)."""
+    """FA2 backward. q,k,v,o,g: (B,H,S,D); lse: (B,H,rows,Sq_pad) f32
+    TRANSPOSED layout (full (8,128) tiles — see the fwd kernel note)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     will_stream = (stream_kv if stream_kv is not None
@@ -884,21 +887,18 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
     sq_p = (sq + bq - 1) // bq * bq
     sk_p = (sk + bk - 1) // bk * bk
 
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    lanes = lse.shape[3]
-    delta = jnp.broadcast_to(delta[..., None], delta.shape + (lanes,))
     # lse was padded with the FORWARD block size; reconcile to ours
     # (padded rows are masked in dkv and sliced off dq, values don't matter)
-    if lse.shape[2] > sq_p:
-        lse = lse[:, :, :sq_p]
-    elif lse.shape[2] < sq_p:
-        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, sq_p - lse.shape[2]),
-                            (0, 0)))
+    if lse.shape[3] > sq_p:
+        lse = lse[..., :sq_p]
+    elif lse.shape[3] < sq_p:
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, 0),
+                            (0, sq_p - lse.shape[3])))
     if sq_p != sq:
         pad = ((0, 0), (0, 0), (0, sq_p - sq), (0, 0))
         q = jnp.pad(q, pad)
         g = jnp.pad(g, pad)
-        delta = jnp.pad(delta, pad)
+        o = jnp.pad(o, pad)
     if sk_p != sk:
         pad = ((0, 0), (0, 0), (0, sk_p - sk), (0, 0))
         k = jnp.pad(k, pad)
@@ -932,15 +932,15 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
         # lse/delta stream per q-block: each block is read exactly once
         # per (b, h) sweep, so streaming costs the same HBM bytes as
         # whole-resident, without dynamic sublane slicing in-kernel
-        lres = pl.BlockSpec((1, 1, bq, lanes),
-                            lambda bi, hi, qi: (bi, hi, qi, 0))
+        lres = pl.BlockSpec((1, 1, _LSE_ROWS, bq),
+                            lambda bi, hi, qi: (bi, hi, 0, qi))
         dq, dk, dv = pl.pallas_call(
             functools.partial(_bwd_fused_kernel, sm_scale=sm_scale,
                               causal=causal, block_k=bk, q_valid=sq,
                               kv_valid=sk, nq_total=sq_p // bq,
                               seg_len=seg_len),
             grid=(b, h, sq_p // bq),
-            in_specs=[qspec, kres, kres, qspec, lres, lres],
+            in_specs=[qspec, kres, kres, qspec, qspec, lres],
             out_specs=[qspec, kres, kres],
             out_shape=[jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
                        jax.ShapeDtypeStruct((b, h, sk_p, d), k.dtype),
@@ -948,8 +948,17 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
             scratch_shapes=[pltpu.VMEM((sk_p, d), jnp.float32),
                             pltpu.VMEM((sk_p, d), jnp.float32)],
             interpret=interpret,
-        )(q, k, v, g, lse, delta)
+        )(q, k, v, g, o, lse)
         return (dq[:, :, :sq, :], dk[:, :, :sk, :], dv[:, :, :sk, :])
+
+    # non-fused paths (streamed / dq+dkv pair) still consume the
+    # materialized lane-broadcast delta (their kernels read it per
+    # (q-block, kv-block) pair, where recomputing from o would re-read
+    # o once per kv block)
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, :, None, :],
+                             delta.shape[:2] + (_LSE_ROWS,)
+                             + delta.shape[2:])
 
     if stream_kv:
         clamp = _ki_clamp(bq, bk, causal, seg_len)
@@ -958,8 +967,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
         kspec4q = pl.BlockSpec((1, 1, bk, d),
                                lambda bi, hi, qi, ki: (bi, hi,
                                                        clamp(qi, ki), 0))
-        lspec4q = pl.BlockSpec((1, 1, bq, lanes),
-                               lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+        lspec4q = pl.BlockSpec((1, 1, _LSE_ROWS, bq),
+                               lambda bi, hi, qi, ki: (bi, hi, 0, qi))
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel_stream, sm_scale=sm_scale,
                               causal=causal, kv_valid=sk,
@@ -976,8 +985,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
                              lambda bi, hi, qi: (bi, hi, qi, 0))
         kfull = pl.BlockSpec((1, 1, sk_p, d),
                              lambda bi, hi, qi: (bi, hi, 0, 0))
-        lspec = pl.BlockSpec((1, 1, bq, lanes),
-                             lambda bi, hi, qi: (bi, hi, qi, 0))
+        lspec = pl.BlockSpec((1, 1, _LSE_ROWS, bq),
+                             lambda bi, hi, qi: (bi, hi, 0, qi))
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel, sm_scale=sm_scale,
                               causal=causal, block_k=bk, kv_valid=sk,
@@ -994,8 +1003,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, g, causal, sm_scale,
                           lambda bi, hi, ki, qi: (bi, hi, ki, 0))
     qspec4 = pl.BlockSpec((1, 1, bq, d),
                           lambda bi, hi, ki, qi: (bi, hi, qi, 0))
-    lspec4 = pl.BlockSpec((1, 1, bq, lanes),
-                          lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+    lspec4 = pl.BlockSpec((1, 1, _LSE_ROWS, bq),
+                          lambda bi, hi, ki, qi: (bi, hi, 0, qi))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           nq_total=nq_total, q_valid=sq, kv_valid=sk,

@@ -24,6 +24,11 @@ def _measure_steps(trainer, batch, steps=6, repeats=5):
     reported band is the protocol). Returns (median_dt, loss, spread)
     where spread = (max-min)/median over the windows."""
     import statistics
+    import jax.numpy as jnp
+    # pre-stage the batch on device ONCE (bench.py protocol): a numpy
+    # batch re-crosses the dispatch tunnel every step, which dominates
+    # sub-100ms steps (the r3 DiT row's 3.6x spread was exactly this)
+    batch = {k: jnp.asarray(v) for k, v in batch.items()}
     float(trainer.step(batch))                 # compile + sync
     times = []
     loss = None
