@@ -82,6 +82,18 @@ int PT_GeneratorStream(PT_Generator g, const int32_t* prompt, int batch,
                        int eos_token_id, long long seed,
                        PT_TokenCallback cb, void* user);
 
+/* As PT_GeneratorStream, plus a prompt padding mask: batch x prompt_len
+ * bytes, 1 = real token, 0 = pad (LEFT padding — every row must end
+ * with a real token). NULL mask == all-real. Requires a format-2
+ * bundle exported from a mask-capable model. */
+int PT_GeneratorStreamMasked(PT_Generator g, const int32_t* prompt,
+                             const uint8_t* attention_mask, int batch,
+                             int prompt_len, int max_new_tokens,
+                             int do_sample, double temperature, int top_k,
+                             double top_p, int eos_token_id,
+                             long long seed, PT_TokenCallback cb,
+                             void* user);
+
 const char* PT_LastError(void);
 
 #ifdef __cplusplus
@@ -229,32 +241,56 @@ void PT_GeneratorDestroy(void* g) {
   PyGILState_Release(gs);
 }
 
-int PT_GeneratorStream(void* g, const int32_t* prompt, int batch,
-                       int prompt_len, int max_new_tokens, int do_sample,
-                       double temperature, int top_k, double top_p,
-                       int eos_token_id, long long seed,
-                       int (*cb)(const int32_t*, int, int, void*),
-                       void* user) {
+int PT_GeneratorStreamMasked(void* g, const int32_t* prompt,
+                             const uint8_t* attention_mask, int batch,
+                             int prompt_len, int max_new_tokens,
+                             int do_sample, double temperature, int top_k,
+                             double top_p, int eos_token_id,
+                             long long seed,
+                             int (*cb)(const int32_t*, int, int, void*),
+                             void* user) {
   PyGILState_STATE gs = PyGILState_Ensure();
   int rc = -1;
+  PyObject* mask = NULL;
   PyObject* buf = PyBytes_FromStringAndSize(
       (const char*)prompt, (Py_ssize_t)batch * prompt_len * 4);
-  PyObject* mod = buf ? PyImport_ImportModule("paddle_tpu.inference.capi")
-                      : NULL;
+  if (buf) {
+    if (attention_mask) {
+      mask = PyBytes_FromStringAndSize(
+          (const char*)attention_mask, (Py_ssize_t)batch * prompt_len);
+    } else {
+      mask = Py_None; Py_INCREF(Py_None);
+    }
+  }
+  PyObject* mod = (buf && mask)
+      ? PyImport_ImportModule("paddle_tpu.inference.capi") : NULL;
   PyObject* res = mod ? PyObject_CallMethod(
-      mod, "_capi_generator_stream", "OOiiiididiLKK",
-      (PyObject*)g, buf, batch, prompt_len, max_new_tokens, do_sample,
-      temperature, top_k, top_p, eos_token_id, seed,
+      mod, "_capi_generator_stream", "OOOiiiididiLKK",
+      (PyObject*)g, buf, mask, batch, prompt_len, max_new_tokens,
+      do_sample, temperature, top_k, top_p, eos_token_id, seed,
       (unsigned long long)(uintptr_t)cb,
       (unsigned long long)(uintptr_t)user) : NULL;
   Py_XDECREF(mod);
   Py_XDECREF(buf);
+  Py_XDECREF(mask);
   if (!res) { set_err_from_py(); goto done; }
   rc = (int)PyLong_AsLong(res);
   Py_DECREF(res);
 done:
   PyGILState_Release(gs);
   return rc;
+}
+
+int PT_GeneratorStream(void* g, const int32_t* prompt, int batch,
+                       int prompt_len, int max_new_tokens, int do_sample,
+                       double temperature, int top_k, double top_p,
+                       int eos_token_id, long long seed,
+                       int (*cb)(const int32_t*, int, int, void*),
+                       void* user) {
+  return PT_GeneratorStreamMasked(g, prompt, NULL, batch, prompt_len,
+                                  max_new_tokens, do_sample, temperature,
+                                  top_k, top_p, eos_token_id, seed, cb,
+                                  user);
 }
 
 int PT_PredictorOutput(void* p, int i, const void** data, int64_t* shape,
@@ -326,9 +362,10 @@ def _capi_generator_create(path_prefix):
     return [GenerationPredictor(path_prefix)]
 
 
-def _capi_generator_stream(holder, prompt_bytes, batch, prompt_len,
-                           max_new_tokens, do_sample, temperature, top_k,
-                           top_p, eos_token_id, seed, cb_addr, user_addr):
+def _capi_generator_stream(holder, prompt_bytes, mask_bytes, batch,
+                           prompt_len, max_new_tokens, do_sample,
+                           temperature, top_k, top_p, eos_token_id, seed,
+                           cb_addr, user_addr):
     """Drive GenerationPredictor.stream, invoking the C callback (raw
     function-pointer address, called via ctypes) once per generated
     position. A nonzero callback return cancels the stream. Returns the
@@ -337,13 +374,17 @@ def _capi_generator_stream(holder, prompt_bytes, batch, prompt_len,
 
     gp = holder[0]
     ids = np.frombuffer(prompt_bytes, "int32").reshape(batch, prompt_len)
+    mask = (None if mask_bytes is None else
+            np.frombuffer(mask_bytes, "uint8")
+              .reshape(batch, prompt_len).astype(bool))
     cb = ctypes.CFUNCTYPE(
         ctypes.c_int, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
         ctypes.c_int, ctypes.c_void_p)(cb_addr)
     user = ctypes.c_void_p(user_addr or None)
     steps = 0
     for tok in gp.stream(
-            ids, max_new_tokens, do_sample=bool(do_sample),
+            ids, max_new_tokens, attention_mask=mask,
+            do_sample=bool(do_sample),
             temperature=temperature, top_k=top_k, top_p=top_p,
             eos_token_id=None if eos_token_id < 0 else eos_token_id,
             seed=None if seed < 0 else int(seed)):

@@ -58,6 +58,14 @@ _LOG2E = 1.4426950408889634  # kernels exponentiate in base 2: exp(x) = exp2(x*l
 # broadcasting at 33 GB/s — 4.3 ms/step of layout waste.)
 _LSE_ROWS = 8
 
+if _os.environ.get("PADDLE_TPU_FLASH_LSE_LANES"):
+    import warnings as _warnings
+
+    _warnings.warn(
+        "PADDLE_TPU_FLASH_LSE_LANES no longer exists: the r4 transposed "
+        "(b, h, 8, sq) lse layout removed the lane-width knob entirely "
+        "(every tile is full). The env var is ignored.")
+
 # A/B flag: run the softmax exponentials in bf16 (packed VPU rate)
 # instead of f32. Changes numerics by ~1e-3 relative on p; the l/lse
 # accumulations stay f32.

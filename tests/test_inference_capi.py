@@ -203,3 +203,59 @@ def test_c_api_generator_streaming(tmp_path):
     assert not lib.PT_GeneratorCreate(b"/nonexistent/bundle")
     assert lib.PT_LastError()
     lib.PT_GeneratorDestroy(g)
+
+
+def test_c_api_generator_streaming_masked(tmp_path):
+    """PT_GeneratorStreamMasked: a left-padded prompt through the C API
+    matches live padded generation; NULL mask equals the unmasked
+    entry."""
+    from paddle_tpu.models import LlamaForCausalLM, generate
+    from paddle_tpu.models.llama import tiny_llama_config
+    from paddle_tpu.models.generation import export_generation_bundle
+    from paddle_tpu.inference import capi
+
+    paddle.seed(0)
+    m = LlamaForCausalLM(tiny_llama_config(num_hidden_layers=2))
+    m.eval()
+    rng = np.random.RandomState(1)
+    prompt = np.ascontiguousarray(rng.randint(0, 256, (2, 8)),
+                                  dtype=np.int32)
+    mask = np.ones((2, 8), np.uint8)
+    mask[1, :3] = 0                       # row 1 left-padded by 3
+    path = str(tmp_path / "gm")
+    export_generation_bundle(m, path, batch_size=2, prompt_len=8,
+                             max_new_tokens=4)
+    ref = generate(m, paddle.to_tensor(prompt), max_new_tokens=4,
+                   attention_mask=mask.astype("int32")).numpy()[:, 8:]
+
+    so = capi.build(str(tmp_path / "capi"))
+    lib = ctypes.CDLL(so)
+    CB = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+                          ctypes.c_int, ctypes.c_int, ctypes.c_void_p)
+    lib.PT_GeneratorCreate.restype = ctypes.c_void_p
+    lib.PT_GeneratorCreate.argtypes = [ctypes.c_char_p]
+    lib.PT_GeneratorDestroy.argtypes = [ctypes.c_void_p]
+    lib.PT_GeneratorStreamMasked.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_double, ctypes.c_int,
+        ctypes.c_double, ctypes.c_int, ctypes.c_longlong, CB,
+        ctypes.c_void_p]
+    lib.PT_LastError.restype = ctypes.c_char_p
+
+    g = lib.PT_GeneratorCreate(path.encode())
+    assert g, lib.PT_LastError()
+    got = []
+
+    @CB
+    def on_tok(toks, batch, step, user):
+        got.append([toks[i] for i in range(batch)])
+        return 0
+
+    pp = prompt.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    mp = mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+    n = lib.PT_GeneratorStreamMasked(g, pp, mp, 2, 8, 4, 0, 1.0, 0, 1.0,
+                                     -1, -1, on_tok, None)
+    assert n == 4, (n, lib.PT_LastError())
+    np.testing.assert_array_equal(np.array(got, np.int32).T, ref)
+    lib.PT_GeneratorDestroy(g)
