@@ -151,7 +151,7 @@ class ElasticSupervisor:
 
     def __init__(self, cmd, world_size, env=None, max_restarts=3,
                  heartbeat_grace=15.0, poll_interval=0.5,
-                 startup_grace=120.0):
+                 startup_grace=120.0, jax_coordinator=False):
         self.cmd = list(cmd)
         self.world_size = world_size
         self.env = dict(env) if env is not None else dict(os.environ)
@@ -162,6 +162,12 @@ class ElasticSupervisor:
         self.attempt = 0
         self.restarts = 0
         self._spawn_time = 0.0
+        # jax_coordinator=True: workers form a REAL jax.distributed
+        # world. Each attempt gets a FRESH coordination-service address
+        # (PADDLE_JAX_COORDINATOR) — the service lives inside rank 0, so
+        # it dies with the attempt and a relaunch must not race the old
+        # socket's teardown on the same port.
+        self.jax_coordinator = jax_coordinator
         from paddle_tpu.distributed.store import TCPStore
         self._store = TCPStore(is_master=True, world_size=world_size)
         self._procs: list = []
@@ -173,6 +179,10 @@ class ElasticSupervisor:
         self._spawn_time = time.time()
         for rank in range(self.world_size):
             env = dict(self.env)
+            # never leak an OUTER job's coordinator into our workers
+            # (env.py gives these top precedence)
+            env.pop("PADDLE_JAX_COORDINATOR", None)
+            env.pop("PADDLE_JAX_COORDINATOR_FROM_STORE", None)
             env.update({
                 "PADDLE_TRAINER_ID": str(rank),
                 "PADDLE_TRAINERS_NUM": str(self.world_size),
@@ -180,6 +190,11 @@ class ElasticSupervisor:
                     f"{self._store.host}:{self._store.port}",
                 "PADDLE_ELASTIC_ATTEMPT": str(self.attempt),
             })
+            if self.jax_coordinator:
+                # rank 0 allocates + publishes the per-attempt
+                # coordination address through the store (env.py
+                # _coordinator_from_store) — no supervisor-side TOCTOU
+                env["PADDLE_JAX_COORDINATOR_FROM_STORE"] = "1"
             self._procs.append(subprocess.Popen(
                 self.cmd, env=env,
                 stdout=None if env.get("PADDLE_ELASTIC_VERBOSE")

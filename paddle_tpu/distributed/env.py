@@ -47,8 +47,13 @@ def init_parallel_env():
     immediately, mirroring the reference's is_initialized short-circuit."""
     if _initialized[0]:
         return
-    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
-        "MASTER_ADDR")
+    # PADDLE_JAX_COORDINATOR wins when set: under the elastic supervisor
+    # PADDLE_MASTER is the supervisor's heartbeat/rendezvous store, and
+    # the jax coordination service needs its own (per-attempt) address
+    coord = (_coordinator_from_store()
+             or os.environ.get("PADDLE_JAX_COORDINATOR")
+             or os.environ.get("PADDLE_MASTER")
+             or os.environ.get("MASTER_ADDR"))
     nprocs = _env_int("PADDLE_TRAINERS_NUM", "WORLD_SIZE", default=1)
     if nprocs > 1 and not _jax_distributed_active():
         port = os.environ.get("MASTER_PORT", "8476")
@@ -58,6 +63,38 @@ def init_parallel_env():
             num_processes=nprocs,
             process_id=_env_int("PADDLE_TRAINER_ID", "RANK", default=0))
     _initialized[0] = True
+
+
+def _coordinator_from_store():
+    """Rank-0-publishes-port handshake (PADDLE_JAX_COORDINATOR_FROM_
+    STORE=1, set by ElasticSupervisor(jax_coordinator=True)): the
+    supervisor picking a free port ahead of time is a TOCTOU race —
+    another process can claim it before rank 0's coordination service
+    binds, burning a restart for a non-worker fault. Instead rank 0
+    allocates the port IN-PROCESS (microseconds before initialize binds
+    it) and publishes the address under an attempt-scoped key in the
+    rendezvous store; peers wait for it."""
+    if os.environ.get("PADDLE_JAX_COORDINATOR_FROM_STORE") != "1":
+        return None
+    from paddle_tpu.distributed.store import TCPStore
+    host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+    attempt = os.environ.get("PADDLE_ELASTIC_ATTEMPT", "")
+    key = (f"a{attempt}/" if attempt != "" else "") + "jax_coord"
+    rank = _env_int("PADDLE_TRAINER_ID", "RANK", default=0)
+    store = TCPStore(host, int(port))
+    try:
+        if rank == 0:
+            import socket
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            addr = f"127.0.0.1:{s.getsockname()[1]}"
+            s.close()
+            store.set(key, addr.encode())
+            return addr
+        store.wait(key, timeout=300)
+        return store.get(key).decode()
+    finally:
+        store.close()
 
 
 def _jax_distributed_active():

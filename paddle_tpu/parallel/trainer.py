@@ -166,16 +166,29 @@ class Trainer:
                 for k, v in st.items()}
             for n, st in self.opt_state.items()}
 
+    @staticmethod
+    def _put_global(v, sh):
+        """device_put that tolerates COMMITTED local arrays when the
+        target sharding spans non-addressable devices (multi-process
+        resume: checkpoint loads commit values to local devices; jax
+        only re-spreads uncommitted/host values across processes)."""
+        try:
+            return jax.device_put(v, sh)
+        except ValueError:
+            import numpy as np
+            return jax.device_put(np.asarray(v), sh)
+
     def _shard_state(self):
         for n in list(self.params):
             sh = NamedSharding(self.mesh, self._spec(n))
-            self.params[n] = jax.device_put(self.params[n], sh)
+            self.params[n] = self._put_global(self.params[n], sh)
         # optimizer moments shard exactly like their parameter; scalars
         # (beta_pow) replicate. This is ZeRO sharding of optimizer state
         # (reference: dygraph_sharding_optimizer.py:48) for free.
         for n, st in self.opt_state.items():
             for k, v in st.items():
-                st[k] = jax.device_put(v, self._opt_leaf_sharding(n, v))
+                st[k] = self._put_global(v,
+                                         self._opt_leaf_sharding(n, v))
 
     # -- the compiled step -------------------------------------------------
     def _loss_from_batch(self, params_c, batch):
