@@ -49,6 +49,14 @@ OP_REGISTRY: dict[str, OpDef] = {}
 # executed ops by output dtype (reference: debugging.py operator stats)
 OP_STATS_HOOK = None
 
+# static-graph capture (paddle_tpu.static.graph) installs a
+# callable(op, args, kwargs) here while a program_guard is active; it
+# returns NotImplemented for all-concrete calls (which then execute
+# eagerly as usual) and a recorded placeholder result otherwise —
+# the deferred-op analog of the reference's static op append
+# (python/paddle/base/framework.py append_op)
+STATIC_GRAPH_HOOK = None
+
 # amp.debugging installs a callable(op_name)->bool here to narrow the
 # NaN/Inf check to TensorCheckerConfig's checked/skipped op lists
 NAN_CHECK_FILTER = None
@@ -97,6 +105,11 @@ def defop(name: str, differentiable: bool = True, amp_policy: str = "promote",
 def dispatch(op: OpDef, args, kwargs):
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu import amp as amp_mod
+
+    if STATIC_GRAPH_HOOK is not None:
+        out = STATIC_GRAPH_HOOK(op, args, kwargs)
+        if out is not NotImplemented:
+            return out
 
     # AMP autocast hook (reference: eager_gen.py:515 AMP logic in every
     # generated forward).

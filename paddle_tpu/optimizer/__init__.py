@@ -145,6 +145,18 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from paddle_tpu.static.graph import _StaticVar, current_program
+        if isinstance(loss, _StaticVar):
+            # static mode (reference: optimizer.minimize appends the
+            # backward + update ops): register the training directive;
+            # Executor.run computes grads in the jitted replay and
+            # drives this optimizer's eager step()
+            prog = current_program()
+            if prog is None:
+                raise RuntimeError(
+                    "minimize(static loss) outside a program_guard")
+            prog.minimizers.append((self, loss))
+            return None, None
         loss.backward()
         self.step()
         self.clear_grad()

@@ -1,16 +1,22 @@
-"""`paddle.static` — static-graph compatibility surface (reference:
-python/paddle/static/).
+"""`paddle.static` — static-graph mode (reference: python/paddle/static/).
 
-There is no separate static engine in paddle_tpu: `jax.jit` tracing IS the
-static mode (SURVEY.md §3.3 — SOT/AST-to-PIR + PirInterpreter collapse to
-jaxpr -> StableHLO -> XLA). This module keeps the reference's user-facing
-names so static-style programs port: InputSpec/data for input declaration,
-save/load_inference_model for deployment artifacts, and thin Program/
-Executor shims that delegate to jit tracing.
+Round 4: this is a REAL captured-program engine, not a façade. Under
+`program_guard`, `static.data` creates placeholders and every registry
+op touching one records a deferred node (shape-inferred via
+jax.eval_shape — the InferMeta analog); `Executor.run(prog, feed,
+fetch_list)` replays the node list as ONE jitted XLA program, and
+`optimizer.minimize(loss)` turns each run into a full training step
+(grads from jax.value_and_grad inside the same program, applied by the
+eager optimizer — clipping/schedules/multi-precision all work). See
+paddle_tpu/static/graph.py for the capture machinery and its documented
+limits. The save/load_inference_model path keeps the jit-traced
+callable flow (SURVEY.md §3.3 — PIR + interpreters collapse to
+jaxpr -> StableHLO -> XLA).
 """
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.tensor import Tensor
@@ -26,8 +32,15 @@ __all__ = [
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    """Declare a graph input (reference: python/paddle/static/input.py data).
-    Returns an InputSpec usable with to_static/jit.save."""
+    """Declare a graph input (reference: python/paddle/static/input.py
+    data). Under an active `program_guard`, returns a PLACEHOLDER
+    variable of the captured program (ops on it record instead of
+    executing — see paddle_tpu/static/graph.py); outside a guard,
+    returns an InputSpec usable with to_static/jit.save."""
+    from paddle_tpu.static import graph as _graph
+    prog = _graph.current_program()
+    if prog is not None:
+        return prog.add_data(name, list(shape), dtype)
     return InputSpec(shape=shape, dtype=dtype, name=name)
 
 
@@ -62,13 +75,22 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 
 
 class Program:
-    """Compat shim for paddle.static.Program (reference:
-    python/paddle/base/framework.py:5741). Holds a callable; tracing state
-    is jax's, not an op graph we mutate."""
+    """paddle.static.Program (reference: base/framework.py:5741).
+
+    Two modes:
+    - CAPTURED program: built imperatively under `program_guard` —
+      `static.data` placeholders + recorded deferred ops
+      (static/graph.py); `Executor.run(prog, feed, fetch_list)` replays
+      it as one jitted function, including a full training step when an
+      optimizer `minimize`d a loss in it.
+    - callable shim (`_layer` set): wraps a jitted callable, for the
+      save/load_inference_model path."""
 
     def __init__(self):
         self._layer = None
         self._feed_names = None
+        from paddle_tpu.static import graph as _graph
+        self._captured = _graph.CapturedProgram()
 
     def __call__(self, *args):
         if self._layer is None:
@@ -107,16 +129,23 @@ def default_startup_program():
 
 
 class program_guard:
-    """with program_guard(main, startup): no-op context — tracing replaces
-    graph construction; kept so reference code runs."""
+    """with program_guard(main, startup): activates CAPTURE onto
+    `main_program` — `static.data` creates placeholders and registry
+    ops on them record as deferred nodes (reference: framework.py
+    program_guard + Block.append_op)."""
 
     def __init__(self, main_program=None, startup_program=None):
         self._main = main_program
 
     def __enter__(self):
+        from paddle_tpu.static import graph as _graph
+        prog = self._main if self._main is not None else _main_program
+        _graph.push(prog._captured)
         return self._main
 
     def __exit__(self, *exc):
+        from paddle_tpu.static import graph as _graph
+        _graph.pop()
         return False
 
 
@@ -143,6 +172,10 @@ class Executor:
             return_numpy=True):
         prog = program or _main_program
         feed = feed or {}
+        cap = getattr(prog, "_captured", None)
+        if cap is not None and cap.nodes:
+            return self._run_captured(cap, feed, fetch_list or [],
+                                      return_numpy)
         if getattr(prog, "_layer", None) is None and not feed:
             # the universal port pattern `exe.run(startup_program)`:
             # parameter initialization already happened eagerly at layer
@@ -180,6 +213,65 @@ class Executor:
     def close(self):
         return None
 
+    # -- captured-program execution ---------------------------------------
+
+    def _run_captured(self, cap, feed, fetch_list, return_numpy):
+        """Replay the captured program as ONE jitted call (reference:
+        executor.py _ExecutorCache -> StandaloneExecutor). With minimize
+        directives, the same call also returns loss + grads and the
+        EAGER optimizer applies them (static training)."""
+        from paddle_tpu.static import graph as _graph
+
+        missing = [n for n in cap.datas if n not in feed]
+        if missing:
+            raise ValueError(f"Executor.run: program declares feeds "
+                             f"{sorted(cap.datas)}, missing {missing}")
+        feed_names = sorted(cap.datas)
+        feeds = [jnp.asarray(np.asarray(feed[n])) for n in feed_names]
+
+        fetch_ids = []
+        for f in fetch_list:
+            if not isinstance(f, _graph._StaticVar):
+                raise ValueError(
+                    "Executor.run(fetch_list=...) entries must be static "
+                    f"variables from this program, got {type(f).__name__}")
+            fetch_ids.append(id(f))
+
+        loss_id = None
+        optimizer = None
+        grad_positions = ()
+        if cap.minimizers:
+            if len(cap.minimizers) > 1:
+                raise ValueError("only one optimizer.minimize per "
+                                 "program is supported")
+            optimizer, loss_var = cap.minimizers[0]
+            loss_id = id(loss_var)
+            grad_positions = tuple(
+                i for i, t in enumerate(cap.params)
+                if not t.stop_gradient)
+
+        cache = cap._jit_cache
+        key = (cap.version, tuple(fetch_ids), loss_id,
+               tuple((tuple(a.shape), str(a.dtype)) for a in feeds))
+        jfn = cache.get(key)
+        if jfn is None:
+            fn = _graph._replay(cap, feed_names, fetch_ids, loss_id,
+                                grad_positions)
+            jfn = jax.jit(fn)
+            cache[key] = jfn
+        params = [t._value for t in cap.params]
+        fetched, loss, grads = jfn(params, feeds)
+
+        if optimizer is not None:
+            for pos, g in zip(grad_positions, grads):
+                p = cap.params[pos]
+                p._grad = Tensor(g, stop_gradient=True)
+            optimizer.step()
+            optimizer.clear_grad()
+        if return_numpy:
+            return [np.asarray(v) for v in fetched]
+        return [Tensor(v) for v in fetched]
+
 
 class _Scope:
     """Honest scope shim: there is no variable scope in the jit-first
@@ -215,18 +307,64 @@ def normalize_program(program, feed_vars, fetch_vars):
 
 class _StaticNN:
     """paddle.static.nn facade (reference: python/paddle/static/nn/
-    control_flow.py cond/while_loop) — the control-flow ops route to the
-    lax-backed implementations in paddle_tpu.jit.dy2static."""
+    control_flow.py cond/while_loop + common.py fc) — control-flow ops
+    route to the lax-backed implementations in paddle_tpu.jit.dy2static;
+    fc builds real (eagerly initialized) parameters whose matmul records
+    into the captured program."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+           activation=None, name=None):
+        """reference: python/paddle/static/nn/common.py fc — flatten
+        trailing dims, x @ W + b, optional activation by name. Creates a
+        FRESH parameter pair per call (reference semantics); the layer
+        is pinned to the active captured program so its parameters
+        survive across Executor.run calls."""
+        from paddle_tpu import nn as _nn
+        from paddle_tpu.nn import functional as _F
+        from paddle_tpu.static import graph as _graph
+        from paddle_tpu import tensor as _T
+
+        shape = list(x.shape)
+        in_dim = int(np.prod(shape[num_flatten_dims:]))
+        layer = _nn.Linear(in_dim, size, weight_attr=weight_attr,
+                           bias_attr=bias_attr)
+        prog = _graph.current_program()
+        if prog is not None:
+            prog._sublayers.append(layer)
+        h = x
+        if len(shape) > num_flatten_dims + 1:
+            h = _T.reshape(h, shape[:num_flatten_dims] + [in_dim])
+        out = layer(h)
+        if activation:
+            out = getattr(_F, activation)(out)
+        return out
 
     @staticmethod
     def cond(pred, true_fn=None, false_fn=None, name=None,
              return_names=None):
         from paddle_tpu.jit.dy2static import cond as _cond
+        from paddle_tpu.static.graph import _StaticVar
+        if isinstance(pred, _StaticVar):
+            raise NotImplementedError(
+                "static.nn.cond on a captured-program placeholder: "
+                "branch-subprogram recording is not supported — port "
+                "data-dependent control flow with paddle.jit.to_static "
+                "(lax.cond capture) instead of program_guard")
         return _cond(pred, true_fn, false_fn)
 
     @staticmethod
     def while_loop(cond, body, loop_vars, is_test=False, name=None):
         from paddle_tpu.jit.dy2static import while_loop as _wl
+        from paddle_tpu.static.graph import _StaticVar
+        if any(isinstance(v, _StaticVar) for v in
+               (loop_vars if isinstance(loop_vars, (list, tuple))
+                else [loop_vars])):
+            raise NotImplementedError(
+                "static.nn.while_loop on captured-program placeholders "
+                "is not supported — port data-dependent control flow "
+                "with paddle.jit.to_static (lax.while_loop capture) "
+                "instead of program_guard")
         return _wl(cond, body, loop_vars)
 
     @staticmethod

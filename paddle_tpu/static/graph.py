@@ -1,0 +1,277 @@
+"""Real static-graph capture (reference: python/paddle/base/framework.py
+Program/Block/Operator + executor.py Executor.run + backward.py
+append_backward).
+
+The reference builds an op graph imperatively under `program_guard` and
+interprets it; here the same imperative surface records a DEFERRED op
+list which `Executor.run` replays as ONE jitted function:
+
+- `paddle.static.data(...)` (under a program_guard) returns a
+  placeholder variable (`_StaticVar`) carrying only shape/dtype.
+- Any registry op that touches a placeholder is intercepted at the
+  dispatcher (core/dispatch.py STATIC_GRAPH_HOOK): output shapes come
+  from `jax.eval_shape` over the op's pure jax function — the
+  TPU-native analog of the reference's InferMeta pass — and the call is
+  recorded as a node instead of executing.
+- CONCRETE tensors flowing into recorded ops (layer parameters) are
+  captured BY OBJECT: replay reads their current `_value` each run, so
+  optimizer updates between runs are visible without retracing, and the
+  parameters are passed as jit arguments (not baked constants).
+- `Executor.run(program, feed=..., fetch_list=[...])` binds feeds to
+  placeholders, replays the node list under `jax.jit` (cached per feed
+  signature), and returns the fetched arrays — the
+  StandaloneExecutor/PirInterpreter collapse (SURVEY.md §3.3).
+- `optimizer.minimize(loss)` under capture registers a training
+  directive: `run()` then computes `jax.value_and_grad` of the loss
+  w.r.t. the program's trainable parameters inside the same jitted
+  program, assigns `.grad` on the parameter tensors and drives the
+  EAGER `optimizer.step()` — every optimizer feature (clipping, lr
+  schedules, multi-precision state) works unchanged in static mode.
+
+Limits (documented, checked): python control flow on placeholder VALUES
+can't capture, and the lax-backed static.nn.cond/while_loop raise a
+clear NotImplementedError under capture (branch-subprogram recording is
+a non-goal — port data-dependent control flow to `paddle.jit.to_static`
+instead); -1 ("batch") dims capture with a nominal size — ops whose
+PYTHON-side behavior branches on that size may mis-capture (the replay
+itself re-executes with the real arrays, so ordinary ops are
+shape-correct per feed).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dispatch as _dispatch
+from paddle_tpu.core import dtype as dtypes
+from paddle_tpu.core.tensor import Tensor
+
+_NOMINAL_DIM = 2      # stand-in for -1/None dims during shape inference
+
+
+class _StaticVar(Tensor):
+    """Placeholder/graph-output variable: a Tensor whose `_value` is a
+    jax.ShapeDtypeStruct (shape/dtype surface works; any attempt to
+    concretize raises with porting guidance)."""
+
+    def __init__(self, aval, program, name=None):
+        # bypass Tensor.__init__'s jnp.asarray
+        self._value = aval
+        self._stop_gradient = True
+        self._grad = None
+        self._grad_hooks = []
+        self._version = 0
+        self.persistable = False
+        self._uid = id(self)
+        self.name = name or f"static_var_{id(self):x}"
+        self._program = program
+
+    def numpy(self):
+        raise RuntimeError(
+            f"{self.name} is a static-graph variable (no value until "
+            "Executor.run); fetch it via run(fetch_list=[var])")
+
+    def __repr__(self):
+        return (f"StaticVar(name={self.name}, shape={list(self._value.shape)},"
+                f" dtype={self._value.dtype})")
+
+
+def _aval(shape, dtype):
+    shp = tuple(_NOMINAL_DIM if (d is None or d == -1) else int(d)
+                for d in shape)
+    return jax.ShapeDtypeStruct(shp, dtypes.convert_dtype(dtype)
+                                or jnp.float32)
+
+
+class CapturedProgram:
+    """The recorded op list + variable/parameter registries."""
+
+    def __init__(self):
+        self.nodes = []            # list of _Node
+        self.datas = {}            # feed name -> _StaticVar
+        self.params = []           # concrete Tensors captured by object
+        self._param_pos = {}       # id(tensor) -> index in params
+        self.minimizers = []       # (optimizer, loss_var)
+        self.version = 0           # bumped per node: invalidates jit cache
+        self._sublayers = []       # keep static.nn-created layers alive
+        self._jit_cache = {}       # (version, fetches, loss, shapes) -> jit
+
+    def add_data(self, name, shape, dtype):
+        if name in self.datas:
+            old = self.datas[name]
+            new_aval = _aval(shape, dtype)
+            if (old._value.shape, old._value.dtype) != \
+                    (new_aval.shape, new_aval.dtype):
+                raise ValueError(
+                    f"static.data({name!r}) redeclared with a different "
+                    f"signature: {old._value.shape}/{old._value.dtype} "
+                    f"vs {new_aval.shape}/{new_aval.dtype}")
+            return old
+        var = _StaticVar(_aval(shape, dtype), self, name=name)
+        self.datas[name] = var
+        return var
+
+    def param_index(self, t):
+        k = id(t)
+        if k not in self._param_pos:
+            self._param_pos[k] = len(self.params)
+            self.params.append(t)
+        return self._param_pos[k]
+
+
+class _Node:
+    __slots__ = ("op", "treedef", "slots", "out_treedef", "out_ids",
+                 "n_out")
+
+    def __init__(self, op, treedef, slots, out_treedef, out_ids, n_out):
+        self.op = op
+        self.treedef = treedef
+        self.slots = slots          # per input leaf: ("var", vid) |
+        #                             ("param", idx) | ("lit", value)
+        self.out_treedef = out_treedef
+        self.out_ids = out_ids      # var id per ARRAY output leaf (None
+        #                             for non-array leaves, which are
+        #                             stored literally)
+        self.n_out = n_out
+
+
+# -- capture context ---------------------------------------------------------
+
+_stack: list[CapturedProgram] = []
+
+
+def current_program():
+    return _stack[-1] if _stack else None
+
+
+def push(program: CapturedProgram):
+    _stack.append(program)
+    _dispatch.STATIC_GRAPH_HOOK = _record_hook
+
+
+def pop():
+    _stack.pop()
+    if not _stack:
+        _dispatch.STATIC_GRAPH_HOOK = None
+
+
+def _is_static(x):
+    return isinstance(x, _StaticVar)
+
+
+def _record_hook(op, args, kwargs):
+    """dispatch() calls this under capture; NotImplemented means 'no
+    placeholder involved — execute eagerly as usual'."""
+    prog = current_program()
+    leaves, treedef = jax.tree.flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    if not any(_is_static(l) for l in leaves):
+        return NotImplemented
+
+    slots = []
+    avals = []
+    for l in leaves:
+        if _is_static(l):
+            if l._program is not prog:
+                raise RuntimeError(
+                    f"static variable {l.name} belongs to a different "
+                    "Program than the active program_guard")
+            slots.append(("var", id(l)))
+            avals.append(l._value)
+        elif isinstance(l, Tensor):
+            from paddle_tpu.core.tensor import Parameter
+            if not l.stop_gradient and not isinstance(l, Parameter):
+                import warnings
+                warnings.warn(
+                    f"static capture: {l.name} is a concrete non-leaf "
+                    "tensor computed EAGERLY before entering the graph; "
+                    "it is captured by value-reference and gradients "
+                    "will NOT flow past it to its producers. Compute it "
+                    "from placeholders inside the program, or mark it "
+                    "stop_gradient if that is intended.")
+            slots.append(("param", prog.param_index(l)))
+            avals.append(jax.ShapeDtypeStruct(tuple(l._value.shape),
+                                              l._value.dtype))
+        else:
+            slots.append(("lit", l))
+            avals.append(None)
+
+    def shaped(*arrs):
+        lv = []
+        it = iter(arrs)
+        for s, l in zip(slots, leaves):
+            lv.append(next(it) if s[0] != "lit" else l)
+        a2, k2 = jax.tree.unflatten(treedef, lv)
+        return op.fn(*a2, **k2)
+
+    out_shape = jax.eval_shape(shaped,
+                               *[a for a in avals if a is not None])
+    out_flat, out_treedef = jax.tree.flatten(out_shape)
+    outs = []
+    out_ids = []
+    for o in out_flat:
+        if isinstance(o, jax.ShapeDtypeStruct):
+            v = _StaticVar(o, prog)
+            outs.append(v)
+            out_ids.append(id(v))
+        else:
+            outs.append(o)
+            out_ids.append(None)
+    prog.nodes.append(_Node(op, treedef, slots, out_treedef,
+                            out_ids, len(out_flat)))
+    prog.version += 1
+    result = jax.tree.unflatten(out_treedef, outs)
+    return result
+
+
+# -- replay ------------------------------------------------------------------
+
+def _replay(prog, feed_names, fetch_ids, loss_id, grad_param_positions):
+    """Build the pure replay function over (param_arrays, feed_arrays).
+    Returns fn(params_list, feeds_list) -> (fetch_vals, loss, grads)."""
+    nodes = list(prog.nodes)
+    data_ids = {name: id(prog.datas[name]) for name in feed_names}
+
+    def forward(param_arrays, feed_arrays):
+        env = {}
+        for name, arr in zip(feed_names, feed_arrays):
+            env[data_ids[name]] = arr
+        for node in nodes:
+            lv = []
+            for s in node.slots:
+                kind, v = s
+                if kind == "var":
+                    lv.append(env[v])
+                elif kind == "param":
+                    lv.append(param_arrays[v])
+                else:
+                    lv.append(v)
+            a2, k2 = jax.tree.unflatten(node.treedef, lv)
+            out = node.op.fn(*a2, **k2)
+            flat, _ = jax.tree.flatten(out)
+            for oid, val in zip(node.out_ids, flat):
+                if oid is not None:
+                    env[oid] = val
+        return env
+
+    if loss_id is None:
+        def fn(param_arrays, feed_arrays):
+            env = forward(param_arrays, feed_arrays)
+            return [env[i] for i in fetch_ids], None, None
+        return fn
+
+    def loss_of(grad_params, param_arrays, feed_arrays):
+        pa = list(param_arrays)
+        for pos, arr in zip(grad_param_positions, grad_params):
+            pa[pos] = arr
+        env = forward(pa, feed_arrays)
+        loss = env[loss_id]
+        return loss.astype(jnp.float32).reshape(()), env
+
+    def fn(param_arrays, feed_arrays):
+        gp = [param_arrays[p] for p in grad_param_positions]
+        (loss, env), grads = jax.value_and_grad(loss_of, has_aux=True)(
+            gp, param_arrays, feed_arrays)
+        return [env[i] for i in fetch_ids], loss, grads
+    return fn

@@ -35,9 +35,16 @@ def test_save_load_inference_model(tmp_path):
 
 
 def test_program_guard_compat():
-    main = static.default_main_program()
+    # r4: under a guard, data() is a real PLACEHOLDER of the captured
+    # program (ops on it record — test_static_capture.py); outside a
+    # guard it remains an InputSpec for to_static/jit.save
+    main = static.Program()
     with static.program_guard(main):
-        spec = static.data("x", [1, 4])
+        var = static.data("x", [1, 4])
+    from paddle_tpu.static.graph import _StaticVar
+    assert isinstance(var, _StaticVar)
+    assert "x" in main._captured.datas
+    spec = static.data("x", [1, 4])
     assert isinstance(spec, static.InputSpec)
 
 
