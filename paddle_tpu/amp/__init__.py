@@ -102,18 +102,54 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
 amp_guard = auto_cast
 
 
-def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
-             master_weight=None, save_dtype=None):
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
     """Cast model params to low precision for O2 (reference: auto_cast.py:529).
 
     With master_weight (default True at O2), optimizers keep fp32 master
     copies — our Optimizer handles that via its `multi_precision` support.
+    dtype defaults to "bfloat16" (TPU-native; the reference defaults
+    "float16" for CUDA — a DOCUMENTED deviation, see
+    tests/test_api_surface.py deviations). excluded_layers keeps the
+    listed sublayers (instances or Layer classes) in fp32; master_grad
+    is implied on TPU (the fused train step computes grads in the
+    params' compute precision with fp32 reductions) and accepted for
+    compat.
     """
     dt = dtypes.convert_dtype(dtype)
     model_list = models if isinstance(models, (list, tuple)) else [models]
+
+    def _excluded(layer):
+        if not excluded_layers:
+            return False
+        for e in excluded_layers:
+            if isinstance(e, type):
+                if isinstance(layer, e):
+                    return True
+            elif layer is e:
+                return True
+        return False
+
     if level == "O2":
         for m in model_list:
-            m.to(dtype=dt)
+            if excluded_layers:
+                # per-layer version of Layer.to(dtype=...): same
+                # float-only guard, buffers included, _dtype updated —
+                # only the excluded layers keep fp32
+                for sub in m.sublayers(include_self=True):
+                    if _excluded(sub):
+                        continue
+                    own = list(sub.__dict__.get("_parameters",
+                                                {}).values()) + \
+                        list(sub.__dict__.get("_buffers", {}).values())
+                    for t in own:
+                        if t is not None and \
+                                dtypes.is_floating_point(t.dtype):
+                            t._value = t._value.astype(dt)
+                    sub._dtype = dt
+            else:
+                m.to(dtype=dt)
         if optimizers is not None:
             opts = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
             for o in opts:

@@ -269,7 +269,7 @@ def upsample(x, size=None, scale_factor=None, mode="nearest",
 
 
 @defop("pixel_shuffle")
-def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
     if data_format not in ("NCHW", "NHWC"):
         raise ValueError(f"data_format must be NCHW or NHWC, got "
                          f"{data_format!r}")

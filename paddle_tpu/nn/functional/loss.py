@@ -286,7 +286,7 @@ def _cosine_embedding(input1, input2, label, margin=0.0, reduction="mean"):
     return _reduce(loss, reduction)
 
 
-def cosine_embedding_loss(input1, input2, label, margin=0.0,
+def cosine_embedding_loss(input1, input2, label, margin=0,
                           reduction="mean", name=None):
     return _cosine_embedding(input1, input2, label, margin=margin,
                              reduction=reduction)
@@ -305,7 +305,7 @@ def _triplet_margin(input, positive, negative, margin=1.0, p=2.0,
     return _reduce(jnp.clip(dp - dn + margin, 0, None), reduction)
 
 
-def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2,
                         epsilon=1e-6, swap=False, reduction="mean", name=None):
     return _triplet_margin(input, positive, negative, margin=margin, p=p,
                            epsilon=epsilon, swap=swap, reduction=reduction)

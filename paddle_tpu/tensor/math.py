@@ -131,7 +131,7 @@ def outer(x, y):
 
 
 @defop("kron")
-def kron(x, y):
+def kron(x, y, name=None):
     return jnp.kron(x, y)
 
 
@@ -467,7 +467,7 @@ def prod(x, axis=None, keepdim=False, dtype=None):
 
 
 @defop("logsumexp", amp_policy="black")
-def logsumexp(x, axis=None, keepdim=False):
+def logsumexp(x, axis=None, keepdim=False, name=None):
     return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
 
 
@@ -493,7 +493,7 @@ def nansum(x, axis=None, dtype=None, keepdim=False):
 
 
 @defop("nanmean", amp_policy="black")
-def nanmean(x, axis=None, keepdim=False):
+def nanmean(x, axis=None, keepdim=False, name=None):
     return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
 
 
@@ -548,7 +548,7 @@ def logcumsumexp(x, axis=None):
 
 # ---- misc --------------------------------------------------------------
 @defop("trace")
-def trace(x, offset=0, axis1=0, axis2=1):
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
     return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
 
 

@@ -112,14 +112,16 @@ def nonzero(x, as_tuple=False):
 
 
 @defop("searchsorted", differentiable=False)
-def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
     out = jnp.searchsorted(sorted_sequence, values,
                            side="right" if right else "left")
     return out.astype(jnp.int32 if out_int32 else jnp.int64)
 
 
 @defop("bucketize", differentiable=False)
-def bucketize(x, sorted_sequence, out_int32=False, right=False):
+def bucketize(x, sorted_sequence, out_int32=False, right=False,
+              name=None):
     out = jnp.searchsorted(sorted_sequence, x,
                            side="right" if right else "left")
     return out.astype(jnp.int32 if out_int32 else jnp.int64)

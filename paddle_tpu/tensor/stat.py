@@ -73,7 +73,8 @@ def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear"):
 
 
 @defop("histogram", differentiable=False)
-def histogram(input, bins=100, min=0, max=0, weight=None, density=False):
+def histogram(input, bins=100, min=0, max=0, weight=None,
+              density=False, name=None):
     if min == 0 and max == 0:
         lo, hi = jnp.min(input), jnp.max(input)
     else:

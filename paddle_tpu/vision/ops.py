@@ -86,7 +86,7 @@ def _bilinear_sample(feat, y, x):
 
 
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
-              sampling_ratio=-1, aligned=True):
+              sampling_ratio=-1, aligned=True, name=None):
     """RoIAlign (reference ops.py roi_align). x: (N,C,H,W); boxes: (R,4)
     x1,y1,x2,y2; boxes_num: rois per image."""
     xv = _val(x)

@@ -257,18 +257,48 @@ def _signature_drift(dotted, spec):
     return msgs
 
 
+# deliberate, documented deviations from the reference's defaults:
+# (api, param) -> (OUR pinned default repr, reason). The pinned value is
+# ASSERTED — a deviation drifting further still fails.
+_SIGNATURE_DEVIATIONS = {
+    ("paddle.amp.auto_cast", "dtype"): (
+        "'bfloat16'",
+        "TPU-native default (reference: float16 for CUDA); documented "
+        "in amp.decorate's docstring"),
+    ("paddle.amp.decorate", "dtype"): (
+        "'bfloat16'", "TPU-native default (reference: float16 for CUDA)"),
+}
+
+
 @pytest.mark.quick
 def test_signature_parity_with_reference():
-    """~120 highest-traffic APIs keep the reference's parameter names,
+    """~170 highest-traffic APIs keep the reference's parameter names,
     order, and literal defaults (recorded by
     tools/extract_ref_signatures.py from the reference SOURCE — rerun
     it if the reference moves). Name parity alone let defaults drift
-    silently (VERDICT r3)."""
+    silently (VERDICT r3). Intentional deviations must be whitelisted
+    in _SIGNATURE_DEVIATIONS with a reason."""
+    import inspect
     sigs = _load_ref_signatures()
-    assert len(sigs) >= 100
+    assert len(sigs) >= 150
     drift = {}
     for dotted, spec in sorted(sigs.items()):
-        msgs = _signature_drift(dotted, spec)
+        msgs = []
+        for m in _signature_drift(dotted, spec):
+            if m.startswith("param "):
+                param = m.split()[1].rstrip(":")
+                dev = _SIGNATURE_DEVIATIONS.get((dotted, param))
+                if dev is not None:
+                    # whitelisted, but the deviation must hold the
+                    # PINNED value — further drift still fails
+                    obj = _resolve(dotted)
+                    target = obj.__init__ if spec["kind"] == "cls" and \
+                        inspect.isclass(obj) else obj
+                    ours = inspect.signature(target).parameters[param]
+                    if repr(ours.default) == dev[0]:
+                        continue
+                    m += f" (whitelisted as {dev[0]}, drifted further)"
+            msgs.append(m)
         if msgs:
             drift[dotted] = msgs
     assert not drift, "\n".join(

@@ -197,7 +197,88 @@ APIS = [
      "distributed/auto_parallel/api.py", "shard_tensor"),
     ("paddle.distributed.reshard", "fn",
      "distributed/auto_parallel/api.py", "reshard"),
+    # round-4 extension: second tranche (comm, amp, jit, lr, layers)
+    ("paddle.distributed.all_to_all", "fn",
+     "distributed/communication/all_to_all.py", "alltoall"),
+    ("paddle.distributed.scatter", "fn",
+     "distributed/communication/scatter.py", "scatter"),
+    ("paddle.distributed.reduce", "fn",
+     "distributed/communication/reduce.py", "reduce"),
+    ("paddle.distributed.send", "fn",
+     "distributed/communication/send.py", "send"),
+    ("paddle.distributed.recv", "fn",
+     "distributed/communication/recv.py", "recv"),
+    ("paddle.distributed.barrier", "fn",
+     "distributed/communication/group.py", "barrier"),
+    ("paddle.amp.auto_cast", "fn", "amp/auto_cast.py", "auto_cast"),
+    ("paddle.amp.decorate", "fn", "amp/auto_cast.py", "decorate"),
+    ("paddle.amp.GradScaler", "cls", "amp/grad_scaler.py", "GradScaler"),
+    ("paddle.optimizer.lr.StepDecay", "cls", "optimizer/lr.py",
+     "StepDecay"),
+    ("paddle.optimizer.lr.MultiStepDecay", "cls", "optimizer/lr.py",
+     "MultiStepDecay"),
+    ("paddle.optimizer.lr.ExponentialDecay", "cls", "optimizer/lr.py",
+     "ExponentialDecay"),
+    ("paddle.optimizer.lr.NoamDecay", "cls", "optimizer/lr.py",
+     "NoamDecay"),
+    ("paddle.optimizer.lr.PolynomialDecay", "cls", "optimizer/lr.py",
+     "PolynomialDecay"),
+    ("paddle.optimizer.lr.ReduceOnPlateau", "cls", "optimizer/lr.py",
+     "ReduceOnPlateau"),
+    ("paddle.nn.ReLU", "cls", "nn/layer/activation.py", "ReLU"),
+    ("paddle.nn.Softmax", "cls", "nn/layer/activation.py", "Softmax"),
+    ("paddle.nn.GroupNorm", "cls", "nn/layer/norm.py", "GroupNorm"),
+    ("paddle.nn.InstanceNorm2D", "cls", "nn/layer/norm.py",
+     "InstanceNorm2D"),
+    ("paddle.nn.Conv1D", "cls", "nn/layer/conv.py", "Conv1D"),
+    ("paddle.nn.Conv3D", "cls", "nn/layer/conv.py", "Conv3D"),
+    ("paddle.nn.Conv2DTranspose", "cls", "nn/layer/conv.py",
+     "Conv2DTranspose"),
+    ("paddle.nn.AvgPool2D", "cls", "nn/layer/pooling.py", "AvgPool2D"),
+    ("paddle.nn.MaxPool2D", "cls", "nn/layer/pooling.py", "MaxPool2D"),
+    ("paddle.nn.Flatten", "cls", "nn/layer/common.py", "Flatten"),
+    ("paddle.nn.Upsample", "cls", "nn/layer/common.py", "Upsample"),
+    ("paddle.nn.GRUCell", "cls", "nn/layer/rnn.py", "GRUCell"),
+    ("paddle.nn.LSTMCell", "cls", "nn/layer/rnn.py", "LSTMCell"),
+    ("paddle.nn.functional.one_hot", "fn", "nn/functional/input.py",
+     "one_hot"),
+    ("paddle.nn.functional.label_smooth", "fn",
+     "nn/functional/common.py", "label_smooth"),
+    ("paddle.nn.functional.ctc_loss", "fn", "nn/functional/loss.py",
+     "ctc_loss"),
+    ("paddle.nn.functional.margin_ranking_loss", "fn",
+     "nn/functional/loss.py", "margin_ranking_loss"),
+    ("paddle.nn.functional.triplet_margin_loss", "fn",
+     "nn/functional/loss.py", "triplet_margin_loss"),
+    ("paddle.nn.functional.cosine_embedding_loss", "fn",
+     "nn/functional/loss.py", "cosine_embedding_loss"),
+    ("paddle.nn.functional.unfold", "fn", "nn/functional/common.py",
+     "unfold"),
+    ("paddle.nn.functional.grid_sample", "fn",
+     "nn/functional/vision.py", "grid_sample"),
+    ("paddle.nn.functional.pixel_shuffle", "fn",
+     "nn/functional/vision.py", "pixel_shuffle"),
+    ("paddle.scatter", "fn", "tensor/manipulation.py", "scatter"),
+    ("paddle.put_along_axis", "fn", "tensor/manipulation.py",
+     "put_along_axis"),
+    ("paddle.take_along_axis", "fn", "tensor/manipulation.py",
+     "take_along_axis"),
+    ("paddle.diag", "fn", "tensor/creation.py", "diag"),
+    ("paddle.kron", "fn", "tensor/math.py", "kron"),
+    ("paddle.trace", "fn", "tensor/math.py", "trace"),
+    ("paddle.logsumexp", "fn", "tensor/math.py", "logsumexp"),
+    ("paddle.nanmean", "fn", "tensor/math.py", "nanmean"),
+    ("paddle.quantile", "fn", "tensor/stat.py", "quantile"),
+    ("paddle.bucketize", "fn", "tensor/search.py", "bucketize"),
+    ("paddle.searchsorted", "fn", "tensor/search.py", "searchsorted"),
+    ("paddle.histogram", "fn", "tensor/linalg.py", "histogram"),
+    ("paddle.unique", "fn", "tensor/manipulation.py", "unique"),
+    ("paddle.repeat_interleave", "fn", "tensor/manipulation.py",
+     "repeat_interleave"),
+    ("paddle.vision.ops.roi_align", "fn", "vision/ops.py", "roi_align"),
+    ("paddle.vision.ops.nms", "fn", "vision/ops.py", "nms"),
 ]
+
 
 
 def _sig_of(node: ast.FunctionDef):
