@@ -16,7 +16,15 @@ import numpy as np
 from paddle_tpu.core.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "PlaceType"]
+           "PlaceType", "PagedKVEngine"]
+
+
+def __getattr__(name):
+    # lazy: the paged serving engine pulls in models/generation helpers
+    if name == "PagedKVEngine":
+        from paddle_tpu.inference.paged import PagedKVEngine
+        return PagedKVEngine
+    raise AttributeError(name)
 
 
 def _default_exec_cache():

@@ -308,13 +308,26 @@ class PredictorServer:
         _END = object()
         cancelled = threading.Event()
 
+        # a continuous-batching generator (PagedKVEngine) multiplexes
+        # concurrent requests itself — serializing its streams through
+        # the executable lock would defeat mid-decode admission
+        import contextlib
+        lock = (contextlib.nullcontext()
+                if getattr(g, "concurrent_safe", False) else self._lock)
+
         def produce():
             try:
-                with self._lock:
+                with lock:
                     step = 0
                     for tok in it:
                         if cancelled.is_set():
-                            break       # consumer gone: free the chip
+                            # consumer gone: free the chip. close() the
+                            # source too — an engine-backed stream
+                            # cancels its in-flight requests on close,
+                            # a plain generator just stops
+                            if hasattr(it, "close"):
+                                it.close()
+                            break
                         q.put({"step": step,
                                "tokens": np.asarray(tok).tolist()})
                         step += 1

@@ -146,6 +146,16 @@ class LlamaAttention(nn.Layer):
             q, k, None, position_ids=position_ids,
             rotary_emb_base=cfg.rope_theta)
         if cache is not None:
+            from paddle_tpu.inference.paged import (PagedState,
+                                                    paged_attention_update)
+            if isinstance(cache_index, PagedState):
+                # paged (block) KV serving: cache is a (k_pool, v_pool)
+                # page-pool pair, cache_index carries the block tables +
+                # per-slot lengths (inference/paged.py; reference serving
+                # path: block_multi_head_attention_kernel.cu)
+                out, new_cache = paged_attention_update(
+                    q, k, v, cache, cache_index)
+                return self.o_proj(out), new_cache
             # incremental decode (models/generation.py): write this
             # step's k/v into the fixed-size buffer at cache_index,
             # then attend over the whole buffer under a position mask
