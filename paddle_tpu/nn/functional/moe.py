@@ -134,6 +134,104 @@ def topk_gating_dropless(logits, k):
     return idx.astype(jnp.int32), gates, aux_loss
 
 
+def moe_dropless_mlp_ep_local(xt, router_w, wg, wu, wd, k, axis_name,
+                              token_axes=(), buffer_rows=None):
+    """Expert-parallel dropless dMoE — the per-shard body (runs inside
+    shard_map over the `axis_name` ('ep') mesh axis).
+
+    Reference mechanism: global_scatter / global_gather all-to-all
+    (python/paddle/distributed/utils/moe_utils.py:20,
+    incubate/distributed/models/moe/moe_layer.py:263). TPU-native
+    realisation: the ragged (token, expert) pair stream is packed into a
+    DENSE-PADDED per-destination buffer and exchanged with
+    `lax.all_to_all` (XLA's ragged-all-to-all is not available on every
+    backend; dense padding keeps shapes static, which XLA needs anyway).
+
+    xt: (T_local, D) this shard's tokens. router_w: (D, E) replicated.
+    wg/wu: (E_local, D, F), wd: (E_local, F, D) — expert dim already
+    sharded over `axis_name`. Tokens route by global expert id; shard p
+    owns experts [p*E_local, (p+1)*E_local).
+
+    buffer_rows: per-(src, dst) buffer capacity. None (default) =
+    T_local*k — the worst case, so NOTHING is ever dropped (true
+    dropless at P x memory in the a2a buffers). Smaller values trade
+    memory/compute for GShard-style overflow drops (overflowing pairs
+    contribute zero, gates NOT renormalized — monitor aux_loss).
+
+    Returns (out (T_local, D), aux_loss scalar pmean'd over
+    token_axes + (axis_name,))."""
+    t_l, d = xt.shape
+    e_l = wg.shape[0]
+    p = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    e = e_l * p
+    n = t_l * k
+    cbuf = n if buffer_rows is None else int(buffer_rows)
+
+    logits = jnp.einsum("td,de->te", xt.astype(jnp.float32),
+                        router_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, idx = jax.lax.top_k(probs, k)                     # (T_l, k)
+    gates = gates / jnp.maximum(
+        jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+    # aux loss over GLOBAL token means (reference computes it on the
+    # full batch; local means pmean'd are exact for equal shard sizes)
+    red = tuple(token_axes) + (axis_name,)
+    me_mean = jax.lax.pmean(jnp.mean(probs, axis=0), red)
+    ce_mean = jax.lax.pmean(
+        jnp.mean(jnp.sum(_one_hot(idx, e), axis=1), axis=0) / k, red)
+    aux = e * jnp.sum(me_mean * ce_mean)
+
+    # ---- pack: sort pairs by global expert id (= by destination, and
+    # by expert within destination) into (P, cbuf, D) send buffers ----
+    flat_e = idx.reshape(-1).astype(jnp.int32)               # (N,)
+    order = jnp.argsort(flat_e, stable=True)
+    sorted_e = jnp.take(flat_e, order)
+    sorted_x = jnp.take(xt, order // k, axis=0)              # (N, D)
+    dest = sorted_e // e_l                                   # (N,)
+    send_counts = jnp.bincount(dest, length=p)
+    start = jnp.cumsum(send_counts) - send_counts            # excl. cumsum
+    slot = jnp.arange(n, dtype=jnp.int32) - start[dest].astype(jnp.int32)
+    send_x = jnp.zeros((p, cbuf, d), xt.dtype).at[dest, slot].set(
+        sorted_x, mode="drop")
+    send_e = jnp.full((p, cbuf), e, jnp.int32).at[dest, slot].set(
+        sorted_e, mode="drop")                               # e = sentinel
+
+    # ---- all-to-all: row block i of the buffer goes to shard i ------
+    a2a = lambda a: jax.lax.all_to_all(                      # noqa: E731
+        a, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    recv_x = a2a(send_x).reshape(p * cbuf, d)
+    recv_e = a2a(send_e).reshape(p * cbuf)
+
+    # ---- local ragged grouped matmul over MY experts ----------------
+    # received ids are all in [me*e_l, (me+1)*e_l) or the sentinel;
+    # sort groups them, the sentinel rows form a trailing junk group
+    # consumed by a zero dummy expert so group sizes sum to the row
+    # count (lax.ragged_dot contract)
+    order2 = jnp.argsort(recv_e, stable=True)
+    rx = jnp.take(recv_x, order2, axis=0)
+    le = jnp.take(recv_e, order2) - me * e_l
+    le = jnp.where(le < e_l, le, e_l).astype(jnp.int32)
+    group_sizes = jnp.bincount(le, length=e_l + 1).astype(jnp.int32)
+    pad = lambda w: jnp.concatenate(                         # noqa: E731
+        [w, jnp.zeros((1,) + w.shape[1:], w.dtype)], axis=0)
+    a = jax.lax.ragged_dot(rx, pad(wg).astype(rx.dtype), group_sizes)
+    b_up = jax.lax.ragged_dot(rx, pad(wu).astype(rx.dtype), group_sizes)
+    act = jax.nn.silu(a.astype(jnp.float32)).astype(rx.dtype) * b_up
+    o = jax.lax.ragged_dot(act, pad(wd).astype(rx.dtype), group_sizes)
+    inv2 = jnp.argsort(order2, stable=True)
+    out_recv = jnp.take(o, inv2, axis=0).reshape(p, cbuf, d)
+
+    # ---- return trip + unpack ---------------------------------------
+    back = a2a(out_recv)                                     # (P,cbuf,D)
+    val_sorted = back[dest, jnp.clip(slot, 0, cbuf - 1)]
+    val_sorted = jnp.where((slot < cbuf)[:, None], val_sorted, 0.0)
+    inv = jnp.argsort(order, stable=True)
+    out_rows = jnp.take(val_sorted, inv, axis=0).reshape(t_l, k, d)
+    out = jnp.sum(gates[..., None].astype(xt.dtype) * out_rows, axis=1)
+    return out, aux
+
+
 def moe_dropless_mlp(xt, wg, wu, wd, idx, gates):
     """Sort-based grouped-matmul expert MLP with ZERO token drops
     (MegaBlocks-style; TPU-native via jax.lax.ragged_dot — the

@@ -9,6 +9,8 @@ from the shardings.
 """
 from __future__ import annotations
 
+from contextlib import contextmanager
+
 import jax
 import jax.numpy as jnp
 
@@ -37,6 +39,81 @@ def _moe_mlp_dropless(x, router_w, wg, wu, wd, k):
     idx, gates, aux = FM.topk_gating_dropless(logits, k)
     out = FM.moe_dropless_mlp(xt, wg, wu, wd, idx, gates)
     return out.reshape(*lead, d), aux
+
+
+# ---------------------------------------------------------------------------
+# dropless x expert parallelism (VERDICT r4 item 2)
+# ---------------------------------------------------------------------------
+
+_ep_state = {"mesh": None, "axis": "ep", "buffer_rows": None}
+
+
+@contextmanager
+def expert_parallel_guard(mesh, axis="ep", buffer_rows=None):
+    """Inside this context, MoEMLP(dropless=True) routes through the
+    expert-parallel dropless path: experts shard over the mesh's `axis`,
+    tokens exchange via dense-padded all-to-all (reference mechanism:
+    global_scatter/global_gather, distributed/utils/moe_utils.py:20).
+    Mirrors context_parallel_guard's pattern — active at trace time."""
+    prev = dict(_ep_state)
+    _ep_state.update(mesh=mesh, axis=axis, buffer_rows=buffer_rows)
+    try:
+        yield
+    finally:
+        _ep_state.update(prev)
+
+
+def current_expert_parallel():
+    return dict(_ep_state) if _ep_state["mesh"] is not None else None
+
+
+def moe_dropless_ep(x, router_w, wg, wu, wd, k, mesh, axis="ep",
+                    buffer_rows=None):
+    """Global-array wrapper: x (B, S, D) with batch over dp/fsdp and seq
+    over `axis` (or (T, D) with tokens over `axis`); expert weights
+    (E, ...) sharded over `axis` on dim 0. shard_map is full-manual over
+    the mentioned axes only; mp (if any) stays replicated inside (each
+    mp member computes identically)."""
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed.mesh import ProcessMesh
+    if isinstance(mesh, ProcessMesh):
+        mesh = mesh.jax_mesh
+    names = mesh.axis_names
+    if x.ndim == 3:
+        batch = tuple(a for a in ("dp", "fsdp") if a in names)
+        x_spec = P(batch if batch else None, axis, None)
+    elif x.ndim == 2:
+        batch = ()
+        x_spec = P(axis, None)
+    else:
+        raise ValueError(f"moe_dropless_ep expects (B, S, D) or (T, D), "
+                         f"got shape {x.shape}")
+    w_spec = P(axis)
+
+    def local(xl, rw, wgl, wul, wdl):
+        d = xl.shape[-1]
+        out, aux = FM.moe_dropless_mlp_ep_local(
+            xl.reshape(-1, d), rw, wgl, wul, wdl, k, axis,
+            token_axes=batch, buffer_rows=buffer_rows)
+        return out.reshape(xl.shape), aux
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(x_spec, P(), w_spec, w_spec, w_spec),
+        out_specs=(x_spec, P()), check_vma=False)
+    return fn(x, router_w, wg, wu, wd)
+
+
+@defop("moe_mlp_dropless_ep", amp_policy="white",
+       spmd_note="experts shard over 'ep' (dense-padded all-to-all "
+                 "dispatch inside shard_map); token dims over dp + ep")
+def _moe_mlp_dropless_ep(x, router_w, wg, wu, wd, k, mesh, axis,
+                         buffer_rows):
+    """Dropless dMoE x expert parallelism (VERDICT r4 item 2; reference
+    global_scatter/global_gather, distributed/utils/moe_utils.py:20).
+    Returns (out, aux_loss)."""
+    return moe_dropless_ep(x, router_w, wg, wu, wd, k, mesh, axis=axis,
+                           buffer_rows=buffer_rows)
 
 
 @defop("moe_mlp", amp_policy="white",
@@ -92,6 +169,15 @@ class MoEMLP(Layer):
 
     def forward(self, x):
         if self.dropless:
+            ep = current_expert_parallel()
+            if ep is not None:
+                out, aux = _moe_mlp_dropless_ep(
+                    x, self.router_weight, self.experts_gate_weight,
+                    self.experts_up_weight, self.experts_down_weight,
+                    k=self.top_k, mesh=ep["mesh"], axis=ep["axis"],
+                    buffer_rows=ep["buffer_rows"])
+                self.aux_loss = aux
+                return out
             out, aux = _moe_mlp_dropless(x, self.router_weight,
                                          self.experts_gate_weight,
                                          self.experts_up_weight,
