@@ -93,10 +93,12 @@ def cond(pred, true_fn, false_fn, *operands):
                         else a, out)
 
 
-def while_loop(cond_fn, body_fn, loop_vars):
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
     """lax.while_loop over Tensor loop vars (reference:
-    python/paddle/static/nn/control_flow.py while_loop). Carried
-    shapes/dtypes must be loop-invariant."""
+    python/paddle/static/nn/control_flow.py while_loop — param names
+    match; is_test is a static-graph hint with no meaning here).
+    Carried shapes/dtypes must be loop-invariant."""
+    cond_fn, body_fn = cond, body
     template = list(loop_vars)
     init = [_unwrap(v) for v in template]
 
@@ -427,9 +429,16 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
                  _name_tuple(params)]))
             return [tfn, ffn, ret]
 
-        names = sorted(_assigned(node.body) | _assigned(node.orelse))
         stores_t = _assigned(node.body)
         stores_f = _assigned(node.orelse)
+        bound_before = getattr(node, "_pt_bound_before", None)
+        if bound_before is None:        # un-annotated (nested def): old rule
+            names = sorted(stores_t | stores_f)
+        else:
+            # branch-local temps (assigned in ONE branch, no prior
+            # binding) stay inside the extracted branch function — they
+            # are not cond outputs and never read at the call site
+            names = sorted(_if_outs(node, bound_before))
         # parameters: names the branches read before writing, plus out
         # names one branch passes through unchanged (it reads them for
         # the return tuple) — evaluated at the CALL SITE so python
@@ -473,9 +482,15 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
                 "paddle_tpu.jit.while_loop")
         self.counter += 1
         n = self.counter
-        # carry = names the body rebinds; everything else the test/body
-        # reads stays a closure read (globals, helper fns, constants)
-        names = sorted(_assigned(node.body))
+        # carry = names the body rebinds AND that live across iterations
+        # (bound before / read-first / test-read); write-first temps stay
+        # body-local. Everything else the test/body reads stays a
+        # closure read (globals, helper fns, constants)
+        bound_before = getattr(node, "_pt_bound_before", None)
+        if bound_before is None:
+            names = sorted(_assigned(node.body))
+        else:
+            names = sorted(_while_carries(node, bound_before))
         if not names:
             raise Dy2StaticTransformError(
                 f"line {node.lineno}: `while` body assigns no locals — "
@@ -556,29 +571,86 @@ def _check_while_carries(fdef):
         bound.add(a.vararg.arg)
     if a.kwarg:
         bound.add(a.kwarg.arg)
+    _annotate_outside_loads(fdef)
     _check_block(fdef.body, bound)
+
+
+def _test_reads(test):
+    return {n.id for n in ast.walk(test)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _annotate_outside_loads(fdef):
+    """For each If/While in fdef, record the names LOADED anywhere in
+    the function OUTSIDE that statement's own subtree — the liveness
+    signal that distinguishes a private temp from a value the rest of
+    the function consumes."""
+    all_loads = [n for n in ast.walk(fdef)
+                 if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)]
+    for s in ast.walk(fdef):
+        if isinstance(s, (ast.If, ast.While)):
+            inside = set(map(id, ast.walk(s)))
+            s._pt_outside_loads = frozenset(
+                n.id for n in all_loads if id(n) not in inside)
+
+
+def _while_carries(node, bound_before):
+    """lax.while_loop carry = body-assigned names that are live OUTSIDE
+    one iteration: bound before the loop, read-before-written in the
+    body, read by the test, or read anywhere after/outside the loop.
+    Pure write-first temps (incl. `_` unpacking slots) stay body-local —
+    they caused spurious unbound-carry rejections (NOTES_r4
+    'environment facts', now deleted)."""
+    assigned = _assigned(node.body)
+    outside = getattr(node, "_pt_outside_loads", frozenset())
+    return assigned & (set(bound_before) | _read_first(node.body)
+                       | _test_reads(node.test) | set(outside))
+
+
+def _if_outs(node, bound_before):
+    """Names the if-transform's call-site assign binds: assigned in BOTH
+    branches (cond can produce them whichever side runs), or assigned in
+    one branch with a pre-existing binding to pass through. One-branch
+    temps with no prior binding are private to the branch body —
+    _check_block rejects them at transform time (-> eager fallback) if
+    the rest of the function reads them, since lax.cond cannot produce
+    a value with no else-side initial."""
+    st, sf = _assigned(node.body), _assigned(node.orelse)
+    return {x for x in st | sf
+            if x in bound_before or (x in st and x in sf)}
 
 
 def _check_block(stmts, bound):
     for s in stmts:
         if isinstance(s, ast.While):
-            carries = _assigned(s.body)
+            s._pt_bound_before = frozenset(bound)
+            carries = _while_carries(s, bound)
             missing = sorted(carries - bound)
             if missing:
                 raise Dy2StaticTransformError(
-                    f"line {s.lineno}: `while` body assigns "
-                    f"{', '.join(missing)} which is not bound before the "
-                    "loop; lax.while_loop carries need an initial value — "
+                    f"line {s.lineno}: `while` carries "
+                    f"{', '.join(missing)} read before any binding; "
+                    "lax.while_loop carries need an initial value — "
                     "initialize it before the loop")
-            _check_block(s.body, set(bound) | carries)
-            bound |= carries          # call-site assign rebinds all carries
+            _check_block(s.body, set(bound) | _assigned(s.body))
+            bound |= carries          # call-site assign rebinds carries
         elif isinstance(s, ast.If):
+            s._pt_bound_before = frozenset(bound)
+            st_a, sf_a = _assigned(s.body), _assigned(s.orelse)
+            dropped = {x for x in (st_a ^ sf_a) if x not in bound}
+            leaked = sorted(dropped
+                            & getattr(s, "_pt_outside_loads", frozenset()))
+            if leaked:
+                raise Dy2StaticTransformError(
+                    f"line {s.lineno}: {', '.join(leaked)} is assigned in "
+                    "only one `if` branch but read after it; lax.cond "
+                    "needs a value from both sides — bind it before the "
+                    "`if` or in both branches")
             bt, bf = set(bound), set(bound)
             _check_block(s.body, bt)
             _check_block(s.orelse, bf)
-            # the if-transform's call-site assign binds every name either
-            # branch stores (visit_If `names`)
-            bound |= _assigned(s.body) | _assigned(s.orelse)
+            # the if-transform's call-site assign binds visit_If `names`
+            bound |= _if_outs(s, bound)
         elif isinstance(s, ast.For):
             for n in ast.walk(s.target):
                 if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):

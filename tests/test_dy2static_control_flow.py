@@ -220,12 +220,11 @@ def test_closure_values_not_shared_across_instances():
     np.testing.assert_allclose(f3(xn).numpy(), np.full((3,), -4.0))
 
 
-def test_while_body_temp_local_falls_back():
-    """A while body that first-binds a temp local cannot be a
-    lax.while_loop carry (no initial value); the transform must reject it
-    at transform time so the python-bool loop still runs via the
-    untransformed fallback (advisor r2 medium: this used to be an
-    UnboundLocalError with no eager escape)."""
+def test_while_body_temp_local_transforms():
+    """A while body that first-binds a temp local is no longer rejected
+    (r5: write-first temps are body-local, not carries — they used to
+    force an eager fallback; advisor r2 medium was the UnboundLocalError
+    this check replaced, VERDICT r4 item 9 the rejection it relaxes)."""
 
     def f(x):
         n = 0
@@ -235,16 +234,16 @@ def test_while_body_temp_local_falls_back():
             n = n + 1
         return x
 
-    from paddle_tpu.jit.dy2static import (ast_transform,
-                                          Dy2StaticTransformError)
-    with pytest.raises(Dy2StaticTransformError, match="initialize"):
-        ast_transform(f)
+    from paddle_tpu.jit.dy2static import ast_transform
+    assert ast_transform(f) is not None     # transforms cleanly now
 
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")
-        sf = paddle.jit.to_static(f)
-        x = paddle.to_tensor(np.ones((2,), "float32"))
-        np.testing.assert_allclose(sf(x).numpy(), np.full((2,), 27.0))
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = sf(x)
+    assert not any("could not be traced" in str(wi.message) for wi in w)
+    np.testing.assert_allclose(out.numpy(), np.full((2,), 27.0))
 
 
 def test_while_carry_bound_by_if_before_loop():
@@ -395,3 +394,101 @@ def test_compilation_cache_stats_and_layer_explain():
                for s in after["per_function"])
     with pytest.raises(ValueError, match="to_static"):
         paddle_tpu.jit.explain(lambda x: x)
+
+
+# -- round 5: liveness-aware carries (VERDICT r4 item 9) ---------------------
+# Branch-local temps and `_` unpacking used to fall back to eager (the
+# NOTES_r4 'environment facts' rejections); they now capture into ONE
+# lax.cond/while_loop program.
+
+def _assert_one_program(fn, *args):
+    """Run a to_static fn and assert NO eager-fallback warning fired."""
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = fn(*args)
+    assert not any("could not be traced" in str(wi.message) for wi in w), \
+        [str(wi.message) for wi in w]
+    return out
+
+
+def test_branch_local_temp_captures():
+    @paddle.jit.to_static
+    def f(x):
+        if T.sum(x) > 0:
+            tmp = x * 2.0            # branch-local, no prior binding
+            out = tmp + 1.0
+        else:
+            out = x - 1.0
+        return out
+
+    x = paddle.to_tensor(np.ones((4,), "float32"))
+    np.testing.assert_allclose(_assert_one_program(f, x).numpy(),
+                               np.full(4, 3.0, "float32"))
+    xn = paddle.to_tensor(np.full((4,), -1.0, "float32"))
+    np.testing.assert_allclose(f(xn).numpy(), np.full(4, -2.0, "float32"))
+
+
+def test_underscore_unpacking_in_branch_captures():
+    @paddle.jit.to_static
+    def f(x):
+        if T.sum(x) > 0:
+            a, _ = T.topk(x, 2)      # `_` is a branch-local junk slot
+            r = a * 2.0
+        else:
+            r = x[:2]
+        return r
+
+    x = paddle.to_tensor(np.array([1., 3., 2., 4.], "float32"))
+    np.testing.assert_allclose(_assert_one_program(f, x).numpy(),
+                               [8.0, 6.0])
+    xn = paddle.to_tensor(np.array([-1., -3., -2., -4.], "float32"))
+    np.testing.assert_allclose(f(xn).numpy(), [-1.0, -3.0])
+
+
+def test_while_write_first_temp_captures():
+    @paddle.jit.to_static
+    def f(x):
+        i = paddle.to_tensor(np.int32(0))
+        while i < 3:
+            t = x * 2.0              # write-first temp: NOT a carry
+            x = t + 1.0
+            i = i + 1
+        return x
+
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    np.testing.assert_allclose(_assert_one_program(f, x).numpy(),
+                               np.full(2, 15.0, "float32"))
+
+
+def test_passthrough_still_carried():
+    """A name bound BEFORE the if and assigned in one branch must still
+    pass through the untaken branch (regression guard for the filter)."""
+    @paddle.jit.to_static
+    def f(x):
+        y = x + 1.0
+        if T.sum(x) > 0:
+            y = y * 10.0
+        return y
+
+    x = paddle.to_tensor(np.ones((2,), "float32"))
+    np.testing.assert_allclose(_assert_one_program(f, x).numpy(),
+                               np.full(2, 20.0, "float32"))
+    xn = paddle.to_tensor(np.full((2,), -1.0, "float32"))
+    np.testing.assert_allclose(f(xn).numpy(), np.zeros(2, "float32"))
+
+
+def test_unbound_carry_still_rejected():
+    """Reading a while-carry that was never initialized is a real error
+    and must still route to the clear transform-time message."""
+    from paddle_tpu.jit.dy2static import (ast_transform,
+                                          Dy2StaticTransformError)
+
+    def f(x):
+        i = paddle.to_tensor(np.int32(0))
+        while i < 3:
+            acc = acc + x            # read-first, never bound: broken
+            i = i + 1
+        return acc
+
+    with pytest.raises(Dy2StaticTransformError, match="initial value"):
+        ast_transform(f)
