@@ -39,11 +39,17 @@ Two schedules:
 
 Stage partitioning is generic (SegmentLayers equivalent): the trainer
 auto-detects the model's longest LayerList of structurally-identical
-layers (Llama's model.layers, BERT's encoder stack, any custom stack) and
-requires layers % stages == 0 (the stacked (S, k, ...) layout needs equal
-stages; the reference's uneven SegmentLayers split does not map to a
-vmap-able stack). Embedding and loss head are overridable callables for
-non-Llama models.
+layers (Llama's model.layers, BERT's encoder stack, any custom stack).
+Since r5, layers need NOT divide evenly: uneven splits — uniform-uneven
+(layers % stages != 0) or explicit SegmentLayers-style
+`stage_boundaries` (reference pp_layers.py:92) — pad the short stages
+with masked identity slots (zero params, zero grads; compute waste
+bounded by (S*K - L)/L). Tied embeddings (SharedLayerDesc,
+pp_layers.py:76) come free: the head falls back to the embedding
+weight's transpose and autodiff sums both stages' contributions into
+the one shared weight. VPP interleave still needs
+layers % (pp * interleave) == 0. Embedding and loss head are
+overridable callables for non-Llama models.
 """
 from __future__ import annotations
 
@@ -62,6 +68,9 @@ from paddle_tpu.parallel.plan import ShardingPlan
 from paddle_tpu.parallel.trainer import Trainer, TrainStepConfig, _cast_tree
 
 STACK_PREFIX = "pipeline.layers::"
+# pseudo-entry riding in the staged param dict for uneven splits: the
+# (S, k) bool validity mask (padded slots run as identity)
+_VALID_KEY = "__stage_valid__"
 
 
 def detect_layer_stack(model):
@@ -112,6 +121,12 @@ class PipelineConfig(TrainStepConfig):
     num_microbatches: int = 4
     schedule: str = "1f1b"            # "1f1b" | "gpipe"
     interleave: int = 1               # virtual stages per device (VPP)
+    # SegmentLayers-style custom stage split (reference pp_layers.py:92):
+    # len S+1 ascending boundaries over the layer stack, e.g. (0, 3, 6,
+    # 8, 10) puts layers [0,3) on stage 0 etc. None = uniform — which,
+    # since r5, also handles layers % stages != 0 by padding the short
+    # stages (masked identity slots, see _stage_view).
+    stage_boundaries: tuple | None = None
 
     def __post_init__(self):
         if self.schedule not in ("1f1b", "gpipe"):
@@ -123,6 +138,17 @@ class PipelineConfig(TrainStepConfig):
         if self.interleave > 1 and self.schedule != "1f1b":
             raise ValueError(
                 "interleave (virtual pipeline) requires schedule='1f1b'")
+        if self.stage_boundaries is not None:
+            b = tuple(self.stage_boundaries)
+            if len(b) < 2 or b[0] != 0 or any(
+                    y <= x for x, y in zip(b, b[1:])):
+                raise ValueError(
+                    "stage_boundaries must be ascending and start at 0, "
+                    f"got {b}")
+            if self.interleave > 1:
+                raise ValueError(
+                    "stage_boundaries does not compose with interleave "
+                    "(VPP chunks need a uniform stack split)")
 
 
 def build_interleaved_schedule(S: int, v: int, M: int):
@@ -252,15 +278,68 @@ class PipelineTrainer(Trainer):
         super().__init__(model, optimizer, mesh=mesh,
                          plan=PipelinePlan(plan), config=cfg)
 
+    # -- stage partitioning (SegmentLayers equivalent) ---------------------
+    def _compute_slots(self):
+        """Map stage slots to stack layers. Returns (slot_layers: tuple
+        of layer-index-or-negative per padded row, K: slots per stage,
+        valid: (S, K) bool np mask, even: bool fast-path flag)."""
+        L = self._num_layers
+        S = self.mesh.shape["pp"] if self.mesh is not None \
+            and "pp" in self.mesh.shape else 1
+        b = self.config.stage_boundaries
+        if b is not None:
+            b = tuple(b)
+            if len(b) != S + 1 or b[-1] != L:
+                raise ValueError(
+                    f"stage_boundaries needs len pp+1={S + 1} ending at "
+                    f"{L} layers, got {b}")
+        elif L % S == 0:
+            k = L // S
+            return tuple(range(L)), k, np.ones((S, k), bool), True
+        else:
+            # uniform-uneven: first (L % S) stages get one extra layer
+            q, r = divmod(L, S)
+            b, acc = [0], 0
+            for i in range(S):
+                acc += q + (1 if i < r else 0)
+                b.append(acc)
+        sizes = [b[i + 1] - b[i] for i in range(S)]
+        k = max(sizes)
+        slot_layers, valid = [], np.zeros((S, k), bool)
+        for i in range(S):
+            for j in range(k):
+                if j < sizes[i]:
+                    slot_layers.append(b[i] + j)
+                    valid[i, j] = True
+                else:
+                    slot_layers.append(-1)       # padded identity slot
+        return tuple(slot_layers), k, valid, False
+
     # -- stacked state ----------------------------------------------------
     def _init_state(self):
+        (self._slot_layers, self._stage_k, self._valid_mask,
+         self._even_stages) = self._compute_slots()
+        if not self._even_stages and self.config.interleave > 1:
+            raise ValueError(
+                "interleave (VPP) needs layers % (pp * interleave) == 0; "
+                "uneven/custom stage splits are plain-1F1B only")
         tensors = state_tensors(self.model)
         stacked = {}
         consumed = set()
         for local, by_idx in self._layer_groups.items():
             names = [by_idx[i] for i in range(self._num_layers)]
-            stacked[STACK_PREFIX + local] = jnp.stack(
-                [tensors[n]._value for n in names])
+            rows = [tensors[n]._value for n in names]
+            if self._even_stages:
+                stacked[STACK_PREFIX + local] = jnp.stack(rows)
+            else:
+                # padded storage, ordered by stage assignment: row s*K+j
+                # holds its stage's j-th layer or zeros (masked slots
+                # contribute zero grads; see _stage_fwd). Keeps the
+                # stacked dim divisible by pp so P('pp') shards evenly.
+                zero = jnp.zeros_like(rows[0])
+                stacked[STACK_PREFIX + local] = jnp.stack(
+                    [rows[li] if li >= 0 else zero
+                     for li in self._slot_layers])
             consumed.update(names)
         self.params = {n: t._value for n, t in tensors.items()
                        if n not in consumed}
@@ -279,9 +358,14 @@ class PipelineTrainer(Trainer):
         for n, arr in self.params.items():
             if n.startswith(STACK_PREFIX):
                 local = n[len(STACK_PREFIX):]
-                for i, name in sorted(
-                        self._layer_groups[local].items()):
-                    tensors[name]._value = arr[i]
+                by_idx = self._layer_groups[local]
+                if self._even_stages:
+                    for i, name in sorted(by_idx.items()):
+                        tensors[name]._value = arr[i]
+                else:
+                    for row, li in enumerate(self._slot_layers):
+                        if li >= 0:
+                            tensors[by_idx[li]]._value = arr[row]
             else:
                 tensors[n]._value = arr
         return self.model
@@ -368,13 +452,21 @@ class PipelineTrainer(Trainer):
         return other, stacked
 
     def _stage_view(self, stacked, n_pp):
-        """(L, ...) -> (S, k, ...), stage dim sharded over 'pp'."""
-        k = self._num_layers // n_pp
-        return {
+        """(S*k, ...) -> (S, k, ...), stage dim sharded over 'pp'. For
+        uneven splits the dict also carries the (S, k) validity mask as
+        a pseudo-entry consumed by _stage_fwd (padded slots are identity
+        passthroughs)."""
+        k = self._stage_k
+        out = {
             n: jax.lax.with_sharding_constraint(
                 v.reshape((n_pp, k) + v.shape[1:]),
                 NamedSharding(self.mesh, P("pp")))
             for n, v in stacked.items()}
+        if not self._even_stages:
+            out[_VALID_KEY] = jax.lax.with_sharding_constraint(
+                jnp.asarray(self._valid_mask),
+                NamedSharding(self.mesh, P("pp")))
+        return out
 
     def _layer_apply(self, layer_params: dict, h):
         """One stack layer, functional (template-layer swap)."""
@@ -383,9 +475,20 @@ class PipelineTrainer(Trainer):
         return out._value if isinstance(out, Tensor) else out
 
     def _stage_fwd(self, stage_params, h):
+        stage_params = dict(stage_params)
+        valid = stage_params.pop(_VALID_KEY, None)
+
         def body(hh, one_layer):
-            return self._layer_apply(one_layer, hh), None
-        out, _ = jax.lax.scan(body, h, stage_params)
+            if valid is None:
+                return self._layer_apply(one_layer, hh), None
+            ok, lp = one_layer
+            y = self._layer_apply(lp, hh)
+            # padded slot: identity. where()'s zero cotangent keeps the
+            # dummy zero params' grads exactly zero.
+            return jnp.where(ok, y, hh), None
+
+        xs = stage_params if valid is None else (valid, stage_params)
+        out, _ = jax.lax.scan(body, h, xs)
         return out
 
     def _module_by_name(self, name):
@@ -477,8 +580,6 @@ class PipelineTrainer(Trainer):
         mesh = self.mesh
         n_pp = mesh.shape["pp"]
         M = self.config.num_microbatches
-        L = self._num_layers
-        assert L % n_pp == 0, f"{L} layers not divisible by pp={n_pp}"
 
         input_ids = batch["input_ids"]
         B = input_ids.shape[0]
@@ -563,8 +664,6 @@ class PipelineTrainer(Trainer):
         mesh = self.mesh
         S = mesh.shape["pp"]
         M = self.config.num_microbatches
-        L = self._num_layers
-        assert L % S == 0, f"{L} layers not divisible by pp={S}"
         assert M >= 1
 
         ctx = self._pipeline_common(params_c, batch)
@@ -610,7 +709,16 @@ class PipelineTrainer(Trainer):
             h_saved = jax.vmap(get_one)(saved, b_mb)
 
             def one_bwd(stage_params, h_in, g):
-                _, vjp = jax.vjp(self._stage_fwd, stage_params, h_in)
+                sp = dict(stage_params)
+                ok = sp.pop(_VALID_KEY, None)
+
+                def fwd(p, h):
+                    if ok is not None:
+                        p = dict(p)
+                        p[_VALID_KEY] = ok      # closed over: no bool grad
+                    return self._stage_fwd(p, h)
+
+                _, vjp = jax.vjp(fwd, sp, h_in)
                 gp, gx = vjp(g)
                 return gp, gx
 
@@ -648,7 +756,7 @@ class PipelineTrainer(Trainer):
 
         # accumulators
         grads_st0 = {n: shard(jnp.zeros(v.shape, jnp.float32), P("pp"))
-                     for n, v in staged.items()}
+                     for n, v in staged.items() if n != _VALID_KEY}
         grads_other0 = jax.tree.map(
             lambda v: jnp.zeros(v.shape, jnp.float32), other)
         g_emb0 = jnp.zeros((M, mb, S_len, D), emb.dtype)
@@ -690,7 +798,7 @@ class PipelineTrainer(Trainer):
 
         grads = self._pipeline_epilogue(
             ctx, batch, grads_st, grads_other, g_emb,
-            unstage=lambda v: v.reshape((L,) + v.shape[2:]))
+            unstage=lambda v: v.reshape((-1,) + v.shape[2:]))
         return loss, grads
 
     # -- interleaved 1F1B (virtual pipeline, VPP) --------------------------

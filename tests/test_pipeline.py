@@ -308,3 +308,139 @@ def test_vpp_config_validation():
     ids = np.zeros((8, 16), np.int32)
     with pytest.raises(ValueError, match="divisible"):
         tr.step({"input_ids": ids, "labels": ids})
+
+
+# -- round 5: uneven stages + tied embeddings (VERDICT r4 item 7) -----------
+
+def _unpipelined_losses(cfg, batch, steps=3, lr=1e-3):
+    """Plain data-parallel oracle: same model, same batch, no pipeline."""
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.parallel import Trainer, TrainStepConfig
+    paddle_tpu.seed(7)
+    model = LlamaForCausalLM(cfg)
+    o = opt.AdamW(learning_rate=lr, parameters=model.parameters())
+    mesh = init_mesh({"dp": 8})
+    tr = Trainer(model, o, mesh=mesh,
+                 plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
+                 config=TrainStepConfig(compute_dtype=None))
+    return [float(tr.step(batch)) for _ in range(steps)]
+
+
+def test_uneven_stages_tied_embeddings_parity():
+    """The VERDICT-r4 bar: layers=10, stages=4 (uniform-uneven 3/3/2/2),
+    tie_word_embeddings=True — training-loss parity with the unpipelined
+    run over 3 steps."""
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.models.llama import tiny_llama_config
+
+    rng = np.random.RandomState(0)
+    cfg = tiny_llama_config(num_hidden_layers=10,
+                            tie_word_embeddings=True)
+    ids = rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    want = _unpipelined_losses(cfg, batch)
+
+    mesh = init_mesh({"pp": 4, "dp": 2})
+    paddle_tpu.seed(7)
+    model = LlamaForCausalLM(cfg)
+    o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    tr = PipelineTrainer(
+        model, o, mesh=mesh,
+        plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
+        config=PipelineConfig(compute_dtype=None, num_microbatches=4))
+    got = [float(tr.step(batch)) for _ in range(3)]
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+    # tied weight really is shared: no lm_head param exists
+    assert not any("lm_head" in n for n in tr.params)
+    # padded slots stayed zero through the optimizer steps
+    k = tr._stage_k
+    assert not tr._even_stages and k == 3
+    import jax.numpy as jnp
+    for n, v in tr.params.items():
+        if n.startswith("pipeline.layers::"):
+            rows = v.reshape((4, k) + v.shape[1:])
+            dead = rows[~tr._valid_mask]
+            assert float(jnp.abs(dead).max()) == 0.0, n
+
+
+def test_custom_stage_boundaries_match_uniform():
+    """Explicit SegmentLayers-style boundaries give the same training
+    curve as the uniform split of the same assignment."""
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.models.llama import tiny_llama_config
+
+    rng = np.random.RandomState(0)
+    cfg = tiny_llama_config(num_hidden_layers=6)
+    ids = rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    mesh = init_mesh({"pp": 2, "dp": 4})
+
+    losses = {}
+    for name, kw in (("uniform", {}),
+                     ("custom", {"stage_boundaries": (0, 3, 6)})):
+        paddle_tpu.seed(3)
+        model = LlamaForCausalLM(cfg)
+        o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        tr = PipelineTrainer(
+            model, o, mesh=mesh,
+            plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
+            config=PipelineConfig(compute_dtype=None,
+                                  num_microbatches=4, **kw))
+        losses[name] = [float(tr.step(batch)) for _ in range(2)]
+    np.testing.assert_allclose(losses["custom"], losses["uniform"],
+                               rtol=1e-5)
+
+
+def test_uneven_custom_boundaries_train():
+    """Heavily skewed custom split (4/1 over 5 layers) trains to parity
+    with the unpipelined oracle; gpipe and 1f1b agree."""
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.models.llama import tiny_llama_config
+
+    rng = np.random.RandomState(1)
+    cfg = tiny_llama_config(num_hidden_layers=5)
+    ids = rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    want = _unpipelined_losses(cfg, batch, steps=2)
+
+    mesh = init_mesh({"pp": 2, "dp": 4})
+    for sched in ("gpipe", "1f1b"):
+        paddle_tpu.seed(7)
+        model = LlamaForCausalLM(cfg)
+        o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        tr = PipelineTrainer(
+            model, o, mesh=mesh,
+            plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
+            config=PipelineConfig(compute_dtype=None, num_microbatches=4,
+                                  schedule=sched,
+                                  stage_boundaries=(0, 4, 5)))
+        got = [float(tr.step(batch)) for _ in range(2)]
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4,
+                                   err_msg=sched)
+
+
+def test_stage_boundaries_validation():
+    with pytest.raises(ValueError, match="ascending"):
+        PipelineConfig(stage_boundaries=(0, 3, 3))
+    with pytest.raises(ValueError, match="interleave"):
+        PipelineConfig(stage_boundaries=(0, 2, 4), interleave=2)
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.models.llama import tiny_llama_config
+    mesh = init_mesh({"pp": 2, "dp": 4})
+    paddle_tpu.seed(0)
+    model = LlamaForCausalLM(tiny_llama_config(num_hidden_layers=4))
+    o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    with pytest.raises(ValueError, match="len pp"):
+        PipelineTrainer(model, o, mesh=mesh,
+                        plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
+                        config=PipelineConfig(
+                            stage_boundaries=(0, 1, 2, 4)))
+    # uneven uniform + VPP is rejected with a clear message
+    paddle_tpu.seed(0)
+    m5 = LlamaForCausalLM(tiny_llama_config(num_hidden_layers=5))
+    o5 = opt.AdamW(learning_rate=1e-3, parameters=m5.parameters())
+    with pytest.raises(ValueError, match="VPP"):
+        PipelineTrainer(m5, o5, mesh=mesh,
+                        plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
+                        config=PipelineConfig(num_microbatches=4,
+                                              interleave=2))
