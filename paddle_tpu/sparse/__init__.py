@@ -280,15 +280,16 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
 
 # -- unary (zero-preserving ops apply to values only) -----------------------
 
-def _unary(name, fn):
-    def op(x, name_arg=None):
+def _unary(op_name, fn):
+    def op(x, name=None):
         if not _is_sparse(x):
-            raise TypeError(f"paddle.sparse.{name} expects a sparse tensor")
-        vals = _vop(name, fn, x._values)
+            raise TypeError(
+                f"paddle.sparse.{op_name} expects a sparse tensor")
+        vals = _vop(op_name, fn, x._values)
         if x.is_sparse_coo():
             return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)
         return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 

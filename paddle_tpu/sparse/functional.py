@@ -6,7 +6,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ['relu', 'relu6', 'leaky_relu', 'softmax', 'attention']
+__all__ = ['relu', 'relu6', 'leaky_relu', 'softmax', 'attention',
+           'conv3d', 'subm_conv3d']
 
 
 def relu(x, name=None):
@@ -80,3 +81,153 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
         scores = coo if scores.is_sparse_coo() else coo.to_sparse_csr()
     probs = softmax(scores)
     return matmul(probs, value)
+
+
+# ---------------------------------------------------------------------------
+# sparse 3-D convolution (gather-scatter-matmul)
+# ---------------------------------------------------------------------------
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+def _conv3d_gather_scatter(x, weight, bias, stride, padding, dilation,
+                           groups, subm, name):
+    """True sparse conv (reference kernels:
+    paddle/phi/kernels/sparse/gpu/conv_kernel.cu — the rulebook
+    gather/GEMM/scatter pipeline). TPU-native formulation: per kernel
+    offset, contributing nnz entries are GATHERED from the value rows,
+    hit with that offset's (C, M) weight slice (MXU matmuls), and
+    SEGMENT-SUMMED into the output rows. The index rulebook is computed
+    host-side once per call (eager contract, like the reference's
+    rulebook build); the value path is pure jax, so forward AND backward
+    (grads to values, weight, bias) ride the tape.
+
+    x: SparseCooTensor, shape (N, D, H, W, C), sparse_dim 4, values
+    (nnz, C). weight: (KD, KH, KW, C, M) — the reference's DHWCM filter
+    layout. subm=True keeps the input's sparsity pattern (submanifold,
+    https://arxiv.org/abs/1706.01307)."""
+    import numpy as np
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.sparse import SparseCooTensor, _vop
+
+    if groups != 1:
+        raise NotImplementedError("sparse conv3d: groups > 1")
+    if x.sparse_dim != 4 or x.ndim != 5:
+        raise ValueError(
+            "sparse conv3d expects a (N, D, H, W, C) SparseCooTensor "
+            f"with sparse_dim 4, got shape {x.shape} sparse_dim "
+            f"{x.sparse_dim}")
+    wt = weight._value if isinstance(weight, Tensor) else jnp.asarray(weight)
+    kd, kh, kw, c, m = wt.shape
+    st, pd, dl = _triple(stride), _triple(padding), _triple(dilation)
+    if subm and st != (1, 1, 1):
+        raise ValueError("subm_conv3d requires stride 1 (the output "
+                         "pattern equals the input pattern)")
+    n_, d_, h_, w_, _ = x.shape
+    if subm:
+        od, oh, ow = d_, h_, w_
+    else:
+        od = (d_ + 2 * pd[0] - dl[0] * (kd - 1) - 1) // st[0] + 1
+        oh = (h_ + 2 * pd[1] - dl[1] * (kh - 1) - 1) // st[1] + 1
+        ow = (w_ + 2 * pd[2] - dl[2] * (kw - 1) - 1) // st[2] + 1
+
+    idx = np.asarray(x._indices)                 # (4, nnz) host
+    nnz = idx.shape[1]
+    # -- host rulebook: (kernel offset, src row, out coordinate) --------
+    src_rows, off_ids, out_coords = [], [], []
+    for ko in range(kd * kh * kw):
+        k0, rem = divmod(ko, kh * kw)
+        k1, k2 = divmod(rem, kw)
+        num = (idx[1] + pd[0] - k0 * dl[0],
+               idx[2] + pd[1] - k1 * dl[1],
+               idx[3] + pd[2] - k2 * dl[2])
+        ok = np.ones(nnz, bool)
+        outs = []
+        for a in range(3):
+            q, r = np.divmod(num[a], st[a])
+            ok &= (r == 0) & (q >= 0) & (q < (od, oh, ow)[a])
+            outs.append(q)
+        rows = np.nonzero(ok)[0]
+        if rows.size == 0:
+            continue
+        src_rows.append(rows)
+        off_ids.append(np.full(rows.size, ko, np.int64))
+        out_coords.append(np.stack(
+            [idx[0][rows], outs[0][rows], outs[1][rows], outs[2][rows]]))
+    out_shape = (n_, od, oh, ow, m)
+    if not src_rows:
+        return SparseCooTensor(np.zeros((4, 0), np.int32),
+                               jnp.zeros((0, m), wt.dtype), out_shape)
+    src_rows = np.concatenate(src_rows)
+    off_ids = np.concatenate(off_ids)
+    out_coords = np.concatenate(out_coords, axis=1)   # (4, R)
+
+    if subm:
+        # output pattern == input pattern: map contributions onto the
+        # existing rows, drop any that fall outside the pattern
+        lin_in = np.ravel_multi_index(tuple(idx), (n_, d_, h_, w_))
+        lin_out = np.ravel_multi_index(tuple(out_coords),
+                                       (n_, d_, h_, w_))
+        order = np.argsort(lin_in)
+        pos = np.searchsorted(lin_in[order], lin_out)
+        pos = np.clip(pos, 0, lin_in.size - 1)
+        hit = lin_in[order][pos] == lin_out
+        seg = order[pos][hit]
+        src_rows, off_ids = src_rows[hit], off_ids[hit]
+        out_idx = idx
+        n_out = nnz
+    else:
+        lin_out = np.ravel_multi_index(tuple(out_coords),
+                                       (n_, od, oh, ow))
+        uniq, seg = np.unique(lin_out, return_inverse=True)
+        out_idx = np.stack(np.unravel_index(uniq, (n_, od, oh, ow)))
+        n_out = uniq.size
+
+    srt = np.argsort(off_ids, kind="stable")     # group rows by offset
+    src_rows, seg, off_srt = src_rows[srt], seg[srt], off_ids[srt]
+    counts = np.bincount(off_srt, minlength=kd * kh * kw)
+    bounds = np.concatenate([[0], np.cumsum(counts)])
+
+    def f(vals, w, *maybe_bias):
+        w2 = w.reshape(kd * kh * kw, c, m)
+        parts = []
+        for ko in range(kd * kh * kw):
+            lo, hi = int(bounds[ko]), int(bounds[ko + 1])
+            if hi == lo:
+                continue
+            parts.append(jnp.take(vals, src_rows[lo:hi], axis=0)
+                         @ w2[ko].astype(vals.dtype))
+        contrib = jnp.concatenate(parts, axis=0)
+        out = jax.ops.segment_sum(contrib, jnp.asarray(seg),
+                                  num_segments=n_out)
+        if maybe_bias:
+            out = out + maybe_bias[0].astype(out.dtype)
+        return out
+
+    from paddle_tpu.sparse import _vop as vop
+    args = (x._values, weight) if bias is None else (x._values, weight,
+                                                     bias)
+    out_vals = vop("subm_conv3d" if subm else "conv3d", f, *args)
+    return SparseCooTensor(out_idx.astype(np.int32), out_vals, out_shape,
+                           coalesced=True)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC", name=None):
+    """Sparse conv3d (reference: sparse/nn/functional/conv.py:199)."""
+    if data_format != "NDHWC":
+        raise ValueError("sparse conv3d supports NDHWC only (reference "
+                         "contract)")
+    return _conv3d_gather_scatter(x, weight, bias, stride, padding,
+                                  dilation, groups, False, name)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold sparse conv3d (reference:
+    sparse/nn/functional/conv.py:305; output keeps the input pattern)."""
+    if data_format != "NDHWC":
+        raise ValueError("sparse subm_conv3d supports NDHWC only")
+    return _conv3d_gather_scatter(x, weight, bias, stride, padding,
+                                  dilation, groups, True, name)

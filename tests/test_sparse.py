@@ -278,3 +278,113 @@ def test_subm_conv_preserves_pattern():
     for (i, j) in pts:
         inmask[0, i, j] = True
     assert not np.any(mask & ~inmask)
+
+
+# -- round 5: true sparse conv3d (gather-scatter-matmul, VERDICT r4 #10) ----
+import paddle_tpu
+
+def _dense_conv3d_oracle(xd, w, bias, stride, padding, dilation):
+    """torch-free NDHWC conv oracle via jax.lax on the densified input."""
+    import jax
+    import jax.numpy as jnp
+    out = jax.lax.conv_general_dilated(
+        jnp.asarray(xd), jnp.asarray(w),
+        window_strides=(stride,) * 3, padding=[(padding, padding)] * 3,
+        rhs_dilation=(dilation,) * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    if bias is not None:
+        out = out + jnp.asarray(bias)
+    return np.asarray(out)
+
+
+def _rand_sparse_input(rng, n=2, d=5, h=5, w=5, c=3, nnz=14):
+    coords = set()
+    while len(coords) < nnz:
+        coords.add((rng.randint(n), rng.randint(d), rng.randint(h),
+                    rng.randint(w)))
+    idx = np.array(sorted(coords)).T.astype(np.int32)      # (4, nnz)
+    vals = rng.randn(idx.shape[1], c).astype(np.float32)
+    import paddle_tpu.sparse as sp
+    x = sp.sparse_coo_tensor(idx, vals, (n, d, h, w, c))
+    dense = np.zeros((n, d, h, w, c), np.float32)
+    dense[tuple(idx)] = vals
+    return x, dense
+
+
+@pytest.mark.parametrize("stride,padding,dilation", [(1, 1, 1), (2, 0, 1),
+                                                     (1, 2, 2)])
+def test_sparse_conv3d_matches_dense(stride, padding, dilation):
+    from paddle_tpu.sparse.nn import functional as SF
+    rng = np.random.RandomState(0)
+    x, dense = _rand_sparse_input(rng)
+    w = (rng.randn(3, 3, 3, 3, 4) * 0.3).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    out = SF.conv3d(x, paddle_tpu.to_tensor(w), paddle_tpu.to_tensor(b),
+                    stride=stride, padding=padding, dilation=dilation)
+    want = _dense_conv3d_oracle(dense, w, b, stride, padding, dilation)
+    assert tuple(out.shape) == want.shape
+    got = np.asarray(out.to_dense().numpy())
+    # sparse conv only materializes rows touched by >= 1 input site;
+    # everywhere else the oracle has pure-bias values. Compare on the
+    # materialized pattern, and check the rest is exactly bias.
+    mask = np.zeros(want.shape[:4], bool)
+    mask[tuple(np.asarray(out._indices))] = True
+    np.testing.assert_allclose(got[mask], want[mask], rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(
+        want[~mask], np.broadcast_to(b, want[~mask].shape), rtol=1e-6)
+
+
+def test_sparse_subm_conv3d_pattern_and_values():
+    from paddle_tpu.sparse.nn import functional as SF
+    rng = np.random.RandomState(3)
+    x, dense = _rand_sparse_input(rng)
+    w = (rng.randn(3, 3, 3, 3, 3) * 0.3).astype(np.float32)
+    out = SF.subm_conv3d(x, paddle_tpu.to_tensor(w), stride=1, padding=1)
+    # pattern preserved exactly
+    np.testing.assert_array_equal(np.asarray(out._indices),
+                                  np.asarray(x._indices))
+    # values = dense conv sampled AT the input pattern
+    want = _dense_conv3d_oracle(dense, w, None, 1, 1, 1)
+    got = np.asarray(out.values().numpy())
+    sel = want[tuple(np.asarray(x._indices))]
+    np.testing.assert_allclose(got, sel, rtol=2e-5, atol=2e-5)
+
+
+def test_sparse_conv3d_gradients():
+    """Backward through values, weight and bias (the tape rides _vop)."""
+    from paddle_tpu.sparse.nn import functional as SF
+    import paddle_tpu.tensor as T
+    rng = np.random.RandomState(5)
+    x, _ = _rand_sparse_input(rng, nnz=8)
+    x.stop_gradient = False
+    w = paddle_tpu.to_tensor((rng.randn(3, 3, 3, 3, 2) * 0.3)
+                             .astype(np.float32))
+    w.stop_gradient = False
+    b = paddle_tpu.to_tensor(rng.randn(2).astype(np.float32))
+    b.stop_gradient = False
+    out = SF.conv3d(x, w, b, stride=1, padding=1)
+    loss = T.sum(out.values() * out.values())
+    loss.backward()
+    for t in (x.values(), w, b):
+        g = t.grad
+        assert g is not None and np.isfinite(g.numpy()).all()
+    assert np.abs(w.grad.numpy()).max() > 0
+    # bias grad = 2 * sum over rows of out values
+    np.testing.assert_allclose(
+        b.grad.numpy(), 2 * out.values().numpy().sum(0), rtol=1e-4)
+
+
+def test_sparse_conv3d_layers_use_sparse_path():
+    """sparse.nn.Conv3D / SubmConv3D produce the same result as the
+    functional gather-scatter path."""
+    from paddle_tpu.sparse import nn as snn
+    from paddle_tpu.sparse.nn import functional as SF
+    rng = np.random.RandomState(7)
+    x, dense = _rand_sparse_input(rng)
+    conv = snn.Conv3D(3, 4, 3, padding=1)
+    out = conv(x)
+    assert out.shape[-1] == 4
+    sub = snn.SubmConv3D(3, 4, 3, padding=1)
+    out2 = sub(x)
+    np.testing.assert_array_equal(np.asarray(out2._indices),
+                                  np.asarray(x._indices))
