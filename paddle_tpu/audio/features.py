@@ -13,8 +13,8 @@ __all__ = ['Spectrogram', 'MelSpectrogram', 'LogMelSpectrogram', 'MFCC']
 
 
 class Spectrogram(nn.Layer):
-    def __init__(self, n_fft=512, hop_length=None, win_length=None,
-                 window="hann", power=2.0, center=True, pad_mode="reflect",
+    def __init__(self, n_fft=512, hop_length=512, win_length=None,
+                 window="hann", power=1.0, center=True, pad_mode="reflect",
                  dtype="float32"):
         super().__init__()
         self.n_fft = n_fft
@@ -36,7 +36,7 @@ class Spectrogram(nn.Layer):
 
 
 class MelSpectrogram(nn.Layer):
-    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+    def __init__(self, sr=22050, n_fft=2048, hop_length=512,
                  win_length=None, window="hann", power=2.0, center=True,
                  pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
                  htk=False, norm="slaney", dtype="float32"):

@@ -184,9 +184,15 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
-         create_graph=False, allow_unused=False):
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
     """paddle.grad equivalent (reference: backward.cc:440 egr::Grad /
     GeneralGrad subgraph). Returns grads of `inputs` without touching .grad.
+
+    only_inputs=False (compute .grad for the whole subgraph too) is
+    deprecated in the reference and unsupported here; no_grad_vars
+    excludes tensors from the sweep (their grads become None/zero
+    contributions, matching reference semantics).
 
     create_graph=True records the backward sweep ITSELF on the tape
     (each node's vjp is re-linearized via its replay_fn and recorded as
@@ -195,6 +201,20 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     the same way, recursively."""
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if not only_inputs:
+        raise NotImplementedError(
+            "only_inputs=False is deprecated in the reference (always "
+            "behaves as True there too) and is not supported")
+    blocked = None
+    if no_grad_vars:
+        ng = (no_grad_vars if isinstance(no_grad_vars, (list, tuple))
+              else [no_grad_vars])
+        blocked = {t._uid for t in ng}
+        if blocked & {t._uid for t in inputs}:
+            raise ValueError("no_grad_vars overlaps inputs")
+        if create_graph:
+            raise NotImplementedError(
+                "no_grad_vars with create_graph=True is not supported")
     tape = current_tape()
     wanted = {t._uid for t in inputs}
     if create_graph:
@@ -215,7 +235,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     grads = _seed_grads(outputs, grad_outputs)
     visited = set()
     result_map = _sweep(tape, grads, accumulate_leaves=False, wanted=wanted,
-                        visited=visited)
+                        visited=visited, blocked=blocked)
     if not retain_graph:
         tape.remove(visited)
     out = []
@@ -352,7 +372,8 @@ def _seed_grads(tensors, grad_tensors):
     return grads
 
 
-def _sweep(tape, grads, accumulate_leaves, wanted=None, visited=None):
+def _sweep(tape, grads, accumulate_leaves, wanted=None, visited=None,
+           blocked=None):
     """Reverse sweep over tape nodes, returning the final grad map.
     Grad bookkeeping is keyed by tensor uid (monotonic, never reused — id()
     can be recycled by the allocator mid-training-loop)."""
@@ -378,6 +399,8 @@ def _sweep(tape, grads, accumulate_leaves, wanted=None, visited=None):
         for t, g in zip(node.inputs, in_grads):
             if g is None:
                 continue
+            if blocked is not None and t._uid in blocked:
+                continue           # grad(no_grad_vars=...): cut the edge
             for hook in getattr(t, "_grad_hooks", ()):
                 res = hook(_wrap(g))
                 if res is not None:

@@ -97,11 +97,12 @@ def reshard(dist_tensor, mesh: ProcessMesh | None = None,
     return out
 
 
-def shard_layer(layer, mesh: ProcessMesh, shard_fn=None,
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
                 input_fn=None, output_fn=None):
-    """Shard every parameter/buffer of `layer` on `mesh`
+    """Shard every parameter/buffer of `layer` on `process_mesh`
     (reference: auto_parallel/api.py:403). `shard_fn(name, layer, mesh)`
     decides per-sublayer placement; default replicates everything."""
+    mesh = process_mesh
     _register(mesh)
 
     def _default_shard_fn(name, sublayer, m):
@@ -168,9 +169,10 @@ def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
     return Tensor(arr, stop_gradient=True)
 
 
-def unshard_dtensor(t: Tensor) -> Tensor:
+def unshard_dtensor(dist_tensor: Tensor) -> Tensor:
     """Gather a distributed tensor to a fully-replicated dense tensor
     (reference: api.py unshard_dtensor)."""
+    t = dist_tensor
     sh = getattr(t._value, "sharding", None)
     if isinstance(sh, NamedSharding):
         arr = jax.device_put(t._value, NamedSharding(sh.mesh,

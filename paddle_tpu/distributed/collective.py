@@ -89,8 +89,8 @@ def new_group(ranks=None, backend=None, timeout=None) -> Group:
     return g
 
 
-def get_group(gid: int) -> Group:
-    return _group_registry[gid]
+def get_group(id: int = 0) -> Group:
+    return _group_registry[id]
 
 
 def _as_rank_major(t, g: Group):

@@ -96,8 +96,8 @@ def load_state_dict(state_dict, path, **kw):
     return ckpt.load_state_dict(state_dict, path, **kw)
 
 
-def shard_dataloader(dataloader, meshes=None, shard_dims=None,
-                     input_keys=None):
+def shard_dataloader(dataloader, meshes=None, input_keys=None,
+                     shard_dims=None, is_dataset_splitted=False):
     """(reference: auto_parallel/api.py shard_dataloader). Single-
     controller jax feeds per-host batches already; the loader is returned
     unchanged with a marker for Trainer's batch sharding."""
@@ -105,7 +105,8 @@ def shard_dataloader(dataloader, meshes=None, shard_dims=None,
     return dataloader
 
 
-def shard_op(op_fn, mesh, in_placements=None, out_placements=None):
+def shard_op(op, process_mesh=None, in_shard_specs=None,
+             out_shard_specs=None, **kwargs):
     """(reference: auto_parallel/api.py shard_op) — constrain an op's
     outputs onto the mesh."""
     import jax
@@ -113,12 +114,13 @@ def shard_op(op_fn, mesh, in_placements=None, out_placements=None):
     from paddle_tpu.distributed.placement import placements_to_spec
     from paddle_tpu.core.tensor import Tensor
 
-    def wrapped(*args, **kwargs):
-        out = op_fn(*args, **kwargs)
-        if out_placements is not None and isinstance(out, Tensor):
-            spec = placements_to_spec(out_placements, mesh, ndim=out.ndim)
+    def wrapped(*args, **kw):
+        out = op(*args, **kw)
+        if out_shard_specs is not None and isinstance(out, Tensor):
+            spec = placements_to_spec(out_shard_specs, process_mesh,
+                                      ndim=out.ndim)
             out._value = jax.lax.with_sharding_constraint(
-                out._value, NamedSharding(mesh.jax_mesh, spec))
+                out._value, NamedSharding(process_mesh.jax_mesh, spec))
         return out
     return wrapped
 

@@ -30,7 +30,8 @@ _fleet_state = {"inited": False, "strategy": None, "hcg": None,
                 "mesh": None}
 
 
-def init(role_maker=None, is_collective=True, strategy=None, log_level=None):
+def init(role_maker=None, is_collective=True, strategy=None,
+         log_level="INFO"):
     """(reference: fleet/fleet.py:167) Build the hybrid topology. The
     hybrid_configs degrees multiply up to the device count; remaining
     devices go to the data-parallel axis."""

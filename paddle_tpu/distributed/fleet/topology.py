@@ -14,9 +14,9 @@ __all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
 
 
 class CommunicateTopology:
-    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
-                                           "sep", "model"),
-                 dims=(1, 1, 1, 1, 1)):
+    def __init__(self, hybrid_group_names=["data", "pipe", "sharding",
+                                           "sep", "model"],
+                 dims=[1, 1, 1, 1, 1]):
         self._parallel_names = list(hybrid_group_names)
         self._dims = list(dims)
         self.coordinate = collections.namedtuple("Coordinate",

@@ -72,20 +72,48 @@ def main():
     del total
 
     # -- global-mesh trainer ----------------------------------------------
-    dp, mp = (int(x) for x in
-              os.environ.get("SMOKE_MESH", "2,4").split(","))
-    assert dp * mp == n_global
+    # SMOKE_MESH: legacy "2,4" = {"dp": 2, "mp": 4}, or the ordered
+    # "name:size,name:size" form. ORDER sets the device layout: the
+    # first axis varies slowest across jax.devices() (which groups by
+    # process), so the FIRST axis is the one spanning the process
+    # boundary — "mp:2,dp:4" makes every mp collective cross-process
+    # (VERDICT r4 item 4; reference: fleet/base/topology.py:61
+    # cartesian topo across hosts).
+    spec = os.environ.get("SMOKE_MESH", "2,4")
+    if ":" in spec:
+        axes = {}
+        for part in spec.split(","):
+            k, v = part.split(":")
+            axes[k] = int(v)
+    else:
+        dp, mp = (int(x) for x in spec.split(","))
+        axes = {"dp": dp, "mp": mp}
+    sz = 1
+    for v in axes.values():
+        sz *= v
+    assert sz == n_global, (axes, n_global)
     from paddle_tpu.distributed.mesh import init_mesh
-    mesh = init_mesh({"dp": dp, "mp": mp})
+    mesh = init_mesh(axes)
 
     paddle_tpu.seed(0)
+    kind = os.environ.get("SMOKE_TRAINER", "trainer")
     cfg = tiny_llama_config(num_hidden_layers=2)
     model = LlamaForCausalLM(cfg)
     optimizer = opt.AdamW(learning_rate=1e-3,
                           parameters=model.parameters())
-    tr = Trainer(model, optimizer, mesh=mesh,
-                 plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
-                 config=TrainStepConfig(compute_dtype=None))
+    if kind == "pipeline":
+        from paddle_tpu.parallel.pipeline import (PipelineConfig,
+                                                  PipelineTrainer)
+        tr = PipelineTrainer(
+            model, optimizer, mesh=mesh,
+            plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
+            config=PipelineConfig(
+                compute_dtype=None,
+                num_microbatches=int(os.environ.get("SMOKE_MICRO", "4"))))
+    else:
+        tr = Trainer(model, optimizer, mesh=mesh,
+                     plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
+                     config=TrainStepConfig(compute_dtype=None))
 
     steps = int(os.environ.get("SMOKE_STEPS", "4"))
     losses = []
@@ -107,7 +135,8 @@ def main():
             json.dump({"losses": losses, "world": world,
                        "devices_global": n_global,
                        "devices_local": n_local,
-                       "mesh": [dp, mp]}, f)
+                       "mesh": list(axes.items()),
+                       "trainer": kind}, f)
     multihost_utils.sync_global_devices("smoke:done")
     print(f"SMOKE_OK rank={rank} losses={losses}", flush=True)
     # this environment's XLA teardown aborts ("terminate called without

@@ -28,7 +28,15 @@ class ProcessMesh:
     surface to the reference's paddle.distributed.ProcessMesh.
     """
 
-    def __init__(self, mesh, dim_names=None, process_ids=None):
+    def __init__(self, mesh=None, dim_names=None, shape=None,
+                 process_ids=None):
+        if mesh is None:
+            # compatibility ctor (reference process_mesh.py:94): rebuild
+            # the id array from shape + flat process_ids
+            if shape is None or process_ids is None:
+                raise ValueError(
+                    "ProcessMesh needs mesh=, or shape= + process_ids=")
+            mesh = np.asarray(process_ids, dtype=np.int64).reshape(shape)
         arr = np.asarray(mesh, dtype=np.int64)
         if dim_names is None:
             dim_names = _default_dim_names(arr.ndim)

@@ -24,11 +24,11 @@ from .transformed_distribution import Independent
 _REGISTRY: dict[tuple[type, type], callable] = {}
 
 
-def register_kl(p_cls, q_cls):
+def register_kl(cls_p, cls_q):
     """Decorator registering a pairwise KL implementation."""
 
     def deco(fn):
-        _REGISTRY[(p_cls, q_cls)] = fn
+        _REGISTRY[(cls_p, cls_q)] = fn
         return fn
 
     return deco

@@ -102,15 +102,15 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
     return _op("send_uv", f, x, y)
 
 
-def _segment(name, reduce_op):
-    def api(data, segment_ids, name_arg=None):
+def _segment(op_name, reduce_op):
+    def api(data, segment_ids, name=None):
         seg = _idx(segment_ids)
         n = int(jnp.max(seg)) + 1 if seg.size else 0
 
         def f(d):
             return _segment_reduce(d, seg, n, reduce_op)
-        return _op(name, f, data)
-    api.__name__ = name
+        return _op(op_name, f, data)
+    api.__name__ = op_name
     return api
 
 
@@ -145,15 +145,15 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
             Tensor(jnp.asarray(np.array(out_nodes, np.int32))))
 
 
-def reindex_heter_graph(x, neighbors_list, count_list, value_buffer=None,
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
                         index_buffer=None, name=None):
     """Heterogeneous variant: neighbors per edge type share one id space
     (reference: reindex.py reindex_heter_graph)."""
     xv = np.asarray(x._value if isinstance(x, Tensor) else x).ravel()
     nbs = [np.asarray(n._value if isinstance(n, Tensor) else n).ravel()
-           for n in neighbors_list]
+           for n in neighbors]
     cnts = [np.asarray(c._value if isinstance(c, Tensor) else c).ravel()
-            for c in count_list]
+            for c in count]
     seen = dict((int(n), i) for i, n in enumerate(xv))
     out_nodes = list(xv)
     srcs, dsts = [], []

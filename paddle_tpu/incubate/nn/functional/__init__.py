@@ -49,7 +49,7 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
 
 
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
-                     begin_norm_axis=-1, **kwargs):
+                     begin_norm_axis=1, **kwargs):
     from paddle_tpu.nn import functional as F
     return F.layer_norm(x, x.shape[begin_norm_axis:], norm_weight,
                         norm_bias, epsilon), None
@@ -214,8 +214,10 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
 
 
 def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
-                            activation="gelu"):
+                            activation=None):
     out = fused_linear(x, y, bias, transpose_weight=trans_y)
+    if activation is None:      # reference default: plain biased linear
+        return out
     return fused_bias_act(out, None, act_method=activation)
 
 
@@ -226,22 +228,40 @@ def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
 # .py — CUDA kernels fused_multi_transformer / block_multi_head_attention)
 # ---------------------------------------------------------------------------
 
-def fused_dot_product_attention(q, k, v, attn_mask=None, dropout=0.0,
-                                causal=False, return_softmax=False,
-                                training=True, name=None):
-    """(reference: fused_dot_product_attention.py — cuDNN fused MHA).
-    Routes to the flash kernel when unmasked, the fused SDPA otherwise;
-    layout (batch, seq, heads, head_dim)."""
+def fused_dot_product_attention(q, k, v, mask=None, scaling_factor=None,
+                                dropout_prob=0.0, is_training=True,
+                                is_causal_masking=False,
+                                use_workspace_opt=None,
+                                return_softmax=False, *, attn_mask=None,
+                                dropout=None, causal=None, training=None,
+                                name=None):
+    """(reference: fused_dot_product_attention.py:22 — cuDNN fused MHA;
+    positional params match). Routes to the flash kernel when unmasked,
+    the fused SDPA otherwise; layout (batch, seq, heads, head_dim).
+    The trailing keyword aliases (attn_mask/dropout/causal/training) are
+    the pre-r5 names, kept for compatibility."""
     from paddle_tpu.nn import functional as F
-    if attn_mask is None and not (dropout and training):
-        out, _ = F.flash_attention(q, k, v, causal=causal,
-                                   training=training)
+    mask = attn_mask if attn_mask is not None else mask
+    dropout_prob = dropout if dropout is not None else dropout_prob
+    is_causal_masking = causal if causal is not None else is_causal_masking
+    is_training = training if training is not None else is_training
+    if scaling_factor is not None:
+        d = q.shape[-1]
+        import math
+        if abs(float(scaling_factor) - 1.0 / math.sqrt(d)) > 1e-9:
+            raise NotImplementedError(
+                "non-default scaling_factor is not supported; scale q "
+                "before the call")
+    if mask is None and not (dropout_prob and is_training):
+        out, _ = F.flash_attention(q, k, v, causal=is_causal_masking,
+                                   training=is_training)
         return out
     # dropout (or a mask) needs the SDPA path — the flash kernel has no
     # dropout support, and silently dropping it would change training
     return F.scaled_dot_product_attention(
-        q, k, v, attn_mask=attn_mask,
-        dropout_p=dropout if training else 0.0, is_causal=causal)
+        q, k, v, attn_mask=mask,
+        dropout_p=dropout_prob if is_training else 0.0,
+        is_causal=is_causal_masking)
 
 
 @defop("varlen_attn_mask", differentiable=False)

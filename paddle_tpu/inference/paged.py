@@ -410,7 +410,8 @@ class PagedKVEngine:
         self._reserved_unalloc -= slot.req.pages_needed - len(slot.pages)
         self._bt[slot_idx, :] = 0
         self._slots[slot_idx] = None
-        self.stats["finished"] += 1
+        if not slot.req.cancelled.is_set():
+            self.stats["finished"] += 1      # cancelled counts separately
         slot.req.queue.put(None)
         slot.req.done.set()
 
@@ -484,7 +485,15 @@ class PagedKVEngine:
 
     def run_until_idle(self):
         """Synchronously drain all pending + active requests (tests,
-        batch generation)."""
+        batch generation). When the background ticker is running it OWNS
+        the scheduler — stepping here too would race on pages/pools — so
+        this just waits for it to drain the work."""
+        t = self._ticker
+        if t is not None and t.is_alive():
+            import time
+            while self.has_work():
+                time.sleep(0.005)
+            return
         while self.has_work():
             if not self.step():
                 # nothing live but pending couldn't admit: impossible by
@@ -579,7 +588,6 @@ class PagedKVEngine:
                             do_sample=do_sample, temperature=temperature,
                             top_k=top_k, top_p=top_p) for r in rows]
         streams = [r.stream_tokens() for r in reqs]
-        out = [None] * len(reqs)
         try:
             for step in range(int(max_new_tokens)):
                 row = np.full(len(reqs), pad_token_id, np.int32)

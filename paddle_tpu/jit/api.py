@@ -45,10 +45,12 @@ class InputSpec:
     Dims of -1 ("dynamic") are accepted; jit simply retraces per concrete
     shape (XLA wants static shapes — SURVEY.md §7 design stance)."""
 
-    def __init__(self, shape, dtype="float32", name=None):
+    def __init__(self, shape, dtype="float32", name=None,
+                 stop_gradient=False):
         self.shape = tuple(shape)
         self.dtype = dtype
         self.name = name
+        self.stop_gradient = stop_gradient
 
     def __repr__(self):
         return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
@@ -419,14 +421,16 @@ def compilation_cache_stats():
     }
 
 
-def not_to_static(fn):
-    fn._not_to_static = True
-    return fn
+def not_to_static(func=None):
+    if func is None:            # @not_to_static() factory form
+        return not_to_static
+    func._not_to_static = True
+    return func
 
 
 def ignore_module(modules):
     return None
 
 
-def enable_to_static(flag=True):
+def enable_to_static(enable_to_static_bool=True):
     return None

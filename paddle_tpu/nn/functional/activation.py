@@ -13,7 +13,7 @@ def relu(x, name=None):
 
 
 @defop("relu6")
-def relu6(x):
+def relu6(x, name=None):
     return jax.nn.relu6(x)
 
 
@@ -22,7 +22,7 @@ def _relu_inplace(x):
     return jax.nn.relu(x)
 
 
-def relu_(x):
+def relu_(x, name=None):
     return x._inplace_assign(_relu_inplace(x))
 
 
@@ -45,33 +45,33 @@ def sigmoid(x, name=None):
 
 
 @defop("hardsigmoid")
-def hardsigmoid(x, slope=0.1666667, offset=0.5):
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
     return jnp.clip(slope * x + offset, 0.0, 1.0)
 
 
 @defop("hardswish")
-def hardswish(x):
+def hardswish(x, name=None):
     return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
 
 
 @defop("hardtanh")
-def hardtanh(x, min=-1.0, max=1.0):
+def hardtanh(x, min=-1.0, max=1.0, name=None):
     return jnp.clip(x, min, max)
 
 
 @defop("hardshrink")
-def hardshrink(x, threshold=0.5):
+def hardshrink(x, threshold=0.5, name=None):
     return jnp.where(jnp.abs(x) > threshold, x, 0.0)
 
 
 @defop("softshrink")
-def softshrink(x, threshold=0.5):
+def softshrink(x, threshold=0.5, name=None):
     return jnp.where(x > threshold, x - threshold,
                      jnp.where(x < -threshold, x + threshold, 0.0))
 
 
 @defop("tanhshrink")
-def tanhshrink(x):
+def tanhshrink(x, name=None):
     return x - jnp.tanh(x)
 
 
@@ -81,21 +81,21 @@ def leaky_relu(x, negative_slope=0.01, name=None):
 
 
 @defop("elu")
-def elu(x, alpha=1.0):
+def elu(x, alpha=1.0, name=None):
     return jax.nn.elu(x, alpha)
 
 
-def elu_(x, alpha=1.0):
+def elu_(x, alpha=1.0, name=None):
     return x._inplace_assign(elu(x, alpha))
 
 
 @defop("selu")
-def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
     return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
 
 
 @defop("celu")
-def celu(x, alpha=1.0):
+def celu(x, alpha=1.0, name=None):
     return jax.nn.celu(x, alpha)
 
 
@@ -116,7 +116,7 @@ def prelu(x, weight, data_format="NCHW", name=None):
 
 
 @defop("rrelu", differentiable=True)
-def rrelu(x, lower=0.125, upper=0.3333333, training=True):
+def rrelu(x, lower=0.125, upper=0.3333333, training=True, name=None):
     slope = (lower + upper) / 2.0
     return jnp.where(x >= 0, x, slope * x)
 
@@ -150,23 +150,23 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
 
 
 @defop("softplus")
-def softplus(x, beta=1.0, threshold=20.0):
+def softplus(x, beta=1, threshold=20, name=None):
     return jnp.where(x * beta > threshold, x,
                      jax.nn.softplus(x * beta) / beta)
 
 
 @defop("softsign")
-def softsign(x):
+def softsign(x, name=None):
     return jax.nn.soft_sign(x)
 
 
 @defop("mish")
-def mish(x):
+def mish(x, name=None):
     return x * jnp.tanh(jax.nn.softplus(x))
 
 
 @defop("maxout")
-def maxout(x, groups, axis=1):
+def maxout(x, groups, axis=1, name=None):
     c = x.shape[axis]
     new_shape = list(x.shape)
     new_shape[axis] = c // groups
@@ -175,7 +175,7 @@ def maxout(x, groups, axis=1):
 
 
 @defop("glu")
-def glu(x, axis=-1):
+def glu(x, axis=-1, name=None):
     a, b = jnp.split(x, 2, axis=axis)
     return a * jax.nn.sigmoid(b)
 
@@ -186,12 +186,12 @@ def tanh(x):
 
 
 @defop("thresholded_relu")
-def thresholded_relu(x, threshold=1.0, value=0.0):
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
     return jnp.where(x > threshold, x, value)
 
 
 @defop("log_sigmoid", amp_policy="black")
-def log_sigmoid(x):
+def log_sigmoid(x, name=None):
     return jax.nn.log_sigmoid(x)
 
 

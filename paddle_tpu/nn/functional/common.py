@@ -290,7 +290,7 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
 
 
 @defop("pixel_unshuffle")
-def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
     if data_format not in ("NCHW", "NHWC"):
         raise ValueError(f"data_format must be NCHW or NHWC, got "
                          f"{data_format!r}")
@@ -309,7 +309,7 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
 
 
 @defop("channel_shuffle")
-def channel_shuffle(x, groups, data_format="NCHW"):
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
     if data_format not in ("NCHW", "NHWC"):
         raise ValueError(f"data_format must be NCHW or NHWC, got "
                          f"{data_format!r}")
@@ -398,7 +398,8 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
     return _label_smooth(label, epsilon=epsilon, prior_dist=prior_dist)
 
 
-def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    lengths = x      # reference param name is x (nn/functional/common.py)
     lv = lengths._value if isinstance(lengths, Tensor) else jnp.asarray(lengths)
     m = int(maxlen) if maxlen is not None else int(jnp.max(lv))
     mask = jnp.arange(m)[None, :] < lv[..., None]
@@ -406,7 +407,8 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
 
 
 @defop("temporal_shift")
-def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
     nt, c, h, w = x.shape
     n = nt // seg_num
     xr = x.reshape(n, seg_num, c, h, w)
@@ -419,7 +421,8 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
     return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
 
 
-def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    x = input
     xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
     n = xv.shape[-1]
     base = jnp.zeros(xv.shape[:-1] + (n + abs(offset), n + abs(offset)), xv.dtype)

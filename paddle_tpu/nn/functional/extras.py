@@ -174,7 +174,7 @@ def _make_unpool(name, nd):
     def api(x, indices, kernel_size, stride=None, padding=0,
             data_format="NCL" if nd == 1 else ("NCHW" if nd == 2
                                                else "NCDHW"),
-            output_size=None, name_arg=None, name=None):
+            output_size=None, name=None):
         sp = _unpool_out_shape(tuple(x.shape[2:]), kernel_size, stride,
                                padding, output_size, nd)
         return op(x, _arr(indices), tuple(sp))

@@ -138,8 +138,8 @@ def _max_pool3d(x, kernel_size, stride, padding):
                           padding, 3)
 
 
-def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
-               return_mask=False, data_format="NCDHW", name=None):
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
     ks = _tuple(kernel_size, 3)
     st = _tuple(stride, 3) if stride is not None else ks
     return _max_pool3d(x, kernel_size=ks, stride=st,

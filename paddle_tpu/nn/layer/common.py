@@ -194,7 +194,7 @@ class ChannelShuffle(Layer):
 
 
 class Unfold(Layer):
-    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+    def __init__(self, kernel_sizes, dilations=1, paddings=0, strides=1,
                  name=None):
         super().__init__()
         self.args = (kernel_sizes, strides, paddings, dilations)
@@ -205,8 +205,8 @@ class Unfold(Layer):
 
 
 class Fold(Layer):
-    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
-                 dilations=1, name=None):
+    def __init__(self, output_sizes, kernel_sizes, dilations=1, paddings=0,
+                 strides=1, name=None):
         super().__init__()
         self.args = (output_sizes, kernel_sizes, strides, paddings, dilations)
 

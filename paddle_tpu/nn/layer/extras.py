@@ -70,13 +70,28 @@ class _MaxUnPoolNd(Layer):
 class MaxUnPool1D(_MaxUnPoolNd):
     _fn = staticmethod(F.max_unpool1d)
 
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format='NCL', output_size=None, name=None):
+        super().__init__(kernel_size, stride, padding, data_format,
+                         output_size, name)
+
 
 class MaxUnPool2D(_MaxUnPoolNd):
     _fn = staticmethod(F.max_unpool2d)
 
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format='NCHW', output_size=None, name=None):
+        super().__init__(kernel_size, stride, padding, data_format,
+                         output_size, name)
+
 
 class MaxUnPool3D(_MaxUnPoolNd):
     _fn = staticmethod(F.max_unpool3d)
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format='NCDHW', output_size=None, name=None):
+        super().__init__(kernel_size, stride, padding, data_format,
+                         output_size, name)
 
 
 class CTCLoss(Layer):
@@ -213,9 +228,13 @@ class BeamSearchDecoder:
         self.output_fn = output_fn
 
 
-def dynamic_decode(decoder, inits=None, max_step_num=20, **kwargs):
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
     """Greedy decode loop over a BeamSearchDecoder's cell (reference:
-    nn/decode.py dynamic_decode; beam_size=1 greedy semantics)."""
+    nn/decode.py dynamic_decode; beam_size=1 greedy semantics).
+    max_step_num=None decodes until every row emits end_token, with a
+    1000-step safety bound (the reference loops unboundedly)."""
     import numpy as np
     from paddle_tpu import tensor as T
     cell, emb = decoder.cell, decoder.embedding_fn
@@ -223,7 +242,7 @@ def dynamic_decode(decoder, inits=None, max_step_num=20, **kwargs):
     token = decoder.start_token
     outputs = []
     finished = None
-    for _ in range(max_step_num):
+    for _ in range(1000 if max_step_num is None else max_step_num):
         inp = emb(token) if emb is not None else token
         out, state = cell(inp, state)
         logits = decoder.output_fn(out) if decoder.output_fn else out

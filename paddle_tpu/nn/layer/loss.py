@@ -115,7 +115,7 @@ class HingeEmbeddingLoss(Layer):
 
 
 class CosineEmbeddingLoss(Layer):
-    def __init__(self, margin=0.0, reduction="mean", name=None):
+    def __init__(self, margin=0, reduction="mean", name=None):
         super().__init__()
         self.margin = margin
         self.reduction = reduction

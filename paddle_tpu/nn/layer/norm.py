@@ -79,7 +79,32 @@ class _BatchNormBase(Layer):
 
 
 class BatchNorm(_BatchNormBase):
-    pass
+    """Legacy dygraph BatchNorm (reference: nn/layer/norm.py BatchNorm —
+    the old num_channels-first signature, unlike BatchNorm1D/2D/3D).
+    act/in_place/moving_*_name/do_model_average_* are accepted for
+    signature parity; only `act` changes behavior here (post-norm
+    activation), the rest are static-graph bookkeeping knobs."""
+
+    def __init__(self, num_channels, act=None, is_test=False,
+                 momentum=0.9, epsilon=1e-05, param_attr=None,
+                 bias_attr=None, dtype='float32', data_layout='NCHW',
+                 in_place=False, moving_mean_name=None,
+                 moving_variance_name=None,
+                 do_model_average_for_mean_and_var=True,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__(num_channels, momentum=momentum, epsilon=epsilon,
+                         weight_attr=param_attr, bias_attr=bias_attr,
+                         data_format=data_layout,
+                         use_global_stats=use_global_stats or None)
+        self._act = act
+        if is_test:
+            self.eval()
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            out = getattr(F, self._act)(out)
+        return out
 
 
 class BatchNorm1D(_BatchNormBase):
@@ -192,7 +217,7 @@ class SpectralNorm(Layer):
     """Power-iteration spectral norm (reference: nn/layer/norm.py:SpectralNorm)."""
 
     def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
-                 name=None):
+                 dtype='float32', name=None):
         super().__init__()
         self._dim = dim
         self._power_iters = power_iters

@@ -346,9 +346,8 @@ class AdamW(Adam):
 
 class Adagrad(Optimizer):
     def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
-                 weight_decay=None, grad_clip=None,
-                 initial_accumulator_value=0.0, multi_precision=False,
-                 name=None):
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0, multi_precision=False):
         self._eps = epsilon
         self._init_acc = initial_accumulator_value
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
@@ -428,7 +427,7 @@ class Lamb(Optimizer):
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
                  beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
                  grad_clip=None, exclude_from_weight_decay_fn=None,
-                 multi_precision=False, name=None):
+                 multi_precision=False, always_adapt=False, name=None):
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._exclude_fn = exclude_from_weight_decay_fn
         super().__init__(learning_rate, parameters, lamb_weight_decay,
@@ -505,7 +504,7 @@ class Rprop(Optimizer):
     """Resilient backprop — sign-based per-weight step sizes
     (reference: python/paddle/optimizer/rprop.py)."""
 
-    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-05, 50),
                  parameters=None, etas=(0.5, 1.2), grad_clip=None,
                  multi_precision=False, name=None):
         self._lr_min, self._lr_max = learning_rate_range
@@ -571,7 +570,8 @@ class LBFGS(Optimizer):
     """
 
     def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
-                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=10,
+                 tolerance_grad=1e-07, tolerance_change=1e-09,
+                 history_size=100,
                  line_search_fn=None, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,

@@ -87,10 +87,11 @@ class QuanterFactory:
 QUANTER_REGISTRY = {}
 
 
-def quanter(name):
+def quanter(class_name):
     """Decorator registering a quanter layer under a factory name
     (reference: factory.py quanter). The factory is available as
-    QUANTER_REGISTRY[name]."""
+    QUANTER_REGISTRY[class_name]."""
+    name = class_name
     def deco(cls):
         def factory(*args, **kwargs):
             return QuanterFactory(cls, *args, **kwargs)

@@ -31,13 +31,15 @@ __all__ = [
 ]
 
 
-def data(name, shape, dtype="float32", lod_level=0):
+def data(name, shape, dtype=None, lod_level=0):
     """Declare a graph input (reference: python/paddle/static/input.py
     data). Under an active `program_guard`, returns a PLACEHOLDER
     variable of the captured program (ops on it record instead of
     executing — see paddle_tpu/static/graph.py); outside a guard,
     returns an InputSpec usable with to_static/jit.save."""
     from paddle_tpu.static import graph as _graph
+    if dtype is None:
+        dtype = "float32"      # reference: None -> default dtype
     prog = _graph.current_program()
     if prog is not None:
         return prog.add_data(name, list(shape), dtype)
@@ -294,11 +296,12 @@ def global_scope():
     return _Scope()
 
 
-def gradients(targets, inputs, target_gradients=None):
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     """Static-mode AD entry (reference: python/paddle/base/backward.py
     gradients) — delegates to the eager/tape grad which jits identically."""
     from paddle_tpu.autograd import grad as _grad
-    return _grad(targets, inputs, grad_outputs=target_gradients)
+    return _grad(targets, inputs, grad_outputs=target_gradients,
+                 no_grad_vars=list(no_grad_set) if no_grad_set else None)
 
 
 def normalize_program(program, feed_vars, fetch_vars):

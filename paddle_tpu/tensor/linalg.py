@@ -29,7 +29,7 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
 
 
 @defop("mm", amp_policy="white")
-def mm(input, mat2):
+def mm(input, mat2, name=None):
     return jnp.matmul(input, mat2)
 
 
@@ -44,7 +44,7 @@ def dot(x, y, name=None):
 
 
 @defop("mv", amp_policy="white")
-def mv(x, vec):
+def mv(x, vec, name=None):
     return jnp.matmul(x, vec)
 
 
@@ -58,7 +58,7 @@ def t(input, name=None):
 
 
 @defop("cross")
-def cross(x, y, axis=9):
+def cross(x, y, axis=9, name=None):
     ax = axis if axis != 9 else next(
         (i for i, s in enumerate(x.shape) if s == 3), -1)
     return jnp.cross(x, y, axis=ax)
@@ -96,7 +96,7 @@ def p_norm(x, p=2.0, axis=None, keepdim=False):
 
 
 @defop("dist", amp_policy="black")
-def dist(x, y, p=2.0):
+def dist(x, y, p=2, name=None):
     d = x - y
     if p == float("inf"):
         return jnp.max(jnp.abs(d))
@@ -108,13 +108,13 @@ def dist(x, y, p=2.0):
 
 
 @defop("cholesky")
-def cholesky(x, upper=False):
+def cholesky(x, upper=False, name=None):
     L = jnp.linalg.cholesky(x)
     return jnp.swapaxes(L, -1, -2) if upper else L
 
 
 @defop("cholesky_solve")
-def cholesky_solve(x, y, upper=False):
+def cholesky_solve(x, y, upper=False, name=None):
     L = jnp.swapaxes(y, -1, -2) if upper else y
     z = jax.scipy.linalg.solve_triangular(L, x, lower=True)
     return jax.scipy.linalg.solve_triangular(
@@ -122,14 +122,14 @@ def cholesky_solve(x, y, upper=False):
 
 
 @defop("triangular_solve")
-def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
     return jax.scipy.linalg.solve_triangular(
         x, y, lower=not upper, trans=1 if transpose else 0,
         unit_diagonal=unitriangular)
 
 
 @defop("inverse")
-def inverse(x):
+def inverse(x, name=None):
     return jnp.linalg.inv(x)
 
 
@@ -137,12 +137,12 @@ inv = inverse
 
 
 @defop("pinv")
-def pinv(x, rcond=1e-15, hermitian=False):
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
     return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
 
 
 @defop("solve")
-def solve(x, y):
+def solve(x, y, name=None):
     return jnp.linalg.solve(x, y)
 
 
@@ -157,23 +157,23 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
 
 
 @defop("det")
-def det(x):
+def det(x, name=None):
     return jnp.linalg.det(x)
 
 
 @defop("slogdet")
-def slogdet(x):
+def slogdet(x, name=None):
     sign, logdet = jnp.linalg.slogdet(x)
     return jnp.stack([sign, logdet]) if sign.ndim == 0 else (sign, logdet)
 
 
 @defop("matrix_power")
-def matrix_power(x, n):
+def matrix_power(x, n, name=None):
     return jnp.linalg.matrix_power(x, n)
 
 
 @defop("matrix_rank", differentiable=False)
-def matrix_rank(x, tol=None, hermitian=False):
+def matrix_rank(x, tol=None, hermitian=False, name=None):
     return jnp.linalg.matrix_rank(x, rtol=tol)
 
 
@@ -198,7 +198,7 @@ def qr(x, mode="reduced", name=None):
 
 
 @defop("eig", differentiable=False)
-def eig(x):
+def eig(x, name=None):
     # jax.numpy.linalg.eig is CPU-only; pull to host
     w, v = np.linalg.eig(np.asarray(x))
     return jnp.asarray(w), jnp.asarray(v)
@@ -214,7 +214,7 @@ def eigh(x, UPLO="L", name=None):
 
 
 @defop("eigvals", differentiable=False)
-def eigvals(x):
+def eigvals(x, name=None):
     return jnp.asarray(np.linalg.eigvals(np.asarray(x)))
 
 
@@ -241,7 +241,7 @@ def lu(x, pivot=True, get_infos=False, name=None):
 
 
 @defop("matrix_exp")
-def matrix_exp(x):
+def matrix_exp(x, name=None):
     return jax.scipy.linalg.expm(x)
 
 
@@ -255,7 +255,7 @@ def cond(x, p=None, name=None):
 
 
 @defop("householder_product")
-def householder_product(x, tau):
+def householder_product(x, tau, name=None):
     m, n = x.shape[-2], x.shape[-1]
     Q = jnp.eye(m, dtype=x.dtype)
     for i in range(n):
@@ -281,12 +281,12 @@ def multi_dot(x, name=None):
 
 
 @defop("corrcoef")
-def corrcoef(x, rowvar=True):
+def corrcoef(x, rowvar=True, name=None):
     return jnp.corrcoef(x, rowvar=rowvar)
 
 
 @defop("cov")
-def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
                    fweights=fweights, aweights=aweights)
 
@@ -296,7 +296,7 @@ def _matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
     return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
 
 
-def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+def matrix_norm(x, p="fro", axis=[-2, -1], keepdim=False, name=None):
     return _matrix_norm(x, p=p, axis=axis, keepdim=keepdim)
 
 

@@ -118,7 +118,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     return Tensor(jnp.stack(outs))
 
 
-def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
     """Max RoI pooling (reference ops.py roi_pool)."""
     xv = _val(x)
     bv = np.asarray(_val(boxes))
@@ -374,9 +374,9 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
     return Tensor(out)
 
 
-def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
-              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
-              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
+              steps=[0.0, 0.0], offset=0.5, min_max_aspect_ratios_order=False,
               name=None):
     """SSD prior boxes (reference: vision/ops.py prior_box)."""
     fa, ia = _val(input), _val(image)
@@ -432,8 +432,8 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
 
 
 def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
-             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
-             iou_aware=False, iou_aware_factor=0.5, name=None):
+             downsample_ratio=32, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
     """Decode YOLOv3 head output to boxes+scores (reference: vision/ops.py
     yolo_box)."""
     xa = _val(x)
@@ -638,8 +638,8 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     return rois, rscores
 
 
-def read_file(path, name=None):
-    with open(path, "rb") as f:
+def read_file(filename, name=None):
+    with open(filename, "rb") as f:
         data = np.frombuffer(f.read(), np.uint8)
     return Tensor(jnp.asarray(data))
 

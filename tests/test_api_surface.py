@@ -267,6 +267,12 @@ _SIGNATURE_DEVIATIONS = {
         "in amp.decorate's docstring"),
     ("paddle.amp.decorate", "dtype"): (
         "'bfloat16'", "TPU-native default (reference: float16 for CUDA)"),
+    ("paddle.amp.amp_guard", "dtype"): (
+        "'bfloat16'", "TPU-native default (reference: float16 for CUDA); "
+        "same deviation as auto_cast, which amp_guard aliases"),
+    ("paddle.audio.functional.get_window", "dtype"): (
+        "'float32'", "float64 is unavailable on the TPU stack "
+        "(jax_enable_x64 off); window generation stays f32"),
 }
 
 

@@ -124,7 +124,7 @@ def test_two_process_world_shape(two_proc_run):
     assert result["world"] == 2
     assert result["devices_global"] == 8
     assert result["devices_local"] == 4
-    assert result["mesh"] == [2, 4]
+    assert result["mesh"] == [["dp", 2], ["mp", 4]]
 
 
 def test_two_process_losses_match_single_process(two_proc_run):
@@ -325,3 +325,94 @@ def test_elastic_supervisor_relaunches_multiprocess_job(tmp_path):
     assert restarts == 1
     _, clean = run_job(str(tmp_path / "b"), 10**9)   # never killed
     np.testing.assert_allclose(interrupted, clean, rtol=1e-5, atol=1e-6)
+
+
+# -- round 5: parallelism axes SPANNING the process boundary (VERDICT #4) ---
+
+def _launch_two(tmp_path, extra_env, steps=3):
+    """Run the 2-process launcher job with env overrides; return the
+    result dict."""
+    out = str(tmp_path)
+    master, store = _free_port(), _free_port()
+    procs = []
+    for rank in range(2):
+        env = _worker_env(rank, master, store, out)
+        env["SMOKE_STEPS"] = str(steps)
+        env.update(extra_env)
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--master", f"127.0.0.1:{master}", "--nnodes", "2",
+               "--rank", str(rank), SMOKE]
+        procs.append(subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            o, _ = p.communicate(timeout=420)
+            outs.append(o)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate(timeout=30)
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{o[-4000:]}"
+        assert "SMOKE_OK" in o
+    with open(os.path.join(out, "result.json")) as f:
+        return json.load(f)
+
+
+def _reference_losses(axes, kind="trainer", steps=3, micro=4):
+    """Same job single-process on the 8 virtual devices, same ordered
+    mesh (GSPMD math must not depend on which axis crosses processes)."""
+    import paddle_tpu
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+    from paddle_tpu.parallel import (Trainer, TrainStepConfig,
+                                     llama_sharding_plan)
+
+    mesh = init_mesh(axes)
+    paddle_tpu.seed(0)
+    cfg = tiny_llama_config(num_hidden_layers=2)
+    model = LlamaForCausalLM(cfg)
+    optimizer = opt.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+    if kind == "pipeline":
+        from paddle_tpu.parallel.pipeline import (PipelineConfig,
+                                                  PipelineTrainer)
+        tr = PipelineTrainer(
+            model, optimizer, mesh=mesh,
+            plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
+            config=PipelineConfig(compute_dtype=None,
+                                  num_microbatches=micro))
+    else:
+        tr = Trainer(model, optimizer, mesh=mesh,
+                     plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
+                     config=TrainStepConfig(compute_dtype=None))
+    losses = []
+    rng = np.random.RandomState(7)
+    for _ in range(steps):
+        ids = rng.randint(0, cfg.vocab_size, (8, 32)).astype("int32")
+        losses.append(float(tr.step({"input_ids": ids, "labels": ids})))
+    return losses
+
+
+def test_mp_axis_spans_process_boundary(tmp_path):
+    """mp as the SLOW mesh axis = every tensor-parallel collective is a
+    cross-process (Gloo) collective; losses must match the 1-process
+    run exactly (reference: fleet/base/topology.py:61)."""
+    res = _launch_two(tmp_path, {"SMOKE_MESH": "mp:2,dp:4"})
+    assert res["mesh"] == [["mp", 2], ["dp", 4]]
+    want = _reference_losses({"mp": 2, "dp": 4})
+    np.testing.assert_allclose(res["losses"], want, rtol=1e-5)
+
+
+def test_pp_axis_spans_process_boundary(tmp_path):
+    """Pipeline stages split ACROSS processes: the stage-boundary
+    activation roll is a cross-process ppermute every tick."""
+    res = _launch_two(tmp_path, {"SMOKE_MESH": "pp:2,dp:4",
+                                 "SMOKE_TRAINER": "pipeline"})
+    assert res["trainer"] == "pipeline"
+    want = _reference_losses({"pp": 2, "dp": 4}, kind="pipeline")
+    np.testing.assert_allclose(res["losses"], want, rtol=1e-5)
