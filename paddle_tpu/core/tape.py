@@ -207,7 +207,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             "behaves as True there too) and is not supported")
     blocked = None
     if no_grad_vars:
-        ng = (no_grad_vars if isinstance(no_grad_vars, (list, tuple))
+        ng = (list(no_grad_vars)
+              if isinstance(no_grad_vars, (list, tuple, set))
               else [no_grad_vars])
         blocked = {t._uid for t in ng}
         if blocked & {t._uid for t in inputs}:

@@ -264,6 +264,7 @@ class PagedKVEngine:
         self._bt = np.zeros((self.max_slots, self.max_pages_per_slot),
                             np.int32)
         self._pending: list[_Request] = []
+        self._inflight = 0      # submitted, not yet retired/dropped
         self._lock = threading.Lock()
         self._programs = {}
         self._tick_count = 0
@@ -302,12 +303,16 @@ class PagedKVEngine:
             # seed replay identically regardless of process history
             req.sample_index = self._submitted
             self._submitted += 1
+            self._inflight += 1
             self._pending.append(req)
         return req
 
     def has_work(self):
+        # _inflight counts submit -> retire/drop, so the transient
+        # window where _admit has popped self._pending but not yet
+        # assigned slots cannot read as idle
         with self._lock:
-            return bool(self._pending) or any(self._slots)
+            return self._inflight > 0
 
     # -- scheduling core -------------------------------------------------
     def _bucket(self, n):
@@ -331,6 +336,8 @@ class PagedKVEngine:
         for req in pending:
             if req.cancelled.is_set():
                 self.stats["cancelled"] += 1
+                with self._lock:
+                    self._inflight -= 1
                 req.queue.put(None)
                 req.done.set()
                 continue
@@ -410,6 +417,8 @@ class PagedKVEngine:
         self._reserved_unalloc -= slot.req.pages_needed - len(slot.pages)
         self._bt[slot_idx, :] = 0
         self._slots[slot_idx] = None
+        with self._lock:
+            self._inflight -= 1
         if not slot.req.cancelled.is_set():
             self.stats["finished"] += 1      # cancelled counts separately
         slot.req.queue.put(None)
@@ -543,6 +552,7 @@ class PagedKVEngine:
                 with self._lock:
                     doomed = self._pending
                     self._pending = []
+                    self._inflight -= len(doomed)   # dropped, not retired
                 for i, s in enumerate(self._slots):
                     if s is not None:
                         s.req.error = e

@@ -242,6 +242,7 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     token = decoder.start_token
     outputs = []
     finished = None
+    lengths = None
     for _ in range(1000 if max_step_num is None else max_step_num):
         inp = emb(token) if emb is not None else token
         out, state = cell(inp, state)
@@ -249,7 +250,13 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
         token = T.argmax(logits, axis=-1)
         tok_np = np.asarray(token._value)
         done_now = (tok_np == decoder.end_token)
-        finished = done_now if finished is None else (finished | done_now)
+        if finished is None:
+            finished = np.zeros_like(tok_np, dtype=bool)
+            lengths = np.zeros_like(tok_np, dtype=np.int64)
+        # a row still live at this step's start emits a real token
+        # (its eos, if this is the step it finishes, counts)
+        lengths = lengths + (~finished)
+        finished = finished | done_now
         # finished sequences keep emitting end_token, not garbage
         if finished.any():
             token = Tensor(jnp.where(jnp.asarray(finished),
@@ -257,4 +264,7 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
         outputs.append(token)
         if finished.all():
             break
-    return T.stack(outputs, axis=1), state
+    stacked = T.stack(outputs, axis=0 if output_time_major else 1)
+    if return_length:
+        return stacked, state, Tensor(jnp.asarray(lengths, jnp.int64))
+    return stacked, state

@@ -300,8 +300,17 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     """Static-mode AD entry (reference: python/paddle/base/backward.py
     gradients) — delegates to the eager/tape grad which jits identically."""
     from paddle_tpu.autograd import grad as _grad
+    if no_grad_set:
+        ng = list(no_grad_set)
+        if any(isinstance(v, str) for v in ng):
+            raise NotImplementedError(
+                "no_grad_set by VARIABLE NAME is a static-graph-scope "
+                "lookup the captured-program engine does not keep; pass "
+                "the Tensors themselves")
+    else:
+        ng = None
     return _grad(targets, inputs, grad_outputs=target_gradients,
-                 no_grad_vars=list(no_grad_set) if no_grad_set else None)
+                 no_grad_vars=ng)
 
 
 def normalize_program(program, feed_vars, fetch_vars):
