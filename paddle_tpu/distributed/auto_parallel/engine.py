@@ -128,11 +128,15 @@ def complete_plan(model, mesh_axes):
       (q/k/v col, o row), transformer MLPs (gate/up col, down row) and
       BERT blocks without naming conventions;
     - lone output heads (a Linear whose out_features looks vocab-sized)
-      are column-parallel; 1D params (norms, biases) replicate.
+      are column-parallel; 1D params (norms, biases) replicate;
+    - stacked expert parameters (a module exposing num_experts with
+      (E, ...) 3-D weights) shard the expert dim over 'ep' (r5: the
+      MoE rule the reference Completer gets from its moe spmd rules).
     """
     from paddle_tpu import nn
     mp = "mp" if "mp" in mesh_axes else None
     fsdp = "fsdp" if "fsdp" in mesh_axes else None
+    ep = "ep" if "ep" in mesh_axes else None
     table = {}
 
     emb_dims = set()
@@ -140,6 +144,12 @@ def complete_plan(model, mesh_axes):
         if isinstance(sub, nn.Embedding):
             table[f"{name}.weight"] = P(mp, fsdp)
             emb_dims.add(sub.weight.shape[0])
+        n_exp = getattr(sub, "num_experts", None)
+        if n_exp:
+            for pname, pt in sub.__dict__.get("_parameters", {}).items():
+                if pt is not None and len(pt.shape) == 3 \
+                        and pt.shape[0] == n_exp:
+                    table[f"{name}.{pname}"] = P(ep)
 
     for name, sub in model.named_sublayers(include_self=True):
         linears = [(n, c) for n, c in sub.named_children()

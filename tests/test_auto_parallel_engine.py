@@ -91,3 +91,73 @@ def test_engine_semi_auto_pipeline():
     ids = rng.randint(0, cfg.vocab_size, (4, 32)).astype(np.int32)
     losses = eng.fit([{"input_ids": ids, "labels": ids}] * 3)
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+# -- round 5: the automated plan trains REAL model families -----------------
+
+def test_complete_plan_trains_llama_to_hand_plan_parity():
+    """Completer output (structure-derived, no name conventions) trains
+    tiny-llama on dp x fsdp x mp to the same losses as the hand-written
+    llama_sharding_plan (GSPMD semantics are sharding-invariant), and it
+    actually shards the big weights."""
+    import paddle_tpu
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed import init_mesh
+    from paddle_tpu.distributed.auto_parallel.engine import complete_plan
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.models.llama import tiny_llama_config
+    from paddle_tpu.parallel import Trainer, TrainStepConfig
+    from paddle_tpu.parallel.plan import llama_sharding_plan
+
+    mesh = init_mesh({"dp": 2, "fsdp": 2, "mp": 2})
+    ids = np.random.RandomState(0).randint(0, 256, (8, 32)).astype("int32")
+    batch = {"input_ids": ids, "labels": ids}
+    losses = {}
+    for name in ("hand", "auto"):
+        paddle_tpu.seed(0)
+        cfg = tiny_llama_config(num_hidden_layers=2)
+        model = LlamaForCausalLM(cfg)
+        plan = (llama_sharding_plan(mesh.jax_mesh.axis_names)
+                if name == "hand" else complete_plan(
+                    model, mesh.jax_mesh.axis_names))
+        o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        tr = Trainer(model, o, mesh=mesh, plan=plan,
+                     config=TrainStepConfig(compute_dtype=None))
+        losses[name] = [float(tr.step(batch)) for _ in range(3)]
+        if name == "auto":
+            # the attention projections really sharded over mp
+            spec = tr.params[
+                "model.layers.0.self_attn.q_proj.weight"].sharding.spec
+            assert "mp" in str(spec), spec
+    np.testing.assert_allclose(losses["auto"], losses["hand"], rtol=2e-5)
+
+
+def test_complete_plan_shards_moe_experts_over_ep():
+    """The r5 MoE completion rule: stacked (E, ...) expert weights get
+    P('ep') without name conventions; Qwen2-MoE trains under the
+    completed plan."""
+    import paddle_tpu
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed import init_mesh
+    from paddle_tpu.distributed.auto_parallel.engine import complete_plan
+    from paddle_tpu.models.qwen2_moe import (Qwen2MoeForCausalLM,
+                                             tiny_qwen2_moe_config)
+    from paddle_tpu.parallel import Trainer, TrainStepConfig
+
+    paddle_tpu.seed(0)
+    cfg = tiny_qwen2_moe_config()
+    model = Qwen2MoeForCausalLM(cfg)
+    mesh = init_mesh({"dp": 2, "ep": 2, "mp": 2})
+    plan = complete_plan(model, mesh.jax_mesh.axis_names)
+    name = next(n for n in plan.table if "experts_gate_weight" in n)
+    assert "ep" in str(plan.table[name])
+    o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    tr = Trainer(model, o, mesh=mesh, plan=plan,
+                 config=TrainStepConfig(compute_dtype=None))
+    ids = np.random.RandomState(1).randint(
+        0, cfg.vocab_size, (4, 32)).astype(np.int32)
+    l1 = float(tr.step({"input_ids": ids, "labels": ids}))
+    l2 = float(tr.step({"input_ids": ids, "labels": ids}))
+    assert np.isfinite(l1) and l2 < l1
+    spec = tr.params[name].sharding.spec
+    assert "ep" in str(spec), spec

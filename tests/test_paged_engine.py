@@ -324,3 +324,26 @@ def test_cancel_frees_slot_and_pages():
         assert len(eng._free) == eng.num_pages - 1
     finally:
         eng.stop()
+
+
+def test_qwen2_moe_serves_through_paged_engine():
+    """The MoE flagship rides the same paged path (its attention IS
+    LlamaAttention): mid-decode admission token parity holds."""
+    from paddle_tpu.models.qwen2_moe import (Qwen2MoeForCausalLM,
+                                             tiny_qwen2_moe_config)
+    paddle_tpu.seed(1)
+    model = Qwen2MoeForCausalLM(tiny_qwen2_moe_config())
+    pa, pb = [5, 9, 2], [17, 3, 11, 4]
+    solo = {}
+    for key, p in (("a", pa), ("b", pb)):
+        solo[key] = np.asarray(
+            generate(model, np.asarray([p], np.int32),
+                     max_new_tokens=5))[0].tolist()[len(p):]
+    eng = PagedKVEngine(model, max_slots=2, page_size=4, num_pages=24,
+                        max_pages_per_slot=6, steps_per_tick=2)
+    ra = eng.submit(pa, max_new_tokens=5)
+    eng.step()
+    rb = eng.submit(pb, max_new_tokens=5)    # joins mid-decode of A
+    eng.run_until_idle()
+    assert ra.result() == solo["a"]
+    assert rb.result() == solo["b"]
