@@ -274,7 +274,8 @@ class PagedKVEngine:
         self._ticker = None
         # telemetry for tests / the serving bench
         self.stats = {"ticks": 0, "prefills": 0, "tokens_out": 0,
-                      "admitted": 0, "finished": 0, "cancelled": 0}
+                      "admitted": 0, "finished": 0, "cancelled": 0,
+                      "prefill_s": 0.0, "tick_s": 0.0}
         # serving integration: PredictorServer must not serialize
         # concurrent streams through its executable lock — the engine's
         # ticker thread is the only chip user
@@ -355,6 +356,8 @@ class PagedKVEngine:
                 self._pending = requeue + self._pending
 
     def _prefill(self, slot_idx, req):
+        import time as _time
+        t0 = _time.perf_counter()
         p = int(req.prompt.size)
         slot = _Slot(req, lens=0, tok=0)
         self._slots[slot_idx] = slot
@@ -386,6 +389,7 @@ class PagedKVEngine:
             tok = int(np.argmax(logits))
         slot.tok = tok
         self.stats["prefills"] += 1
+        self.stats["prefill_s"] += _time.perf_counter() - t0
         self._accept(slot_idx, [tok])
 
     def _accept(self, slot_idx, toks):
@@ -463,6 +467,8 @@ class PagedKVEngine:
             topk[i] = slot.req.top_k
             topp[i] = slot.req.top_p
             wants[i] = slot.req.do_sample
+        import time as _time
+        t0 = _time.perf_counter()
         any_sample = bool(wants.any())
         fn = self._tick_fn(any_sample)
         key = jax.random.fold_in(self._key, self._tick_count)
@@ -481,6 +487,7 @@ class PagedKVEngine:
         lens_np = np.asarray(lens_f)
         self._tick_count += 1
         self.stats["ticks"] += 1
+        self.stats["tick_s"] += _time.perf_counter() - t0
         for i in live:
             slot = self._slots[i]
             cnt = min(int(limit[i]), n)

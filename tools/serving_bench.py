@@ -63,6 +63,7 @@ print(f"prefill+first tick compiled: {time.perf_counter()-t0:.1f}s",
 eng.run_until_idle()
 r.result()
 print(f"warm request done: {time.perf_counter()-t0:.1f}s", flush=True)
+warm_pf, warm_tk = eng.stats["prefill_s"], eng.stats["tick_s"]
 
 # measured: saturate 8 slots from a 16-deep queue; finishing requests
 # free their slot and the queue refills it mid-decode of the others
@@ -71,10 +72,14 @@ reqs = [eng.submit(p, max_new_tokens=new) for p in prompts]
 eng.run_until_idle()
 dt = time.perf_counter() - t0
 total = sum(len(r.result()) for r in reqs)
+pf = eng.stats["prefill_s"] - warm_pf
+tk = eng.stats["tick_s"] - warm_tk
 print(f"continuous batching: {n_req} reqs x {new} tok (b8 slots, "
       f"s{s}): {total} tokens in {dt:.2f}s = "
       f"{total / dt:.1f} tok/s aggregate | ticks={eng.stats['ticks']} "
-      f"prefills={eng.stats['prefills']}")
+      f"prefills={eng.stats['prefills']} | prefill {pf:.2f}s, decode "
+      f"ticks {tk:.2f}s -> decode-phase "
+      f"{total / tk:.1f} tok/s")
 
 # heterogeneous budgets: half the requests are short (16 tokens), so
 # slots retire early and refill mid-decode — the admission-latency
