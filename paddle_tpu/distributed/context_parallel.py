@@ -57,11 +57,166 @@ def _merge_block(q, kj, vj, m, l, acc, sm_scale, causal, row_off, col_off):
     return m_new, l_new, acc_new
 
 
-def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
+# -- flash-kernel ring (r5): per-shard Pallas flash + base-2 lse merge ------
+# The jnp _merge_block ring materializes the full (S_local, S_shard)
+# score matrix per step — ~8x slower than the flash kernel at S=8k
+# (tools/cp_bench.py). This path runs the SAME Pallas kernels the
+# single-chip flash path uses, merging per-shard partials by their
+# base-2 lse; backward is a second ring rotating (k, v, dk, dv)
+# together so each shard's grads ride home with it.
+
+_RING_BQ = 512   # pinned blocks: lax.switch branches must agree on the
+_RING_BK = 512   # padded lse width, so no per-branch autotune here
+
+
+def _ring_flash_shapes_ok(q, k):
+    sq, sk, d = q.shape[2], k.shape[2], q.shape[3]
+    return (sq % min(_RING_BQ, sq) == 0 and sk % min(_RING_BK, sk) == 0
+            and sq >= 8 and sk >= 8 and d % 8 == 0)
+
+
+def _ring_flash_step_fwd(q, k_cur, v_cur, mode, sm_scale, interpret):
+    """mode: 0 = unmasked shard, 1 = aligned-diagonal (causal), 2 =
+    future shard (fully masked -> zero weight)."""
+    from paddle_tpu.kernels.flash_attention import _flash_fwd_pallas
+    bq = min(_RING_BQ, q.shape[2])
+    bk = min(_RING_BK, k_cur.shape[2])
+
+    def run(causal):
+        def f():
+            return _flash_fwd_pallas(q, k_cur, v_cur, causal, sm_scale,
+                                     block_q=bq, block_k=bk,
+                                     interpret=interpret)
+        return f
+
+    def skip():
+        b, h, sq, d = q.shape
+        return (jnp.zeros((b, h, sq, d), q.dtype),
+                jnp.full((b, h, 8, sq), _NEG_INF, jnp.float32))
+
+    return jax.lax.switch(mode, [run(False), run(True), skip])
+
+
+def _ring_flash_fwd_scan(q, k, v, axis_name, causal, sm_scale,
+                         interpret):
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, j):
+        acc, lse_acc, k_cur, v_cur = carry
+        src = (idx - j) % n
+        if causal:
+            mode = jnp.where(src == idx, 1, jnp.where(src < idx, 0, 2))
+        else:
+            mode = jnp.zeros((), jnp.int32)
+        o_j, lse_j = _ring_flash_step_fwd(q, k_cur, v_cur, mode,
+                                          sm_scale, interpret)
+        a = lse_acc[:, :, 0, :sq]                      # (b, h, sq) base-2
+        bj = lse_j[:, :, 0, :sq]
+        new = jnp.logaddexp2(a, bj)
+        w_old = jnp.exp2(a - new)[..., None]
+        w_new = jnp.exp2(bj - new)[..., None]
+        acc = acc * w_old + o_j.astype(jnp.float32) * w_new
+        lse_full = jnp.broadcast_to(new[:, :, None, :],
+                                    lse_acc.shape)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (acc, lse_full, k_nxt, v_nxt), None
+
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    lse0 = jnp.full((b, h, 8, sq), _NEG_INF, jnp.float32)
+    (acc, lse, _, _), _ = jax.lax.scan(
+        step, (acc0, lse0, k, v), jnp.arange(n))
+    return acc.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name, causal, sm_scale, interpret):
+    out, _ = _ring_flash_fwd_scan(q, k, v, axis_name, causal, sm_scale,
+                                  interpret)
+    return out
+
+
+def _ring_flash_fwd_rule(q, k, v, axis_name, causal, sm_scale,
+                         interpret):
+    out, lse = _ring_flash_fwd_scan(q, k, v, axis_name, causal, sm_scale,
+                                    interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd_rule(axis_name, causal, sm_scale, interpret, res, g):
+    from paddle_tpu.kernels.flash_attention import _flash_bwd_pallas
+    q, k, v, o, lse = res
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    bq = min(_RING_BQ, q.shape[2])
+    bk = min(_RING_BK, k.shape[2])
+
+    def one(mode, k_cur, v_cur):
+        def run(cflag):
+            def f():
+                return _flash_bwd_pallas(q, k_cur, v_cur, o, lse, g,
+                                         cflag, sm_scale, block_q=bq,
+                                         block_k=bk, interpret=interpret)
+            return f
+
+        def skip():
+            return (jnp.zeros_like(q), jnp.zeros_like(k_cur),
+                    jnp.zeros_like(v_cur))
+
+        return jax.lax.switch(mode, [run(False), run(True), skip])
+
+    def step(carry, j):
+        dq_acc, k_cur, v_cur, dk_acc, dv_acc = carry
+        src = (idx - j) % n
+        if causal:
+            mode = jnp.where(src == idx, 1, jnp.where(src < idx, 0, 2))
+        else:
+            mode = jnp.zeros((), jnp.int32)
+        dq_j, dk_j, dv_j = one(mode, k_cur, v_cur)
+        dq_acc = dq_acc + dq_j.astype(jnp.float32)
+        dk_acc = dk_acc + dk_j.astype(jnp.float32)
+        dv_acc = dv_acc + dv_j.astype(jnp.float32)
+        # rotate the shard AND its grad accumulator together: after the
+        # final rotation (n total) both are back at the owner
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+        return (dq_acc, k_cur, v_cur, dk_acc, dv_acc), None
+
+    z = jnp.zeros(q.shape, jnp.float32)
+    zk = jnp.zeros(k.shape, jnp.float32)
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        step, (z, k, v, zk, jnp.zeros(v.shape, jnp.float32)),
+        jnp.arange(n))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)
+
+
+def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None,
+                         use_flash=None, interpret=False):
     """Local view: q,k,v (B, H, S_local, D), seq dim sharded over
-    `axis_name`. Returns local (B, H, S_local, D)."""
+    `axis_name`. Returns local (B, H, S_local, D). On TPU (or with
+    interpret=True) block-aligned shapes take the flash-kernel ring;
+    others keep the jnp online-softmax merge."""
+    import os
+    from paddle_tpu.kernels.flash_attention import _on_tpu
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if use_flash is None:
+        use_flash = ((_on_tpu() or interpret)
+                     and os.environ.get("PADDLE_TPU_RING_FLASH",
+                                        "1") != "0"
+                     and _ring_flash_shapes_ok(q, k))
+    if use_flash:
+        return _ring_flash(q, k, v, axis_name, causal, sm_scale,
+                           interpret)
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     s_loc = q.shape[2]

@@ -381,7 +381,8 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
                               qkv_out_scale=None, qkv_bias=None,
                               out_shift=None, out_smooth=None,
                               rope_emb=None, mask=None, tgt_mask=None,
-                              max_seq_len=-1, block_size=64, **kw):
+                              max_seq_len=-1, block_size=64,
+                              padded_layout=False, **kw):
     """Paged (block) KV-cache attention (reference: incubate/nn/functional/
     block_multihead_attention.py; CUDA kernel
     block_multi_head_attention_kernel.cu). TPU-native reimplementation:
@@ -427,18 +428,58 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     qkv_a = _a(qkv)
     kc = _a(key_cache)
     vc = _a(value_cache)
-    # EAGER-ONLY: the page/token bookkeeping below runs on host numpy
-    # (the reference's serving launcher drives this op eagerly too);
-    # under jit the seq-lens are tracers and there is no graph to build
+    # Under jit (traced seq-lens), the ragged host-packed token layout
+    # has no static shape — but the PADDED layout does: pass qkv as
+    # (batch * s_pad, 3*h*d) with per-row validity in
+    # seq_lens_this_time, and the op routes through the engine's
+    # jit-traceable paged core (inference/paged.py, r5 — invalid rows'
+    # writes go to the trash page). s_pad = tok // batch must divide.
     if any(isinstance(_a(t), jax.core.Tracer)
-           for t in (block_tables, seq_lens_encoder, seq_lens_decoder,
-                     seq_lens_this_time)):
-        raise TypeError(
-            "block_multihead_attention is eager-only: its paged-KV "
-            "bookkeeping (block tables, sequence lengths) runs on the "
-            "host and cannot be traced under jit/to_static. Call it "
-            "outside the compiled function (serving loops drive it "
-            "eagerly, like the reference).")
+           for t in (qkv, block_tables, seq_lens_encoder,
+                     seq_lens_decoder, seq_lens_this_time)):
+        if not padded_layout:
+            raise TypeError(
+                "block_multihead_attention under jit requires the PADDED "
+                "token layout, opted into EXPLICITLY: pass "
+                "padded_layout=True with qkv rows = batch x s_pad and "
+                "real counts in seq_lens_this_time. (The eager ragged "
+                "host-packed layout cannot be distinguished from padded "
+                "under tracing — a silent misread would corrupt the "
+                "cache.)")
+        if mask is not None:
+            raise NotImplementedError(
+                "block_multihead_attention under jit does not apply "
+                "`mask` (the eager path does); fold the mask into the "
+                "compiled caller or drop it")
+        from paddle_tpu.inference.paged import (PagedState,
+                                                paged_attention_update)
+        bsz = int(seq_lens_this_time.shape[0]) \
+            if hasattr(seq_lens_this_time, "shape") \
+            else len(seq_lens_this_time)
+        tok = qkv_a.shape[0]
+        if tok % bsz:
+            raise TypeError(
+                f"padded_layout: qkv rows ({tok}) must be batch ({bsz}) "
+                "x s_pad")
+        s_pad = tok // bsz
+        mbk, hk_, bs_, d_ = kc.shape
+        hq_ = qkv_a.shape[-1] // d_ - 2 * hk_
+        if qkv_bias is not None:
+            qkv_a = qkv_a + _a(qkv_bias)
+        q_, k_, v_ = jnp.split(
+            qkv_a.reshape(bsz, s_pad, -1),
+            [hq_ * d_, (hq_ + hk_) * d_], axis=-1)
+        state = PagedState(
+            _a(block_tables),
+            jnp.reshape(_a(seq_lens_decoder), (-1,)).astype(jnp.int32),
+            jnp.reshape(_a(seq_lens_this_time), (-1,)).astype(jnp.int32))
+        out, (kc2, vc2) = paged_attention_update(
+            q_.reshape(bsz, s_pad, hq_, d_),
+            k_.reshape(bsz, s_pad, hk_, d_),
+            v_.reshape(bsz, s_pad, hk_, d_),
+            (kc, vc), state)
+        out2 = _T(out._value.reshape(tok, hq_ * d_).astype(qkv_a.dtype))
+        return out2, _T(qkv_a), _T(kc2._value), _T(vc2._value)
     bt = _np.asarray(_a(block_tables))
     enc = _np.asarray(_a(seq_lens_encoder)).reshape(-1)
     dec = _np.asarray(_a(seq_lens_decoder)).reshape(-1)

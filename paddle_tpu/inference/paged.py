@@ -63,13 +63,14 @@ class PagedState(NamedTuple):
     as `cache_index` (a NamedTuple is a jax pytree, so it traces).
 
     block_tables: (b, max_pages) int32 — logical page j of slot i lives
-        in physical page block_tables[i, j]; 0 is the reserved trash
-        page (unallocated entries point there).
+        in physical page block_tables[i, j]; the engine keeps 0 as a
+        never-allocated page so unallocated entries gather zeros
+        (writes for invalid rows are DROPPED, never routed anywhere).
     lens: (b,) int32 — tokens already committed to the cache per slot.
     n_valid: (b,) int32 — how many of this call's `s` new tokens are
         real per slot (prefill: the unpadded prompt length; decode: 1
         for live slots, 0 for finished/empty ones — their writes are
-        routed to the trash page).
+        dropped).
     """
     block_tables: jnp.ndarray
     lens: jnp.ndarray
@@ -105,14 +106,19 @@ def paged_attention_update(q, k, v, cache, state: PagedState):
     pos = lens[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # (b,s)
     valid = jnp.arange(s, dtype=jnp.int32)[None, :] < n_valid[:, None]
     logical = pos // page_size
-    phys = jnp.take_along_axis(bt, logical, axis=1)          # (b, s)
-    phys = jnp.where(valid, phys, 0)                         # 0 = trash
+    phys = jnp.take_along_axis(
+        bt, jnp.clip(logical, 0, bt.shape[1] - 1), axis=1)   # (b, s)
+    # invalid rows: point past the pool and DROP the write (r5 review:
+    # routing them to page 0 corrupted callers whose block tables
+    # legitimately allocate page 0 — the public op has no trash-page
+    # reservation; the engine's page-0 convention is gather-only)
+    phys = jnp.where(valid, phys, kp.shape[0])
     off = pos % page_size
     flat = lambda a: a.reshape(b * s)                        # noqa: E731
     kp = kp.at[flat(phys), :, flat(off), :].set(
-        k.reshape(b * s, hk, d).astype(kp.dtype))
+        k.reshape(b * s, hk, d).astype(kp.dtype), mode="drop")
     vp = vp.at[flat(phys), :, flat(off), :].set(
-        v.reshape(b * s, hk, d).astype(vp.dtype))
+        v.reshape(b * s, hk, d).astype(vp.dtype), mode="drop")
 
     # -- gather each slot's window and attend -------------------------
     # window column c IS logical position c (page j holds positions

@@ -87,3 +87,79 @@ def test_trainer_with_ring_cp_matches_eager():
                                         context_parallel="ring"))
     loss = tr.step({"input_ids": ids, "labels": ids})
     np.testing.assert_allclose(float(ref.numpy()), loss, rtol=1e-5)
+
+
+# -- round 5: flash-kernel ring (lse-merged Pallas ring) --------------------
+
+def test_flash_ring_matches_jnp_ring_interpret():
+    """The r5 flash-kernel ring (per-shard Pallas flash + base-2 lse
+    merge, rotating-dkdv backward) must match the jnp online-softmax
+    ring in values AND grads — exercised in Pallas interpret mode on
+    the 4-shard CPU mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.distributed import context_parallel as cp
+
+    mesh = init_mesh({"sp": 4})
+    rng = np.random.RandomState(0)
+    b, s, h, d = 1, 128, 2, 16
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+
+    def run(use_flash):
+        def local(ql, kl, vl):
+            return cp.ring_attention_local(
+                ql, kl, vl, "sp", causal=True, use_flash=use_flash,
+                interpret=use_flash)
+        f = jax.shard_map(local, mesh=mesh.jax_mesh,
+                          in_specs=(P(None, None, "sp", None),) * 3,
+                          out_specs=P(None, None, "sp", None),
+                          check_vma=False)
+
+        def loss(q_, k_, v_):
+            return jnp.sum(f(q_, k_, v_) ** 2)
+        return jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    v0, g0 = run(False)
+    v1, g1 = run(True)
+    np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5)
+    for a, bb in zip(g0, g1):
+        np.testing.assert_allclose(np.asarray(bb), np.asarray(a),
+                                   rtol=2e-4, atol=1e-5)
+
+
+def test_flash_ring_noncausal_and_fallback_gate():
+    """causal=False takes every shard unmasked; odd shapes fall back to
+    the jnp path (the gate, not an error)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.distributed import context_parallel as cp
+
+    mesh = init_mesh({"sp": 2})
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 2, 64, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, 64, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, 64, 16), jnp.float32)
+
+    def run(use_flash):
+        def local(ql, kl, vl):
+            return cp.ring_attention_local(
+                ql, kl, vl, "sp", causal=False, use_flash=use_flash,
+                interpret=use_flash)
+        f = jax.shard_map(local, mesh=mesh.jax_mesh,
+                          in_specs=(P(None, None, "sp", None),) * 3,
+                          out_specs=P(None, None, "sp", None),
+                          check_vma=False)
+        return f(q, k, v)
+
+    np.testing.assert_allclose(np.asarray(run(True)),
+                               np.asarray(run(False)), rtol=2e-4,
+                               atol=1e-5)
+    # gate: d not multiple of 8 -> jnp path (no crash)
+    assert not cp._ring_flash_shapes_ok(
+        jnp.zeros((1, 2, 64, 12)), jnp.zeros((1, 2, 64, 12)))

@@ -301,3 +301,78 @@ def test_fused_layers_guardrails():
     assert fl.weight.shape == [3, 6]
     y = fl(paddle.to_tensor(np.ones((2, 6), np.float32)))
     assert y.shape == [2, 3]
+
+
+def test_block_multihead_attention_jit_padded_layout():
+    """r5: the op now traces under jit in the PADDED token layout,
+    routing through the paged serving core — results match the eager
+    (host-bookkeeping) path for mixed ragged prefill rows."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu
+    from paddle_tpu.incubate.nn import functional as IF
+
+    rng = np.random.RandomState(0)
+    bsz, s_pad, hq, hk, d, bs, nblocks, mp = 2, 4, 4, 2, 8, 4, 9, 3
+    this = np.array([4, 2], np.int32)            # ragged prefill rows
+    dec = np.zeros(bsz, np.int32)
+    enc = this.copy()
+    bt = np.array([[1, 2, 3], [4, 5, 6]], np.int32)
+    kc = np.zeros((nblocks, hk, bs, d), np.float32)
+    vc = np.zeros((nblocks, hk, bs, d), np.float32)
+    width = (hq + 2 * hk) * d
+
+    # eager oracle uses the packed ragged layout
+    packed = rng.randn(int(this.sum()), width).astype(np.float32)
+    e_out, _, e_kc, e_vc = IF.block_multihead_attention(
+        paddle_tpu.to_tensor(packed), paddle_tpu.to_tensor(kc),
+        paddle_tpu.to_tensor(vc), paddle_tpu.to_tensor(enc),
+        paddle_tpu.to_tensor(dec), paddle_tpu.to_tensor(this),
+        block_tables=paddle_tpu.to_tensor(bt), block_size=bs)
+
+    # jit path uses the padded layout: rows beyond n_valid are junk
+    padded = np.zeros((bsz * s_pad, width), np.float32)
+    padded[0:4] = packed[0:4]
+    padded[4:6] = packed[4:6]
+
+    @jax.jit
+    def step(qkv, kc, vc, enc, dec, this, bt):
+        out, _, kc2, vc2 = IF.block_multihead_attention(
+            qkv, kc, vc, enc, dec, this, block_tables=bt, block_size=bs,
+            padded_layout=True)
+        return out, kc2, vc2
+
+    j_out, j_kc, j_vc = step(jnp.asarray(padded), jnp.asarray(kc),
+                             jnp.asarray(vc), jnp.asarray(enc),
+                             jnp.asarray(dec), jnp.asarray(this),
+                             jnp.asarray(bt))
+    j_out = np.asarray(j_out).reshape(bsz, s_pad, hq * d)
+    e_out = np.asarray(e_out.numpy() if hasattr(e_out, "numpy")
+                       else e_out)
+    np.testing.assert_allclose(j_out[0, :4], e_out[0:4], rtol=2e-5,
+                               atol=2e-5)
+    np.testing.assert_allclose(j_out[1, :2], e_out[4:6], rtol=2e-5,
+                               atol=2e-5)
+    # without the explicit opt-in, tracing still raises loudly
+    import pytest as _pytest
+    with _pytest.raises(TypeError, match="padded_layout"):
+        jax.jit(lambda q: IF.block_multihead_attention(
+            q, jnp.asarray(kc), jnp.asarray(vc), jnp.asarray(enc),
+            jnp.asarray(dec), jnp.asarray(this),
+            block_tables=jnp.asarray(bt),
+            block_size=bs))(jnp.asarray(padded))
+    # page 0 in a caller's block table is safe: padding writes DROP
+    bt0 = np.array([[0, 1, 2], [3, 4, 5]], np.int32)
+    j2_out, j2_kc, _ = step(jnp.asarray(padded), jnp.asarray(kc),
+                            jnp.asarray(vc), jnp.asarray(enc),
+                            jnp.asarray(dec), jnp.asarray(this),
+                            jnp.asarray(bt0))
+    row1_pad = np.asarray(j2_kc)[bt0[1, 0], :, this[1]:, :]
+    np.testing.assert_array_equal(row1_pad, 0)
+    # cache contents written identically (valid positions)
+    for row, n in enumerate(this):
+        for pos in range(n):
+            np.testing.assert_allclose(
+                np.asarray(j_kc)[bt[row, pos // bs], :, pos % bs],
+                np.asarray(e_kc.numpy())[bt[row, pos // bs], :, pos % bs],
+                rtol=2e-5)

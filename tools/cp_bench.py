@@ -24,15 +24,38 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def _bench(fn, *args, iters=10):
+def _bench(fn, *args):
+    """Per-call timing is unreliable through the axon tunnel (dispatch
+    is async and block_until_ready is a proxy no-op) — so: chain N calls
+    inside ONE jit program with a data dependency, FETCH a scalar to
+    close the chain (the bench.py protocol), and difference two window
+    sizes to cancel the constant tunnel RTT."""
+    import functools
     import jax
-    out = fn(*args)
-    jax.block_until_ready(out)           # compile
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters * 1e3
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def chained(q, k, v, n):
+        def body(qq, _):
+            out = fn(qq, k, v)
+            return out.astype(qq.dtype), None
+        out, _ = jax.lax.scan(body, q, None, length=n)
+        return jnp.sum(out.astype(jnp.float32))
+
+    q, k, v = args
+    n_lo, n_hi = 8, 40
+    float(chained(q, k, v, n_lo))               # compile both
+    float(chained(q, k, v, n_hi))
+
+    def window(n):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(chained(q, k, v, n))          # scalar fetch = sync
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return (window(n_hi) - window(n_lo)) / (n_hi - n_lo) * 1e3
 
 
 def main():
@@ -55,18 +78,19 @@ def main():
         k = jnp.asarray(rng.randn(1, S, H, D), jnp.bfloat16)
         v = jnp.asarray(rng.randn(1, S, H, D), jnp.bfloat16)
 
-        flash = jax.jit(lambda a, b, c: flash_attention_bhsd(
-            jnp.swapaxes(a, 1, 2), jnp.swapaxes(b, 1, 2),
-            jnp.swapaxes(c, 1, 2), causal=True))
-        ring = jax.jit(lambda a, b, c: ring_attention(
-            a, b, c, mesh=mesh.jax_mesh, axis="sp", causal=True))
+        flash = lambda a, b, c: jnp.swapaxes(      # noqa: E731
+            flash_attention_bhsd(
+                jnp.swapaxes(a, 1, 2), jnp.swapaxes(b, 1, 2),
+                jnp.swapaxes(c, 1, 2), causal=True), 1, 2)
+        ring = lambda a, b, c: ring_attention(     # noqa: E731
+            a, b, c, mesh=mesh.jax_mesh, axis="sp", causal=True)
         t_flash = _bench(flash, q, k, v)
         t_ring = _bench(ring, q, k, v)
         print(f"S={S} H={H} D={D} bf16 single chip: flash "
               f"{t_flash:.2f} ms | ring(sp=1 degenerate) {t_ring:.2f} ms "
               f"| ratio {t_ring / t_flash:.3f}")
-        uly = jax.jit(lambda a, b, c: ulysses_attention(
-            a, b, c, mesh=mesh.jax_mesh, axis="sp", causal=True))
+        uly = lambda a, b, c: ulysses_attention(   # noqa: E731
+            a, b, c, mesh=mesh.jax_mesh, axis="sp", causal=True)
         t_uly = _bench(uly, q, k, v)
         print(f"  ulysses(sp=1 degenerate) {t_uly:.2f} ms "
               f"| ratio {t_uly / t_flash:.3f}")
@@ -82,9 +106,9 @@ def main():
         q = jnp.asarray(rng.randn(1, S, 8, 32), jnp.float32)
         k = jnp.asarray(rng.randn(1, S, 8, 32), jnp.float32)
         v = jnp.asarray(rng.randn(1, S, 8, 32), jnp.float32)
-        ring = jax.jit(lambda a, b, c, m=mesh: ring_attention(
-            a, b, c, mesh=m.jax_mesh, axis="sp", causal=True))
-        t = _bench(ring, q, k, v, iters=5)
+        ring = lambda a, b, c, m=mesh: ring_attention(   # noqa: E731
+            a, b, c, mesh=m.jax_mesh, axis="sp", causal=True)
+        t = _bench(ring, q, k, v)
         print(f"sp={n}: ring {t:.2f} ms (S={S} local {S // n})")
 
 

@@ -79,7 +79,8 @@ print(f"continuous batching: {n_req} reqs x {new} tok (b8 slots, "
       f"{total / dt:.1f} tok/s aggregate | ticks={eng.stats['ticks']} "
       f"prefills={eng.stats['prefills']} | prefill {pf:.2f}s, decode "
       f"ticks {tk:.2f}s -> decode-phase "
-      f"{total / tk:.1f} tok/s")
+      f"{(total - n_req) / tk:.1f} tok/s "
+      f"({total - n_req} tick tokens)")
 
 # heterogeneous budgets: half the requests are short (16 tokens), so
 # slots retire early and refill mid-decode — the admission-latency
