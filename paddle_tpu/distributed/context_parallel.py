@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.kernels.flash_attention import (
-    _NEG_INF, _chunked_attention, flash_attention_bhsd)
+    _LSE_ROWS, _NEG_INF, _chunked_attention, flash_attention_bhsd)
 
 
 # ---------------------------------------------------------------------------
@@ -92,7 +92,7 @@ def _ring_flash_step_fwd(q, k_cur, v_cur, mode, sm_scale, interpret):
     def skip():
         b, h, sq, d = q.shape
         return (jnp.zeros((b, h, sq, d), q.dtype),
-                jnp.full((b, h, 8, sq), _NEG_INF, jnp.float32))
+                jnp.full((b, h, _LSE_ROWS, sq), _NEG_INF, jnp.float32))
 
     return jax.lax.switch(mode, [run(False), run(True), skip])
 
@@ -126,7 +126,7 @@ def _ring_flash_fwd_scan(q, k, v, axis_name, causal, sm_scale,
         return (acc, lse_full, k_nxt, v_nxt), None
 
     acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
-    lse0 = jnp.full((b, h, 8, sq), _NEG_INF, jnp.float32)
+    lse0 = jnp.full((b, h, _LSE_ROWS, sq), _NEG_INF, jnp.float32)
     (acc, lse, _, _), _ = jax.lax.scan(
         step, (acc0, lse0, k, v), jnp.arange(n))
     return acc.astype(q.dtype), lse

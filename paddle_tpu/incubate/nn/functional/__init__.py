@@ -434,9 +434,12 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
     # seq_lens_this_time, and the op routes through the engine's
     # jit-traceable paged core (inference/paged.py, r5 — invalid rows'
     # writes go to the trash page). s_pad = tok // batch must divide.
-    if any(isinstance(_a(t), jax.core.Tracer)
-           for t in (qkv, block_tables, seq_lens_encoder,
-                     seq_lens_decoder, seq_lens_this_time)):
+    meta_traced = any(isinstance(_a(t), jax.core.Tracer)
+                      for t in (block_tables, seq_lens_encoder,
+                                seq_lens_decoder, seq_lens_this_time))
+    # traced qkv with CONCRETE metadata keeps the ragged path: its index
+    # math is host-side, only the value math traces (pre-r5 behavior)
+    if padded_layout or meta_traced:
         if not padded_layout:
             raise TypeError(
                 "block_multihead_attention under jit requires the PADDED "

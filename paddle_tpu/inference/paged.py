@@ -340,6 +340,7 @@ class PagedKVEngine:
         with self._lock:
             pending, self._pending = self._pending, []
         requeue = []
+        admitted = []
         for req in pending:
             if req.cancelled.is_set():
                 self.stats["cancelled"] += 1
@@ -355,48 +356,79 @@ class PagedKVEngine:
                 requeue.append(req)
                 continue
             self._reserved_unalloc += req.pages_needed
-            self._prefill(idx, req)
+            admitted.append((idx, req))
+            # reserve the slot immediately so the next pending request
+            # can't claim it while we batch this tick's prefills
+            self._slots[idx] = _Slot(req, lens=0, tok=0)
+            self._alloc_pages(idx, -(-req.prompt.size // self.page_size))
             self.stats["admitted"] += 1
+        # batch same-bucket prefills into ONE program call (an admission
+        # storm used to pay one ~full prefill latency per request)
+        groups = {}
+        for idx, req in admitted:
+            groups.setdefault(self._bucket(req.prompt.size),
+                              []).append((idx, req))
+        for ppad, grp in groups.items():
+            self._prefill_group(ppad, grp)
         if requeue:
             with self._lock:
                 self._pending = requeue + self._pending
 
     def _prefill(self, slot_idx, req):
+        """Single-request prefill (kept for direct callers/tests):
+        delegates to the group path."""
+        self._slots[slot_idx] = _Slot(req, lens=0, tok=0)
+        self._alloc_pages(slot_idx,
+                          -(-int(req.prompt.size) // self.page_size))
+        self._prefill_group(self._bucket(int(req.prompt.size)),
+                            [(slot_idx, req)])
+
+    def _prefill_group(self, ppad, grp):
+        """Prefill all (slot, request) pairs of one padded-length bucket
+        in ONE program call. Two static batch widths per bucket — 1 for
+        the steady trickle, max_slots (padded with n_valid=0 rows whose
+        writes drop) for admission storms — so the compile count stays
+        at two per bucket while a storm pays one prefill latency
+        total."""
         import time as _time
         t0 = _time.perf_counter()
-        p = int(req.prompt.size)
-        slot = _Slot(req, lens=0, tok=0)
-        self._slots[slot_idx] = slot
-        self._alloc_pages(slot_idx, -(-p // self.page_size))
-        ppad = self._bucket(p)
-        fn = self._prefill_fn(ppad)
-        ids = np.zeros((1, ppad), np.int32)
-        ids[0, :p] = req.prompt
+        bw = 1 if len(grp) == 1 else self.max_slots
+        fn = self._prefill_fn(ppad, bw)
+        ids = np.zeros((bw, ppad), np.int32)
+        nv = np.zeros(bw, np.int32)
+        bt = np.zeros((bw, self.max_pages_per_slot), np.int32)
+        for row, (idx, req) in enumerate(grp):
+            pn = int(req.prompt.size)
+            ids[row, :pn] = req.prompt
+            nv[row] = pn
+            bt[row] = self._bt[idx]
         last_logits, flat = fn(
-            jnp.asarray(ids), jnp.int32(p),
-            jnp.asarray(self._bt[slot_idx:slot_idx + 1]),
+            jnp.asarray(ids), jnp.asarray(nv), jnp.asarray(bt),
             [a for kv in self.pools for a in kv])
         self.pools = [(flat[2 * i], flat[2 * i + 1])
                       for i in range(len(self.pools))]
-        slot.lens = p
-        # first generated token: host-side select over the fetched last
-        # row (one (vocab,) fetch per request; mirrors generation.py's
-        # host-noise sampling contract)
-        logits = np.asarray(last_logits)
-        if req.do_sample:
-            from paddle_tpu.models.generation import _np_process_logits
-            rng = np.random.default_rng(
-                np.random.SeedSequence([self._seed, req.sample_index]))
-            x = _np_process_logits(logits[None, :], req.temperature,
-                                   req.top_k, req.top_p)[0]
-            u = rng.uniform(1e-9, 1.0, size=x.shape).astype(np.float32)
-            tok = int(np.argmax(x - np.log(-np.log(u))))
-        else:
-            tok = int(np.argmax(logits))
-        slot.tok = tok
-        self.stats["prefills"] += 1
+        logits_np = np.asarray(last_logits)              # (bw, vocab)
+        self.stats["prefills"] += len(grp)
         self.stats["prefill_s"] += _time.perf_counter() - t0
-        self._accept(slot_idx, [tok])
+        for row, (idx, req) in enumerate(grp):
+            slot = self._slots[idx]
+            slot.lens = int(req.prompt.size)
+            logits = logits_np[row]
+            if req.do_sample:
+                from paddle_tpu.models.generation import \
+                    _np_process_logits
+                rng = np.random.default_rng(
+                    np.random.SeedSequence([self._seed,
+                                            req.sample_index]))
+                x = _np_process_logits(logits[None, :], req.temperature,
+                                       req.top_k, req.top_p)[0]
+                u = rng.uniform(1e-9, 1.0,
+                                size=x.shape).astype(np.float32)
+                tok = int(np.argmax(x - np.log(-np.log(u))))
+            else:
+                tok = int(np.argmax(logits))
+            slot.tok = tok
+            self._accept(idx, [tok])
 
     def _accept(self, slot_idx, toks):
         """Feed accepted tokens to the request; retire the slot when the
@@ -635,22 +667,24 @@ class PagedKVEngine:
         return [(Tensor(flat[2 * i]), Tensor(flat[2 * i + 1]))
                 for i in range(len(self.pools))]
 
-    def _prefill_fn(self, ppad):
-        key = ("prefill", ppad)
+    def _prefill_fn(self, ppad, bw=1):
+        key = ("prefill", ppad, bw)
         if key in self._programs:
             return self._programs[key]
         model = self.model
 
-        def run(ids, n_valid, bt_row, pool_flat):
-            state = PagedState(bt_row, jnp.zeros((1,), jnp.int32),
-                               jnp.reshape(n_valid, (1,)))
-            pos = jnp.arange(ppad, dtype=jnp.int32)[None, :]
+        def run(ids, n_valid, bt_rows, pool_flat):
+            state = PagedState(bt_rows, jnp.zeros((bw,), jnp.int32),
+                               n_valid)
+            pos = jnp.broadcast_to(
+                jnp.arange(ppad, dtype=jnp.int32)[None, :], (bw, ppad))
             logits, new_caches = model(
                 Tensor(ids), caches=self._layer_caches(pool_flat),
                 position_ids=Tensor(pos), cache_index=state)
-            lv = _val(logits)
-            last = jax.lax.dynamic_index_in_dim(
-                lv, n_valid - 1, axis=1, keepdims=False)[0]
+            lv = _val(logits)                            # (bw, ppad, v)
+            idxs = jnp.clip(n_valid - 1, 0, ppad - 1)
+            last = jnp.take_along_axis(
+                lv, idxs[:, None, None], axis=1)[:, 0]   # (bw, v)
             return last, [_val(a) for kv in new_caches for a in kv]
 
         fn = jax.jit(run)

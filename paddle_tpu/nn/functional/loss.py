@@ -217,8 +217,12 @@ def binary_cross_entropy(input, label, weight=None, reduction="mean",
 
 @defop("bce_with_logits", amp_policy="black")
 def _bce_logits(logit, label, weight=None, pos_weight=None, reduction="mean"):
-    x = logit.astype(jnp.float32)
-    lab = label.astype(jnp.float32)
+    # PROMOTE to at least f32 (bf16/f16 upcast for stability) without
+    # downcasting f64 — forcing f32 made the x64 numeric-grad check
+    # noise-limited (the analytic grad was always exact)
+    acc = jnp.promote_types(logit.dtype, jnp.float32)
+    x = logit.astype(acc)
+    lab = label.astype(acc)
     max_val = jnp.clip(-x, 0, None)
     if pos_weight is not None:
         log_w = (pos_weight - 1) * lab + 1

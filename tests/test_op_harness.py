@@ -829,7 +829,11 @@ def _jaxify(args):
 
 
 def _rng_for(name):
-    return np.random.RandomState(abs(hash(name)) % (2 ** 31))
+    # zlib.crc32, NOT hash(): python string hashing is randomized per
+    # process (PYTHONHASHSEED), which made spec inputs differ run to
+    # run — test_numeric_grad[bce_with_logits] flaked on the draws
+    import zlib
+    return np.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
 
 
 # ---------------------------------------------------------------------------
@@ -1640,6 +1644,10 @@ WAIVERS: dict[str, str] = {
                "tests/test_moe.py",
     "moe_mlp_dropless": "dense-oracle parity (the zero-drop proof) + "
                         "grad-flow suite in tests/test_moe.py",
+    "moe_mlp_dropless_ep": "needs a mesh (shard_map over 'ep'): "
+                           "single-shard parity, imbalance no-drop, "
+                           "grad-flow and trainer suites in "
+                           "tests/test_moe.py",
     "flash_attention_op": "full parity/grad suite in "
                           "tests/test_flash_attention.py",
     "rnnt_loss": "lattice-loss parity suite in tests/test_nn_extras.py",

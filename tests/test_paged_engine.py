@@ -347,3 +347,24 @@ def test_qwen2_moe_serves_through_paged_engine():
     eng.run_until_idle()
     assert ra.result() == solo["a"]
     assert rb.result() == solo["b"]
+
+
+def test_admission_storm_batched_prefill_parity():
+    """Several same-bucket requests admitted in ONE tick prefill as one
+    batched program call (r5 storm path) — tokens still exactly match
+    solo runs, and the prefill program count shows the batching."""
+    model = _model()
+    prompts = [[5, 9, 2], [17, 3, 11], [40, 41, 2], [7, 8, 9]]
+    solo = [np.asarray(generate(model, np.asarray([p], np.int32),
+                                max_new_tokens=5))[0].tolist()[len(p):]
+            for p in prompts]
+    eng = PagedKVEngine(model, max_slots=4, page_size=4, num_pages=40,
+                        max_pages_per_slot=6, steps_per_tick=3)
+    reqs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+    eng.run_until_idle()
+    for r, want in zip(reqs, solo):
+        assert r.result() == want
+    assert eng.stats["prefills"] == 4
+    # all four prefilled through the ONE batched (bw=max_slots) program
+    assert ("prefill", 8, 4) in eng._programs
+    assert ("prefill", 8, 1) not in eng._programs
