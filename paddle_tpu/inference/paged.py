@@ -242,7 +242,7 @@ class PagedKVEngine:
 
     def __init__(self, model, *, max_slots=4, page_size=16, num_pages=64,
                  max_pages_per_slot=None, steps_per_tick=4, seed=0,
-                 dtype=None):
+                 prefill_chunk=None, dtype=None):
         cfg = model.config
         self.model = model
         self.max_slots = int(max_slots)
@@ -252,6 +252,12 @@ class PagedKVEngine:
             max_pages_per_slot
             or min(num_pages - 1, max(1, (num_pages - 1) // max_slots)))
         self.steps_per_tick = int(steps_per_tick)
+        # prompts longer than this prefill in fixed-size chunks through
+        # ONE reused program (chunked prefill — the paged core appends
+        # at lens>0) instead of compiling a program per padded length.
+        # None = always use the bucketed whole-prompt path.
+        self.prefill_chunk = (int(prefill_chunk) if prefill_chunk
+                              else None)
         n_kv = getattr(cfg, "num_key_value_heads", None) \
             or cfg.num_attention_heads
         hd = getattr(cfg, "head_dim", None) \
@@ -365,9 +371,16 @@ class PagedKVEngine:
         # batch same-bucket prefills into ONE program call (an admission
         # storm used to pay one ~full prefill latency per request)
         groups = {}
+        long_grp = []
         for idx, req in admitted:
+            if self.prefill_chunk and \
+                    req.prompt.size > self.prefill_chunk:
+                long_grp.append((idx, req))
+                continue
             groups.setdefault(self._bucket(req.prompt.size),
                               []).append((idx, req))
+        if long_grp:
+            self._prefill_chunked_group(long_grp)
         for ppad, grp in groups.items():
             self._prefill_group(ppad, grp)
         if requeue:
@@ -382,6 +395,94 @@ class PagedKVEngine:
                           -(-int(req.prompt.size) // self.page_size))
         self._prefill_group(self._bucket(int(req.prompt.size)),
                             [(slot_idx, req)])
+
+    def _first_token(self, logits, req):
+        """Select a request's first token from its prefill logits —
+        host-side, seeded from (engine seed, submission index) so
+        same-seed engines replay identically."""
+        if req.do_sample:
+            from paddle_tpu.models.generation import _np_process_logits
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self._seed, req.sample_index]))
+            x = _np_process_logits(logits[None, :], req.temperature,
+                                   req.top_k, req.top_p)[0]
+            u = rng.uniform(1e-9, 1.0, size=x.shape).astype(np.float32)
+            return int(np.argmax(x - np.log(-np.log(u))))
+        return int(np.argmax(logits))
+
+    def _prefill_chunked(self, slot_idx, req):
+        self._prefill_chunked_group([(slot_idx, req)])
+
+    def _prefill_chunked_group(self, grp):
+        """Feed long prompts through the fixed-size chunk program in
+        LOCKSTEP rounds — the paged core appends at lens>0 (the
+        reference's chunked-prefill contract, seq_lens_decoder > 0),
+        and a storm of long prompts pays ceil(max_len/chunk) program
+        calls total instead of one full chunk loop per request.
+        Exhausted rows ride later rounds with n_valid=0 (writes drop)."""
+        import time as _time
+        t0 = _time.perf_counter()
+        chunk = self.prefill_chunk
+        bw = 1 if len(grp) == 1 else self.max_slots
+        fn = self._prefill_chunk_fn(chunk, bw)
+        done = np.zeros(bw, np.int32)              # consumed per row
+        plens = [int(req.prompt.size) for _, req in grp]
+        final_logits = [None] * len(grp)
+        while any(done[r] < plens[r] for r in range(len(grp))):
+            ids = np.zeros((bw, chunk), np.int32)
+            lens = np.zeros(bw, np.int32)
+            nv = np.zeros(bw, np.int32)
+            bt = np.zeros((bw, self.max_pages_per_slot), np.int32)
+            for r, (idx, req) in enumerate(grp):
+                take = min(chunk, plens[r] - int(done[r]))
+                if take <= 0:
+                    continue
+                ids[r, :take] = req.prompt[done[r]:done[r] + take]
+                lens[r] = done[r]
+                nv[r] = take
+                bt[r] = self._bt[idx]
+            last, flat = fn(jnp.asarray(ids), jnp.asarray(lens),
+                            jnp.asarray(nv), jnp.asarray(bt),
+                            [a for kv in self.pools for a in kv])
+            self.pools = [(flat[2 * i], flat[2 * i + 1])
+                          for i in range(len(self.pools))]
+            last_np = np.asarray(last)
+            for r in range(len(grp)):
+                if nv[r] > 0 and done[r] + nv[r] >= plens[r]:
+                    final_logits[r] = last_np[r]
+                done[r] += nv[r]
+        self.stats["prefills"] += len(grp)
+        self.stats["prefill_s"] += _time.perf_counter() - t0
+        for r, (idx, req) in enumerate(grp):
+            slot = self._slots[idx]
+            slot.lens = plens[r]
+            slot.tok = self._first_token(final_logits[r], req)
+            self._accept(idx, [slot.tok])
+
+    def _prefill_chunk_fn(self, chunk, bw=1):
+        key = ("prefill_chunk", chunk, bw)
+        if key in self._programs:
+            return self._programs[key]
+        model = self.model
+
+        def run(ids, lens, n_valid, bt_rows, pool_flat):
+            state = PagedState(bt_rows, lens, n_valid)
+            pos = lens[:, None] + jnp.arange(chunk,
+                                             dtype=jnp.int32)[None, :]
+            logits, new_caches = model(
+                Tensor(ids), caches=self._layer_caches(pool_flat),
+                position_ids=Tensor(pos), cache_index=state)
+            lv = _val(logits)                            # (bw, chunk, v)
+            idxs = jnp.clip(n_valid - 1, 0, chunk - 1)
+            last = jnp.take_along_axis(
+                lv, idxs[:, None, None], axis=1)[:, 0]   # (bw, v)
+            return last, [_val(a) for kv in new_caches for a in kv]
+
+        import jax as _jax
+        donate = () if _jax.default_backend() == "cpu" else (4,)
+        fn = jax.jit(run, donate_argnums=donate)
+        self._programs[key] = fn
+        return fn
 
     def _prefill_group(self, ppad, grp):
         """Prefill all (slot, request) pairs of one padded-length bucket
@@ -413,22 +514,8 @@ class PagedKVEngine:
         for row, (idx, req) in enumerate(grp):
             slot = self._slots[idx]
             slot.lens = int(req.prompt.size)
-            logits = logits_np[row]
-            if req.do_sample:
-                from paddle_tpu.models.generation import \
-                    _np_process_logits
-                rng = np.random.default_rng(
-                    np.random.SeedSequence([self._seed,
-                                            req.sample_index]))
-                x = _np_process_logits(logits[None, :], req.temperature,
-                                       req.top_k, req.top_p)[0]
-                u = rng.uniform(1e-9, 1.0,
-                                size=x.shape).astype(np.float32)
-                tok = int(np.argmax(x - np.log(-np.log(u))))
-            else:
-                tok = int(np.argmax(logits))
-            slot.tok = tok
-            self._accept(idx, [tok])
+            slot.tok = self._first_token(logits_np[row], req)
+            self._accept(idx, [slot.tok])
 
     def _accept(self, slot_idx, toks):
         """Feed accepted tokens to the request; retire the slot when the
@@ -687,7 +774,9 @@ class PagedKVEngine:
                 lv, idxs[:, None, None], axis=1)[:, 0]   # (bw, v)
             return last, [_val(a) for kv in new_caches for a in kv]
 
-        fn = jax.jit(run)
+        import jax as _jax
+        donate = () if _jax.default_backend() == "cpu" else (3,)
+        fn = jax.jit(run, donate_argnums=donate)
         self._programs[key] = fn
         return fn
 

@@ -368,3 +368,48 @@ def test_admission_storm_batched_prefill_parity():
     # all four prefilled through the ONE batched (bw=max_slots) program
     assert ("prefill", 8, 4) in eng._programs
     assert ("prefill", 8, 1) not in eng._programs
+
+
+def test_chunked_prefill_long_prompt_parity():
+    """prefill_chunk: a prompt longer than the chunk streams through
+    the ONE chunk-sized program (appending at lens>0 — the reference's
+    chunked-prefill contract); tokens exactly match the solo run, and
+    no whole-prompt bucket program is ever compiled."""
+    model = _model()
+    prompt = list(np.random.RandomState(3).randint(1, 90, 19))
+    solo = np.asarray(generate(model, np.asarray([prompt], np.int32),
+                               max_new_tokens=6))[0].tolist()[19:]
+    eng = PagedKVEngine(model, max_slots=2, page_size=4, num_pages=40,
+                        max_pages_per_slot=10, steps_per_tick=3,
+                        prefill_chunk=8)
+    r = eng.submit(prompt, max_new_tokens=6)
+    # a short co-traveller still uses the bucketed path
+    r2 = eng.submit([5, 9, 2], max_new_tokens=4)
+    eng.run_until_idle()
+    assert r.result() == solo
+    solo2 = np.asarray(generate(model, np.asarray([[5, 9, 2]], np.int32),
+                                max_new_tokens=4))[0].tolist()[3:]
+    assert r2.result() == solo2
+    keys = sorted(k for k in eng._programs if k[0].startswith("prefill"))
+    assert ("prefill_chunk", 8, 1) in keys
+    assert not any(k[0] == "prefill" and k[1] >= 19 for k in keys), keys
+
+
+def test_chunked_prefill_storm_lockstep():
+    """A storm of DIFFERENT-length long prompts prefills in lockstep
+    rounds through one (chunk, max_slots) program — token parity exact
+    for every request."""
+    model = _model()
+    rng = np.random.RandomState(11)
+    prompts = [list(rng.randint(1, 90, n)) for n in (13, 21, 9)]
+    solo = [np.asarray(generate(model, np.asarray([p], np.int32),
+                                max_new_tokens=4))[0].tolist()[len(p):]
+            for p in prompts]
+    eng = PagedKVEngine(model, max_slots=4, page_size=4, num_pages=60,
+                        max_pages_per_slot=8, steps_per_tick=3,
+                        prefill_chunk=8)
+    reqs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    eng.run_until_idle()
+    for r, want in zip(reqs, solo):
+        assert r.result() == want
+    assert ("prefill_chunk", 8, 4) in eng._programs
