@@ -69,24 +69,43 @@ _RING_BQ = 512   # pinned blocks: lax.switch branches must agree on the
 _RING_BK = 512   # padded lse width, so no per-branch autotune here
 
 
+def _ring_flash_plan(hq, hk, sq, sk, d):
+    """THE fold/flash decision, shared by the wrapper and the local
+    entry (they used to re-derive it and drift). Returns None (shapes
+    can't take the kernels), ("plain", None), or ("fold", seg_len) —
+    seg_len = the local q length; bq = min(_RING_BQ, seg_len), so the
+    base alignment check below already covers the folded layout."""
+    if not (sq % min(_RING_BQ, sq) == 0 and sk % min(_RING_BK, sk) == 0
+            and sq >= 8 and sk >= 8 and d % 8 == 0):
+        return None
+    if hq == hk:
+        return ("plain", None)
+    if hq % hk:
+        return None
+    return ("fold", sq)
+
+
 def _ring_flash_shapes_ok(q, k):
-    sq, sk, d = q.shape[2], k.shape[2], q.shape[3]
-    return (sq % min(_RING_BQ, sq) == 0 and sk % min(_RING_BK, sk) == 0
-            and sq >= 8 and sk >= 8 and d % 8 == 0)
+    return _ring_flash_plan(q.shape[1], k.shape[1], q.shape[2],
+                            k.shape[2], q.shape[3]) is not None
 
 
-def _ring_flash_step_fwd(q, k_cur, v_cur, mode, sm_scale, interpret):
+def _ring_flash_step_fwd(q, k_cur, v_cur, mode, sm_scale, interpret,
+                         seg_len=None):
     """mode: 0 = unmasked shard, 1 = aligned-diagonal (causal), 2 =
-    future shard (fully masked -> zero weight)."""
+    future shard (fully masked -> zero weight). seg_len: GQA fold — q
+    carries G concatenated segments of this length (causal masking is
+    per-segment, exactly the single-chip fold)."""
     from paddle_tpu.kernels.flash_attention import _flash_fwd_pallas
-    bq = min(_RING_BQ, q.shape[2])
+    bq = min(_RING_BQ, seg_len if seg_len else q.shape[2])
     bk = min(_RING_BK, k_cur.shape[2])
 
     def run(causal):
         def f():
             return _flash_fwd_pallas(q, k_cur, v_cur, causal, sm_scale,
                                      block_q=bq, block_k=bk,
-                                     interpret=interpret)
+                                     interpret=interpret,
+                                     seg_len=seg_len if causal else None)
         return f
 
     def skip():
@@ -98,7 +117,7 @@ def _ring_flash_step_fwd(q, k_cur, v_cur, mode, sm_scale, interpret):
 
 
 def _ring_flash_fwd_scan(q, k, v, axis_name, causal, sm_scale,
-                         interpret):
+                         interpret, seg_len=None):
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
@@ -112,7 +131,7 @@ def _ring_flash_fwd_scan(q, k, v, axis_name, causal, sm_scale,
         else:
             mode = jnp.zeros((), jnp.int32)
         o_j, lse_j = _ring_flash_step_fwd(q, k_cur, v_cur, mode,
-                                          sm_scale, interpret)
+                                          sm_scale, interpret, seg_len)
         a = lse_acc[:, :, 0, :sq]                      # (b, h, sq) base-2
         bj = lse_j[:, :, 0, :sq]
         new = jnp.logaddexp2(a, bj)
@@ -132,35 +151,38 @@ def _ring_flash_fwd_scan(q, k, v, axis_name, causal, sm_scale,
     return acc.astype(q.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_flash(q, k, v, axis_name, causal, sm_scale, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis_name, causal, sm_scale, interpret,
+                seg_len=None):
     out, _ = _ring_flash_fwd_scan(q, k, v, axis_name, causal, sm_scale,
-                                  interpret)
+                                  interpret, seg_len)
     return out
 
 
 def _ring_flash_fwd_rule(q, k, v, axis_name, causal, sm_scale,
-                         interpret):
+                         interpret, seg_len=None):
     out, lse = _ring_flash_fwd_scan(q, k, v, axis_name, causal, sm_scale,
-                                    interpret)
+                                    interpret, seg_len)
     return out, (q, k, v, out, lse)
 
 
-def _ring_flash_bwd_rule(axis_name, causal, sm_scale, interpret, res, g):
+def _ring_flash_bwd_rule(axis_name, causal, sm_scale, interpret, seg_len,
+                         res, g):
     from paddle_tpu.kernels.flash_attention import _flash_bwd_pallas
     q, k, v, o, lse = res
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
-    bq = min(_RING_BQ, q.shape[2])
+    bq = min(_RING_BQ, seg_len if seg_len else q.shape[2])
     bk = min(_RING_BK, k.shape[2])
 
     def one(mode, k_cur, v_cur):
         def run(cflag):
             def f():
-                return _flash_bwd_pallas(q, k_cur, v_cur, o, lse, g,
-                                         cflag, sm_scale, block_q=bq,
-                                         block_k=bk, interpret=interpret)
+                return _flash_bwd_pallas(
+                    q, k_cur, v_cur, o, lse, g, cflag, sm_scale,
+                    block_q=bq, block_k=bk, interpret=interpret,
+                    seg_len=seg_len if cflag else None)
             return f
 
         def skip():
@@ -215,6 +237,17 @@ def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None,
                                         "1") != "0"
                      and _ring_flash_shapes_ok(q, k))
     if use_flash:
+        plan = _ring_flash_plan(q.shape[1], k.shape[1], q.shape[2],
+                                k.shape[2], q.shape[3])
+        if plan and plan[0] == "fold":
+            # GQA fold (same trick as flash_attention_bhsd): stream each
+            # kv head once and halve the ring's ICI volume vs repeating
+            hq, hk = q.shape[1], k.shape[1]
+            b_, _, sl, d_ = q.shape
+            qf = q.reshape(b_, hk, (hq // hk) * sl, d_)
+            out = _ring_flash(qf, k, v, axis_name, causal, sm_scale,
+                              interpret, sl)
+            return out.reshape(b_, hq, sl, d_)
         return _ring_flash(q, k, v, axis_name, causal, sm_scale,
                            interpret)
     n = jax.lax.axis_size(axis_name)
@@ -281,13 +314,25 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=True,
                    sm_scale=None):
     """Global arrays (B, S, H, D); seq dim sharded over mesh axis `axis`.
     GQA handled by head repeat."""
+    import os as _os
+    from paddle_tpu.kernels.flash_attention import _on_tpu
     from paddle_tpu.distributed.mesh import ProcessMesh
     if isinstance(mesh, ProcessMesh):
         mesh = mesh.jax_mesh
     hq, hk = q.shape[2], k.shape[2]
     if hk != hq:
-        k = jnp.repeat(k, hq // hk, axis=2)
-        v = jnp.repeat(v, hq // hk, axis=2)
+        # the flash-ring folds GQA itself (halves ring ICI volume);
+        # only the jnp fallback needs materialized repeats
+        n_sp = mesh.shape[axis]
+        s_loc = q.shape[1] // n_sp
+        plan = _ring_flash_plan(hq, hk, s_loc, s_loc, q.shape[3])
+        will_fold = (_on_tpu()
+                     and _os.environ.get("PADDLE_TPU_RING_FLASH",
+                                         "1") != "0"
+                     and plan is not None and plan[0] == "fold")
+        if not will_fold:
+            k = jnp.repeat(k, hq // hk, axis=2)
+            v = jnp.repeat(v, hq // hk, axis=2)
 
     def local(ql, kl, vl):
         out = ring_attention_local(

@@ -393,8 +393,11 @@ class PagedKVEngine:
         self._slots[slot_idx] = _Slot(req, lens=0, tok=0)
         self._alloc_pages(slot_idx,
                           -(-int(req.prompt.size) // self.page_size))
-        self._prefill_group(self._bucket(int(req.prompt.size)),
-                            [(slot_idx, req)])
+        if self.prefill_chunk and req.prompt.size > self.prefill_chunk:
+            self._prefill_chunked_group([(slot_idx, req)])
+        else:
+            self._prefill_group(self._bucket(int(req.prompt.size)),
+                                [(slot_idx, req)])
 
     def _first_token(self, logits, req):
         """Select a request's first token from its prefill logits —
@@ -409,9 +412,6 @@ class PagedKVEngine:
             u = rng.uniform(1e-9, 1.0, size=x.shape).astype(np.float32)
             return int(np.argmax(x - np.log(-np.log(u))))
         return int(np.argmax(logits))
-
-    def _prefill_chunked(self, slot_idx, req):
-        self._prefill_chunked_group([(slot_idx, req)])
 
     def _prefill_chunked_group(self, grp):
         """Feed long prompts through the fixed-size chunk program in

@@ -163,3 +163,48 @@ def test_flash_ring_noncausal_and_fallback_gate():
     # gate: d not multiple of 8 -> jnp path (no crash)
     assert not cp._ring_flash_shapes_ok(
         jnp.zeros((1, 2, 64, 12)), jnp.zeros((1, 2, 64, 12)))
+
+
+def test_flash_ring_gqa_fold_matches_repeat():
+    """GQA through the flash-ring: the fold path (kv streamed once,
+    halved ring volume) must match the repeat-kv jnp ring in values and
+    grads — interpret mode, 4 shards, hq=4 over hk=2."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed.mesh import init_mesh
+    from paddle_tpu.distributed import context_parallel as cp
+
+    mesh = init_mesh({"sp": 4})
+    rng = np.random.RandomState(2)
+    b, s, hq, hk, d = 1, 128, 4, 2, 16
+    q = jnp.asarray(rng.randn(b, hq, s, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, hk, s, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, hk, s, d), jnp.float32)
+
+    def run(use_flash):
+        if use_flash:
+            kk, vv = k, v                     # fold inside the ring
+        else:
+            kk = jnp.repeat(k, hq // hk, axis=1)
+            vv = jnp.repeat(v, hq // hk, axis=1)
+
+        def local(ql, kl, vl):
+            return cp.ring_attention_local(
+                ql, kl, vl, "sp", causal=True, use_flash=use_flash,
+                interpret=use_flash)
+        f = jax.shard_map(local, mesh=mesh.jax_mesh,
+                          in_specs=(P(None, None, "sp", None),) * 3,
+                          out_specs=P(None, None, "sp", None),
+                          check_vma=False)
+
+        def loss(q_, k_, v_):
+            return jnp.sum(f(q_, k_, v_) ** 2)
+        val, (gq,) = jax.value_and_grad(loss, argnums=(0,))(q, kk, vv)
+        return val, gq
+
+    v0, gq0 = run(False)
+    v1, gq1 = run(True)
+    np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gq1), np.asarray(gq0),
+                               rtol=2e-4, atol=1e-5)
