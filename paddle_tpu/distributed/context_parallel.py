@@ -250,6 +250,10 @@ def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None,
             return out.reshape(b_, hq, sl, d_)
         return _ring_flash(q, k, v, axis_name, causal, sm_scale,
                            interpret)
+    if q.shape[1] != k.shape[1]:     # jnp fallback: materialize GQA
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     s_loc = q.shape[2]
@@ -314,25 +318,12 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=True,
                    sm_scale=None):
     """Global arrays (B, S, H, D); seq dim sharded over mesh axis `axis`.
     GQA handled by head repeat."""
-    import os as _os
-    from paddle_tpu.kernels.flash_attention import _on_tpu
     from paddle_tpu.distributed.mesh import ProcessMesh
     if isinstance(mesh, ProcessMesh):
         mesh = mesh.jax_mesh
-    hq, hk = q.shape[2], k.shape[2]
-    if hk != hq:
-        # the flash-ring folds GQA itself (halves ring ICI volume);
-        # only the jnp fallback needs materialized repeats
-        n_sp = mesh.shape[axis]
-        s_loc = q.shape[1] // n_sp
-        plan = _ring_flash_plan(hq, hk, s_loc, s_loc, q.shape[3])
-        will_fold = (_on_tpu()
-                     and _os.environ.get("PADDLE_TPU_RING_FLASH",
-                                         "1") != "0"
-                     and plan is not None and plan[0] == "fold")
-        if not will_fold:
-            k = jnp.repeat(k, hq // hk, axis=2)
-            v = jnp.repeat(v, hq // hk, axis=2)
+    # GQA handling lives entirely in ring_attention_local: the flash
+    # path folds (halved ring ICI volume), the jnp fallback repeats —
+    # the wrapper no longer predicts the local decision (it drifted)
 
     def local(ql, kl, vl):
         out = ring_attention_local(

@@ -216,7 +216,7 @@ class _Request:
 
 
 class _Slot:
-    __slots__ = ("req", "lens", "tok", "pages", "emitted")
+    __slots__ = ("req", "lens", "tok", "pages", "emitted", "draft_lens")
 
     def __init__(self, req, lens, tok):
         self.req = req
@@ -224,6 +224,7 @@ class _Slot:
         self.tok = int(tok)         # next decode input (last emitted)
         self.pages: list[int] = []  # physical pages allocated (in order)
         self.emitted = 0            # generated tokens accepted so far
+        self.draft_lens = 0         # draft-pool progress (spec decode)
 
 
 class PagedKVEngine:
@@ -242,7 +243,8 @@ class PagedKVEngine:
 
     def __init__(self, model, *, max_slots=4, page_size=16, num_pages=64,
                  max_pages_per_slot=None, steps_per_tick=4, seed=0,
-                 prefill_chunk=None, dtype=None):
+                 prefill_chunk=None, draft_model=None, spec_tokens=4,
+                 dtype=None):
         cfg = model.config
         self.model = model
         self.max_slots = int(max_slots)
@@ -268,6 +270,27 @@ class PagedKVEngine:
         shape = (self.num_pages, n_kv, self.page_size, hd)
         self.pools = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                       for _ in range(cfg.num_hidden_layers)]
+        # speculative decoding (greedy-lossless): a draft model rides
+        # its OWN page pools over the SAME block tables — paged caches
+        # make rejection rollback free (lens simply doesn't advance;
+        # stale positions are masked and overwritten)
+        if draft_model is not None and prefill_chunk:
+            raise NotImplementedError(
+                "speculative decoding + chunked prefill: the draft "
+                "prefill mirrors the bucketed path only (compose later)")
+        self.draft_model = draft_model
+        self.spec_tokens = int(spec_tokens)
+        self.draft_pools = None
+        if draft_model is not None:
+            dcfg = draft_model.config
+            dn_kv = getattr(dcfg, "num_key_value_heads", None) \
+                or dcfg.num_attention_heads
+            dhd = getattr(dcfg, "head_dim", None) \
+                or dcfg.hidden_size // dcfg.num_attention_heads
+            dshape = (self.num_pages, dn_kv, self.page_size, dhd)
+            self.draft_pools = [(jnp.zeros(dshape, dtype),
+                                 jnp.zeros(dshape, dtype))
+                                for _ in range(dcfg.num_hidden_layers)]
         self._free = list(range(self.num_pages - 1, 0, -1))  # 0 = trash
         # pages promised to admitted slots but not yet popped from the
         # free list; admission headroom = len(_free) - _reserved_unalloc
@@ -508,6 +531,15 @@ class PagedKVEngine:
             [a for kv in self.pools for a in kv])
         self.pools = [(flat[2 * i], flat[2 * i + 1])
                       for i in range(len(self.pools))]
+        if self.draft_model is not None:
+            dfn = self._draft_prefill_fn(ppad, bw)
+            dflat = dfn(jnp.asarray(ids), jnp.asarray(nv),
+                        jnp.asarray(bt),
+                        [a for kv in self.draft_pools for a in kv])
+            self.draft_pools = [(dflat[2 * i], dflat[2 * i + 1])
+                                for i in range(len(self.draft_pools))]
+            for idx, req in grp:
+                self._slots[idx].draft_lens = int(req.prompt.size)
         logits_np = np.asarray(last_logits)              # (bw, vocab)
         self.stats["prefills"] += len(grp)
         self.stats["prefill_s"] += _time.perf_counter() - t0
@@ -553,6 +585,39 @@ class PagedKVEngine:
         slot.req.queue.put(None)
         slot.req.done.set()
 
+    def _slot_arrays(self, live):
+        """Host-side per-slot marshaling shared by the normal and
+        speculative ticks."""
+        b = self.max_slots
+        arrs = dict(tok=np.zeros(b, np.int32),
+                    lens=np.zeros(b, np.int32),
+                    active=np.zeros(b, bool),
+                    limit=np.zeros(b, np.int32),
+                    eos=np.full(b, -1, np.int32))
+        for i in live:
+            slot = self._slots[i]
+            arrs["tok"][i] = slot.tok
+            arrs["lens"][i] = slot.lens
+            arrs["active"][i] = True
+            arrs["limit"][i] = slot.req.max_new_tokens - slot.emitted
+            arrs["eos"][i] = slot.req.eos_token_id
+        return arrs
+
+    def _accept_tick(self, live, out_np, counts, eos, lens_np,
+                     draft_lens=None):
+        """Shared accept epilogue: truncate by budget then eos, feed the
+        request, advance slot state for survivors."""
+        for i in live:
+            slot = self._slots[i]
+            emitted = list(out_np[i, :int(counts[i])])
+            if eos[i] >= 0 and eos[i] in emitted:
+                emitted = emitted[:emitted.index(eos[i]) + 1]
+            if self._accept(i, emitted):
+                slot.lens = int(lens_np[i])
+                slot.tok = int(emitted[-1])
+                if draft_lens is not None:
+                    slot.draft_lens = int(draft_lens[i])
+
     def step(self):
         """One scheduler tick: admit pending requests (prefill), then
         one fused multi-step decode over every live slot. Returns True
@@ -565,6 +630,9 @@ class PagedKVEngine:
         live = [i for i, s in enumerate(self._slots) if s is not None]
         if not live:
             return False
+        if self.draft_model is not None and not any(
+                self._slots[i].req.do_sample for i in live):
+            return self._step_spec(live)
         n = self.steps_per_tick
         for i in live:
             slot = self._slots[i]
@@ -572,22 +640,15 @@ class PagedKVEngine:
             need = min(slot.lens + n, budget_tokens)
             self._alloc_pages(i, -(-need // self.page_size))
         b = self.max_slots
-        tok = np.zeros(b, np.int32)
-        lens = np.zeros(b, np.int32)
-        active = np.zeros(b, bool)
-        limit = np.zeros(b, np.int32)
-        eos = np.full(b, -1, np.int32)
+        a = self._slot_arrays(live)
+        tok, lens, active = a["tok"], a["lens"], a["active"]
+        limit, eos = a["limit"], a["eos"]
         temp = np.ones(b, np.float32)
         topk = np.zeros(b, np.int32)
         topp = np.ones(b, np.float32)
         wants = np.zeros(b, bool)
         for i in live:
             slot = self._slots[i]
-            tok[i] = slot.tok
-            lens[i] = slot.lens
-            active[i] = True
-            limit[i] = slot.req.max_new_tokens - slot.emitted
-            eos[i] = slot.req.eos_token_id
             temp[i] = slot.req.temperature
             topk[i] = slot.req.top_k
             topp[i] = slot.req.top_p
@@ -613,16 +674,100 @@ class PagedKVEngine:
         self._tick_count += 1
         self.stats["ticks"] += 1
         self.stats["tick_s"] += _time.perf_counter() - t0
+        counts = np.minimum(limit, n)
+        self._accept_tick(live, toks_np, counts, eos, lens_np)
+        return True
+
+    def _step_spec(self, live):
+        """Speculative tick (greedy slots only; any sampled slot this
+        tick falls back to the normal path in step())."""
+        import time as _time
+        g = self.spec_tokens
         for i in live:
             slot = self._slots[i]
-            cnt = min(int(limit[i]), n)
-            emitted = list(toks_np[i, :cnt])
-            if eos[i] >= 0 and eos[i] in emitted:
-                emitted = emitted[:emitted.index(eos[i]) + 1]
-            if self._accept(i, emitted):
-                slot.lens = int(lens_np[i])
-                slot.tok = int(emitted[-1])
+            budget = slot.req.prompt.size + slot.req.max_new_tokens
+            need = min(slot.lens + g + 1, budget)
+            self._alloc_pages(i, -(-need // self.page_size))
+        self._draft_catch_up(live)
+        a = self._slot_arrays(live)
+        t0 = _time.perf_counter()
+        fn = self._spec_tick_fn()
+        out, n_emit, lens_f, tflat, dflat = fn(
+            jnp.asarray(a["tok"]), jnp.asarray(a["lens"]),
+            jnp.asarray(a["active"]), jnp.asarray(self._bt),
+            [x for kv in self.pools for x in kv],
+            [x for kv in self.draft_pools for x in kv])
+        self.pools = [(tflat[2 * i], tflat[2 * i + 1])
+                      for i in range(len(self.pools))]
+        self.draft_pools = [(dflat[2 * i], dflat[2 * i + 1])
+                            for i in range(len(self.draft_pools))]
+        out_np = np.asarray(out)
+        emit_np = np.asarray(n_emit)
+        lens_np = np.asarray(lens_f)
+        self._tick_count += 1
+        self.stats["ticks"] += 1
+        self.stats["spec_ticks"] = self.stats.get("spec_ticks", 0) + 1
+        self.stats["spec_proposed"] = (self.stats.get("spec_proposed", 0)
+                                       + g * len(live))
+        self.stats["spec_accepted"] = (
+            self.stats.get("spec_accepted", 0)
+            + int(sum(emit_np[i] - 1 for i in live)))
+        self.stats["tick_s"] += _time.perf_counter() - t0
+        counts = np.minimum(emit_np, a["limit"])
+        # survivors accepted everything: draft progressed with target
+        self._accept_tick(live, out_np, counts, a["eos"], lens_np,
+                          draft_lens=lens_np)
         return True
+
+    def _draft_catch_up(self, live):
+        """Normal (fallback) ticks advance only the target pools; before
+        speculating again, replay the tokens the draft missed through
+        its own pools (ids are known host-side: prompt + accepted
+        emissions). Without this the draft attends over unwritten
+        positions and acceptance silently collapses (review r5)."""
+        todo = [i for i in live
+                if self._slots[i].draft_lens < self._slots[i].lens]
+        if not todo:
+            return
+        chunk = self.spec_tokens + 1
+        fn = self._draft_catchup_fn(chunk)
+        for i in todo:
+            slot = self._slots[i]
+            seq = np.concatenate([slot.req.prompt,
+                                  np.asarray(slot.req.tokens, np.int32)])
+            while slot.draft_lens < slot.lens:
+                take = min(chunk, slot.lens - slot.draft_lens)
+                ids = np.zeros((1, chunk), np.int32)
+                ids[0, :take] = seq[slot.draft_lens:slot.draft_lens
+                                    + take]
+                dflat = fn(jnp.asarray(ids),
+                           jnp.int32(slot.draft_lens), jnp.int32(take),
+                           jnp.asarray(self._bt[i:i + 1]),
+                           [x for kv in self.draft_pools for x in kv])
+                self.draft_pools = [(dflat[2 * j], dflat[2 * j + 1])
+                                    for j in range(len(self.draft_pools))]
+                slot.draft_lens += take
+
+    def _draft_catchup_fn(self, chunk):
+        key = ("draft_catchup", chunk)
+        if key in self._programs:
+            return self._programs[key]
+        model = self.draft_model
+
+        def run(ids, lens, n_valid, bt_row, pool_flat):
+            state = PagedState(bt_row, jnp.reshape(lens, (1,)),
+                               jnp.reshape(n_valid, (1,)))
+            pos = lens + jnp.arange(chunk, dtype=jnp.int32)[None, :]
+            _, new_caches = model(
+                Tensor(ids), caches=self._layer_caches(pool_flat),
+                position_ids=Tensor(pos), cache_index=state)
+            return [_val(x) for kv in new_caches for x in kv]
+
+        import jax as _jax
+        donate = () if _jax.default_backend() == "cpu" else (4,)
+        fn = jax.jit(run, donate_argnums=donate)
+        self._programs[key] = fn
+        return fn
 
     def run_until_idle(self):
         """Synchronously drain all pending + active requests (tests,
@@ -752,7 +897,7 @@ class PagedKVEngine:
     # -- compiled programs ----------------------------------------------
     def _layer_caches(self, flat):
         return [(Tensor(flat[2 * i]), Tensor(flat[2 * i + 1]))
-                for i in range(len(self.pools))]
+                for i in range(len(flat) // 2)]
 
     def _prefill_fn(self, ppad, bw=1):
         key = ("prefill", ppad, bw)
@@ -776,6 +921,91 @@ class PagedKVEngine:
 
         import jax as _jax
         donate = () if _jax.default_backend() == "cpu" else (3,)
+        fn = jax.jit(run, donate_argnums=donate)
+        self._programs[key] = fn
+        return fn
+
+    def _draft_prefill_fn(self, ppad, bw):
+        key = ("draft_prefill", ppad, bw)
+        if key in self._programs:
+            return self._programs[key]
+        model = self.draft_model
+
+        def run(ids, n_valid, bt_rows, pool_flat):
+            state = PagedState(bt_rows, jnp.zeros((bw,), jnp.int32),
+                               n_valid)
+            pos = jnp.broadcast_to(
+                jnp.arange(ppad, dtype=jnp.int32)[None, :], (bw, ppad))
+            _, new_caches = model(
+                Tensor(ids), caches=self._layer_caches(pool_flat),
+                position_ids=Tensor(pos), cache_index=state)
+            return [_val(a) for kv in new_caches for a in kv]
+
+        import jax as _jax
+        donate = () if _jax.default_backend() == "cpu" else (3,)
+        fn = jax.jit(run, donate_argnums=donate)
+        self._programs[key] = fn
+        return fn
+
+    def _spec_tick_fn(self):
+        """Greedy-lossless speculative tick: g draft steps on the draft
+        pools, ONE target verify over the g+1 candidate positions, and
+        in-graph longest-prefix acceptance (models/generation.py's
+        greedy spec contract, composed with paged caches — rejection
+        rollback is free: lens simply doesn't advance, stale positions
+        are masked and overwritten)."""
+        key = ("spec_tick",)
+        if key in self._programs:
+            return self._programs[key]
+        target, draft = self.model, self.draft_model
+        g = self.spec_tokens
+
+        def run(tok, lens, active, bt, target_flat, draft_flat):
+            live32 = active.astype(jnp.int32)
+
+            def dstep(carry, j):
+                cur, dflat = carry
+                state = PagedState(bt, lens + j, live32)
+                logits, dcaches = draft(
+                    Tensor(cur[:, None]),
+                    caches=self._layer_caches(list(dflat)),
+                    position_ids=Tensor((lens + j)[:, None]),
+                    cache_index=state)
+                nxt = jnp.argmax(_val(logits)[:, -1],
+                                 axis=-1).astype(jnp.int32)
+                return (nxt, tuple(_val(a) for kv in dcaches
+                                   for a in kv)), nxt
+
+            (_, dflat_f), d_toks = jax.lax.scan(
+                dstep, (tok, tuple(draft_flat)),
+                jnp.arange(g, dtype=jnp.int32))
+            d_toks = jnp.swapaxes(d_toks, 0, 1)          # (B, g)
+
+            ids = jnp.concatenate([tok[:, None], d_toks], axis=1)
+            state = PagedState(bt, lens, live32 * (g + 1))
+            pos = lens[:, None] + jnp.arange(g + 1,
+                                             dtype=jnp.int32)[None, :]
+            logits, tcaches = target(
+                Tensor(ids), caches=self._layer_caches(target_flat),
+                position_ids=Tensor(pos), cache_index=state)
+            picks = jnp.argmax(_val(logits), axis=-1).astype(jnp.int32)
+
+            match = (picks[:, :g] == d_toks).astype(jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # (B,)
+            col = jnp.arange(g + 1, dtype=jnp.int32)[None, :]
+            padded = jnp.concatenate(
+                [d_toks, jnp.zeros((d_toks.shape[0], 1), jnp.int32)], 1)
+            out = jnp.where(col < n_acc[:, None], padded,
+                            jnp.where(col == n_acc[:, None], picks, 0))
+            out = jnp.where(active[:, None], out, 0)
+            n_emit = jnp.where(active, n_acc + 1, 0)
+            lens_f = lens + live32 * (1 + n_acc)
+            return (out, n_emit, lens_f,
+                    [_val(a) for kv in tcaches for a in kv],
+                    list(dflat_f))
+
+        import jax as _jax
+        donate = () if _jax.default_backend() == "cpu" else (4, 5)
         fn = jax.jit(run, donate_argnums=donate)
         self._programs[key] = fn
         return fn

@@ -200,11 +200,21 @@ def test_flash_ring_gqa_fold_matches_repeat():
 
         def loss(q_, k_, v_):
             return jnp.sum(f(q_, k_, v_) ** 2)
-        val, (gq,) = jax.value_and_grad(loss, argnums=(0,))(q, kk, vv)
-        return val, gq
+        val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, kk, vv)
+        return val, grads
 
-    v0, gq0 = run(False)
-    v1, gq1 = run(True)
+    v0, (gq0, gk0, gv0) = run(False)
+    v1, (gq1, gk1, gv1) = run(True)
     np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(gq1), np.asarray(gq0),
                                rtol=2e-4, atol=1e-5)
+    # fold dk/dv come out per-kv-head; repeat path needs the group-sum
+    rep = 2
+    np.testing.assert_allclose(
+        np.asarray(gk1),
+        np.asarray(gk0).reshape(1, 2, rep, 128, 16).sum(2), rtol=2e-4,
+        atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(gv1),
+        np.asarray(gv0).reshape(1, 2, rep, 128, 16).sum(2), rtol=2e-4,
+        atol=1e-5)

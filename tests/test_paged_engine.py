@@ -413,3 +413,84 @@ def test_chunked_prefill_storm_lockstep():
     for r, want in zip(reqs, solo):
         assert r.result() == want
     assert ("prefill_chunk", 8, 4) in eng._programs
+
+
+def test_speculative_paged_lossless_parity():
+    """Greedy speculative decoding composed with the paged engine: a
+    draft model proposes, ONE target verify per tick accepts the
+    longest matching prefix — output tokens are EXACTLY the solo target
+    tokens (losslessness), including mid-decode admission. The best
+    draft is the target itself: acceptance is then total."""
+    model = _model()
+    paddle_tpu.seed(5)
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    draft = LlamaForCausalLM(model.config)          # independent weights
+    pa, pb = [5, 9, 2, 14], [17, 3, 11]
+    solo = {}
+    for key, p, m in (("a", pa, 9), ("b", pb, 6)):
+        solo[key] = np.asarray(
+            generate(model, np.asarray([p], np.int32),
+                     max_new_tokens=m))[0].tolist()[len(p):]
+    eng = PagedKVEngine(model, max_slots=2, page_size=4, num_pages=40,
+                        max_pages_per_slot=8, steps_per_tick=3,
+                        draft_model=draft, spec_tokens=3)
+    ra = eng.submit(pa, max_new_tokens=9)
+    eng.step()
+    rb = eng.submit(pb, max_new_tokens=6)   # joins mid-decode of A
+    eng.run_until_idle()
+    assert ra.result() == solo["a"]
+    assert rb.result() == solo["b"]
+    assert eng.stats["spec_ticks"] > 0
+    assert 0 <= eng.stats["spec_accepted"] <= eng.stats["spec_proposed"]
+
+    # perfect draft (the target itself) accepts every proposal
+    eng2 = PagedKVEngine(model, max_slots=1, page_size=4, num_pages=40,
+                        max_pages_per_slot=8, draft_model=model,
+                        spec_tokens=3)
+    r = eng2.submit(pa, max_new_tokens=9)
+    eng2.run_until_idle()
+    assert r.result() == solo["a"]
+    assert eng2.stats["spec_accepted"] == eng2.stats["spec_proposed"]
+
+
+def test_speculative_falls_back_for_sampled_slots():
+    """A sampled request in the live set routes the tick through the
+    normal path (spec is greedy-lossless only); output stays valid."""
+    model = _model()
+    paddle_tpu.seed(5)
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    draft = LlamaForCausalLM(model.config)
+    eng = PagedKVEngine(model, max_slots=2, page_size=4, num_pages=40,
+                        max_pages_per_slot=8, steps_per_tick=3,
+                        draft_model=draft, spec_tokens=3, seed=7)
+    rg = eng.submit([5, 9, 2], max_new_tokens=5)
+    rs = eng.submit([5, 9, 2], max_new_tokens=5, do_sample=True,
+                    temperature=0.9)
+    eng.run_until_idle()
+    solo = np.asarray(generate(model, np.asarray([[5, 9, 2]], np.int32),
+                               max_new_tokens=5))[0].tolist()[3:]
+    assert rg.result() == solo
+    assert len(rs.result()) == 5
+
+
+def test_speculative_draft_catches_up_after_fallback():
+    """Greedy + sampled coexist (normal ticks advance only the target
+    pools); when the sampled slot retires and speculation resumes, the
+    draft cache is replayed to the slot's accepted history — with the
+    TARGET as draft, acceptance must be total again (it would collapse
+    to ~0 on a stale cache)."""
+    model = _model()
+    solo = np.asarray(generate(model, np.asarray([[5, 9, 2]], np.int32),
+                               max_new_tokens=14))[0].tolist()[3:]
+    eng = PagedKVEngine(model, max_slots=2, page_size=4, num_pages=48,
+                        max_pages_per_slot=10, steps_per_tick=2,
+                        draft_model=model, spec_tokens=3, seed=5)
+    rg = eng.submit([5, 9, 2], max_new_tokens=14)
+    rs = eng.submit([7, 8], max_new_tokens=4, do_sample=True,
+                    temperature=0.8)
+    eng.run_until_idle()
+    assert rg.result() == solo
+    assert len(rs.result()) == 4
+    assert eng.stats["spec_ticks"] > 0
+    # perfect-draft invariant survives the fallback interlude
+    assert eng.stats["spec_accepted"] == eng.stats["spec_proposed"]
