@@ -216,7 +216,7 @@ class _Request:
 
 
 class _Slot:
-    __slots__ = ("req", "lens", "tok", "pages", "emitted", "draft_lens")
+    __slots__ = ("req", "lens", "tok", "pages", "emitted")
 
     def __init__(self, req, lens, tok):
         self.req = req
@@ -224,7 +224,6 @@ class _Slot:
         self.tok = int(tok)         # next decode input (last emitted)
         self.pages: list[int] = []  # physical pages allocated (in order)
         self.emitted = 0            # generated tokens accepted so far
-        self.draft_lens = 0         # draft-pool progress (spec decode)
 
 
 class PagedKVEngine:
@@ -538,8 +537,6 @@ class PagedKVEngine:
                         [a for kv in self.draft_pools for a in kv])
             self.draft_pools = [(dflat[2 * i], dflat[2 * i + 1])
                                 for i in range(len(self.draft_pools))]
-            for idx, req in grp:
-                self._slots[idx].draft_lens = int(req.prompt.size)
         logits_np = np.asarray(last_logits)              # (bw, vocab)
         self.stats["prefills"] += len(grp)
         self.stats["prefill_s"] += _time.perf_counter() - t0
@@ -593,7 +590,11 @@ class PagedKVEngine:
                     lens=np.zeros(b, np.int32),
                     active=np.zeros(b, bool),
                     limit=np.zeros(b, np.int32),
-                    eos=np.full(b, -1, np.int32))
+                    eos=np.full(b, -1, np.int32),
+                    temp=np.ones(b, np.float32),
+                    topk=np.zeros(b, np.int32),
+                    topp=np.ones(b, np.float32),
+                    wants=np.zeros(b, bool))
         for i in live:
             slot = self._slots[i]
             arrs["tok"][i] = slot.tok
@@ -601,10 +602,13 @@ class PagedKVEngine:
             arrs["active"][i] = True
             arrs["limit"][i] = slot.req.max_new_tokens - slot.emitted
             arrs["eos"][i] = slot.req.eos_token_id
+            arrs["temp"][i] = slot.req.temperature
+            arrs["topk"][i] = slot.req.top_k
+            arrs["topp"][i] = slot.req.top_p
+            arrs["wants"][i] = slot.req.do_sample
         return arrs
 
-    def _accept_tick(self, live, out_np, counts, eos, lens_np,
-                     draft_lens=None):
+    def _accept_tick(self, live, out_np, counts, eos, lens_np):
         """Shared accept epilogue: truncate by budget then eos, feed the
         request, advance slot state for survivors."""
         for i in live:
@@ -615,8 +619,6 @@ class PagedKVEngine:
             if self._accept(i, emitted):
                 slot.lens = int(lens_np[i])
                 slot.tok = int(emitted[-1])
-                if draft_lens is not None:
-                    slot.draft_lens = int(draft_lens[i])
 
     def step(self):
         """One scheduler tick: admit pending requests (prefill), then
@@ -630,8 +632,7 @@ class PagedKVEngine:
         live = [i for i, s in enumerate(self._slots) if s is not None]
         if not live:
             return False
-        if self.draft_model is not None and not any(
-                self._slots[i].req.do_sample for i in live):
+        if self.draft_model is not None:
             return self._step_spec(live)
         n = self.steps_per_tick
         for i in live:
@@ -639,20 +640,11 @@ class PagedKVEngine:
             budget_tokens = slot.req.prompt.size + slot.req.max_new_tokens
             need = min(slot.lens + n, budget_tokens)
             self._alloc_pages(i, -(-need // self.page_size))
-        b = self.max_slots
         a = self._slot_arrays(live)
         tok, lens, active = a["tok"], a["lens"], a["active"]
         limit, eos = a["limit"], a["eos"]
-        temp = np.ones(b, np.float32)
-        topk = np.zeros(b, np.int32)
-        topp = np.ones(b, np.float32)
-        wants = np.zeros(b, bool)
-        for i in live:
-            slot = self._slots[i]
-            temp[i] = slot.req.temperature
-            topk[i] = slot.req.top_k
-            topp[i] = slot.req.top_p
-            wants[i] = slot.req.do_sample
+        temp, topk, topp, wants = (a["temp"], a["topk"], a["topp"],
+                                   a["wants"])
         import time as _time
         t0 = _time.perf_counter()
         any_sample = bool(wants.any())
@@ -679,8 +671,8 @@ class PagedKVEngine:
         return True
 
     def _step_spec(self, live):
-        """Speculative tick (greedy slots only; any sampled slot this
-        tick falls back to the normal path in step())."""
+        """Speculative tick: greedy AND sampled slots ride it together
+        (per-slot regimes in-graph; _spec_tick_fn doc)."""
         import time as _time
         g = self.spec_tokens
         for i in live:
@@ -688,13 +680,16 @@ class PagedKVEngine:
             budget = slot.req.prompt.size + slot.req.max_new_tokens
             need = min(slot.lens + g + 1, budget)
             self._alloc_pages(i, -(-need // self.page_size))
-        self._draft_catch_up(live)
         a = self._slot_arrays(live)
         t0 = _time.perf_counter()
-        fn = self._spec_tick_fn()
+        fn = self._spec_tick_fn(bool(a["wants"].any()))
+        key = jax.random.fold_in(self._key, self._tick_count)
         out, n_emit, lens_f, tflat, dflat = fn(
             jnp.asarray(a["tok"]), jnp.asarray(a["lens"]),
             jnp.asarray(a["active"]), jnp.asarray(self._bt),
+            jax.random.key_data(key), jnp.asarray(a["temp"]),
+            jnp.asarray(a["topk"]), jnp.asarray(a["topp"]),
+            jnp.asarray(a["wants"]),
             [x for kv in self.pools for x in kv],
             [x for kv in self.draft_pools for x in kv])
         self.pools = [(tflat[2 * i], tflat[2 * i + 1])
@@ -714,60 +709,8 @@ class PagedKVEngine:
             + int(sum(emit_np[i] - 1 for i in live)))
         self.stats["tick_s"] += _time.perf_counter() - t0
         counts = np.minimum(emit_np, a["limit"])
-        # survivors accepted everything: draft progressed with target
-        self._accept_tick(live, out_np, counts, a["eos"], lens_np,
-                          draft_lens=lens_np)
+        self._accept_tick(live, out_np, counts, a["eos"], lens_np)
         return True
-
-    def _draft_catch_up(self, live):
-        """Normal (fallback) ticks advance only the target pools; before
-        speculating again, replay the tokens the draft missed through
-        its own pools (ids are known host-side: prompt + accepted
-        emissions). Without this the draft attends over unwritten
-        positions and acceptance silently collapses (review r5)."""
-        todo = [i for i in live
-                if self._slots[i].draft_lens < self._slots[i].lens]
-        if not todo:
-            return
-        chunk = self.spec_tokens + 1
-        fn = self._draft_catchup_fn(chunk)
-        for i in todo:
-            slot = self._slots[i]
-            seq = np.concatenate([slot.req.prompt,
-                                  np.asarray(slot.req.tokens, np.int32)])
-            while slot.draft_lens < slot.lens:
-                take = min(chunk, slot.lens - slot.draft_lens)
-                ids = np.zeros((1, chunk), np.int32)
-                ids[0, :take] = seq[slot.draft_lens:slot.draft_lens
-                                    + take]
-                dflat = fn(jnp.asarray(ids),
-                           jnp.int32(slot.draft_lens), jnp.int32(take),
-                           jnp.asarray(self._bt[i:i + 1]),
-                           [x for kv in self.draft_pools for x in kv])
-                self.draft_pools = [(dflat[2 * j], dflat[2 * j + 1])
-                                    for j in range(len(self.draft_pools))]
-                slot.draft_lens += take
-
-    def _draft_catchup_fn(self, chunk):
-        key = ("draft_catchup", chunk)
-        if key in self._programs:
-            return self._programs[key]
-        model = self.draft_model
-
-        def run(ids, lens, n_valid, bt_row, pool_flat):
-            state = PagedState(bt_row, jnp.reshape(lens, (1,)),
-                               jnp.reshape(n_valid, (1,)))
-            pos = lens + jnp.arange(chunk, dtype=jnp.int32)[None, :]
-            _, new_caches = model(
-                Tensor(ids), caches=self._layer_caches(pool_flat),
-                position_ids=Tensor(pos), cache_index=state)
-            return [_val(x) for kv in new_caches for x in kv]
-
-        import jax as _jax
-        donate = () if _jax.default_backend() == "cpu" else (4,)
-        fn = jax.jit(run, donate_argnums=donate)
-        self._programs[key] = fn
-        return fn
 
     def run_until_idle(self):
         """Synchronously drain all pending + active requests (tests,
@@ -947,21 +890,26 @@ class PagedKVEngine:
         self._programs[key] = fn
         return fn
 
-    def _spec_tick_fn(self):
-        """Greedy-lossless speculative tick: g draft steps on the draft
-        pools, ONE target verify over the g+1 candidate positions, and
-        in-graph longest-prefix acceptance (models/generation.py's
-        greedy spec contract, composed with paged caches — rejection
-        rollback is free: lens simply doesn't advance, stale positions
-        are masked and overwritten)."""
-        key = ("spec_tick",)
+    def _spec_tick_fn(self, any_sample=True):
+        """Unified speculative tick: g draft steps on the draft pools,
+        ONE target verify over the g+1 candidate positions, per-slot
+        acceptance in-graph. Greedy slots accept by token equality
+        (lossless vs solo greedy); sampled slots run Leviathan
+        rejection sampling — accept d_i with prob p_i(d_i)/q_i(d_i),
+        correct from the residual max(p-q, 0), bonus row q=0 — so the
+        emitted distribution IS the target's processed softmax
+        (models/generation.py generate_speculative contract, composed
+        with paged caches: rejection rollback is free)."""
+        key = ("spec_tick", any_sample)
         if key in self._programs:
             return self._programs[key]
         target, draft = self.model, self.draft_model
         g = self.spec_tokens
 
-        def run(tok, lens, active, bt, target_flat, draft_flat):
+        def run(tok, lens, active, bt, key_data, temp, topk, topp,
+                wants, target_flat, draft_flat):
             live32 = active.astype(jnp.int32)
+            base = jax.random.wrap_key_data(key_data)
 
             def dstep(carry, j):
                 cur, dflat = carry
@@ -971,15 +919,31 @@ class PagedKVEngine:
                     caches=self._layer_caches(list(dflat)),
                     position_ids=Tensor((lens + j)[:, None]),
                     cache_index=state)
-                nxt = jnp.argmax(_val(logits)[:, -1],
-                                 axis=-1).astype(jnp.int32)
+                last = _val(logits)[:, -1]
+                greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                if not any_sample:   # greedy-only program: no sorts,
+                    #                  no q_rows materialization
+                    return (greedy, tuple(_val(a) for kv in dcaches
+                                          for a in kv)), \
+                        (greedy, jnp.zeros((last.shape[0], 1),
+                                           jnp.float32))
+                x = _process_logits_rowwise(last, temp, topk, topp)
+                qprob = jax.nn.softmax(x, axis=-1)
+                gkey = jax.random.fold_in(base, j)
+                noise = jax.random.gumbel(gkey, x.shape, jnp.float32)
+                sampled = jnp.argmax(x + noise, axis=-1).astype(jnp.int32)
+                nxt = jnp.where(wants, sampled, greedy)
+                onehot = jax.nn.one_hot(nxt, last.shape[-1],
+                                        dtype=jnp.float32)
+                qrow = jnp.where(wants[:, None], qprob, onehot)
                 return (nxt, tuple(_val(a) for kv in dcaches
-                                   for a in kv)), nxt
+                                   for a in kv)), (nxt, qrow)
 
-            (_, dflat_f), d_toks = jax.lax.scan(
+            (_, dflat_f), (d_toks, q_rows) = jax.lax.scan(
                 dstep, (tok, tuple(draft_flat)),
                 jnp.arange(g, dtype=jnp.int32))
             d_toks = jnp.swapaxes(d_toks, 0, 1)          # (B, g)
+            q_rows = jnp.swapaxes(q_rows, 0, 1)          # (B, g, v)
 
             ids = jnp.concatenate([tok[:, None], d_toks], axis=1)
             state = PagedState(bt, lens, live32 * (g + 1))
@@ -988,15 +952,73 @@ class PagedKVEngine:
             logits, tcaches = target(
                 Tensor(ids), caches=self._layer_caches(target_flat),
                 position_ids=Tensor(pos), cache_index=state)
-            picks = jnp.argmax(_val(logits), axis=-1).astype(jnp.int32)
+            lv = _val(logits)                            # (B, g+1, v)
+            v = lv.shape[-1]
+            picks = jnp.argmax(lv, axis=-1).astype(jnp.int32)
+            if not any_sample:
+                match = (picks[:, :g] == d_toks).astype(jnp.int32)
+                n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                corr = jnp.take_along_axis(
+                    picks, n_acc[:, None], axis=1)[:, 0]
+                col = jnp.arange(g + 1, dtype=jnp.int32)[None, :]
+                padded = jnp.concatenate(
+                    [d_toks, jnp.zeros((d_toks.shape[0], 1),
+                                       jnp.int32)], 1)
+                out = jnp.where(col < n_acc[:, None], padded,
+                                jnp.where(col == n_acc[:, None],
+                                          corr[:, None], 0))
+                out = jnp.where(active[:, None], out, 0)
+                n_emit = jnp.where(active, n_acc + 1, 0)
+                lens_f = lens + live32 * (1 + n_acc)
+                return (out, n_emit, lens_f,
+                        [_val(a) for kv in tcaches for a in kv],
+                        list(dflat_f))
+            xt = _process_logits_rowwise(
+                lv.reshape(-1, v),
+                jnp.repeat(temp, g + 1), jnp.repeat(topk, g + 1),
+                jnp.repeat(topp, g + 1)).reshape(lv.shape)
+            p_rows = jax.nn.softmax(xt, axis=-1)         # (B, g+1, v)
 
-            match = (picks[:, :g] == d_toks).astype(jnp.int32)
+            # per-position acceptance
+            p_at_d = jnp.take_along_axis(
+                p_rows[:, :g], d_toks[..., None], axis=-1)[..., 0]
+            q_at_d = jnp.take_along_axis(
+                q_rows, d_toks[..., None], axis=-1)[..., 0]
+            ukey = jax.random.fold_in(base, g + 1)
+            u = jax.random.uniform(ukey, d_toks.shape, jnp.float32)
+            acc_sampled = u * jnp.maximum(q_at_d, 1e-30) < p_at_d
+            acc_greedy = picks[:, :g] == d_toks
+            match = jnp.where(wants[:, None], acc_sampled,
+                              acc_greedy).astype(jnp.int32)
             n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # (B,)
+
+            # correction token at row n_acc: greedy -> target argmax;
+            # sampled -> residual max(p - q, 0) (bonus row: q = 0)
+            q_pad = jnp.concatenate(
+                [q_rows, jnp.zeros((q_rows.shape[0], 1, v),
+                                   jnp.float32)], axis=1)
+            p_corr = jnp.take_along_axis(
+                p_rows, n_acc[:, None, None], axis=1)[:, 0]  # (B, v)
+            q_corr = jnp.take_along_axis(
+                q_pad, n_acc[:, None, None], axis=1)[:, 0]
+            res = jnp.maximum(p_corr - q_corr, 0.0)
+            has_res = jnp.sum(res, axis=-1, keepdims=True) > 1e-30
+            res_dist = jnp.where(has_res, res, p_corr)
+            ckey = jax.random.fold_in(base, g + 2)
+            cnoise = jax.random.gumbel(ckey, res_dist.shape, jnp.float32)
+            corr_sampled = jnp.argmax(
+                jnp.log(jnp.maximum(res_dist, 1e-30)) + cnoise,
+                axis=-1).astype(jnp.int32)
+            corr_greedy = jnp.take_along_axis(
+                picks, n_acc[:, None], axis=1)[:, 0]
+            corr = jnp.where(wants, corr_sampled, corr_greedy)
+
             col = jnp.arange(g + 1, dtype=jnp.int32)[None, :]
             padded = jnp.concatenate(
                 [d_toks, jnp.zeros((d_toks.shape[0], 1), jnp.int32)], 1)
             out = jnp.where(col < n_acc[:, None], padded,
-                            jnp.where(col == n_acc[:, None], picks, 0))
+                            jnp.where(col == n_acc[:, None],
+                                      corr[:, None], 0))
             out = jnp.where(active[:, None], out, 0)
             n_emit = jnp.where(active, n_acc + 1, 0)
             lens_f = lens + live32 * (1 + n_acc)
@@ -1005,7 +1027,7 @@ class PagedKVEngine:
                     list(dflat_f))
 
         import jax as _jax
-        donate = () if _jax.default_backend() == "cpu" else (4, 5)
+        donate = () if _jax.default_backend() == "cpu" else (9, 10)
         fn = jax.jit(run, donate_argnums=donate)
         self._programs[key] = fn
         return fn
