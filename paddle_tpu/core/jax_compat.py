@@ -1,0 +1,42 @@
+"""Version-fragile jax API surface, centralized.
+
+`shard_map` has moved twice: `jax.experimental.shard_map.shard_map`
+(<= 0.4.x), then promoted to `jax.shard_map` (>= 0.6), with the
+`check_rep` kwarg renamed to `check_vma` along the way. A bare
+`from jax import shard_map` therefore breaks every importing module on
+the 0.4.x line (10 test files failed collection on 0.4.37). All
+paddle_tpu code imports `shard_map` from HERE; tools/check_jax_compat.py
+fails CI when a bare import sneaks back in.
+"""
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+__all__ = ["shard_map", "axis_size"]
+
+try:                                   # jax >= 0.6: promoted to top level
+    from jax import shard_map as _shard_map
+except ImportError:                    # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if hasattr(jax.lax, "axis_size"):      # added ~0.5
+    axis_size = jax.lax.axis_size
+else:
+    def axis_size(axis_name):
+        """Size of a named mesh axis inside shard_map: psum of 1 folds
+        to the constant at compile time on the 0.4.x line."""
+        return jax.lax.psum(1, axis_name)
+
+_HAS_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+              check_vma=None, **kw):
+    """`jax.shard_map` with the replication-check kwarg translated for
+    whichever jax line is installed (`check_vma` new / `check_rep` old)."""
+    if check_vma is not None:
+        kw["check_vma" if _HAS_VMA else "check_rep"] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

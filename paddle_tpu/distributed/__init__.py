@@ -35,7 +35,7 @@ def __getattr__(name):
     import importlib
     if name in ("fleet", "checkpoint", "pipeline", "launch", "parallel",
                 "sharding", "elastic", "auto_tuner", "rpc", "ps",
-                "auto_parallel", "watchdog"):
+                "auto_parallel", "watchdog", "chaos", "retries", "store"):
         mod = importlib.import_module(f"paddle_tpu.distributed.{name}")
         globals()[name] = mod
         return mod

@@ -1,0 +1,256 @@
+"""Deterministic, seeded fault injection ("chaos") for the runtime.
+
+The reference survives preemptions/hangs with a C++ watchdog subsystem
+(comm_task_manager.cc) plus an elastic relaunch agent — but nothing in
+either tree can *prove* the recovery paths work, because there is no way
+to inject a fault on purpose. This module is that switch: named
+injection points threaded through the store RPC client, eager
+collectives, checkpoint I/O, the elastic signal path and the serving
+batcher, each firing deterministically from a seed so a chaos run is
+exactly reproducible (and bit-identical to a fault-free run after
+recovery, which the soak test asserts).
+
+Contract with the hot path: when chaos is disabled (the default), every
+injection point is a single module-attribute load + falsy branch —
+``if chaos.ENABLED: chaos.maybe_drop("site")``. No RNG, no dict lookup,
+no allocation. Enabling is explicit: `configure(...)` in-process, or the
+environment (read once at import):
+
+    PADDLE_TPU_CHAOS=1                       master switch
+    PADDLE_TPU_CHAOS_SEED=1234               decision seed (default 0)
+    PADDLE_TPU_CHAOS_RATES=store.client=0.3,ckpt.write.shards=1@1
+        comma list of site=probability; `@N` caps a site at N fires
+        (e.g. `1@1` = fire exactly once). A rate keyed by a PREFIX of
+        the site name matches (longest prefix wins), so `store=1`
+        covers every store.* site.
+    PADDLE_TPU_CHAOS_DELAY_MS=50             injected delay length
+    PADDLE_TPU_CHAOS_HANG_MS=0               extra hang on delay sites
+
+Determinism: each site keeps a fire counter; decision n at site s is
+uniform from sha256(f"{seed}:{s}:{n}") — independent of wall clock,
+process interleaving, or Python hash randomization. Two runs that make
+the same sequence of calls at a site see the same faults.
+
+Injection vocabulary (call the one matching the site's failure mode):
+    maybe_delay(site)           sleep delay_ms (+hang_ms) if it fires
+    maybe_drop(site)            raise InjectedConnectionDrop (an OSError
+                                subclass, so real network-error handling
+                                paths take it)
+    maybe_preempt(site)         SIGTERM to this process (the TPU
+                                maintenance-event signal)
+    maybe_corrupt_file(site, path)  tear the just-written file: truncate
+                                to half (torn write) or flip a byte mid-
+                                file (bit rot), alternating per fire
+    grad_poison(site)           1.0, or NaN when it fires (multiplied
+                                into gradients by the trainer)
+    should_fire(site)           the bare decision, for custom faults
+
+Everything is stdlib-only; importing this module never touches jax.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import signal
+import threading
+import time
+from contextlib import contextmanager
+
+__all__ = [
+    "ENABLED", "InjectedConnectionDrop", "InjectedFault", "configure",
+    "disable", "scoped", "should_fire", "maybe_delay", "maybe_drop",
+    "maybe_preempt", "maybe_corrupt_file", "grad_poison", "fire_count",
+    "fires", "site_rate",
+]
+
+
+class InjectedFault(RuntimeError):
+    """Base of faults raised (not simulated) by an injection point."""
+
+
+class InjectedConnectionDrop(ConnectionError, InjectedFault):
+    """A torn network connection. Subclasses ConnectionError so every
+    handler written for the real failure also handles the injected one."""
+
+
+# the ONE attribute hot paths branch on; everything else lives in _State
+ENABLED = False
+
+_lock = threading.Lock()
+
+
+class _State:
+    def __init__(self, seed=0, rates=None, delay_ms=50.0, hang_ms=0.0):
+        self.seed = int(seed)
+        # {site_prefix: (probability, max_fires | None)}
+        self.rates = dict(rates or {})
+        self.delay_ms = float(delay_ms)
+        self.hang_ms = float(hang_ms)
+        self.counters: dict[str, int] = {}   # decisions made per site
+        self.fired: dict[str, int] = {}      # faults fired per site
+
+
+_state = _State()
+
+
+def _parse_rates(spec: str) -> dict:
+    """`site=p[@N],site=p` -> {site: (p, N|None)}."""
+    rates = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, val = part.partition("=")
+        val, _, cap = val.partition("@")
+        rates[site.strip()] = (float(val), int(cap) if cap else None)
+    return rates
+
+
+def configure(seed=0, rates=None, delay_ms=50.0, hang_ms=0.0):
+    """Enable chaos with `rates` = {site_prefix: probability} or
+    {site_prefix: (probability, max_fires)}. Resets all counters."""
+    global ENABLED, _state
+    norm = {}
+    for k, v in (rates or {}).items():
+        norm[k] = tuple(v) if isinstance(v, (tuple, list)) else (float(v),
+                                                                 None)
+    with _lock:
+        _state = _State(seed, norm, delay_ms, hang_ms)
+        ENABLED = True
+
+
+def disable():
+    """Back to the zero-cost default; counters are kept for inspection."""
+    global ENABLED
+    ENABLED = False
+
+
+@contextmanager
+def scoped(seed=0, rates=None, delay_ms=50.0, hang_ms=0.0):
+    """Enable chaos for a `with` block (test harness form). Restores the
+    previous configuration — including disabled — on exit."""
+    global ENABLED, _state
+    with _lock:
+        prev = (ENABLED, _state)
+    configure(seed, rates, delay_ms, hang_ms)
+    try:
+        yield
+    finally:
+        with _lock:
+            ENABLED, _state = prev
+
+
+def _rate_for(site: str):
+    """Longest-prefix match of `site` against configured rates."""
+    rates = _state.rates
+    if site in rates:
+        return rates[site]
+    best = None
+    for k, v in rates.items():
+        if site.startswith(k) and (best is None or len(k) > len(best[0])):
+            best = (k, v)
+    return best[1] if best else (0.0, None)
+
+
+def site_rate(site: str) -> float:
+    return _rate_for(site)[0]
+
+
+def should_fire(site: str) -> bool:
+    """One deterministic decision for `site` (advances its counter)."""
+    if not ENABLED:
+        return False
+    with _lock:
+        p, cap = _rate_for(site)
+        n = _state.counters.get(site, 0)
+        _state.counters[site] = n + 1
+        if p <= 0.0:
+            return False
+        if cap is not None and _state.fired.get(site, 0) >= cap:
+            return False
+        h = hashlib.sha256(
+            f"{_state.seed}:{site}:{n}".encode()).digest()
+        u = int.from_bytes(h[:8], "big") / 2**64
+        if u >= p:
+            return False
+        _state.fired[site] = _state.fired.get(site, 0) + 1
+        return True
+
+
+def fire_count(site: str) -> int:
+    with _lock:
+        return _state.fired.get(site, 0)
+
+
+def fires() -> dict:
+    """Snapshot {site: fire count} of everything that fired so far."""
+    with _lock:
+        return dict(_state.fired)
+
+
+# -- fault actions ----------------------------------------------------------
+
+def maybe_delay(site: str) -> bool:
+    """Injected slow op (slow host / congested ICI). Returns whether it
+    fired, so callers can log."""
+    if should_fire(site):
+        time.sleep((_state.delay_ms + _state.hang_ms) / 1000.0)
+        return True
+    return False
+
+
+def maybe_drop(site: str) -> None:
+    """Injected dropped connection."""
+    if should_fire(site):
+        raise InjectedConnectionDrop(
+            f"chaos: injected connection drop at {site!r} "
+            f"(fire #{fire_count(site)})")
+
+
+def maybe_preempt(site: str) -> bool:
+    """Synthetic preemption: deliver SIGTERM to this process, exactly
+    what a TPU maintenance event does. Handlers installed by
+    ElasticManager (or anyone else) observe it; with no handler the
+    default action terminates the process — also realistic."""
+    if should_fire(site):
+        os.kill(os.getpid(), signal.SIGTERM)
+        return True
+    return False
+
+
+def maybe_corrupt_file(site: str, path: str) -> bool:
+    """Tear or corrupt a just-written file. Odd fires truncate to half
+    (a torn write at power loss); even fires flip one mid-file byte
+    (silent media corruption). Both must be caught by checkpoint
+    checksums/quarantine."""
+    if not should_fire(site):
+        return False
+    size = os.path.getsize(path)
+    nth = fire_count(site)
+    with open(path, "r+b") as f:
+        if nth % 2 == 1 or size < 2:
+            f.truncate(max(size // 2, 0))
+        else:
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+    return True
+
+
+def grad_poison(site: str) -> float:
+    """1.0 normally; NaN when the site fires. The trainer multiplies
+    this into the incoming gradients (trace-time gated: the factor only
+    exists in the compiled step while chaos is enabled)."""
+    return float("nan") if should_fire(site) else 1.0
+
+
+# -- env bootstrap (read once at import) ------------------------------------
+
+if os.environ.get("PADDLE_TPU_CHAOS") == "1":
+    configure(
+        seed=int(os.environ.get("PADDLE_TPU_CHAOS_SEED", "0")),
+        rates=_parse_rates(os.environ.get("PADDLE_TPU_CHAOS_RATES", "")),
+        delay_ms=float(os.environ.get("PADDLE_TPU_CHAOS_DELAY_MS", "50")),
+        hang_ms=float(os.environ.get("PADDLE_TPU_CHAOS_HANG_MS", "0")),
+    )

@@ -20,25 +20,51 @@ Plain (unsharded) tensors round-trip as single-shard entries.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+from contextlib import contextmanager
 
 import numpy as np
 import jax
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import chaos
+from paddle_tpu.distributed.retries import default_policy
 
 __all__ = ["save_state_dict", "load_state_dict", "finish_async_save",
-           "register_migration"]
+           "register_migration", "CheckpointCorruptionError",
+           "verify_checkpoint", "quarantine_corrupt",
+           "newest_complete_checkpoint", "load_newest_complete"]
 
 _META = "metadata.json"
+_QUARANTINE = ".quarantine"
+
+# file-write retry budget (transient I/O errors on network filesystems —
+# the reference's save path dies on the first EIO; gcsfuse hiccups are
+# routine at pod scale)
+_io_retry = default_policy(retryable=(OSError,))
 
 # checkpoint format version, stamped into metadata.json (reference:
 # paddle/phi/api/yaml/op_version.yaml — the reference versions ops so old
 # checkpoints keep loading; here the FORMAT itself is versioned and
 # migration hooks upgrade old merged tables on load).
-# v1: unstamped (r1-r3 checkpoints); v2: adds format_version stamp.
-_FORMAT_VERSION = 2
+# v1: unstamped (r1-r3 checkpoints); v2: adds format_version stamp;
+# v3: per-file sha256 checksums in each host table's "__files__" entry
+# (older checkpoints simply skip integrity verification on load).
+_FORMAT_VERSION = 3
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A shard/table file failed its recorded checksum (or cannot be
+    parsed). `bad_files` names them, relative to `path`."""
+
+    def __init__(self, path, bad_files):
+        self.path = path
+        self.bad_files = dict(bad_files)
+        super().__init__(
+            f"checkpoint {path!r} corrupt: " + "; ".join(
+                f"{f}: {why}" for f, why in self.bad_files.items()))
 
 # {from_version: fn(merged_tables, info) -> merged_tables} applied in
 # sequence on load until _FORMAT_VERSION is reached
@@ -214,15 +240,92 @@ def _snapshot_state(state_dict):
     return payload, meta, pid
 
 
+# Digest memo, active only inside one resume operation (scan + load):
+# the resume path verifies every shard in newest_complete_checkpoint and
+# load_state_dict checks each file again before np.load — without the
+# memo a multi-GB restart hashes every file twice. Scoped (not a global
+# stat cache) so separate calls always re-hash and later in-place
+# corruption is never masked by a stale entry.
+_digest_memo: dict | None = None
+
+
+@contextmanager
+def _digest_memo_scope():
+    global _digest_memo
+    prev = _digest_memo
+    if prev is None:
+        _digest_memo = {}
+    try:
+        yield
+    finally:
+        _digest_memo = prev
+
+
+def _sha256_file(path, chunk=1 << 20):
+    key = os.path.abspath(path)
+    if _digest_memo is not None and key in _digest_memo:
+        return _digest_memo[key]
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    if _digest_memo is not None:
+        _digest_memo[key] = h.hexdigest()
+    return h.hexdigest()
+
+
+def _atomic_write(final, write_fn):
+    """tmp-then-rename so a death mid-write never leaves a half file
+    under the final name; transient I/O errors retried per policy."""
+    tmp = final + ".tmp"
+
+    def attempt():
+        with open(tmp, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+    try:
+        _io_retry.run(attempt, desc=f"write {os.path.basename(final)}")
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
 def _write_files(payload, meta, pid, path, coordinator_rank):
     os.makedirs(path, exist_ok=True)
-    np.savez(os.path.join(path, f"shards_{pid}.npz"), **payload)
-    with open(os.path.join(path, f"table_{pid}.json"), "w") as f:
-        json.dump(meta, f, indent=1)
+    fname = f"shards_{pid}.npz"
+    shards_path = os.path.join(path, fname)
+    _atomic_write(shards_path, lambda f: np.savez(f, **payload))
+    # the digest is of the INTENDED bytes as they landed; recorded in
+    # this host's table so load verifies end-to-end (serialize -> media
+    # -> load)
+    table = dict(meta)
+    table["__files__"] = {fname: {"sha256": _sha256_file(shards_path),
+                                  "size": os.path.getsize(shards_path)}}
+    if chaos.ENABLED:
+        # torn/corrupted write AFTER the digest was taken: the failure
+        # atomic rename can't protect against (partial flush on power
+        # loss, silent media corruption) — what the checksum must catch
+        chaos.maybe_corrupt_file("ckpt.write.shards", shards_path)
+    _atomic_write(os.path.join(path, f"table_{pid}.json"),
+                  lambda f: f.write(
+                      json.dumps(table, indent=1).encode()))
+    if chaos.ENABLED:
+        chaos.maybe_corrupt_file("ckpt.write.table",
+                                 os.path.join(path, f"table_{pid}.json"))
     if pid == coordinator_rank:
-        with open(os.path.join(path, _META), "w") as f:
-            json.dump({"process_count": jax.process_count(),
-                       "format_version": _FORMAT_VERSION}, f, indent=1)
+        _atomic_write(os.path.join(path, _META),
+                      lambda f: f.write(json.dumps(
+                          {"process_count": jax.process_count(),
+                           "format_version": _FORMAT_VERSION},
+                          indent=1).encode()))
 
 
 _barrier_seq = 0
@@ -325,6 +428,8 @@ def _merged_tables(path):
         with open(os.path.join(path, fn)) as f:
             tbl = json.load(f)
         for name, entry in tbl.items():
+            if name.startswith("__"):   # reserved (file checksums etc.)
+                continue
             if name not in merged:
                 merged[name] = {"shape": entry["shape"],
                                 "dtype": entry["dtype"], "shards": [],
@@ -347,11 +452,217 @@ def _merged_tables(path):
 
 def _migrate(merged, version, info):
     """Upgrade old formats through registered migration hooks (v1 -> v2
-    needs none: the stamp is the only difference)."""
+    needs none: the stamp is the only difference; v2 -> v3 adds only the
+    checksum records, absent on old checkpoints)."""
     for v in range(version, _FORMAT_VERSION):
         if v in _MIGRATIONS:
             merged = _MIGRATIONS[v](merged, info)
     return merged
+
+
+# ---------------------------------------------------------------------------
+# integrity: verification, quarantine, newest-complete fallback
+# ---------------------------------------------------------------------------
+
+
+def _recorded_checksums(path):
+    """Union of every host table's "__files__" record (v3+). Empty for
+    pre-v3 checkpoints — they carry no integrity info, so loads of them
+    skip verification rather than fail."""
+    out = {}
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return out
+    for fn in names:
+        if fn.startswith("table_") and fn.endswith(".json"):
+            try:
+                with open(os.path.join(path, fn)) as f:
+                    out.update(json.load(f).get("__files__") or {})
+            except (OSError, ValueError):
+                continue    # unparseable table reported by verify/merge
+    return out
+
+
+def _check_file(path, fname, rec):
+    """None if `fname` matches its record, else a reason string. The
+    size check runs first: a torn (truncated) write is the common case
+    and the mismatch message should say so without hashing the file."""
+    fp = os.path.join(path, fname)
+    if not os.path.exists(fp):
+        return "missing"
+    if rec is None:
+        return None
+    size = os.path.getsize(fp)
+    if size != rec.get("size"):
+        return (f"size {size} != recorded {rec.get('size')} "
+                f"(torn write)")
+    if _sha256_file(fp) != rec.get("sha256"):
+        return "sha256 mismatch (corrupted)"
+    return None
+
+
+def verify_checkpoint(path):
+    """Integrity-check a checkpoint directory WITHOUT loading tensors:
+    metadata parse, expected host-table set, table parses, and every
+    recorded per-file checksum. Returns {filename: reason} — empty means
+    complete and intact."""
+    bad = {}
+    try:
+        with open(os.path.join(path, _META)) as f:
+            info = json.load(f)
+    except FileNotFoundError:
+        info = None
+    except (OSError, ValueError) as e:
+        return {_META: f"unreadable: {e}"}
+    version = int((info or {}).get("format_version", 1))
+    if version > _FORMAT_VERSION:
+        # not loadable by THIS build, but intact for a newer one — the
+        # fallback must skip it, and quarantine_corrupt must NOT gut it
+        return {_META: f"format_version {version} newer than supported "
+                       f"{_FORMAT_VERSION} (skip, do not quarantine)"}
+    expect = (info or {}).get("process_count")
+    if expect is not None:
+        tables = [f"table_{p}.json" for p in range(expect)]
+    else:
+        try:
+            tables = sorted(
+                fn for fn in os.listdir(path)
+                if fn.startswith("table_") and fn.endswith(".json"))
+        except OSError as e:
+            return {path: f"unreadable directory: {e}"}
+        if info is None and tables:
+            bad[_META] = ("missing (cannot prove the table set is "
+                          "complete)")
+    if not tables:
+        bad["table_*.json"] = "no shard tables"
+        return bad
+    for fn in tables:
+        fp = os.path.join(path, fn)
+        if not os.path.exists(fp):
+            bad[fn] = "missing host table (a host's save did not finish)"
+            continue
+        try:
+            with open(fp) as f:
+                tbl = json.load(f)
+        except (OSError, ValueError) as e:
+            bad[fn] = f"unparseable (torn write?): {e}"
+            continue
+        recs = tbl.get("__files__") or {}
+        for fname, rec in recs.items():
+            why = _check_file(path, fname, rec)
+            if why is not None:
+                bad[fname] = why
+        # pre-v3 tables carry no checksum records, but EXISTENCE of
+        # every referenced shard file is still checkable — without this
+        # a quarantined/lost npz leaves the checkpoint "verified" while
+        # unloadable (and the newest-complete fallback loops on it)
+        for name, entry in tbl.items():
+            if name.startswith("__"):
+                continue
+            for sh in entry.get("shards", ()):
+                fname = sh.get("file")
+                if fname and fname not in recs and fname not in bad \
+                        and not os.path.exists(os.path.join(path, fname)):
+                    bad[fname] = "missing shard file"
+    return bad
+
+
+def quarantine_corrupt(path, bad_files=None):
+    """Move corrupt/torn files into `path`/.quarantine/ — the directory
+    becomes visibly incomplete (it can never half-load) while the
+    evidence survives for postmortems. Returns the names moved."""
+    bad = bad_files if bad_files is not None else verify_checkpoint(path)
+    qdir = os.path.join(path, _QUARANTINE)
+    moved = []
+    for fn, why in bad.items():
+        if "do not quarantine" in str(why):
+            continue    # e.g. a newer-format checkpoint: intact, skip
+        src = os.path.join(path, fn)
+        if not os.path.isfile(src):
+            continue
+        os.makedirs(qdir, exist_ok=True)
+        os.replace(src, os.path.join(qdir, fn))
+        moved.append(fn)
+    return moved
+
+
+def _candidate_dirs(root):
+    """Checkpoint subdirectories of `root`, NEWEST FIRST. `step_{n}`
+    names order by step number; anything else by mtime. Directories
+    holding a .quarantine (a past scan already gutted them — they can
+    never verify complete again) are excluded outright, so repeated
+    resume scans don't re-hash their surviving multi-GB shards. A
+    candidate vanishing mid-scan (another host pruning, the expiry
+    path's rmtree) is skipped, not a crash — this runs on the recovery
+    path."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    out = []
+    for n in names:
+        d = os.path.join(root, n)
+        try:
+            if not os.path.isdir(d) or n == _QUARANTINE:
+                continue
+            if os.path.isdir(os.path.join(d, _QUARANTINE)):
+                continue
+            if not (os.path.exists(os.path.join(d, _META))
+                    or any(fn.startswith("table_")
+                           for fn in os.listdir(d))):
+                continue
+            mtime = os.path.getmtime(d)
+        except OSError:
+            continue    # removed between listdir and stat
+        if n.startswith("step_"):
+            try:
+                key = (1, int(n[5:]))
+            except ValueError:
+                key = (0, mtime)
+        else:
+            key = (0, mtime)
+        out.append((key, d))
+    return [d for _, d in sorted(out, reverse=True)]
+
+
+def newest_complete_checkpoint(root, quarantine=True):
+    """Newest subdirectory of `root` that verifies complete and intact;
+    corrupt newer candidates are quarantined (so the next scan skips
+    straight past them) and skipped — the fallback contract the elastic
+    restart loop relies on. Returns the path, or None."""
+    for d in _candidate_dirs(root):
+        issues = verify_checkpoint(d)
+        if not issues:
+            return d
+        if quarantine:
+            quarantine_corrupt(d, issues)
+    return None
+
+
+def load_newest_complete(state_dict, root, **kw):
+    """load_state_dict from the newest complete checkpoint under `root`,
+    falling back past quarantined/corrupt ones. Returns the directory
+    loaded, or None when no complete checkpoint exists."""
+    failed: dict = {}
+    while True:
+        with _digest_memo_scope():      # verify + load hash each file once
+            d = newest_complete_checkpoint(root)
+            if d is None:
+                return None
+            try:
+                load_state_dict(state_dict, d, **kw)
+                return d
+            except CheckpointCorruptionError as e:
+                # verification passed but the load still tripped (e.g. a
+                # file replaced between scan and read): quarantine, retry
+                if failed.get(d) == e.bad_files:
+                    # no progress since last pass (nothing left to move,
+                    # yet verification still passes) — re-raise rather
+                    # than loop on the same directory forever
+                    raise
+                failed[d] = e.bad_files
+                quarantine_corrupt(d, e.bad_files)
 
 
 def _overlap(t_offs, t_sizes, s_offs, s_sizes):
@@ -376,12 +687,24 @@ def load_state_dict(state_dict, path, process_group=None,
     # must wait for the writer (else a half-written directory loads)
     finish_async_save()
     meta = _merged_tables(path)
+    checksums = _recorded_checksums(path)
 
     files = {}
 
     def _file(fname):
+        """Open a shard file, verifying its recorded checksum first —
+        a torn/corrupted shard surfaces as CheckpointCorruptionError
+        (callers quarantine + fall back), never as a numpy parse crash
+        or silently wrong weights."""
         if fname not in files:
-            files[fname] = np.load(os.path.join(path, fname))
+            why = _check_file(path, fname, checksums.get(fname))
+            if why is not None:
+                raise CheckpointCorruptionError(path, {fname: why})
+            try:
+                files[fname] = np.load(os.path.join(path, fname))
+            except Exception as e:      # noqa: BLE001 — npz parse
+                raise CheckpointCorruptionError(
+                    path, {fname: f"unreadable npz: {e}"}) from e
         return files[fname]
 
     flat = _flatten_state(state_dict)

@@ -27,8 +27,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
+from paddle_tpu.core.jax_compat import shard_map
 from paddle_tpu.core.tensor import Tensor
 
 _AXIS = "_pg"
@@ -179,10 +179,14 @@ def _jit_collective(mesh, body, static_arg=None):
         # CommTask per NCCL op); completion is observed by the watchdog's
         # background completer, not a host sync here, so consecutive
         # eager collectives keep pipelining
-        from paddle_tpu.distributed import watchdog
+        from paddle_tpu.distributed import watchdog, chaos
         name = getattr(body, "__name__", "collective")
         op = watchdog.begin(f"collective/{name} mesh={dict(mesh.shape)}")
         try:
+            if chaos.ENABLED:
+                # a slow/hung host INSIDE the registered op's window, so
+                # the watchdog's deadline is what catches the hang
+                chaos.maybe_delay(f"collective.dispatch/{name}")
             out = jitted(*args)
         except BaseException:
             watchdog.end(op)

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from paddle_tpu.core.jax_compat import axis_size, shard_map
 from paddle_tpu.kernels.flash_attention import (
     _LSE_ROWS, _NEG_INF, _chunked_attention, flash_attention_bhsd)
 
@@ -118,7 +119,7 @@ def _ring_flash_step_fwd(q, k_cur, v_cur, mode, sm_scale, interpret,
 
 def _ring_flash_fwd_scan(q, k, v, axis_name, causal, sm_scale,
                          interpret, seg_len=None):
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -170,7 +171,7 @@ def _ring_flash_bwd_rule(axis_name, causal, sm_scale, interpret, seg_len,
                          res, g):
     from paddle_tpu.kernels.flash_attention import _flash_bwd_pallas
     q, k, v, o, lse = res
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     bq = min(_RING_BQ, seg_len if seg_len else q.shape[2])
@@ -254,7 +255,7 @@ def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None,
         rep = q.shape[1] // k.shape[1]
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     s_loc = q.shape[2]
     b, h, _, d = q.shape
@@ -282,7 +283,7 @@ def ulysses_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
     """Local view: q (B, S_local, H, D) seq-sharded. All-to-all to
     head-sharding, full-seq attention, all-to-all back (DeepSpeed-Ulysses;
     the reference's 'sep' axis ambition, topology.py:184, realised)."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     hq, hk = q.shape[2], k.shape[2]
     if hk != hq:                      # GQA: repeat kv to q heads first
         k = jnp.repeat(k, hq // hk, axis=2)
@@ -333,7 +334,7 @@ def ring_attention(q, k, v, mesh=None, axis="sp", causal=True,
         return jnp.swapaxes(out, 1, 2)
 
     spec = _attn_specs(mesh, axis)
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
@@ -351,7 +352,7 @@ def ulysses_attention(q, k, v, mesh=None, axis="sp", causal=True,
     local = functools.partial(ulysses_attention_local, axis_name=axis,
                               causal=causal, sm_scale=sm_scale)
     spec = _attn_specs(mesh, axis)
-    fn = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)
 

@@ -14,12 +14,16 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import signal
 import threading
 import time
 
+from paddle_tpu.distributed import chaos
+
 __all__ = ["ElasticManager", "ElasticSupervisor", "StoreHeartbeat",
-           "safe_barrier", "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
+           "safe_barrier", "run_resilient",
+           "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
 
 ELASTIC_AUTO_PARALLEL_EXIT_CODE = 101  # reference manager.py same code
 
@@ -89,6 +93,11 @@ class ElasticManager:
             try:
                 step = start
                 while step < total_steps:
+                    if chaos.ENABLED:
+                        # synthetic maintenance-event SIGTERM: lands on
+                        # the handler this manager installed, setting
+                        # the preempted flag checked after the chunk
+                        chaos.maybe_preempt("elastic.preempt")
                     end = min(step + checkpoint_interval, total_steps)
                     train_fn(step, end, self)
                     step = end
@@ -364,6 +373,168 @@ class StoreHeartbeat:
             if now - t > self.grace:
                 stale.append(r)
         return stale
+
+
+def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
+                  load_fn, checkpoint_interval=100, max_restarts=3,
+                  signals=(signal.SIGTERM,), watchdog_abort=True):
+    """The self-healing training loop: ties the islands — watchdog
+    expiry -> abort, preemption signal -> checkpoint, failure -> elastic
+    restart from the newest COMPLETE checkpoint — into one supervisor
+    (the in-process analog of the reference's comm_task_manager abort +
+    elastic relaunch agent).
+
+    Contract:
+      train_fn(start, end)   runs steps [start, end) deterministically
+                             from the currently-loaded state
+      save_fn(step, path)    writes a checkpoint at step boundary `step`
+                             (steps [0, step) are done) into `path`
+      load_fn(path)          restores training state from `path`
+
+    Checkpoints land in ``checkpoint_dir/step_{step:08d}``; resume
+    always goes through checkpoint.newest_complete_checkpoint, so a
+    torn/corrupt checkpoint (power loss, chaos injection) is quarantined
+    and the loop falls back to the previous complete one — recomputing
+    the lost steps rather than loading garbage. With deterministic
+    train_fn the final state is bit-identical to a fault-free run.
+
+    Faults that trigger a restart: any exception out of train_fn/save
+    (including retry-budget exhaustion and watchdog CommTimeoutError), a
+    watchdog op expiring (polled between chunks when `watchdog_abort`),
+    and a preemption signal (checkpoint is already on disk; the loop
+    reloads and continues — in production the scheduler would kill and
+    relaunch the process, landing in the same resume path). Gives up
+    after `max_restarts`.
+
+    Returns {"steps": completed, "restarts": n, "resumed_from": last
+    checkpoint dir used}.
+    """
+    from paddle_tpu.distributed import checkpoint as ckpt_mod
+    from paddle_tpu.distributed import watchdog
+
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    mgr = ElasticManager(checkpoint_dir=None, max_restarts=max_restarts,
+                         signals=signals)
+    restarts = 0
+    resumed_from = None
+
+    def _step_of(d):
+        try:
+            return int(os.path.basename(d).split("_", 1)[1])
+        except (IndexError, ValueError):
+            return 0
+
+    def _save(step):
+        path = os.path.join(checkpoint_dir, f"step_{step:08d}")
+        if os.path.isdir(path):
+            # a stale/quarantined artifact of a previous attempt at
+            # this same step — clear it so the fresh save is a clean,
+            # resumable candidate (a lingering .quarantine would hide
+            # the new good checkpoint from the resume scan)
+            shutil.rmtree(path, ignore_errors=True)
+        save_fn(step, path)
+        return path
+
+    try:
+        # always have a restore point: without the step-0 checkpoint, a
+        # failure in the FIRST chunk would restart train_fn(0, ...) on
+        # top of the failed attempt's partially-mutated in-memory state
+        # — silently breaking the bit-identical recovery contract
+        if ckpt_mod.newest_complete_checkpoint(checkpoint_dir) is None:
+            _save(0)
+        last_load_failure = None
+        # `dirty` = train_fn has mutated in-memory state since the last
+        # successful restore (or pristine start); only then is a
+        # no-checkpoint restart unrecoverable
+        dirty = False
+        while True:
+            with ckpt_mod._digest_memo_scope():
+                # scan + load verify the same files; hash each once
+                newest = ckpt_mod.newest_complete_checkpoint(
+                    checkpoint_dir)
+                start = 0
+                if newest is not None:
+                    try:
+                        load_fn(newest)
+                    except ckpt_mod.CheckpointCorruptionError as e:
+                        # verified complete but unloadable (e.g. pre-v3
+                        # with a torn shard — no checksums to catch it
+                        # at scan time): quarantine and fall back to an
+                        # older checkpoint instead of aborting the run
+                        if last_load_failure == (newest,
+                                                 str(e.bad_files)):
+                            raise   # no progress; don't loop forever
+                        last_load_failure = (newest, str(e.bad_files))
+                        ckpt_mod.quarantine_corrupt(newest, e.bad_files)
+                        continue
+                    start = _step_of(newest)
+                    resumed_from = newest
+                    dirty = False
+                elif dirty:
+                    # a restart with MUTATED in-memory state and nothing
+                    # to restore (every checkpoint quarantined, incl.
+                    # step 0's): training on would silently break the
+                    # deterministic-recovery contract. (A restart with
+                    # pristine state — e.g. the step-0 save itself was
+                    # torn before any training — just re-runs from 0.)
+                    raise RuntimeError(
+                        "run_resilient: restart requested but no "
+                        "complete checkpoint remains to restore from "
+                        f"(checkpoint_dir={checkpoint_dir!r}); aborting "
+                        "rather than training on a dirty state")
+            wd_base = watchdog.expired_count() if watchdog_abort else 0
+            try:
+                step = start
+                while step < total_steps:
+                    if chaos.ENABLED:
+                        chaos.maybe_preempt("elastic.preempt")
+                    if mgr.preempted:
+                        # a checkpoint for `step` is already on disk
+                        # (or step 0's); restart from it
+                        mgr._preempted = False
+                        raise _Preempted()
+                    end = min(step + checkpoint_interval, total_steps)
+                    dirty = True
+                    train_fn(step, end)
+                    step = end
+                    # a chunk during which a collective hung/aborted
+                    # must not become the newest-complete resume: poll
+                    # expiry before persisting, and AGAIN after (eager
+                    # collectives complete asynchronously, so a deadline
+                    # can blow while the save is writing) — a late
+                    # expiry discards the checkpoint just written
+                    if watchdog_abort and \
+                            watchdog.expired_count() > wd_base:
+                        raise watchdog.CommTimeoutError(
+                            "watchdog expiry during training: "
+                            + watchdog.last_expired())
+                    saved = _save(step)
+                    if watchdog_abort and \
+                            watchdog.expired_count() > wd_base:
+                        shutil.rmtree(saved, ignore_errors=True)
+                        raise watchdog.CommTimeoutError(
+                            "watchdog expiry while checkpointing: "
+                            + watchdog.last_expired())
+                return {"steps": total_steps, "restarts": restarts,
+                        "resumed_from": resumed_from}
+            except _Preempted:
+                restarts += 1
+                if restarts > max_restarts:
+                    raise RuntimeError(
+                        f"run_resilient: max_restarts={max_restarts} "
+                        "exhausted after repeated preemptions") from None
+            except Exception:
+                restarts += 1
+                if restarts > max_restarts:
+                    raise
+                # fall through: reload from the newest complete
+                # checkpoint and recompute the lost steps
+    finally:
+        mgr.close()
+
+
+class _Preempted(Exception):
+    """Internal: unwind the chunk loop after a preemption signal."""
 
 
 def safe_barrier(store, name, rank, world_size, timeout, heartbeat=None):

@@ -1,0 +1,102 @@
+"""Shared retry/backoff/deadline policy for transient distributed faults.
+
+The reference scatters ad-hoc retry loops through its store client and
+elastic agent; here every retryable surface (store RPC ops, checkpoint
+file I/O) goes through ONE policy object so budgets are visible and
+testable. A policy is immutable and cheap; call `run(fn)` (or use it as
+a decorator) and it retries `fn` on the configured exception types with
+exponential backoff, honoring both an attempt budget and a wall-clock
+deadline.
+
+Retryable vs fatal is decided by exception TYPE: pass the typed errors
+(e.g. store.StoreConnectionError) as `retryable`; anything else
+propagates on the first throw. `on_retry(attempt, exc)` lets callers
+re-establish state between attempts (the store client reconnects its
+socket there).
+
+Env override: PADDLE_TPU_RETRY_MAX_ATTEMPTS / PADDLE_TPU_RETRY_DEADLINE_S
+set the defaults for policies built with `default_policy()`.
+"""
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+__all__ = ["RetryPolicy", "RetryBudgetExceeded", "default_policy"]
+
+
+class RetryBudgetExceeded(RuntimeError):
+    """All attempts (or the deadline) exhausted; `last` is the final
+    underlying exception, also chained as __cause__."""
+
+    def __init__(self, msg, last):
+        super().__init__(msg)
+        self.last = last
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    max_attempts: int = 3             # total tries, not re-tries
+    base_delay: float = 0.05          # first backoff sleep (seconds)
+    max_delay: float = 2.0            # backoff cap
+    multiplier: float = 2.0
+    deadline: float | None = None     # wall-clock budget across attempts
+    retryable: tuple = (ConnectionError, TimeoutError)
+    # sleep hook — tests swap in a no-op to run fast
+    sleep: object = field(default=time.sleep, repr=False)
+
+    def delays(self):
+        d = self.base_delay
+        while True:
+            yield min(d, self.max_delay)
+            d *= self.multiplier
+
+    def run(self, fn, *args, desc=None, on_retry=None, **kwargs):
+        """Call fn(*args, **kwargs), retrying on `retryable` errors with
+        exponential backoff until attempts or deadline run out."""
+        start = time.monotonic()
+        last = None
+        gen = self.delays()
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return fn(*args, **kwargs)
+            except self.retryable as e:   # noqa: PERF203 — the point
+                last = e
+                if attempt >= self.max_attempts:
+                    break
+                delay = next(gen)
+                if self.deadline is not None and (
+                        time.monotonic() - start + delay > self.deadline):
+                    break
+                self.sleep(delay)
+                if on_retry is not None:
+                    try:
+                        on_retry(attempt, e)
+                    except Exception:   # noqa: BLE001 — recovery is
+                        pass            # best-effort; next try reports
+        raise RetryBudgetExceeded(
+            f"{desc or getattr(fn, '__name__', 'op')} failed after "
+            f"{self.max_attempts} attempts "
+            f"({time.monotonic() - start:.2f}s): {last!r}", last) from last
+
+    def __call__(self, fn):
+        """Decorator form."""
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*a, **k):
+            return self.run(fn, *a, **k)
+        return wrapped
+
+
+def default_policy(**overrides) -> RetryPolicy:
+    """Policy with env-tunable attempt/deadline budgets."""
+    kw = dict(
+        max_attempts=int(os.environ.get(
+            "PADDLE_TPU_RETRY_MAX_ATTEMPTS", "3")),
+        deadline=float(os.environ["PADDLE_TPU_RETRY_DEADLINE_S"])
+        if "PADDLE_TPU_RETRY_DEADLINE_S" in os.environ else None,
+    )
+    kw.update(overrides)
+    return RetryPolicy(**kw)

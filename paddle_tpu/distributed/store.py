@@ -18,12 +18,41 @@ import socket
 import struct
 import threading
 import time
+from contextlib import contextmanager
 
 from paddle_tpu import _native
+from paddle_tpu.distributed import chaos
+from paddle_tpu.distributed.retries import default_policy
 
-__all__ = ["Store", "TCPStore"]
+__all__ = ["Store", "TCPStore", "StoreError", "StoreConnectionError",
+           "StoreTimeoutError", "StoreKeyError"]
 
 _MASTER_KEY_PREFIX = "/paddle_tpu/"
+
+
+# -- typed error hierarchy --------------------------------------------------
+# Raw socket errors (ECONNRESET, timeouts, short reads) are mapped to
+# these so the retry policy can tell retryable transport failures from
+# fatal/semantic ones. Each also subclasses the builtin callers already
+# catch (TimeoutError/ConnectionError/KeyError), so existing handlers —
+# barrier diagnostics, elastic heartbeats — keep working unchanged.
+
+class StoreError(RuntimeError):
+    """Base of every store failure."""
+
+
+class StoreConnectionError(StoreError, ConnectionError):
+    """Transport-level failure (reset, short read, closed socket).
+    Retryable: the op never completed, or its reply was lost."""
+
+
+class StoreTimeoutError(StoreError, TimeoutError):
+    """Server-side wait/get timeout. Semantic, NOT retryable: the key
+    genuinely did not appear within the budget."""
+
+
+class StoreKeyError(StoreError, KeyError):
+    """Key not found (server-reported). Fatal for the issued op."""
 
 
 class Store:
@@ -46,13 +75,13 @@ def _raise_rc(op: str, key: str, rc: int):
     """Map native client return codes: -1=-kTimeout, -2=-kNotFound,
     -3=-kError (server-reported); -100 = transport failure."""
     if rc == -1:
-        raise TimeoutError(f"store {op}({key}) timed out")
+        raise StoreTimeoutError(f"store {op}({key}) timed out")
     if rc == -2:
-        raise KeyError(f"store {op}({key}): key not found")
+        raise StoreKeyError(f"store {op}({key}): key not found")
     if rc == -100:
-        raise ConnectionError(
+        raise StoreConnectionError(
             f"store {op}({key}): lost connection to the store server")
-    raise RuntimeError(f"store {op}({key}) failed: rc={rc}")
+    raise StoreError(f"store {op}({key}) failed: rc={rc}")
 
 
 def _to_bytes(value) -> bytes:
@@ -182,24 +211,50 @@ class _PyStoreServer:
 
 
 class _PyStoreClient:
+    """Protocol client over one TCP socket.
+
+    Raw socket failures (ECONNRESET, short reads, broken pipes, socket
+    timeouts) surface as the typed StoreConnectionError so TCPStore's
+    retry policy can distinguish them from semantic failures; after one
+    the wire protocol state is undefined, so `reconnect()` (a fresh
+    socket) is the only valid recovery — TCPStore calls it between
+    retry attempts."""
+
     def __init__(self, host, port, timeout):
+        self._host, self._port, self._timeout = host, port, timeout
+        self._lock = threading.Lock()
+        self._sock = self._connect(timeout)
+
+    def _connect(self, timeout):
         deadline = time.monotonic() + timeout
         last_err = None
         while True:
             try:
-                self._sock = socket.create_connection((host, port), timeout=5)
+                sock = socket.create_connection((self._host, self._port),
+                                                timeout=5)
                 break
             except OSError as e:
                 last_err = e
                 if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"connect to store {host}:{port} timed out") from e
+                    raise StoreTimeoutError(
+                        f"connect to store {self._host}:{self._port} "
+                        f"timed out") from (last_err or e)
                 time.sleep(0.05)
         # blocking semantics from here on: waits are bounded by the
         # server-side timeout in the protocol, not the connect timeout
-        self._sock.settimeout(None)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._lock = threading.Lock()
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def reconnect(self, timeout=10.0):
+        """Tear down the (possibly mid-protocol) socket and dial a fresh
+        one. Safe to call after any StoreConnectionError."""
+        with self._lock:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = self._connect(timeout)
 
     def close(self):
         try:
@@ -215,7 +270,8 @@ class _PyStoreClient:
         while len(buf) < n:
             chunk = self._sock.recv(n - len(buf))
             if not chunk:
-                raise ConnectionError("store connection closed")
+                raise StoreConnectionError(
+                    "store connection closed (short read)")
             buf += chunk
         return buf
 
@@ -223,57 +279,72 @@ class _PyStoreClient:
         (n,) = struct.unpack("<I", self._recv_all(4))
         return self._recv_all(n) if n else b""
 
-    def set(self, key, value):
+    @contextmanager
+    def _io(self, op, key):
+        """Map raw socket errors inside one locked protocol exchange to
+        the typed hierarchy (socket.timeout is an OSError subclass and
+        must NOT become StoreTimeoutError: the transport stalled, the
+        server never answered — that is a connection problem)."""
         with self._lock:
+            try:
+                yield
+            except StoreError:
+                raise
+            except (OSError, EOFError) as e:
+                raise StoreConnectionError(
+                    f"store {op}({key}): transport failure: {e}") from e
+
+    def set(self, key, value):
+        with self._io("set", key):
             self._sock.sendall(b"\x00")
             self._send_bytes(key.encode())
             self._send_bytes(value)
             st = self._recv_all(1)[0]
             if st != 0:
-                raise RuntimeError(f"store set({key}) failed: {st}")
+                raise StoreError(f"store set({key}) failed: {st}")
 
     def get(self, key, timeout_ms):
-        with self._lock:
+        with self._io("get", key):
             self._sock.sendall(b"\x01")
             self._send_bytes(key.encode())
             self._sock.sendall(struct.pack("<q", timeout_ms))
             st = self._recv_all(1)[0]
             if st == 1:
-                raise TimeoutError(f"store get({key}) timed out")
+                raise StoreTimeoutError(f"store get({key}) timed out")
             if st != 0:
-                raise RuntimeError(f"store get({key}) failed: {st}")
+                raise StoreError(f"store get({key}) failed: {st}")
             return self._recv_bytes()
 
     def add(self, key, delta):
-        with self._lock:
+        with self._io("add", key):
             self._sock.sendall(b"\x02")
             self._send_bytes(key.encode())
             self._sock.sendall(struct.pack("<q", delta))
             st = self._recv_all(1)[0]
             if st != 0:
-                raise RuntimeError(f"store add({key}) failed: {st}")
+                raise StoreError(f"store add({key}) failed: {st}")
             (v,) = struct.unpack("<q", self._recv_all(8))
             return v
 
     def wait(self, key, timeout_ms):
-        with self._lock:
+        with self._io("wait", key):
             self._sock.sendall(b"\x03")
             self._send_bytes(key.encode())
             self._sock.sendall(struct.pack("<q", timeout_ms))
             st = self._recv_all(1)[0]
             if st == 1:
-                raise TimeoutError(f"store wait({key}) timed out")
+                raise StoreTimeoutError(f"store wait({key}) timed out")
             if st != 0:
-                raise RuntimeError(f"store wait({key}) failed: {st}")
+                raise StoreError(f"store wait({key}) failed: {st}")
 
     def check(self, key):
-        with self._lock:
+        with self._io("check", key):
             self._sock.sendall(b"\x04")
             self._send_bytes(key.encode())
             return self._recv_all(1)[0] == 0
 
     def delete(self, key):
-        with self._lock:
+        with self._io("delete", key):
             self._sock.sendall(b"\x05")
             self._send_bytes(key.encode())
             return self._recv_all(1)[0] == 0
@@ -294,12 +365,24 @@ class TCPStore(Store):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  is_master: bool = False, timeout: float = 300.0,
-                 world_size: int | None = None, prefix: str = ""):
+                 world_size: int | None = None, prefix: str = "",
+                 retry_policy=None):
         self._lib = _native.load()
         self._timeout = timeout
         self._prefix = prefix
         self._server = None
         self._native_server = None
+        # transport-failure retry: StoreConnectionError means the op (or
+        # its reply) was lost on the wire; reconnect and reissue. Wait/
+        # get TIMEOUTS are semantic and never retried. Note `add` is not
+        # idempotent — a reply lost AFTER the server applied it double-
+        # counts on retry, so exact-count protocols must not build on
+        # it (barrier() uses idempotent per-rank set()s for this
+        # reason); add-based counters are safe only when overcount is
+        # tolerable (monotonic progress markers compared with >=).
+        self._retry = retry_policy if retry_policy is not None \
+            else default_policy(retryable=(ConnectionError,))
+        self._barrier_rounds: dict = {}   # local per-name round index
         self.host = host
         if is_master:
             if self._lib is not None:
@@ -326,94 +409,179 @@ class TCPStore(Store):
     def _k(self, key: str) -> str:
         return _MASTER_KEY_PREFIX + self._prefix + key
 
+    def _reconnect(self, attempt, exc):
+        """Between retry attempts: the old connection's protocol state
+        is garbage after a transport failure — dial a fresh one."""
+        if self._native_client:
+            if self._client:
+                self._lib.pt_store_client_free(self._client)
+            self._client = self._lib.pt_store_client_new(
+                self.host.encode(), self.port,
+                int(self._timeout * 1000))
+            if not self._client:
+                raise StoreConnectionError(
+                    f"reconnect to store {self.host}:{self.port} failed")
+        else:
+            self._client.reconnect()
+
+    def _run(self, desc, fn):
+        """Every public op goes through here: chaos injection point
+        `store.client` (delay + dropped connection) ahead of the wire
+        op, transport failures retried per policy with a reconnect
+        between attempts. Disabled chaos costs one attribute check."""
+        def attempt():
+            if self._native_client and not self._client:
+                # a previous reconnect failed and left no handle (the
+                # on_retry hook's failure is swallowed by the policy);
+                # re-dial HERE so the raise is retryable instead of the
+                # NULL handle masquerading as an instant rc=-1 timeout
+                self._reconnect(0, None)
+            if chaos.ENABLED:
+                chaos.maybe_delay("store.client")
+                chaos.maybe_drop("store.client")
+            return fn()
+        return self._retry.run(attempt, desc=desc,
+                               on_retry=self._reconnect)
+
     def set(self, key: str, value) -> None:
         data = _to_bytes(value)
-        if self._native_client:
-            buf = (ctypes.c_uint8 * max(len(data), 1)).from_buffer_copy(
-                data or b"\x00")
-            rc = self._lib.pt_store_set(self._client, self._k(key).encode(),
-                                        buf, len(data))
-            if rc != 0:
-                _raise_rc("set", key, rc)
-        else:
-            self._client.set(self._k(key), data)
+
+        def op():
+            if self._native_client:
+                buf = (ctypes.c_uint8 * max(len(data), 1)).from_buffer_copy(
+                    data or b"\x00")
+                rc = self._lib.pt_store_set(
+                    self._client, self._k(key).encode(), buf, len(data))
+                if rc != 0:
+                    _raise_rc("set", key, rc)
+            else:
+                self._client.set(self._k(key), data)
+        return self._run(f"store.set({key})", op)
+
+    @staticmethod
+    def _budget_ms(deadline):
+        """Remaining server-side timeout for one attempt, so a retried
+        wait/get never blocks for more than the CALLER's total budget
+        (a reconnect mid-wait must not restart the clock)."""
+        return max(1, int((deadline - time.monotonic()) * 1000))
 
     def get(self, key: str, timeout: float | None = None) -> bytes:
-        tmo = int((self._timeout if timeout is None else timeout) * 1000)
-        if self._native_client:
-            out = ctypes.POINTER(ctypes.c_uint8)()
-            out_len = ctypes.c_int64()
-            rc = self._lib.pt_store_get(self._client, self._k(key).encode(),
-                                        tmo, ctypes.byref(out),
-                                        ctypes.byref(out_len))
-            if rc != 0:
-                _raise_rc("get", key, rc)
-            return _native._take_bytes(self._lib, out, out_len)
-        return self._client.get(self._k(key), tmo)
+        deadline = time.monotonic() + (
+            self._timeout if timeout is None else timeout)
+
+        def op():
+            tmo = self._budget_ms(deadline)
+            if self._native_client:
+                out = ctypes.POINTER(ctypes.c_uint8)()
+                out_len = ctypes.c_int64()
+                rc = self._lib.pt_store_get(
+                    self._client, self._k(key).encode(), tmo,
+                    ctypes.byref(out), ctypes.byref(out_len))
+                if rc != 0:
+                    _raise_rc("get", key, rc)
+                return _native._take_bytes(self._lib, out, out_len)
+            return self._client.get(self._k(key), tmo)
+        return self._run(f"store.get({key})", op)
 
     def add(self, key: str, delta: int = 1) -> int:
-        if self._native_client:
-            out = ctypes.c_int64()
-            rc = self._lib.pt_store_add(self._client, self._k(key).encode(),
-                                        delta, ctypes.byref(out))
-            if rc != 0:
-                _raise_rc("add", key, rc)
-            return out.value
-        return self._client.add(self._k(key), delta)
+        def op():
+            if self._native_client:
+                out = ctypes.c_int64()
+                rc = self._lib.pt_store_add(
+                    self._client, self._k(key).encode(), delta,
+                    ctypes.byref(out))
+                if rc != 0:
+                    _raise_rc("add", key, rc)
+                return out.value
+            return self._client.add(self._k(key), delta)
+        return self._run(f"store.add({key})", op)
 
     def wait(self, key: str, timeout: float | None = None) -> None:
-        tmo = int((self._timeout if timeout is None else timeout) * 1000)
-        if self._native_client:
-            rc = self._lib.pt_store_wait(self._client, self._k(key).encode(),
-                                         tmo)
-            if rc != 0:
-                _raise_rc("wait", key, rc)
-        else:
-            self._client.wait(self._k(key), tmo)
+        deadline = time.monotonic() + (
+            self._timeout if timeout is None else timeout)
+
+        def op():
+            tmo = self._budget_ms(deadline)
+            if self._native_client:
+                rc = self._lib.pt_store_wait(
+                    self._client, self._k(key).encode(), tmo)
+                if rc != 0:
+                    _raise_rc("wait", key, rc)
+            else:
+                self._client.wait(self._k(key), tmo)
+        return self._run(f"store.wait({key})", op)
 
     def check(self, key: str) -> bool:
-        if self._native_client:
-            return self._lib.pt_store_check(
-                self._client, self._k(key).encode()) == 1
-        return self._client.check(self._k(key))
+        def op():
+            if self._native_client:
+                return self._lib.pt_store_check(
+                    self._client, self._k(key).encode()) == 1
+            return self._client.check(self._k(key))
+        return self._run(f"store.check({key})", op)
 
     def delete_key(self, key: str) -> bool:
-        if self._native_client:
-            return self._lib.pt_store_delete(
-                self._client, self._k(key).encode()) == 1
-        return self._client.delete(self._k(key))
+        def op():
+            if self._native_client:
+                return self._lib.pt_store_delete(
+                    self._client, self._k(key).encode()) == 1
+            return self._client.delete(self._k(key))
+        return self._run(f"store.delete({key})", op)
 
     # -- composite ops -----------------------------------------------------
     def barrier(self, name: str, rank: int, world_size: int | None = None,
                 timeout: float | None = None) -> None:
         """All `world_size` callers block until every one has arrived.
 
-        Reusable: arrival n belongs to round (n-1)//ws, and each round has
-        its own done-key, so calling barrier("epoch", ...) every epoch
-        re-synchronizes instead of falling through on the stale done flag.
-        """
+        Reusable: each caller keeps a LOCAL round counter per barrier
+        name (a barrier is collective — every rank calls it the same
+        number of times), and each round has its own key namespace, so
+        calling barrier("epoch", ...) every epoch re-synchronizes
+        instead of falling through on a stale done flag.
+
+        Retry-safe by construction: arrival is an idempotent per-rank
+        set(), not a shared counter add() — a reply lost to a connection
+        drop and re-sent cannot double-count a rank (an add-based count
+        skews round arithmetic for every later round). Whichever
+        rank(s) observe the full arrival set mark done; done is also a
+        set(), so racing markers are harmless.
+
+        Elastic relaunches namespace by PADDLE_ELASTIC_ATTEMPT: the
+        supervisor restarts the WHOLE world with a fresh attempt id, so
+        restarted clients (local rounds back at 0) never fall through
+        the previous life's stale done keys. The marker rank deletes
+        the previous round's keys, bounding server state to ~one round
+        per barrier name."""
         from paddle_tpu.distributed import watchdog
         ws = world_size or self.world_size
         if not ws:
             raise ValueError("barrier needs world_size")
-        n = self.add(f"barrier/{name}/count", 1)
-        round_idx = (n - 1) // ws
-        done_key = f"barrier/{name}/done/{round_idx}"
-        if n % ws == 0:
+        round_idx = self._barrier_rounds.get(name, 0)
+        self._barrier_rounds[name] = round_idx + 1
+        attempt = os.environ.get("PADDLE_ELASTIC_ATTEMPT", "")
+        pre = f"barrier/a{attempt}/{name}/{round_idx}"
+        done_key = f"{pre}/done"
+        self.set(f"{pre}/arrive/{rank}", b"1")
+        if all(self.check(f"{pre}/arrive/{r}") for r in range(ws)):
             self.set(done_key, b"1")
+            if round_idx > 0:   # GC the completed previous round
+                prev = f"barrier/a{attempt}/{name}/{round_idx - 1}"
+                for r in range(ws):
+                    self.delete_key(f"{prev}/arrive/{r}")
+                self.delete_key(f"{prev}/done")
         tmo_ms = int((timeout or self._timeout) * 1000)
         with watchdog.watch(f"store.barrier/{name} rank={rank}", tmo_ms):
             try:
                 self.wait(done_key, timeout)
             except Exception as e:
                 try:
-                    arrived = int(self.get(
-                        f"barrier/{name}/count").decode())
+                    arrived = sum(
+                        self.check(f"{pre}/arrive/{r}")
+                        for r in range(ws))
                 except Exception:
-                    arrived = n
+                    arrived = 1
                 raise RuntimeError(
                     f"store barrier '{name}' timed out on rank {rank}: "
-                    f"{arrived % ws or ws}/{ws} ranks arrived in round "
+                    f"{arrived}/{ws} ranks arrived in round "
                     f"{round_idx} — a peer is dead or hung "
                     f"(original: {e})") from e
 

@@ -132,11 +132,20 @@ class DynamicBatcher:
         return batch
 
     def _loop(self):
+        from paddle_tpu.distributed import chaos
         while not self._stop:
             batch = self._take_batch()
             if not batch:
                 continue
             try:
+                if chaos.ENABLED:
+                    # a slow backend (serving.batch.delay) and a failed
+                    # batch run (serving.batch.fail): the error must fan
+                    # out to every waiter, never wedge the loop
+                    chaos.maybe_delay("serving.batch.delay")
+                    if chaos.should_fire("serving.batch.fail"):
+                        raise chaos.InjectedFault(
+                            "chaos: injected batch failure")
                 n_in = len(batch[0].inputs)
                 merged = [np.concatenate([p.inputs[i] for p in batch], 0)
                           for i in range(n_in)]

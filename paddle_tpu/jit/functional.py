@@ -20,6 +20,9 @@ from typing import Any
 
 import jax
 
+# NOTE: for shard_map over functional_call, import it from
+# paddle_tpu.core.jax_compat — the bare jax spellings are
+# version-fragile (tools/check_jax_compat.py enforces this)
 from paddle_tpu.core.tape import no_grad, push_tape, pop_tape
 from paddle_tpu.core.tensor import Tensor
 

@@ -22,6 +22,11 @@ import math
 import jax
 import jax.numpy as jnp
 
+# version-safe axis_size (the bare jax.lax spelling is version-fragile;
+# callers wrapping the ep-local entry points in shard_map should import
+# it from paddle_tpu.core.jax_compat too)
+from paddle_tpu.core.jax_compat import axis_size
+
 
 def _one_hot(x, n, dtype=jnp.float32):
     return jax.nn.one_hot(x, n, dtype=dtype)
@@ -162,7 +167,7 @@ def moe_dropless_mlp_ep_local(xt, router_w, wg, wu, wd, k, axis_name,
     token_axes + (axis_name,))."""
     t_l, d = xt.shape
     e_l = wg.shape[0]
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     e = e_l * p
     n = t_l * k

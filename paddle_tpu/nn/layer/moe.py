@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 import paddle_tpu
+from paddle_tpu.core.jax_compat import shard_map
 from paddle_tpu.core.dispatch import defop
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.nn.layer.layers import Layer
@@ -97,7 +98,7 @@ def moe_dropless_ep(x, router_w, wg, wu, wd, k, mesh, axis="ep",
             token_axes=batch, buffer_rows=buffer_rows)
         return out.reshape(xl.shape), aux
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local, mesh=mesh,
         in_specs=(x_spec, P(), w_spec, w_spec, w_spec),
         out_specs=(x_spec, P()), check_vma=False)

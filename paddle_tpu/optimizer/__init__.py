@@ -22,6 +22,9 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor, Parameter
 from paddle_tpu.core.tape import no_grad
+# NOTE: ZeRO-style sharded-update composition (module docstring) should
+# import shard_map from paddle_tpu.core.jax_compat — the bare jax
+# spellings are version-fragile (tools/check_jax_compat.py enforces it)
 from paddle_tpu.optimizer import lr as lr_mod
 from paddle_tpu.optimizer.lr import LRScheduler
 

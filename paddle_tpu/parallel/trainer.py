@@ -52,6 +52,27 @@ class TrainStepConfig:
     # 8 bytes/param of HBM for activations/batch at the cost of a
     # host<->HBM round trip per step. TPU-native via jax memory kinds.
     offload_opt_state: bool = False
+    # non-finite-gradient skip (reference: the check_nan_inf + GradScaler
+    # found-inf skip the reference applies under fp16): when any grad
+    # (or the loss) is Inf/NaN the whole update is suppressed in-jit —
+    # params and optimizer state pass through unchanged — and the step
+    # reports skipped=True. Opt-in: enabling adds an isfinite reduction
+    # + per-param selects to the compiled step, so the default keeps the
+    # hot path byte-identical.
+    skip_nonfinite_grads: bool = False
+    # consecutive skipped steps before the trainer ABORTS (a diverged
+    # run burning pod-hours silently is worse than a crash; bounded like
+    # the reference's FLAGS_check_nan_inf hard stop)
+    max_consecutive_nonfinite: int = 25
+    # how many steps of skip flags to buffer before the host reads them
+    # (each read syncs on that step; 1 = check every step, larger keeps
+    # more dispatch pipelining and still aborts within the window)
+    nonfinite_check_every: int = 1
+
+
+class NonFiniteGradError(RuntimeError):
+    """max_consecutive_nonfinite steps in a row produced Inf/NaN grads —
+    the run has diverged; aborting beats silently skipping forever."""
 
 
 def _cast_tree(tree, dtype):
@@ -117,6 +138,11 @@ class Trainer:
             self.config.offload_opt_state = True
         self._loss_fn = loss_fn
         self._step_fn = None
+        self._chaos_poison = False
+        # non-finite skip bookkeeping (host side)
+        self._pending_skips: list = []
+        self.nonfinite_streak = 0
+        self.nonfinite_skipped = 0
         self._init_state()
 
     # -- state -------------------------------------------------------------
@@ -205,6 +231,12 @@ class Trainer:
     def _build_step(self, batch_treedef):
         cfg = self.config
         mesh = self.mesh
+        # chaos injection "trainer.grad" is gated at TRACE time: with
+        # chaos off the compiled step has no poison input at all — the
+        # hot path stays byte-identical
+        from paddle_tpu.distributed import chaos
+        self._chaos_poison = bool(chaos.ENABLED
+                                  and chaos.site_rate("trainer.grad") > 0)
 
         def loss_for(params, batch):
             params_c = _cast_tree(params, cfg.compute_dtype)
@@ -227,11 +259,17 @@ class Trainer:
         grad_fn = jax.value_and_grad(
             lambda tp, fp, b: loss_for({**fp, **tp}, b))
 
-        def step(params, opt_state, lr, batch):
-            with self._precision_ctx():
-                return _step_inner(params, opt_state, lr, batch)
+        if self._chaos_poison:
+            def step(params, opt_state, lr, batch, poison):
+                with self._precision_ctx():
+                    return _step_inner(params, opt_state, lr, batch,
+                                       poison)
+        else:
+            def step(params, opt_state, lr, batch):
+                with self._precision_ctx():
+                    return _step_inner(params, opt_state, lr, batch)
 
-        def _step_inner(params, opt_state, lr, batch):
+        def _step_inner(params, opt_state, lr, batch, poison=None):
             train_p = {n: params[n] for n in self.param_names}
             frozen_p = {n: v for n, v in params.items()
                         if n not in train_p}
@@ -255,6 +293,8 @@ class Trainer:
                 grads = jax.tree.map(lambda g: g / n_mb, grads)
             else:
                 loss, grads = grad_fn(train_p, frozen_p, batch)
+            if poison is not None:
+                grads = jax.tree.map(lambda g: g * poison, grads)
             return self._apply_update(loss, grads, params, opt_state, lr)
 
         return self._jit_step(step)
@@ -274,7 +314,9 @@ class Trainer:
                 else contextlib.nullcontext())
 
     def _apply_update(self, loss, grads, params, opt_state, lr):
-        """Shared step epilogue: f32 grads + opt barrier + optimizer."""
+        """Shared step epilogue: f32 grads + opt barrier + optimizer;
+        with skip_nonfinite_grads the whole update is suppressed in-jit
+        when any grad (or the loss) is Inf/NaN."""
         grads = _opt_barrier(
             jax.tree.map(lambda g: g.astype(jnp.float32), grads),
             self.config)
@@ -289,6 +331,21 @@ class Trainer:
         train_p = {n: params[n] for n in self.param_names}
         new_p, new_s = self.optimizer.apply_gradients_arrays(
             train_p, grads, opt_state, lr)
+        if self.config.skip_nonfinite_grads:
+            finite = jnp.isfinite(loss)
+            for g in grads.values():
+                finite = jnp.logical_and(finite,
+                                         jnp.all(jnp.isfinite(g)))
+            # select, don't branch: one program for both outcomes, and
+            # every rank takes the same path by construction
+            new_p = {n: jnp.where(finite, v, train_p[n])
+                     for n, v in new_p.items()}
+            new_s = jax.tree.map(lambda new, old: jnp.where(finite, new,
+                                                            old),
+                                 new_s, opt_state)
+            out_params = dict(params)
+            out_params.update(new_p)
+            return loss, out_params, new_s, jnp.logical_not(finite)
         out_params = dict(params)
         out_params.update(new_p)
         return loss, out_params, new_s
@@ -303,6 +360,8 @@ class Trainer:
         park = "pinned_host" if self.config.offload_opt_state else None
         if park:
             donate = (0,) if self.config.donate else ()
+        # optional extra input (chaos grad poison) / output (skip flag)
+        extra_in = (None,) if self._chaos_poison else ()
         if mesh is not None:
             pspec = {n: NamedSharding(mesh, self._spec(n))
                      for n in self.params}
@@ -310,17 +369,21 @@ class Trainer:
                          for k, v in st.items()}
                      for n, st in self.opt_state.items()}
             rep = NamedSharding(mesh, P())
+            extra_out = (rep,) if self.config.skip_nonfinite_grads else ()
             return jax.jit(
                 step, donate_argnums=donate,
-                in_shardings=(pspec, sspec, rep, None),
-                out_shardings=(rep, pspec, sspec))
+                in_shardings=(pspec, sspec, rep, None) + extra_in,
+                out_shardings=(rep, pspec, sspec) + extra_out)
         if park:
             sspec = {n: {k: self._opt_leaf_sharding(n, v, park)
                          for k, v in st.items()}
                      for n, st in self.opt_state.items()}
+            extra_out = (None,) if self.config.skip_nonfinite_grads \
+                else ()
             return jax.jit(step, donate_argnums=donate,
-                           in_shardings=(None, sspec, None, None),
-                           out_shardings=(None, None, sspec))
+                           in_shardings=(None, sspec, None, None)
+                           + extra_in,
+                           out_shardings=(None, None, sspec) + extra_out)
         return jax.jit(step, donate_argnums=donate)
 
     # -- public API --------------------------------------------------------
@@ -349,14 +412,46 @@ class Trainer:
             # fresh host->device transfer every step costs several ms
             # through the axon dispatch tunnel
             self._lr_cache = (lrv, jnp.asarray(lrv, jnp.float32))
+        args = (self.params, self.opt_state, self._lr_cache[1], batch)
+        if self._chaos_poison:
+            from paddle_tpu.distributed import chaos
+            args += (jnp.asarray(chaos.grad_poison("trainer.grad"),
+                                 jnp.float32),)
         # enter the mesh context for the (first-call) trace so
         # sharding-aware custom vjps (e.g. the embedding grad reshard in
         # nn/functional/common.py) can read the axis names
         with self._mesh_ctx():
-            loss, self.params, self.opt_state = self._step_fn(
-                self.params, self.opt_state, self._lr_cache[1], batch)
+            out = self._step_fn(*args)
+        if self.config.skip_nonfinite_grads:
+            loss, self.params, self.opt_state, skipped = out
+            self._note_skip(skipped)
+        else:
+            loss, self.params, self.opt_state = out
         self.optimizer._step_count += 1
         return Tensor(loss, stop_gradient=True)
+
+    def _note_skip(self, flag):
+        """Track consecutive non-finite skips without a per-step host
+        sync: flags buffer until nonfinite_check_every of them pend,
+        then one blocking read drains the batch; crossing
+        max_consecutive_nonfinite raises NonFiniteGradError (the run
+        has diverged — checkpoint-and-abort beats skipping forever)."""
+        self._pending_skips.append(flag)
+        if len(self._pending_skips) < max(
+                1, self.config.nonfinite_check_every):
+            return
+        pending, self._pending_skips = self._pending_skips, []
+        for f in pending:
+            if bool(np.asarray(f)):
+                self.nonfinite_streak += 1
+                self.nonfinite_skipped += 1
+            else:
+                self.nonfinite_streak = 0
+        if self.nonfinite_streak >= self.config.max_consecutive_nonfinite:
+            raise NonFiniteGradError(
+                f"{self.nonfinite_streak} consecutive steps produced "
+                f"non-finite gradients (limit "
+                f"{self.config.max_consecutive_nonfinite}); aborting")
 
     def _mesh_ctx(self):
         import contextlib
@@ -371,11 +466,13 @@ class Trainer:
         if self._step_fn is None:
             self._step_fn = self._build_step(None)
         lr = jnp.asarray(self._lr_value(), jnp.float32)
+        args = (self.params, self.opt_state, lr, batch)
+        if self._chaos_poison:
+            args += (jnp.asarray(1.0, jnp.float32),)
         # same mesh context as step(): AOT lowering must see the ambient
         # mesh or sharding-aware vjps silently degrade
         with self._mesh_ctx():
-            return self._step_fn.lower(self.params, self.opt_state, lr,
-                                       batch)
+            return self._step_fn.lower(*args)
 
     def sync_to_model(self):
         """Write the trainer's param arrays back into the Layer tree (for

@@ -1,0 +1,582 @@
+"""Chaos fault-injection layer + the hardening it exercises.
+
+Tier-1 (fast, CPU, seeded): store ops survive injected connection drops
+via retry; a torn checkpoint shard is quarantined and load falls back to
+the previous complete checkpoint; a non-finite gradient step is skipped
+with training state unchanged; the serving batcher fans an injected
+failure to its waiters without wedging. One slow-marked soak drives
+run_resilient() through injected preemption + torn checkpoint + store
+drops and asserts the final parameters are bit-identical to a
+fault-free run.
+"""
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed import chaos
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed import elastic
+from paddle_tpu.distributed.retries import (RetryPolicy,
+                                            RetryBudgetExceeded)
+from paddle_tpu.distributed.store import (TCPStore, StoreError,
+                                          StoreConnectionError,
+                                          StoreTimeoutError, StoreKeyError)
+
+
+# ---------------------------------------------------------------------------
+# the chaos switch itself
+# ---------------------------------------------------------------------------
+
+def test_chaos_disabled_by_default():
+    assert chaos.ENABLED is False
+    assert chaos.should_fire("anything") is False
+
+
+def test_chaos_deterministic_and_capped():
+    def draw():
+        with chaos.scoped(seed=42, rates={"x": 0.5}):
+            return [chaos.should_fire("x") for _ in range(64)]
+    a, b = draw(), draw()
+    assert a == b                       # same seed -> same decisions
+    assert any(a) and not all(a)        # a 0.5 rate actually mixes
+    with chaos.scoped(seed=42, rates={"x": (1.0, 3)}):
+        fired = sum(chaos.should_fire("x") for _ in range(10))
+    assert fired == 3                   # @cap honored
+
+    with chaos.scoped(seed=7, rates={"x": 0.5}):
+        c = [chaos.should_fire("x") for _ in range(64)]
+    assert c != a                       # different seed -> different run
+
+
+def test_chaos_prefix_match_and_env_spec():
+    with chaos.scoped(seed=0, rates={"store": 1.0,
+                                     "store.client.special": 0.0}):
+        assert chaos.should_fire("store.client")        # prefix
+        assert not chaos.should_fire("store.client.special")  # longest wins
+        assert not chaos.should_fire("ckpt.write")
+    spec = chaos._parse_rates("a=0.5,b=1@2")
+    assert spec == {"a": (0.5, None), "b": (1.0, 2)}
+
+
+def test_scoped_restores_previous_state():
+    with chaos.scoped(seed=1, rates={"x": 1.0}):
+        assert chaos.ENABLED
+        with chaos.scoped(seed=2, rates={"y": 1.0}):
+            assert not chaos.should_fire("x")
+            assert chaos.should_fire("y")
+        assert chaos.should_fire("x")
+    assert chaos.ENABLED is False
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+def test_retry_policy_retries_then_succeeds():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("boom")
+        return "ok"
+
+    pol = RetryPolicy(max_attempts=3, base_delay=0, sleep=lambda s: None)
+    assert pol.run(flaky) == "ok"
+    assert calls["n"] == 3
+
+
+def test_retry_policy_budget_and_fatal():
+    pol = RetryPolicy(max_attempts=2, base_delay=0, sleep=lambda s: None)
+    with pytest.raises(RetryBudgetExceeded) as ei:
+        pol.run(lambda: (_ for _ in ()).throw(ConnectionError("x")))
+    assert isinstance(ei.value.last, ConnectionError)
+    # non-retryable types propagate untouched on the FIRST attempt
+    calls = {"n": 0}
+
+    def fatal():
+        calls["n"] += 1
+        raise KeyError("nope")
+    with pytest.raises(KeyError):
+        pol.run(fatal)
+    assert calls["n"] == 1
+
+
+def test_retry_policy_on_retry_hook():
+    seen = []
+    pol = RetryPolicy(max_attempts=3, base_delay=0, sleep=lambda s: None)
+    state = {"n": 0}
+
+    def op():
+        state["n"] += 1
+        if state["n"] < 2:
+            raise TimeoutError("t")
+        return state["n"]
+    assert pol.run(op, on_retry=lambda a, e: seen.append((a, type(e)))) \
+        == 2
+    assert seen == [(1, TimeoutError)]
+
+
+# ---------------------------------------------------------------------------
+# store: typed errors + retry under injected drops
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def store():
+    s = TCPStore(is_master=True, world_size=1, timeout=5.0)
+    yield s
+    s.close()
+
+
+def test_store_typed_error_hierarchy():
+    # typed errors still satisfy the builtin handlers callers already use
+    assert issubclass(StoreConnectionError, ConnectionError)
+    assert issubclass(StoreConnectionError, StoreError)
+    assert issubclass(StoreTimeoutError, TimeoutError)
+    assert issubclass(StoreKeyError, KeyError)
+    assert issubclass(chaos.InjectedConnectionDrop, ConnectionError)
+
+
+def test_store_wait_timeout_is_semantic_not_retried(store):
+    # a missing key times out with the typed error, quickly (no retry
+    # loop multiplying the wait)
+    with pytest.raises(TimeoutError):
+        store.wait("never-set", timeout=0.2)
+
+
+def test_store_ops_survive_injected_drops(store):
+    """(a) store ops succeed under injected connection drops via retry."""
+    store.set("pre", b"1")
+    with chaos.scoped(seed=11, rates={"store.client": (1.0, 4)},
+                      delay_ms=1):
+        store.set("k", b"v")
+        assert store.get("k") == b"v"
+        assert store.add("ctr", 2) == 2
+        assert store.check("k") is True
+        assert chaos.fire_count("store.client") == 4
+    # back to normal after the scope
+    assert store.get("pre") == b"1"
+
+
+def test_barrier_retry_safe_under_drops(store):
+    """Barrier arithmetic must survive retried ops: arrival is an
+    idempotent per-rank set(), so a retry after a dropped reply cannot
+    double-count a rank and skew later rounds (code-review finding)."""
+    import threading
+    peer = TCPStore(store.host, store.port, is_master=False, timeout=5.0)
+    errs = []
+
+    def go(s, rank):
+        try:
+            for _ in range(3):          # several rounds stay in sync
+                s.barrier("b", rank, world_size=2, timeout=10.0)
+        except Exception as e:          # noqa: BLE001
+            errs.append(e)
+
+    with chaos.scoped(seed=5, rates={"store.client": (0.3, 6)},
+                      delay_ms=1):
+        t1 = threading.Thread(target=go, args=(store, 0))
+        t2 = threading.Thread(target=go, args=(peer, 1))
+        t1.start()
+        t2.start()
+        t1.join(30)
+        t2.join(30)
+    peer.close()
+    assert errs == []
+
+
+def test_store_drop_exhausts_budget_raises(store):
+    # drops beyond the retry budget surface as RetryBudgetExceeded
+    # with the underlying (injected) connection error chained
+    with chaos.scoped(seed=1, rates={"store.client": 1.0}, delay_ms=0):
+        with pytest.raises(RetryBudgetExceeded) as ei:
+            store.get("k2", timeout=0.5)
+    assert isinstance(ei.value.last, ConnectionError)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity
+# ---------------------------------------------------------------------------
+
+def _save(value, path):
+    sd = {"w": paddle_tpu.to_tensor(np.asarray(value, np.float32))}
+    ckpt.save_state_dict(sd, path)
+
+
+def _load(path, shape=(3, 4)):
+    sd = {"w": paddle_tpu.to_tensor(np.zeros(shape, np.float32))}
+    ckpt.load_state_dict(sd, path)
+    return np.asarray(sd["w"]._value)
+
+
+def test_checkpoint_checksums_roundtrip(tmp_path):
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    _save(w, str(tmp_path / "c"))
+    assert ckpt.verify_checkpoint(str(tmp_path / "c")) == {}
+    np.testing.assert_array_equal(_load(str(tmp_path / "c")), w)
+
+
+def test_checkpoint_detects_bitflip_and_truncation(tmp_path):
+    _save(np.ones((3, 4)), str(tmp_path / "c"))
+    shard = tmp_path / "c" / "shards_0.npz"
+    data = shard.read_bytes()
+    # bit flip mid-file
+    shard.write_bytes(data[:50] + bytes([data[50] ^ 0xFF]) + data[51:])
+    issues = ckpt.verify_checkpoint(str(tmp_path / "c"))
+    assert "shards_0.npz" in issues and "sha256" in issues["shards_0.npz"]
+    # truncation reported as a torn write
+    shard.write_bytes(data[: len(data) // 2])
+    issues = ckpt.verify_checkpoint(str(tmp_path / "c"))
+    assert "torn" in issues["shards_0.npz"]
+    with pytest.raises(ckpt.CheckpointCorruptionError):
+        _load(str(tmp_path / "c"))
+
+
+def test_torn_shard_quarantined_and_fallback(tmp_path):
+    """(b) torn shard -> quarantine + fall back to previous complete."""
+    root = str(tmp_path)
+    _save(np.full((3, 4), 1.0), os.path.join(root, "step_00000010"))
+    _save(np.full((3, 4), 2.0), os.path.join(root, "step_00000020"))
+    # the newest save lands torn (chaos fires on the shard write)
+    with chaos.scoped(seed=3, rates={"ckpt.write.shards": (1.0, 1)}):
+        _save(np.full((3, 4), 3.0), os.path.join(root, "step_00000030"))
+    assert ckpt.verify_checkpoint(os.path.join(root, "step_00000030"))
+
+    sd = {"w": paddle_tpu.to_tensor(np.zeros((3, 4), np.float32))}
+    loaded = ckpt.load_newest_complete(sd, root)
+    assert loaded == os.path.join(root, "step_00000020")
+    np.testing.assert_array_equal(np.asarray(sd["w"]._value),
+                                  np.full((3, 4), 2.0, np.float32))
+    # the torn file moved aside, evidence preserved
+    q = os.path.join(root, "step_00000030", ".quarantine")
+    assert os.path.exists(os.path.join(q, "shards_0.npz"))
+    # scan now skips the gutted directory without re-verifying
+    assert ckpt.newest_complete_checkpoint(root) == \
+        os.path.join(root, "step_00000020")
+
+
+def test_missing_shard_without_checksums_skipped_not_looped(tmp_path):
+    """Pre-v3 checkpoint (no checksum records) with a lost shard file:
+    the fallback must skip it on the existence check, not loop forever
+    (code-review finding)."""
+    import json
+    root = str(tmp_path)
+    _save(np.full((3, 4), 1.0), os.path.join(root, "step_00000010"))
+    _save(np.full((3, 4), 2.0), os.path.join(root, "step_00000020"))
+    d = tmp_path / "step_00000020"
+    tbl = json.loads((d / "table_0.json").read_text())
+    tbl.pop("__files__")                    # simulate pre-v3
+    (d / "table_0.json").write_text(json.dumps(tbl))
+    os.remove(d / "shards_0.npz")           # ... with a lost npz
+    assert "shards_0.npz" in ckpt.verify_checkpoint(str(d))
+    sd = {"w": paddle_tpu.to_tensor(np.zeros((3, 4), np.float32))}
+    loaded = ckpt.load_newest_complete(sd, root)
+    assert loaded == os.path.join(root, "step_00000010")
+    np.testing.assert_array_equal(np.asarray(sd["w"]._value),
+                                  np.full((3, 4), 1.0, np.float32))
+
+
+def test_newer_format_checkpoint_skipped_intact(tmp_path):
+    """A checkpoint from a NEWER build is skipped by the fallback but
+    never quarantined/gutted — a newer build must still be able to load
+    it (code-review finding)."""
+    import json
+    root = str(tmp_path)
+    _save(np.full((3, 4), 1.0), os.path.join(root, "step_00000010"))
+    _save(np.full((3, 4), 2.0), os.path.join(root, "step_00000020"))
+    meta_p = tmp_path / "step_00000020" / "metadata.json"
+    meta = json.loads(meta_p.read_text())
+    meta["format_version"] = ckpt._FORMAT_VERSION + 1
+    meta_p.write_text(json.dumps(meta))
+
+    sd = {"w": paddle_tpu.to_tensor(np.zeros((3, 4), np.float32))}
+    loaded = ckpt.load_newest_complete(sd, root)
+    assert loaded == os.path.join(root, "step_00000010")
+    # the newer checkpoint is untouched: no quarantine dir, files intact
+    d = tmp_path / "step_00000020"
+    assert not (d / ".quarantine").exists()
+    assert (d / "shards_0.npz").exists() and meta_p.exists()
+
+
+def test_old_checkpoints_without_checksums_still_load(tmp_path):
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    _save(w, str(tmp_path / "c"))
+    # strip the v3 integrity record to simulate a pre-v3 checkpoint
+    import json
+    tbl_p = tmp_path / "c" / "table_0.json"
+    tbl = json.loads(tbl_p.read_text())
+    tbl.pop("__files__")
+    tbl_p.write_text(json.dumps(tbl))
+    meta_p = tmp_path / "c" / "metadata.json"
+    meta = json.loads(meta_p.read_text())
+    meta["format_version"] = 2
+    meta_p.write_text(json.dumps(meta))
+    np.testing.assert_array_equal(_load(str(tmp_path / "c")), w)
+
+
+# ---------------------------------------------------------------------------
+# trainer: non-finite grad skip
+# ---------------------------------------------------------------------------
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, input_ids=None, labels=None):
+        return ((self.fc(input_ids) - labels) ** 2).mean()
+
+
+def _trainer(**cfg_kw):
+    from paddle_tpu.parallel.trainer import Trainer, TrainStepConfig
+    m = _Net()
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    cfg = TrainStepConfig(compute_dtype=None, donate=False,
+                          shard_batch_seq=False, **cfg_kw)
+    return Trainer(m, o, config=cfg)
+
+
+def _batch():
+    return {"input_ids": np.ones((2, 4), np.float32),
+            "labels": np.zeros((2, 4), np.float32)}
+
+
+def test_nonfinite_grad_step_skipped_state_unchanged():
+    """(c) a non-finite gradient step is skipped; state is unchanged."""
+    with chaos.scoped(seed=2, rates={"trainer.grad": (1.0, 1)}):
+        t = _trainer(skip_nonfinite_grads=True, nonfinite_check_every=1,
+                     max_consecutive_nonfinite=10)
+        p0 = {n: np.asarray(v).copy() for n, v in t.params.items()}
+        s0 = {n: {k: np.asarray(v).copy() for k, v in st.items()}
+              for n, st in t.opt_state.items()}
+        t.step(_batch())                  # poisoned -> skipped
+        assert t.nonfinite_skipped == 1 and t.nonfinite_streak == 1
+        for n in p0:
+            np.testing.assert_array_equal(p0[n],
+                                          np.asarray(t.params[n]))
+        for n in s0:
+            for k in s0[n]:
+                np.testing.assert_array_equal(
+                    s0[n][k], np.asarray(t.opt_state[n][k]))
+        t.step(_batch())                  # cap hit: healthy again
+        assert t.nonfinite_skipped == 1 and t.nonfinite_streak == 0
+        assert not np.array_equal(p0["fc.weight"],
+                                  np.asarray(t.params["fc.weight"]))
+
+
+def test_nonfinite_poison_then_recovery_matches_clean_run():
+    """A poisoned+skipped step must leave state EXACTLY as before it."""
+    # clean: one healthy step (seed pinned so both nets init identically)
+    paddle_tpu.seed(1234)
+    t_clean = _trainer(skip_nonfinite_grads=True)
+    t_clean.step(_batch())
+    # chaos: poisoned step first (skipped), then the same healthy step
+    with chaos.scoped(seed=2, rates={"trainer.grad": (1.0, 1)}):
+        paddle_tpu.seed(1234)
+        t_chaos = _trainer(skip_nonfinite_grads=True)
+        t_chaos.step(_batch())            # poisoned -> skipped
+        assert t_chaos.nonfinite_skipped == 1
+        t_chaos.step(_batch())            # healthy
+    for n in t_clean.params:
+        np.testing.assert_array_equal(np.asarray(t_clean.params[n]),
+                                      np.asarray(t_chaos.params[n]))
+
+
+def test_consecutive_nonfinite_aborts():
+    from paddle_tpu.parallel.trainer import NonFiniteGradError
+    with chaos.scoped(seed=2, rates={"trainer.grad": 1.0}):
+        t = _trainer(skip_nonfinite_grads=True, nonfinite_check_every=1,
+                     max_consecutive_nonfinite=3)
+        t.step(_batch())
+        t.step(_batch())
+        with pytest.raises(NonFiniteGradError):
+            t.step(_batch())
+
+
+def test_default_step_has_no_skip_output():
+    # hot path: skip disabled -> plain 3-tuple step, no poison input
+    t = _trainer()
+    t.step(_batch())
+    assert t._chaos_poison is False
+    assert t._pending_skips == []
+
+
+# ---------------------------------------------------------------------------
+# serving batcher under chaos
+# ---------------------------------------------------------------------------
+
+def test_batcher_injected_failure_fans_out_then_recovers():
+    from paddle_tpu.inference.serving import DynamicBatcher
+    b = DynamicBatcher(lambda arrays: [a * 2 for a in arrays],
+                       max_batch=4, timeout_ms=1.0)
+    try:
+        with chaos.scoped(seed=9,
+                          rates={"serving.batch.fail": (1.0, 1)}):
+            with pytest.raises(chaos.InjectedFault):
+                b.submit([np.ones((1, 2), np.float32)])
+            # the loop survived the injected failure and keeps serving
+            out = b.submit([np.ones((1, 2), np.float32)])
+        np.testing.assert_array_equal(out[0],
+                                      np.full((1, 2), 2.0, np.float32))
+    finally:
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# run_resilient: fast (numpy state) end-to-end + slow soak (real Trainer)
+# ---------------------------------------------------------------------------
+
+class _ToyState:
+    """Deterministic toy training state: w <- w * 1.01 + step.
+    float32 so the checkpoint roundtrip is exactly value-preserving."""
+
+    def __init__(self):
+        self.w = np.zeros(4, np.float32)
+
+    def train_fn(self, start, end):
+        for s in range(start, end):
+            self.w = (self.w * np.float32(1.01)
+                      + np.float32(s)).astype(np.float32)
+
+    def save_fn(self, step, path):
+        sd = {"w": paddle_tpu.to_tensor(self.w)}
+        ckpt.save_state_dict(sd, path)
+
+    def load_fn(self, path):
+        sd = {"w": paddle_tpu.to_tensor(np.zeros(4, np.float32))}
+        ckpt.load_state_dict(sd, path)
+        self.w = np.asarray(sd["w"]._value)
+
+
+def test_run_resilient_faultfree_and_chaos_bitidentical(tmp_path, store):
+    """Acceptance: chaos at a fixed seed injecting a store drop, a torn
+    checkpoint shard AND a synthetic preemption; run_resilient finishes
+    the step budget and the final state is bit-identical to the
+    fault-free run."""
+    class StoreToy(_ToyState):
+        # each chunk also reports progress through the rendezvous store
+        # (retry path under injected drops)
+        def train_fn(self, start, end):
+            super().train_fn(start, end)
+            store.set("progress", str(end))
+
+    ref = StoreToy()
+    res = elastic.run_resilient(ref.train_fn, 40, str(tmp_path / "a"),
+                                ref.save_fn, ref.load_fn,
+                                checkpoint_interval=10, max_restarts=3)
+    assert res["steps"] == 40 and res["restarts"] == 0
+
+    st = StoreToy()
+    # preempt rate < 1 so the (deterministic) fire lands mid-run, after
+    # checkpoints exist — forcing a real resume-from-checkpoint
+    with chaos.scoped(seed=13, rates={"elastic.preempt": (0.5, 2),
+                                      "ckpt.write.shards": (0.5, 1),
+                                      "store.client": (0.5, 2)},
+                      delay_ms=1):
+        res2 = elastic.run_resilient(st.train_fn, 40,
+                                     str(tmp_path / "b"), st.save_fn,
+                                     st.load_fn, checkpoint_interval=10,
+                                     max_restarts=10)
+        fired = chaos.fires()
+    assert res2["steps"] == 40
+    assert res2["restarts"] >= 1
+    assert res2["resumed_from"] is not None       # real resume happened
+    assert fired.get("elastic.preempt", 0) >= 1   # all three fault
+    assert fired.get("ckpt.write.shards", 0) >= 1  # classes actually
+    assert fired.get("store.client", 0) >= 1       # fired
+    assert store.get("progress") == b"40"
+    np.testing.assert_array_equal(ref.w, st.w)   # bit-identical
+
+
+def test_run_resilient_first_chunk_failure_restores_initial_state(
+        tmp_path):
+    """A failure BEFORE the first interval checkpoint must restart from
+    the pristine step-0 state, not on top of the failed attempt's
+    partial updates (code-review finding)."""
+    st = _ToyState()
+    boom = {"armed": True}
+
+    def flaky_train(a, b):
+        mid = (a + b) // 2
+        st.train_fn(a, mid)                 # mutate half the chunk...
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("transient fault mid-chunk")
+        st.train_fn(mid, b)
+
+    res = elastic.run_resilient(flaky_train, 10, str(tmp_path / "d"),
+                                st.save_fn, st.load_fn,
+                                checkpoint_interval=10, max_restarts=3)
+    assert res["restarts"] == 1
+    clean = _ToyState()
+    clean.train_fn(0, 10)
+    np.testing.assert_array_equal(clean.w, st.w)
+
+
+def test_run_resilient_gives_up_after_max_restarts(tmp_path):
+    def bad_train(start, end):
+        raise RuntimeError("always broken")
+    st = _ToyState()
+    with pytest.raises(RuntimeError, match="always broken"):
+        elastic.run_resilient(bad_train, 10, str(tmp_path / "c"),
+                              st.save_fn, st.load_fn,
+                              checkpoint_interval=5, max_restarts=2)
+
+
+@pytest.mark.slow
+def test_soak_run_resilient_real_trainer_bitidentical(tmp_path):
+    """Soak: the REAL compiled Trainer driven by run_resilient through
+    injected preemption + torn checkpoint + store-free faults; final
+    parameters bit-identical to the fault-free run."""
+    def batch_for(s):
+        rng = np.random.RandomState(s)          # deterministic per step
+        return {"input_ids": rng.randn(2, 4).astype(np.float32),
+                "labels": rng.randn(2, 4).astype(np.float32)}
+
+    def make():
+        paddle_tpu.seed(1234)
+        t = _trainer(skip_nonfinite_grads=True)
+        return t
+
+    def run(root, steps=12, interval=3):
+        t = make()
+
+        def train_fn(start, end):
+            for s in range(start, end):
+                t.step(batch_for(s))
+
+        def save_fn(step, path):
+            sd = {n: paddle_tpu.to_tensor(v)
+                  for n, v in t.params.items()}
+            ckpt.save_state_dict(sd, path)
+
+        def load_fn(path):
+            sd = {n: paddle_tpu.to_tensor(np.zeros(v.shape,
+                                                   np.asarray(v).dtype))
+                  for n, v in t.params.items()}
+            ckpt.load_state_dict(sd, path)
+            for n in t.params:
+                t.params[n] = sd[n]._value
+        res = elastic.run_resilient(train_fn, steps, root, save_fn,
+                                    load_fn, checkpoint_interval=interval,
+                                    max_restarts=10)
+        return res, {n: np.asarray(v).copy() for n, v in t.params.items()}
+
+    res_ref, p_ref = run(str(tmp_path / "ref"))
+    assert res_ref["restarts"] == 0
+
+    with chaos.scoped(seed=21, rates={"elastic.preempt": (1.0, 1),
+                                      "ckpt.write.shards": (1.0, 1)}):
+        res_chaos, p_chaos = run(str(tmp_path / "chaos"))
+        fired = chaos.fires()
+    assert res_chaos["steps"] == res_ref["steps"]
+    assert res_chaos["restarts"] >= 1
+    assert fired.get("elastic.preempt", 0) >= 1
+    assert fired.get("ckpt.write.shards", 0) >= 1
+    for n in p_ref:
+        np.testing.assert_array_equal(p_ref[n], p_chaos[n])

@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import pytest
 
 import paddle_tpu
+from paddle_tpu.core.jax_compat import shard_map
 from paddle_tpu.distributed.mesh import init_mesh
 from paddle_tpu.distributed.context_parallel import (
     ring_attention, ulysses_attention)
@@ -114,7 +115,7 @@ def test_flash_ring_matches_jnp_ring_interpret():
             return cp.ring_attention_local(
                 ql, kl, vl, "sp", causal=True, use_flash=use_flash,
                 interpret=use_flash)
-        f = jax.shard_map(local, mesh=mesh.jax_mesh,
+        f = shard_map(local, mesh=mesh.jax_mesh,
                           in_specs=(P(None, None, "sp", None),) * 3,
                           out_specs=P(None, None, "sp", None),
                           check_vma=False)
@@ -151,7 +152,7 @@ def test_flash_ring_noncausal_and_fallback_gate():
             return cp.ring_attention_local(
                 ql, kl, vl, "sp", causal=False, use_flash=use_flash,
                 interpret=use_flash)
-        f = jax.shard_map(local, mesh=mesh.jax_mesh,
+        f = shard_map(local, mesh=mesh.jax_mesh,
                           in_specs=(P(None, None, "sp", None),) * 3,
                           out_specs=P(None, None, "sp", None),
                           check_vma=False)
@@ -193,7 +194,7 @@ def test_flash_ring_gqa_fold_matches_repeat():
             return cp.ring_attention_local(
                 ql, kl, vl, "sp", causal=True, use_flash=use_flash,
                 interpret=use_flash)
-        f = jax.shard_map(local, mesh=mesh.jax_mesh,
+        f = shard_map(local, mesh=mesh.jax_mesh,
                           in_specs=(P(None, None, "sp", None),) * 3,
                           out_specs=P(None, None, "sp", None),
                           check_vma=False)

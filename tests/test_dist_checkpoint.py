@@ -282,7 +282,7 @@ def test_format_version_stamped_and_old_format_loads(tmp_path):
     t = dist.shard_tensor(w, mesh, [dist.Shard(0), dist.Replicate()])
     ckpt.save_state_dict({"w": t}, str(tmp_path))
     meta = json.load(open(tmp_path / "metadata.json"))
-    assert meta["format_version"] == 2
+    assert meta["format_version"] == ckpt._FORMAT_VERSION >= 2
 
     # simulate an old (round-3) checkpoint: strip the stamp
     del meta["format_version"]
