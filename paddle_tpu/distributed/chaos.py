@@ -57,11 +57,41 @@ import time
 from contextlib import contextmanager
 
 __all__ = [
-    "ENABLED", "InjectedConnectionDrop", "InjectedFault", "configure",
-    "disable", "scoped", "should_fire", "maybe_delay", "maybe_drop",
-    "maybe_preempt", "maybe_corrupt_file", "grad_poison", "fire_count",
-    "fires", "site_rate",
+    "ENABLED", "InjectedConnectionDrop", "InjectedFault", "POINTS",
+    "configure", "disable", "scoped", "should_fire", "maybe_delay",
+    "maybe_drop", "maybe_preempt", "maybe_corrupt_file", "grad_poison",
+    "fire_count", "fires", "site_rate",
 ]
+
+#: Documented injection-point registry: every literal site name passed
+#: to should_fire/maybe_delay/maybe_drop/maybe_preempt/
+#: maybe_corrupt_file/grad_poison anywhere in the package MUST have an
+#: entry here — tools/check_chaos_points.py (run by tier-1 via
+#: tests/test_chaos_points_tool.py) fails the build otherwise, so the
+#: catalogue of injectable faults can never silently drift from the
+#: code. Keys ending in "/" are prefixes for dynamically-suffixed
+#: sites (f-string call sites).
+POINTS = {
+    "store.client": "TCPStore RPC op (delay, then dropped connection)",
+    "collective.dispatch/": "eager collective dispatch delay "
+                            "(suffix = op name)",
+    "ckpt.write.shards": "corrupt the just-written checkpoint shard "
+                         "file (torn write / bit rot)",
+    "ckpt.write.table": "corrupt the just-written checkpoint table "
+                        "file",
+    "elastic.preempt": "synthetic preemption: SIGTERM to this process",
+    "serving.batch.delay": "slow DynamicBatcher backend run",
+    "serving.batch.fail": "failed DynamicBatcher batch run (error "
+                          "must fan out to every waiter)",
+    "serving.admit.delay": "slow HTTP admission gate (builds queue "
+                           "pressure for shed-path tests)",
+    "serving.run.delay": "slow predictor run (stretches deadlines "
+                         "toward 504)",
+    "serving.run.fail": "failed predictor run (feeds the serving "
+                        "circuit breaker toward open)",
+    "trainer.grad": "non-finite (NaN) gradient poisoning in the "
+                    "compiled train step",
+}
 
 
 class InjectedFault(RuntimeError):

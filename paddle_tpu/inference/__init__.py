@@ -16,7 +16,8 @@ import numpy as np
 from paddle_tpu.core.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "PlaceType", "PagedKVEngine"]
+           "PlaceType", "PagedKVEngine", "PredictorServer", "serve",
+           "overload"]
 
 
 def __getattr__(name):
@@ -24,6 +25,12 @@ def __getattr__(name):
     if name == "PagedKVEngine":
         from paddle_tpu.inference.paged import PagedKVEngine
         return PagedKVEngine
+    if name in ("PredictorServer", "serve"):
+        from paddle_tpu.inference import serving
+        return getattr(serving, name)
+    if name == "overload":
+        from paddle_tpu.inference import overload
+        return overload
     raise AttributeError(name)
 
 

@@ -54,6 +54,9 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.inference.overload import (DeadlineExceeded,
+                                           EngineOverloaded,
+                                           OverloadError)
 
 __all__ = ["PagedState", "paged_attention_update", "PagedKVEngine"]
 
@@ -171,7 +174,8 @@ class _Request:
     _id_lock = threading.Lock()
 
     def __init__(self, ids, max_new_tokens, eos_token_id, do_sample,
-                 temperature, top_k, top_p, pages_needed):
+                 temperature, top_k, top_p, pages_needed,
+                 deadline=None):
         with _Request._id_lock:
             self.rid = _Request._next_id
             _Request._next_id += 1
@@ -183,6 +187,7 @@ class _Request:
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.pages_needed = pages_needed
+        self.deadline = deadline    # expire-in-queue (overload.Deadline)
         self.sample_index = 0       # engine-local; set by submit()
         self.tokens: list[int] = []          # accepted generated tokens
         self.queue: queue.Queue = queue.Queue()
@@ -238,12 +243,17 @@ class PagedKVEngine:
         length per request at `max_pages_per_slot * page_size`.
     steps_per_tick: decode steps fused into one device program call
         (admission granularity AND host round-trip amortization).
+    max_pending: bound on the not-yet-admitted queue. None (default)
+        queues unboundedly (batch/offline use); serving deployments set
+        it so `submit` sheds with EngineOverloaded — a typed, retryable
+        rejection — instead of letting queue depth (and every queued
+        request's latency) grow without limit.
     """
 
     def __init__(self, model, *, max_slots=4, page_size=16, num_pages=64,
                  max_pages_per_slot=None, steps_per_tick=4, seed=0,
                  prefill_chunk=None, draft_model=None, spec_tokens=4,
-                 dtype=None):
+                 dtype=None, max_pending=None):
         cfg = model.config
         self.model = model
         self.max_slots = int(max_slots)
@@ -253,6 +263,8 @@ class PagedKVEngine:
             max_pages_per_slot
             or min(num_pages - 1, max(1, (num_pages - 1) // max_slots)))
         self.steps_per_tick = int(steps_per_tick)
+        self.max_pending = (None if max_pending is None
+                            else int(max_pending))
         # prompts longer than this prefill in fixed-size chunks through
         # ONE reused program (chunked prefill — the paged core appends
         # at lens>0) instead of compiling a program per padded length.
@@ -309,6 +321,7 @@ class PagedKVEngine:
         # telemetry for tests / the serving bench
         self.stats = {"ticks": 0, "prefills": 0, "tokens_out": 0,
                       "admitted": 0, "finished": 0, "cancelled": 0,
+                      "expired": 0, "overloaded": 0,
                       "prefill_s": 0.0, "tick_s": 0.0}
         # serving integration: PredictorServer must not serialize
         # concurrent streams through its executable lock — the engine's
@@ -316,9 +329,18 @@ class PagedKVEngine:
         self.concurrent_safe = True
 
     # -- submission ------------------------------------------------------
+    def admission_headroom(self):
+        """Pages not promised to any admitted slot (free minus
+        outstanding reservations) — the budget new admissions draw
+        from. Advisory (the ticker mutates concurrently)."""
+        return len(self._free) - self._reserved_unalloc
+
     def submit(self, ids, max_new_tokens=32, *, eos_token_id=None,
                do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-               **_ignored) -> _Request:
+               deadline=None, **_ignored) -> _Request:
+        if deadline is not None and deadline.expired():
+            raise DeadlineExceeded(
+                "deadline exceeded before engine admission")
         ids = np.asarray(ids, np.int32).reshape(-1)
         total = ids.size + int(max_new_tokens)
         pages = -(-total // self.page_size)
@@ -331,8 +353,26 @@ class PagedKVEngine:
             raise ValueError(f"request needs {pages} pages > pool size "
                              f"{self.num_pages - 1}")
         req = _Request(ids, max_new_tokens, eos_token_id, do_sample,
-                       temperature, top_k, top_p, pages)
+                       temperature, top_k, top_p, pages,
+                       deadline=deadline)
         with self._lock:
+            if self.max_pending is not None:
+                # shed when the request can neither start NOW (free
+                # slot + page headroom, nothing queued ahead of it)
+                # nor wait within the pending bound — the serving tier
+                # turns this into a retryable 503, instead of this
+                # request waiting unboundedly
+                queued = len(self._pending)
+                admissible_now = (
+                    queued == 0
+                    and any(s is None for s in self._slots)
+                    and pages <= self.admission_headroom())
+                if not admissible_now and queued >= self.max_pending:
+                    self.stats["overloaded"] += 1
+                    raise EngineOverloaded(
+                        f"engine overloaded: {queued} pending >= "
+                        f"max_pending {self.max_pending} and no "
+                        "admission headroom", retry_after=0.1)
             # engine-local index: prefill sampling derives from
             # (engine seed, this index), so two engines with the same
             # seed replay identically regardless of process history
@@ -374,6 +414,18 @@ class PagedKVEngine:
                 self.stats["cancelled"] += 1
                 with self._lock:
                     self._inflight -= 1
+                req.queue.put(None)
+                req.done.set()
+                continue
+            if req.deadline is not None and req.deadline.expired():
+                # expired while queued: fail it WITHOUT spending a
+                # slot, pages, or a prefill on work nobody waits for
+                self.stats["expired"] += 1
+                with self._lock:
+                    self._inflight -= 1
+                req.error = DeadlineExceeded(
+                    "deadline exceeded while queued for engine "
+                    "admission")
                 req.queue.put(None)
                 req.done.set()
                 continue
@@ -790,7 +842,7 @@ class PagedKVEngine:
     def stream(self, input_ids, max_new_tokens=32, *, eos_token_id=None,
                pad_token_id=0, do_sample=False, temperature=1.0,
                top_k=0, top_p=1.0, attention_mask=None, seed=None,
-               **_ignored):
+               deadline=None, **_ignored):
         """generate_stream-compatible surface for PredictorServer: each
         ROW of input_ids becomes an independent engine request (they
         join the continuous batch individually), and the yielded step
@@ -814,9 +866,19 @@ class PagedKVEngine:
         else:
             rows = list(ids)
         self.start()
-        reqs = [self.submit(r, max_new_tokens, eos_token_id=eos_token_id,
-                            do_sample=do_sample, temperature=temperature,
-                            top_k=top_k, top_p=top_p) for r in rows]
+        reqs = []
+        try:
+            for r in rows:
+                reqs.append(self.submit(
+                    r, max_new_tokens, eos_token_id=eos_token_id,
+                    do_sample=do_sample, temperature=temperature,
+                    top_k=top_k, top_p=top_p, deadline=deadline))
+        except OverloadError:
+            # partial multi-row admission must not leak: cancel the
+            # rows already submitted before re-raising the shed
+            for r in reqs:
+                r.cancel()
+            raise
         streams = [r.stream_tokens() for r in reqs]
         try:
             for step in range(int(max_new_tokens)):

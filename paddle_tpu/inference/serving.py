@@ -4,18 +4,25 @@ deployment; here a dependency-free HTTP/JSON server plays that role —
 the exported StableHLO program is the deployment artifact, SURVEY.md
 §2.7).
 
-POST /predict  {"inputs": {name: nested-list | {"data": .., "dtype": ..}}}
+POST /predict  {"inputs": {name: nested-list | {"data": .., "dtype": ..}},
+                "timeout_ms": optional budget}
            ->  {"outputs": {name: {"data": .., "dtype": .., "shape": ..}}}
 POST /generate {"ids": [[..]], "max_new_tokens": n, "stream": bool,
                 "do_sample"/"temperature"/"top_k"/"top_p"/"eos_token_id"
-                /"seed": ...}
+                /"seed"/"timeout_ms": ...}
            ->  stream=false: {"sequences": [[..]]}
                stream=true: application/x-ndjson chunks, one
                {"step": i, "tokens": [..]} line per generated position,
                then {"done": true} — the token-streaming surface
                (requires a generator: a GenerationPredictor bundle or a
                cache-capable CausalLM, see models/generation.py)
-GET  /health   -> {"status": "ok", "model": ...}
+GET  /health   -> liveness (alias of /healthz, kept for compatibility)
+GET  /healthz  -> {"status": "ok"} while the process serves HTTP at all
+GET  /readyz   -> 200 when accepting traffic; 503 {"reason":
+               "draining" | "breaker_open" | "breaker_half_open" |
+               "saturated"} when a load balancer should steer away
+GET  /stats    -> JSON counters (admission, sheds, breaker state,
+               latency p50/p99, batcher queue)
 GET  /metadata -> input/output names of the served program
 
 Requests are serialized through a lock (one XLA executable, one chip).
@@ -25,18 +32,37 @@ Paddle Serving auto-batching, the "batching policy" piece of
 analysis-predictor deployment): each request waits at most
 batch_timeout_ms for co-travellers, the batch is concatenated on dim 0,
 run once, and the split outputs are scattered back to the callers.
+
+Overload control (inference/overload.py): every POST passes an
+admission gate (bounded in-flight count -> 429 + Retry-After), carries
+a deadline from `timeout_ms`/`X-Timeout-Ms` (expiry -> 504, including
+*while queued* in the batcher — an expired request never occupies a
+batch slot), and runs under a circuit breaker (consecutive backend
+failures -> fast-fail 503 until a half-open probe recloses it).
+`drain()` — also hooked to SIGTERM by `serve()` — stops admission,
+finishes in-flight work, then stops the server. Chaos points
+`serving.admit.delay` / `serving.run.delay` / `serving.run.fail`
+(distributed/chaos.py) drive these paths deterministically in tests.
 """
 from __future__ import annotations
 
 import collections
+import contextlib
 import json
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
-__all__ = ["PredictorServer", "DynamicBatcher", "serve"]
+from paddle_tpu.inference.overload import (
+    AdmissionController, AdmissionRejected, CircuitBreaker, Deadline,
+    DeadlineExceeded, LatencyStats, OverloadError, ServerDraining,
+    expired as _expired)
+
+__all__ = ["PredictorServer", "DynamicBatcher", "serve",
+           "UnbatchableRequest", "OversizedBatch"]
 
 
 class UnbatchableRequest(ValueError):
@@ -45,15 +71,30 @@ class UnbatchableRequest(ValueError):
     ValueError must propagate, not trigger a silent second run)."""
 
 
-class _Pending:
-    __slots__ = ("inputs", "n", "event", "result", "error")
+class OversizedBatch(UnbatchableRequest):
+    """A single request larger than the exported leading dim: neither a
+    merged batch nor a solo run can serve it, so it is a client error
+    (HTTP 400), never a fallback."""
 
-    def __init__(self, inputs, n):
+
+class _StreamAborted(RuntimeError):
+    """Internal: a /generate stream failed AFTER the 200 header went
+    out — the error chunk is already on the wire, so no HTTP reply can
+    follow, but the failure must still reach the circuit breaker (a
+    backend dying mid-stream on every request would otherwise never
+    trip it) and the server_error counter."""
+
+
+class _Pending:
+    __slots__ = ("inputs", "n", "event", "result", "error", "deadline")
+
+    def __init__(self, inputs, n, deadline=None):
         self.inputs = inputs            # list of np arrays, fixed order
         self.n = n                      # leading-dim size
         self.event = threading.Event()
         self.result = None
         self.error = None
+        self.deadline = deadline
 
 
 class DynamicBatcher:
@@ -62,17 +103,28 @@ class DynamicBatcher:
     run_fn(list_of_arrays) -> list_of_arrays, batching on dim 0. Only
     requests with identical (shape[1:], dtype) signatures merge; the
     first request of a batch waits up to `timeout_ms` for co-travellers,
-    bounded by `max_batch` total rows."""
+    bounded by `max_batch` total rows.
 
-    def __init__(self, run_fn, max_batch=8, timeout_ms=5.0):
+    Overload behavior: `max_queue` bounds the pending buffer (shed with
+    AdmissionRejected when full), `hard_cap` rejects single requests
+    wider than the exported leading dim (OversizedBatch), and a request
+    whose `deadline` expires while still buffered is withdrawn with
+    DeadlineExceeded instead of wasting rows of a batch."""
+
+    def __init__(self, run_fn, max_batch=8, timeout_ms=5.0, *,
+                 max_queue=None, hard_cap=None):
         self.run_fn = run_fn
         self.max_batch = max_batch
         self.timeout = timeout_ms / 1000.0
+        self.max_queue = max_queue
+        self.hard_cap = hard_cap
         self._buf: collections.deque = collections.deque()
         self._cv = threading.Condition()
         self._stop = False
         self.batches_run = 0            # observability / tests
         self.requests_served = 0
+        self.expired_in_queue = 0
+        self.shed_full = 0
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -80,7 +132,7 @@ class DynamicBatcher:
     def _sig(arrays):
         return tuple((a.shape[1:], str(a.dtype)) for a in arrays)
 
-    def submit(self, arrays):
+    def submit(self, arrays, deadline=None):
         """Blocking: returns the outputs for this request's rows."""
         arrays = [np.asarray(a) for a in arrays]
         if not arrays or any(a.ndim == 0 for a in arrays):
@@ -90,22 +142,69 @@ class DynamicBatcher:
             raise UnbatchableRequest(
                 "dynamic batching needs a shared leading dim across all "
                 f"inputs, got {[a.shape for a in arrays]}")
-        p = _Pending(arrays, arrays[0].shape[0])
+        rows = arrays[0].shape[0]
+        if self.hard_cap is not None and rows > self.hard_cap:
+            raise OversizedBatch(
+                f"request of {rows} rows exceeds the exported leading "
+                f"dim {self.hard_cap}; split it or re-export with a "
+                "larger batch input_spec")
+        if _expired(deadline):
+            raise DeadlineExceeded("deadline exceeded before batching")
+        p = _Pending(arrays, rows, deadline)
         with self._cv:
+            if self._stop:
+                raise RuntimeError("DynamicBatcher stopped")
+            if self.max_queue is not None \
+                    and len(self._buf) >= self.max_queue:
+                self.shed_full += 1
+                raise AdmissionRejected(
+                    f"batcher queue full ({self.max_queue} pending)",
+                    retry_after=self.timeout)
             self._buf.append(p)
             self._cv.notify()
-        p.event.wait()
+        self._await(p)
         if p.error is not None:
             raise p.error
         return p.result
 
+    def _await(self, p):
+        """Wait for completion, bounded by the request's deadline: on
+        expiry WITHDRAW the request if it is still buffered (it never
+        occupies a batch slot); once taken by the worker the run always
+        completes it."""
+        if p.deadline is None or p.deadline.t is None:
+            p.event.wait()
+            return
+        while not p.event.wait(timeout=max(p.deadline.remaining(), 0.0)):
+            with self._cv:
+                if p in self._buf:
+                    self._buf.remove(p)
+                    self.expired_in_queue += 1
+                    raise DeadlineExceeded(
+                        "deadline exceeded while queued for batching")
+            # already taken into a batch: the worker will finish it
+            p.event.wait()
+            return
+
+    def _expire_locked(self, p):
+        self.expired_in_queue += 1
+        p.error = DeadlineExceeded(
+            "deadline exceeded while queued for batching")
+        p.event.set()
+
     def _take_batch(self):
         with self._cv:
-            while not self._buf and not self._stop:
-                self._cv.wait()
-            if self._stop:
-                return []
-            first = self._buf.popleft()
+            first = None
+            while first is None:
+                while not self._buf and not self._stop:
+                    self._cv.wait()
+                if self._stop:
+                    return []
+                cand = self._buf.popleft()
+                if _expired(cand.deadline):
+                    self._expire_locked(cand)   # dead rows get no slot
+                else:
+                    first = cand
         batch = [first]
         sig = self._sig(first.inputs)
         rows = first.n
@@ -116,7 +215,9 @@ class DynamicBatcher:
                 keep: collections.deque = collections.deque()
                 while self._buf and rows < self.max_batch:
                     cand = self._buf.popleft()
-                    if self._sig(cand.inputs) == sig \
+                    if _expired(cand.deadline):
+                        self._expire_locked(cand)
+                    elif self._sig(cand.inputs) == sig \
                             and rows + cand.n <= self.max_batch:
                         batch.append(cand)
                         rows += cand.n
@@ -135,6 +236,13 @@ class DynamicBatcher:
         from paddle_tpu.distributed import chaos
         while not self._stop:
             batch = self._take_batch()
+            if self._stop:
+                # taken but never run (shutdown race): fan the stop to
+                # the waiters instead of wedging them
+                for p in batch:
+                    p.error = RuntimeError("DynamicBatcher stopped")
+                    p.event.set()
+                return
             if not batch:
                 continue
             try:
@@ -163,7 +271,7 @@ class DynamicBatcher:
             for p in batch:
                 p.event.set()
 
-    def stop(self):
+    def stop(self, join_timeout=5.0):
         with self._cv:
             self._stop = True
             pending = list(self._buf)
@@ -173,18 +281,42 @@ class DynamicBatcher:
         for p in pending:
             p.error = RuntimeError("DynamicBatcher stopped")
             p.event.set()
+        # bounded join: a worker wedged inside run_fn must not hang
+        # shutdown (it is a daemon thread and dies with the process)
+        self._thread.join(timeout=join_timeout)
 
 
 class PredictorServer:
-    """Serve a Predictor (or any callable dict->dict) over HTTP."""
+    """Serve a Predictor (or any callable dict->dict) over HTTP, behind
+    an overload-control gate (admission / deadlines / circuit breaker /
+    graceful drain — module doc)."""
+
+    # bad requests: the backend is fine, the payload is not. These map
+    # to 400 and do NOT count as breaker failures.
+    _CLIENT_ERRORS = (UnbatchableRequest, ValueError, KeyError, TypeError)
 
     def __init__(self, predictor, host="127.0.0.1", port=0,
                  model_name="model", dynamic_batching=False,
-                 max_batch_size=8, batch_timeout_ms=5.0, generator=None):
+                 max_batch_size=8, batch_timeout_ms=5.0, generator=None,
+                 *, max_concurrent=32, max_queue_depth=64,
+                 default_timeout_ms=None, breaker_threshold=5,
+                 breaker_reset_s=5.0, retry_after_s=1.0):
         self.predictor = predictor
         self.model_name = model_name
         self.generator = generator
         self._lock = threading.Lock()
+        self.default_timeout_ms = default_timeout_ms
+        self.admission = AdmissionController(
+            max_concurrent=max_concurrent, max_queue=max_queue_depth,
+            retry_after_s=retry_after_s)
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_threshold,
+            reset_after_s=breaker_reset_s)
+        self.latency = LatencyStats()
+        self._counts: collections.Counter = collections.Counter()
+        self._counts_lock = threading.Lock()
+        self._draining = False
+        self.retry_after_s = float(retry_after_s)
         self.batcher = None
         # batching needs the handle-free run(list) API; a plain callable
         # predictor keeps the solo path (its input names don't survive
@@ -192,12 +324,15 @@ class PredictorServer:
         if dynamic_batching and hasattr(predictor, "run"):
             shapes = (predictor.input_shapes()
                       if hasattr(predictor, "input_shapes") else None)
+            hard_cap = None
             if shapes and shapes[0]:
                 # never merge past the exported leading dim
-                max_batch_size = min(max_batch_size, shapes[0][0])
+                hard_cap = shapes[0][0]
+                max_batch_size = min(max_batch_size, hard_cap)
             self.batcher = DynamicBatcher(
                 self._run_locked, max_batch=max_batch_size,
-                timeout_ms=batch_timeout_ms)
+                timeout_ms=batch_timeout_ms, max_queue=max_queue_depth,
+                hard_cap=hard_cap)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -209,17 +344,28 @@ class PredictorServer:
             def log_message(self, *a):      # quiet
                 pass
 
-            def _reply(self, code, obj):
+            def _reply(self, code, obj, retry_after=None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if retry_after is not None:
+                    self.send_header(
+                        "Retry-After",
+                        str(max(1, int(math.ceil(retry_after)))))
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _stream_reply(self, lines):
+            def _stream_reply(self, lines, src=None):
                 """Chunked application/x-ndjson: one JSON line per chunk,
-                flushed as each token batch is produced."""
+                flushed as each token batch is produced. `src` is the
+                underlying generate_steps iterator — ALWAYS closed on
+                the way out, so a mid-stream client disconnect cancels
+                the producer (and frees the chip lock) immediately
+                instead of waiting for GC. Returns the backend
+                exception if the stream failed mid-flight (the caller
+                raises _StreamAborted so the breaker sees it); a client
+                disconnect returns None — the backend did not fail."""
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -230,65 +376,220 @@ class PredictorServer:
                     self.wfile.write(b"%x\r\n" % len(data) + data
                                      + b"\r\n")
                     self.wfile.flush()
+                exc = None
                 try:
-                    for obj in lines:
-                        chunk(obj)
-                except Exception as e:      # noqa: BLE001
-                    chunk({"error": str(e)})
-                self.wfile.write(b"0\r\n\r\n")
+                    try:
+                        for obj in lines:
+                            chunk(obj)
+                    except OSError:
+                        return None     # client went away mid-stream
+                    except Exception as e:      # noqa: BLE001
+                        exc = e
+                        chunk({"error": str(e)})
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass                # terminal chunk hit a dead socket
+                finally:
+                    if src is not None and hasattr(src, "close"):
+                        src.close()
+                return exc
 
             def do_GET(self):
-                if self.path == "/health":
+                if self.path in ("/health", "/healthz"):
+                    # liveness only: the process is up and serving HTTP.
+                    # Whether it should RECEIVE traffic is /readyz.
                     return self._reply(200, {"status": "ok",
                                              "model": outer.model_name})
+                if self.path == "/readyz":
+                    ready, reason = outer.readiness()
+                    if ready:
+                        return self._reply(200, {"status": "ready"})
+                    return self._reply(
+                        503, {"status": "unready", "reason": reason},
+                        retry_after=outer.retry_after_s)
+                if self.path == "/stats":
+                    return self._reply(200, outer.stats())
                 if self.path == "/metadata":
                     return self._reply(200, outer.metadata())
                 return self._reply(404, {"error": "unknown path"})
 
             def do_POST(self):
-                if self.path == "/generate":
-                    try:
-                        n = int(self.headers.get("Content-Length", 0))
-                        req = json.loads(self.rfile.read(n))
-                        stream = bool(req.pop("stream", False))
-                        it = outer.generate_steps(req)
-                        if stream:
-                            # pull the first item BEFORE sending the 200
-                            # header so request errors (bad shape, no
-                            # generator) still surface as a real 400
-                            import itertools
-                            first = next(it)
-                            return self._stream_reply(
-                                itertools.chain([first], it))
-                        steps = [obj for obj in it if "tokens" in obj]
-                        return self._reply(200, {
-                            "sequences": [
-                                [s["tokens"][b] for s in steps]
-                                for b in range(len(steps[0]["tokens"]))]
-                            if steps else []})
-                    except Exception as e:      # noqa: BLE001
-                        return self._reply(400, {"error": str(e)})
-                if self.path != "/predict":
+                if self.path not in ("/predict", "/generate"):
                     return self._reply(404, {"error": "unknown path"})
+                outer._count("total")
                 try:
                     n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n))
-                    out = outer.predict(req.get("inputs", {}))
-                    return self._reply(200, {"outputs": out})
-                except Exception as e:      # noqa: BLE001
+                    req = json.loads(self.rfile.read(n)) if n else {}
+                    if not isinstance(req, dict):
+                        raise ValueError(
+                            "request body must be a JSON object")
+                    deadline = outer._request_deadline(req, self.headers)
+                    with outer._admit(deadline):
+                        if self.path == "/generate":
+                            stream = bool(req.pop("stream", False))
+                            it = outer.generate_steps(req,
+                                                      deadline=deadline)
+                            if stream:
+                                # pull the first item BEFORE sending the
+                                # 200 header so request errors (bad
+                                # shape, no generator) still surface as
+                                # a real error status
+                                import itertools
+                                first = next(it)
+                                exc = self._stream_reply(
+                                    itertools.chain([first], it), src=it)
+                                if exc is not None:
+                                    raise _StreamAborted(str(exc)) \
+                                        from exc
+                                outer._count("ok")
+                                return
+                            steps = [o for o in it if "tokens" in o]
+                            outer._count("ok")
+                            return self._reply(200, {
+                                "sequences": [
+                                    [s["tokens"][b] for s in steps]
+                                    for b in
+                                    range(len(steps[0]["tokens"]))]
+                                if steps else []})
+                        out = outer.predict(req.get("inputs", {}),
+                                            deadline=deadline)
+                        outer._count("ok")
+                        return self._reply(200, {"outputs": out})
+                except _StreamAborted:
+                    # the 200 + error chunk are already on the wire; no
+                    # reply possible, but _admit recorded the breaker
+                    # failure on the way here
+                    outer._count("server_error")
+                    return
+                except OverloadError as e:
+                    outer._count(e.counter)
+                    return self._reply(e.status, {"error": str(e)},
+                                       retry_after=e.retry_after)
+                except outer._CLIENT_ERRORS as e:
+                    outer._count("client_error")
                     return self._reply(400, {"error": str(e)})
+                except Exception as e:      # noqa: BLE001
+                    outer._count("server_error")
+                    return self._reply(500, {"error": str(e)})
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self.host = host
         self._thread = None
 
+    # -- overload gate ------------------------------------------------------
+    def _count(self, key):
+        with self._counts_lock:
+            self._counts[key] += 1
+
+    def _request_deadline(self, req, headers):
+        """Deadline from the X-Timeout-Ms header, the `timeout_ms` body
+        field, or the server default — header wins. None = unbounded."""
+        ms = headers.get("X-Timeout-Ms") if headers else None
+        body_ms = req.pop("timeout_ms", None) \
+            if isinstance(req, dict) else None
+        if ms is None:
+            ms = body_ms
+        if ms is None:
+            ms = self.default_timeout_ms
+        if ms is None:
+            return None
+        ms = float(ms)                  # bad value -> 400 client error
+        if ms <= 0:
+            raise ValueError(f"timeout_ms must be > 0, got {ms}")
+        return Deadline.after_ms(ms)
+
+    @contextlib.contextmanager
+    def _admit(self, deadline):
+        """Admission front half (shed cheaply, in order: draining ->
+        expired -> capacity -> breaker) + outcome back half (breaker
+        record, latency). Control-plane rejections (OverloadError) and
+        client errors never count as backend failures."""
+        from paddle_tpu.distributed import chaos
+        if chaos.ENABLED:
+            chaos.maybe_delay("serving.admit.delay")
+        if self._draining:
+            raise ServerDraining("server is draining",
+                                 retry_after=self.retry_after_s)
+        if deadline is not None:
+            deadline.check("before admission")
+        self.admission.try_acquire()
+        try:
+            self.breaker.allow()
+        except BaseException:
+            self.admission.release()
+            raise
+        t0 = time.monotonic()
+        try:
+            yield
+        except OverloadError:
+            # shed by a later gate (deadline in queue, batcher full,
+            # engine overload): the backend never answered, so hand an
+            # un-judged half-open probe back instead of burning it
+            self.breaker.release_probe()
+            raise
+        except self._CLIENT_ERRORS:
+            # the backend did not fail; a bad payload must not
+            # accumulate toward tripping the breaker
+            self.breaker.record_success()
+            raise
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        else:
+            self.breaker.record_success()
+            self.latency.record(time.monotonic() - t0)
+        finally:
+            self.admission.release()
+
+    @staticmethod
+    def _chaos_run_gate():
+        from paddle_tpu.distributed import chaos
+        if chaos.ENABLED:
+            # a slow predictor (serving.run.delay) stretches deadlines;
+            # a failed run (serving.run.fail) feeds the circuit breaker
+            chaos.maybe_delay("serving.run.delay")
+            if chaos.should_fire("serving.run.fail"):
+                raise chaos.InjectedFault(
+                    "chaos: injected predictor run failure")
+
+    def readiness(self):
+        """(ready, reason) for /readyz. Liveness (/healthz) is separate:
+        a draining or breaker-open server is alive but unready."""
+        if self._draining:
+            return False, "draining"
+        bstate = self.breaker.state
+        if bstate != CircuitBreaker.CLOSED:
+            return False, f"breaker_{bstate}"
+        if self.admission.saturated:
+            return False, "saturated"
+        return True, "ready"
+
+    def stats(self):
+        with self._counts_lock:
+            counts = dict(self._counts)
+        out = {"model": self.model_name,
+               "draining": self._draining,
+               "in_flight": self.admission.in_flight,
+               "capacity": self.admission.capacity,
+               "requests": counts,
+               "breaker": self.breaker.snapshot(),
+               "latency_ms": self.latency.snapshot()}
+        if self.batcher is not None:
+            out["batcher"] = {
+                "batches_run": self.batcher.batches_run,
+                "requests_served": self.batcher.requests_served,
+                "queued": len(self.batcher._buf),
+                "expired_in_queue": self.batcher.expired_in_queue,
+                "shed_full": self.batcher.shed_full}
+        return out
+
     # -- core -------------------------------------------------------------
     _GEN_PARAMS = ("max_new_tokens", "attention_mask", "eos_token_id",
                    "pad_token_id", "do_sample", "temperature", "top_k",
                    "top_p", "seed", "tokens_per_fetch")
 
-    def generate_steps(self, req):
+    def generate_steps(self, req, deadline=None):
         """Yield {"step": i, "tokens": [...]} per generated position,
         then {"done": True, "steps": n}.
 
@@ -300,6 +601,9 @@ class PredictorServer:
         if self.generator is None:
             raise ValueError("this server has no generator "
                              "(pass generator= to PredictorServer)")
+        if deadline is not None:
+            deadline.check("before generation")
+        self._chaos_run_gate()
         ids = np.asarray(req["ids"], "int32")
         kw = {k: req[k] for k in self._GEN_PARAMS if k in req}
         g = self.generator
@@ -307,6 +611,10 @@ class PredictorServer:
             # bundle predictors decode host-side; the device block loop
             # does not apply there
             kw.pop("tokens_per_fetch", None)
+            if deadline is not None \
+                    and getattr(g, "concurrent_safe", False):
+                # the paged engine's admission understands deadlines
+                kw["deadline"] = deadline
             it = g.stream(ids, **kw)
         else:
             from paddle_tpu.models.generation import generate_stream
@@ -320,7 +628,6 @@ class PredictorServer:
         # a continuous-batching generator (PagedKVEngine) multiplexes
         # concurrent requests itself — serializing its streams through
         # the executable lock would defeat mid-decode admission
-        import contextlib
         lock = (contextlib.nullcontext()
                 if getattr(g, "concurrent_safe", False) else self._lock)
 
@@ -383,10 +690,18 @@ class PredictorServer:
         input_spec batch = max_batch_size."""
         p = self.predictor
         rows = int(np.asarray(arrays[0]).shape[0])
+        self._chaos_run_gate()
         with self._lock:
             if hasattr(p, "run"):
                 shapes = (p.input_shapes()
                           if hasattr(p, "input_shapes") else None)
+                if shapes and shapes[0] and shapes[0][0] < rows:
+                    # an oversized batch would otherwise reach XLA and
+                    # die with a cryptic executable shape mismatch
+                    raise OversizedBatch(
+                        f"batch of {rows} rows exceeds the exported "
+                        f"leading dim {shapes[0][0]}; split the request "
+                        "or re-export with a larger batch input_spec")
                 if shapes and shapes[0] and shapes[0][0] > rows:
                     tgt = shapes[0][0]
                     arrays = [np.concatenate(
@@ -414,12 +729,14 @@ class PredictorServer:
             arrays.append(self._decode(v))
         return arrays
 
-    def predict(self, inputs: dict) -> dict:
+    def predict(self, inputs: dict, deadline=None) -> dict:
         p = self.predictor
         if self.batcher is not None and hasattr(p, "get_input_names"):
             arrays = self._resolve_inputs(p.get_input_names(), inputs)
             try:
-                outs = self.batcher.submit(arrays)
+                outs = self.batcher.submit(arrays, deadline=deadline)
+            except OversizedBatch:
+                raise       # a solo run hits the same exported-dim wall
             except UnbatchableRequest:
                 outs = None             # solo run below
             if outs is not None:
@@ -427,6 +744,9 @@ class PredictorServer:
                                     "dtype": str(np.asarray(a).dtype),
                                     "shape": list(np.asarray(a).shape)}
                         for i, a in enumerate(outs)}
+        if deadline is not None:
+            deadline.check("before predictor run")
+        self._chaos_run_gate()
         with self._lock:
             if hasattr(p, "get_input_names"):
                 names = p.get_input_names()
@@ -455,23 +775,57 @@ class PredictorServer:
         self._thread.start()
         return self
 
-    def stop(self):
+    def drain(self, timeout=30.0, poll_s=0.01):
+        """Graceful shutdown: stop admitting (new requests shed with 503
+        + Retry-After, /readyz flips to "draining"), wait up to
+        `timeout` seconds for in-flight requests to finish, then stop
+        the server. Returns True when nothing was left in flight."""
+        self._draining = True
+        t_end = time.monotonic() + timeout
+        while self.admission.in_flight > 0 and time.monotonic() < t_end:
+            time.sleep(poll_s)
+        clean = self.admission.in_flight == 0
+        self.stop()
+        return clean
+
+    def stop(self, join_timeout=5.0):
         if self.batcher is not None:
-            self.batcher.stop()
-        self.httpd.shutdown()
+            self.batcher.stop(join_timeout=join_timeout)
+        if self._thread is not None:
+            # shutdown() handshakes with serve_forever and would block
+            # forever on a server that was never start()ed
+            self.httpd.shutdown()
         self.httpd.server_close()
+        if self._thread is not None:
+            # bounded: a handler wedged in a request must not hang
+            # shutdown (daemon thread, dies with the process)
+            self._thread.join(timeout=join_timeout)
 
 
 def serve(model_path, params_path=None, host="127.0.0.1", port=8866,
-          block=True):
+          block=True, drain_timeout=30.0, **server_kw):
     """One-call deployment: load the exported program into a Predictor
-    and serve it (reference: paddle_inference demo main loops)."""
+    and serve it (reference: paddle_inference demo main loops). SIGTERM
+    — the TPU-maintenance / pod-stop signal — triggers a graceful
+    drain instead of an abrupt exit."""
     from paddle_tpu.inference import Config, create_predictor
     pred = create_predictor(Config(model_path, params_path))
-    srv = PredictorServer(pred, host=host, port=port).start()
+    srv = PredictorServer(pred, host=host, port=port,
+                          **server_kw).start()
+    import signal as _signal
+
+    def _on_term(signum, frame):
+        # drain off the signal-handler frame; serve_forever unblocks
+        # (and the join below returns) when the drain stops the server
+        threading.Thread(target=srv.drain, args=(drain_timeout,),
+                         daemon=True).start()
+    try:
+        _signal.signal(_signal.SIGTERM, _on_term)
+    except ValueError:
+        pass                    # not the main thread: embedder owns signals
     if block:
         try:
             srv._thread.join()
         except KeyboardInterrupt:
-            srv.stop()
+            srv.drain(drain_timeout)
     return srv

@@ -1,0 +1,88 @@
+"""tools/check_chaos_points.py — the chaos-point-registry gate.
+
+Every `chaos.should_fire/maybe_*("site")` literal in paddle_tpu/ must
+be documented in the POINTS registry (distributed/chaos.py). Running
+the checker against the live tree IS the tier-1 wiring: an
+undocumented injection point anywhere in the package fails this
+module (the same pattern as tests/test_jax_compat_tool.py)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOL = os.path.join(_ROOT, "tools", "check_chaos_points.py")
+
+
+def _scan(root):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("check_chaos_points",
+                                                  _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.scan(root)
+
+
+def _mini_tree(tmp_path, registry, body):
+    """A fake repo: paddle_tpu/distributed/chaos.py carrying POINTS =
+    `registry`, plus paddle_tpu/mod.py with `body`."""
+    pkg = tmp_path / "paddle_tpu"
+    dist = pkg / "distributed"
+    dist.mkdir(parents=True)
+    (dist / "chaos.py").write_text(f"POINTS = {registry!r}\n")
+    (pkg / "mod.py").write_text(textwrap.dedent(body))
+    return str(tmp_path)
+
+
+def test_live_tree_is_clean():
+    """Tier-1 gate: every injection point in the real package is in
+    the documented POINTS registry."""
+    proc = subprocess.run([sys.executable, _TOOL, _ROOT],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+
+
+def test_registry_covers_the_new_serving_points():
+    from paddle_tpu.distributed.chaos import POINTS
+    for site in ("serving.admit.delay", "serving.run.fail",
+                 "serving.run.delay", "serving.batch.fail"):
+        assert site in POINTS
+
+
+def test_detects_unregistered_site(tmp_path):
+    root = _mini_tree(tmp_path, {"ok.site": "fine"}, """
+        from paddle_tpu.distributed import chaos
+        chaos.maybe_delay("ok.site")
+        chaos.should_fire("nope.site")
+    """)
+    violations, seen, points = _scan(root)
+    assert [(v[0], v[2]) for v in violations] == [
+        (os.path.join("paddle_tpu", "mod.py"),
+         "should_fire('nope.site')")]
+    assert ("ok.site", False) in seen
+
+
+def test_fstring_prefix_and_nonliteral(tmp_path):
+    root = _mini_tree(
+        tmp_path, {"dyn.dispatch/": "dynamic suffix"}, """
+        from paddle_tpu.distributed import chaos
+        name = "x"
+        chaos.maybe_delay(f"dyn.dispatch/{name}")     # covered prefix
+        chaos.maybe_drop(f"other.{name}")             # unregistered
+        chaos.should_fire(name)                       # unauditable
+    """)
+    violations, _seen, _points = _scan(root)
+    problems = sorted(v[2] for v in violations)
+    assert problems == ["maybe_drop(f'other.{name}')",
+                        "should_fire(name)"]
+
+
+def test_checker_exit_code_on_dirty_tree(tmp_path):
+    root = _mini_tree(tmp_path, {}, """
+        from paddle_tpu.distributed import chaos
+        chaos.maybe_preempt("ghost.site")
+    """)
+    proc = subprocess.run([sys.executable, _TOOL, root],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "ghost.site" in proc.stderr

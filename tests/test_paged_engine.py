@@ -534,3 +534,59 @@ def test_speculative_sampled_matches_target_distribution():
     # over ~97 tokens -> bound 0.25 comfortably separates correct
     # rejection sampling from e.g. always-emitting the draft sample
     assert tv < 0.25, tv
+
+
+# -- overload control (ISSUE 2: bounded admission + deadlines) --------------
+
+def test_engine_sheds_when_pending_bounded():
+    import time
+    from paddle_tpu.inference.overload import EngineOverloaded
+    eng = PagedKVEngine(_model(), max_slots=1, page_size=4, num_pages=9,
+                        steps_per_tick=2, max_pending=0)
+    r1 = eng.submit([1, 2, 3], max_new_tokens=4)    # admissible right now
+    with pytest.raises(EngineOverloaded) as ei:
+        eng.submit([1, 2, 3], max_new_tokens=4)     # queued behind r1
+    assert ei.value.retry_after is not None
+    assert eng.stats["overloaded"] == 1
+    # the shed is a queue-state rejection, not a permanent one: once
+    # the queue clears (r1 cancelled + reaped) admission works again
+    r1.cancel()
+    eng.step()
+    assert eng.stats["cancelled"] == 1
+    r3 = eng.submit([1, 2, 3], max_new_tokens=4)
+    r3.cancel()
+    eng.step()
+
+
+def test_engine_submit_deadline_expiry():
+    import time
+    from paddle_tpu.inference.overload import Deadline, DeadlineExceeded
+    eng = PagedKVEngine(_model(), max_slots=1, page_size=4, num_pages=9,
+                        steps_per_tick=2)
+    # already-dead budget: rejected at submit, nothing enqueued
+    with pytest.raises(DeadlineExceeded):
+        eng.submit([1, 2], max_new_tokens=2,
+                   deadline=Deadline(time.monotonic() - 1.0))
+    assert not eng.has_work()
+    # expires while queued: the next tick fails it WITHOUT a prefill
+    r = eng.submit([1, 2], max_new_tokens=2,
+                   deadline=Deadline.after_ms(1))
+    time.sleep(0.02)
+    eng.step()
+    with pytest.raises(DeadlineExceeded):
+        r.result()
+    assert eng.stats["expired"] == 1
+    assert eng.stats["prefills"] == 0   # no slot/compile spent on it
+    assert not eng.has_work()
+
+
+def test_engine_stream_deadline_threads_through():
+    import time
+    from paddle_tpu.inference.overload import Deadline, DeadlineExceeded
+    eng = PagedKVEngine(_model(), max_slots=1, page_size=4, num_pages=9,
+                        steps_per_tick=2)
+    it = eng.stream(np.asarray([[1, 2]], np.int32), max_new_tokens=2,
+                    deadline=Deadline(time.monotonic() - 1.0))
+    with pytest.raises(DeadlineExceeded):
+        next(it)
+    eng.stop()

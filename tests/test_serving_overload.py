@@ -1,0 +1,675 @@
+"""Serving overload control (inference/overload.py wired through
+inference/serving.py): admission shedding, request deadlines, circuit
+breaking, health/readiness split, /stats, and graceful drain.
+
+The load-bearing scenarios (ISSUE 2 acceptance bar), all deterministic
+— chaos faults are seeded (`distributed/chaos.py`) and every blocking
+backend is event-controlled, never sleep-raced:
+
+- consecutive injected `serving.run.fail` faults open the breaker:
+  fast-fail 503 without touching the predictor, /readyz flips
+  not-ready while /healthz stays live, a half-open probe recloses it
+  once the faults stop;
+- saturated admission sheds with 429 + Retry-After;
+- a request whose deadline expires while queued in the DynamicBatcher
+  gets 504 and never occupies a batch slot;
+- drain() finishes in-flight work, rejects new work with 503, then
+  stops the server (the SIGTERM flow `serve()` hooks up);
+- an oversized request (rows > exported leading dim) is a clear 400,
+  not a cryptic XLA shape error;
+- closing a /generate stream mid-decode cancels the producer, closes
+  the source iterator, and releases the executable lock;
+- batcher/server stop() join their threads (no leaked workers).
+
+No jax.export needed: predictors here are plain callables or fake
+run(list)->list objects, so this file runs everywhere tier-1 does.
+"""
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import chaos
+from paddle_tpu.inference.overload import (AdmissionController,
+                                           CircuitBreaker, Deadline,
+                                           DeadlineExceeded,
+                                           LatencyStats)
+from paddle_tpu.inference.serving import (DynamicBatcher, OversizedBatch,
+                                          PredictorServer)
+
+
+# -- helpers ----------------------------------------------------------------
+
+def _req(port, path, obj=None, headers=None, method=None):
+    """(status, body_dict, headers_dict) for one HTTP round trip."""
+    url = f"http://127.0.0.1:{port}{path}"
+    data = None if obj is None else json.dumps(obj).encode()
+    r = urllib.request.Request(url, data=data, method=method,
+                               headers={"Content-Type":
+                                        "application/json",
+                                        **(headers or {})})
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            return resp.status, json.loads(resp.read()), dict(
+                resp.headers)
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, json.loads(body) if body else {}, dict(e.headers)
+
+
+def _post_bg(port, path, obj, headers=None):
+    """POST on a background thread; returns (thread, result_holder)."""
+    out = {}
+
+    def go():
+        try:
+            out["resp"] = _req(port, path, obj, headers)
+        except Exception as e:      # noqa: BLE001
+            out["error"] = e
+    t = threading.Thread(target=go, daemon=True)
+    t.start()
+    return t, out
+
+
+def _wait_for(cond, timeout=10.0, what="condition"):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _elapse_cooldown(breaker, seconds=1000.0):
+    """Warp the breaker's transition clock backwards instead of
+    sleeping through reset_after_s — keeps the tests fast AND immune
+    to slow-machine scheduling (a real sleep can silently outlive a
+    short cooldown and reclose the breaker mid-assertion)."""
+    with breaker._lock:
+        breaker._changed_at -= seconds
+
+
+class _CountingCallable:
+    """Plain dict->dict predictor (solo path, no batcher)."""
+
+    def __init__(self, block=None):
+        self.calls = 0
+        self.block = block          # threading.Event to wait on, or None
+
+    def __call__(self, inputs):
+        self.calls += 1
+        if self.block is not None:
+            assert self.block.wait(timeout=30)
+        return {"y": np.asarray([[2.0]], np.float32)}
+
+
+class _RunPredictor:
+    """run(list)->list predictor with a fixed exported leading dim
+    (what DynamicBatcher pads to / is capped by)."""
+
+    def __init__(self, dim=4, started=None, release=None):
+        self.dim = dim
+        self.calls = 0
+        self.started = started      # Event set when run() begins
+        self.release = release      # Event run() waits for
+
+    def get_input_names(self):
+        return ["x0"]
+
+    def get_output_names(self):
+        return ["out0"]
+
+    def input_shapes(self):
+        return [(self.dim, 2)]
+
+    def run(self, arrays):
+        self.calls += 1
+        if self.started is not None:
+            self.started.set()
+        if self.release is not None:
+            assert self.release.wait(timeout=30)
+        return [np.asarray(arrays[0]) * 2.0]
+
+
+_ONE_ROW = {"x0": [[1.0, 2.0]]}
+
+
+# -- circuit breaker through HTTP (chaos-driven) ----------------------------
+
+def test_breaker_opens_fast_fails_and_recloses():
+    pred = _CountingCallable()
+    # cooldown far beyond the test's runtime: transitions happen only
+    # when _elapse_cooldown warps the clock, never by accident
+    srv = PredictorServer(pred, breaker_threshold=3,
+                          breaker_reset_s=1000.0).start()
+    try:
+        with chaos.scoped(seed=7,
+                          rates={"serving.run.fail": (1.0, 3)}):
+            # three consecutive injected run failures -> three 500s
+            for _ in range(3):
+                code, body, _h = _req(srv.port, "/predict",
+                                      {"inputs": _ONE_ROW})
+                assert code == 500
+                assert "injected predictor run failure" in body["error"]
+            assert pred.calls == 0      # fault fires before the backend
+
+            # breaker is now open: fast-fail 503 + Retry-After, the
+            # predictor is never touched
+            code, body, hdrs = _req(srv.port, "/predict",
+                                    {"inputs": _ONE_ROW})
+            assert code == 503 and "circuit breaker" in body["error"]
+            assert "Retry-After" in hdrs
+            assert pred.calls == 0
+
+            # liveness vs readiness split while open
+            code, body, _h = _req(srv.port, "/healthz")
+            assert code == 200
+            code, body, hdrs = _req(srv.port, "/readyz")
+            assert code == 503 and body["reason"].startswith("breaker_")
+            assert "Retry-After" in hdrs
+
+            # cooldown -> half-open -> the probe succeeds (the fault
+            # cap is exhausted) -> reclosed
+            _elapse_cooldown(srv.breaker)
+            code, body, _h = _req(srv.port, "/predict",
+                                  {"inputs": _ONE_ROW})
+            assert code == 200 and pred.calls == 1
+
+        code, body, _h = _req(srv.port, "/readyz")
+        assert code == 200 and body["status"] == "ready"
+        st = srv.stats()
+        assert st["breaker"]["state"] == "closed"
+        assert st["breaker"]["opens"] == 1
+        assert st["breaker"]["recloses"] == 1
+        assert st["requests"]["server_error"] == 3
+        assert st["requests"]["shed_breaker"] == 1
+        assert st["requests"]["ok"] >= 1
+    finally:
+        srv.stop()
+
+
+def test_breaker_not_tripped_by_client_errors():
+    srv = PredictorServer(_CountingCallable(),
+                          breaker_threshold=2).start()
+    try:
+        for _ in range(4):
+            # missing "data" key in a dict input -> 400, backend fine
+            code, _b, _h = _req(srv.port, "/predict",
+                                {"inputs": {"x": {"dtype": "float32"}}})
+            assert code == 400
+        assert srv.breaker.state == CircuitBreaker.CLOSED
+        code, _b, _h = _req(srv.port, "/predict", {"inputs": _ONE_ROW})
+        assert code == 200
+    finally:
+        srv.stop()
+
+
+# -- admission / saturation -------------------------------------------------
+
+def test_saturated_admission_sheds_429_with_retry_after():
+    release = threading.Event()
+    pred = _CountingCallable(block=release)
+    srv = PredictorServer(pred, max_concurrent=1,
+                          max_queue_depth=0).start()
+    try:
+        t, out = _post_bg(srv.port, "/predict", {"inputs": _ONE_ROW})
+        _wait_for(lambda: srv.admission.in_flight == 1,
+                  what="first request in flight")
+
+        code, body, _h = _req(srv.port, "/readyz")
+        assert code == 503 and body["reason"] == "saturated"
+
+        code, body, hdrs = _req(srv.port, "/predict",
+                                {"inputs": _ONE_ROW})
+        assert code == 429
+        assert "admission rejected" in body["error"]
+        assert "Retry-After" in hdrs
+
+        release.set()
+        t.join(timeout=10)
+        assert out["resp"][0] == 200
+        assert srv.stats()["requests"]["shed_admission"] == 1
+        code, _b, _h = _req(srv.port, "/readyz")
+        assert code == 200
+    finally:
+        release.set()
+        srv.stop()
+
+
+def test_deadline_expired_at_admission_is_504_chaos_driven():
+    srv = PredictorServer(_CountingCallable()).start()
+    try:
+        # the injected admission delay (60ms) outlives the request's
+        # 20ms budget: the gate sheds 504 before touching anything
+        with chaos.scoped(seed=3, rates={"serving.admit.delay": 1.0},
+                          delay_ms=60):
+            code, body, _h = _req(srv.port, "/predict",
+                                  {"inputs": _ONE_ROW},
+                                  headers={"X-Timeout-Ms": "20"})
+        assert code == 504 and "deadline exceeded" in body["error"]
+        assert srv.stats()["requests"]["deadline_exceeded"] == 1
+    finally:
+        srv.stop()
+
+
+def test_timeout_ms_body_field_and_validation():
+    srv = PredictorServer(_CountingCallable()).start()
+    try:
+        code, _b, _h = _req(srv.port, "/predict",
+                            {"inputs": _ONE_ROW, "timeout_ms": 5000})
+        assert code == 200
+        code, body, _h = _req(srv.port, "/predict",
+                              {"inputs": _ONE_ROW, "timeout_ms": -5})
+        assert code == 400 and "timeout_ms" in body["error"]
+        code, body, _h = _req(srv.port, "/predict",
+                              {"inputs": _ONE_ROW,
+                               "timeout_ms": "nope"})
+        assert code == 400
+    finally:
+        srv.stop()
+
+
+# -- deadline expiry inside the batcher queue -------------------------------
+
+def test_expired_in_batcher_queue_gets_504_and_no_batch_slot():
+    started, release = threading.Event(), threading.Event()
+    pred = _RunPredictor(dim=4, started=started, release=release)
+    srv = PredictorServer(pred, dynamic_batching=True, max_batch_size=4,
+                          batch_timeout_ms=1.0).start()
+    try:
+        # request 1 occupies the batch worker inside run()
+        t, out = _post_bg(srv.port, "/predict", {"inputs": _ONE_ROW})
+        assert started.wait(timeout=10)
+
+        # request 2 queues behind it with a 40ms budget -> withdrawn
+        # with 504 while request 1 still holds the worker
+        code, body, _h = _req(srv.port, "/predict",
+                              {"inputs": _ONE_ROW},
+                              headers={"X-Timeout-Ms": "40"})
+        assert code == 504
+        assert "queued for batching" in body["error"]
+        assert pred.calls == 1          # the expired request never ran
+
+        release.set()
+        t.join(timeout=10)
+        assert out["resp"][0] == 200
+        st = srv.stats()
+        assert st["batcher"]["expired_in_queue"] == 1
+        assert st["batcher"]["batches_run"] == 1
+        assert pred.calls == 1          # still: no slot for dead work
+    finally:
+        release.set()
+        srv.stop()
+
+
+def test_batcher_worker_skips_expired_requests():
+    ran = []
+    b = DynamicBatcher(lambda arrays: (ran.append(len(arrays[0])),
+                                       [arrays[0]])[1],
+                       max_batch=8, timeout_ms=1.0)
+    try:
+        # already-dead deadline, submitted directly into the buffer:
+        # the worker must expire it without running anything
+        p_dead = Deadline(time.monotonic() - 1.0)
+        with pytest.raises(DeadlineExceeded):
+            b.submit([np.ones((1, 2), np.float32)], deadline=p_dead)
+        out = b.submit([np.ones((2, 2), np.float32)])
+        assert np.asarray(out[0]).shape == (2, 2)
+        assert ran == [2]               # only the live request ran
+    finally:
+        b.stop()
+
+
+def test_batcher_bounded_queue_sheds():
+    started, release = threading.Event(), threading.Event()
+
+    def run_fn(arrays):
+        started.set()
+        assert release.wait(timeout=30)
+        return [arrays[0]]
+
+    b = DynamicBatcher(run_fn, max_batch=1, timeout_ms=1.0, max_queue=1)
+    try:
+        holders, threads = [], []
+        # first request: taken by the worker, blocked inside run_fn
+        h0 = {}
+        th0 = threading.Thread(
+            target=lambda: h0.update(
+                r=b.submit([np.ones((1, 1), np.float32)])),
+            daemon=True)
+        th0.start()
+        threads.append(th0)
+        holders.append(h0)
+        assert started.wait(timeout=10)
+        # second request: sits in the (now full, max_queue=1) buffer
+        h1 = {}
+        th1 = threading.Thread(
+            target=lambda: h1.update(
+                r=b.submit([np.ones((1, 1), np.float32)])),
+            daemon=True)
+        th1.start()
+        threads.append(th1)
+        holders.append(h1)
+        _wait_for(lambda: len(b._buf) == 1, what="queued request")
+        from paddle_tpu.inference.overload import AdmissionRejected
+        with pytest.raises(AdmissionRejected):
+            b.submit([np.ones((1, 1), np.float32)])
+        assert b.shed_full == 1
+        release.set()
+        for th in threads:
+            th.join(timeout=10)
+        assert all("r" in h for h in holders)   # queued ones completed
+    finally:
+        release.set()
+        b.stop()
+
+
+# -- oversized batch --------------------------------------------------------
+
+def test_oversized_request_is_clear_400_not_xla_error():
+    pred = _RunPredictor(dim=2)
+    srv = PredictorServer(pred, dynamic_batching=True,
+                          max_batch_size=8).start()
+    try:
+        three_rows = {"x0": [[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]]}
+        code, body, _h = _req(srv.port, "/predict",
+                              {"inputs": three_rows})
+        assert code == 400
+        assert "exceeds the exported leading dim 2" in body["error"]
+        assert pred.calls == 0          # never reached the executable
+        # the in-process guard inside the run path agrees
+        with pytest.raises(OversizedBatch):
+            srv._run_locked([np.zeros((3, 2), np.float32)])
+    finally:
+        srv.stop()
+
+
+# -- graceful drain ---------------------------------------------------------
+
+def test_drain_finishes_inflight_then_rejects_and_stops():
+    release = threading.Event()
+    pred = _CountingCallable(block=release)
+    srv = PredictorServer(pred).start()
+    try:
+        t, out = _post_bg(srv.port, "/predict", {"inputs": _ONE_ROW})
+        _wait_for(lambda: srv.admission.in_flight == 1,
+                  what="in-flight request")
+
+        drained = {}
+        dt = threading.Thread(
+            target=lambda: drained.update(clean=srv.drain(timeout=20)),
+            daemon=True)
+        dt.start()
+        _wait_for(lambda: srv._draining, what="draining flag")
+
+        code, body, hdrs = _req(srv.port, "/predict",
+                                {"inputs": _ONE_ROW})
+        assert code == 503 and "draining" in body["error"]
+        assert "Retry-After" in hdrs
+        code, body, _h = _req(srv.port, "/readyz")
+        assert code == 503 and body["reason"] == "draining"
+
+        release.set()                   # let the in-flight one finish
+        t.join(timeout=10)
+        assert out["resp"][0] == 200    # drained, not killed
+        dt.join(timeout=20)
+        assert drained["clean"] is True
+        assert not srv._thread.is_alive()
+    finally:
+        release.set()
+
+
+# -- health / stats surfaces ------------------------------------------------
+
+def test_healthz_readyz_stats_surfaces():
+    srv = PredictorServer(_CountingCallable(), model_name="m1").start()
+    try:
+        for path in ("/health", "/healthz"):
+            code, body, _h = _req(srv.port, path)
+            assert code == 200 and body["model"] == "m1"
+        code, body, _h = _req(srv.port, "/readyz")
+        assert code == 200 and body["status"] == "ready"
+
+        code, _b, _h = _req(srv.port, "/predict", {"inputs": _ONE_ROW})
+        assert code == 200
+        code, st, _h = _req(srv.port, "/stats")
+        assert code == 200
+        assert st["requests"]["total"] == 1
+        assert st["requests"]["ok"] == 1
+        assert st["in_flight"] == 0
+        assert st["latency_ms"]["count"] == 1
+        assert st["latency_ms"]["p50_ms"] is not None
+        assert st["breaker"]["state"] == "closed"
+    finally:
+        srv.stop()
+
+
+# -- streaming client disconnect --------------------------------------------
+
+class _SlowTokenSource:
+    """generator= object whose stream() yields one token every few ms
+    and records close(); stands in for a decoding model."""
+
+    def __init__(self):
+        self.closed = threading.Event()
+        self.produced = 0
+
+    def stream(self, ids, **kw):
+        src = self
+
+        class _It:
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                if src.closed.is_set():
+                    raise StopIteration
+                src.produced += 1
+                time.sleep(0.003)
+                return np.asarray([7])
+
+            def close(self):
+                src.closed.set()
+        return _It()
+
+
+def test_generate_close_cancels_closes_source_and_frees_lock():
+    gen = _SlowTokenSource()
+    srv = PredictorServer(_CountingCallable(), generator=gen)
+    it = srv.generate_steps({"ids": [[1, 2]], "max_new_tokens": 10000})
+    first = next(it)
+    assert first["tokens"] == [7]
+    next(it)
+    it.close()                          # the client-disconnect path
+    # the producer must observe the cancel, close() the source...
+    assert gen.closed.wait(timeout=10)
+    # ...and release the executable lock (a wedged lock here is the
+    # whole-server outage this path guards against)
+    assert srv._lock.acquire(timeout=10)
+    srv._lock.release()
+    srv.stop()
+
+
+class _MidStreamFailSource:
+    """stream() yields two tokens, then the backend dies."""
+
+    def stream(self, ids, **kw):
+        def gen():
+            yield np.asarray([1])
+            yield np.asarray([2])
+            raise RuntimeError("backend died mid-stream")
+        return gen()
+
+
+def test_mid_stream_backend_failure_reaches_the_breaker():
+    srv = PredictorServer(_CountingCallable(),
+                          generator=_MidStreamFailSource(),
+                          breaker_threshold=2).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/generate"
+        for i in range(2):
+            r = urllib.request.Request(
+                url, data=json.dumps({"ids": [[1, 2]], "stream": True,
+                                      "max_new_tokens": 5}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(r, timeout=30) as resp:
+                assert resp.status == 200       # header already sent...
+                text = resp.read().decode()
+            # ...but the failure rode the stream as an error chunk
+            assert "backend died mid-stream" in text
+        # and counted against the breaker: two mid-stream deaths with
+        # threshold 2 -> open, next request fast-fails
+        assert srv.breaker.state == CircuitBreaker.OPEN
+        code, body, _h = _req(srv.port, "/predict", {"inputs": _ONE_ROW})
+        assert code == 503 and "circuit breaker" in body["error"]
+        assert srv.stats()["requests"]["server_error"] == 2
+    finally:
+        srv.stop()
+
+
+def test_http_stream_client_disconnect_cancels_producer():
+    gen = _SlowTokenSource()
+    srv = PredictorServer(_CountingCallable(), generator=gen).start()
+    try:
+        body = json.dumps({"ids": [[1, 2]], "max_new_tokens": 100000,
+                           "stream": True}).encode()
+        s = socket.create_connection(("127.0.0.1", srv.port),
+                                     timeout=10)
+        s.sendall(b"POST /generate HTTP/1.1\r\n"
+                  b"Host: x\r\nContent-Type: application/json\r\n"
+                  + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                  + body)
+        assert s.recv(1024)             # headers + some chunks flowed
+        s.close()                       # mid-stream disconnect
+        # the dead socket must propagate to a producer cancel + source
+        # close (via _stream_reply's finally), not decode 100k tokens
+        assert gen.closed.wait(timeout=30)
+    finally:
+        srv.stop()
+
+
+# -- lifecycle joins --------------------------------------------------------
+
+def test_batcher_stop_joins_worker_and_rejects_new_submits():
+    b = DynamicBatcher(lambda arrays: [arrays[0]])
+    b.stop()
+    assert not b._thread.is_alive()
+    with pytest.raises(RuntimeError, match="stopped"):
+        b.submit([np.ones((1, 1), np.float32)])
+
+
+def test_server_stop_joins_serve_thread():
+    srv = PredictorServer(_CountingCallable(),
+                          dynamic_batching=False).start()
+    srv.stop()
+    assert not srv._thread.is_alive()
+
+
+def test_batched_roundtrip_still_works():
+    pred = _RunPredictor(dim=4)
+    srv = PredictorServer(pred, dynamic_batching=True, max_batch_size=8,
+                          batch_timeout_ms=1.0).start()
+    try:
+        code, body, _h = _req(srv.port, "/predict", {"inputs": _ONE_ROW})
+        assert code == 200
+        out = body["outputs"]["out0"]
+        assert out["data"] == [[2.0, 4.0]]      # padded, run, sliced
+        assert out["shape"] == [1, 2]
+    finally:
+        srv.stop()
+
+
+# -- overload primitives (unit) ---------------------------------------------
+
+def test_admission_controller_counts():
+    ac = AdmissionController(max_concurrent=1, max_queue=1)
+    ac.try_acquire()
+    ac.try_acquire()
+    from paddle_tpu.inference.overload import AdmissionRejected
+    with pytest.raises(AdmissionRejected) as ei:
+        ac.try_acquire()
+    assert ei.value.retry_after is not None
+    assert ac.saturated and ac.in_flight == 2
+    ac.release()
+    ac.try_acquire()                    # headroom came back
+    assert ac.admitted == 3 and ac.rejected == 1
+
+
+def test_circuit_breaker_state_machine():
+    br = CircuitBreaker(failure_threshold=2, reset_after_s=1000.0)
+    br.allow(); br.record_failure()
+    br.allow(); br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    from paddle_tpu.inference.overload import CircuitOpenError
+    with pytest.raises(CircuitOpenError):
+        br.allow()
+    _elapse_cooldown(br)
+    br.allow()                          # the half-open probe
+    assert br.state == CircuitBreaker.HALF_OPEN
+    with pytest.raises(CircuitOpenError):
+        br.allow()                      # only one probe at a time
+    br.record_failure()                 # probe failed -> re-open
+    assert br.state == CircuitBreaker.OPEN
+    _elapse_cooldown(br)
+    br.allow()
+    br.record_success()                 # probe succeeded -> reclose
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.opens == 2 and br.recloses == 1
+    # an abandoned probe (no outcome recorded) self-heals after
+    # another cooldown instead of wedging the breaker half-open
+    br.record_failure(); br.record_failure()
+    _elapse_cooldown(br)
+    br.allow()                          # probe taken, outcome lost
+    with pytest.raises(CircuitOpenError):
+        br.allow()
+    _elapse_cooldown(br)
+    br.allow()                          # replenished probe budget
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_half_open_probe_released_on_shed():
+    br = CircuitBreaker(failure_threshold=1, reset_after_s=1000.0)
+    br.allow(); br.record_failure()
+    _elapse_cooldown(br)
+    br.allow()                          # probe taken
+    br.release_probe()                  # request shed before the run
+    br.allow()                          # budget back immediately
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+
+
+def test_readiness_warns_before_hard_429():
+    from paddle_tpu.inference.overload import AdmissionRejected
+    ac = AdmissionController(max_concurrent=1, max_queue=1)
+    ac.try_acquire()
+    assert ac.saturated                 # /readyz early warning...
+    ac.try_acquire()                    # ...while still admitting
+    with pytest.raises(AdmissionRejected):
+        ac.try_acquire()                # hard shed only past capacity
+
+
+def test_latency_stats_percentiles():
+    ls = LatencyStats(capacity=16)
+    assert ls.snapshot() == {"count": 0, "p50_ms": None, "p99_ms": None}
+    for ms in range(1, 11):
+        ls.record(ms / 1000.0)
+    snap = ls.snapshot()
+    assert snap["count"] == 10
+    assert 4.0 <= snap["p50_ms"] <= 7.0
+    assert snap["p99_ms"] >= 9.0
+
+
+def test_deadline_helpers():
+    d = Deadline.after_ms(10_000)
+    assert not d.expired() and d.remaining() > 9.0
+    d2 = Deadline(time.monotonic() - 0.001)
+    assert d2.expired()
+    with pytest.raises(DeadlineExceeded):
+        d2.check("unit test")
+    assert Deadline.after_ms(None).remaining() is None

@@ -110,3 +110,22 @@ print(f"heterogeneous budgets: {total} tokens in {dt:.2f}s = "
       f"{total / dt:.1f} tok/s aggregate | admitted="
       f"{eng2.stats['admitted'] - warm2['admitted']} "
       f"ticks={eng2.stats['ticks'] - warm2['ticks']}")
+
+# overload probe: with a bounded pending queue, a burst beyond the
+# bound sheds a typed EngineOverloaded (what the HTTP tier maps to a
+# retryable 503) instead of queueing unboundedly
+from paddle_tpu.inference.overload import EngineOverloaded
+eng2.max_pending = 2
+admitted, shed = [], 0
+for p in prompts:
+    try:
+        admitted.append(eng2.submit(p, max_new_tokens=8))
+    except EngineOverloaded:
+        shed += 1
+eng2.run_until_idle()
+for r in admitted:
+    r.result()
+print(f"overload probe (max_pending=2): {len(admitted)} admitted, "
+      f"{shed} shed | engine counters: "
+      f"overloaded={eng2.stats['overloaded']} "
+      f"expired={eng2.stats['expired']}")
