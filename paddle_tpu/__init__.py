@@ -86,7 +86,8 @@ def __getattr__(name):
     if name in ("distributed", "vision", "distribution", "profiler",
                 "incubate", "sparse", "static", "hapi", "models", "fft",
                 "signal", "linalg", "quantization", "geometric", "text",
-                "audio", "onnx", "utils", "inference", "sysconfig", "version"):
+                "audio", "onnx", "utils", "inference", "sysconfig",
+                "version", "observability"):
         try:
             mod = importlib.import_module(f"paddle_tpu.{name}")
         except ModuleNotFoundError as e:

@@ -204,7 +204,20 @@ def should_fire(site: str) -> bool:
         if u >= p:
             return False
         _state.fired[site] = _state.fired.get(site, 0) + 1
-        return True
+    _note_fire(site)
+    return True
+
+
+def _note_fire(site: str) -> None:
+    """Mirror a fired fault into the shared metrics registry (import
+    deferred: this module must stay loadable standalone, stdlib-only —
+    tools/check_chaos_points.py execs it for the POINTS registry)."""
+    try:
+        from paddle_tpu import observability
+        if observability.ENABLED:
+            observability.inc("chaos.injections", site=site)
+    except Exception:   # noqa: BLE001 — telemetry never breaks a fault
+        pass
 
 
 def fire_count(site: str) -> int:

@@ -23,11 +23,13 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import time
 from contextlib import contextmanager
 
 import numpy as np
 import jax
 
+from paddle_tpu import observability
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.distributed import chaos
 from paddle_tpu.distributed.retries import default_policy
@@ -51,8 +53,13 @@ _io_retry = default_policy(retryable=(OSError,))
 # migration hooks upgrade old merged tables on load).
 # v1: unstamped (r1-r3 checkpoints); v2: adds format_version stamp;
 # v3: per-file sha256 checksums in each host table's "__files__" entry
-# (older checkpoints simply skip integrity verification on load).
-_FORMAT_VERSION = 3
+# (older checkpoints simply skip integrity verification on load);
+# v4: each table_*.json carries a "__table_digest__" self-digest over
+# its canonical JSON, so a corrupted-but-PARSEABLE table (bit flip in
+# a shape/offset digit, or in the recorded shard digests themselves)
+# is detected and quarantined like a torn shard instead of silently
+# loading wrong weights.
+_FORMAT_VERSION = 4
 
 
 class CheckpointCorruptionError(RuntimeError):
@@ -298,7 +305,34 @@ def _atomic_write(final, write_fn):
                 pass
 
 
+def _table_digest(table: dict) -> str:
+    """sha256 over the table's canonical JSON (sorted keys, no
+    whitespace), excluding the digest record itself. Recomputed from
+    the PARSED dict on load, so it survives the pretty-printed on-disk
+    encoding and catches any semantic corruption of shapes/offsets/
+    recorded checksums that still parses as JSON."""
+    body = {k: v for k, v in table.items() if k != "__table_digest__"}
+    blob = json.dumps(body, sort_keys=True,
+                      separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _table_digest_issue(table: dict):
+    """None when `table` matches its recorded self-digest (or predates
+    v4 and has none to check), else a reason string."""
+    rec = table.get("__table_digest__")
+    if rec is None:
+        return None                     # pre-v4: nothing to verify
+    if not isinstance(rec, dict) or not rec.get("sha256"):
+        return "table digest record malformed"
+    if _table_digest(table) != rec["sha256"]:
+        return ("table digest mismatch (corrupted-but-parseable "
+                "table)")
+    return None
+
+
 def _write_files(payload, meta, pid, path, coordinator_rank):
+    t0 = time.monotonic()
     os.makedirs(path, exist_ok=True)
     fname = f"shards_{pid}.npz"
     shards_path = os.path.join(path, fname)
@@ -309,6 +343,9 @@ def _write_files(payload, meta, pid, path, coordinator_rank):
     table = dict(meta)
     table["__files__"] = {fname: {"sha256": _sha256_file(shards_path),
                                   "size": os.path.getsize(shards_path)}}
+    # the table's own integrity record goes last: it covers every other
+    # key, including the shard checksums above
+    table["__table_digest__"] = {"sha256": _table_digest(table)}
     if chaos.ENABLED:
         # torn/corrupted write AFTER the digest was taken: the failure
         # atomic rename can't protect against (partial flush on power
@@ -326,6 +363,10 @@ def _write_files(payload, meta, pid, path, coordinator_rank):
                           {"process_count": jax.process_count(),
                            "format_version": _FORMAT_VERSION},
                           indent=1).encode()))
+    if observability.ENABLED:
+        observability.inc("ckpt.saves")
+        observability.observe("ckpt.save.seconds",
+                              time.monotonic() - t0)
 
 
 _barrier_seq = 0
@@ -427,6 +468,13 @@ def _merged_tables(path):
     for fn in tables:
         with open(os.path.join(path, fn)) as f:
             tbl = json.load(f)
+        why = _table_digest_issue(tbl)
+        if why is not None:
+            # parseable but corrupt: shapes/offsets/recorded checksums
+            # cannot be trusted — surface as corruption so callers
+            # (load_newest_complete, run_resilient) quarantine and
+            # fall back instead of assembling silently wrong weights
+            raise CheckpointCorruptionError(path, {fn: why})
         for name, entry in tbl.items():
             if name.startswith("__"):   # reserved (file checksums etc.)
                 continue
@@ -478,9 +526,12 @@ def _recorded_checksums(path):
         if fn.startswith("table_") and fn.endswith(".json"):
             try:
                 with open(os.path.join(path, fn)) as f:
-                    out.update(json.load(f).get("__files__") or {})
+                    tbl = json.load(f)
             except (OSError, ValueError):
                 continue    # unparseable table reported by verify/merge
+            if _table_digest_issue(tbl) is not None:
+                continue    # corrupt table: its records can't be trusted
+            out.update(tbl.get("__files__") or {})
     return out
 
 
@@ -548,6 +599,10 @@ def verify_checkpoint(path):
         except (OSError, ValueError) as e:
             bad[fn] = f"unparseable (torn write?): {e}"
             continue
+        why = _table_digest_issue(tbl)
+        if why is not None:
+            bad[fn] = why
+            continue        # nothing in a corrupt table is trustable
         recs = tbl.get("__files__") or {}
         for fname, rec in recs.items():
             why = _check_file(path, fname, rec)
@@ -584,6 +639,8 @@ def quarantine_corrupt(path, bad_files=None):
         os.makedirs(qdir, exist_ok=True)
         os.replace(src, os.path.join(qdir, fn))
         moved.append(fn)
+    if moved and observability.ENABLED:
+        observability.inc("ckpt.quarantined_files", len(moved))
     return moved
 
 
@@ -635,6 +692,8 @@ def newest_complete_checkpoint(root, quarantine=True):
         issues = verify_checkpoint(d)
         if not issues:
             return d
+        if observability.ENABLED:
+            observability.inc("ckpt.fallbacks")
         if quarantine:
             quarantine_corrupt(d, issues)
     return None
@@ -686,6 +745,7 @@ def load_state_dict(state_dict, path, process_group=None,
     # loading a checkpoint this process just wrote with async_save=True
     # must wait for the writer (else a half-written directory loads)
     finish_async_save()
+    t0 = time.monotonic()
     meta = _merged_tables(path)
     checksums = _recorded_checksums(path)
 
@@ -773,4 +833,8 @@ def load_state_dict(state_dict, path, process_group=None,
         else:
             out[name] = Tensor(new_arr)
     _unflatten_into(state_dict, out)
+    if observability.ENABLED:
+        observability.inc("ckpt.loads")
+        observability.observe("ckpt.load.seconds",
+                              time.monotonic() - t0)
     return state_dict

@@ -19,6 +19,7 @@ import signal
 import threading
 import time
 
+from paddle_tpu import observability
 from paddle_tpu.distributed import chaos
 
 __all__ = ["ElasticManager", "ElasticSupervisor", "StoreHeartbeat",
@@ -51,6 +52,10 @@ class ElasticManager:
 
     # -- preemption --------------------------------------------------------
     def _on_signal(self, signum, frame):
+        # NOTHING lock-taking here: a handler interrupting the main
+        # thread mid-registry-update would deadlock on the metrics
+        # lock. The flag flip is atomic; observers count the
+        # preemption when they NOTICE it (run loops below).
         self._preempted = True
 
     @property
@@ -103,10 +108,14 @@ class ElasticManager:
                     step = end
                     self.checkpoint(step - 1)
                     if self._preempted:
+                        if observability.ENABLED:
+                            observability.inc("elastic.preemptions")
                         return step  # clean exit; scheduler restarts us
                 return total_steps
             except Exception:
                 restarts += 1
+                if observability.ENABLED:
+                    observability.inc("elastic.restarts")
                 if restarts > self.max_restarts:
                     raise
                 # resume loop from last checkpoint
@@ -274,6 +283,8 @@ class ElasticSupervisor:
                     continue
                 self._kill_all()
                 self.restarts += 1
+                if observability.ENABLED:
+                    observability.inc("elastic.restarts")
                 if self.restarts > self.max_restarts:
                     raise RuntimeError(
                         f"elastic job failed: rank(s) "
@@ -492,6 +503,8 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
                         # a checkpoint for `step` is already on disk
                         # (or step 0's); restart from it
                         mgr._preempted = False
+                        if observability.ENABLED:
+                            observability.inc("elastic.preemptions")
                         raise _Preempted()
                     end = min(step + checkpoint_interval, total_steps)
                     dirty = True
@@ -519,12 +532,16 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
                         "resumed_from": resumed_from}
             except _Preempted:
                 restarts += 1
+                if observability.ENABLED:
+                    observability.inc("elastic.restarts")
                 if restarts > max_restarts:
                     raise RuntimeError(
                         f"run_resilient: max_restarts={max_restarts} "
                         "exhausted after repeated preemptions") from None
             except Exception:
                 restarts += 1
+                if observability.ENABLED:
+                    observability.inc("elastic.restarts")
                 if restarts > max_restarts:
                     raise
                 # fall through: reload from the newest complete

@@ -26,6 +26,23 @@ from dataclasses import dataclass, field
 __all__ = ["RetryPolicy", "RetryBudgetExceeded", "default_policy"]
 
 
+def _note_retry(exhausted=False):
+    """Count retries/exhaustions in the shared metrics registry. On the
+    retry path a fault already fired, so the lazy import + attribute
+    check is noise against the backoff sleep; import failures (partial
+    interpreter teardown) are swallowed — retrying matters more than
+    counting it."""
+    try:
+        from paddle_tpu import observability
+        if observability.ENABLED:
+            if exhausted:
+                observability.inc("retry.exhausted")
+            else:
+                observability.inc("retry.attempts")
+    except Exception:   # noqa: BLE001
+        pass
+
+
 class RetryBudgetExceeded(RuntimeError):
     """All attempts (or the deadline) exhausted; `last` is the final
     underlying exception, also chained as __cause__."""
@@ -69,12 +86,14 @@ class RetryPolicy:
                 if self.deadline is not None and (
                         time.monotonic() - start + delay > self.deadline):
                     break
+                _note_retry()           # cold path: a fault already hit
                 self.sleep(delay)
                 if on_retry is not None:
                     try:
                         on_retry(attempt, e)
                     except Exception:   # noqa: BLE001 — recovery is
                         pass            # best-effort; next try reports
+        _note_retry(exhausted=True)
         raise RetryBudgetExceeded(
             f"{desc or getattr(fn, '__name__', 'op')} failed after "
             f"{self.max_attempts} attempts "

@@ -20,7 +20,7 @@ import threading
 import time
 from contextlib import contextmanager
 
-from paddle_tpu import _native
+from paddle_tpu import _native, observability
 from paddle_tpu.distributed import chaos
 from paddle_tpu.distributed.retries import default_policy
 
@@ -377,9 +377,10 @@ class TCPStore(Store):
         # get TIMEOUTS are semantic and never retried. Note `add` is not
         # idempotent — a reply lost AFTER the server applied it double-
         # counts on retry, so exact-count protocols must not build on
-        # it (barrier() uses idempotent per-rank set()s for this
-        # reason); add-based counters are safe only when overcount is
-        # tolerable (monotonic progress markers compared with >=).
+        # it; add-based counters are safe only when overcount is
+        # tolerable (monotonic progress markers compared with >=, e.g.
+        # barrier()'s scan-now hint — arrival truth there stays an
+        # idempotent per-rank set()).
         self._retry = retry_policy if retry_policy is not None \
             else default_policy(retryable=(ConnectionError,))
         self._barrier_rounds: dict = {}   # local per-name round index
@@ -412,6 +413,8 @@ class TCPStore(Store):
     def _reconnect(self, attempt, exc):
         """Between retry attempts: the old connection's protocol state
         is garbage after a transport failure — dial a fresh one."""
+        if observability.ENABLED:
+            observability.inc("store.rpc.reconnects")
         if self._native_client:
             if self._client:
                 self._lib.pt_store_client_free(self._client)
@@ -428,7 +431,9 @@ class TCPStore(Store):
         """Every public op goes through here: chaos injection point
         `store.client` (delay + dropped connection) ahead of the wire
         op, transport failures retried per policy with a reconnect
-        between attempts. Disabled chaos costs one attribute check."""
+        between attempts, and (when observability is enabled) an RPC
+        count + round-trip latency per op kind. Disabled chaos and
+        disabled observability each cost one attribute check."""
         def attempt():
             if self._native_client and not self._client:
                 # a previous reconnect failed and left no handle (the
@@ -440,6 +445,19 @@ class TCPStore(Store):
                 chaos.maybe_delay("store.client")
                 chaos.maybe_drop("store.client")
             return fn()
+        if observability.ENABLED:
+            # desc is "store.<op>(<key>)"; the op kind is the label
+            # (bounded cardinality — keys are not)
+            op = desc.partition("(")[0].rpartition(".")[2]
+            observability.inc("store.rpc.total", op=op)
+            t0 = time.monotonic()
+            try:
+                return self._retry.run(attempt, desc=desc,
+                                       on_retry=self._reconnect)
+            finally:
+                observability.observe(
+                    "store.rpc.latency_ms",
+                    (time.monotonic() - t0) * 1000.0, op=op)
         return self._retry.run(attempt, desc=desc,
                                on_retry=self._reconnect)
 
@@ -538,17 +556,26 @@ class TCPStore(Store):
         calling barrier("epoch", ...) every epoch re-synchronizes
         instead of falling through on a stale done flag.
 
-        Retry-safe by construction: arrival is an idempotent per-rank
-        set(), not a shared counter add() — a reply lost to a connection
-        drop and re-sent cannot double-count a rank (an add-based count
-        skews round arithmetic for every later round). Whichever
-        rank(s) observe the full arrival set mark done; done is also a
-        set(), so racing markers are harmless.
+        Cost: O(1) store round trips per rank (set + add + wait), plus
+        ONE O(ws) arrival scan by the closing rank(s) — O(ws) total,
+        where the previous every-rank-scans-every-rank design issued
+        O(ws^2) round trips per round (a quadratic storm at pod scale).
+
+        Retry-safe by construction, as a counter/arrival-scan HYBRID:
+        arrival truth is still an idempotent per-rank set() — a reply
+        lost to a connection drop and re-sent cannot double-count a
+        rank. The shared add() counter is only a cheap HINT of when to
+        scan: a retried add can overcount (making an early rank scan
+        too soon — it finds a missing arrival and simply falls through
+        to wait), but can never undercount, so the last-arriving rank
+        always sees count >= ws, scans the authoritative arrival set,
+        and marks done. Done is a set(), so racing closers are
+        harmless.
 
         Elastic relaunches namespace by PADDLE_ELASTIC_ATTEMPT: the
         supervisor restarts the WHOLE world with a fresh attempt id, so
         restarted clients (local rounds back at 0) never fall through
-        the previous life's stale done keys. The marker rank deletes
+        the previous life's stale done keys. The closing rank deletes
         the previous round's keys, bounding server state to ~one round
         per barrier name."""
         from paddle_tpu.distributed import watchdog
@@ -561,12 +588,17 @@ class TCPStore(Store):
         pre = f"barrier/a{attempt}/{name}/{round_idx}"
         done_key = f"{pre}/done"
         self.set(f"{pre}/arrive/{rank}", b"1")
-        if all(self.check(f"{pre}/arrive/{r}") for r in range(ws)):
+        if self.add(f"{pre}/count", 1) >= ws \
+                and all(self.check(f"{pre}/arrive/{r}")
+                        for r in range(ws)):
             self.set(done_key, b"1")
+            if observability.ENABLED:
+                observability.inc("store.barrier.rounds")
             if round_idx > 0:   # GC the completed previous round
                 prev = f"barrier/a{attempt}/{name}/{round_idx - 1}"
                 for r in range(ws):
                     self.delete_key(f"{prev}/arrive/{r}")
+                self.delete_key(f"{prev}/count")
                 self.delete_key(f"{prev}/done")
         tmo_ms = int((timeout or self._timeout) * 1000)
         with watchdog.watch(f"store.barrier/{name} rank={rank}", tmo_ms):

@@ -328,6 +328,23 @@ class PagedKVEngine:
         # ticker thread is the only chip user
         self.concurrent_safe = True
 
+    def export_metrics(self, registry):
+        """Publish the engine's telemetry counters into a metrics
+        registry as scrape-time gauges (PredictorServer's GET /metrics
+        calls this on its generator). Monotonic stats stay gauges
+        because they are absolute values sampled at scrape time, not
+        increments."""
+        s = self.stats
+        registry.set_gauge("engine.ticks", s["ticks"])
+        registry.set_gauge("engine.prefills", s["prefills"])
+        registry.set_gauge("engine.tokens_out", s["tokens_out"])
+        registry.set_gauge("engine.admitted", s["admitted"])
+        registry.set_gauge("engine.finished", s["finished"])
+        registry.set_gauge("engine.cancelled", s["cancelled"])
+        registry.set_gauge("engine.expired", s["expired"])
+        registry.set_gauge("engine.overloaded", s["overloaded"])
+        registry.set_gauge("engine.pending", len(self._pending))
+
     # -- submission ------------------------------------------------------
     def admission_headroom(self):
         """Pages not promised to any admitted slot (free minus

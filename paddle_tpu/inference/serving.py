@@ -23,6 +23,11 @@ GET  /readyz   -> 200 when accepting traffic; 503 {"reason":
                "saturated"} when a load balancer should steer away
 GET  /stats    -> JSON counters (admission, sheds, breaker state,
                latency p50/p99, batcher queue)
+GET  /metrics  -> Prometheus text exposition (observability/): request
+               outcomes + latency histogram, admission/breaker/batcher
+               gauges, paged-engine counters, and the process-wide
+               registry (training telemetry, store RPC, checkpoint,
+               elastic, chaos) when observability is enabled
 GET  /metadata -> input/output names of the served program
 
 Requests are serialized through a lock (one XLA executable, one chip).
@@ -58,8 +63,9 @@ import numpy as np
 
 from paddle_tpu.inference.overload import (
     AdmissionController, AdmissionRejected, CircuitBreaker, Deadline,
-    DeadlineExceeded, LatencyStats, OverloadError, ServerDraining,
+    DeadlineExceeded, OverloadError, ServerDraining,
     expired as _expired)
+from paddle_tpu.observability.metrics import MetricsRegistry
 
 __all__ = ["PredictorServer", "DynamicBatcher", "serve",
            "UnbatchableRequest", "OversizedBatch"]
@@ -286,10 +292,48 @@ class DynamicBatcher:
         self._thread.join(timeout=join_timeout)
 
 
+class _RegistryLatency:
+    """The old LatencyStats surface (record seconds, snapshot in ms)
+    rebased onto the server's metrics registry: the histogram
+    `serving.request.latency_ms` is the single source of truth — the
+    /stats JSON (keys kept stable) and the /metrics exposition both
+    read it."""
+
+    def __init__(self, metrics: MetricsRegistry):
+        self._metrics = metrics
+        self._hist = metrics.histogram("serving.request.latency_ms")
+
+    def record(self, seconds):
+        self._metrics.observe("serving.request.latency_ms",
+                              float(seconds) * 1000.0)
+
+    def percentile(self, p):
+        """Seconds, like LatencyStats.percentile (None when empty)."""
+        v = self._hist.percentile(p)
+        return None if v is None else v / 1000.0
+
+    def snapshot(self):
+        count = self._hist.count()
+        if not count:
+            return {"count": 0, "p50_ms": None, "p99_ms": None}
+        return {"count": count,
+                "p50_ms": self._hist.percentile(50),
+                "p99_ms": self._hist.percentile(99)}
+
+
 class PredictorServer:
     """Serve a Predictor (or any callable dict->dict) over HTTP, behind
     an overload-control gate (admission / deadlines / circuit breaker /
-    graceful drain — module doc)."""
+    graceful drain — module doc).
+
+    Observability: every server owns a MetricsRegistry (pass
+    `metrics=` to share one). Request outcomes and latency are
+    recorded there — /stats reads them back (old JSON keys stable) and
+    GET /metrics serves the Prometheus text exposition of this
+    registry, engine counters from a generator's `export_metrics`, and
+    the process-wide observability.REGISTRY (training/store/checkpoint
+    /elastic/chaos instrumentation, populated when
+    observability.enable() is on)."""
 
     # bad requests: the backend is fine, the payload is not. These map
     # to 400 and do NOT count as breaker failures.
@@ -300,7 +344,7 @@ class PredictorServer:
                  max_batch_size=8, batch_timeout_ms=5.0, generator=None,
                  *, max_concurrent=32, max_queue_depth=64,
                  default_timeout_ms=None, breaker_threshold=5,
-                 breaker_reset_s=5.0, retry_after_s=1.0):
+                 breaker_reset_s=5.0, retry_after_s=1.0, metrics=None):
         self.predictor = predictor
         self.model_name = model_name
         self.generator = generator
@@ -312,9 +356,12 @@ class PredictorServer:
         self.breaker = CircuitBreaker(
             failure_threshold=breaker_threshold,
             reset_after_s=breaker_reset_s)
-        self.latency = LatencyStats()
-        self._counts: collections.Counter = collections.Counter()
-        self._counts_lock = threading.Lock()
+        # per-server by default so two servers in one process (tests,
+        # multi-model deployments) never merge each other's counts
+        self.metrics = metrics if metrics is not None \
+            else MetricsRegistry()
+        self._requests = self.metrics.counter("serving.requests")
+        self.latency = _RegistryLatency(self.metrics)
         self._draining = False
         self.retry_after_s = float(retry_after_s)
         self.batcher = None
@@ -409,6 +456,16 @@ class PredictorServer:
                         retry_after=outer.retry_after_s)
                 if self.path == "/stats":
                     return self._reply(200, outer.stats())
+                if self.path == "/metrics":
+                    body = outer.metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path == "/metadata":
                     return self._reply(200, outer.metadata())
                 return self._reply(404, {"error": "unknown path"})
@@ -479,8 +536,7 @@ class PredictorServer:
 
     # -- overload gate ------------------------------------------------------
     def _count(self, key):
-        with self._counts_lock:
-            self._counts[key] += 1
+        self.metrics.inc("serving.requests", outcome=key)
 
     def _request_deadline(self, req, headers):
         """Deadline from the X-Timeout-Ms header, the `timeout_ms` body
@@ -566,8 +622,9 @@ class PredictorServer:
         return True, "ready"
 
     def stats(self):
-        with self._counts_lock:
-            counts = dict(self._counts)
+        # the registry is the source of truth; /stats keys unchanged
+        counts = {dict(k).get("outcome", ""): v
+                  for k, v in self._requests.labeled().items()}
         out = {"model": self.model_name,
                "draining": self._draining,
                "in_flight": self.admission.in_flight,
@@ -583,6 +640,49 @@ class PredictorServer:
                 "expired_in_queue": self.batcher.expired_in_queue,
                 "shed_full": self.batcher.shed_full}
         return out
+
+    def metrics_text(self):
+        """The GET /metrics body: scrape-time gauges for the live
+        admission/breaker/batcher state, engine counters from a
+        generator exposing `export_metrics(registry)` (PagedKVEngine),
+        this server's request counters + latency histogram, then the
+        process-wide observability registry."""
+        m = self.metrics
+        m.set_gauge("serving.in_flight", self.admission.in_flight)
+        m.set_gauge("serving.capacity", self.admission.capacity)
+        m.set_gauge("serving.draining", 1.0 if self._draining else 0.0)
+        m.set_gauge("serving.admission.admitted", self.admission.admitted)
+        m.set_gauge("serving.admission.rejected", self.admission.rejected)
+        b = self.breaker.snapshot()
+        m.set_gauge("serving.breaker.state",
+                    {"closed": 0, "half_open": 1, "open": 2}.get(
+                        b["state"], -1))
+        m.set_gauge("serving.breaker.consecutive_failures",
+                    b["consecutive_failures"])
+        m.set_gauge("serving.breaker.opens", b["opens"])
+        m.set_gauge("serving.breaker.recloses", b["recloses"])
+        if self.batcher is not None:
+            m.set_gauge("serving.batcher.queued", len(self.batcher._buf))
+            m.set_gauge("serving.batcher.batches_run",
+                        self.batcher.batches_run)
+            m.set_gauge("serving.batcher.requests_served",
+                        self.batcher.requests_served)
+            m.set_gauge("serving.batcher.expired_in_queue",
+                        self.batcher.expired_in_queue)
+            m.set_gauge("serving.batcher.shed_full",
+                        self.batcher.shed_full)
+        g = self.generator
+        if g is not None and hasattr(g, "export_metrics"):
+            g.export_metrics(m)
+        from paddle_tpu.observability import REGISTRY
+        text = m.prometheus_text()
+        if REGISTRY is not m:
+            # a family already emitted from the server registry must
+            # not repeat (e.g. another server sharing the global
+            # registry via metrics=): duplicate # TYPE lines are
+            # invalid exposition and fail the whole scrape
+            text += REGISTRY.prometheus_text(exclude=m.names())
+        return text
 
     # -- core -------------------------------------------------------------
     _GEN_PARAMS = ("max_new_tokens", "attention_mask", "eos_token_id",

@@ -1,0 +1,139 @@
+"""Unified observability: metrics registry, span tracing, training
+telemetry (reference: python/paddle/profiler is the reference's only
+telemetry layer; production TPU stacks — MegaScale et al. — credit
+per-step tokens/sec + MFU, RPC/collective counters and restart
+accounting for keeping large runs healthy. This package is that plane
+for paddle_tpu).
+
+Three pieces, one switch:
+
+    metrics.py    thread-safe MetricsRegistry of Counter/Gauge/
+                  Histogram with a closed name catalogue (METRICS),
+                  JSON snapshot + Prometheus text exposition (served
+                  at GET /metrics by inference/serving.PredictorServer)
+    trace.py      span(name, **attrs) -> bounded ring buffer ->
+                  chrome-trace JSON, mergeable with the profiler's
+                  HostTracer events
+    telemetry.py  per-step training reporter: tokens/sec/chip + MFU
+                  (the bench.py math, in-framework), lagged loss,
+                  driven by parallel/trainer.py
+
+Contract with the hot path — the same one distributed/chaos.py set:
+when observability is disabled (the default), every instrumentation
+point is a single module-attribute load + falsy branch:
+
+    if observability.ENABLED:
+        observability.inc("store.rpc.retries")
+
+No dict lookup, no allocation, no lock. Enabling is explicit —
+`observability.enable()` in-process, or PADDLE_TPU_OBS=1 in the
+environment (read once at import). The serving stack's own request
+counters are the exception: they are always on because they REPLACE
+the /stats bookkeeping PredictorServer already paid for (per-server
+registries, not this module's global one).
+
+Metric names at instrumentation sites must be string literals from
+the metrics.METRICS catalogue; tools/check_metric_names.py (tier-1
+wired) fails the build otherwise.
+
+Importing this package never touches jax.
+"""
+from __future__ import annotations
+
+import os
+
+from paddle_tpu.observability import metrics as metrics  # noqa: PLC0414
+from paddle_tpu.observability import trace as trace      # noqa: PLC0414
+from paddle_tpu.observability.metrics import (
+    METRICS, MetricsRegistry, REGISTRY)
+from paddle_tpu.observability.trace import Span, export_chrome_trace
+
+__all__ = [
+    "ENABLED", "enable", "disable", "scoped", "inc", "observe",
+    "set_gauge", "span", "METRICS", "MetricsRegistry", "REGISTRY",
+    "Span", "export_chrome_trace", "metrics", "trace",
+]
+
+# the ONE attribute hot paths branch on
+ENABLED = False
+
+
+def enable(reset=False):
+    """Turn instrumentation on process-wide. `reset=True` also clears
+    the global registry and span ring (test harness form)."""
+    global ENABLED
+    if reset:
+        REGISTRY.reset()
+        trace.clear()
+    ENABLED = True
+
+
+def disable():
+    """Back to the zero-cost default; recorded data is kept."""
+    global ENABLED
+    ENABLED = False
+
+
+class _Scoped:
+    def __init__(self, reset):
+        self._reset = reset
+
+    def __enter__(self):
+        self._prev = ENABLED
+        enable(reset=self._reset)
+        return REGISTRY
+
+    def __exit__(self, *exc):
+        global ENABLED
+        ENABLED = self._prev
+        return False
+
+
+def scoped(reset=True):
+    """`with observability.scoped() as registry:` — enable for a block,
+    restoring the previous state (including disabled) on exit."""
+    return _Scoped(reset)
+
+
+# -- instrumentation surface ------------------------------------------------
+# Call sites gate with `if observability.ENABLED:` so the disabled cost
+# is one attribute check; these helpers themselves always record (into
+# the global REGISTRY), which is what tests and scoped() rely on.
+
+def inc(name, n=1, **labels):
+    REGISTRY.inc(name, n, **labels)
+
+
+def observe(name, v, **labels):
+    REGISTRY.observe(name, v, **labels)
+
+
+def set_gauge(name, v, **labels):
+    REGISTRY.set_gauge(name, v, **labels)
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+def span(name, **attrs):
+    """Timed scope -> the trace ring. Cheap when disabled: returns a
+    shared no-op context manager without allocating."""
+    if not ENABLED:
+        return _NOOP_SPAN
+    return Span(name, attrs)
+
+
+# -- env bootstrap (read once at import) ------------------------------------
+
+if os.environ.get("PADDLE_TPU_OBS") == "1":
+    enable()

@@ -1,0 +1,442 @@
+"""Process-wide metrics: a thread-safe registry of Counter / Gauge /
+Histogram instruments with label support, a JSON snapshot, and
+Prometheus text exposition (served by PredictorServer's /metrics).
+
+The reference ships a whole profiler layer but no *metrics* plane:
+retries, breaker trips, checkpoint fallbacks and elastic restarts in
+this tree previously left no durable signal. This module is the
+substrate: every runtime instrumentation site increments a named
+instrument here, and any exporter (the serving /metrics endpoint, a
+test, a notebook) reads one consistent snapshot.
+
+Metric NAMES are a closed catalogue (`METRICS` below), exactly like
+chaos.POINTS: an instrumentation call with a name that is not
+catalogued raises at runtime, and tools/check_metric_names.py (tier-1
+wired via tests/test_metric_names_tool.py) fails the build on any
+non-literal or unregistered name at a call site — so the README's
+metric table can never silently drift from the code.
+
+Everything is stdlib-only; importing this module never touches jax
+(tools/check_metric_names.py loads it standalone for the catalogue).
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+__all__ = ["METRICS", "Counter", "Gauge", "Histogram",
+           "MetricsRegistry", "REGISTRY", "DEFAULT_BUCKETS_MS",
+           "DEFAULT_BUCKETS_S"]
+
+# latency-ish defaults; histograms may override via the catalogue
+DEFAULT_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+DEFAULT_BUCKETS_S = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                     10.0, 30.0, 60.0, 300.0)
+
+#: The metric-name catalogue: every literal name passed to
+#: inc/observe/set_gauge anywhere in the package MUST have an entry
+#: here — (kind, help[, buckets]). tools/check_metric_names.py fails
+#: the build otherwise. Keep names dotted + lowercase; the Prometheus
+#: exposition converts to `paddle_tpu_<name with _>` and appends
+#: `_total` to counters.
+METRICS = {
+    # -- store RPC / rendezvous --------------------------------------
+    "store.rpc.total": ("counter", "store RPC ops issued (label: op)"),
+    "store.rpc.latency_ms": ("histogram",
+                             "store RPC round-trip latency (label: op)",
+                             DEFAULT_BUCKETS_MS),
+    "store.rpc.reconnects": ("counter",
+                             "store client reconnects between retries"),
+    "store.barrier.rounds": ("counter",
+                             "store barrier rounds completed"),
+    # -- generic retry policy ----------------------------------------
+    "retry.attempts": ("counter",
+                       "retry attempts across all RetryPolicy objects"),
+    "retry.exhausted": ("counter",
+                        "RetryBudgetExceeded raises (op gave up)"),
+    # -- checkpoint ---------------------------------------------------
+    "ckpt.saves": ("counter", "checkpoint saves completed"),
+    "ckpt.loads": ("counter", "checkpoint loads completed"),
+    "ckpt.save.seconds": ("histogram", "checkpoint save wall time",
+                          DEFAULT_BUCKETS_S),
+    "ckpt.load.seconds": ("histogram", "checkpoint load wall time",
+                          DEFAULT_BUCKETS_S),
+    "ckpt.quarantined_files": ("counter",
+                               "corrupt files moved to .quarantine"),
+    "ckpt.fallbacks": ("counter",
+                       "loads that fell back past a corrupt newest "
+                       "checkpoint"),
+    # -- elastic ------------------------------------------------------
+    "elastic.restarts": ("counter",
+                         "elastic restarts (in-process resume loops + "
+                         "supervisor relaunches)"),
+    "elastic.preemptions": ("counter",
+                            "preemption signals observed"),
+    # -- chaos --------------------------------------------------------
+    "chaos.injections": ("counter",
+                         "chaos faults fired (label: site)"),
+    # -- training telemetry -------------------------------------------
+    "train.steps": ("counter", "optimizer steps dispatched"),
+    "train.step.seconds": ("histogram",
+                           "inter-step wall time (dispatch pipelined: "
+                           "converges to device step time)",
+                           DEFAULT_BUCKETS_S),
+    "train.tokens_per_sec": ("gauge",
+                             "tokens/sec/chip over the last step"),
+    "train.mfu": ("gauge",
+                  "model FLOPs utilization estimate (flops-per-token "
+                  "x tokens/sec / chip peak)"),
+    "train.loss": ("gauge",
+                   "loss of a recent step (lagged a few steps so the "
+                   "read never blocks dispatch)"),
+    "train.grad_norm": ("gauge", "global grad norm, when reported"),
+    "train.nonfinite_skips": ("counter",
+                              "steps skipped for non-finite grads"),
+    "train.recompiles": ("counter",
+                         "train-step program (re)builds"),
+    # -- serving ------------------------------------------------------
+    "serving.requests": ("counter",
+                         "HTTP requests by outcome (label: outcome)"),
+    "serving.request.latency_ms": ("histogram",
+                                   "successful request latency",
+                                   DEFAULT_BUCKETS_MS),
+    "serving.in_flight": ("gauge", "admitted requests in flight"),
+    "serving.capacity": ("gauge", "admission capacity"),
+    "serving.draining": ("gauge", "1 while draining"),
+    "serving.admission.admitted": ("gauge",
+                                   "lifetime admitted (scraped)"),
+    "serving.admission.rejected": ("gauge",
+                                   "lifetime admission rejections "
+                                   "(scraped)"),
+    "serving.breaker.state": ("gauge",
+                              "circuit breaker state (0 closed, "
+                              "1 half-open, 2 open)"),
+    "serving.breaker.consecutive_failures": ("gauge",
+                                             "consecutive backend "
+                                             "failures"),
+    "serving.breaker.opens": ("gauge", "lifetime breaker trips"),
+    "serving.breaker.recloses": ("gauge", "lifetime breaker recloses"),
+    "serving.batcher.queued": ("gauge", "requests buffered for a batch"),
+    "serving.batcher.batches_run": ("gauge", "batches executed"),
+    "serving.batcher.requests_served": ("gauge",
+                                        "requests served via batches"),
+    "serving.batcher.expired_in_queue": ("gauge",
+                                         "requests expired while "
+                                         "buffered"),
+    "serving.batcher.shed_full": ("gauge",
+                                  "requests shed on a full buffer"),
+    # -- paged KV engine ----------------------------------------------
+    "engine.ticks": ("gauge", "scheduler ticks run"),
+    "engine.prefills": ("gauge", "prompts prefilled"),
+    "engine.tokens_out": ("gauge", "tokens emitted"),
+    "engine.admitted": ("gauge", "requests admitted to slots"),
+    "engine.finished": ("gauge", "requests finished"),
+    "engine.cancelled": ("gauge", "requests cancelled"),
+    "engine.expired": ("gauge", "requests expired before admission"),
+    "engine.overloaded": ("gauge", "submits shed with EngineOverloaded"),
+    "engine.pending": ("gauge", "requests queued for admission"),
+}
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class _Instrument:
+    """Base: per-label-set cells guarded by one lock. Label VALUES are
+    free-form (low cardinality by convention); label keys+values are
+    stringified at record time."""
+
+    kind = "untyped"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._cells: dict = {}
+
+    def _norm(self, labels):
+        return _label_key({str(k): str(v) for k, v in labels.items()})
+
+    def labeled(self) -> dict:
+        """{label_key_tuple: value} snapshot."""
+        with self._lock:
+            return dict(self._cells)
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def inc(self, n=1, **labels):
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        key = self._norm(labels)
+        with self._lock:
+            self._cells[key] = self._cells.get(key, 0) + n
+
+    def value(self, **labels):
+        with self._lock:
+            return self._cells.get(self._norm(labels), 0)
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def set(self, v, **labels):
+        key = self._norm(labels)
+        with self._lock:
+            self._cells[key] = float(v)
+
+    def value(self, **labels):
+        with self._lock:
+            return self._cells.get(self._norm(labels))
+
+
+class _HistCell:
+    __slots__ = ("counts", "sum", "count", "ring", "ring_idx")
+
+    def __init__(self, n_buckets, ring_cap):
+        self.counts = [0] * (n_buckets + 1)     # +inf bucket last
+        self.sum = 0.0
+        self.count = 0
+        # bounded reservoir of recent raw values, for percentiles
+        # (bucket counts alone only bound a percentile to a bucket)
+        self.ring = [0.0] * ring_cap
+        self.ring_idx = 0
+
+
+class Histogram(_Instrument):
+    """Fixed-bucket histogram (cumulative `le` semantics on export)
+    plus a bounded ring of recent raw observations so `percentile()`
+    answers exactly over the recent window."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS_MS,
+                 ring_capacity=512):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.ring_capacity = int(ring_capacity)
+
+    def observe(self, v, **labels):
+        v = float(v)
+        key = self._norm(labels)
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                cell = self._cells[key] = _HistCell(
+                    len(self.buckets), self.ring_capacity)
+            i = 0
+            for b in self.buckets:
+                if v <= b:
+                    break
+                i += 1
+            cell.counts[i] += 1
+            cell.sum += v
+            cell.count += 1
+            cell.ring[cell.ring_idx % self.ring_capacity] = v
+            cell.ring_idx += 1
+
+    def labeled(self) -> dict:
+        """Consistent per-cell copies: exporters read counts/sum/count
+        of a cell outside the lock, and a concurrent observe() must
+        not let the +Inf cumulative bucket disagree with _count (the
+        Prometheus invariant strict parsers check)."""
+        with self._lock:
+            out = {}
+            for key, cell in self._cells.items():
+                c = _HistCell(len(self.buckets), 1)
+                c.counts = list(cell.counts)
+                c.sum = cell.sum
+                c.count = cell.count
+                out[key] = c
+            return out
+
+    def count(self, **labels):
+        with self._lock:
+            cell = self._cells.get(self._norm(labels))
+            return cell.count if cell else 0
+
+    def percentile(self, p, **labels):
+        """Nearest-rank percentile over the recent window (None when
+        nothing recorded)."""
+        with self._lock:
+            cell = self._cells.get(self._norm(labels))
+            if cell is None or cell.count == 0:
+                return None
+            n = min(cell.count, self.ring_capacity)
+            win = sorted(cell.ring[:n])
+        rank = min(n - 1, max(0, int(round(p / 100.0 * (n - 1)))))
+        return win[rank]
+
+
+class MetricsRegistry:
+    """Thread-safe, catalogue-validated instrument registry.
+
+    `inc` / `observe` / `set_gauge` are the instrumentation surface
+    (audited by tools/check_metric_names.py); `counter` / `gauge` /
+    `histogram` hand back the instrument object for readers. Unknown
+    names raise — the catalogue, not the call site, is the source of
+    truth for what exists."""
+
+    def __init__(self, catalogue=None):
+        self._catalogue = catalogue if catalogue is not None else METRICS
+        self._lock = threading.Lock()
+        self._metrics: dict = {}
+
+    # -- acquisition --------------------------------------------------
+    def _get(self, name, expect_kind):
+        spec = self._catalogue.get(name)
+        if spec is None:
+            raise KeyError(
+                f"metric {name!r} is not in the METRICS catalogue "
+                "(observability/metrics.py) — register it there")
+        kind = spec[0]
+        if kind != expect_kind:
+            raise TypeError(
+                f"metric {name!r} is a {kind}, not a {expect_kind}")
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                help_ = spec[1] if len(spec) > 1 else ""
+                if kind == "counter":
+                    m = Counter(name, help_)
+                elif kind == "gauge":
+                    m = Gauge(name, help_)
+                else:
+                    buckets = (spec[2] if len(spec) > 2
+                               else DEFAULT_BUCKETS_MS)
+                    m = Histogram(name, help_, buckets)
+                self._metrics[name] = m
+            return m
+
+    def counter(self, name) -> Counter:
+        return self._get(name, "counter")
+
+    def gauge(self, name) -> Gauge:
+        return self._get(name, "gauge")
+
+    def histogram(self, name) -> Histogram:
+        return self._get(name, "histogram")
+
+    # -- instrumentation surface (audited; names must be literal) -----
+    def inc(self, name, n=1, **labels):
+        self._get(name, "counter").inc(n, **labels)
+
+    def observe(self, name, v, **labels):
+        self._get(name, "histogram").observe(v, **labels)
+
+    def set_gauge(self, name, v, **labels):
+        self._get(name, "gauge").set(v, **labels)
+
+    # -- readers ------------------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON-able {name: {kind, help, series: [{labels, ...}]}}."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out = {}
+        for m in sorted(metrics, key=lambda m: m.name):
+            series = []
+            for key, val in sorted(m.labeled().items()):
+                entry = {"labels": dict(key)}
+                if isinstance(val, _HistCell):
+                    entry.update(count=val.count, sum=val.sum,
+                                 buckets=dict(zip(
+                                     [*map(str, m.buckets), "+Inf"],
+                                     _cumulate(val.counts))))
+                else:
+                    entry["value"] = val
+                series.append(entry)
+            out[m.name] = {"kind": m.kind, "help": m.help,
+                           "series": series}
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), indent=1, sort_keys=True)
+
+    def names(self) -> set:
+        """Names of the instruments recorded so far."""
+        with self._lock:
+            return set(self._metrics)
+
+    def prometheus_text(self, exclude=()) -> str:
+        """Prometheus text exposition format 0.0.4. `exclude` skips
+        metric names another exposition already emitted — a family
+        must not appear twice in one scrape body (serving.metrics_text
+        concatenates the per-server and global registries)."""
+        with self._lock:
+            metrics = [m for m in self._metrics.values()
+                       if m.name not in exclude]
+        lines = []
+        for m in sorted(metrics, key=lambda m: m.name):
+            pname = _prom_name(m.name, m.kind)
+            if m.help:
+                lines.append(f"# HELP {pname} {_prom_escape_help(m.help)}")
+            lines.append(f"# TYPE {pname} {m.kind}")
+            for key, val in sorted(m.labeled().items()):
+                labels = dict(key)
+                if isinstance(val, _HistCell):
+                    cum = _cumulate(val.counts)
+                    for b, c in zip([*m.buckets, "+Inf"], cum):
+                        le = _prom_float(b) if b != "+Inf" else "+Inf"
+                        lines.append(
+                            f"{pname}_bucket"
+                            f"{_prom_labels({**labels, 'le': le})} {c}")
+                    lines.append(f"{pname}_sum{_prom_labels(labels)} "
+                                 f"{_prom_float(val.sum)}")
+                    lines.append(f"{pname}_count{_prom_labels(labels)} "
+                                 f"{val.count}")
+                else:
+                    lines.append(f"{pname}{_prom_labels(labels)} "
+                                 f"{_prom_float(val)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self):
+        """Drop every instrument (tests)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+def _cumulate(counts):
+    out, acc = [], 0
+    for c in counts:
+        acc += c
+        out.append(acc)
+    return out
+
+
+def _prom_name(name: str, kind: str) -> str:
+    base = "paddle_tpu_" + name.replace(".", "_").replace("-", "_")
+    if kind == "counter" and not base.endswith("_total"):
+        base += "_total"
+    return base
+
+
+def _prom_escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _prom_escape_label(s: str) -> str:
+    return (s.replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _prom_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_prom_escape_label(str(v))}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _prom_float(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+#: the process-wide default registry every `observability.inc(...)`
+#: helper writes to; serving creates per-server registries besides
+REGISTRY = MetricsRegistry()

@@ -1,0 +1,182 @@
+"""Per-step training telemetry: tokens/sec/chip, MFU, loss, skips.
+
+The north star (Llama-3-8B-class MFU on v5p) previously had no
+in-framework measurement — the MFU math lived only in bench.py. This
+module is that math as a runtime reporter: `TrainingTelemetry` turns
+(tokens, step wall time) into tokens/sec and an MFU estimate using the
+SAME flops-per-token helper bench.py uses (models/llama.py
+`flops_per_token`, including the 8/6 recompute replay factor) and the
+same per-chip peak-FLOPs table, publishing gauges/histograms into the
+shared metrics registry. `parallel/trainer.py` drives it when
+observability is enabled; the cost when disabled is one attribute
+check in Trainer.step.
+
+Two measurement caveats, both deliberate:
+  - step time is the interval between consecutive step() dispatches.
+    Dispatch is async, but donated buffers backpressure the host, so
+    in steady state the interval converges to device step time (the
+    same quantity bench.py measures over a synced window).
+  - the loss gauge lags `loss_lag` steps: a loss read that young would
+    force a host sync and stall the dispatch pipeline; by the time a
+    step is `loss_lag` old its value is already on host and float() is
+    free.
+
+Importing this module never touches jax; model-specific helpers import
+lazily inside functions.
+"""
+from __future__ import annotations
+
+import collections
+
+from paddle_tpu.observability import metrics as _metrics
+
+__all__ = ["PEAK_FLOPS", "peak_flops_for_kind", "detect_peak_flops",
+           "flops_per_token_for", "TrainingTelemetry"]
+
+# bf16 peak FLOP/s per chip by device kind (public TPU specs) — kept in
+# lockstep with bench.py's _PEAK table; tests cross-check the two.
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops_for_kind(kind: str) -> float:
+    """Longest-key-first match (bench.py learned this the hard way:
+    'TPU v5 lite' must win over 'TPU v5'). Unknown kinds assume v5p,
+    the north-star part."""
+    kind = kind or ""
+    for k in sorted(PEAK_FLOPS, key=len, reverse=True):
+        if kind.startswith(k) or k in kind:
+            return PEAK_FLOPS[k]
+    return 459e12
+
+
+def detect_peak_flops():
+    """Peak FLOP/s of device 0, or None off-TPU (MFU reads 0 there —
+    a CPU-emulation 'MFU' would be noise)."""
+    try:
+        import jax
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            return None
+        return peak_flops_for_kind(getattr(dev, "device_kind", ""))
+    except Exception:
+        return None
+
+
+def flops_per_token_for(model, seq_len: int) -> float:
+    """Training FLOPs/token for `model`: the shared analytic helper
+    (models/llama.py flops_per_token — 6N + attention term, x8/6 when
+    the config says recompute) when the config quacks like a llama;
+    otherwise the generic 6 x trainable-param-count estimate."""
+    cfg = getattr(model, "config", None)
+    ftok = None
+    if cfg is not None:
+        try:
+            from paddle_tpu.models.llama import flops_per_token
+            ftok = flops_per_token(cfg, seq_len)
+        except Exception:
+            ftok = None
+    if ftok is None:
+        n = 0
+        for p in getattr(model, "parameters", lambda: [])():
+            if not getattr(p, "stop_gradient", False):
+                n += int(getattr(p, "size", 0) or 0)
+        ftok = 6.0 * n
+    if cfg is not None and getattr(cfg, "recompute", False):
+        # remat replays each layer's forward once: ~8N/token not 6N
+        ftok = ftok * 8.0 / 6.0
+    return float(ftok)
+
+
+class TrainingTelemetry:
+    """Per-step reporter publishing into a metrics registry.
+
+    flops_per_token: float, or a callable seq_len -> float (so the
+    attention term can track the batch's actual sequence length).
+    peak_flops: per-chip peak FLOP/s; None disables MFU (reports 0).
+    """
+
+    def __init__(self, flops_per_token=None, peak_flops=None,
+                 registry=None, loss_lag=8):
+        self._fpt = flops_per_token
+        self.peak_flops = peak_flops
+        self.registry = registry if registry is not None \
+            else _metrics.REGISTRY
+        self.loss_lag = max(0, int(loss_lag))
+        self._loss_buf: collections.deque = collections.deque()
+        self.steps = 0
+        self.last_tokens_per_sec = 0.0
+        self.last_mfu = 0.0
+        self.last_loss = None
+
+    @classmethod
+    def for_model(cls, model, registry=None, peak_flops=None, **kw):
+        """Reporter bound to `model`'s analytic flops-per-token and the
+        detected chip peak."""
+        if peak_flops is None:
+            peak_flops = detect_peak_flops()
+        return cls(
+            flops_per_token=lambda seq: flops_per_token_for(model, seq),
+            peak_flops=peak_flops, registry=registry, **kw)
+
+    def flops_per_token(self, seq_len) -> float:
+        if callable(self._fpt):
+            return float(self._fpt(seq_len))
+        return float(self._fpt or 0.0)
+
+    def mfu(self, tokens_per_sec, seq_len) -> float:
+        """tokens/sec/chip x FLOPs/token / chip peak — identically
+        bench.py's formula (tests cross-check)."""
+        if not self.peak_flops:
+            return 0.0
+        return tokens_per_sec * self.flops_per_token(seq_len) \
+            / self.peak_flops
+
+    def step(self, tokens, step_time_s, seq_len=None, loss=None,
+             grad_norm=None):
+        """Report one completed step. `loss` may be lazy (a jax array /
+        Tensor); it is buffered and materialized `loss_lag` steps
+        later, never blocking the current dispatch."""
+        reg = self.registry
+        self.steps += 1
+        reg.inc("train.steps")
+        if step_time_s and step_time_s > 0:
+            reg.observe("train.step.seconds", step_time_s)
+            tps = tokens / step_time_s
+            self.last_tokens_per_sec = tps
+            reg.set_gauge("train.tokens_per_sec", tps)
+            seq = seq_len if seq_len is not None else tokens
+            self.last_mfu = self.mfu(tps, seq)
+            reg.set_gauge("train.mfu", self.last_mfu)
+        if grad_norm is not None:
+            reg.set_gauge("train.grad_norm", float(grad_norm))
+        if loss is not None:
+            self._loss_buf.append(loss)
+            while len(self._loss_buf) > self.loss_lag:
+                self._publish_loss(self._loss_buf.popleft())
+
+    def _publish_loss(self, loss):
+        try:
+            val = float(loss)
+        except Exception:
+            return              # non-scalar / dead array: drop silently
+        self.last_loss = val
+        self.registry.set_gauge("train.loss", val)
+
+    def flush(self):
+        """Materialize every buffered loss (end of run / snapshot)."""
+        while self._loss_buf:
+            self._publish_loss(self._loss_buf.popleft())
+
+    def snapshot(self) -> dict:
+        self.flush()
+        return {"steps": self.steps,
+                "tokens_per_sec": round(self.last_tokens_per_sec, 2),
+                "mfu": round(self.last_mfu, 4),
+                "loss": self.last_loss}

@@ -1,0 +1,127 @@
+"""Lightweight span tracing into a bounded ring buffer.
+
+`observability.span(name, **attrs)` (the gated entry point — see
+observability/__init__.py) wraps a host-side scope; completed spans
+land in a process-wide ring buffer (oldest evicted first, so a
+long-running job's memory is bounded) and export as chrome-trace JSON
+that loads in chrome://tracing / perfetto. `export_chrome_trace`
+merges the native profiler's HostTracer events on request so one
+timeline shows both the coarse runtime spans recorded here (steps,
+checkpoint saves, RPC retries) and the fine per-op scopes from
+paddle_tpu/_native — and, side by side in perfetto, the XLA device
+trace `jax.profiler` writes under its logdir.
+
+Spans nest naturally: chrome-trace "X" (complete) events reconstruct
+the stack from ts/dur containment per thread; `depth` is also recorded
+explicitly in args for programmatic consumers.
+
+Stdlib-only; importing this module never touches jax.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+__all__ = ["Span", "set_ring_capacity", "ring_capacity", "spans",
+           "clear", "export_chrome_trace", "chrome_events"]
+
+_DEFAULT_CAPACITY = 4096
+
+_lock = threading.Lock()
+_ring: collections.deque = collections.deque(maxlen=_DEFAULT_CAPACITY)
+_tls = threading.local()
+
+
+def set_ring_capacity(n: int):
+    """Resize the span ring (keeps the newest spans)."""
+    global _ring
+    with _lock:
+        _ring = collections.deque(_ring, maxlen=int(n))
+
+
+def ring_capacity() -> int:
+    return _ring.maxlen
+
+
+def clear():
+    with _lock:
+        _ring.clear()
+
+
+class Span:
+    """One timed scope. Use through observability.span(...) so the
+    disabled path stays a single attribute check; constructing a Span
+    directly always records."""
+
+    __slots__ = ("name", "attrs", "t0", "dur_us", "depth", "tid")
+
+    def __init__(self, name, attrs=None):
+        self.name = name
+        self.attrs = attrs or {}
+        self.t0 = 0.0
+        self.dur_us = 0.0
+        self.depth = 0
+        self.tid = 0
+
+    def __enter__(self):
+        depth = getattr(_tls, "depth", 0)
+        _tls.depth = depth + 1
+        self.depth = depth
+        self.tid = threading.get_ident()
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.dur_us = (time.perf_counter() - self.t0) * 1e6
+        _tls.depth = self.depth
+        if exc_type is not None:
+            self.attrs = {**self.attrs, "error": exc_type.__name__}
+        with _lock:
+            _ring.append(self)
+        return False
+
+
+def spans() -> list:
+    """Snapshot of the ring, oldest first."""
+    with _lock:
+        return list(_ring)
+
+
+def chrome_events() -> list:
+    """Ring contents as chrome-trace event dicts. perf_counter has an
+    arbitrary epoch; events are self-consistent with each other and
+    with the HostTracer events merged by export_chrome_trace (both
+    clocks are monotonic-since-boot on Linux)."""
+    evs = []
+    pid = os.getpid()
+    for s in spans():
+        args = {"depth": s.depth}
+        args.update({str(k): v for k, v in s.attrs.items()})
+        evs.append({"name": s.name, "ph": "X", "pid": pid,
+                    "tid": s.tid, "ts": s.t0 * 1e6,
+                    "dur": s.dur_us, "cat": "observability",
+                    "args": args})
+    return evs
+
+
+def export_chrome_trace(path=None, merge_host_tracer=False) -> dict:
+    """Chrome-trace document of the recorded spans; with
+    `merge_host_tracer` the native profiler HostTracer's events (the
+    per-op scopes the Profiler records) join the same timeline. Writes
+    to `path` when given; always returns the document."""
+    events = chrome_events()
+    if merge_host_tracer:
+        try:
+            from paddle_tpu.profiler import utils as _utils
+            events = events + list(_utils.host_chrome_events())
+        except Exception:
+            pass        # profiler backend unavailable: spans alone
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "metadata": {"producer": "paddle_tpu.observability"}}
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddle_tpu import observability
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.jit.functional import functional_call, state_tensors
 from paddle_tpu.parallel.plan import ShardingPlan, batch_spec
@@ -143,6 +144,11 @@ class Trainer:
         self._pending_skips: list = []
         self.nonfinite_streak = 0
         self.nonfinite_skipped = 0
+        # step telemetry (observability.telemetry.TrainingTelemetry),
+        # built lazily on the first step with observability enabled
+        self._telemetry = None
+        self._tel_last_t = None
+        self._tel_prev = None
         self._init_state()
 
     # -- state -------------------------------------------------------------
@@ -237,6 +243,8 @@ class Trainer:
         from paddle_tpu.distributed import chaos
         self._chaos_poison = bool(chaos.ENABLED
                                   and chaos.site_rate("trainer.grad") > 0)
+        if observability.ENABLED:
+            observability.inc("train.recompiles")
 
         def loss_for(params, batch):
             params_c = _cast_tree(params, cfg.compute_dtype)
@@ -395,6 +403,13 @@ class Trainer:
         old eager float() here serialized dispatch against execution."""
         batch = {k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
                  for k, v in batch.items()}
+        if observability.ENABLED:
+            self._telemetry_tick(batch)
+        elif self._tel_last_t is not None:
+            # telemetry was disabled mid-run: drop the stale timestamp
+            # so a later re-enable doesn't report the whole disabled
+            # gap as one giant step into train.step.seconds
+            self._tel_last_t = self._tel_prev = None
         if self.mesh is not None:
             bspec = batch_spec(self.mesh.axis_names,
                                self.config.shard_batch_seq)
@@ -428,7 +443,52 @@ class Trainer:
         else:
             loss, self.params, self.opt_state = out
         self.optimizer._step_count += 1
+        if self._tel_prev is not None:
+            # hand the LAZY loss to the reporter: it materializes a
+            # few steps later, when float() no longer forces a sync
+            self._tel_prev[2] = loss
         return Tensor(loss, stop_gradient=True)
+
+    def _telemetry_tick(self, batch):
+        """Report the PREVIOUS step's telemetry now that its interval
+        is known (dispatch is async; the inter-call interval converges
+        to device step time under donation backpressure), then stamp
+        this step's token count for the next tick. One attribute check
+        when observability is disabled (the caller gates)."""
+        import time as _time
+        now = _time.perf_counter()
+        if self._telemetry is None:
+            from paddle_tpu.observability.telemetry import (
+                TrainingTelemetry)
+            self._telemetry = TrainingTelemetry.for_model(self.model)
+        if self._tel_prev is not None and self._tel_last_t is not None:
+            tokens, seq, loss = self._tel_prev
+            self._telemetry.step(tokens, now - self._tel_last_t,
+                                 seq_len=seq, loss=loss)
+        self._tel_last_t = now
+        arr = batch.get("input_ids")
+        if arr is None and batch:
+            arr = next(iter(batch.values()))
+        ndim = getattr(arr, "ndim", 0)
+        if ndim >= 2:
+            tokens = int(arr.shape[0]) * int(arr.shape[1])
+            seq = int(arr.shape[1])
+        elif ndim == 1:
+            tokens = seq = int(arr.shape[0])
+        else:
+            tokens = seq = 0
+        # the batch is GLOBAL; tokens_per_sec/MFU are catalogued
+        # per-CHIP (bench.py's single-chip framing), so divide by the
+        # mesh size — otherwise a 4-chip run reads 4x the true MFU
+        if self.mesh is not None:
+            tokens = tokens / max(1, int(self.mesh.devices.size))
+        self._tel_prev = [tokens, seq, None]
+
+    @property
+    def telemetry(self):
+        """The TrainingTelemetry reporter (None until a step ran with
+        observability enabled)."""
+        return self._telemetry
 
     def _note_skip(self, flag):
         """Track consecutive non-finite skips without a per-step host
@@ -445,6 +505,8 @@ class Trainer:
             if bool(np.asarray(f)):
                 self.nonfinite_streak += 1
                 self.nonfinite_skipped += 1
+                if observability.ENABLED:
+                    observability.inc("train.nonfinite_skips")
             else:
                 self.nonfinite_streak = 0
         if self.nonfinite_streak >= self.config.max_consecutive_nonfinite:

@@ -271,6 +271,7 @@ def test_missing_shard_without_checksums_skipped_not_looped(tmp_path):
     d = tmp_path / "step_00000020"
     tbl = json.loads((d / "table_0.json").read_text())
     tbl.pop("__files__")                    # simulate pre-v3
+    tbl.pop("__table_digest__", None)       # (v4 record too)
     (d / "table_0.json").write_text(json.dumps(tbl))
     os.remove(d / "shards_0.npz")           # ... with a lost npz
     assert "shards_0.npz" in ckpt.verify_checkpoint(str(d))
@@ -311,6 +312,7 @@ def test_old_checkpoints_without_checksums_still_load(tmp_path):
     tbl_p = tmp_path / "c" / "table_0.json"
     tbl = json.loads(tbl_p.read_text())
     tbl.pop("__files__")
+    tbl.pop("__table_digest__", None)       # simulate pre-v4
     tbl_p.write_text(json.dumps(tbl))
     meta_p = tmp_path / "c" / "metadata.json"
     meta = json.loads(meta_p.read_text())
@@ -580,3 +582,21 @@ def test_soak_run_resilient_real_trainer_bitidentical(tmp_path):
     assert fired.get("ckpt.write.shards", 0) >= 1
     for n in p_ref:
         np.testing.assert_array_equal(p_ref[n], p_chaos[n])
+
+
+def test_parseable_table_corruption_detected(tmp_path):
+    """PR-3 satellite (ROADMAP v3 integrity gap): a table_*.json that
+    is corrupted but still PARSES — a flipped shape/dtype digit, or a
+    tampered recorded shard digest — must trip the v4 table self-digest
+    on verify AND on load, never assemble silently wrong weights."""
+    import json
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    _save(w, str(tmp_path / "c"))
+    tbl_p = tmp_path / "c" / "table_0.json"
+    tbl = json.loads(tbl_p.read_text())
+    tbl["w"]["dtype"] = "float64"           # parses fine, lies
+    tbl_p.write_text(json.dumps(tbl))
+    issues = ckpt.verify_checkpoint(str(tmp_path / "c"))
+    assert "digest" in issues["table_0.json"]
+    with pytest.raises(ckpt.CheckpointCorruptionError):
+        _load(str(tmp_path / "c"))

@@ -326,3 +326,77 @@ def test_migration_hook_applies(tmp_path):
                                       w)
     finally:
         C._MIGRATIONS.pop(1, None)
+
+
+# -- PR 3: table-level integrity (format v4) ---------------------------------
+
+def _flip_table_value(path):
+    """Corrupt a table_0.json so it stays PARSEABLE but lies: change a
+    recorded shard offset digit — exactly the corruption per-file
+    checksums cannot see (the table carries them)."""
+    import json
+    tbl = json.loads((path / "table_0.json").read_text())
+    name = next(k for k in tbl if not k.startswith("__"))
+    tbl[name]["dtype"] = "float64" if tbl[name]["dtype"] != "float64" \
+        else "float32"
+    (path / "table_0.json").write_text(json.dumps(tbl))
+    return name
+
+
+def test_torn_table_detected_and_quarantined(tmp_path):
+    """A corrupted-but-parseable table_*.json must be detected by the
+    v4 table digest: verify reports it, load raises
+    CheckpointCorruptionError (never silently-wrong weights), and the
+    newest-complete scan quarantines the directory."""
+    w = np.arange(32, dtype=np.float32).reshape(8, 4)
+    d = tmp_path / "step_00000001"
+    ckpt.save_state_dict({"w": paddle.to_tensor(w)}, str(d))
+    # pristine: digest recorded and verifies clean
+    import json
+    tbl = json.loads((d / "table_0.json").read_text())
+    assert tbl["__table_digest__"]["sha256"]
+    assert ckpt.verify_checkpoint(str(d)) == {}
+
+    _flip_table_value(d)
+    issues = ckpt.verify_checkpoint(str(d))
+    assert "table_0.json" in issues
+    assert "digest" in issues["table_0.json"]
+
+    sd = {"w": paddle.to_tensor(np.zeros_like(w))}
+    with pytest.raises(ckpt.CheckpointCorruptionError):
+        ckpt.load_state_dict(sd, str(d))
+
+    # the resume scan quarantines it and reports no complete checkpoint
+    assert ckpt.newest_complete_checkpoint(str(tmp_path)) is None
+    assert (d / ".quarantine" / "table_0.json").exists()
+
+
+def test_torn_table_falls_back_to_previous_checkpoint(tmp_path):
+    """load_newest_complete must fall PAST a checkpoint whose table is
+    corrupted-but-parseable, onto the older intact one."""
+    w1 = np.full((4,), 1.0, np.float32)
+    w2 = np.full((4,), 2.0, np.float32)
+    ckpt.save_state_dict({"w": paddle.to_tensor(w1)},
+                         str(tmp_path / "step_00000001"))
+    ckpt.save_state_dict({"w": paddle.to_tensor(w2)},
+                         str(tmp_path / "step_00000002"))
+    _flip_table_value(tmp_path / "step_00000002")
+
+    sd = {"w": paddle.to_tensor(np.zeros(4, np.float32))}
+    loaded = ckpt.load_newest_complete(sd, str(tmp_path))
+    assert loaded == str(tmp_path / "step_00000001")
+    np.testing.assert_array_equal(np.asarray(sd["w"]._value), w1)
+
+
+def test_table_digest_covers_recorded_checksums(tmp_path):
+    """Tampering with the RECORDED shard digests themselves (the attack
+    the ROADMAP item names: the digests were unprotected) is caught."""
+    import json
+    w = np.arange(8, dtype=np.float32)
+    ckpt.save_state_dict({"w": paddle.to_tensor(w)}, str(tmp_path))
+    tbl = json.loads((tmp_path / "table_0.json").read_text())
+    fname = next(iter(tbl["__files__"]))
+    tbl["__files__"][fname]["sha256"] = "0" * 64
+    (tmp_path / "table_0.json").write_text(json.dumps(tbl))
+    issues = ckpt.verify_checkpoint(str(tmp_path))
+    assert "digest" in issues.get("table_0.json", "")

@@ -1,0 +1,92 @@
+"""tools/check_metric_names.py — the metric-name-catalogue gate.
+
+Every `inc/observe/set_gauge("name")` literal in paddle_tpu/ must be
+documented in the METRICS catalogue (observability/metrics.py), and
+instrumentation names must BE literals. Running the checker against
+the live tree IS the tier-1 wiring (the same pattern as
+tests/test_chaos_points_tool.py)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOL = os.path.join(_ROOT, "tools", "check_metric_names.py")
+
+
+def _scan(root):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("check_metric_names",
+                                                  _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.scan(root)
+
+
+def _mini_tree(tmp_path, catalogue, body):
+    """A fake repo: paddle_tpu/observability/metrics.py carrying
+    METRICS = `catalogue`, plus paddle_tpu/mod.py with `body`."""
+    pkg = tmp_path / "paddle_tpu"
+    obs = pkg / "observability"
+    obs.mkdir(parents=True)
+    (obs / "metrics.py").write_text(f"METRICS = {catalogue!r}\n")
+    (pkg / "mod.py").write_text(textwrap.dedent(body))
+    return str(tmp_path)
+
+
+def test_live_tree_is_clean():
+    """Tier-1 gate: every metric instrumentation site in the real
+    package uses a literal, catalogued name."""
+    proc = subprocess.run([sys.executable, _TOOL, _ROOT],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+
+
+def test_catalogue_covers_the_acceptance_metrics():
+    from paddle_tpu.observability.metrics import METRICS
+    for name in ("serving.requests", "serving.request.latency_ms",
+                 "serving.breaker.state", "engine.ticks",
+                 "train.tokens_per_sec", "train.mfu",
+                 "store.rpc.latency_ms", "ckpt.fallbacks",
+                 "elastic.restarts", "chaos.injections"):
+        assert name in METRICS, name
+
+
+def test_detects_unregistered_and_nonliteral(tmp_path):
+    root = _mini_tree(tmp_path, {"ok.metric": ("counter", "fine")}, """
+        from paddle_tpu import observability as obs
+        name = "dyn"
+        obs.inc("ok.metric")
+        obs.inc("nope.metric")          # unregistered
+        obs.observe(name, 1.0)          # unauditable
+    """)
+    violations, seen, _cat = _scan(root)
+    problems = sorted(v[2] for v in violations)
+    assert problems == ["inc('nope.metric')", "observe(name)"]
+    assert "ok.metric" in seen
+
+
+def test_acquirers_checked_only_when_literal(tmp_path):
+    """registry.counter("x") with an off-catalogue literal fails, but
+    np.histogram(arr, ...) — same method name, array argument — must
+    not false-positive."""
+    root = _mini_tree(tmp_path, {"a.b": ("gauge", "ok")}, """
+        import numpy as np
+        def f(reg, arr):
+            reg.gauge("a.b")            # catalogued, fine
+            reg.counter("ghost.total")  # literal + unregistered
+            return np.histogram(arr, bins=4)   # not a metric site
+    """)
+    violations, _seen, _cat = _scan(root)
+    assert [v[2] for v in violations] == ["counter('ghost.total')"]
+
+
+def test_checker_exit_code_on_dirty_tree(tmp_path):
+    root = _mini_tree(tmp_path, {}, """
+        from paddle_tpu import observability as obs
+        obs.inc("ghost.metric")
+    """)
+    proc = subprocess.run([sys.executable, _TOOL, root],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "ghost.metric" in proc.stderr

@@ -590,3 +590,21 @@ def test_engine_stream_deadline_threads_through():
     with pytest.raises(DeadlineExceeded):
         next(it)
     eng.stop()
+
+
+@pytest.mark.quick
+def test_engine_export_metrics():
+    """export_metrics publishes the stats dict as catalogued gauges
+    (the /metrics integration PredictorServer scrapes)."""
+    from paddle_tpu.observability.metrics import MetricsRegistry
+    model = _model()
+    eng = PagedKVEngine(model, max_slots=2, page_size=4, num_pages=24,
+                        max_pages_per_slot=6, steps_per_tick=3)
+    eng.generate([[5, 9, 2]], max_new_tokens=4)
+    reg = MetricsRegistry()
+    eng.export_metrics(reg)
+    assert reg.gauge("engine.finished").value() == 1
+    assert reg.gauge("engine.ticks").value() >= 1
+    assert reg.gauge("engine.tokens_out").value() >= 4
+    assert reg.gauge("engine.pending").value() == 0
+    assert "paddle_tpu_engine_finished 1" in reg.prometheus_text()

@@ -673,3 +673,86 @@ def test_deadline_helpers():
     with pytest.raises(DeadlineExceeded):
         d2.check("unit test")
     assert Deadline.after_ms(None).remaining() is None
+
+
+# ---------------------------------------------------------------------------
+# /metrics: Prometheus exposition (PR 3 observability)
+# ---------------------------------------------------------------------------
+
+def test_metrics_endpoint_prometheus_text():
+    """GET /metrics returns valid Prometheus text carrying the serving
+    request counters/latency, admission + breaker gauges, and engine
+    counters from a generator exposing export_metrics — the ISSUE 3
+    acceptance surface."""
+    import re
+
+    class FakeEngine:
+        concurrent_safe = True
+
+        def stream(self, ids, **kw):        # pragma: no cover - unused
+            yield [0]
+
+        def export_metrics(self, registry):
+            registry.set_gauge("engine.ticks", 7)
+            registry.set_gauge("engine.tokens_out", 42)
+
+    srv = PredictorServer(lambda inputs: {"y": np.zeros((1, 2))},
+                          generator=FakeEngine()).start()
+    try:
+        _req(srv.port, "/predict", {"inputs": {"x": [[1.0, 2.0]]}})
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+    finally:
+        srv.stop()
+
+    sample = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9.eE+-]+$")
+    for line in text.strip().split("\n"):
+        if line.startswith("#"):
+            continue
+        assert sample.match(line), line
+    assert 'paddle_tpu_serving_requests_total{outcome="ok"} 1' in text
+    assert "paddle_tpu_serving_request_latency_ms_count 1" in text
+    assert "paddle_tpu_serving_breaker_state 0" in text
+    assert "paddle_tpu_serving_in_flight 0" in text
+    assert "paddle_tpu_serving_capacity " in text
+    assert "paddle_tpu_engine_ticks 7" in text
+    assert "paddle_tpu_engine_tokens_out 42" in text
+
+
+def test_metrics_per_server_counts_do_not_bleed():
+    """Two servers in one process keep separate request counts (each
+    owns its registry), while both still serve /metrics."""
+    a = PredictorServer(lambda inputs: {"y": np.zeros((1,))}).start()
+    b = PredictorServer(lambda inputs: {"y": np.zeros((1,))}).start()
+    try:
+        _req(a.port, "/predict", {"inputs": {"x": [[1.0]]}})
+        assert a.stats()["requests"].get("ok") == 1
+        assert b.stats()["requests"] == {}
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_metrics_shared_registry_no_duplicate_families():
+    """A server constructed with metrics=observability.REGISTRY must
+    not emit any metric family twice in one /metrics body (duplicate
+    # TYPE lines are invalid exposition)."""
+    from paddle_tpu import observability as obs
+    obs.REGISTRY.reset()
+    srv = PredictorServer(lambda inputs: {"y": np.zeros((1,))},
+                          metrics=obs.REGISTRY).start()
+    try:
+        _req(srv.port, "/predict", {"inputs": {"x": [[1.0]]}})
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=30
+        ).read().decode()
+    finally:
+        srv.stop()
+        obs.REGISTRY.reset()
+    type_lines = [l for l in text.split("\n") if l.startswith("# TYPE ")]
+    assert len(type_lines) == len(set(type_lines)), type_lines
+    assert 'paddle_tpu_serving_requests_total{outcome="ok"} 1' in text
