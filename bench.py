@@ -97,23 +97,30 @@ def main():
     trainer = Trainer(model, optimizer,
                       config=TrainStepConfig(compute_dtype="bfloat16"))
 
-    import jax.numpy as jnp
+    import itertools
     rng = np.random.RandomState(0)
-    ids = jnp.asarray(
-        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
-    # pre-staged device array: the per-step host->device transfer of the
-    # batch re-sent the same 48KB through the dispatch tunnel every step
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    # HOST batch fed through the sharding-aware device prefetcher
+    # (trainer.data_iter -> io/prefetch.py): H2D happens on the prefetch
+    # thread overlapped with the previous step's compute, and step()
+    # sees already-placed arrays — the measured loop is the overlapped
+    # zero-device_put path real input pipelines take (for a synthetic
+    # in-memory batch this can only tie the old pre-staged-array loop,
+    # never beat it; the win is that the benchmark now measures the
+    # production path)
     data = {"input_ids": ids, "labels": ids}
+    it = trainer.data_iter(itertools.repeat(data, steps + 1), depth=3)
 
     # warmup + compile; float() forces a real device sync (through the
     # axon tunnel jax.block_until_ready returns before execution finishes)
-    float(trainer.step(data))
+    float(trainer.step(next(it)))
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = trainer.step(data)
+    for b in it:
+        loss = trainer.step(b)
     loss = float(loss)  # sync: the last step's outputs close the chain
     dt = time.perf_counter() - t0
+    it.close()
 
     tokens_per_sec = batch * seq * steps / dt
     ftok = flops_per_token(cfg, seq)

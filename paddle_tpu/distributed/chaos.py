@@ -91,6 +91,8 @@ POINTS = {
                         "circuit breaker toward open)",
     "trainer.grad": "non-finite (NaN) gradient poisoning in the "
                     "compiled train step",
+    "io.prefetch.delay": "slow host input pipeline (delay in the "
+                         "device-prefetch worker before placement)",
 }
 
 

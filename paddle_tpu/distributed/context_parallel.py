@@ -240,7 +240,23 @@ def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None,
     if use_flash:
         plan = _ring_flash_plan(q.shape[1], k.shape[1], q.shape[2],
                                 k.shape[2], q.shape[3])
-        if plan and plan[0] == "fold":
+        if plan is None:
+            # only reachable with an explicit use_flash=True (the auto
+            # path gates on _ring_flash_shapes_ok): name the misaligned
+            # dims instead of dying later on an obscure Pallas shape
+            # assert inside the kernel
+            hq, hk = q.shape[1], k.shape[1]
+            sq, sk, d = q.shape[2], k.shape[2], q.shape[3]
+            raise ValueError(
+                "ring_attention_local(use_flash=True): shapes cannot "
+                "take the flash-kernel ring — requires local seq lens "
+                f"divisible by their block (q: {sq} % "
+                f"{min(_RING_BQ, sq)} == 0, k: {sk} % "
+                f"{min(_RING_BK, sk)} == 0), seq >= 8 (q={sq}, k={sk}), "
+                f"head_dim % 8 == 0 (got {d}), and q heads divisible "
+                f"by kv heads (hq={hq}, hk={hk}); pass use_flash=False "
+                "(or None) for the jnp online-softmax ring")
+        if plan[0] == "fold":
             # GQA fold (same trick as flash_attention_bhsd): stream each
             # kv head once and halve the ring's ICI volume vs repeating
             hq, hk = q.shape[1], k.shape[1]

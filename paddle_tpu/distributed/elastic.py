@@ -388,7 +388,8 @@ class StoreHeartbeat:
 
 def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
                   load_fn, checkpoint_interval=100, max_restarts=3,
-                  signals=(signal.SIGTERM,), watchdog_abort=True):
+                  signals=(signal.SIGTERM,), watchdog_abort=True,
+                  data_factory=None):
     """The self-healing training loop: ties the islands — watchdog
     expiry -> abort, preemption signal -> checkpoint, failure -> elastic
     restart from the newest COMPLETE checkpoint — into one supervisor
@@ -397,7 +398,18 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
 
     Contract:
       train_fn(start, end)   runs steps [start, end) deterministically
-                             from the currently-loaded state
+                             from the currently-loaded state; with
+                             `data_factory` set the signature becomes
+                             train_fn(start, end, batches)
+      data_factory(start)    (optional) builds the input iterator for an
+                             attempt resuming at `start` — typically
+                             ``lambda s: trainer.data_iter(loader_from(s))``
+                             (io/prefetch.py device prefetcher). Rebuilt
+                             per attempt and close()d when the attempt
+                             ends, so a restart drops the previous
+                             attempt's prefetch thread and its queue of
+                             stale on-device batches instead of leaking
+                             them into the resumed stream.
       save_fn(step, path)    writes a checkpoint at step boundary `step`
                              (steps [0, step) are done) into `path`
       load_fn(path)          restores training state from `path`
@@ -494,7 +506,13 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
                         f"(checkpoint_dir={checkpoint_dir!r}); aborting "
                         "rather than training on a dirty state")
             wd_base = watchdog.expired_count() if watchdog_abort else 0
+            batches = None
             try:
+                # inside the try: a transient failure BUILDING the
+                # input iterator must count as a restartable attempt
+                # failure, not abort the resilient run
+                if data_factory is not None:
+                    batches = data_factory(start)
                 step = start
                 while step < total_steps:
                     if chaos.ENABLED:
@@ -508,7 +526,10 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
                         raise _Preempted()
                     end = min(step + checkpoint_interval, total_steps)
                     dirty = True
-                    train_fn(step, end)
+                    if batches is not None:
+                        train_fn(step, end, batches)
+                    else:
+                        train_fn(step, end)
                     step = end
                     # a chunk during which a collective hung/aborted
                     # must not become the newest-complete resume: poll
@@ -546,6 +567,13 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
                     raise
                 # fall through: reload from the newest complete
                 # checkpoint and recompute the lost steps
+            finally:
+                close = getattr(batches, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:   # noqa: BLE001 — best-effort
+                        pass
     finally:
         mgr.close()
 

@@ -47,6 +47,8 @@ from __future__ import annotations
 import math
 import queue
 import threading
+import time
+import weakref
 from typing import NamedTuple
 
 import numpy as np
@@ -175,7 +177,7 @@ class _Request:
 
     def __init__(self, ids, max_new_tokens, eos_token_id, do_sample,
                  temperature, top_k, top_p, pages_needed,
-                 deadline=None):
+                 deadline=None, engine=None):
         with _Request._id_lock:
             self.rid = _Request._next_id
             _Request._next_id += 1
@@ -188,6 +190,10 @@ class _Request:
         self.top_p = float(top_p)
         self.pages_needed = pages_needed
         self.deadline = deadline    # expire-in-queue (overload.Deadline)
+        # weakly back-reference the engine so result() can detect a
+        # scheduler that nobody is driving (stall guard) without keeping
+        # the engine alive through abandoned request handles
+        self._engine = weakref.ref(engine) if engine is not None else None
         self.sample_index = 0       # engine-local; set by submit()
         self.tokens: list[int] = []          # accepted generated tokens
         self.queue: queue.Queue = queue.Queue()
@@ -212,9 +218,61 @@ class _Request:
                 return
             yield from item
 
-    def result(self):
-        """Block until finished; return the generated token list."""
-        self.done.wait()
+    def result(self, stall_timeout=60.0):
+        """Block until finished; return the generated token list.
+
+        Stall guard: submit() does NOT auto-start the background ticker
+        (only stream() does), so a bare submit()+result() would
+        otherwise block forever. If the request is unfinished and
+        nothing is driving the scheduler — no live ticker thread, no
+        tick in flight, no new step() call — for `stall_timeout`
+        seconds, raise with the fix named instead of hanging. The
+        default is deliberately generous: an external driver doing slow
+        host work BETWEEN step() calls must not trip it (the guard
+        exists to turn an infinite hang into an explained error, not to
+        detect stalls fast)."""
+        eng_ref = self._engine
+        last_seq = None
+        last_t = time.monotonic()
+        while not self.done.wait(0.5):
+            if eng_ref is None:
+                continue          # engine unknown (legacy): plain wait
+            eng = eng_ref()
+            if eng is None:
+                if self.done.is_set():
+                    break     # finished during the wait (TOCTOU)
+                # the engine was garbage-collected with this request
+                # unfinished: NOTHING can ever finish it — raise now
+                raise RuntimeError(
+                    "result(): the engine owning this request was "
+                    "garbage-collected before the request finished — "
+                    "keep the PagedKVEngine alive and drive it "
+                    "(start() or run_until_idle()) until result() "
+                    "returns")
+            ticker = eng._ticker
+            seq = eng._step_seq
+            # a live ticker, a tick in flight (first-call XLA compiles
+            # run well past any timeout) or a new step() call all count
+            # as someone driving the scheduler
+            progressing = ((ticker is not None and ticker.is_alive())
+                           or eng._in_step or seq != last_seq)
+            del eng, ticker   # don't pin the engine (and its KV pools)
+            #                   across the wait — the collected-engine
+            #                   branch above must stay reachable
+            if progressing:
+                last_seq = seq
+                last_t = time.monotonic()
+                continue
+            if time.monotonic() - last_t > stall_timeout:
+                if self.done.is_set():
+                    break     # finished during the wait (TOCTOU)
+                raise RuntimeError(
+                    "result(): request unfinished and no scheduler is "
+                    "driving the engine (no ticker thread, no step() "
+                    f"progress for {stall_timeout:.1f}s) — call "
+                    "engine.start() for background serving or "
+                    "engine.run_until_idle() after submit(); submit() "
+                    "does not auto-start the ticker (stream() does)")
         if self.error is not None:
             raise self.error
         return list(self.tokens)
@@ -314,6 +372,8 @@ class PagedKVEngine:
         self._lock = threading.Lock()
         self._programs = {}
         self._tick_count = 0
+        self._step_seq = 0      # step() calls ever made (result() stall
+        self._in_step = False   # guard watches both for driver progress)
         self._seed = int(seed)
         self._submitted = 0
         self._key = jax.random.key(seed)
@@ -371,7 +431,7 @@ class PagedKVEngine:
                              f"{self.num_pages - 1}")
         req = _Request(ids, max_new_tokens, eos_token_id, do_sample,
                        temperature, top_k, top_p, pages,
-                       deadline=deadline)
+                       deadline=deadline, engine=self)
         with self._lock:
             if self.max_pending is not None:
                 # shed when the request can neither start NOW (free
@@ -693,6 +753,14 @@ class PagedKVEngine:
         """One scheduler tick: admit pending requests (prefill), then
         one fused multi-step decode over every live slot. Returns True
         if any work was done."""
+        self._step_seq += 1
+        self._in_step = True   # a tick in flight (incl. a long first-
+        try:                   # call compile) counts as driver progress
+            return self._step_tick()
+        finally:
+            self._in_step = False
+
+    def _step_tick(self):
         for i, slot in enumerate(self._slots):
             if slot is not None and slot.req.cancelled.is_set():
                 self.stats["cancelled"] += 1
@@ -811,8 +879,11 @@ class PagedKVEngine:
 
     # -- background ticker (HTTP serving) --------------------------------
     def start(self):
-        """Run the scheduler in a daemon thread until stop(); submit()
-        auto-starts it when serving."""
+        """Run the scheduler in a daemon thread until stop(). stream()
+        auto-starts it when serving; submit() does NOT — pair submit()
+        with start() or run_until_idle() (a bare submit()+result()
+        raises after result()'s stall guard instead of blocking
+        forever)."""
         with self._lock:
             if self._ticker is None or not self._ticker.is_alive():
                 self._stop_flag = False
@@ -1034,6 +1105,27 @@ class PagedKVEngine:
             lv = _val(logits)                            # (B, g+1, v)
             v = lv.shape[-1]
             picks = jnp.argmax(lv, axis=-1).astype(jnp.int32)
+
+            def write_bonus_draft_kv(n_acc, dflat):
+                """Full acceptance advances lens by g+1, committing
+                position lens+g (token d_{g-1}) — the one position the
+                g draft steps never wrote (they covered lens..lens+g-1).
+                Without this write, later draft steps attend over
+                zeros/stale KV there (output stays correct — target
+                verify — but acceptance silently degrades over long
+                generations). One extra draft step writes it; rows
+                without full acceptance drop the write via n_valid=0
+                (their stale tail is overwritten by the next tick's
+                draft scan anyway)."""
+                bonus = (active & (n_acc == g)).astype(jnp.int32)
+                bstate = PagedState(bt, lens + g, bonus)
+                _, dcaches = draft(
+                    Tensor(d_toks[:, g - 1:g]),
+                    caches=self._layer_caches(list(dflat)),
+                    position_ids=Tensor((lens + g)[:, None]),
+                    cache_index=bstate)
+                return [_val(a) for kv in dcaches for a in kv]
+
             if not any_sample:
                 match = (picks[:, :g] == d_toks).astype(jnp.int32)
                 n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
@@ -1051,7 +1143,7 @@ class PagedKVEngine:
                 lens_f = lens + live32 * (1 + n_acc)
                 return (out, n_emit, lens_f,
                         [_val(a) for kv in tcaches for a in kv],
-                        list(dflat_f))
+                        write_bonus_draft_kv(n_acc, dflat_f))
             xt = _process_logits_rowwise(
                 lv.reshape(-1, v),
                 jnp.repeat(temp, g + 1), jnp.repeat(topk, g + 1),
@@ -1103,7 +1195,7 @@ class PagedKVEngine:
             lens_f = lens + live32 * (1 + n_acc)
             return (out, n_emit, lens_f,
                     [_val(a) for kv in tcaches for a in kv],
-                    list(dflat_f))
+                    write_bonus_draft_kv(n_acc, dflat_f))
 
         import jax as _jax
         donate = () if _jax.default_backend() == "cpu" else (9, 10)
@@ -1164,6 +1256,12 @@ class PagedKVEngine:
                 jnp.arange(n, dtype=jnp.int32))
             return jnp.swapaxes(toks, 0, 1), lens_f, list(flat_f)
 
-        fn = jax.jit(run)
+        # donate the pool buffers (the last positional arg; its index
+        # depends on the 4 sampling vectors) on non-CPU backends, like
+        # _prefill_fn/_spec_tick_fn already do — without it steady-state
+        # decode held ~2x KV-pool memory on TPU
+        donate = () if jax.default_backend() == "cpu" \
+            else (11 if any_sample else 7,)
+        fn = jax.jit(run, donate_argnums=donate)
         self._programs[key] = fn
         return fn

@@ -756,6 +756,25 @@ class _MultiprocessIter:
         self.workers = []
 
 
+def __getattr__(name):
+    # lazy: prefetch.py imports distributed.chaos/observability, which
+    # must not load mid-way through the package __init__ (io is imported
+    # before distributed during `import paddle_tpu`)
+    if name in ("DevicePrefetcher", "prefetch_to_device", "prefetch"):
+        # importlib, NOT `from paddle_tpu.io import prefetch`: the
+        # from-import re-enters THIS __getattr__ through importlib's
+        # _handle_fromlist hasattr probe on the handled name "prefetch"
+        # -> RecursionError when the submodule isn't imported yet
+        import importlib
+        _prefetch = importlib.import_module("paddle_tpu.io.prefetch")
+        globals()["prefetch"] = _prefetch
+        globals()["DevicePrefetcher"] = _prefetch.DevicePrefetcher
+        globals()["prefetch_to_device"] = _prefetch.prefetch_to_device
+        return globals()[name]
+    raise AttributeError(
+        f"module 'paddle_tpu.io' has no attribute {name!r}")
+
+
 class SubsetRandomSampler(Sampler):
     """Sample randomly from a fixed index subset (reference:
     io/dataloader/sampler.py SubsetRandomSampler)."""

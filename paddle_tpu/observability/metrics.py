@@ -95,6 +95,17 @@ METRICS = {
                               "steps skipped for non-finite grads"),
     "train.recompiles": ("counter",
                          "train-step program (re)builds"),
+    # -- input pipeline -----------------------------------------------
+    "io.prefetch.queue_depth": ("gauge",
+                                "batches already on device, waiting "
+                                "for the consumer"),
+    "io.prefetch.batches": ("counter",
+                            "batches placed on device by prefetch "
+                            "workers"),
+    "io.h2d.seconds": ("histogram",
+                       "host->device batch placement time on the "
+                       "prefetch thread (dispatch + ready)",
+                       DEFAULT_BUCKETS_S),
     # -- serving ------------------------------------------------------
     "serving.requests": ("counter",
                          "HTTP requests by outcome (label: outcome)"),

@@ -140,6 +140,10 @@ class Trainer:
         self._loss_fn = loss_fn
         self._step_fn = None
         self._chaos_poison = False
+        # per-(key, ndim) NamedSharding cache for batch leaves: shared
+        # by step() and data_iter()'s prefetcher, so a prefetched batch
+        # compares equal (same objects) and skips device_put entirely
+        self._batch_shardings: dict = {}
         # non-finite skip bookkeeping (host side)
         self._pending_skips: list = []
         self.nonfinite_streak = 0
@@ -401,7 +405,13 @@ class Trainer:
         asynchronously and only reading the value (float()/numpy()) blocks.
         Through the axon tunnel a per-step host sync costs ~100ms, so the
         old eager float() here serialized dispatch against execution."""
-        batch = {k: (v._value if isinstance(v, Tensor) else jnp.asarray(v))
+        # numpy leaves stay numpy here: on the mesh path device_put
+        # below does ONE direct host->sharded transfer (jnp.asarray
+        # first paid an extra staging copy to the default device), and
+        # on the meshless path jit dispatch converts identically
+        batch = {k: (v._value if isinstance(v, Tensor)
+                     else v if isinstance(v, (np.ndarray, jax.Array))
+                     else jnp.asarray(v))
                  for k, v in batch.items()}
         if observability.ENABLED:
             self._telemetry_tick(batch)
@@ -411,12 +421,16 @@ class Trainer:
             # gap as one giant step into train.step.seconds
             self._tel_last_t = self._tel_prev = None
         if self.mesh is not None:
-            bspec = batch_spec(self.mesh.axis_names,
-                               self.config.shard_batch_seq)
             put = {}
             for k, v in batch.items():
-                spec = P(*(list(bspec) + [None] * (v.ndim - 2))[:v.ndim])
-                put[k] = jax.device_put(v, NamedSharding(self.mesh, spec))
+                sh = self._batch_sharding(k, v.ndim)
+                if getattr(v, "sharding", None) == sh:
+                    # already placed (the data_iter prefetch path): the
+                    # hot path stays free of device_put — no H2D, no
+                    # host->device sync on the dispatch thread
+                    put[k] = v
+                else:
+                    put[k] = jax.device_put(v, sh)
             batch = put
         if self._step_fn is None:
             self._step_fn = self._build_step(None)
@@ -448,6 +462,41 @@ class Trainer:
             # few steps later, when float() no longer forces a sync
             self._tel_prev[2] = loss
         return Tensor(loss, stop_gradient=True)
+
+    def _batch_sharding(self, key, ndim):
+        """Cached NamedSharding for batch leaf (key, ndim). step() used
+        to rebuild the spec + NamedSharding per tensor per step — pure
+        host work on the dispatch thread; the cache makes the repeat
+        cost one dict hit, and hands the SAME objects to data_iter's
+        prefetcher so placed batches compare equal in step()."""
+        if self.mesh is None:
+            return None           # prefetcher default-places; step()'s
+            #                       jnp.asarray is then a no-op
+        sh = self._batch_shardings.get((key, ndim))
+        if sh is None:
+            bspec = batch_spec(self.mesh.axis_names,
+                               self.config.shard_batch_seq)
+            spec = P(*(list(bspec) + [None] * (ndim - 2))[:ndim])
+            sh = NamedSharding(self.mesh, spec)
+            self._batch_shardings[(key, ndim)] = sh
+        return sh
+
+    def data_iter(self, loader, depth=2):
+        """The idiomatic input-pipeline entry point: wrap a DataLoader
+        (or any iterator of {name: array} batches) in a sharding-aware
+        device prefetcher matched to this trainer. Batches come out
+        already placed with the trainer's own batch shardings, H2D
+        overlapped with the previous step's compute on a background
+        thread, so step() performs ZERO device_put calls:
+
+            for batch in trainer.data_iter(loader):
+                loss = trainer.step(batch)
+
+        Returns a DevicePrefetcher (io/prefetch.py): a context manager
+        with close(), bounded to `depth` on-device batches."""
+        from paddle_tpu.io.prefetch import DevicePrefetcher
+        return DevicePrefetcher(loader, sharding_for=self._batch_sharding,
+                                depth=depth)
 
     def _telemetry_tick(self, batch):
         """Report the PREVIOUS step's telemetry now that its interval

@@ -219,3 +219,23 @@ def test_flash_ring_gqa_fold_matches_repeat():
         np.asarray(gv1),
         np.asarray(gv0).reshape(1, 2, rep, 128, 16).sum(2), rtol=2e-4,
         atol=1e-5)
+
+
+def test_ring_flash_explicit_misaligned_raises_descriptive():
+    """ring_attention_local(use_flash=True) with shapes the flash plan
+    rejects must raise a ValueError naming the misaligned dims up
+    front, not die later on an obscure Pallas shape assert (r5
+    advisory). The auto path (use_flash=None) still falls back to the
+    jnp ring for the same shapes."""
+    import paddle_tpu.distributed.context_parallel as cp
+    rng = np.random.RandomState(0)
+    # hq % hk != 0 -> no fold plan
+    q = jnp.asarray(rng.randn(1, 3, 16, 32), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, 16, 32), jnp.float32)
+    with pytest.raises(ValueError, match=r"hq=3, hk=2"):
+        cp.ring_attention_local(q, k, k, "sp", use_flash=True)
+    # head_dim % 8 != 0
+    q2 = jnp.asarray(rng.randn(1, 2, 16, 12), jnp.float32)
+    k2 = jnp.asarray(rng.randn(1, 2, 16, 12), jnp.float32)
+    with pytest.raises(ValueError, match=r"head_dim % 8"):
+        cp.ring_attention_local(q2, k2, k2, "sp", use_flash=True)

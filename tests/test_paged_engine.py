@@ -195,6 +195,21 @@ def test_submit_validation():
         eng.submit(list(range(10)), max_new_tokens=8)
 
 
+def test_submit_without_driver_result_raises_not_hangs():
+    """submit() does NOT auto-start the ticker (only stream() does) —
+    result()'s stall guard must raise with the fix named instead of
+    blocking forever, and the handle stays usable once a real driver
+    drains the engine."""
+    model = _model()
+    eng = PagedKVEngine(model, max_slots=1, page_size=4, num_pages=16,
+                        max_pages_per_slot=4)
+    r = eng.submit([1, 2, 3], max_new_tokens=2)
+    with pytest.raises(RuntimeError, match="run_until_idle"):
+        r.result(stall_timeout=0.4)
+    eng.run_until_idle()
+    assert len(r.result()) == 2
+
+
 def test_eos_mid_tick_truncates_and_frees():
     model = _model()
     # discover what the model emits, then use its 2nd token as eos

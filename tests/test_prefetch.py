@@ -1,0 +1,373 @@
+"""Sharding-aware device prefetch (io/prefetch.py) + the zero-sync
+trainer hot path it feeds (parallel/trainer.py data_iter/step).
+
+Covers the PR-4 acceptance list: queue depth bounds + backpressure,
+exact batch-order/content parity vs the unprefetched loop
+(bit-identical losses), worker-exception propagation, shutdown
+mid-epoch, a chaos-delay soak, the device_put-free hot-path regression
+(monkeypatched jax.device_put must see ZERO calls per step once batches
+arrive pre-placed), prefetch metrics, and the resilient-loop
+data_factory wiring."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed import chaos
+from paddle_tpu.distributed.mesh import init_mesh
+from paddle_tpu.io.prefetch import DevicePrefetcher, prefetch_to_device
+from paddle_tpu.parallel import ShardingPlan, Trainer, TrainStepConfig
+
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, input_ids=None, labels=None):
+        return ((self.fc(input_ids) - labels) ** 2).mean()
+
+
+def _mesh_trainer():
+    paddle_tpu.seed(7)
+    m = _Net()
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    mesh = init_mesh({"dp": 2})
+    return Trainer(m, o, mesh=mesh, plan=ShardingPlan([]),
+                   config=TrainStepConfig(compute_dtype=None,
+                                          donate=False,
+                                          shard_batch_seq=False))
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"input_ids": rng.randn(4, 4).astype(np.float32),
+             "labels": rng.randn(4, 4).astype(np.float32)}
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# prefetcher core
+# ---------------------------------------------------------------------------
+
+def test_depth_bound_backpressures_producer():
+    """The queue never holds more than `depth` batches, and a stalled
+    consumer stalls the SOURCE (bounded device residency) instead of
+    letting the worker race through the epoch."""
+    pulled = []
+
+    def src():
+        for i in range(50):
+            pulled.append(i)
+            yield {"x": np.full((2,), i, np.float32)}
+
+    pf = DevicePrefetcher(src(), depth=3)
+    try:
+        deadline = time.time() + 5
+        while pf.qsize() < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert pf.qsize() == 3
+        time.sleep(0.2)           # stalled consumer: no further pulls
+        # depth in queue + at most one batch in flight inside the worker
+        assert len(pulled) <= 3 + 1
+        got = next(pf)
+        assert int(np.asarray(got["x"]._value
+                              if hasattr(got["x"], "_value")
+                              else got["x"])[0]) == 0
+        deadline = time.time() + 5
+        while len(pulled) < 5 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(pulled) <= 3 + 2   # exactly one refill + one in flight
+    finally:
+        pf.close()
+
+
+def test_exhaustion_and_order():
+    """Exhaustion propagates as StopIteration; batch order and content
+    are exactly the source's."""
+    batches = _batches(6)
+    pf = DevicePrefetcher(iter(batches), depth=2)
+    out = list(pf)
+    assert len(out) == 6
+    for want, got in zip(batches, out):
+        for k in want:
+            np.testing.assert_array_equal(want[k], np.asarray(got[k]))
+        assert isinstance(got["input_ids"], jax.Array)
+    with pytest.raises(StopIteration):
+        next(pf)
+    pf.close()                    # idempotent after exhaustion
+
+
+def test_worker_exception_propagates_to_consumer():
+    """The ORIGINAL exception object from the source re-raises in the
+    consumer thread (handlers for the source's failure mode keep
+    working), after the batches before it were delivered."""
+    def src():
+        yield {"x": np.zeros((2,), np.float32)}
+        raise ValueError("boom-in-source")
+
+    pf = DevicePrefetcher(src(), depth=2)
+    next(pf)
+    with pytest.raises(ValueError, match="boom-in-source"):
+        next(pf)
+    pf.close()
+
+
+def test_shutdown_mid_epoch_joins_worker():
+    """close() mid-epoch (queue full, producer blocked on put) cancels
+    the worker promptly; the iterator then reads as exhausted."""
+    def src():
+        i = 0
+        while True:               # infinite: only close() can end this
+            yield {"x": np.full((2,), i, np.float32)}
+            i += 1
+
+    pf = DevicePrefetcher(src(), depth=2)
+    deadline = time.time() + 5
+    while pf.qsize() < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    next(pf)                      # consume one mid-epoch
+    pf.close()
+    assert not pf._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(pf)
+    pf.close()                    # idempotent
+
+
+def test_prefetch_to_device_mesh_spec_placement():
+    """prefetch_to_device(mesh=, spec=) places leaves with the expected
+    NamedSharding, truncated to each leaf's rank."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = init_mesh({"dp": 2}).jax_mesh
+    src = [{"a": np.zeros((4, 2), np.float32),
+            "b": np.zeros((4,), np.float32)}]
+    with prefetch_to_device(iter(src), mesh=mesh, spec=P("dp")) as pf:
+        out = next(pf)
+    assert out["a"].sharding == NamedSharding(mesh, P("dp", None))
+    assert out["b"].sharding == NamedSharding(mesh, P("dp"))
+
+
+def test_lazy_io_export_works_in_fresh_process():
+    """paddle_tpu.io's lazy __getattr__ must resolve the prefetch names
+    in a process that never imported paddle_tpu.io.prefetch directly —
+    a from-import inside __getattr__ recursed via importlib's
+    _handle_fromlist probe (review finding), which in-process tests
+    mask because sys.modules is already populated."""
+    import os
+    import subprocess
+    import sys
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}   # no TPU claim in the child
+    env["JAX_PLATFORMS"] = "cpu"
+    code = ("import paddle_tpu.io as io; io.prefetch_to_device; "
+            "io.DevicePrefetcher; "
+            "from paddle_tpu.io import DevicePrefetcher; print('ok')")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0 and "ok" in out.stdout, out.stderr[-2000:]
+
+
+def test_abandoned_prefetcher_is_collectable_and_thread_exits():
+    """Dropping the handle without close() (early `break`, no context
+    manager) must not leak the worker forever: the thread holds only a
+    weakref, so GC reclaims the prefetcher, __del__ closes it, and the
+    thread exits."""
+    import gc
+
+    def src():
+        i = 0
+        while True:
+            yield {"x": np.full((2,), i, np.float32)}
+            i += 1
+
+    pf = DevicePrefetcher(src(), depth=2)
+    thread = pf._thread
+    next(pf)                      # consumer ran, then walks away
+    del pf
+    gc.collect()
+    thread.join(timeout=5)
+    assert not thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# trainer integration: parity + the zero-sync hot path
+# ---------------------------------------------------------------------------
+
+def test_trainer_parity_bit_identical_vs_unprefetched():
+    """data_iter must be a pure transport: losses AND final params over
+    N steps are bit-identical to stepping host batches directly."""
+    batches = _batches(5, seed=3)
+
+    t1 = _mesh_trainer()
+    raw = [float(t1.step(b)) for b in batches]
+
+    t2 = _mesh_trainer()
+    with t2.data_iter(iter(batches), depth=2) as it:
+        pre = [float(t2.step(b)) for b in it]
+
+    assert raw == pre             # bit-identical losses
+    for n in t1.params:
+        np.testing.assert_array_equal(np.asarray(t1.params[n]),
+                                      np.asarray(t2.params[n]))
+
+
+def test_hot_path_zero_device_put_once_preplaced(monkeypatch):
+    """THE regression gate for the tentpole: once batches arrive
+    pre-placed (data_iter), Trainer.step performs ZERO jax.device_put
+    calls — the last recurring host->device sync is out of the step
+    dispatch path."""
+    tr = _mesh_trainer()
+    batches = _batches(4, seed=5)
+    it = tr.data_iter(iter(batches), depth=8)
+    deadline = time.time() + 10
+    while it.batches_prefetched < 4 and time.time() < deadline:
+        time.sleep(0.01)
+    assert it.batches_prefetched == 4
+    it._thread.join(timeout=5)    # worker fully done: no bg placements
+
+    calls = {"n": 0}
+    orig = jax.device_put
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(jax, "device_put", counting)
+    losses = [float(tr.step(b)) for b in it]
+    monkeypatch.undo()
+    it.close()
+    assert len(losses) == 4
+    assert calls["n"] == 0, "step() still calls device_put on " \
+                            "pre-placed batches"
+
+
+def test_unprefetched_step_still_places_host_batches():
+    """The skip is conditional: a plain host-numpy batch still goes
+    through device_put and trains identically (no behavior change for
+    non-prefetched callers)."""
+    tr = _mesh_trainer()
+    b = _batches(1)[0]
+    loss = float(tr.step(b))
+    assert np.isfinite(loss)
+    # the cached shardings are reused across steps (one per (key, ndim))
+    tr.step(b)
+    assert set(tr._batch_shardings) == {("input_ids", 2), ("labels", 2)}
+
+
+def test_chaos_delay_soak_parity():
+    """io.prefetch.delay slows the worker but must never change WHAT is
+    delivered: losses stay bit-identical to the clean prefetched run,
+    and the site's fires are counted."""
+    batches = _batches(6, seed=11)
+    t1 = _mesh_trainer()
+    with t1.data_iter(iter(batches), depth=2) as it:
+        clean = [float(t1.step(b)) for b in it]
+
+    t2 = _mesh_trainer()
+    with chaos.scoped(seed=4, rates={"io.prefetch.delay": 1.0},
+                      delay_ms=2):
+        with t2.data_iter(iter(batches), depth=2) as it:
+            slow = [float(t2.step(b)) for b in it]
+        assert chaos.fire_count("io.prefetch.delay") == 6
+    assert clean == slow
+
+
+def test_prefetch_metrics_catalogued_and_recorded():
+    """Queue-depth gauge, h2d histogram and batches counter are
+    recorded under observability (and therefore catalogued — the
+    registry raises on uncatalogued names)."""
+    from paddle_tpu import observability as obs
+    batches = _batches(3)
+    tr = _mesh_trainer()
+    with obs.scoped() as reg:
+        with tr.data_iter(iter(batches), depth=2) as it:
+            for b in it:
+                tr.step(b)
+        assert reg.counter("io.prefetch.batches").value() == 3
+        assert reg.histogram("io.h2d.seconds").count() == 3
+        assert reg.gauge("io.prefetch.queue_depth").value() is not None
+
+
+# ---------------------------------------------------------------------------
+# resilient-loop wiring
+# ---------------------------------------------------------------------------
+
+def test_run_resilient_data_factory_rebuilds_and_closes(tmp_path):
+    """run_resilient(data_factory=...) hands train_fn a per-attempt
+    iterator, closes it when the attempt ends (incl. on failure), and
+    the resumed stream restarts at the right step — final state matches
+    the fault-free run exactly."""
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.distributed import elastic
+
+    def batch_for(s):
+        return np.full((2,), float(s), np.float32)
+
+    class St:
+        def __init__(self):
+            self.w = np.zeros(2, np.float32)
+
+        def train_fn(self, start, end, batches):
+            for s in range(start, end):
+                b = next(batches)
+                self.w = (self.w * np.float32(1.01)
+                          + np.asarray(b)).astype(np.float32)
+
+        def save_fn(self, step, path):
+            ckpt.save_state_dict(
+                {"w": paddle_tpu.to_tensor(self.w)}, path)
+
+        def load_fn(self, path):
+            sd = {"w": paddle_tpu.to_tensor(np.zeros(2, np.float32))}
+            ckpt.load_state_dict(sd, path)
+            self.w = np.asarray(sd["w"]._value)
+
+    made, closed = [], []
+
+    def factory_for(st, boom_at=None):
+        fired = {"done": False}
+
+        def src(start):
+            s = start
+            while True:
+                if boom_at is not None and s == boom_at \
+                        and not fired["done"]:
+                    fired["done"] = True
+                    raise RuntimeError("transient input-pipeline fault")
+                yield batch_for(s)
+                s += 1
+
+        def factory(start):
+            made.append(start)
+            pf = DevicePrefetcher(src(start), depth=2)
+            real_close = pf.close
+            pf.close = lambda: (closed.append(start), real_close())
+            return pf
+        return factory
+
+    ref = St()
+    res = elastic.run_resilient(
+        ref.train_fn, 8, str(tmp_path / "a"), ref.save_fn, ref.load_fn,
+        checkpoint_interval=2, max_restarts=0,
+        data_factory=factory_for(ref))
+    assert res["steps"] == 8 and res["restarts"] == 0
+    assert made == [0] and closed == [0]
+
+    made.clear(), closed.clear()
+    st = St()
+    res2 = elastic.run_resilient(
+        st.train_fn, 8, str(tmp_path / "b"), st.save_fn, st.load_fn,
+        checkpoint_interval=2, max_restarts=2,
+        data_factory=factory_for(st, boom_at=5))
+    assert res2["steps"] == 8 and res2["restarts"] == 1
+    # one factory per attempt, each closed; the retry resumed from the
+    # step-4 checkpoint so its stream restarts at 4
+    assert made == [0, 4] and closed == [0, 4]
+    np.testing.assert_array_equal(ref.w, st.w)   # bit-identical
