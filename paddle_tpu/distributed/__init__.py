@@ -33,9 +33,10 @@ all_to_all = alltoall  # torch-style alias the reference also exposes
 
 def __getattr__(name):
     import importlib
-    if name in ("fleet", "checkpoint", "pipeline", "launch", "parallel",
-                "sharding", "elastic", "auto_tuner", "rpc", "ps",
-                "auto_parallel", "watchdog", "chaos", "retries", "store"):
+    if name in ("fleet", "checkpoint", "async_checkpoint", "pipeline",
+                "launch", "parallel", "sharding", "elastic",
+                "auto_tuner", "rpc", "ps", "auto_parallel", "watchdog",
+                "chaos", "retries", "store"):
         mod = importlib.import_module(f"paddle_tpu.distributed.{name}")
         globals()[name] = mod
         return mod

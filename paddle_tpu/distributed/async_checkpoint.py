@@ -1,0 +1,388 @@
+"""Asynchronous checkpointing: snapshot-then-write saves that overlap
+training compute (the shape Orbax uses on TPU; reference:
+python/paddle/distributed/checkpoint/save_state_dict.py:104 async_save
+is the reference's one-thread version of the same idea).
+
+Every `checkpoint_interval`, a synchronous `save_state_dict` drains the
+dispatch pipeline for the FULL serialization time: device->host
+transfer, sha256 hashing, JSON table emission and atomic file writes
+all run on the training thread. This module splits the save at the only
+point the training thread actually has to participate:
+
+  1. **Snapshot** (training thread, fast): `copy_to_host_async()` is
+     fanned across every leaf/shard first, then one materialization
+     drain — D2H overlaps across arrays, so the blocking window is one
+     batched transfer, not N serial `np.asarray` calls. Donation-safe
+     by construction: the snapshot completes before `save()` returns,
+     so the next `step()` may donate/overwrite the device buffers
+     freely. `checkpoint.snapshot.seconds` records exactly this stall.
+  2. **Write** (background writer thread): hashing + file I/O reuse the
+     format-v4 machinery (streamed per-file sha256, `__table_digest__`,
+     atomic tmp-then-rename, quarantine-compatible layout) via
+     `checkpoint._write_files`, so `verify_checkpoint` /
+     `load_newest_complete` treat async-written checkpoints exactly
+     like sync ones. `checkpoint.write.seconds` records this part.
+  3. **Commit**: the completion marker (metadata.json) is written by
+     the coordinator only after a store barrier confirms EVERY rank's
+     writer finished its files, and `wait()`/`flush()` return only
+     after a second barrier confirms the marker landed. A crash at any
+     point mid-write leaves a directory without a marker — invisible to
+     `newest_complete_checkpoint`, so the previous newest-complete
+     checkpoint remains the fallback (the elastic recovery invariant).
+
+One-outstanding-save policy: a new `save()` never interleaves files
+with the previous one. `policy="wait"` (default) blocks the caller
+until the previous save committed; `policy="supersede"` snapshots
+immediately and replaces any QUEUED-but-unstarted save (a save already
+writing always finishes — its files are never torn by a newer save).
+
+Writer failures re-raise as the ORIGINAL exception object from the
+next `save()`/`wait()`/`flush()` (the io/prefetch.py contract), and an
+atexit hook drains in-flight saves so interpreter exit never truncates
+the run's final checkpoint.
+"""
+from __future__ import annotations
+
+import atexit
+import collections
+import os
+import sys
+import threading
+import time
+import weakref
+
+from paddle_tpu import observability
+from paddle_tpu.distributed import chaos
+from paddle_tpu.distributed import checkpoint as _ckpt
+
+__all__ = ["AsyncCheckpointer"]
+
+
+class _Save:
+    """One enqueued snapshot on its way to disk."""
+
+    __slots__ = ("payload", "meta", "pid", "path", "coordinator_rank",
+                 "callbacks", "committed", "error")
+
+    def __init__(self, payload, meta, pid, path, coordinator_rank):
+        self.payload = payload
+        self.meta = meta
+        self.pid = pid
+        self.path = path
+        self.coordinator_rank = coordinator_rank
+        self.callbacks: list = []
+        self.committed = False
+        self.error = None
+
+
+# Live checkpointers, drained by ONE atexit hook: a daemon writer
+# killed at interpreter exit would truncate the run's final checkpoint
+# silently (same failure checkpoint._atexit_finish guards for the
+# legacy async_save flag).
+_LIVE: "weakref.WeakSet[AsyncCheckpointer]" = weakref.WeakSet()
+
+
+def _atexit_flush():
+    for cp in list(_LIVE):
+        try:
+            cp.flush()
+        except Exception as e:  # noqa: BLE001 — exit path: report only
+            print(f"WARNING: async checkpoint flush failed at exit: "
+                  f"{e!r}", file=sys.stderr)
+
+
+atexit.register(_atexit_flush)
+
+
+class AsyncCheckpointer:
+    """Snapshot-then-write checkpoint saver (see module docstring).
+
+    policy: "wait" — a new save() first blocks until the previous one
+        committed (bounded memory: one payload alive at a time);
+        "supersede" — save() never blocks on the writer; a queued save
+        that has not started writing is replaced by the newer one.
+    store / rank / world_size: a TCPStore-compatible rendezvous for the
+        multi-process commit barrier (`store.barrier(name, rank, ws)`).
+        Without one, the jax coordination-service KV barrier is used
+        when available (never the device-sync barrier: this runs on a
+        background thread, and a device all-reduce from here would
+        interleave with training collectives — cross-host deadlock).
+    coordinator_rank: which process commits the completion marker.
+    """
+
+    def __init__(self, *, policy="wait", coordinator_rank=0, store=None,
+                 rank=0, world_size=None, barrier_timeout=600.0):
+        if policy not in ("wait", "supersede"):
+            raise ValueError(
+                f"policy must be 'wait' or 'supersede', got {policy!r}")
+        if policy == "supersede" and self._multiprocess(world_size):
+            # superseding is a HOST-LOCAL queue decision: one rank
+            # skipping a save the others perform would pair the commit
+            # barriers of DIFFERENT saves (coordinator marks a
+            # directory some ranks never wrote into, then every later
+            # barrier hangs). Saves must stay collective.
+            raise ValueError(
+                "policy='supersede' is single-process only: rank-local "
+                "supersede decisions desynchronize the collective "
+                "commit barriers; use policy='wait' in multi-process "
+                "runs")
+        self.policy = policy
+        self.coordinator_rank = int(coordinator_rank)
+        self._store = store
+        self._rank = int(rank)
+        self._world_size = world_size
+        self._barrier_timeout = float(barrier_timeout)
+        self._cv = threading.Condition()
+        self._queue: collections.deque = collections.deque()
+        self._inflight: _Save | None = None
+        self._error = None          # first un-reraised writer failure
+        self._stop = False
+        self._closed = False
+        self._thread: threading.Thread | None = None
+        self._last_job: _Save | None = None
+        self._barrier_seq = 0       # writer-thread-only (no lock)
+        self.saves_started = 0
+        self.saves_committed = 0
+        _LIVE.add(self)
+
+    @staticmethod
+    def _multiprocess(world_size):
+        if world_size is not None and int(world_size) > 1:
+            return True
+        import jax
+        try:
+            return jax.process_count() > 1
+        except Exception:       # noqa: BLE001 — backend not ready yet
+            return False
+
+    # -- public API ----------------------------------------------------
+    def save(self, state_dict, path, *, on_complete=None):
+        """Snapshot `state_dict` NOW (device->host, the only part the
+        caller pays) and enqueue the write. Returns once the snapshot
+        is materialized — subsequent training steps may donate the
+        device buffers. `on_complete` (optional, called on the writer
+        thread) runs after the completion marker committed."""
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointer is closed")
+        if self.policy == "wait":
+            # one outstanding save: drain (and surface any failure of)
+            # the previous one BEFORE snapshotting, so at most one
+            # host-side payload is alive at a time
+            self.wait()
+        payload, meta, pid = _ckpt._snapshot_state(state_dict)
+        job = _Save(payload, meta, pid, os.path.abspath(str(path)),
+                    self.coordinator_rank)
+        if on_complete is not None:
+            job.callbacks.append(on_complete)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointer is closed")
+            if self.policy == "supersede":
+                # replace anything not yet started; the in-flight save
+                # (if any) finishes untouched — files never interleave
+                self._queue.clear()
+            self._queue.append(job)
+            self._last_job = job
+            self.saves_started += 1
+            self._ensure_thread()
+            self._pending_gauge_locked()
+            self._cv.notify_all()
+        return job
+
+    def wait(self, timeout=None):
+        """Block until every enqueued save is durably committed (files
+        + barrier + marker); re-raise the first writer failure as the
+        ORIGINAL exception object. Returns False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._queue or self._inflight is not None:
+                rem = None if deadline is None \
+                    else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    return False
+                self._cv.wait(rem)
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+        return True
+
+    def flush(self, timeout=None):
+        """Alias of wait() with the lifecycle framing: call on
+        preemption signal and at normal exit so the last checkpoint is
+        durable before the process goes away."""
+        return self.wait(timeout)
+
+    def on_complete(self, fn):
+        """Attach `fn` to the most recently enqueued save: it runs (on
+        the writer thread) after that save's marker commits. When that
+        save already committed, `fn` runs immediately on the calling
+        thread; when it FAILED (or was superseded), `fn` is dropped —
+        a follow-up marker must never advance past data that did not
+        land. Lets callers sequence their own markers (e.g.
+        ElasticManager's latest.json) behind the durable checkpoint."""
+        with self._cv:
+            target = self._queue[-1] if self._queue else self._inflight
+            if target is not None and not target.committed:
+                target.callbacks.append(fn)
+                return
+            last = self._last_job
+        if last is not None and not last.committed:
+            return      # the save died before fn could attach: drop
+        fn()
+
+    @property
+    def pending(self) -> int:
+        """Saves not yet durably committed (queued + in flight)."""
+        with self._cv:
+            return len(self._queue) + (self._inflight is not None)
+
+    def close(self, flush=True):
+        """Stop the writer. With `flush` (default) all queued saves
+        commit first (re-raising a writer failure); with flush=False
+        queued-but-unstarted saves are dropped and only the in-flight
+        one finishes. Idempotent."""
+        with self._cv:
+            self._closed = True
+            if not flush:
+                self._queue.clear()
+        try:
+            if flush:
+                self.flush()
+        finally:
+            with self._cv:
+                self._stop = True
+                self._cv.notify_all()
+            t = self._thread
+            if t is not None:
+                t.join(timeout=60)
+            _LIVE.discard(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- writer --------------------------------------------------------
+    def _ensure_thread(self):
+        # caller holds self._cv
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._writer, daemon=True,
+                name="ckpt-async-writer")
+            self._thread.start()
+
+    def _writer(self):
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait()
+                if not self._queue:           # stop requested, drained
+                    return
+                job = self._queue.popleft()
+                self._inflight = job
+            try:
+                callbacks = self._write(job)
+                # the save is durable by here: a callback blowing up is
+                # the CALLER's problem (warn, keep going) — treating it
+                # as a writer failure would restart elastic off an
+                # older checkpoint than the one that just committed,
+                # and starve the callbacks queued after it
+                for cb in callbacks:
+                    try:
+                        cb()
+                    except Exception as e:    # noqa: BLE001
+                        print(f"WARNING: async checkpoint on_complete "
+                              f"callback failed for {job.path!r}: "
+                              f"{e!r}", file=sys.stderr)
+            except BaseException as e:        # noqa: BLE001 — hand to
+                job.error = e                 # the consumer (original
+                with self._cv:                # object, prefetch contract)
+                    if self._error is None:
+                        self._error = e
+            finally:
+                with self._cv:
+                    self._inflight = None
+                    if job.error is None:
+                        self.saves_committed += 1
+                    # gauge moves under the lock, BEFORE waiters wake:
+                    # a flush() returning with the gauge still stale
+                    # would misreport pending work as outstanding
+                    self._pending_gauge_locked()
+                    self._cv.notify_all()
+
+    def _write(self, job):
+        t0 = time.monotonic()
+        if chaos.ENABLED:
+            chaos.maybe_delay("ckpt.async.delay")
+        _ckpt._write_files(job.payload, job.meta, job.pid, job.path,
+                           job.coordinator_rank, defer_marker=True)
+        job.payload = job.meta = None      # free the snapshot promptly
+        if chaos.ENABLED and chaos.should_fire("ckpt.async.fail"):
+            # the writer dying AFTER shards/tables landed but BEFORE the
+            # marker: exactly the torn state the marker ordering exists
+            # to make recoverable
+            raise chaos.InjectedFault(
+                f"chaos: async checkpoint writer killed at {job.path!r} "
+                "after file writes, before the completion marker")
+        self._barrier("files", job.path)
+        if job.pid == job.coordinator_rank:
+            _ckpt._write_marker(job.path)
+        # second barrier: no rank's wait() may return (and start a scan
+        # that would quarantine a marker-less directory) before the
+        # coordinator's marker exists
+        self._barrier("marker", job.path)
+        if observability.ENABLED:
+            observability.observe("checkpoint.write.seconds",
+                                  time.monotonic() - t0)
+        with self._cv:
+            job.committed = True
+            return list(job.callbacks)
+
+    def _barrier(self, stage, path):
+        ws = self._world_size
+        if self._store is not None and ws is not None and int(ws) > 1:
+            self._store.barrier(f"async_ckpt/{stage}", self._rank,
+                                int(ws), timeout=self._barrier_timeout)
+            return
+        # KV barrier only (never a device sync from this thread), with
+        # an "async_ckpt" tag namespace of our OWN: checkpoint.py's
+        # _save_barrier counter belongs to the training thread's sync
+        # saves — bumping it from here would race it and, with mixed
+        # sync+async saves, assign divergent sequence tags across hosts
+        # (writer speed is host-dependent), hanging every later save.
+        # Saves through one checkpointer are collective and its writer
+        # is one thread, so this private counter advances in lockstep.
+        import jax
+        if jax.process_count() == 1:
+            return
+        try:
+            from jax._src import distributed as _dist
+            client = _dist.global_state.client
+        except Exception:       # noqa: BLE001 — no coordination client
+            client = None
+        if client is None:
+            import warnings
+            warnings.warn(
+                f"async checkpoint commit barrier SKIPPED in a "
+                f"{jax.process_count()}-process run (no coordination "
+                "client and no store= given): the completion marker "
+                "may commit before other hosts finish writing")
+            return
+        self._barrier_seq += 1
+        tag = f"async_ckpt:{stage}:{self._barrier_seq}"
+        from paddle_tpu.distributed import watchdog
+        with watchdog.watch(f"async_checkpoint.barrier {tag}",
+                            int(self._barrier_timeout * 1000)):
+            client.wait_at_barrier(
+                tag, timeout_in_ms=int(self._barrier_timeout * 1000))
+
+    def _pending_gauge_locked(self):
+        # caller holds self._cv (the registry takes only its own locks,
+        # so no ordering hazard)
+        if observability.ENABLED:
+            observability.set_gauge(
+                "checkpoint.async.pending",
+                len(self._queue) + (self._inflight is not None))

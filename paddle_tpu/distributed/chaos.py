@@ -79,6 +79,13 @@ POINTS = {
                          "file (torn write / bit rot)",
     "ckpt.write.table": "corrupt the just-written checkpoint table "
                         "file",
+    "ckpt.async.delay": "slow background checkpoint writer (stretches "
+                        "the window a save stays in flight — the "
+                        "overlap tests' lever)",
+    "ckpt.async.fail": "kill the background checkpoint writer after "
+                       "its file writes, before the completion marker "
+                       "commits (torn async save; recovery must fall "
+                       "back to the previous complete checkpoint)",
     "elastic.preempt": "synthetic preemption: SIGTERM to this process",
     "serving.batch.delay": "slow DynamicBatcher backend run",
     "serving.batch.fail": "failed DynamicBatcher batch run (error "

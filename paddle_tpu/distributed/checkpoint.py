@@ -209,25 +209,49 @@ def save_state_dict(state_dict, path, process_group=None,
     _async_thread.start()
 
 
+def _start_d2h(arr):
+    """Begin an asynchronous device->host copy of one jax array; the
+    later np.asarray completes (or awaits) it. Backends without the
+    hook just fall through — asarray then does the whole transfer."""
+    start = getattr(arr, "copy_to_host_async", None)
+    if start is not None:
+        try:
+            start()
+        except Exception:       # noqa: BLE001 — optional acceleration
+            pass
+    return arr
+
+
 def _snapshot_state(state_dict):
-    """Device->host copy of every addressable shard (the synchronous
-    part of a save: after this returns, the checkpoint content is
-    immune to donation/overwrite by subsequent training steps)."""
+    """Device->host copy of every addressable shard — the part of a
+    save the TRAINING thread pays (after this returns, the checkpoint
+    content is immune to donation/overwrite by subsequent steps).
+
+    D2H is fanned out first: copy_to_host_async() is dispatched on
+    EVERY shard before any np.asarray materializes one, so transfers
+    overlap each other and the blocking window is one batched drain
+    instead of N serial round trips. The one snapshot helper shared by
+    the sync save, the legacy async_save flag and AsyncCheckpointer;
+    `checkpoint.snapshot.seconds` records the stall it costs."""
+    t0 = time.monotonic()
     flat = _flatten_state(state_dict)
     pid = jax.process_index()
     fname = f"shards_{pid}.npz"
-    payload = {}
+    sources = {}      # key -> array with its D2H already in flight
     meta = {}
     for name, v in flat.items():
         arr = _arr(v)
         if not isinstance(arr, jax.Array):
-            arr = jax.numpy.asarray(np.asarray(arr))
+            # plain host value: keep it host-side — the old path staged
+            # it through the device and back (host->device->host) just
+            # to reuse the jax.Array branch below
+            arr = np.asarray(arr)
         gshape = list(arr.shape)
         entry = {"shape": gshape, "dtype": str(np.dtype(arr.dtype)),
                  "shards": []}
         if arr.ndim == 0 or not hasattr(arr, "addressable_shards"):
             key = f"{name}__0"
-            payload[key] = np.asarray(arr)
+            sources[key] = _start_d2h(arr)
             entry["shards"].append({"offsets": [0] * arr.ndim,
                                     "sizes": gshape, "file": fname,
                                     "key": key})
@@ -240,10 +264,15 @@ def _snapshot_state(state_dict):
                     continue
                 seen.add(tkey)
                 key = f"{name}__{i}"
-                payload[key] = np.asarray(sh.data)
+                sources[key] = _start_d2h(sh.data)
                 entry["shards"].append({"offsets": offs, "sizes": sizes,
                                         "file": fname, "key": key})
         meta[name] = entry
+    # materialize: every copy is already in flight, so this drains
+    payload = {k: np.asarray(v) for k, v in sources.items()}
+    if observability.ENABLED:
+        observability.observe("checkpoint.snapshot.seconds",
+                              time.monotonic() - t0)
     return payload, meta, pid
 
 
@@ -284,14 +313,61 @@ def _sha256_file(path, chunk=1 << 20):
     return h.hexdigest()
 
 
-def _atomic_write(final, write_fn):
+class _HashingWriter:
+    """Write-only file facade that streams every byte through sha256 on
+    the way to the real file, so the save path records a digest without
+    re-reading what it just wrote (`_sha256_file` stays for the verify/
+    load side). Deliberately NOT seekable: np.savez's zipfile falls back
+    to pure append-order (data-descriptor) output, which np.load reads
+    fine — a seek-back to patch headers would silently wrong the hash."""
+
+    def __init__(self, f):
+        self._f = f
+        self._h = hashlib.sha256()
+
+    def write(self, b):
+        n = self._f.write(b)
+        self._h.update(b)
+        return n
+
+    def read(self, *a):         # numpy duck-types file objects on this
+        import io
+        raise io.UnsupportedOperation("write-only")
+
+    def flush(self):
+        self._f.flush()
+
+    def fileno(self):
+        return self._f.fileno()
+
+    def seekable(self):
+        return False
+
+    def tell(self):             # zipfile probes; OSError -> streaming
+        import io
+        raise io.UnsupportedOperation("not seekable")
+
+    def hexdigest(self):
+        return self._h.hexdigest()
+
+
+def _atomic_write(final, write_fn, hashed=False):
     """tmp-then-rename so a death mid-write never leaves a half file
-    under the final name; transient I/O errors retried per policy."""
+    under the final name; transient I/O errors retried per policy.
+    `hashed=True` hands write_fn a _HashingWriter and returns the
+    sha256 of the written bytes — computed DURING the write (each retry
+    attempt restarts the hash with its fresh file)."""
     tmp = final + ".tmp"
+    out = {}
 
     def attempt():
         with open(tmp, "wb") as f:
-            write_fn(f)
+            if hashed:
+                w = _HashingWriter(f)
+                write_fn(w)
+                out["sha256"] = w.hexdigest()
+            else:
+                write_fn(f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, final)
@@ -303,6 +379,7 @@ def _atomic_write(final, write_fn):
                 os.remove(tmp)
             except OSError:
                 pass
+    return out.get("sha256")
 
 
 def _table_digest(table: dict) -> str:
@@ -331,17 +408,24 @@ def _table_digest_issue(table: dict):
     return None
 
 
-def _write_files(payload, meta, pid, path, coordinator_rank):
+def _write_files(payload, meta, pid, path, coordinator_rank,
+                 defer_marker=False):
+    """Write this host's shards + table (+ the metadata.json completion
+    marker, unless `defer_marker`: the async writer commits the marker
+    only after a cross-rank barrier, so a crash mid-write can never
+    leave a directory that scans as complete)."""
     t0 = time.monotonic()
     os.makedirs(path, exist_ok=True)
     fname = f"shards_{pid}.npz"
     shards_path = os.path.join(path, fname)
-    _atomic_write(shards_path, lambda f: np.savez(f, **payload))
-    # the digest is of the INTENDED bytes as they landed; recorded in
-    # this host's table so load verifies end-to-end (serialize -> media
-    # -> load)
+    # the digest is of the INTENDED bytes as they landed (streamed
+    # during the write — no second full read of a multi-GB shard file);
+    # recorded in this host's table so load verifies end-to-end
+    # (serialize -> media -> load)
+    digest = _atomic_write(shards_path, lambda f: np.savez(f, **payload),
+                           hashed=True)
     table = dict(meta)
-    table["__files__"] = {fname: {"sha256": _sha256_file(shards_path),
+    table["__files__"] = {fname: {"sha256": digest,
                                   "size": os.path.getsize(shards_path)}}
     # the table's own integrity record goes last: it covers every other
     # key, including the shard checksums above
@@ -357,16 +441,24 @@ def _write_files(payload, meta, pid, path, coordinator_rank):
     if chaos.ENABLED:
         chaos.maybe_corrupt_file("ckpt.write.table",
                                  os.path.join(path, f"table_{pid}.json"))
-    if pid == coordinator_rank:
-        _atomic_write(os.path.join(path, _META),
-                      lambda f: f.write(json.dumps(
-                          {"process_count": jax.process_count(),
-                           "format_version": _FORMAT_VERSION},
-                          indent=1).encode()))
+    if pid == coordinator_rank and not defer_marker:
+        _write_marker(path)
     if observability.ENABLED:
         observability.inc("ckpt.saves")
         observability.observe("ckpt.save.seconds",
                               time.monotonic() - t0)
+
+
+def _write_marker(path):
+    """metadata.json is the checkpoint's COMPLETION marker: without it
+    (and its process_count) the directory never verifies complete, so
+    committing it LAST — after every host's files exist — is what makes
+    a torn save fall back cleanly instead of half-loading."""
+    _atomic_write(os.path.join(path, _META),
+                  lambda f: f.write(json.dumps(
+                      {"process_count": jax.process_count(),
+                       "format_version": _FORMAT_VERSION},
+                      indent=1).encode()))
 
 
 _barrier_seq = 0

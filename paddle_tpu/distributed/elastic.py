@@ -6,9 +6,21 @@ TPU-native failure model: TPU VMs receive a SIGTERM ahead of preemption
 (maintenance events), and multi-slice jobs see peers vanish via the
 jax.distributed heartbeat. Recovery is restart-from-checkpoint — there is
 no NCCL communicator to rebuild; XLA re-compiles on the new topology. So
-the manager here is: signal-hook -> flush an async checkpoint -> mark a
-resume file; on start, resume from the newest complete checkpoint; a
+the manager here is: signal-hook -> flush the async checkpointer -> mark
+a resume file; on start, resume from the newest complete checkpoint; a
 `run` loop with bounded restarts replaces the reference's relaunch agent.
+
+Async-save ordering guarantee (distributed/async_checkpoint.py wiring):
+with a `checkpointer=` attached, saves return after only the device->
+host snapshot and the write overlaps later steps, so both loops here
+`flush()` at every point the checkpoint must be DURABLE — on a
+preemption signal before returning control to the scheduler, at normal
+exit before reporting completion, and before any resume scan (a scan
+racing an in-flight writer would quarantine the half-written
+directory). ElasticManager's `latest.json` resume marker is deferred
+behind the same boundary: it commits via the checkpointer's
+`on_complete` hook only once the save's own completion marker landed,
+so the marker can never point at a checkpoint that does not verify.
 """
 from __future__ import annotations
 
@@ -34,13 +46,19 @@ class ElasticManager:
 
     save_fn(step) -> writes a checkpoint for `step`
     load_fn() -> returns last step to resume from (or -1)
+    checkpointer -> optional AsyncCheckpointer the save_fn writes
+        through: the latest.json marker is then deferred until that
+        save durably committed, and run() flushes it on preemption and
+        at normal exit (module docstring: ordering guarantee)
     """
 
     def __init__(self, save_fn=None, load_fn=None, checkpoint_dir=None,
-                 max_restarts=3, signals=(signal.SIGTERM,)):
+                 max_restarts=3, signals=(signal.SIGTERM,),
+                 checkpointer=None):
         self._save_fn = save_fn
         self._load_fn = load_fn
         self._dir = checkpoint_dir
+        self._checkpointer = checkpointer
         self.max_restarts = max_restarts
         self._preempted = False
         self._prev_handlers = {}
@@ -64,15 +82,33 @@ class ElasticManager:
 
     def checkpoint(self, step):
         """Record a completed checkpoint for `step` (atomic marker file so a
-        death mid-write never yields a half checkpoint on resume)."""
+        death mid-write never yields a half checkpoint on resume). With
+        an async checkpointer the marker is deferred: it commits on the
+        writer thread only after the save itself is durable, so the
+        marker can never lead the data it points at."""
         if self._save_fn is not None:
             self._save_fn(step)
-        if self._dir is not None:
-            os.makedirs(self._dir, exist_ok=True)
-            tmp = os.path.join(self._dir, ".latest.tmp")
-            with open(tmp, "w") as f:
-                json.dump({"step": int(step), "time": time.time()}, f)
-            os.replace(tmp, os.path.join(self._dir, "latest.json"))
+        if self._dir is None:
+            return
+        if self._checkpointer is not None:
+            self._checkpointer.on_complete(
+                lambda s=step: self._write_latest(s))
+        else:
+            self._write_latest(step)
+
+    def _write_latest(self, step):
+        os.makedirs(self._dir, exist_ok=True)
+        tmp = os.path.join(self._dir, ".latest.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"step": int(step), "time": time.time()}, f)
+        os.replace(tmp, os.path.join(self._dir, "latest.json"))
+
+    def flush(self):
+        """Drain the async checkpointer (no-op without one), re-raising
+        a writer failure. The durability boundary run() crosses before
+        handing control back on preemption or normal exit."""
+        if self._checkpointer is not None:
+            self._checkpointer.flush()
 
     def last_step(self):
         if self._dir is not None:
@@ -110,7 +146,11 @@ class ElasticManager:
                     if self._preempted:
                         if observability.ENABLED:
                             observability.inc("elastic.preemptions")
+                        # the preemption checkpoint must be DURABLE
+                        # before the scheduler kills us
+                        self.flush()
                         return step  # clean exit; scheduler restarts us
+                self.flush()         # normal exit: final save durable
                 return total_steps
             except Exception:
                 restarts += 1
@@ -118,9 +158,22 @@ class ElasticManager:
                     observability.inc("elastic.restarts")
                 if restarts > self.max_restarts:
                     raise
+                try:
+                    # drain the writer before resuming: last_step() must
+                    # not race an in-flight marker commit
+                    self.flush()
+                except Exception:   # noqa: BLE001 — the torn save never
+                    pass            # marked latest.json; resume is older
                 # resume loop from last checkpoint
 
     def close(self):
+        if self._checkpointer is not None:
+            try:
+                self._checkpointer.flush()
+            except Exception as e:  # noqa: BLE001 — teardown path
+                import sys
+                print(f"WARNING: async checkpoint flush failed in "
+                      f"ElasticManager.close: {e!r}", file=sys.stderr)
         for s, h in self._prev_handlers.items():
             try:
                 signal.signal(s, h)
@@ -389,7 +442,7 @@ class StoreHeartbeat:
 def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
                   load_fn, checkpoint_interval=100, max_restarts=3,
                   signals=(signal.SIGTERM,), watchdog_abort=True,
-                  data_factory=None):
+                  data_factory=None, checkpointer=None):
     """The self-healing training loop: ties the islands — watchdog
     expiry -> abort, preemption signal -> checkpoint, failure -> elastic
     restart from the newest COMPLETE checkpoint — into one supervisor
@@ -413,6 +466,20 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
       save_fn(step, path)    writes a checkpoint at step boundary `step`
                              (steps [0, step) are done) into `path`
       load_fn(path)          restores training state from `path`
+      checkpointer           (optional) the AsyncCheckpointer save_fn
+                             writes through. The loop then owns its
+                             lifecycle at every durability boundary:
+                             flush() before each resume scan (a scan
+                             racing the in-flight writer would
+                             quarantine the half-written directory),
+                             before the watchdog's discard of a
+                             suspect save, and before returning at
+                             normal exit. A writer failure surfacing
+                             at a flush counts as a restartable
+                             attempt fault: the torn directory carries
+                             no completion marker, so the scan below
+                             falls back past it — PR 1's recovery
+                             invariant, now async.
 
     Checkpoints land in ``checkpoint_dir/step_{step:08d}``; resume
     always goes through checkpoint.newest_complete_checkpoint, so a
@@ -459,6 +526,10 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
         return path
 
     try:
+        if checkpointer is not None:
+            # leftovers of a previous run on the same checkpointer must
+            # settle before the first scan below can be trusted
+            checkpointer.flush()
         # always have a restore point: without the step-0 checkpoint, a
         # failure in the FIRST chunk would restart train_fn(0, ...) on
         # top of the failed attempt's partially-mutated in-memory state
@@ -471,6 +542,24 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
         # no-checkpoint restart unrecoverable
         dirty = False
         while True:
+            if checkpointer is not None:
+                try:
+                    # an attempt may end (preemption, fault) with a save
+                    # still in flight; it must be durable — or dead —
+                    # before the scan, which would otherwise quarantine
+                    # the half-written directory out from under the
+                    # writer
+                    checkpointer.flush()
+                except Exception:   # noqa: BLE001 — torn async save
+                    # no completion marker landed, so the scan falls
+                    # back past the dead save; count it like any other
+                    # attempt fault so a writer failing every time
+                    # cannot loop forever
+                    restarts += 1
+                    if observability.ENABLED:
+                        observability.inc("elastic.restarts")
+                    if restarts > max_restarts:
+                        raise
             with ckpt_mod._digest_memo_scope():
                 # scan + load verify the same files; hash each once
                 newest = ckpt_mod.newest_complete_checkpoint(
@@ -545,10 +634,22 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
                     saved = _save(step)
                     if watchdog_abort and \
                             watchdog.expired_count() > wd_base:
+                        if checkpointer is not None:
+                            try:
+                                # never rmtree under a live writer
+                                checkpointer.flush()
+                            except Exception:   # noqa: BLE001
+                                pass            # discarding it anyway
                         shutil.rmtree(saved, ignore_errors=True)
                         raise watchdog.CommTimeoutError(
                             "watchdog expiry while checkpointing: "
                             + watchdog.last_expired())
+                if checkpointer is not None:
+                    # normal exit: the final save must be durable before
+                    # completion is reported (a failure here is an
+                    # attempt fault like any other — the except below
+                    # restarts from the last complete checkpoint)
+                    checkpointer.flush()
                 return {"steps": total_steps, "restarts": restarts,
                         "resumed_from": resumed_from}
             except _Preempted:

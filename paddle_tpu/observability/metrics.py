@@ -67,6 +67,20 @@ METRICS = {
     "ckpt.fallbacks": ("counter",
                        "loads that fell back past a corrupt newest "
                        "checkpoint"),
+    "checkpoint.async.pending": ("gauge",
+                                 "async saves snapshotted but not yet "
+                                 "durably committed (queued + in "
+                                 "flight)"),
+    "checkpoint.snapshot.seconds": ("histogram",
+                                    "device->host snapshot time — the "
+                                    "only save stall the TRAINING "
+                                    "thread pays on the async path",
+                                    DEFAULT_BUCKETS_S),
+    "checkpoint.write.seconds": ("histogram",
+                                 "background writer time per async "
+                                 "save (hash + files + barrier + "
+                                 "marker), overlapped with training",
+                                 DEFAULT_BUCKETS_S),
     # -- elastic ------------------------------------------------------
     "elastic.restarts": ("counter",
                          "elastic restarts (in-process resume loops + "

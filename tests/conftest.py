@@ -61,3 +61,32 @@ def _seed_everything():
     paddle_tpu.seed(1234)
     np.random.seed(1234)
     yield
+
+
+@pytest.fixture
+def no_leaked_threads():
+    """Fail any test that leaks a NON-daemon thread. The repo now has
+    four thread-owning subsystems (async checkpoint writer, device
+    prefetcher, serving batcher/server, paged engine driver); a
+    non-daemon leak hangs interpreter exit and is invisible in a
+    passing test. Daemon workers are exempt: their contract is join-on-
+    close but die-with-the-process as the backstop. Opt in per module:
+
+        pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+    """
+    import threading
+    import time
+
+    before = set(threading.enumerate())
+    yield
+    deadline = time.time() + 5.0
+    while True:
+        leaked = [t for t in threading.enumerate()
+                  if t not in before and t.is_alive() and not t.daemon]
+        if not leaked:
+            return
+        if time.time() > deadline:
+            raise AssertionError(
+                "non-daemon thread(s) outlived the test (missing "
+                f"close()/stop()/join?): {[t.name for t in leaked]}")
+        time.sleep(0.05)

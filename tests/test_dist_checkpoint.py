@@ -10,6 +10,9 @@ import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import checkpoint as ckpt
 
+# checkpoint writers own threads (legacy async_save + AsyncCheckpointer)
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
 
 def _mesh2d():
     return dist.ProcessMesh(

@@ -25,6 +25,9 @@ from paddle_tpu.distributed.mesh import init_mesh
 from paddle_tpu.io.prefetch import DevicePrefetcher, prefetch_to_device
 from paddle_tpu.parallel import ShardingPlan, Trainer, TrainStepConfig
 
+# the prefetcher owns a worker thread per instance
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
 
 class _Net(nn.Layer):
     def __init__(self):

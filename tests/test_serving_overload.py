@@ -42,6 +42,9 @@ from paddle_tpu.inference.overload import (AdmissionController,
 from paddle_tpu.inference.serving import (DynamicBatcher, OversizedBatch,
                                           PredictorServer)
 
+# servers and batchers own threads; stop() must join them
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
 
 # -- helpers ----------------------------------------------------------------
 
