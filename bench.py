@@ -38,6 +38,64 @@ def _peak_flops(dev) -> float:
     return 459e12  # assume v5p (the north-star part)
 
 
+def _decode_bench(on_tpu):
+    """Serving decode microbench: aggregate tok/s and KV bytes/slot at
+    a fixed slot count, for the jnp attend path, the Pallas
+    paged-decode kernel (interpret mode off-TPU — a parity/coverage
+    config there, a perf config on real chips), and the kernel with
+    int8 KV pools. Returns a list of row dicts for the BENCH json."""
+    import time
+
+    import paddle_tpu
+    from paddle_tpu.inference.paged import PagedKVEngine
+    from paddle_tpu.models.llama import LlamaForCausalLM, LlamaConfig, \
+        tiny_llama_config
+
+    paddle_tpu.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=4, max_position_embeddings=1024,
+            rope_theta=10000.0, seq_length=1024)
+        # page_size 32: the int8 row's (page_size, d) k/v block must
+        # tile the int8 Mosaic sublane minimum of 32 when compiled
+        slots, page_size, num_pages, max_new = 8, 32, 256, 64
+    else:
+        cfg = tiny_llama_config(num_hidden_layers=2, vocab_size=128,
+                                hidden_size=64, intermediate_size=128,
+                                num_attention_heads=4,
+                                num_key_value_heads=2)
+        slots, page_size, num_pages, max_new = 4, 8, 64, 16
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(1, cfg.vocab_size, 12))
+               for _ in range(slots)]
+
+    rows = []
+    for label, kernel, kv_dtype in (
+            ("jnp", "jnp", "bf16"),
+            ("pallas", "pallas", "bf16"),
+            ("pallas+int8", "pallas", "int8")):
+        eng = PagedKVEngine(
+            model, max_slots=slots, page_size=page_size,
+            num_pages=num_pages, steps_per_tick=4, kernel=kernel,
+            kv_dtype=kv_dtype)
+        eng.generate(prompts, max_new_tokens=2)      # compile warmup
+        base_tokens = eng.stats["tokens_out"]
+        t0 = time.perf_counter()
+        eng.generate(prompts, max_new_tokens=max_new)
+        dt = time.perf_counter() - t0
+        rows.append({
+            "path": label,
+            "tokens_per_sec": round(
+                (eng.stats["tokens_out"] - base_tokens) / dt, 2),
+            "kv_bytes_per_slot": eng.kv_bytes_per_slot(),
+            "slots": slots,
+        })
+    return rows
+
+
 def main():
     import jax
     import paddle_tpu
@@ -132,6 +190,13 @@ def main():
     # this chip against the v5p peak (459 TF/s) via a lookup-order bug
     mfu_v5p_ref = tokens_per_sec * ftok / 459e12 if on_tpu else 0.0
 
+    # serving decode microbench (ISSUE 6): the perf trajectory now
+    # carries aggregate decode tok/s and KV bytes/slot per attend path
+    try:
+        decode = _decode_bench(on_tpu)
+    except Exception as e:           # noqa: BLE001 — never sink the
+        decode = {"error": f"{type(e).__name__}: {e}"}  # train metric
+
     print(json.dumps({
         "metric": "llama1b_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
@@ -141,7 +206,8 @@ def main():
                   "mfu_v5p_ref": round(mfu_v5p_ref, 4),
                   "loss": round(float(loss), 4),
                   "device": getattr(dev, "device_kind", str(dev)),
-                  "batch": batch, "seq": seq, "steps": steps},
+                  "batch": batch, "seq": seq, "steps": steps,
+                  "decode": decode},
     }))
 
 

@@ -7,6 +7,10 @@
 the 0.4.x line (10 test files failed collection on 0.4.37). All
 paddle_tpu code imports `shard_map` from HERE; tools/check_jax_compat.py
 fails CI when a bare import sneaks back in.
+
+Pallas TPU compiler params renamed too: `pltpu.TPUCompilerParams`
+(0.4.x) became `pltpu.CompilerParams` (newer lines). Kernels build
+theirs through `tpu_compiler_params(...)` here.
 """
 from __future__ import annotations
 
@@ -14,7 +18,16 @@ import inspect
 
 import jax
 
-__all__ = ["shard_map", "axis_size"]
+__all__ = ["shard_map", "axis_size", "tpu_compiler_params"]
+
+
+def tpu_compiler_params(**kwargs):
+    """`pltpu.CompilerParams(**kwargs)` under whichever name the
+    installed jax line exports (`TPUCompilerParams` on 0.4.x)."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
 
 try:                                   # jax >= 0.6: promoted to top level
     from jax import shard_map as _shard_map

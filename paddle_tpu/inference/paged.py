@@ -41,9 +41,20 @@ Models opt in exactly like dense KV-cache decode (models/generation.py)
 but receive a `PagedState` as `cache_index` and per-layer `(k_pool,
 v_pool)` pairs as `caches`; their attention layer calls
 `paged_attention_update` (LlamaAttention does — models/llama.py).
+
+Decode hot path (ISSUE 6): the tick's attention-over-pages can ride
+the Pallas paged-decode kernel (kernels/paged_attention.py — block
+tables as scalar-prefetch indices, GQA head fold, online softmax;
+`PagedKVEngine(kernel=...)`), and KV pools can be stored int8 with
+per-page-per-head f32 scales quantized at scatter time and
+dequantized inside the kernel's K-loop (`kv_dtype="int8"` — about
+half the KV HBM per slot vs bf16). The jnp gather/softmax path
+remains the fallback for prefill, speculative verify, and
+kernel-incompatible geometries.
 """
 from __future__ import annotations
 
+import contextlib
 import math
 import queue
 import threading
@@ -56,11 +67,13 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu import observability
 from paddle_tpu.inference.overload import (DeadlineExceeded,
                                            EngineOverloaded,
                                            OverloadError)
 
-__all__ = ["PagedState", "paged_attention_update", "PagedKVEngine"]
+__all__ = ["PagedState", "paged_attention_update", "decode_kernel_scope",
+           "PagedKVEngine"]
 
 
 class PagedState(NamedTuple):
@@ -86,28 +99,49 @@ def _val(x):
     return x._value if isinstance(x, Tensor) else jnp.asarray(x)
 
 
-def paged_attention_update(q, k, v, cache, state: PagedState):
-    """Write this call's k/v into the slot's pages, then attend over the
-    slot's whole paged window. One code path serves BOTH phases of the
-    reference contract (block_multi_head_attention_kernel.cu's prefill
-    and decode): prefill is s=prompt tokens at lens=0, decode is s=1.
+# -- decode-kernel selection (trace-time) -----------------------------------
+# The engine's compiled programs pick the attend path at TRACE time via
+# this thread-local scope: PagedKVEngine wraps its model calls in
+# decode_kernel_scope(engine.decode_kernel, ...), and
+# paged_attention_update reads the scope while tracing. Direct callers
+# of the public op default to the jnp path (unchanged behavior).
+_decode_cfg = threading.local()
 
-    q: (b, s, hq, d), k/v: (b, s, hk, d) — already position-encoded.
-    cache: (k_pool, v_pool), each (num_pages, hk, page_size, d).
-    Returns (out (b, s, hq*d), (k_pool', v_pool')).
 
-    All index math is traced (block tables / lens are device data), so
-    this runs under jit — unlike the eager op's host-numpy bookkeeping.
+@contextlib.contextmanager
+def decode_kernel_scope(kind="jnp", interpret=False):
+    """Select the decode attend path ("pallas" | "jnp") for
+    paged_attention_update calls traced inside this scope. `interpret`
+    runs the Pallas kernel in interpreter mode (CPU/tier-1)."""
+    prev = getattr(_decode_cfg, "cfg", None)
+    _decode_cfg.cfg = (kind, bool(interpret))
+    try:
+        yield
+    finally:
+        _decode_cfg.cfg = prev
+
+
+def _scatter_kv(kp, vp, k, v, state: PagedState, k_scale=None,
+                v_scale=None):
+    """Scatter this call's (b, s, hk, d) k/v into their pages.
+
+    Plain pools: one vectorized scatter per pool. int8 pools (k_scale/
+    v_scale present, (num_pages, hk) f32): quantize AT SCATTER TIME —
+    per-page-per-head symmetric scales grow monotonically (scatter-max
+    of |token|/127 into the touched pages), previously written int8
+    content of a touched page is RESCALED in one gather->round->scatter
+    pass (old/new scale ratio), and the new tokens quantize with the
+    final scale. The f32/bf16 pool never exists in HBM; only the
+    touched pages (<= b*s of them) move.
+
+    Returns (kp, vp, k_scale, v_scale) — scales None when unquantized.
     """
-    q, k, v = _val(q), _val(k), _val(v)
-    kp, vp = _val(cache[0]), _val(cache[1])
     bt, lens, n_valid = (_val(state.block_tables),
                          _val(state.lens), _val(state.n_valid))
-    b, s, hq, d = q.shape
-    hk = k.shape[2]
+    b, s, hk, d = k.shape
     page_size = kp.shape[2]
+    num_pages = kp.shape[0]
 
-    # -- scatter new tokens into their pages --------------------------
     pos = lens[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # (b,s)
     valid = jnp.arange(s, dtype=jnp.int32)[None, :] < n_valid[:, None]
     logical = pos // page_size
@@ -117,32 +151,144 @@ def paged_attention_update(q, k, v, cache, state: PagedState):
     # routing them to page 0 corrupted callers whose block tables
     # legitimately allocate page 0 — the public op has no trash-page
     # reservation; the engine's page-0 convention is gather-only)
-    phys = jnp.where(valid, phys, kp.shape[0])
+    phys = jnp.where(valid, phys, num_pages)
     off = pos % page_size
-    flat = lambda a: a.reshape(b * s)                        # noqa: E731
-    kp = kp.at[flat(phys), :, flat(off), :].set(
-        k.reshape(b * s, hk, d).astype(kp.dtype), mode="drop")
-    vp = vp.at[flat(phys), :, flat(off), :].set(
-        v.reshape(b * s, hk, d).astype(vp.dtype), mode="drop")
+    phys_f = phys.reshape(b * s)
+    off_f = off.reshape(b * s)
 
-    # -- gather each slot's window and attend -------------------------
+    if k_scale is None:
+        kp = kp.at[phys_f, :, off_f, :].set(
+            k.reshape(b * s, hk, d).astype(kp.dtype), mode="drop")
+        vp = vp.at[phys_f, :, off_f, :].set(
+            v.reshape(b * s, hk, d).astype(vp.dtype), mode="drop")
+        return kp, vp, None, None
+
+    def quant_scatter(pool, scale, toks):
+        toks = toks.reshape(b * s, hk, d).astype(jnp.float32)
+        cand = jnp.max(jnp.abs(toks), axis=-1) / 127.0       # (b*s, hk)
+        new_scale = scale.at[phys_f].max(cand, mode="drop")
+        idx = jnp.minimum(phys_f, num_pages - 1)  # clamp gathers only;
+        #                          invalid rows' writes still DROP below
+        old_g = scale[idx]                                   # (b*s, hk)
+        new_g = new_scale[idx]
+        ratio = jnp.where(new_g > 0,
+                          old_g / jnp.maximum(new_g, 1e-30), 0.0)
+        pages = pool[idx].astype(jnp.float32) \
+            * ratio[:, :, None, None]                # (b*s, hk, ps, d)
+        pages = jnp.clip(jnp.round(pages), -127, 127).astype(pool.dtype)
+        pool = pool.at[phys_f].set(pages, mode="drop")
+        qtok = jnp.clip(
+            jnp.round(toks / jnp.maximum(new_g, 1e-30)[:, :, None]),
+            -127, 127).astype(pool.dtype)
+        pool = pool.at[phys_f, :, off_f, :].set(qtok, mode="drop")
+        return pool, new_scale
+
+    kp, k_scale = quant_scatter(kp, k_scale, k)
+    vp, v_scale = quant_scatter(vp, v_scale, v)
+    return kp, vp, k_scale, v_scale
+
+
+def _attend_pages(q, kp, vp, state: PagedState, k_scale=None,
+                  v_scale=None):
+    """jnp fallback attend: gather each slot's page window and run a
+    dense masked softmax in f32. GQA folds query heads into a head-
+    group axis (reshape + einsum) instead of jnp.repeat-ing K/V —
+    the gathered window is never materialized hq/hk times.
+
+    q: (b, s, hq, d). Returns (b, s, hq*d) in q.dtype.
+    """
+    bt, lens = _val(state.block_tables), _val(state.lens)
+    b, s, hq, d = q.shape
+    hk = kp.shape[1]
+    pos = lens[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+
     # window column c IS logical position c (page j holds positions
     # [j*page_size, (j+1)*page_size)), so the causal bound is c <= pos.
     ks = jnp.moveaxis(kp[bt], 2, 1).reshape(b, hk, -1, d)    # (b,hk,L,d)
     vs = jnp.moveaxis(vp[bt], 2, 1).reshape(b, hk, -1, d)
     L = ks.shape[2]
-    if hq != hk:
-        ks = jnp.repeat(ks, hq // hk, axis=1)
-        vs = jnp.repeat(vs, hq // hk, axis=1)
+    ks = ks.astype(jnp.float32)
+    vs = vs.astype(jnp.float32)
+    if k_scale is not None:
+        # dequantize the gathered window: per-page-per-head scales
+        # broadcast over (page_size, d) — (b, mp, hk) -> (b, hk, L, 1)
+        ksg = jnp.repeat(jnp.swapaxes(k_scale[bt], 1, 2),
+                         kp.shape[2], axis=2)[..., None]
+        vsg = jnp.repeat(jnp.swapaxes(v_scale[bt], 1, 2),
+                         vp.shape[2], axis=2)[..., None]
+        ks = ks * ksg
+        vs = vs * vsg
     qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)           # (b,hq,s,d)
-    scores = jnp.einsum("bhsd,bhcd->bhsc", qt,
-                        ks.astype(jnp.float32)) / math.sqrt(d)
     col = jnp.arange(L, dtype=jnp.int32)[None, None, None, :]
     mask = col <= pos[:, None, :, None]                      # (b,1,s,L)
-    scores = jnp.where(mask, scores, -1e9)
-    p = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhsc,bhcd->bhsd", p, vs.astype(jnp.float32))
-    out = jnp.swapaxes(out, 1, 2).reshape(b, s, hq * d).astype(q.dtype)
+    if hq != hk:
+        g = hq // hk
+        qg = qt.reshape(b, hk, g, s, d)
+        scores = jnp.einsum("bhgsd,bhcd->bhgsc", qg,
+                            ks) / math.sqrt(d)
+        scores = jnp.where(mask[:, :, None], scores, -1e9)
+        p = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhgsc,bhcd->bhgsd", p, vs)
+        out = out.reshape(b, hq, s, d)
+    else:
+        scores = jnp.einsum("bhsd,bhcd->bhsc", qt, ks) / math.sqrt(d)
+        scores = jnp.where(mask, scores, -1e9)
+        p = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhsc,bhcd->bhsd", p, vs)
+    return jnp.swapaxes(out, 1, 2).reshape(b, s, hq * d).astype(q.dtype)
+
+
+def paged_attention_update(q, k, v, cache, state: PagedState):
+    """Write this call's k/v into the slot's pages, then attend over the
+    slot's whole paged window. One code path serves BOTH phases of the
+    reference contract (block_multi_head_attention_kernel.cu's prefill
+    and decode): prefill is s=prompt tokens at lens=0, decode is s=1.
+
+    q: (b, s, hq, d), k/v: (b, s, hk, d) — already position-encoded.
+    cache: (k_pool, v_pool), each (num_pages, hk, page_size, d) — or,
+    for int8 KV quantization, (k_pool, v_pool, k_scale, v_scale) with
+    int8 pools and (num_pages, hk) f32 per-page-per-head scales.
+    Returns (out (b, s, hq*d), new cache of the SAME arity).
+
+    Decode calls (s == 1) traced inside
+    `decode_kernel_scope("pallas")` take the Pallas paged-decode
+    kernel (kernels/paged_attention.py); everything else — prefill,
+    speculative verify, direct callers — runs the jnp gather/softmax
+    path. All index math is traced (block tables / lens are device
+    data), so this runs under jit — unlike the eager op's host-numpy
+    bookkeeping.
+    """
+    q, k, v = _val(q), _val(k), _val(v)
+    quantized = len(cache) == 4
+    kp, vp = _val(cache[0]), _val(cache[1])
+    k_scale = _val(cache[2]) if quantized else None
+    v_scale = _val(cache[3]) if quantized else None
+    if kp.dtype == jnp.int8 and not quantized:
+        raise ValueError(
+            "int8 k/v pools need a 4-tuple cache (k_pool, v_pool, "
+            "k_scale, v_scale); got a 2-tuple — pass the per-page "
+            "scales (see PagedKVEngine(kv_dtype='int8'))")
+    b, s, hq, d = q.shape
+
+    kp, vp, k_scale, v_scale = _scatter_kv(kp, vp, k, v, state,
+                                           k_scale, v_scale)
+
+    kind, interpret = getattr(_decode_cfg, "cfg", None) or ("jnp", False)
+    if kind == "pallas" and s == 1:
+        from paddle_tpu.kernels.paged_attention import \
+            paged_decode_attention
+        # the query position is lens (this token's k/v just landed
+        # there); the kernel masks cols <= lens and skips pages past it
+        out = paged_decode_attention(
+            q[:, 0], kp, vp, _val(state.block_tables),
+            _val(state.lens), k_scale=k_scale, v_scale=v_scale,
+            interpret=interpret)
+        out = out[:, None].reshape(b, s, hq * d).astype(q.dtype)
+    else:
+        out = _attend_pages(q, kp, vp, state, k_scale, v_scale)
+    if quantized:
+        return Tensor(out), (Tensor(kp), Tensor(vp),
+                             Tensor(k_scale), Tensor(v_scale))
     return Tensor(out), (Tensor(kp), Tensor(vp))
 
 
@@ -159,7 +305,11 @@ def _process_logits_rowwise(x, temp, topk, topp):
     use_k = (topk > 0) & (topk < v)
     kth = jnp.where(use_k[:, None], kth, -jnp.inf)
     x = jnp.where(x < kth, -1e9, x)
-    sp = jnp.sort(x, axis=-1)[:, ::-1]
+    # ONE sort serves both filters: top-k masking thresholds on VALUE,
+    # so it commutes with sorting — sort(mask(x)) == mask(sort(x)) —
+    # and the top-p pass reuses `sd` with the same threshold instead of
+    # re-sorting the masked logits (was two full vocab sorts per tick)
+    sp = jnp.where(sd < kth, -1e9, sd)
     probs = jax.nn.softmax(sp, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     keep = cum - probs < topp[:, None]
@@ -306,12 +456,27 @@ class PagedKVEngine:
         it so `submit` sheds with EngineOverloaded — a typed, retryable
         rejection — instead of letting queue depth (and every queued
         request's latency) grow without limit.
+    kernel: decode attend path. "pallas" forces the Pallas paged-decode
+        kernel (kernels/paged_attention.py; interpreter mode off-TPU) —
+        raises a descriptive ValueError naming misaligned dims when the
+        geometry can't take it (the ring_attention_local(use_flash=True)
+        contract). "jnp" forces the gather/softmax fallback. None
+        (default) auto-selects: the kernel on TPU when shapes allow,
+        the jnp path otherwise (interpret mode is for parity testing,
+        not speed, so auto never picks it on CPU).
+    kv_dtype: KV pool storage. None keeps today's behavior (`dtype`, by
+        default the model parameter dtype); "bf16" forces bf16 pools;
+        "int8" stores pools as int8 with per-page-per-head f32 scales,
+        quantized at scatter time and dequantized inside the attend —
+        about half the KV HBM per slot vs bf16 (kv_bytes_per_slot()
+        reports the exact figure from the real buffer dtypes).
     """
 
     def __init__(self, model, *, max_slots=4, page_size=16, num_pages=64,
                  max_pages_per_slot=None, steps_per_tick=4, seed=0,
                  prefill_chunk=None, draft_model=None, spec_tokens=4,
-                 dtype=None, max_pending=None):
+                 dtype=None, max_pending=None, kernel=None,
+                 kv_dtype=None):
         cfg = model.config
         self.model = model
         self.max_slots = int(max_slots)
@@ -336,9 +501,49 @@ class PagedKVEngine:
         if dtype is None:
             p = next(iter(model.parameters()))
             dtype = str(p.dtype)
-        shape = (self.num_pages, n_kv, self.page_size, hd)
-        self.pools = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-                      for _ in range(cfg.num_hidden_layers)]
+        if kv_dtype not in (None, "bf16", "int8"):
+            raise ValueError(f"kv_dtype must be None, 'bf16' or 'int8' "
+                             f"(got {kv_dtype!r})")
+        self.kv_dtype = kv_dtype
+        pool_dtype = {"bf16": "bfloat16", "int8": "int8",
+                      None: dtype}[kv_dtype]
+        self._cache_arity = 4 if kv_dtype == "int8" else 2
+
+        def make_pools(n_heads, head_dim, n_layers):
+            shape = (self.num_pages, n_heads, self.page_size, head_dim)
+            sshape = (self.num_pages, n_heads)
+            if kv_dtype == "int8":
+                return [(jnp.zeros(shape, "int8"),
+                         jnp.zeros(shape, "int8"),
+                         jnp.zeros(sshape, jnp.float32),
+                         jnp.zeros(sshape, jnp.float32))
+                        for _ in range(n_layers)]
+            return [(jnp.zeros(shape, pool_dtype),
+                     jnp.zeros(shape, pool_dtype))
+                    for _ in range(n_layers)]
+
+        self.pools = make_pools(n_kv, hd, cfg.num_hidden_layers)
+        # decode attend path (class doc): resolve once, fail fast on a
+        # forced-but-impossible geometry with the misaligned dims named
+        from paddle_tpu.kernels import paged_attention as _pk
+        on_tpu = jax.default_backend() == "tpu"
+        if kernel not in (None, "pallas", "jnp"):
+            raise ValueError(f"kernel must be None, 'pallas' or 'jnp' "
+                             f"(got {kernel!r})")
+        self._kernel_interpret = not on_tpu
+        if kernel == "pallas":
+            _pk.check_decode_shapes(cfg.num_attention_heads, n_kv, hd,
+                                    self.page_size,
+                                    interpret=self._kernel_interpret,
+                                    kv_dtype=pool_dtype)
+            self.decode_kernel = "pallas"
+        elif kernel is None and on_tpu and \
+                not _pk.decode_shape_problems(cfg.num_attention_heads,
+                                              n_kv, hd, self.page_size,
+                                              kv_dtype=pool_dtype):
+            self.decode_kernel = "pallas"
+        else:
+            self.decode_kernel = "jnp"
         # speculative decoding (greedy-lossless): a draft model rides
         # its OWN page pools over the SAME block tables — paged caches
         # make rejection rollback free (lens simply doesn't advance;
@@ -356,10 +561,20 @@ class PagedKVEngine:
                 or dcfg.num_attention_heads
             dhd = getattr(dcfg, "head_dim", None) \
                 or dcfg.hidden_size // dcfg.num_attention_heads
-            dshape = (self.num_pages, dn_kv, self.page_size, dhd)
-            self.draft_pools = [(jnp.zeros(dshape, dtype),
-                                 jnp.zeros(dshape, dtype))
-                                for _ in range(dcfg.num_hidden_layers)]
+            if self.decode_kernel == "pallas":
+                if kernel == "pallas":      # forced: fail fast, named
+                    _pk.check_decode_shapes(
+                        dcfg.num_attention_heads, dn_kv, dhd,
+                        self.page_size,
+                        interpret=self._kernel_interpret,
+                        kv_dtype=pool_dtype)
+                elif _pk.decode_shape_problems(
+                        dcfg.num_attention_heads, dn_kv, dhd,
+                        self.page_size,
+                        kv_dtype=pool_dtype):  # auto: draft can't ride
+                    self.decode_kernel = "jnp"
+            self.draft_pools = make_pools(dn_kv, dhd,
+                                          dcfg.num_hidden_layers)
         self._free = list(range(self.num_pages - 1, 0, -1))  # 0 = trash
         # pages promised to admitted slots but not yet popped from the
         # free list; admission headroom = len(_free) - _reserved_unalloc
@@ -388,6 +603,19 @@ class PagedKVEngine:
         # ticker thread is the only chip user
         self.concurrent_safe = True
 
+    def kv_bytes_per_slot(self):
+        """HBM bytes one fully-grown slot pins across every layer's KV
+        pools (int8 scale planes and draft-model pools included),
+        computed from the REAL buffer dtypes — so `kv_dtype` is honored
+        end-to-end instead of assuming f32/bf16 element sizes."""
+        per_page = 0
+        for pools in (self.pools, self.draft_pools or []):
+            for grp in pools:
+                for arr in grp:
+                    per_page += (arr.size * arr.dtype.itemsize
+                                 // self.num_pages)
+        return per_page * self.max_pages_per_slot
+
     def export_metrics(self, registry):
         """Publish the engine's telemetry counters into a metrics
         registry as scrape-time gauges (PredictorServer's GET /metrics
@@ -395,6 +623,8 @@ class PagedKVEngine:
         because they are absolute values sampled at scrape time, not
         increments."""
         s = self.stats
+        registry.set_gauge("inference.kv.bytes_per_slot",
+                           self.kv_bytes_per_slot())
         registry.set_gauge("engine.ticks", s["ticks"])
         registry.set_gauge("engine.prefills", s["prefills"])
         registry.set_gauge("engine.tokens_out", s["tokens_out"])
@@ -595,8 +825,7 @@ class PagedKVEngine:
             last, flat = fn(jnp.asarray(ids), jnp.asarray(lens),
                             jnp.asarray(nv), jnp.asarray(bt),
                             [a for kv in self.pools for a in kv])
-            self.pools = [(flat[2 * i], flat[2 * i + 1])
-                          for i in range(len(self.pools))]
+            self.pools = self._unflat_pools(flat)
             last_np = np.asarray(last)
             for r in range(len(grp)):
                 if nv[r] > 0 and done[r] + nv[r] >= plens[r]:
@@ -631,7 +860,7 @@ class PagedKVEngine:
 
         import jax as _jax
         donate = () if _jax.default_backend() == "cpu" else (4,)
-        fn = jax.jit(run, donate_argnums=donate)
+        fn = jax.jit(self._scoped(run), donate_argnums=donate)
         self._programs[key] = fn
         return fn
 
@@ -657,15 +886,13 @@ class PagedKVEngine:
         last_logits, flat = fn(
             jnp.asarray(ids), jnp.asarray(nv), jnp.asarray(bt),
             [a for kv in self.pools for a in kv])
-        self.pools = [(flat[2 * i], flat[2 * i + 1])
-                      for i in range(len(self.pools))]
+        self.pools = self._unflat_pools(flat)
         if self.draft_model is not None:
             dfn = self._draft_prefill_fn(ppad, bw)
             dflat = dfn(jnp.asarray(ids), jnp.asarray(nv),
                         jnp.asarray(bt),
                         [a for kv in self.draft_pools for a in kv])
-            self.draft_pools = [(dflat[2 * i], dflat[2 * i + 1])
-                                for i in range(len(self.draft_pools))]
+            self.draft_pools = self._unflat_pools(dflat)
         logits_np = np.asarray(last_logits)              # (bw, vocab)
         self.stats["prefills"] += len(grp)
         self.stats["prefill_s"] += _time.perf_counter() - t0
@@ -699,6 +926,23 @@ class PagedKVEngine:
 
     def _retire(self, slot_idx):
         slot = self._slots[slot_idx]
+        if self._cache_arity == 4 and slot.pages:
+            # int8 KV: reset the freed pages' quant scales. Scales only
+            # ever GROW at scatter time (scatter-max), so without this a
+            # recycled page would quantize its next tenant's k/v with
+            # the largest magnitude any previous tenant ever wrote —
+            # precision would ratchet away over server lifetime. (Stale
+            # page CONTENT needs no reset: a new tenant overwrites every
+            # position it can attend to.)
+            idx = jnp.asarray(slot.pages, jnp.int32)
+            self.pools = [(kp, vp, ks.at[idx].set(0.0),
+                           vs.at[idx].set(0.0))
+                          for kp, vp, ks, vs in self.pools]
+            if self.draft_pools is not None:
+                self.draft_pools = [(kp, vp, ks.at[idx].set(0.0),
+                                     vs.at[idx].set(0.0))
+                                    for kp, vp, ks, vs in
+                                    self.draft_pools]
         self._free.extend(reversed(slot.pages))
         # release the unallocated remainder of this slot's reservation
         self._reserved_unalloc -= slot.req.pages_needed - len(slot.pages)
@@ -796,13 +1040,15 @@ class PagedKVEngine:
                      jnp.asarray(topp), jnp.asarray(wants)]
         toks_out, lens_f, flat = fn(*args,
                                     [a for kv in self.pools for a in kv])
-        self.pools = [(flat[2 * i], flat[2 * i + 1])
-                      for i in range(len(self.pools))]
+        self.pools = self._unflat_pools(flat)
         toks_np = np.asarray(toks_out)          # (b, n)
         lens_np = np.asarray(lens_f)
         self._tick_count += 1
         self.stats["ticks"] += 1
         self.stats["tick_s"] += _time.perf_counter() - t0
+        if observability.ENABLED:
+            observability.inc("inference.decode.kernel",
+                              path=self.decode_kernel)
         counts = np.minimum(limit, n)
         self._accept_tick(live, toks_np, counts, eos, lens_np)
         return True
@@ -829,10 +1075,8 @@ class PagedKVEngine:
             jnp.asarray(a["wants"]),
             [x for kv in self.pools for x in kv],
             [x for kv in self.draft_pools for x in kv])
-        self.pools = [(tflat[2 * i], tflat[2 * i + 1])
-                      for i in range(len(self.pools))]
-        self.draft_pools = [(dflat[2 * i], dflat[2 * i + 1])
-                            for i in range(len(self.draft_pools))]
+        self.pools = self._unflat_pools(tflat)
+        self.draft_pools = self._unflat_pools(dflat)
         out_np = np.asarray(out)
         emit_np = np.asarray(n_emit)
         lens_np = np.asarray(lens_f)
@@ -845,6 +1089,9 @@ class PagedKVEngine:
             self.stats.get("spec_accepted", 0)
             + int(sum(emit_np[i] - 1 for i in live)))
         self.stats["tick_s"] += _time.perf_counter() - t0
+        if observability.ENABLED:
+            observability.inc("inference.decode.kernel",
+                              path=self.decode_kernel)
         counts = np.minimum(emit_np, a["limit"])
         self._accept_tick(live, out_np, counts, a["eos"], lens_np)
         return True
@@ -989,8 +1236,30 @@ class PagedKVEngine:
 
     # -- compiled programs ----------------------------------------------
     def _layer_caches(self, flat):
-        return [(Tensor(flat[2 * i]), Tensor(flat[2 * i + 1]))
-                for i in range(len(flat) // 2)]
+        """Flat buffer list -> per-layer cache tuples ((k, v) pools, or
+        (k, v, k_scale, v_scale) for int8 KV)."""
+        n = self._cache_arity
+        return [tuple(Tensor(flat[n * i + j]) for j in range(n))
+                for i in range(len(flat) // n)]
+
+    def _unflat_pools(self, flat):
+        """Inverse of `[a for grp in pools for a in grp]`."""
+        n = self._cache_arity
+        return [tuple(flat[n * i + j] for j in range(n))
+                for i in range(len(flat) // n)]
+
+    def _scoped(self, fn):
+        """Trace `fn` under this engine's decode_kernel_scope so every
+        paged_attention_update it reaches (including inside scan
+        bodies) picks the configured attend path at trace time."""
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args):
+            with decode_kernel_scope(self.decode_kernel,
+                                     self._kernel_interpret):
+                return fn(*args)
+        return wrapped
 
     def _prefill_fn(self, ppad, bw=1):
         key = ("prefill", ppad, bw)
@@ -1014,7 +1283,7 @@ class PagedKVEngine:
 
         import jax as _jax
         donate = () if _jax.default_backend() == "cpu" else (3,)
-        fn = jax.jit(run, donate_argnums=donate)
+        fn = jax.jit(self._scoped(run), donate_argnums=donate)
         self._programs[key] = fn
         return fn
 
@@ -1036,7 +1305,7 @@ class PagedKVEngine:
 
         import jax as _jax
         donate = () if _jax.default_backend() == "cpu" else (3,)
-        fn = jax.jit(run, donate_argnums=donate)
+        fn = jax.jit(self._scoped(run), donate_argnums=donate)
         self._programs[key] = fn
         return fn
 
@@ -1199,7 +1468,7 @@ class PagedKVEngine:
 
         import jax as _jax
         donate = () if _jax.default_backend() == "cpu" else (9, 10)
-        fn = jax.jit(run, donate_argnums=donate)
+        fn = jax.jit(self._scoped(run), donate_argnums=donate)
         self._programs[key] = fn
         return fn
 
@@ -1262,6 +1531,6 @@ class PagedKVEngine:
         # decode held ~2x KV-pool memory on TPU
         donate = () if jax.default_backend() == "cpu" \
             else (11 if any_sample else 7,)
-        fn = jax.jit(run, donate_argnums=donate)
+        fn = jax.jit(self._scoped(run), donate_argnums=donate)
         self._programs[key] = fn
         return fn

@@ -5,3 +5,4 @@ live here; everything else rides XLA fusion (SURVEY.md §2.4 "TPU
 equivalent: XLA itself").
 """
 from paddle_tpu.kernels import flash_attention  # noqa: F401
+from paddle_tpu.kernels import paged_attention  # noqa: F401

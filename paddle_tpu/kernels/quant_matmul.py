@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from paddle_tpu.core.jax_compat import tpu_compiler_params
+
 __all__ = ["weight_only_int8_matmul", "pick_block_m"]
 
 
@@ -91,7 +93,7 @@ def weight_only_int8_matmul(x, qw, scale, block_m=None, block_n=512,
         out_specs=pl.BlockSpec((bm, block_n), lambda i, j, k: (i, j)),
         out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
         scratch_shapes=[pltpu.VMEM((bm, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=2 * M * N * K,

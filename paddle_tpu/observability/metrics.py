@@ -152,6 +152,14 @@ METRICS = {
     "serving.batcher.shed_full": ("gauge",
                                   "requests shed on a full buffer"),
     # -- paged KV engine ----------------------------------------------
+    "inference.decode.kernel": ("counter",
+                                "decode ticks by attend path (label: "
+                                "path = pallas | jnp)"),
+    "inference.kv.bytes_per_slot": ("gauge",
+                                    "KV-pool HBM bytes one fully-grown "
+                                    "slot pins (all layers, real "
+                                    "buffer dtypes incl. int8 scale "
+                                    "planes)"),
     "engine.ticks": ("gauge", "scheduler ticks run"),
     "engine.prefills": ("gauge", "prompts prefilled"),
     "engine.tokens_out": ("gauge", "tokens emitted"),

@@ -607,6 +607,278 @@ def test_engine_stream_deadline_threads_through():
     eng.stop()
 
 
+# -- Pallas decode kernel + int8 KV (ISSUE 6) -------------------------------
+
+def test_pallas_kernel_greedy_parity_vs_jnp():
+    """The acceptance bar: kernel="pallas" (interpret on CPU) produces
+    EXACTLY the jnp path's tokens at f32 — including a request that
+    joins mid-decode of another."""
+    model = _model()
+    pa, pb = [5, 9, 2, 14], [17, 3, 11]
+    mk = lambda kern: PagedKVEngine(                       # noqa: E731
+        model, max_slots=2, page_size=4, num_pages=24,
+        max_pages_per_slot=6, steps_per_tick=2, kernel=kern)
+    ej, ep = mk("jnp"), mk("pallas")
+    assert ej.decode_kernel == "jnp"
+    assert ep.decode_kernel == "pallas"
+    results = {}
+    for name, eng in (("jnp", ej), ("pallas", ep)):
+        ra = eng.submit(pa, max_new_tokens=10)
+        eng.step()
+        rb = eng.submit(pb, max_new_tokens=6)    # joins mid-decode
+        eng.run_until_idle()
+        results[name] = (ra.result(), rb.result())
+    assert results["pallas"] == results["jnp"]
+    solo_a = np.asarray(generate(model, np.asarray([pa], np.int32),
+                                 max_new_tokens=10))[0].tolist()[len(pa):]
+    assert results["pallas"][0] == solo_a
+
+
+def test_pallas_kernel_long_generation_page_soak():
+    """Long-generation parity soak: lens crosses >= 3 page boundaries
+    (prompt 3 + 18 new = 21 positions over page_size-4 pages = 6
+    pages); kernel and jnp paths stay token-identical the whole way."""
+    model = _model()
+    prompt = [5, 9, 2]
+    outs = {}
+    for kern in ("jnp", "pallas"):
+        eng = PagedKVEngine(model, max_slots=1, page_size=4,
+                            num_pages=16, max_pages_per_slot=6,
+                            steps_per_tick=3, kernel=kern)
+        outs[kern] = eng.generate([prompt], max_new_tokens=18)[0]
+        assert len(eng._free) == eng.num_pages - 1
+    assert outs["pallas"] == outs["jnp"]
+    assert len(outs["pallas"]) == 18
+    solo = np.asarray(generate(model, np.asarray([prompt], np.int32),
+                               max_new_tokens=18))[0].tolist()[3:]
+    assert outs["pallas"] == solo
+
+
+def test_pallas_kernel_mixed_sampling_tick():
+    """Greedy + sampled slots share one kernel-path tick: the greedy
+    row is untouched by its sampling neighbor and still matches the
+    solo run; sampled output replays per engine seed."""
+    model = _model()
+    mk = lambda: PagedKVEngine(model, max_slots=2, page_size=4,  # noqa
+                               num_pages=24, max_pages_per_slot=6,
+                               steps_per_tick=3, seed=11,
+                               kernel="pallas")
+    eng = mk()
+    rg = eng.submit([5, 9, 2], max_new_tokens=6)
+    rs = eng.submit([5, 9, 2], max_new_tokens=6, do_sample=True,
+                    temperature=0.8, top_k=20, top_p=0.9)
+    eng.run_until_idle()
+    solo = np.asarray(generate(model, np.asarray([[5, 9, 2]], np.int32),
+                               max_new_tokens=6))[0].tolist()[3:]
+    assert rg.result() == solo
+    toks = rs.result()
+    assert len(toks) == 6
+    assert all(0 <= t < model.config.vocab_size for t in toks)
+    eng2 = mk()
+    rg2 = eng2.submit([5, 9, 2], max_new_tokens=6)
+    rs2 = eng2.submit([5, 9, 2], max_new_tokens=6, do_sample=True,
+                      temperature=0.8, top_k=20, top_p=0.9)
+    eng2.run_until_idle()
+    assert rs2.result() == toks and rg2.result() == solo
+
+
+def test_pallas_kernel_speculative_parity():
+    """Speculative decoding rides the kernel path for its s=1 draft
+    steps (the g+1-row verify stays jnp): output is still EXACTLY the
+    solo target tokens."""
+    model = _model()
+    paddle_tpu.seed(5)
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    draft = LlamaForCausalLM(model.config)
+    pa = [5, 9, 2, 14]
+    solo = np.asarray(generate(model, np.asarray([pa], np.int32),
+                               max_new_tokens=9))[0].tolist()[len(pa):]
+    eng = PagedKVEngine(model, max_slots=2, page_size=4, num_pages=40,
+                        max_pages_per_slot=8, steps_per_tick=3,
+                        draft_model=draft, spec_tokens=3,
+                        kernel="pallas")
+    r = eng.submit(pa, max_new_tokens=9)
+    eng.run_until_idle()
+    assert r.result() == solo
+    assert eng.stats["spec_ticks"] > 0
+
+
+def test_int8_kv_greedy_deterministic_replay():
+    """int8 KV pools: generation is deterministic across same-seed
+    engines (the quantize-at-scatter path has no hidden state), tokens
+    are valid ids, and pages recycle cleanly."""
+    model = _model()
+    prompts = [[5, 9, 2], [17, 3, 11, 4]]
+    mk = lambda: PagedKVEngine(model, max_slots=2, page_size=4,  # noqa
+                               num_pages=24, max_pages_per_slot=6,
+                               steps_per_tick=3, kernel="pallas",
+                               kv_dtype="int8")
+    e1, e2 = mk(), mk()
+    g1 = e1.generate(prompts, max_new_tokens=10)
+    g2 = e2.generate(prompts, max_new_tokens=10)
+    assert g1 == g2
+    assert all(0 <= t < model.config.vocab_size for r in g1 for t in r)
+    assert len(e1._free) == e1.num_pages - 1
+    # kernel and jnp attends agree on the SAME quantized pools too
+    e3 = PagedKVEngine(model, max_slots=2, page_size=4, num_pages=24,
+                       max_pages_per_slot=6, steps_per_tick=3,
+                       kernel="jnp", kv_dtype="int8")
+    assert e3.generate(prompts, max_new_tokens=10) == g1
+
+
+def test_int8_kv_with_speculative_draft():
+    """int8 KV composes with speculative decoding: the draft rides its
+    own arity-4 (k, v, k_scale, v_scale) pools through the spec tick,
+    retire zeroes BOTH models' scale planes, output is valid and
+    replays deterministically across same-seed engines."""
+    model = _model()
+    paddle_tpu.seed(5)
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    draft = LlamaForCausalLM(model.config)
+    mk = lambda: PagedKVEngine(model, max_slots=2, page_size=4,  # noqa
+                               num_pages=40, max_pages_per_slot=8,
+                               steps_per_tick=3, draft_model=draft,
+                               spec_tokens=3, kernel="pallas",
+                               kv_dtype="int8", seed=7)
+    e1, e2 = mk(), mk()
+    assert len(e1.draft_pools[0]) == 4
+    g1 = e1.generate([[5, 9, 2, 14]], max_new_tokens=8)
+    assert e1.stats["spec_ticks"] > 0
+    assert len(g1[0]) == 8
+    assert all(0 <= t < model.config.vocab_size for t in g1[0])
+    assert e2.generate([[5, 9, 2, 14]], max_new_tokens=8) == g1
+    # every ALLOCATABLE page's scales reset by retire; row 0 is the
+    # trash page — the spec verify deliberately routes past-budget
+    # writes there (always masked on read), so its scale may be >0
+    for pools in (e1.pools, e1.draft_pools):
+        for _kp, _vp, ks, vs in pools:
+            assert float(jnp.abs(ks[1:]).sum()) == 0.0
+            assert float(jnp.abs(vs[1:]).sum()) == 0.0
+
+
+def test_int8_kv_scales_reset_on_page_recycle():
+    """Quant scales only grow at scatter time (scatter-max), so retire
+    must zero the freed pages' scale rows — otherwise a recycled page
+    quantizes its next tenant with the largest magnitude any PREVIOUS
+    tenant wrote and precision ratchets away over server lifetime.
+    Behavioral pin: a fresh engine and one that already served (and
+    retired) a request produce identical tokens for the same request."""
+    model = _model()
+    mk = lambda: PagedKVEngine(model, max_slots=1, page_size=4,  # noqa
+                               num_pages=12, max_pages_per_slot=4,
+                               steps_per_tick=3, kernel="pallas",
+                               kv_dtype="int8")
+    used, fresh = mk(), mk()
+    r1 = used.generate([[40, 41, 42, 43]], max_new_tokens=6)
+    # every allocatable page's scale row is back to zero after the
+    # retire (row 0 is the trash page — excluded, see the spec test)
+    for kp, vp, ks, vs in used.pools:
+        assert float(jnp.abs(ks[1:]).sum()) == 0.0
+        assert float(jnp.abs(vs[1:]).sum()) == 0.0
+    g_used = used.generate([[5, 9, 2]], max_new_tokens=8)
+    g_fresh = fresh.generate([[5, 9, 2]], max_new_tokens=8)
+    assert g_used == g_fresh
+
+
+def test_int8_kv_sampling_matches_target_distribution():
+    """TV-distance pin for int8-KV sampling (the speculative tick's
+    statistical-pin pattern): over many keys, the first sampled
+    token's marginal must match the processed softmax of the model
+    evaluated on the SAME int8 caches — quantization shifts the
+    logits, but sampling on top of them must stay unbiased."""
+    model = _model()
+    eng = PagedKVEngine(model, max_slots=1, page_size=4, num_pages=24,
+                        max_pages_per_slot=10, steps_per_tick=1,
+                        kernel="pallas", kv_dtype="int8", seed=0)
+    r = eng.submit([5, 9, 2], max_new_tokens=30, do_sample=True,
+                   temperature=0.8, top_k=0, top_p=1.0)
+    eng._admit()                       # prefill only; no tick yet
+    a = eng._slot_arrays([0])
+    fn = eng._tick_fn(True)
+    flat = [x for kv in eng.pools for x in kv]
+
+    from paddle_tpu.inference.paged import (PagedState,
+                                            _process_logits_rowwise)
+    state = PagedState(jnp.asarray(eng._bt), jnp.asarray(a["lens"]),
+                       jnp.asarray(a["active"]).astype(jnp.int32))
+    logits, _ = model(Tensor(jnp.asarray(a["tok"])[:, None]),
+                      caches=eng._layer_caches(flat),
+                      position_ids=Tensor(jnp.asarray(a["lens"])[:, None]),
+                      cache_index=state)
+    want = np.asarray(jax.nn.softmax(_process_logits_rowwise(
+        logits._value[:, -1], jnp.asarray(a["temp"]),
+        jnp.asarray(a["topk"]), jnp.asarray(a["topp"])), axis=-1))[0]
+
+    trials = 400
+    counts = np.zeros(model.config.vocab_size)
+    args_fixed = (jnp.asarray(a["tok"]), jnp.asarray(a["lens"]),
+                  jnp.asarray(a["active"]), jnp.asarray(a["limit"]),
+                  jnp.asarray(eng._bt), jnp.asarray(a["eos"]))
+    sample_args = (jnp.asarray(a["temp"]), jnp.asarray(a["topk"]),
+                   jnp.asarray(a["topp"]), jnp.asarray(a["wants"]))
+    donated = jax.default_backend() != "cpu"   # mirror the engine gate
+    for s in range(trials):
+        key = jax.random.key(1000 + s)
+        fl = [jnp.copy(x) for x in flat] if donated else list(flat)
+        toks, _, _ = fn(*args_fixed, jax.random.key_data(key),
+                        *sample_args, fl)
+        counts[int(np.asarray(toks)[0, 0])] += 1
+    tv = 0.5 * np.abs(counts / trials - want).sum()
+    # same bound as the speculative pin: sampling noise at 400 trials
+    # over ~97 tokens comfortably separates unbiased sampling from
+    # e.g. sampling the UNquantized distribution's argmax region
+    assert tv < 0.25, tv
+
+
+def test_kv_dtype_int8_halves_bytes_per_slot():
+    """kv_dtype honored end-to-end: the exported bytes/slot figure
+    comes from the real buffer dtypes — int8 pools (plus their f32
+    scale planes) cost at most ~0.57x the bf16 figure here (tiny dims;
+    the scale overhead vanishes at production page_size x head_dim)."""
+    from paddle_tpu.observability.metrics import MetricsRegistry
+    model = _model()
+    mk = lambda kd: PagedKVEngine(model, max_slots=2,       # noqa
+                                  page_size=4, num_pages=24,
+                                  max_pages_per_slot=6, kv_dtype=kd)
+    bf16, int8 = mk("bf16"), mk("int8")
+    assert int8.kv_bytes_per_slot() <= 0.6 * bf16.kv_bytes_per_slot()
+    assert int8.pools[0][0].dtype == jnp.int8
+    assert int8.pools[0][2].dtype == jnp.float32
+    assert str(bf16.pools[0][0].dtype) == "bfloat16"
+    reg = MetricsRegistry()
+    int8.export_metrics(reg)
+    assert reg.gauge("inference.kv.bytes_per_slot").value() \
+        == int8.kv_bytes_per_slot()
+
+
+def test_engine_kernel_config_validation():
+    model = _model()
+    with pytest.raises(ValueError, match="kernel"):
+        PagedKVEngine(model, kernel="bogus")
+    with pytest.raises(ValueError, match="kv_dtype"):
+        PagedKVEngine(model, kv_dtype="fp4")
+    # auto on CPU stays on the jnp path (interpret mode is a parity
+    # tool, not a fast path)
+    eng = PagedKVEngine(model, max_slots=1, page_size=4, num_pages=16)
+    assert eng.decode_kernel == "jnp"
+
+
+def test_decode_kernel_tick_counter():
+    """inference.decode.kernel counts ticks by attend path when
+    observability is enabled."""
+    from paddle_tpu import observability
+    model = _model()
+    with observability.scoped() as reg:
+        eng = PagedKVEngine(model, max_slots=1, page_size=4,
+                            num_pages=16, max_pages_per_slot=4,
+                            steps_per_tick=2, kernel="pallas")
+        eng.generate([[5, 9, 2]], max_new_tokens=4)
+        assert reg.counter("inference.decode.kernel").value(
+            path="pallas") >= 1
+        assert reg.counter("inference.decode.kernel").value(
+            path="jnp") == 0
+
+
 @pytest.mark.quick
 def test_engine_export_metrics():
     """export_metrics publishes the stats dict as catalogued gauges
