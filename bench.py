@@ -43,10 +43,16 @@ def _decode_bench(on_tpu):
     a fixed slot count, for the jnp attend path, the Pallas
     paged-decode kernel (interpret mode off-TPU — a parity/coverage
     config there, a perf config on real chips), and the kernel with
-    int8 KV pools. Returns a list of row dicts for the BENCH json."""
+    int8 KV pools. The measured run executes under a scoped
+    observability enable, so the request-tracing layer
+    (observability/requests.py) records per-request TTFT and
+    inter-token latency; their p50/p95/p99 ride each row (the
+    user-felt serving SLOs next to the aggregate throughput).
+    Returns a list of row dicts for the BENCH json."""
     import time
 
     import paddle_tpu
+    from paddle_tpu import observability
     from paddle_tpu.inference.paged import PagedKVEngine
     from paddle_tpu.models.llama import LlamaForCausalLM, LlamaConfig, \
         tiny_llama_config
@@ -83,15 +89,26 @@ def _decode_bench(on_tpu):
             kv_dtype=kv_dtype)
         eng.generate(prompts, max_new_tokens=2)      # compile warmup
         base_tokens = eng.stats["tokens_out"]
-        t0 = time.perf_counter()
-        eng.generate(prompts, max_new_tokens=max_new)
-        dt = time.perf_counter() - t0
+        with observability.scoped(reset=True) as reg:
+            t0 = time.perf_counter()
+            eng.generate(prompts, max_new_tokens=max_new)
+            dt = time.perf_counter() - t0
+
+        def _pcts(name):
+            h = reg.histogram(name)
+            if h.count() == 0:
+                return None
+            return {f"p{p}": round(h.percentile(p) * 1000.0, 3)
+                    for p in (50, 95, 99)}
+
         rows.append({
             "path": label,
             "tokens_per_sec": round(
                 (eng.stats["tokens_out"] - base_tokens) / dt, 2),
             "kv_bytes_per_slot": eng.kv_bytes_per_slot(),
             "slots": slots,
+            "ttft_ms": _pcts("request.ttft.seconds"),
+            "itl_ms": _pcts("request.itl.seconds"),
         })
     return rows
 

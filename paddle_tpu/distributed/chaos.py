@@ -87,6 +87,9 @@ POINTS = {
                        "commits (torn async save; recovery must fall "
                        "back to the previous complete checkpoint)",
     "elastic.preempt": "synthetic preemption: SIGTERM to this process",
+    "engine.tick.delay": "slow paged-engine scheduler tick (stretches "
+                         "request TTFT/ITL — the request-tracing "
+                         "tests' pacing lever)",
     "serving.batch.delay": "slow DynamicBatcher backend run",
     "serving.batch.fail": "failed DynamicBatcher batch run (error "
                           "must fan out to every waiter)",

@@ -1,5 +1,5 @@
 """Overload control for the serving stack (admission, deadlines,
-circuit breaking, latency tracking).
+circuit breaking).
 
 The reference deploys AnalysisPredictor behind Paddle Serving, whose
 production posture is exactly this layer: a server that is saturated
@@ -21,8 +21,11 @@ fast-fail while it recovers rather than time every caller out
     CircuitBreaker       closed -> open after N consecutive backend
                          failures (fast-fail 503), half-open probe after
                          a cooldown, reclose on probe success
-    LatencyStats         fixed-size ring of recent request latencies,
-                         p50/p99 for the /stats endpoint
+
+(The old LatencyStats latency ring lived here through ISSUE 2; the
+serving.request.latency_ms histogram behind serving._RegistryLatency
+replaced it in ISSUE 3 and the dead class was removed in ISSUE 7 —
+request-level latency now lives in observability/requests.py.)
 
 Everything here is stdlib-only and thread-safe; importing this module
 never touches jax (it is also imported by the chaos-test tooling).
@@ -35,7 +38,7 @@ import time
 __all__ = [
     "OverloadError", "AdmissionRejected", "CircuitOpenError",
     "ServerDraining", "DeadlineExceeded", "EngineOverloaded",
-    "Deadline", "AdmissionController", "CircuitBreaker", "LatencyStats",
+    "Deadline", "AdmissionController", "CircuitBreaker",
 ]
 
 
@@ -293,50 +296,3 @@ class CircuitBreaker:
             return {"state": self._state,
                     "consecutive_failures": self._consecutive_failures,
                     "opens": self.opens, "recloses": self.recloses}
-
-
-# -- latency tracking -------------------------------------------------------
-
-def _pct(win, p):
-    """Nearest-rank percentile of a sorted non-empty window."""
-    rank = min(len(win) - 1,
-               max(0, int(round(p / 100.0 * (len(win) - 1)))))
-    return win[rank]
-
-
-class LatencyStats:
-    """Fixed-size ring of recent latencies; percentile() sorts a copy
-    on demand (the /stats endpoint is not a hot path)."""
-
-    def __init__(self, capacity=512):
-        self.capacity = int(capacity)
-        self._ring = [0.0] * self.capacity
-        self._idx = 0
-        self._count = 0                 # lifetime recordings
-        self._lock = threading.Lock()
-
-    def record(self, seconds):
-        with self._lock:
-            self._ring[self._idx] = float(seconds)
-            self._idx = (self._idx + 1) % self.capacity
-            self._count += 1
-
-    def _window(self):
-        n = min(self._count, self.capacity)
-        return sorted(self._ring[:n])
-
-    def percentile(self, p):
-        """p in [0, 100]; None when nothing recorded yet."""
-        with self._lock:
-            win = self._window()
-        return _pct(win, p) if win else None
-
-    def snapshot(self):
-        with self._lock:
-            win = self._window()
-            count = self._count
-        if not win:
-            return {"count": 0, "p50_ms": None, "p99_ms": None}
-        return {"count": count,
-                "p50_ms": _pct(win, 50) * 1000.0,
-                "p99_ms": _pct(win, 99) * 1000.0}

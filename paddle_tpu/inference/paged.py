@@ -68,6 +68,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu import observability
+from paddle_tpu.observability import requests as obs_requests
 from paddle_tpu.inference.overload import (DeadlineExceeded,
                                            EngineOverloaded,
                                            OverloadError)
@@ -345,6 +346,7 @@ class _Request:
         # the engine alive through abandoned request handles
         self._engine = weakref.ref(engine) if engine is not None else None
         self.sample_index = 0       # engine-local; set by submit()
+        self.obs = None             # request-tracing context (or None)
         self.tokens: list[int] = []          # accepted generated tokens
         self.queue: queue.Queue = queue.Queue()
         self.done = threading.Event()
@@ -662,6 +664,39 @@ class PagedKVEngine:
         req = _Request(ids, max_new_tokens, eos_token_id, do_sample,
                        temperature, top_k, top_p, pages,
                        deadline=deadline, engine=self)
+        if observability.ENABLED:
+            # adopt the serving layer's request context (propagated by
+            # contextvar into the stream-producer thread) or start a
+            # fresh one for direct submit() callers; claiming token
+            # accounting keeps the HTTP consumer from double-recording
+            # the emissions this engine records itself
+            ctx = obs_requests.current()
+            if ctx is None:
+                ctx = obs_requests.register(
+                    obs_requests.RequestContext.new())
+            ctx.claim_tokens()
+            req.obs = ctx
+            ctx.record("queued", rid=req.rid)
+            # ref BEFORE the request becomes visible to the ticker: a
+            # running ticker may expire/cancel the row the instant it
+            # lands in _pending, and that release must not underflow
+            # the count (a multi-row stream() shares one serving
+            # context across rows; the context must outlive them all)
+            ctx.adopt_engine()
+        try:
+            self._submit_locked(req, pages)
+        except EngineOverloaded:
+            if req.obs is not None:
+                # the shed row never entered _pending, so nothing else
+                # will release its ref; for an engine-created or
+                # single-row context this finishes it "shed_engine"
+                # (matching EngineOverloaded.counter, so the HTTP
+                # layer's later finish is an idempotent no-op)
+                req.obs.engine_finish("shed_engine")
+            raise
+        return req
+
+    def _submit_locked(self, req, pages):
         with self._lock:
             if self.max_pending is not None:
                 # shed when the request can neither start NOW (free
@@ -721,6 +756,8 @@ class PagedKVEngine:
                 self.stats["cancelled"] += 1
                 with self._lock:
                     self._inflight -= 1
+                if req.obs is not None:
+                    req.obs.engine_finish("cancelled")
                 req.queue.put(None)
                 req.done.set()
                 continue
@@ -733,6 +770,8 @@ class PagedKVEngine:
                 req.error = DeadlineExceeded(
                     "deadline exceeded while queued for engine "
                     "admission")
+                if req.obs is not None:
+                    req.obs.engine_finish("expired")
                 req.queue.put(None)
                 req.done.set()
                 continue
@@ -749,6 +788,10 @@ class PagedKVEngine:
             self._slots[idx] = _Slot(req, lens=0, tok=0)
             self._alloc_pages(idx, -(-req.prompt.size // self.page_size))
             self.stats["admitted"] += 1
+            if req.obs is not None:
+                # rid pairs this row's scheduled with ITS queued event
+                # (per-row queue_wait clock in a shared context)
+                req.obs.record("scheduled", rid=req.rid, slot=idx)
         # batch same-bucket prefills into ONE program call (an admission
         # storm used to pay one ~full prefill latency per request)
         groups = {}
@@ -803,6 +846,9 @@ class PagedKVEngine:
         Exhausted rows ride later rounds with n_valid=0 (writes drop)."""
         import time as _time
         t0 = _time.perf_counter()
+        for _idx, req in grp:
+            if req.obs is not None:
+                req.obs.record("prefill_start", rid=req.rid)
         chunk = self.prefill_chunk
         bw = 1 if len(grp) == 1 else self.max_slots
         fn = self._prefill_chunk_fn(chunk, bw)
@@ -833,6 +879,9 @@ class PagedKVEngine:
                 done[r] += nv[r]
         self.stats["prefills"] += len(grp)
         self.stats["prefill_s"] += _time.perf_counter() - t0
+        for _idx, req in grp:
+            if req.obs is not None:
+                req.obs.record("prefill_end", rid=req.rid)
         for r, (idx, req) in enumerate(grp):
             slot = self._slots[idx]
             slot.lens = plens[r]
@@ -873,6 +922,9 @@ class PagedKVEngine:
         total."""
         import time as _time
         t0 = _time.perf_counter()
+        for _idx, req in grp:
+            if req.obs is not None:
+                req.obs.record("prefill_start", rid=req.rid)
         bw = 1 if len(grp) == 1 else self.max_slots
         fn = self._prefill_fn(ppad, bw)
         ids = np.zeros((bw, ppad), np.int32)
@@ -896,6 +948,9 @@ class PagedKVEngine:
         logits_np = np.asarray(last_logits)              # (bw, vocab)
         self.stats["prefills"] += len(grp)
         self.stats["prefill_s"] += _time.perf_counter() - t0
+        for _idx, req in grp:
+            if req.obs is not None:
+                req.obs.record("prefill_end", rid=req.rid)
         for row, (idx, req) in enumerate(grp):
             slot = self._slots[idx]
             slot.lens = int(req.prompt.size)
@@ -920,11 +975,17 @@ class PagedKVEngine:
         self.stats["tokens_out"] += len(out)
         if out:
             req.queue.put(out)
+            if req.obs is not None:
+                # first call records first_token (-> TTFT); later
+                # calls record the tick's emission (-> ITL). The row id
+                # keys the gap clock so sibling rows of one multi-row
+                # request don't read each other's emission times
+                req.obs.record_tokens(len(out), stream=req.rid)
         if finished:
             self._retire(slot_idx)
         return not finished
 
-    def _retire(self, slot_idx):
+    def _retire(self, slot_idx, reason=None):
         slot = self._slots[slot_idx]
         if self._cache_arity == 4 and slot.pages:
             # int8 KV: reset the freed pages' quant scales. Scales only
@@ -950,8 +1011,12 @@ class PagedKVEngine:
         self._slots[slot_idx] = None
         with self._lock:
             self._inflight -= 1
-        if not slot.req.cancelled.is_set():
+        cancelled = slot.req.cancelled.is_set()
+        if not cancelled:
             self.stats["finished"] += 1      # cancelled counts separately
+        if slot.req.obs is not None:
+            slot.req.obs.engine_finish(
+                reason or ("cancelled" if cancelled else "finished"))
         slot.req.queue.put(None)
         slot.req.done.set()
 
@@ -1005,6 +1070,11 @@ class PagedKVEngine:
             self._in_step = False
 
     def _step_tick(self):
+        from paddle_tpu.distributed import chaos
+        if chaos.ENABLED:
+            # a slow scheduler tick (congested chip, straggler host):
+            # stretches TTFT and ITL — the request-tracing tests' lever
+            chaos.maybe_delay("engine.tick.delay")
         for i, slot in enumerate(self._slots):
             if slot is not None and slot.req.cancelled.is_set():
                 self.stats["cancelled"] += 1
@@ -1160,18 +1230,21 @@ class PagedKVEngine:
                     doomed = self._pending
                     self._pending = []
                     self._inflight -= len(doomed)   # dropped, not retired
+                for req in doomed:                  # never got a slot
+                    req.error = e
+                    if req.obs is not None:
+                        req.obs.engine_finish("error")
+                    req.queue.put(None)
+                    req.done.set()
                 for i, s in enumerate(self._slots):
                     if s is not None:
                         s.req.error = e
-                        doomed.append(s.req)
                         # _retire returns the slot's pages + reservation
-                        # to the pool, so a restarted ticker isn't
-                        # permanently short on capacity
-                        self._retire(i)
-                for req in doomed:
-                    req.error = e
-                    req.queue.put(None)
-                    req.done.set()
+                        # to the pool (a restarted ticker isn't
+                        # permanently short on capacity), releases the
+                        # row's tracing ref with the real outcome, and
+                        # wakes the waiter
+                        self._retire(i, reason="error")
                 raise
 
     def stream(self, input_ids, max_new_tokens=32, *, eos_token_id=None,
@@ -1201,19 +1274,36 @@ class PagedKVEngine:
         else:
             rows = list(ids)
         self.start()
+        # guard ref across the submission window: the ticker is already
+        # running, so a fast first row can retire — dropping the shared
+        # context's last engine ref — before the next row submits,
+        # finishing the whole request early. engine_finish("finished")
+        # never beats an abnormal row reason, so releasing the guard in
+        # any order is safe.
+        guard_ctx = obs_requests.current() if observability.ENABLED \
+            else None
+        if guard_ctx is not None:
+            guard_ctx.adopt_engine()
         reqs = []
         try:
-            for r in rows:
-                reqs.append(self.submit(
-                    r, max_new_tokens, eos_token_id=eos_token_id,
-                    do_sample=do_sample, temperature=temperature,
-                    top_k=top_k, top_p=top_p, deadline=deadline))
-        except OverloadError:
-            # partial multi-row admission must not leak: cancel the
-            # rows already submitted before re-raising the shed
-            for r in reqs:
-                r.cancel()
-            raise
+            try:
+                for r in rows:
+                    reqs.append(self.submit(
+                        r, max_new_tokens, eos_token_id=eos_token_id,
+                        do_sample=do_sample, temperature=temperature,
+                        top_k=top_k, top_p=top_p, deadline=deadline))
+            except BaseException:
+                # partial multi-row admission must not leak: whatever a
+                # later row raised (shed, per-row validation), cancel
+                # the rows already submitted before re-raising — they
+                # would otherwise decode to max_new_tokens for a caller
+                # that already got an exception
+                for r in reqs:
+                    r.cancel()
+                raise
+        finally:
+            if guard_ctx is not None:
+                guard_ctx.engine_finish("finished")
         streams = [r.stream_tokens() for r in reqs]
         try:
             for step in range(int(max_new_tokens)):

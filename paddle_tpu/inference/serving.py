@@ -28,7 +28,21 @@ GET  /metrics  -> Prometheus text exposition (observability/): request
                gauges, paged-engine counters, and the process-wide
                registry (training telemetry, store RPC, checkpoint,
                elastic, chaos) when observability is enabled
+GET  /debug/requests -> live traced requests from the bounded
+               in-flight registry (observability/requests.py): request
+               id, trace id, stage, age, tokens — the fleet router's
+               machine-readable view of what this replica is doing
 GET  /metadata -> input/output names of the served program
+
+Request tracing (observability/requests.py, enabled with the rest of
+the observability plane): every POST gets a RequestContext carrying
+`X-Request-Id` and a W3C `traceparent` (inbound headers honored, both
+echoed on every reply including streamed ones), propagated by
+contextvar through the admission gate, DynamicBatcher, and
+PagedKVEngine — which record the request's lifecycle events and the
+request.* SLO instruments (TTFT / ITL / queue wait / prefill /
+outcome). Disabled (the default), the whole path is per-layer single
+attribute checks.
 
 Requests are serialized through a lock (one XLA executable, one chip).
 With dynamic_batching=True the server coalesces concurrent requests
@@ -53,6 +67,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import contextvars
 import json
 import math
 import threading
@@ -61,10 +76,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from paddle_tpu import observability
 from paddle_tpu.inference.overload import (
     AdmissionController, AdmissionRejected, CircuitBreaker, Deadline,
     DeadlineExceeded, OverloadError, ServerDraining,
     expired as _expired)
+from paddle_tpu.observability import requests as obs_requests
 from paddle_tpu.observability.metrics import MetricsRegistry
 
 __all__ = ["PredictorServer", "DynamicBatcher", "serve",
@@ -92,15 +109,17 @@ class _StreamAborted(RuntimeError):
 
 
 class _Pending:
-    __slots__ = ("inputs", "n", "event", "result", "error", "deadline")
+    __slots__ = ("inputs", "n", "event", "result", "error", "deadline",
+                 "ctx")
 
-    def __init__(self, inputs, n, deadline=None):
+    def __init__(self, inputs, n, deadline=None, ctx=None):
         self.inputs = inputs            # list of np arrays, fixed order
         self.n = n                      # leading-dim size
         self.event = threading.Event()
         self.result = None
         self.error = None
         self.deadline = deadline
+        self.ctx = ctx                  # request-tracing context (or None)
 
 
 class DynamicBatcher:
@@ -156,7 +175,10 @@ class DynamicBatcher:
                 "larger batch input_spec")
         if _expired(deadline):
             raise DeadlineExceeded("deadline exceeded before batching")
-        p = _Pending(arrays, rows, deadline)
+        ctx = obs_requests.current() if observability.ENABLED else None
+        if ctx is not None:
+            ctx.record("queued")
+        p = _Pending(arrays, rows, deadline, ctx=ctx)
         with self._cv:
             if self._stop:
                 raise RuntimeError("DynamicBatcher stopped")
@@ -251,6 +273,9 @@ class DynamicBatcher:
                 return
             if not batch:
                 continue
+            for p in batch:
+                if p.ctx is not None:
+                    p.ctx.record("scheduled")
             try:
                 if chaos.ENABLED:
                     # a slow backend (serving.batch.delay) and a failed
@@ -391,9 +416,19 @@ class PredictorServer:
             def log_message(self, *a):      # quiet
                 pass
 
+            def _echo_trace_headers(self):
+                """X-Request-Id / traceparent on every reply of a
+                traced request (the propagation contract: the caller's
+                trace id comes back, our span id is the new parent)."""
+                ctx = getattr(self, "_obs_ctx", None)
+                if ctx is not None:
+                    self.send_header("X-Request-Id", ctx.request_id)
+                    self.send_header("traceparent", ctx.traceparent())
+
             def _reply(self, code, obj, retry_after=None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
+                self._echo_trace_headers()
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 if retry_after is not None:
@@ -414,6 +449,7 @@ class PredictorServer:
                 raises _StreamAborted so the breaker sees it); a client
                 disconnect returns None — the backend did not fail."""
                 self.send_response(200)
+                self._echo_trace_headers()
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
@@ -429,7 +465,12 @@ class PredictorServer:
                         for obj in lines:
                             chunk(obj)
                     except OSError:
-                        return None     # client went away mid-stream
+                        # client went away mid-stream: the backend did
+                        # not fail, but the request's outcome is final
+                        outer._finish_request(
+                            getattr(self, "_obs_ctx", None),
+                            "disconnected")
+                        return None
                     except Exception as e:      # noqa: BLE001
                         exc = e
                         chunk({"error": str(e)})
@@ -442,6 +483,9 @@ class PredictorServer:
                 return exc
 
             def do_GET(self):
+                # keep-alive: one Handler serves several requests on a
+                # connection — a stale traced POST must not echo here
+                self._obs_ctx = None
                 if self.path in ("/health", "/healthz"):
                     # liveness only: the process is up and serving HTTP.
                     # Whether it should RECEIVE traffic is /readyz.
@@ -451,9 +495,19 @@ class PredictorServer:
                     ready, reason = outer.readiness()
                     if ready:
                         return self._reply(200, {"status": "ready"})
+                    # machine-readable load signals ride the 503 body:
+                    # a fleet router routes/sheds on numbers, not prose
                     return self._reply(
-                        503, {"status": "unready", "reason": reason},
+                        503, {"status": "unready", "reason": reason,
+                              "in_flight": outer.admission.in_flight,
+                              "queue_depth": outer.queue_depth(),
+                              "retry_after_s": outer.retry_after_s},
                         retry_after=outer.retry_after_s)
+                if self.path == "/debug/requests":
+                    live = obs_requests.live_requests()
+                    return self._reply(200, {
+                        "enabled": observability.ENABLED,
+                        "count": len(live), "requests": live})
                 if self.path == "/stats":
                     return self._reply(200, outer.stats())
                 if self.path == "/metrics":
@@ -471,63 +525,90 @@ class PredictorServer:
                 return self._reply(404, {"error": "unknown path"})
 
             def do_POST(self):
+                self._obs_ctx = None        # keep-alive: no stale echo
                 if self.path not in ("/predict", "/generate"):
                     return self._reply(404, {"error": "unknown path"})
                 outer._count("total")
+                ctx = cv_token = None
+                if observability.ENABLED:
+                    # one request context per POST: trace identity from
+                    # the inbound headers, bound to this thread via
+                    # contextvar so the batcher/engine layers see it
+                    ctx = obs_requests.RequestContext.from_headers(
+                        self.headers)
+                    obs_requests.register(ctx)
+                    self._obs_ctx = ctx
+                    cv_token = obs_requests.set_current(ctx)
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n)) if n else {}
-                    if not isinstance(req, dict):
-                        raise ValueError(
-                            "request body must be a JSON object")
-                    deadline = outer._request_deadline(req, self.headers)
-                    with outer._admit(deadline):
-                        if self.path == "/generate":
-                            stream = bool(req.pop("stream", False))
-                            it = outer.generate_steps(req,
-                                                      deadline=deadline)
-                            if stream:
-                                # pull the first item BEFORE sending the
-                                # 200 header so request errors (bad
-                                # shape, no generator) still surface as
-                                # a real error status
-                                import itertools
-                                first = next(it)
-                                exc = self._stream_reply(
-                                    itertools.chain([first], it), src=it)
-                                if exc is not None:
-                                    raise _StreamAborted(str(exc)) \
-                                        from exc
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        req = json.loads(self.rfile.read(n)) if n else {}
+                        if not isinstance(req, dict):
+                            raise ValueError(
+                                "request body must be a JSON object")
+                        deadline = outer._request_deadline(req,
+                                                           self.headers)
+                        with outer._admit(deadline):
+                            if self.path == "/generate":
+                                stream = bool(req.pop("stream", False))
+                                it = outer.generate_steps(
+                                    req, deadline=deadline)
+                                if stream:
+                                    # pull the first item BEFORE sending
+                                    # the 200 header so request errors
+                                    # (bad shape, no generator) still
+                                    # surface as a real error status
+                                    import itertools
+                                    first = next(it)
+                                    exc = self._stream_reply(
+                                        itertools.chain([first], it),
+                                        src=it)
+                                    if exc is not None:
+                                        raise _StreamAborted(str(exc)) \
+                                            from exc
+                                    outer._count("ok")
+                                    outer._finish_request(ctx, "ok")
+                                    return
+                                steps = [o for o in it if "tokens" in o]
                                 outer._count("ok")
-                                return
-                            steps = [o for o in it if "tokens" in o]
+                                outer._finish_request(ctx, "ok")
+                                return self._reply(200, {
+                                    "sequences": [
+                                        [s["tokens"][b] for s in steps]
+                                        for b in
+                                        range(len(steps[0]["tokens"]))]
+                                    if steps else []})
+                            out = outer.predict(req.get("inputs", {}),
+                                                deadline=deadline)
                             outer._count("ok")
-                            return self._reply(200, {
-                                "sequences": [
-                                    [s["tokens"][b] for s in steps]
-                                    for b in
-                                    range(len(steps[0]["tokens"]))]
-                                if steps else []})
-                        out = outer.predict(req.get("inputs", {}),
-                                            deadline=deadline)
-                        outer._count("ok")
-                        return self._reply(200, {"outputs": out})
-                except _StreamAborted:
-                    # the 200 + error chunk are already on the wire; no
-                    # reply possible, but _admit recorded the breaker
-                    # failure on the way here
-                    outer._count("server_error")
-                    return
-                except OverloadError as e:
-                    outer._count(e.counter)
-                    return self._reply(e.status, {"error": str(e)},
-                                       retry_after=e.retry_after)
-                except outer._CLIENT_ERRORS as e:
-                    outer._count("client_error")
-                    return self._reply(400, {"error": str(e)})
-                except Exception as e:      # noqa: BLE001
-                    outer._count("server_error")
-                    return self._reply(500, {"error": str(e)})
+                            outer._finish_request(ctx, "ok")
+                            return self._reply(200, {"outputs": out})
+                    except _StreamAborted:
+                        # the 200 + error chunk are already on the wire;
+                        # no reply possible, but _admit recorded the
+                        # breaker failure on the way here
+                        outer._count("server_error")
+                        outer._finish_request(ctx, "server_error")
+                        return
+                    except OverloadError as e:
+                        outer._count(e.counter)
+                        outer._finish_request(ctx, e.counter)
+                        return self._reply(e.status, {"error": str(e)},
+                                           retry_after=e.retry_after)
+                    except outer._CLIENT_ERRORS as e:
+                        outer._count("client_error")
+                        outer._finish_request(ctx, "client_error")
+                        return self._reply(400, {"error": str(e)})
+                    except Exception as e:      # noqa: BLE001
+                        outer._count("server_error")
+                        outer._finish_request(ctx, "server_error")
+                        return self._reply(500, {"error": str(e)})
+                finally:
+                    if cv_token is not None:
+                        obs_requests.reset_current(cv_token)
+                    # backstop for paths that bypassed the handlers
+                    # above (finish is idempotent: first reason wins)
+                    outer._finish_request(ctx, "server_error")
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
@@ -537,6 +618,25 @@ class PredictorServer:
     # -- overload gate ------------------------------------------------------
     def _count(self, key):
         self.metrics.inc("serving.requests", outcome=key)
+
+    @staticmethod
+    def _finish_request(ctx, reason):
+        """None-tolerant RequestContext.finish (idempotent: a request
+        the engine already retired keeps its engine-side outcome)."""
+        if ctx is not None:
+            ctx.finish(reason)
+
+    def queue_depth(self):
+        """Requests waiting for execution: buffered in the batcher
+        plus pending engine admission — the /readyz 503 body's load
+        number (advisory: both queues mutate concurrently)."""
+        d = 0
+        if self.batcher is not None:
+            d += len(self.batcher._buf)
+        g = self.generator
+        if g is not None and hasattr(g, "_pending"):
+            d += len(g._pending)
+        return d
 
     def _request_deadline(self, req, headers):
         """Deadline from the X-Timeout-Ms header, the `timeout_ms` body
@@ -575,6 +675,10 @@ class PredictorServer:
         except BaseException:
             self.admission.release()
             raise
+        if observability.ENABLED:
+            ctx = obs_requests.current()
+            if ctx is not None:
+                ctx.record("admitted")
         t0 = time.monotonic()
         try:
             yield
@@ -753,8 +857,16 @@ class PredictorServer:
                 q.put(e)
             q.put(_END)
 
-        t = threading.Thread(target=produce, daemon=True)
+        # run the producer under a COPY of this thread's contextvars
+        # context: the engine's submit() happens on the producer thread
+        # and must see the same RequestContext the handler bound
+        run_ctx = contextvars.copy_context()
+        t = threading.Thread(target=run_ctx.run, args=(produce,),
+                             daemon=True)
         t.start()
+        ctx = obs_requests.current() if observability.ENABLED else None
+        eos = kw.get("eos_token_id")
+        finished_rows = None        # per-row EOS tracking (pad filter)
         try:
             while True:
                 item = q.get()
@@ -762,6 +874,29 @@ class PredictorServer:
                     return
                 if isinstance(item, Exception):
                     raise item
+                if ctx is not None and not ctx.tokens_claimed \
+                        and "tokens" in item:
+                    # generators that trace their own emissions
+                    # (PagedKVEngine) claim token accounting at
+                    # submit; everything else is recorded here, at
+                    # the step the HTTP consumer actually saw. Two
+                    # multi-row corrections: a row that hit EOS keeps
+                    # yielding pad_token_id until the whole batch
+                    # drains (generate_stream contract) — pads are
+                    # not generated tokens; and each live row gets
+                    # ONE token per step, so its user-felt ITL is the
+                    # FULL step gap — per-row gap clocks (stream=i),
+                    # not one shared clock that would divide the gap
+                    # by the batch width and flatter the SLO.
+                    toks = item["tokens"]
+                    if finished_rows is None:
+                        finished_rows = [False] * len(toks)
+                    for i, tok in enumerate(toks):
+                        if finished_rows[i]:
+                            continue
+                        ctx.record_tokens(1, stream=i)
+                        if eos is not None and tok == eos:
+                            finished_rows[i] = True
                 yield item
         finally:
             # a disconnected /generate client closes this generator;

@@ -44,14 +44,17 @@ import os
 
 from paddle_tpu.observability import metrics as metrics  # noqa: PLC0414
 from paddle_tpu.observability import trace as trace      # noqa: PLC0414
+from paddle_tpu.observability import requests as requests  # noqa: PLC0414
 from paddle_tpu.observability.metrics import (
     METRICS, MetricsRegistry, REGISTRY)
 from paddle_tpu.observability.trace import Span, export_chrome_trace
+from paddle_tpu.observability.requests import RequestContext
 
 __all__ = [
     "ENABLED", "enable", "disable", "scoped", "inc", "observe",
     "set_gauge", "span", "METRICS", "MetricsRegistry", "REGISTRY",
-    "Span", "export_chrome_trace", "metrics", "trace",
+    "Span", "export_chrome_trace", "metrics", "trace", "requests",
+    "RequestContext",
 ]
 
 # the ONE attribute hot paths branch on
@@ -65,6 +68,7 @@ def enable(reset=False):
     if reset:
         REGISTRY.reset()
         trace.clear()
+        requests.clear()
     ENABLED = True
 
 

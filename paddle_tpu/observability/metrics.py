@@ -151,6 +151,37 @@ METRICS = {
                                          "buffered"),
     "serving.batcher.shed_full": ("gauge",
                                   "requests shed on a full buffer"),
+    # -- per-request serving SLOs (observability/requests.py) ---------
+    "request.ttft.seconds": ("histogram",
+                             "time to first generated token, from "
+                             "request-context creation (HTTP arrival "
+                             "or engine submit) — the user-felt SLO",
+                             DEFAULT_BUCKETS_S),
+    "request.itl.seconds": ("histogram",
+                            "inter-token latency: per-token mean gap "
+                            "between successive decode emissions "
+                            "(one observation per fused tick)",
+                            DEFAULT_BUCKETS_S),
+    "request.queue_wait.seconds": ("histogram",
+                                   "wait between queued and scheduled "
+                                   "(batch formed / engine slot "
+                                   "assigned)", DEFAULT_BUCKETS_S),
+    "request.prefill.seconds": ("histogram",
+                                "prompt prefill wall time per request",
+                                DEFAULT_BUCKETS_S),
+    "request.tokens": ("histogram",
+                       "generated tokens per finished request",
+                       (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                        256.0, 512.0, 1024.0, 2048.0, 4096.0)),
+    "request.outcome": ("counter",
+                        "finished requests by outcome (label: reason "
+                        "= finished | ok | shed_* | deadline_exceeded "
+                        "| expired | cancelled | disconnected | "
+                        "client_error | server_error | error)"),
+    "request.slow_exemplars": ("counter",
+                               "requests breaching the slow-request "
+                               "threshold whose lifecycle was dumped "
+                               "into the span ring"),
     # -- paged KV engine ----------------------------------------------
     "inference.decode.kernel": ("counter",
                                 "decode ticks by attend path (label: "

@@ -25,8 +25,8 @@ import os
 import threading
 import time
 
-__all__ = ["Span", "set_ring_capacity", "ring_capacity", "spans",
-           "clear", "export_chrome_trace", "chrome_events"]
+__all__ = ["Span", "record_span", "set_ring_capacity", "ring_capacity",
+           "spans", "clear", "export_chrome_trace", "chrome_events"]
 
 _DEFAULT_CAPACITY = 4096
 
@@ -82,6 +82,23 @@ class Span:
         with _lock:
             _ring.append(self)
         return False
+
+
+def record_span(name, t0, dur_us, *, depth=0, tid=None, attrs=None):
+    """Append an externally-timed completed span to the ring. The
+    slow-request exemplar path (observability/requests.py) rebuilds a
+    request's lifecycle from its recorded timeline after the fact
+    rather than timing a live scope; `t0` must be a
+    time.perf_counter() value so the span lands on the same timeline
+    as live span() scopes."""
+    s = Span(name, attrs or {})
+    s.t0 = float(t0)
+    s.dur_us = float(dur_us)
+    s.depth = int(depth)
+    s.tid = int(tid) if tid is not None else threading.get_ident()
+    with _lock:
+        _ring.append(s)
+    return s
 
 
 def spans() -> list:

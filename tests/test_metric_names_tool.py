@@ -52,6 +52,30 @@ def test_catalogue_covers_the_acceptance_metrics():
         assert name in METRICS, name
 
 
+def test_catalogue_gate_covers_request_tracing():
+    """ISSUE 7: the gate audits observability/requests.py like any
+    other module (it is NOT in the tool's ALLOWED skip set), and every
+    catalogued request.* SLO instrument is actually recorded by a
+    literal call site there — the catalogue and the request-tracing
+    layer cannot drift apart."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("check_metric_names",
+                                                  _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert not any("requests.py" in p for p in mod.ALLOWED)
+    violations, seen, catalogue = mod.scan(_ROOT)
+    assert violations == []
+    request_names = {n for n in catalogue if n.startswith("request.")}
+    for expected in ("request.ttft.seconds", "request.itl.seconds",
+                     "request.queue_wait.seconds",
+                     "request.prefill.seconds", "request.tokens",
+                     "request.outcome"):
+        assert expected in request_names
+    missing = request_names - seen
+    assert not missing, f"catalogued but never recorded: {missing}"
+
+
 def test_detects_unregistered_and_nonliteral(tmp_path):
     root = _mini_tree(tmp_path, {"ok.metric": ("counter", "fine")}, """
         from paddle_tpu import observability as obs

@@ -607,6 +607,36 @@ def test_engine_stream_deadline_threads_through():
     eng.stop()
 
 
+def test_stream_partial_admission_failure_cancels_submitted_rows():
+    """A non-overload failure on a later row (per-row page-count
+    validation) must cancel the rows already admitted — they would
+    otherwise keep decoding to max_new_tokens for a caller that
+    already got the exception."""
+    import time
+    eng = PagedKVEngine(_model(), max_slots=2, page_size=4, num_pages=16,
+                        max_pages_per_slot=3, steps_per_tick=2)
+    ids = np.tile(np.arange(1, 11, dtype=np.int32), (2, 1))
+    mask = np.ones_like(ids, bool)
+    mask[0, 2:] = False     # row 0: 2 tokens + 8 new -> fits (3 pages)
+    #                         row 1: 10 tokens + 8 new -> needs 5 > 3
+    it = eng.stream(ids, max_new_tokens=8, attention_mask=mask)
+    try:
+        with pytest.raises(ValueError, match="max_pages_per_slot"):
+            next(it)
+        for _ in range(200):
+            if not eng.has_work():
+                break
+            time.sleep(0.05)
+        assert not eng.has_work()
+        assert eng.stats["cancelled"] == 1
+        # same steady state the cancel-frees test pins: at most the
+        # retired slot's residual page stays out of the pool
+        assert len(eng._free) >= eng.num_pages - 1
+        assert eng._reserved_unalloc == 0
+    finally:
+        eng.stop()
+
+
 # -- Pallas decode kernel + int8 KV (ISSUE 6) -------------------------------
 
 def test_pallas_kernel_greedy_parity_vs_jnp():

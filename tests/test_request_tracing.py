@@ -1,0 +1,716 @@
+"""Per-request tracing & serving SLO telemetry
+(observability/requests.py wired through inference/serving.py and
+inference/paged.py) — ISSUE 7.
+
+The load-bearing scenarios (the acceptance bar):
+
+- end-to-end propagation: an inbound W3C `traceparent` is adopted,
+  echoed on the streamed reply (same trace id, a NEW parent span id),
+  visible mid-flight in GET /debug/requests, and — via the
+  slow-request exemplar sampler — reconstructable as a nested span
+  timeline in export_chrome_trace output, all carrying the same
+  request id / trace id;
+- TTFT / ITL histograms record under a chaos-delayed engine tick
+  (`engine.tick.delay`), with TTFT reflecting the injected delay;
+- disabled (the default), the entire path creates NO context, echoes
+  NO headers, and records NO metric or span — asserted by making
+  context construction itself raise;
+- the /readyz 503 body carries machine-readable `in_flight`,
+  `queue_depth`, `retry_after_s` numbers next to the `reason` prose.
+
+Fake token sources keep the HTTP tests model-free (the
+test_serving_overload.py idiom); the chaos-tick test drives a real
+PagedKVEngine. Everything is event- or chaos-deterministic.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed import chaos
+from paddle_tpu.observability import requests as obs_requests
+from paddle_tpu.observability import trace
+from paddle_tpu.observability.requests import (RequestContext,
+                                               parse_traceparent)
+
+# servers, stream producers, and engine tickers own threads
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
+_TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+_TRACE_ID = "ab" * 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Observability and the request registry are process-global;
+    every test starts disabled/empty and restores the exemplar
+    config."""
+    cfg = obs_requests.CONFIG
+    saved = (cfg.slow_ttft_s, cfg.slow_total_s, cfg.live_capacity,
+             cfg.max_events)
+    obs.disable()
+    obs.REGISTRY.reset()
+    trace.clear()
+    obs_requests.clear()
+    yield
+    obs.disable()
+    obs.REGISTRY.reset()
+    trace.clear()
+    obs_requests.clear()
+    (cfg.slow_ttft_s, cfg.slow_total_s, cfg.live_capacity,
+     cfg.max_events) = saved
+
+
+def _req(port, path, obj=None, headers=None):
+    """(status, body_dict, headers_dict) for one HTTP round trip."""
+    url = f"http://127.0.0.1:{port}{path}"
+    data = None if obj is None else json.dumps(obj).encode()
+    r = urllib.request.Request(url, data=data,
+                               headers={"Content-Type":
+                                        "application/json",
+                                        **(headers or {})})
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            return resp.status, json.loads(resp.read()), dict(
+                resp.headers)
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, json.loads(body) if body else {}, dict(e.headers)
+
+
+def _wait_for(cond, timeout=10.0, what="condition"):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# -- W3C trace-context parsing ----------------------------------------------
+
+def test_parse_traceparent_valid():
+    tid, pid, flags = parse_traceparent(_TP)
+    assert tid == _TRACE_ID and pid == "cd" * 8 and flags == 1
+    # surrounding whitespace is tolerated
+    assert parse_traceparent("  " + _TP + " ") == (tid, pid, 1)
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "nonsense",
+    "00-" + "ab" * 16 + "-" + "cd" * 8,          # missing flags
+    "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # forbidden version
+    "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",   # all-zero trace id
+    "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # all-zero parent id
+    "00-" + "xy" * 16 + "-" + "cd" * 8 + "-01",  # non-hex
+    "00-" + "ab" * 15 + "-" + "cd" * 8 + "-01",  # short trace id
+    _TP + "-extradata",     # version 00 defines EXACTLY four fields
+    _TP.upper(),            # spec: hex MUST be lowercase; ignore, don't
+    #                         silently join an uppercase trace id
+])
+def test_parse_traceparent_invalid_is_ignored(bad):
+    # per spec an invalid header starts a fresh trace, never errors
+    assert parse_traceparent(bad) is None
+
+
+def test_from_headers_adopts_and_generates():
+    ctx = RequestContext.from_headers({"traceparent": _TP,
+                                       "X-Request-Id": "my-req-7"})
+    assert ctx.trace_id == _TRACE_ID
+    assert ctx.parent_id == "cd" * 8
+    assert ctx.request_id == "my-req-7"
+    # outbound: same trace id, OUR span id as the new parent
+    out = ctx.traceparent()
+    assert out.startswith("00-" + _TRACE_ID + "-")
+    assert out.split("-")[2] == ctx.span_id != ctx.parent_id
+    fresh = RequestContext.from_headers({})
+    assert fresh.parent_id is None
+    assert len(fresh.trace_id) == 32 and fresh.request_id.startswith(
+        "req-")
+
+
+@pytest.mark.parametrize("bad", [
+    "abc\r\nEvil: 1",       # CRLF injection (obs-folded header value)
+    "abc\rEvil",
+    "abc\nEvil",
+    "abc def",              # whitespace is not a token char
+    "abc\"quoted\"",
+    "x" * 129,              # over the length bound
+    "",
+])
+def test_unsafe_request_id_is_replaced(bad):
+    """The adopted id is echoed via send_header(); a CR/LF-bearing or
+    oversized inbound value is a response-header injection vector and
+    must be replaced with a generated id, never echoed."""
+    ctx = RequestContext.from_headers({"X-Request-Id": bad})
+    assert ctx.request_id.startswith("req-")
+
+
+def test_configure_coerces_thresholds_on_callers_thread():
+    """A bad threshold must raise at configure() time — stored raw,
+    the first comparison happens inside finish(), which on the engine
+    path runs on the ticker thread and would kill it."""
+    obs_requests.configure(slow_ttft_s="0.25", slow_total_s=None)
+    assert obs_requests.CONFIG.slow_ttft_s == 0.25
+    assert obs_requests.CONFIG.slow_total_s is None
+    with pytest.raises(ValueError):
+        obs_requests.configure(slow_ttft_s="not-a-number")
+    assert obs_requests.CONFIG.slow_ttft_s == 0.25  # not clobbered
+
+
+def test_multirow_pad_emissions_not_counted():
+    """generate_stream contract: a row that hit EOS keeps yielding
+    pad_token_id until ALL rows finish. Those pads are not generated
+    tokens — the HTTP-side accounting (non-engine sources) must count
+    only rows still live, or request.tokens inflates and ITL reads
+    better than reality."""
+    from paddle_tpu.inference.serving import PredictorServer
+
+    class TwoRow:
+        def stream(self, ids, **kw):
+            def gen():
+                yield np.asarray([5, 21])
+                yield np.asarray([9, 22])   # 9 == EOS: row 0 done
+                yield np.asarray([0, 23])   # row 0 pads from here
+                yield np.asarray([0, 24])
+            return gen()
+
+    obs.enable(reset=True)
+    srv = PredictorServer(lambda d: d, generator=TwoRow())
+    ctx = obs_requests.register(RequestContext.new())
+    token = obs_requests.set_current(ctx)
+    try:
+        steps = [o for o in srv.generate_steps(
+            {"ids": [[1], [2]], "max_new_tokens": 4, "eos_token_id": 9})
+            if "tokens" in o]
+    finally:
+        obs_requests.reset_current(token)
+    assert len(steps) == 4              # the stream itself is unchanged
+    # 2 (both live) + 2 (row 0's EOS counts) + 1 + 1, not 8
+    assert ctx.tokens == 6
+    ctx.finish("finished")
+    assert obs.REGISTRY.histogram("request.tokens").count() == 1
+
+
+# -- timeline + instrument derivation ---------------------------------------
+
+def test_malformed_slow_threshold_env_is_ignored(monkeypatch):
+    """A typo'd ops knob must not make `import paddle_tpu` raise."""
+    monkeypatch.setenv("PADDLE_TPU_SLOW_TTFT_S", "abc")
+    monkeypatch.setenv("PADDLE_TPU_SLOW_TOTAL_S", "1.5")
+    cfg = obs_requests._Config()
+    assert cfg.slow_ttft_s is None      # malformed -> not armed
+    assert cfg.slow_total_s == 1.5
+
+
+def test_record_rejects_uncatalogued_events():
+    ctx = RequestContext.new()
+    with pytest.raises(KeyError, match="EVENTS"):
+        ctx.record("totally_new_event")
+
+
+def test_phase_instruments_derive_from_timeline():
+    ctx = RequestContext.new()
+    ctx.record("queued")
+    ctx.record("scheduled")
+    ctx.record("prefill_start")
+    ctx.record("prefill_end")
+    ctx.record_tokens(2)                 # first_token (+1 fused token)
+    ctx.record_tokens(3)                 # a later tick -> ITL
+    assert obs.REGISTRY.histogram("request.queue_wait.seconds") \
+        .count() == 1
+    assert obs.REGISTRY.histogram("request.prefill.seconds").count() == 1
+    assert obs.REGISTRY.histogram("request.ttft.seconds").count() == 1
+    assert obs.REGISTRY.histogram("request.itl.seconds").count() == 1
+    assert ctx.tokens == 5
+    names = [e[0] for e in ctx.timeline()]
+    assert names == ["queued", "scheduled", "prefill_start",
+                     "prefill_end", "first_token", "tokens", "tokens"]
+
+
+def test_queue_wait_clock_is_per_row():
+    """A multi-row request queues each engine row at its own time;
+    each row's queue_wait must be measured against ITS queued instant
+    (rid-keyed), not whichever sibling queued last."""
+    ctx = RequestContext.new()
+    t0 = ctx.record("queued", rid=0)
+    time.sleep(0.05)
+    ctx.record("queued", rid=1)         # must not reset row 0's clock
+    time.sleep(0.01)
+    t_sched = ctx.record("scheduled", rid=0)
+    assert t_sched - t0 >= 0.05         # row 0's true wait
+    h = obs.REGISTRY.histogram("request.queue_wait.seconds")
+    assert h.count() == 1
+    # against row 1's clock the wait would be ~10ms; row 0's own
+    # queued instant puts the observation in a >=50ms bucket
+    assert h.percentile(50) >= 0.05
+    ctx.record("scheduled", rid=1)
+    assert h.count() == 2
+    ctx.record("scheduled", rid=1)      # unmatched re-schedule: no obs
+    assert h.count() == 2
+    # prefill gets the same rid-keyed clock: two rows prefilling in one
+    # engine group must record one observation each, against their own
+    # start — start/start/end/end is the interleaving a grouped
+    # prefill produces
+    ctx.record("prefill_start", rid=0)
+    ctx.record("prefill_start", rid=1)
+    ctx.record("prefill_end", rid=0)
+    ctx.record("prefill_end", rid=1)
+    hp = obs.REGISTRY.histogram("request.prefill.seconds")
+    assert hp.count() == 2
+    ctx.record("prefill_end", rid=1)    # unmatched: no observation
+    assert hp.count() == 2
+
+
+def test_terminal_event_survives_a_full_timeline():
+    """The exactly-one-terminal-event contract holds even when tokens
+    ticks filled the timeline to max_events — the exemplar dump and
+    stage() need the terminal mark, so finish() bypasses the cap."""
+    obs_requests.configure(max_events=4)
+    ctx = RequestContext.new()
+    for _ in range(10):
+        ctx.record_tokens(1)
+    assert len(ctx.timeline()) == 4 and ctx.dropped_events == 6
+    ctx.finish("finished")
+    assert ctx.timeline()[-1][0] == "finished"
+    assert ctx.stage() == "finished"
+
+
+def test_finish_is_idempotent_first_reason_wins():
+    ctx = obs_requests.register(RequestContext.new())
+    ctx.record_tokens(4)
+    assert obs_requests.live_count() == 1
+    assert ctx.finish("finished") is True
+    assert ctx.finish("server_error") is False       # first wins
+    assert ctx.outcome == "finished"
+    assert obs_requests.live_count() == 0            # unregistered
+    assert obs.REGISTRY.counter("request.outcome").value(
+        reason="finished") == 1
+    assert obs.REGISTRY.counter("request.outcome").value(
+        reason="server_error") == 0
+    assert obs.REGISTRY.histogram("request.tokens").percentile(50) == 4
+
+
+def test_no_recording_past_the_terminal_event():
+    """A layer still holding a finished context (the batcher
+    scheduling a deadline-expired request) must not grow the timeline
+    or skew the SLO histograms."""
+    ctx = RequestContext.new()
+    ctx.record("queued")
+    ctx.finish("deadline_exceeded")
+    ctx.record("scheduled")             # the batcher, too late
+    ctx.record_tokens(5)                # a straggler emission
+    assert [e[0] for e in ctx.timeline()] == ["queued", "expired"]
+    assert ctx.tokens == 0
+    assert obs.REGISTRY.histogram("request.queue_wait.seconds") \
+        .count() == 0
+    assert obs.REGISTRY.histogram("request.ttft.seconds").count() == 0
+
+
+def test_engine_refcount_last_row_finishes_abnormal_reason_wins():
+    """adopt_engine/engine_finish: a multi-row request's context
+    reaches its terminal state only when the LAST row retires, and an
+    abnormal row outcome beats rows that completed normally."""
+    ctx = obs_requests.register(RequestContext.new())
+    ctx.adopt_engine()
+    ctx.adopt_engine()
+    assert ctx.engine_finish("expired") is False    # one row still live
+    assert not ctx.finished
+    assert obs_requests.live_count() == 1
+    assert ctx.engine_finish("finished") is True    # last release
+    assert ctx.outcome == "expired"                 # abnormal wins
+    assert obs_requests.live_count() == 0
+
+
+def test_live_registry_and_timeline_are_bounded():
+    obs_requests.configure(live_capacity=4, max_events=8)
+    ctxs = [obs_requests.register(RequestContext.new())
+            for _ in range(7)]
+    assert obs_requests.live_count() == 4       # oldest 3 evicted
+    live_ids = {r["request_id"] for r in obs_requests.live_requests()}
+    assert live_ids == {c.request_id for c in ctxs[3:]}
+    ctx = ctxs[-1]
+    for _ in range(20):
+        ctx.record("queued")
+    assert len(ctx.timeline()) == 8
+    assert ctx.dropped_events == 12             # counted, never grown
+
+
+def test_slow_request_exemplar_dumps_nested_spans():
+    obs_requests.configure(slow_ttft_s=0.0)     # any TTFT breaches
+    ctx = obs_requests.register(
+        RequestContext.from_headers({"traceparent": _TP}))
+    ctx.record("queued")
+    ctx.record("scheduled")
+    ctx.record_tokens(1)
+    ctx.record_tokens(1)
+    ctx.finish("finished")
+    assert obs.REGISTRY.counter("request.slow_exemplars").value() == 1
+    evs = trace.chrome_events()
+    by_name = {e["name"]: e for e in evs}
+    root = by_name["request"]
+    assert root["args"]["request_id"] == ctx.request_id
+    assert root["args"]["trace_id"] == _TRACE_ID
+    assert root["args"]["outcome"] == "finished"
+    # phase spans nest under the root; event marks at depth 2; the
+    # whole lifecycle shares one synthetic track (tid)
+    assert by_name["queue_wait"]["args"]["depth"] == 1
+    assert by_name["decode"]["args"]["depth"] == 1
+    assert by_name["ev.first_token"]["args"]["depth"] == 2
+    assert len({e["tid"] for e in evs}) == 1
+    # under threshold -> no dump
+    trace.clear()
+    obs_requests.configure(slow_ttft_s=1e9)
+    c2 = RequestContext.new()
+    c2.record_tokens(1)
+    c2.finish("finished")
+    assert trace.spans() == []
+
+
+# -- fake streaming backends (test_serving_overload.py idiom) ---------------
+
+class _GatedSource:
+    """stream() yields a first token immediately, then waits for
+    `release` before each of the remaining n-1 — so a test can hold a
+    request mid-stream and inspect /debug/requests."""
+
+    def __init__(self, n=3):
+        self.n = n
+        self.release = threading.Event()
+
+    def stream(self, ids, **kw):
+        def gen():
+            yield np.asarray([11])
+            for i in range(self.n - 1):
+                assert self.release.wait(timeout=30)
+                yield np.asarray([12 + i])
+        return gen()
+
+
+# -- end-to-end propagation through the HTTP server -------------------------
+
+def test_e2e_traceparent_streamed_echo_debug_view_and_chrome_trace():
+    """The acceptance-bar flow: inbound traceparent -> echoed on the
+    SSE stream -> same ids in /debug/requests mid-flight -> TTFT/ITL/
+    outcome instruments -> exemplar span timeline in the chrome
+    trace."""
+    import http.client
+    from paddle_tpu.inference.serving import PredictorServer
+    obs.enable(reset=True)
+    obs_requests.configure(slow_ttft_s=0.0)     # exemplar every request
+    gated = _GatedSource(n=3)
+    srv = PredictorServer(lambda d: d, generator=gated).start()
+    try:
+        conn = http.client.HTTPConnection(srv.host, srv.port,
+                                          timeout=30)
+        conn.request("POST", "/generate",
+                     json.dumps({"ids": [[1, 2]], "max_new_tokens": 3,
+                                 "stream": True}),
+                     {"Content-Type": "application/json",
+                      "traceparent": _TP, "X-Request-Id": "my-req-7"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        # echo contract: request id verbatim; same trace id with a
+        # fresh 16-hex parent span id (not the inbound caller's)
+        assert resp.getheader("X-Request-Id") == "my-req-7"
+        echoed = parse_traceparent(resp.getheader("traceparent"))
+        assert echoed is not None
+        tid, parent, _flags = echoed
+        assert tid == _TRACE_ID and parent != "cd" * 8
+        first = json.loads(resp.readline())
+        assert first["tokens"] == [11]
+        # mid-flight: the fleet router's view shows this request live
+        code, body, _h = _req(srv.port, "/debug/requests")
+        assert code == 200 and body["enabled"] is True
+        rows = {r["request_id"]: r for r in body["requests"]}
+        row = rows["my-req-7"]
+        assert row["trace_id"] == _TRACE_ID
+        assert row["stage"] == "first_token"
+        assert row["tokens"] == 1 and row["age_s"] >= 0.0
+        gated.release.set()
+        while True:                         # drain the chunked stream
+            if not resp.readline():
+                break
+        conn.close()
+        _wait_for(lambda: obs_requests.live_count() == 0,
+                  what="request to leave the in-flight registry")
+        reg = obs.REGISTRY
+        assert reg.histogram("request.ttft.seconds").count() == 1
+        assert reg.histogram("request.itl.seconds").count() == 2
+        assert reg.histogram("request.tokens").percentile(50) == 3
+        assert reg.counter("request.outcome").value(reason="ok") == 1
+        # the slow-request exemplar reconstructed the full lifecycle
+        doc = trace.export_chrome_trace()
+        by_name = {}
+        for e in doc["traceEvents"]:
+            by_name.setdefault(e["name"], e)
+        root = by_name["request"]
+        assert root["args"]["request_id"] == "my-req-7"
+        assert root["args"]["trace_id"] == _TRACE_ID
+        assert root["args"]["tokens"] == 3
+        assert "decode" in by_name and "ev.first_token" in by_name
+    finally:
+        srv.stop()
+
+
+def test_unary_reply_and_error_reply_echo_headers():
+    from paddle_tpu.inference.serving import PredictorServer
+    obs.enable(reset=True)
+    srv = PredictorServer(
+        lambda inputs: {"y": np.asarray([[2.0]], np.float32)}).start()
+    try:
+        code, _body, hdrs = _req(
+            srv.port, "/predict", {"inputs": {"x": [[1.0]]}},
+            headers={"traceparent": _TP})
+        assert code == 200
+        tid, _pid, _fl = parse_traceparent(hdrs["traceparent"])
+        assert tid == _TRACE_ID
+        assert hdrs["X-Request-Id"].startswith("req-")
+        assert obs.REGISTRY.counter("request.outcome").value(
+            reason="ok") == 1
+        # a 400 is still a traced outcome, echoed the same way
+        code, _body, hdrs = _req(srv.port, "/predict",
+                                 [1, 2],        # body must be an object
+                                 headers={"traceparent": _TP})
+        assert code == 400
+        assert parse_traceparent(hdrs["traceparent"])[0] == _TRACE_ID
+        assert obs.REGISTRY.counter("request.outcome").value(
+            reason="client_error") == 1
+        _wait_for(lambda: obs_requests.live_count() == 0,
+                  what="contexts to retire")
+    finally:
+        srv.stop()
+
+
+def test_readyz_503_body_carries_numeric_load_fields():
+    """Satellite: the fleet router needs numbers, not prose."""
+    from paddle_tpu.inference.serving import PredictorServer
+    srv = PredictorServer(
+        lambda inputs: {"y": np.asarray([[2.0]], np.float32)},
+        retry_after_s=2.5).start()
+    try:
+        srv._draining = True
+        code, body, _h = _req(srv.port, "/readyz")
+        assert code == 503
+        assert body["reason"] == "draining"
+        assert body["in_flight"] == 0
+        assert body["queue_depth"] == 0
+        assert body["retry_after_s"] == 2.5
+        srv._draining = False
+        code, body, _h = _req(srv.port, "/readyz")
+        assert code == 200 and body["status"] == "ready"
+    finally:
+        srv.stop()
+
+
+# -- real engine under a chaos-delayed tick ---------------------------------
+
+def _model(seed=0):
+    from paddle_tpu.models.llama import LlamaForCausalLM, \
+        tiny_llama_config
+    paddle_tpu.seed(seed)
+    cfg = tiny_llama_config(num_hidden_layers=2, vocab_size=97,
+                            hidden_size=32, intermediate_size=64,
+                            num_attention_heads=4,
+                            num_key_value_heads=2)
+    return LlamaForCausalLM(cfg)
+
+
+def test_ttft_itl_histograms_under_chaos_delayed_tick():
+    """A direct PagedKVEngine stream (no HTTP layer): the engine
+    creates its own context, and an injected `engine.tick.delay`
+    stretches the tick the first token rides — so the recorded TTFT
+    must reflect the injected delay, and ITL records once per
+    subsequent emission."""
+    from paddle_tpu.inference.paged import PagedKVEngine
+    eng = PagedKVEngine(_model(), max_slots=2, page_size=4,
+                        num_pages=24, max_pages_per_slot=6,
+                        steps_per_tick=2)
+    try:
+        with obs.scoped(reset=True) as reg:
+            with chaos.scoped(seed=0,
+                              rates={"engine.tick.delay": 1.0},
+                              delay_ms=25.0):
+                steps = list(eng.stream(np.asarray([[5, 9, 2]],
+                                                   np.int32),
+                                        max_new_tokens=6))
+            assert len(steps) == 6
+            ttft = reg.histogram("request.ttft.seconds")
+            assert ttft.count() == 1
+            # the first emission rode a tick whose start was delayed
+            # 25 ms; TTFT is measured from submit so it must include it
+            assert ttft.percentile(50) >= 0.02
+            itl = reg.histogram("request.itl.seconds")
+            # emissions: prefill's first token, then fused decode
+            # ticks of 2, 2, 1 — the first is TTFT, the other three
+            # are ITL observations
+            assert itl.count() == 3
+            assert itl.percentile(50) > 0.0
+            assert reg.counter("request.outcome").value(
+                reason="finished") == 1
+            assert reg.histogram("request.tokens").percentile(50) == 6
+            assert obs_requests.live_count() == 0
+    finally:
+        eng.stop()
+
+
+def test_itl_gap_clock_is_per_stream():
+    """Sibling rows of a multi-row request emit microseconds apart in
+    the same engine tick; each row's ITL must be measured against ITS
+    OWN previous emission, never a sibling's."""
+    ctx = RequestContext.new()
+    ctx.record_tokens(1, stream="a")        # first overall -> TTFT
+    ctx.record_tokens(1, stream="b")        # b's first -> no gap yet
+    h = obs.REGISTRY.histogram("request.itl.seconds")
+    assert h.count() == 0
+    time.sleep(0.012)
+    ctx.record_tokens(1, stream="a")        # gap vs a's own last
+    ctx.record_tokens(1, stream="b")        # gap vs b's own last —
+    assert h.count() == 2                   # NOT the ~0 gap vs a's
+    assert h.percentile(0) >= 0.01          # emission just above
+
+
+def test_engine_error_finishes_context_with_error_outcome(monkeypatch):
+    """A ticker crash must report traced requests as outcome "error"
+    (with the error fanned out to waiters), not as a normal
+    completion — whether the request was decoding in a slot or still
+    pending."""
+    from paddle_tpu.inference.paged import PagedKVEngine
+    eng = PagedKVEngine(_model(), max_slots=1, page_size=4,
+                        num_pages=24, max_pages_per_slot=6,
+                        steps_per_tick=2)
+    try:
+        with obs.scoped(reset=True) as reg:
+            r1 = eng.submit(np.asarray([5, 9, 2], np.int32), 8)
+            r2 = eng.submit(np.asarray([1, 2], np.int32), 4)
+            assert eng.step() is True       # r1 in a slot, r2 pending
+            assert not r1.obs.finished
+
+            def boom(*a, **k):
+                raise RuntimeError("chip fell over")
+            monkeypatch.setattr(eng, "_slot_arrays", boom)
+            with pytest.raises(RuntimeError, match="chip fell over"):
+                eng._ticker_loop()          # the crash-cleanup path
+            assert r1.done.is_set() and r2.done.is_set()
+            assert r1.obs.outcome == "error"
+            assert r2.obs.outcome == "error"
+            assert reg.counter("request.outcome").value(
+                reason="error") == 2
+            assert obs_requests.live_count() == 0
+    finally:
+        eng.stop()
+
+
+def test_shed_submit_releases_its_context_ref():
+    """An EngineOverloaded shed finishes the shed row's context
+    "shed_engine" (the row never entered the queue, so nothing else
+    would release it) without touching other live requests."""
+    from paddle_tpu.inference.overload import EngineOverloaded
+    from paddle_tpu.inference.paged import PagedKVEngine
+    eng = PagedKVEngine(_model(), max_slots=1, page_size=4,
+                        num_pages=9, steps_per_tick=2, max_pending=0)
+    try:
+        with obs.scoped(reset=True) as reg:
+            r1 = eng.submit([1, 2, 3], max_new_tokens=4)
+            with pytest.raises(EngineOverloaded):
+                eng.submit([1, 2, 3], max_new_tokens=4)
+            assert reg.counter("request.outcome").value(
+                reason="shed_engine") == 1
+            assert obs_requests.live_count() == 1   # only r1 lives
+            assert not r1.obs.finished
+            eng.run_until_idle()
+            assert r1.obs.outcome == "finished"
+            assert obs_requests.live_count() == 0
+    finally:
+        eng.stop()
+
+
+def test_multi_row_request_context_outlives_the_first_retired_row():
+    """Two engine rows sharing one serving-style ambient context: the
+    short row retiring must NOT finish the request — the context stays
+    live (and keeps recording tokens) until the last row retires, and
+    request.tokens records the TOTAL once."""
+    from paddle_tpu.inference.paged import PagedKVEngine
+    eng = PagedKVEngine(_model(), max_slots=2, page_size=4,
+                        num_pages=24, max_pages_per_slot=6,
+                        steps_per_tick=2)
+    try:
+        with obs.scoped(reset=True) as reg:
+            ctx = obs_requests.register(RequestContext.new())
+            token = obs_requests.set_current(ctx)
+            try:
+                r1 = eng.submit(np.asarray([5, 9, 2], np.int32), 2)
+                r2 = eng.submit(np.asarray([17, 3, 11, 4], np.int32), 6)
+            finally:
+                obs_requests.reset_current(token)
+            # one manual tick: prefill emits 1 token per row, the
+            # fused decode up to 2 more — row 1 (max 2) retires here
+            assert eng.step() is True
+            assert r1.done.is_set() and not r2.done.is_set()
+            assert not ctx.finished                 # row 2 still live
+            assert obs_requests.live_count() == 1
+            eng.run_until_idle()
+            assert r2.done.is_set()
+            assert ctx.finished and ctx.outcome == "finished"
+            assert ctx.tokens == 2 + 6              # BOTH rows counted
+            h = reg.histogram("request.tokens")
+            assert h.count() == 1 and h.percentile(50) == 8
+            assert obs_requests.live_count() == 0
+    finally:
+        eng.stop()
+
+
+# -- disabled path ----------------------------------------------------------
+
+def test_disabled_path_creates_no_context_and_records_nothing():
+    """With observability off (the default), the serving + batcher +
+    engine path must never construct a RequestContext, echo a tracing
+    header, or touch a request.* instrument — asserted by making
+    construction itself raise."""
+    from paddle_tpu.inference.serving import PredictorServer
+
+    class _Boom:
+        def __init__(self, *a, **k):
+            raise AssertionError(
+                "RequestContext constructed on the disabled path")
+        from_headers = new = __init__
+
+    real = obs_requests.RequestContext
+    obs_requests.RequestContext = _Boom
+    try:
+        assert obs.ENABLED is False
+        gated = _GatedSource(n=2)
+        gated.release.set()
+        srv = PredictorServer(
+            lambda inputs: {"y": np.asarray([[2.0]], np.float32)},
+            generator=gated).start()
+        try:
+            code, body, hdrs = _req(
+                srv.port, "/generate",
+                {"ids": [[1, 2]], "max_new_tokens": 2},
+                headers={"traceparent": _TP,
+                         "X-Request-Id": "my-req-7"})
+            assert code == 200 and body["sequences"] == [[11, 12]]
+            lower = {k.lower() for k in hdrs}
+            assert "traceparent" not in lower
+            assert "x-request-id" not in lower
+            # /debug/requests stays served (it reports the disablement)
+            code, body, _h = _req(srv.port, "/debug/requests")
+            assert code == 200
+            assert body == {"enabled": False, "count": 0,
+                            "requests": []}
+        finally:
+            srv.stop()
+    finally:
+        obs_requests.RequestContext = real
+    assert obs_requests.live_count() == 0
+    assert trace.spans() == []
+    assert obs.REGISTRY.histogram("request.ttft.seconds").count() == 0
+    assert obs.REGISTRY.counter("request.outcome").value(reason="ok") \
+        == 0

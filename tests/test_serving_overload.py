@@ -37,8 +37,7 @@ import pytest
 from paddle_tpu.distributed import chaos
 from paddle_tpu.inference.overload import (AdmissionController,
                                            CircuitBreaker, Deadline,
-                                           DeadlineExceeded,
-                                           LatencyStats)
+                                           DeadlineExceeded)
 from paddle_tpu.inference.serving import (DynamicBatcher, OversizedBatch,
                                           PredictorServer)
 
@@ -657,8 +656,14 @@ def test_readiness_warns_before_hard_429():
         ac.try_acquire()                # hard shed only past capacity
 
 
-def test_latency_stats_percentiles():
-    ls = LatencyStats(capacity=16)
+def test_registry_latency_percentiles():
+    """_RegistryLatency (the LatencyStats replacement: the old ring
+    class was removed in ISSUE 7) keeps the record-seconds /
+    snapshot-in-ms surface on top of the serving.request.latency_ms
+    histogram."""
+    from paddle_tpu.inference.serving import _RegistryLatency
+    from paddle_tpu.observability.metrics import MetricsRegistry
+    ls = _RegistryLatency(MetricsRegistry())
     assert ls.snapshot() == {"count": 0, "p50_ms": None, "p99_ms": None}
     for ms in range(1, 11):
         ls.record(ms / 1000.0)
@@ -666,6 +671,9 @@ def test_latency_stats_percentiles():
     assert snap["count"] == 10
     assert 4.0 <= snap["p50_ms"] <= 7.0
     assert snap["p99_ms"] >= 9.0
+    with pytest.raises(ImportError):
+        # retirement pin: nothing should quietly resurrect the ring
+        from paddle_tpu.inference.overload import LatencyStats  # noqa
 
 
 def test_deadline_helpers():
