@@ -228,7 +228,7 @@ def _note_fire(site: str) -> None:
         from paddle_tpu import observability
         if observability.ENABLED:
             observability.inc("chaos.injections", site=site)
-    except Exception:   # noqa: BLE001 — telemetry never breaks a fault
+    except Exception:   # lint: disable=silent-swallow -- telemetry must never turn a chaos fault into a crash
         pass
 
 

@@ -162,8 +162,8 @@ class ElasticManager:
                     # drain the writer before resuming: last_step() must
                     # not race an in-flight marker commit
                     self.flush()
-                except Exception:   # noqa: BLE001 — the torn save never
-                    pass            # marked latest.json; resume is older
+                except Exception:   # lint: disable=silent-swallow -- a torn save never marked latest.json; resume just restarts older
+                    pass
                 # resume loop from last checkpoint
 
     def close(self):
@@ -285,7 +285,7 @@ class ElasticSupervisor:
                 p.kill()
                 try:
                     p.wait(timeout=2)      # reap: no zombies per restart
-                except Exception:
+                except Exception:  # lint: disable=silent-swallow -- best-effort zombie reap after kill(); the restart proceeds either way
                     pass
         self._procs = []
 
@@ -353,7 +353,7 @@ class ElasticSupervisor:
         self._kill_all()
         try:
             self._store.close()
-        except Exception:
+        except Exception:  # lint: disable=silent-swallow -- best-effort store teardown; the job is over
             pass
 
 
@@ -385,7 +385,7 @@ class StoreHeartbeat:
                                 timeout=store._timeout,
                                 world_size=store.world_size,
                                 prefix=store._prefix)
-        except Exception:
+        except Exception:  # lint: disable=silent-swallow -- clone is an optimization; fall back to the shared client
             pass
         return store
 
@@ -414,7 +414,7 @@ class StoreHeartbeat:
         if self._beat_store is not self.store:
             try:
                 self._beat_store.close()
-            except Exception:
+            except Exception:  # lint: disable=silent-swallow -- best-effort close of the private beat connection
                 pass
 
     def stale_ranks(self):
@@ -638,8 +638,8 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
                             try:
                                 # never rmtree under a live writer
                                 checkpointer.flush()
-                            except Exception:   # noqa: BLE001
-                                pass            # discarding it anyway
+                            except Exception:   # lint: disable=silent-swallow -- the checkpoint is discarded right below; flush is courtesy
+                                pass
                         shutil.rmtree(saved, ignore_errors=True)
                         raise watchdog.CommTimeoutError(
                             "watchdog expiry while checkpointing: "
@@ -673,7 +673,7 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
                 if close is not None:
                     try:
                         close()
-                    except Exception:   # noqa: BLE001 — best-effort
+                    except Exception:   # lint: disable=silent-swallow -- best-effort close of a caller-owned iterator
                         pass
     finally:
         mgr.close()

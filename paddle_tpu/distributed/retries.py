@@ -39,7 +39,7 @@ def _note_retry(exhausted=False):
                 observability.inc("retry.exhausted")
             else:
                 observability.inc("retry.attempts")
-    except Exception:   # noqa: BLE001
+    except Exception:   # lint: disable=silent-swallow -- retry telemetry must never break the retried op
         pass
 
 
@@ -91,8 +91,8 @@ class RetryPolicy:
                 if on_retry is not None:
                     try:
                         on_retry(attempt, e)
-                    except Exception:   # noqa: BLE001 — recovery is
-                        pass            # best-effort; next try reports
+                    except Exception:   # lint: disable=silent-swallow -- on_retry recovery is best-effort; the next attempt reports
+                        pass
         _note_retry(exhausted=True)
         raise RetryBudgetExceeded(
             f"{desc or getattr(fn, '__name__', 'op')} failed after "

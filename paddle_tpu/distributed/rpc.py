@@ -189,7 +189,7 @@ def shutdown():
             try:
                 _state["store"].barrier("rpc_shutdown", _state["rank"],
                                         _state["world_size"], timeout=60)
-            except Exception:
+            except Exception:  # lint: disable=silent-swallow -- shutdown barrier is best-effort; a dead peer must not block exit
                 pass
         _state["store"].close()
         _state["store"] = None

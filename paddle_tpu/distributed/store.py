@@ -165,6 +165,7 @@ class _PyStoreServer:
                     (timeout_ms,) = struct.unpack("<q", self._recv_all(conn, 8))
                     deadline = (None if timeout_ms < 0
                                 else time.monotonic() + timeout_ms / 1000)
+                    val = None
                     with self._cv:
                         while key not in self._data and not self._stop:
                             remain = (None if deadline is None
@@ -173,12 +174,20 @@ class _PyStoreServer:
                                 break
                             self._cv.wait(remain)
                         if key in self._data:
-                            conn.sendall(b"\x00")
-                            if cmd == 1:
-                                val = self._data[key]
-                                conn.sendall(struct.pack("<I", len(val)) + val)
-                        else:
-                            conn.sendall(b"\x01")  # timeout
+                            val = self._data[key]
+                    # reply OUTSIDE the critical section (found by the
+                    # thread-discipline analyzer pass): sendall blocks
+                    # when the client stalls mid-read (full TCP send
+                    # buffer — a preempted/hung rank does exactly this),
+                    # and holding _cv here convoyed every other rank's
+                    # SET/GET/ADD/barrier behind the sick client. The
+                    # SET/ADD paths already replied outside the lock.
+                    if val is not None:
+                        conn.sendall(b"\x00")
+                        if cmd == 1:
+                            conn.sendall(struct.pack("<I", len(val)) + val)
+                    else:
+                        conn.sendall(b"\x01")  # timeout
                 elif cmd == 2:  # ADD
                     key = self._recv_bytes(conn).decode()
                     (delta,) = struct.unpack("<q", self._recv_all(conn, 8))
@@ -634,5 +643,5 @@ class TCPStore(Store):
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # lint: disable=silent-swallow -- __del__ during interpreter teardown cannot raise usefully
             pass

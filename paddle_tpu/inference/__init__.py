@@ -79,8 +79,8 @@ def _enable_exec_cache(cache_dir, explicit):
     for key, val in updates:
         try:
             jax.config.update(key, val)
-        except Exception:
-            pass                      # knob not present in this jax
+        except Exception:  # lint: disable=silent-swallow -- cache knob not present in this jax version; cache still works
+            pass
     _exec_cache_applied.update(dir=cache_dir,
                                explicit=explicit
                                or _exec_cache_applied["explicit"])

@@ -641,7 +641,7 @@ class _PersistentPool:
         for q in self.index_qs:
             try:
                 q.put(None)
-            except Exception:
+            except Exception:  # lint: disable=silent-swallow -- poison-pill put into a possibly-dead worker queue; terminate() below is the backstop
                 pass
         for p in self.workers:
             p.join(timeout=2)

@@ -201,7 +201,7 @@ class DevicePrefetcher:
         if it_close is not None:
             try:
                 it_close()     # generator sources: run finally blocks
-            except Exception:  # noqa: BLE001 — best-effort cleanup
+            except Exception:  # lint: disable=silent-swallow -- best-effort generator close at shutdown
                 pass           # (incl. 'generator already executing'
             #                    when the worker is inside next())
         if threading.current_thread() is self._thread:
@@ -234,7 +234,7 @@ class DevicePrefetcher:
         try:
             if not self._stop.is_set():
                 self.close()
-        except Exception:      # noqa: BLE001 — interpreter teardown
+        except Exception:      # lint: disable=silent-swallow -- __del__ during interpreter teardown cannot raise usefully
             pass
 
 

@@ -50,7 +50,7 @@ def _ambient_mesh():
         m = thread_resources.env.physical_mesh
         if not m.empty:
             return m
-    except Exception:
+    except Exception:  # lint: disable=silent-swallow -- jax-internal mesh probe; the paddle_tpu global mesh fallback follows
         pass
     from paddle_tpu.distributed.mesh import get_mesh
     return getattr(get_mesh(), "jax_mesh", None)

@@ -134,8 +134,8 @@ def export_chrome_trace(path=None, merge_host_tracer=False) -> dict:
         try:
             from paddle_tpu.profiler import utils as _utils
             events = events + list(_utils.host_chrome_events())
-        except Exception:
-            pass        # profiler backend unavailable: spans alone
+        except Exception:  # lint: disable=silent-swallow -- profiler backend unavailable: export spans alone
+            pass
     doc = {"traceEvents": events, "displayTimeUnit": "ms",
            "metadata": {"producer": "paddle_tpu.observability"}}
     if path is not None:

@@ -439,7 +439,7 @@ class Trainer:
             batch = put
         if self._step_fn is None:
             self._step_fn = self._build_step(None)
-        lrv = float(self._lr_value())
+        lrv = float(self._lr_value())  # lint: disable=hot-path-sync -- LR schedules are host-side python math, never a device value
         cache = getattr(self, "_lr_cache", None)
         if cache is None or cache[0] != lrv:
             # re-stage the lr scalar only when the schedule moves it: a
@@ -449,7 +449,7 @@ class Trainer:
         args = (self.params, self.opt_state, self._lr_cache[1], batch)
         if self._chaos_poison:
             from paddle_tpu.distributed import chaos
-            args += (jnp.asarray(chaos.grad_poison("trainer.grad"),
+            args += (jnp.asarray(chaos.grad_poison("trainer.grad"),  # lint: disable=disabled-gate -- _chaos_poison is derived from chaos.ENABLED at trace time; with chaos off this branch does not exist
                                  jnp.float32),)
         # enter the mesh context for the (first-call) trace so
         # sharding-aware custom vjps (e.g. the embedding grad reshard in

@@ -1,0 +1,22 @@
+"""Fixture: disabled-gate true positives."""
+import paddle_tpu.observability
+from paddle_tpu import observability
+from paddle_tpu.distributed import chaos
+from paddle_tpu.observability import inc as _inc
+
+
+def tick(n):
+    observability.inc("engine.ticks")            # BAD: ungated
+    if n > 3:
+        chaos.maybe_delay("engine.tick.delay")   # BAD: ungated
+    if not observability.ENABLED:
+        observability.observe("engine.tick.seconds", 0.1)   # BAD: inverted
+    return n
+
+
+def plain_import_tick():
+    paddle_tpu.observability.inc("engine.ticks")   # BAD: ungated, no-alias import
+
+
+def bare_import_tick():
+    _inc("engine.ticks")    # BAD: ungated directly-imported instrument

@@ -1,0 +1,44 @@
+"""Fixture: disabled-gate must-not-flag cases (every sanctioned shape)."""
+from paddle_tpu import observability
+from paddle_tpu.distributed import chaos
+
+
+def if_gate(dt):
+    if observability.ENABLED:
+        observability.observe("engine.tick.seconds", dt)
+
+
+def and_gate():
+    if chaos.ENABLED and chaos.should_fire("serving.batch.fail"):
+        raise RuntimeError("injected")
+
+
+def early_out(n):
+    if not observability.ENABLED:
+        return n
+    observability.inc("engine.ticks")
+    return n
+
+
+def else_branch():
+    if not chaos.ENABLED:
+        pass
+    else:
+        chaos.maybe_drop("store.rpc.drop")
+
+
+def non_instrument():
+    # reading config/rates is not an instrumentation call
+    return chaos.site_rate("trainer.grad") if chaos.ENABLED else 0.0
+
+
+def plain_import_gated(dt):
+    import paddle_tpu.observability
+    if paddle_tpu.observability.ENABLED:
+        paddle_tpu.observability.observe("engine.tick.seconds", dt)
+
+
+def bare_import_gated():
+    from paddle_tpu.observability import inc
+    if observability.ENABLED:       # same-kind module alias gates it
+        inc("engine.ticks")

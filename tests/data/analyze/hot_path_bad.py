@@ -1,0 +1,17 @@
+"""Fixture: hot-path-sync true positives (every flagged line syncs)."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def decorated(x):
+    print("tracing", x)               # BAD: print inside a jit body
+    return float(x) + 1.0             # BAD: float() on an array value
+
+
+def wrapped(x):
+    y = np.asarray(x)                 # BAD: np.asarray under tracing
+    return y.item()                   # BAD: .item() device sync
+
+
+run_wrapped = jax.jit(wrapped)

@@ -1,0 +1,20 @@
+"""Fixture: hot-path-sync must-not-flag cases."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_snapshot(x):
+    # np.asarray on a host-side snapshot path is fine: this function
+    # is never jit-wrapped
+    arr = np.asarray(x)
+    print("snapshot", arr.shape)
+    return float(arr.sum())
+
+
+@jax.jit
+def ok(x):
+    n = int(x.shape[0])               # static shape math: trace-time
+    scale = float("inf")              # constant cast: trace-time
+    jax.debug.print("n={n}", n=n)     # sanctioned in-graph print
+    return jnp.asarray(x) * n, scale

@@ -1,0 +1,17 @@
+"""Fixture: silent-swallow true positives."""
+
+
+def writer_loop(jobs):
+    for job in jobs:
+        try:
+            job()
+        except Exception:             # BAD: background failure vanishes
+            pass
+
+
+def poll(source):
+    while True:
+        try:
+            return source()
+        except Exception:             # BAD: lone continue is a swallow
+            continue

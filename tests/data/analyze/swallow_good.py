@@ -1,0 +1,25 @@
+"""Fixture: silent-swallow must-not-flag cases."""
+import sys
+
+
+def handled(job, counters):
+    try:
+        job()
+    except Exception as e:            # records the failure: fine
+        counters["failures"] += 1
+        print(f"job failed: {e!r}", file=sys.stderr)
+
+
+def narrow(d, key):
+    try:
+        return d[key]
+    except KeyError:                  # narrow handler: fine
+        pass
+    return None
+
+
+def justified(sock):
+    try:
+        sock.close()
+    except Exception:  # lint: disable=silent-swallow -- best-effort close on a torn-down socket
+        pass

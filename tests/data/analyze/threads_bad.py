@@ -1,0 +1,22 @@
+"""Fixture: thread-discipline true positives."""
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def spawn():
+    # BAD: non-daemon thread bound to `t`, and no `t.join()` anywhere
+    t = threading.Thread(target=time.sleep, args=(0.01,))
+    t.start()
+    return t
+
+
+def hold_and_sleep():
+    with _lock:
+        time.sleep(0.1)               # BAD: blocking under the lock
+
+
+def hold_and_drain(q):
+    with _lock:
+        return q.get()                # BAD: no-timeout get under lock

@@ -1,0 +1,26 @@
+"""Fixture: thread-discipline must-not-flag cases."""
+import threading
+import time
+
+
+class Owner:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # daemon: dies with the process — fine without a join
+        self._thread = threading.Thread(target=time.sleep, daemon=True)
+        # non-daemon but join()ed in close(): the contract
+        self._worker = threading.Thread(target=time.sleep, args=(0.01,))
+
+    def close(self):
+        self._worker.join(timeout=5)
+
+    def tick(self, q):
+        with self._lock:
+            x = 1
+        time.sleep(0.0)               # sleeping OUTSIDE the lock
+        with self._lock:
+            y = q.get(timeout=1.0)    # bounded get: allowed
+        with self._cv:
+            self._cv.wait(0.1)        # the lock's own condition waits
+        return x, y, ", ".join(["a", "b"])   # str.join is not a thread

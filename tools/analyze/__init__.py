@@ -1,0 +1,39 @@
+"""tools.analyze — unified multi-pass static analysis for paddle_tpu.
+
+Usage (CLI):   python -m tools.analyze [root] [--json] [--pass <id>]
+Usage (API):   from tools.analyze import analyze_tree
+               report = analyze_tree("/path/to/repo")
+
+See tools/analyze/core.py for the framework (shared AST index,
+findings, suppressions, baseline) and tools/analyze/passes/ for the
+seven passes. The README's "Static analysis" section documents the
+pass catalogue and the suppression/baseline policy.
+"""
+from tools.analyze.core import (Baseline, Finding, Report, build_index,
+                                default_baseline_path, run)
+from tools.analyze.passes import ALL_PASSES, BY_ID
+
+__all__ = ["Baseline", "Finding", "Report", "ALL_PASSES", "BY_ID",
+           "build_index", "run", "analyze_tree",
+           "default_baseline_path"]
+
+
+def analyze_tree(root, pass_ids=None, baseline_path=None,
+                 use_baseline=True) -> Report:
+    """Run the suite (or the `pass_ids` subset) over `root` and return
+    a Report. `baseline_path=None` with use_baseline=True uses the
+    checked-in tools/analyze/baseline.json."""
+    if pass_ids:
+        unknown = [p for p in pass_ids if p not in BY_ID]
+        if unknown:
+            raise ValueError(
+                f"unknown pass id(s) {unknown}; known: "
+                f"{sorted(BY_ID)}")
+        passes = [BY_ID[p] for p in pass_ids]
+    else:
+        passes = ALL_PASSES
+    baseline = None
+    if use_baseline:
+        baseline = Baseline.load(baseline_path
+                                 or default_baseline_path())
+    return run(root, passes, baseline, known_ids=set(BY_ID))

@@ -1,0 +1,17 @@
+"""Pass registry: ordered list of pass modules, each exposing
+PASS_ID, DESCRIPTION and run(index) -> iterable[Finding]."""
+from tools.analyze.passes import (chaos_points, gating, hot_path,
+                                  jax_compat, metric_names, swallow,
+                                  threads)
+
+ALL_PASSES = [
+    jax_compat,        # jax-compat
+    chaos_points,      # chaos-points
+    metric_names,      # metric-names
+    hot_path,          # hot-path-sync
+    threads,           # thread-discipline
+    swallow,           # silent-swallow
+    gating,            # disabled-gate
+]
+
+BY_ID = {p.PASS_ID: p for p in ALL_PASSES}

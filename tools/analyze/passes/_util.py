@@ -1,0 +1,69 @@
+"""Small AST helpers shared by the analysis passes."""
+from __future__ import annotations
+
+import ast
+
+
+def dotted(node) -> str | None:
+    """'jax.lax.scan' for nested Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal(node) -> str | None:
+    """The last identifier of an expression: `self._lock` -> '_lock',
+    `lock` -> 'lock', anything else -> None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def func_name(call: ast.Call) -> str | None:
+    """Last component of the called name: `threading.Thread(...)` ->
+    'Thread', `t.join()` -> 'join'."""
+    return terminal(call.func)
+
+
+def stmt_of(node):
+    """The statement a node belongs to (walk up to an ast.stmt)."""
+    while node is not None and not isinstance(node, ast.stmt):
+        node = getattr(node, "parent", None)
+    return node
+
+
+def enclosing(node, kinds):
+    """Nearest ancestor of one of `kinds` (a tuple of AST types)."""
+    node = getattr(node, "parent", None)
+    while node is not None:
+        if isinstance(node, kinds):
+            return node
+        node = getattr(node, "parent", None)
+    return None
+
+
+def walk_no_defs(node):
+    """Yield nodes in `node`'s subtree WITHOUT descending into nested
+    function/lambda bodies (deferred execution is a different context).
+    `node` itself is yielded."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield from walk_no_defs(child)
+
+
+def call_snippet(call: ast.Call, max_len=60) -> str:
+    try:
+        s = ast.unparse(call)
+    except Exception:      # degraded label is fine: unparse is cosmetic
+        s = "<call>"
+    return s if len(s) <= max_len else s[:max_len - 3] + "..."

@@ -1,0 +1,212 @@
+"""Pass `disabled-gate` — instruments stay free when switched off.
+
+The contract every PR since PR 1 asserts by hand-written test: with
+observability/chaos disabled, an instrumented hot path pays exactly one
+module-attribute load and a falsy branch. That only holds if every
+call site OUTSIDE the instrument's own package sits behind the gate:
+
+    if observability.ENABLED:
+        observability.inc("store.rpc.retries")
+
+    if chaos.ENABLED and chaos.should_fire("ckpt.async.fail"):
+        ...
+
+This pass finds `observability.inc/observe/set_gauge` and
+`chaos.should_fire/maybe_*` calls in paddle_tpu/ (outside
+paddle_tpu/observability/ and distributed/chaos.py) that are NOT
+dominated by an `<module>.ENABLED` check — whether the module is
+imported `from paddle_tpu import observability [as x]`, plainly
+(`import paddle_tpu.observability[ as y]`), or the instrument itself
+is imported directly (`from paddle_tpu.observability import inc`,
+which leaves no module object to gate on and is flagged unless a
+same-kind module alias's ENABLED dominates). Recognized gate shapes:
+
+  - an enclosing `if <mod>.ENABLED [and ...]:` (call in the body), or
+    `if not <mod>.ENABLED:` (call in the else branch),
+  - a conditional expression `X if <mod>.ENABLED else Y`,
+  - short-circuit `<mod>.ENABLED and <call>`,
+  - an early-out guard earlier in the same function:
+    `if not <mod>.ENABLED: return/raise/continue`.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.analyze.core import Finding
+from tools.analyze.passes._util import call_snippet, terminal
+
+PASS_ID = "disabled-gate"
+DESCRIPTION = ("observability/chaos instrument calls outside their "
+               "packages must sit behind the <module>.ENABLED gate")
+
+OBS_INSTRUMENTS = {"inc", "observe", "set_gauge"}
+CHAOS_INSTRUMENTS = {"should_fire", "maybe_delay", "maybe_drop",
+                     "maybe_preempt", "maybe_corrupt_file",
+                     "grad_poison"}
+
+# instrument home packages: call sites inside them ARE the plumbing
+_EXEMPT_PREFIXES = (os.path.join("paddle_tpu", "observability") + os.sep,)
+_EXEMPT_FILES = {os.path.join("paddle_tpu", "distributed", "chaos.py")}
+
+
+_HOMES = {"paddle_tpu.observability": "obs",
+          "paddle_tpu.distributed.chaos": "chaos"}
+
+
+def _aliases(tree):
+    """(aliases, bare): `aliases` maps module alias -> 'obs'/'chaos'
+    from `from paddle_tpu import observability [as x]`,
+    `from paddle_tpu.distributed import chaos [as y]`, and plain
+    `import paddle_tpu....[ as z]` (without `as`, the call spells
+    `paddle_tpu.observability.inc(...)` whose terminal attribute IS the
+    module name). `bare` maps directly-imported instrument names
+    (`from paddle_tpu.observability import inc [as i]`) -> kind —
+    those call sites have no module object to gate on and are audited
+    separately."""
+    aliases, bare = {}, {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if node.module == "paddle_tpu" \
+                        and a.name == "observability":
+                    aliases[a.asname or a.name] = "obs"
+                elif node.module == "paddle_tpu.distributed" \
+                        and a.name == "chaos":
+                    aliases[a.asname or a.name] = "chaos"
+                elif node.module in _HOMES:
+                    kind = _HOMES[node.module]
+                    wanted = OBS_INSTRUMENTS if kind == "obs" \
+                        else CHAOS_INSTRUMENTS
+                    if a.name in wanted:
+                        bare[a.asname or a.name] = kind
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                kind = _HOMES.get(a.name)
+                if kind:
+                    # `import paddle_tpu.observability as o` -> o.inc;
+                    # without `as`, paddle_tpu.observability.inc whose
+                    # terminal() is the last dotted component
+                    aliases[a.asname or a.name.rsplit(".", 1)[-1]] = kind
+    return aliases, bare
+
+
+def _enabled_polarities(test, alias):
+    """Polarities at which `<alias>.ENABLED` occurs in `test`: True for
+    a plain mention, False under an odd number of `not`s."""
+    found = set()
+
+    def visit(node, neg):
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op,
+                                                        ast.Not):
+            visit(node.operand, not neg)
+            return
+        if isinstance(node, ast.Attribute) and node.attr == "ENABLED" \
+                and terminal(node.value) == alias:
+            found.add(not neg)
+        for child in ast.iter_child_nodes(node):
+            visit(child, neg)
+
+    visit(test, False)
+    return found
+
+
+def _stmt_guards(fn_body, before_stmt, alias):
+    """True when a statement before `before_stmt` in the same body is
+    `if not <alias>.ENABLED: return/raise/continue`."""
+    for stmt in fn_body:
+        if stmt is before_stmt:
+            return False
+        if isinstance(stmt, ast.If) \
+                and False in _enabled_polarities(stmt.test, alias) \
+                and stmt.body \
+                and isinstance(stmt.body[-1],
+                               (ast.Return, ast.Raise, ast.Continue)):
+            return True
+    return False
+
+
+def _is_gated(call, alias):
+    child = call
+    node = getattr(call, "parent", None)
+    while node is not None:
+        if isinstance(node, ast.If):
+            pol = _enabled_polarities(node.test, alias)
+            if child in node.body and True in pol:
+                return True
+            if child in node.orelse and False in pol:
+                return True
+        elif isinstance(node, ast.IfExp):
+            pol = _enabled_polarities(node.test, alias)
+            if child is node.body and True in pol:
+                return True
+            if child is node.orelse and False in pol:
+                return True
+        elif isinstance(node, ast.BoolOp) and isinstance(node.op,
+                                                         ast.And):
+            idx = node.values.index(child) if child in node.values \
+                else len(node.values)
+            for earlier in node.values[:idx]:
+                if True in _enabled_polarities(earlier, alias):
+                    return True
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # early-out guard before the statement containing the call
+            stmt = child
+            while stmt is not None and stmt not in node.body:
+                stmt = getattr(stmt, "parent", None)
+            if stmt is not None and _stmt_guards(node.body, stmt,
+                                                 alias):
+                return True
+            return False
+        elif isinstance(node, (ast.Lambda, ast.Module, ast.ClassDef)):
+            return False
+        child, node = node, getattr(node, "parent", None)
+    return False
+
+
+def run(index):
+    for mod in index.under("paddle_tpu"):
+        if mod.tree is None or mod.rel in _EXEMPT_FILES \
+                or mod.rel.startswith(_EXEMPT_PREFIXES):
+            continue
+        aliases, bare = _aliases(mod.tree)
+        if not aliases and not bare:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute):
+                alias = terminal(node.func.value)
+                kind = aliases.get(alias)
+                if kind is None:
+                    continue
+                wanted = OBS_INSTRUMENTS if kind == "obs" \
+                    else CHAOS_INSTRUMENTS
+                if node.func.attr not in wanted:
+                    continue
+                if _is_gated(node, alias):
+                    continue
+                yield Finding(
+                    PASS_ID, mod.rel, node.lineno,
+                    f"{call_snippet(node)} is not behind `if "
+                    f"{alias}.ENABLED:` — the disabled path must cost "
+                    "one attribute check (gate it, or justify with a "
+                    "suppression)")
+            elif isinstance(node.func, ast.Name):
+                # directly-imported instrument (`from ... import inc`):
+                # gated only if some same-kind module alias's ENABLED
+                # dominates the call
+                kind = bare.get(node.func.id)
+                if kind is None:
+                    continue
+                mods = [a for a, k in aliases.items() if k == kind]
+                if any(_is_gated(node, a) for a in mods):
+                    continue
+                gate = f"{mods[0]}.ENABLED" if mods else \
+                    "the module's ENABLED attribute (import the " \
+                    "module, not the function)"
+                yield Finding(
+                    PASS_ID, mod.rel, node.lineno,
+                    f"{call_snippet(node)} is not behind `if {gate}:` "
+                    "— the disabled path must cost one attribute "
+                    "check (gate it, or justify with a suppression)")

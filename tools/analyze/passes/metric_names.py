@@ -1,0 +1,107 @@
+"""Pass `metric-names` — every metric instrumentation site is catalogued.
+
+Port of tools/check_metric_names.py: `observability/metrics.py` carries
+METRICS, the closed catalogue of every metric name. An instrumentation
+call (`inc`/`observe`/`set_gauge`) with an uncatalogued or non-literal
+name would mint a metric invisible to operators reading the docs;
+acquisition calls (`counter`/`gauge`/`histogram`) are checked only when
+their first argument IS a literal (np.histogram/jnp.histogram share the
+method name with array first arguments and must not false-positive).
+
+The legacy `scan(root) -> (violations, seen, catalogue)` surface is
+kept for tools/check_metric_names.py (now a shim) and its tests.
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+
+from tools.analyze.core import Finding, build_index
+
+PASS_ID = "metric-names"
+DESCRIPTION = ("metric instrumentation names must be string literals "
+               "from the observability/metrics.py METRICS catalogue")
+
+# literal-REQUIRED instrumentation calls
+INSTRUMENTS = {"inc", "observe", "set_gauge"}
+# literal-checked-when-literal acquisition calls
+ACQUIRERS = {"counter", "gauge", "histogram"}
+
+# the registry implementation itself passes `name` variables around;
+# same for the module-level helper shims in the package __init__.
+# observability/requests.py (the request-tracing SLO instrumentation)
+# is deliberately NOT here: its request.* literals are audited like
+# any other call site (tests/test_metric_names_tool.py pins that).
+ALLOWED = {
+    os.path.join("paddle_tpu", "observability", "metrics.py"),
+    os.path.join("paddle_tpu", "observability", "__init__.py"),
+}
+
+
+def _load_catalogue(root: str) -> dict:
+    path = os.path.join(root, "paddle_tpu", "observability", "metrics.py")
+    if not os.path.isfile(path):
+        return {}                   # no catalogue: nothing to audit
+    spec = importlib.util.spec_from_file_location("_metrics_catalogue",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)        # stdlib-only module (no jax)
+    return dict(getattr(mod, "METRICS", {}))
+
+
+def _literal_of(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _scan_index(index):
+    """(violations, seen, catalogue); violations are (rel, lineno,
+    call, problem)."""
+    catalogue = _load_catalogue(index.root)
+    violations = []
+    seen = set()
+    for mod in index.under("paddle_tpu"):
+        if mod.rel in ALLOWED or mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            name = (func.attr if isinstance(func, ast.Attribute)
+                    else func.id if isinstance(func, ast.Name)
+                    else None)
+            if name not in INSTRUMENTS and name not in ACQUIRERS:
+                continue
+            metric = _literal_of(node.args[0])
+            call = f"{name}({ast.unparse(node.args[0])})"
+            if metric is None:
+                if name in INSTRUMENTS:
+                    violations.append(
+                        (mod.rel, node.lineno, call,
+                         "metric name is not a string literal — "
+                         "cannot be audited against the METRICS "
+                         "catalogue"))
+                continue
+            seen.add(metric)
+            if metric not in catalogue:
+                violations.append(
+                    (mod.rel, node.lineno, call,
+                     f"metric {metric!r} is not in the METRICS "
+                     "catalogue (observability/metrics.py) — "
+                     "register it there"))
+    return violations, seen, catalogue
+
+
+def run(index):
+    violations, _seen, _cat = _scan_index(index)
+    for rel, no, call, why in violations:
+        yield Finding(PASS_ID, rel, no, f"{call}: {why}")
+
+
+def scan(root: str):
+    """Legacy surface (tools/check_metric_names.py shim + its tests).
+    Indexes only paddle_tpu/ — all this scanner ever looked at."""
+    return _scan_index(build_index(root, subdirs=("paddle_tpu",),
+                                   files=()))

@@ -1,0 +1,78 @@
+"""Pass `silent-swallow` — broad exception handlers may not eat errors.
+
+`except Exception: pass` (or bare `except:` / `except BaseException:`,
+or a lone `continue`) inside a background writer, ticker loop, or any
+other body turns real failures into silence: the thread keeps running
+(or dies later, elsewhere), the operator sees nothing, and the bug
+report arrives as "training hung". Every such handler must either
+re-raise, record the failure somewhere visible (metric, log, stderr),
+or carry an inline justification:
+
+    except Exception:   # lint: disable=silent-swallow -- <why this is safe>
+        pass
+
+Handlers that DO something (assign a fallback, return a default, log,
+count) are not flagged — only bodies that are nothing but
+`pass`/`continue`/`...`.
+"""
+from __future__ import annotations
+
+import ast
+
+from tools.analyze.core import Finding
+
+PASS_ID = "silent-swallow"
+DESCRIPTION = ("`except Exception: pass` must re-raise, record, or "
+               "carry a justification")
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler_type):
+    if handler_type is None:
+        return True                                 # bare except:
+    names = []
+    if isinstance(handler_type, ast.Tuple):
+        names = handler_type.elts
+    else:
+        names = [handler_type]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in _BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _BROAD:
+            return True
+    return False
+
+
+def _is_silent(body):
+    """True when the handler body does literally nothing: only
+    pass/continue/`...` statements."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) \
+                and isinstance(stmt.value, ast.Constant) \
+                and stmt.value.value is Ellipsis:
+            continue
+        return False
+    return True
+
+
+def run(index):
+    for mod in index.modules:
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node.type) or not _is_silent(node.body):
+                continue
+            kind = ("bare except" if node.type is None
+                    else f"except {ast.unparse(node.type)}")
+            what = ("continue" if any(isinstance(s, ast.Continue)
+                                      for s in node.body) else "pass")
+            yield Finding(
+                PASS_ID, mod.rel, node.lineno,
+                f"`{kind}: {what}` swallows failures silently — "
+                "re-raise, record to a metric/log, or add "
+                "`# lint: disable=silent-swallow -- <why>`")

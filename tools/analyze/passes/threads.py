@@ -1,0 +1,194 @@
+"""Pass `thread-discipline` — threads must be reapable, locks must stay
+off blocking calls.
+
+Two invariants, both learned the hard way (PR 5's `no_leaked_threads`
+fixture, PR 3's signal-handler deadlock dodge):
+
+1. Every `threading.Thread(...)` must be `daemon=True` or be bound to
+   a name/attribute that some code path `.join()`s (the close()/stop()
+   contract). A non-daemon thread with no reachable join hangs
+   interpreter exit and is invisible in a passing test.
+
+2. A lock must not be held across a blocking call: `time.sleep`,
+   thread `.join()`, a `.get()` with no timeout, socket I/O, or a
+   `.wait()` on a DIFFERENT object than the one the `with` holds
+   (Condition.wait on its own condition releases the lock and is the
+   sanctioned pattern). Any of these inside `with <lock>:` is the
+   classic deadlock/convoy shape.
+
+Lock-like contexts are names/attributes assigned from
+`threading.Lock/RLock/Condition/Semaphore` anywhere in the module,
+plus anything whose terminal name looks like a lock (`_lock`, `cv`,
+`_cond`, `mutex`).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analyze.core import Finding
+from tools.analyze.passes._util import (call_snippet, func_name,
+                                        terminal, walk_no_defs)
+
+PASS_ID = "thread-discipline"
+DESCRIPTION = ("threads need daemon=True or a reachable join(); locks "
+               "must not be held across blocking calls")
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+_LOCK_NAME_HINT = re.compile(
+    r"(^|_)(lock|rlock|mutex|cv|cond|condition)s?$", re.I)
+_SOCKET_BLOCKERS = {"recv", "recv_into", "accept", "connect", "sendall",
+                    "serve_forever", "makefile"}
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _assigned_lock_names(tree):
+    """Terminal names bound to threading lock objects anywhere in the
+    module (class-agnostic: one module, one namespace of lock names)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            if func_name(node.value) in _LOCK_FACTORIES:
+                for t in node.targets:
+                    term = terminal(t)
+                    if term:
+                        names.add(term)
+    return names
+
+
+def _is_locklike(expr, lock_names):
+    term = terminal(expr)
+    if term is None:
+        return None
+    if term in lock_names or _LOCK_NAME_HINT.search(term):
+        return term
+    return None
+
+
+def _base_terminal(attr_call_func):
+    """For `a.b.wait` return 'b' (the object being waited on)."""
+    if isinstance(attr_call_func, ast.Attribute):
+        return terminal(attr_call_func.value)
+    return None
+
+
+def _blocking_reason(call, lock_term):
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        a = f.attr
+        if a == "sleep":
+            return "time.sleep() while holding the lock"
+        if a == "join":
+            pos = call.args
+            if not pos or (len(pos) == 1
+                           and isinstance(pos[0], ast.Constant)
+                           and isinstance(pos[0].value, (int, float))):
+                return "thread join() while holding the lock"
+            return None             # str.join/os.path.join shapes
+        if a == "get" and not call.args \
+                and not any(kw.arg == "timeout" for kw in call.keywords):
+            return ("blocking .get() with no timeout while holding "
+                    "the lock")
+        if a in _SOCKET_BLOCKERS:
+            return f"socket/server .{a}() while holding the lock"
+        if a in ("wait", "wait_for"):
+            base = _base_terminal(f)
+            if base is not None and base != lock_term:
+                return (f"waiting on `{base}` while holding lock "
+                        f"`{lock_term}` (only the lock's own "
+                        "condition may wait here)")
+            return None
+    elif isinstance(f, ast.Name) and f.id == "sleep":
+        return "sleep() while holding the lock"
+    return None
+
+
+def _check_with_blocks(mod, lock_names):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            lock_term = _is_locklike(item.context_expr, lock_names)
+            if lock_term is None:
+                continue
+            for stmt in node.body:
+                for sub in walk_no_defs(stmt):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    why = _blocking_reason(sub, lock_term)
+                    if why:
+                        yield Finding(
+                            PASS_ID, mod.rel, sub.lineno,
+                            f"{call_snippet(sub)}: {why} — the "
+                            "deadlock/convoy shape; move the call "
+                            "outside the critical section")
+            break   # one lock-like item is enough to audit the body
+
+
+def _joined_terminals(tree):
+    """Terminal names X for which `X.join(...)` appears anywhere."""
+    joined = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute) \
+                and node.func.attr == "join":
+            term = terminal(node.func.value)
+            if term:
+                joined.add(term)
+    return joined
+
+
+def _binding_terminal(call):
+    """The name a Thread(...) result is bound to: `t = Thread(...)` ->
+    't', `self._thread = Thread(...)` -> '_thread', appended into a
+    container -> the container's name; None when unbound."""
+    parent = getattr(call, "parent", None)
+    if isinstance(parent, (ast.Assign, ast.AnnAssign)):
+        targets = parent.targets if isinstance(parent, ast.Assign) \
+            else [parent.target]
+        for t in targets:
+            term = terminal(t)
+            if term:
+                return term
+    if isinstance(parent, ast.Call) and isinstance(parent.func,
+                                                   ast.Attribute) \
+            and parent.func.attr == "append":
+        return terminal(parent.func.value)
+    return None
+
+
+def _check_thread_creations(mod):
+    joined = _joined_terminals(mod.tree)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) \
+                or func_name(node) != "Thread":
+            continue
+        daemon = next((kw.value for kw in node.keywords
+                       if kw.arg == "daemon"), None)
+        if daemon is not None:
+            if isinstance(daemon, ast.Constant):
+                if daemon.value:
+                    continue        # daemon=True: dies with the process
+            else:
+                continue            # daemon=<expr>: can't audit
+        bound = _binding_terminal(node)
+        if bound is not None and bound in joined:
+            continue                # join() on the binding exists
+        where = (f"bound to `{bound}` which is never join()ed"
+                 if bound else "never bound (so never join()ed)")
+        yield Finding(
+            PASS_ID, mod.rel, node.lineno,
+            f"non-daemon threading.Thread {where} — pass daemon=True "
+            "or join it in a close()/stop() path (a leaked non-daemon "
+            "thread hangs interpreter exit)")
+
+
+def run(index):
+    for mod in index.modules:
+        if mod.tree is None:
+            continue
+        lock_names = _assigned_lock_names(mod.tree)
+        yield from _check_with_blocks(mod, lock_names)
+        yield from _check_thread_creations(mod)

@@ -1,123 +1,33 @@
 #!/usr/bin/env python
 """Fail CI when a chaos injection point is missing from the registry.
 
-`distributed/chaos.py` carries POINTS, the documented registry of every
-named fault-injection site. An injection call whose site literal is not
-registered is invisible to operators reading the catalogue (and to the
-README's knob table), so this checker walks every
-`chaos.should_fire/maybe_delay/maybe_drop/maybe_preempt/
-maybe_corrupt_file/grad_poison("site")` call in paddle_tpu/ and fails
-if:
-
-  - the literal site name has no POINTS entry (registry keys ending in
-    "/" cover dynamically-suffixed f-string sites by static prefix), or
-  - the site argument is not a string literal / f-string at all (a
-    variable cannot be audited against the registry).
+THIN SHIM: the scanner now lives in the unified static-analysis
+framework as the `chaos-points` pass
+(tools/analyze/passes/chaos_points.py) and runs with the full suite via
+`python -m tools.analyze`. This CLI (and its `scan(root)` surface, used
+by tests/test_chaos_points_tool.py) is kept so nothing downstream
+breaks.
 
 Usage: python tools/check_chaos_points.py [root]
 Exit 0 = clean, 1 = undocumented or unauditable sites found. Stale
 registry entries (documented but never called) are reported as a
 warning without failing — a point may be mid-migration.
-
-Wired into the tier-1 flow via tests/test_chaos_points_tool.py (the
-same pattern as tools/check_jax_compat.py).
 """
 from __future__ import annotations
 
-import ast
-import importlib.util
 import os
 import sys
 
-INJECTORS = {"should_fire", "maybe_delay", "maybe_drop",
-             "maybe_preempt", "maybe_corrupt_file", "grad_poison"}
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-# the registry module itself (its function bodies pass `site` variables
-# around, which is the implementation, not an injection site)
-ALLOWED = {os.path.join("paddle_tpu", "distributed", "chaos.py")}
-
-
-def _load_points(root: str) -> dict:
-    path = os.path.join(root, "paddle_tpu", "distributed", "chaos.py")
-    spec = importlib.util.spec_from_file_location("_chaos_registry", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)        # stdlib-only module (no jax)
-    return dict(getattr(mod, "POINTS", {}))
-
-
-def _site_of(node):
-    """(site, is_prefix) of an injection call's first argument, or
-    (None, False) when it is not a literal. An f-string yields its
-    static leading text as a prefix."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value, False
-    if isinstance(node, ast.JoinedStr):
-        if node.values and isinstance(node.values[0], ast.Constant) \
-                and isinstance(node.values[0].value, str):
-            return node.values[0].value, True
-        return None, False
-    return None, False
-
-
-def _covered(site: str, is_prefix: bool, points: dict) -> bool:
-    if not is_prefix:
-        return site in points or any(
-            k.endswith("/") and site.startswith(k) for k in points)
-    # an f-string's static prefix must match a registered prefix key
-    return any(k.endswith("/") and site.startswith(k) for k in points)
-
-
-def scan(root: str):
-    """Yield (relpath, lineno, call, problem) for every violation, and
-    also return the set of sites seen (for stale-entry reporting) via
-    the second element of the (violations, seen) tuple."""
-    points = _load_points(root)
-    pkg = os.path.join(root, "paddle_tpu")
-    violations = []
-    seen = set()
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root)
-            if rel in ALLOWED:
-                continue
-            try:
-                with open(path, encoding="utf-8") as f:
-                    tree = ast.parse(f.read(), filename=rel)
-            except (OSError, SyntaxError):
-                continue
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                func = node.func
-                name = (func.attr if isinstance(func, ast.Attribute)
-                        else func.id if isinstance(func, ast.Name)
-                        else None)
-                if name not in INJECTORS or not node.args:
-                    continue
-                site, is_prefix = _site_of(node.args[0])
-                call = f"{name}({ast.unparse(node.args[0])})"
-                if site is None:
-                    violations.append(
-                        (rel, node.lineno, call,
-                         "site is not a string literal / f-string — "
-                         "cannot be audited against chaos.POINTS"))
-                    continue
-                seen.add((site, is_prefix))
-                if not _covered(site, is_prefix, points):
-                    violations.append(
-                        (rel, node.lineno, call,
-                         f"site {site!r} is not in the chaos.POINTS "
-                         "registry (distributed/chaos.py) — document "
-                         "it there"))
-    return violations, seen, points
+from tools.analyze.passes.chaos_points import (  # noqa: E402,F401
+    ALLOWED, INJECTORS, scan)
 
 
 def main(argv):
-    root = argv[1] if len(argv) > 1 else \
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = argv[1] if len(argv) > 1 else _ROOT
     violations, seen, points = scan(root)
     if violations:
         print(f"check_chaos_points: {len(violations)} undocumented "

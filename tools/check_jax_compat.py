@@ -1,110 +1,30 @@
 #!/usr/bin/env python
 """Fail CI when version-fragile jax imports sneak into paddle_tpu/.
 
-`from jax import shard_map` only exists on jax >= 0.6 and broke
-collection of 10 test files on 0.4.37; `jax.shard_map(...)` attribute
-access breaks the same way at call time. The sanctioned spelling is
-`from paddle_tpu.core.jax_compat import shard_map` (which also
-translates the check_vma/check_rep kwarg rename). This checker greps
-the package for the fragile spellings and prints each offending line.
+THIN SHIM: the scanner now lives in the unified static-analysis
+framework as the `jax-compat` pass (tools/analyze/passes/jax_compat.py)
+and runs with the full suite via `python -m tools.analyze`. This CLI
+(and its `scan(root)` surface, used by tests/test_jax_compat_tool.py)
+is kept so nothing downstream breaks.
 
 Usage: python tools/check_jax_compat.py [root]
 Exit 0 = clean, 1 = offending lines found.
-
-Wired into the tier-1 flow via tests/test_jax_compat_tool.py.
 """
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-# (pattern, why). Docstrings/comments are excluded by stripping `#`
-# trails and skipping lines without code; prose mentions inside
-# docstrings are tolerated (they can't break an import).
-FRAGILE = [
-    (re.compile(r"^\s*from\s+jax\s+import\s+(?:\([^)]*\bshard_map\b"
-                r"|.*\bshard_map\b)"),
-     "`from jax import shard_map` needs jax>=0.6; import it from "
-     "paddle_tpu.core.jax_compat instead"),
-    (re.compile(r"\bjax\.shard_map\s*\("),
-     "`jax.shard_map(...)` needs jax>=0.6; use "
-     "paddle_tpu.core.jax_compat.shard_map"),
-    (re.compile(r"^\s*from\s+jax\.experimental\.shard_map\s+import"),
-     "import shard_map via paddle_tpu.core.jax_compat (handles the "
-     "check_rep->check_vma rename), not jax.experimental directly"),
-    (re.compile(r"\bjax\.lax\.axis_size\s*\("),
-     "`jax.lax.axis_size` does not exist on jax 0.4.x; use "
-     "paddle_tpu.core.jax_compat.axis_size"),
-]
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-# the one module allowed to touch the real locations
-ALLOWED = {os.path.join("paddle_tpu", "core", "jax_compat.py")}
-
-
-def _strip(line: str, open_q: str | None):
-    """One stateful pass per line: returns (code, new_open_q) with
-    comment trails and ALL string-literal contents removed. `open_q` is
-    the delimiter of a still-open triple-quoted string from earlier
-    lines (None when outside). Tracking strings and comments together
-    is what keeps a stray triple-quote inside a COMMENT from hiding the
-    rest of the file from the scan."""
-    out = []
-    i = 0
-    while i < len(line):
-        if open_q:
-            j = line.find(open_q, i)
-            if j < 0:
-                return "".join(out), open_q     # string spans the line
-            i = j + len(open_q)
-            open_q = None
-            continue
-        if line.startswith('"""', i) or line.startswith("'''", i):
-            open_q = line[i:i + 3]
-            i += 3
-            continue
-        ch = line[i]
-        if ch in "\"'":
-            j = line.find(ch, i + 1)
-            if j < 0:               # unterminated/escaped: drop the rest
-                return "".join(out), None
-            i = j + 1
-            continue
-        if ch == "#":
-            return "".join(out), None
-        out.append(ch)
-        i += 1
-    return "".join(out), open_q
-
-
-def scan(root: str):
-    """Yield (relpath, lineno, line, why) for every fragile use."""
-    pkg = os.path.join(root, "paddle_tpu")
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root)
-            if rel in ALLOWED:
-                continue
-            try:
-                with open(path, encoding="utf-8") as f:
-                    lines = f.read().splitlines()
-            except OSError:
-                continue
-            open_q = None
-            for no, line in enumerate(lines, 1):
-                code, open_q = _strip(line, open_q)
-                for pat, why in FRAGILE:
-                    if pat.search(code):
-                        yield rel, no, line.rstrip(), why
-                        break
+from tools.analyze.passes.jax_compat import (  # noqa: E402,F401
+    ALLOWED, FRAGILE, scan)
 
 
 def main(argv):
-    root = argv[1] if len(argv) > 1 else \
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = argv[1] if len(argv) > 1 else _ROOT
     bad = list(scan(root))
     if not bad:
         print("check_jax_compat: clean")

@@ -1,124 +1,34 @@
 #!/usr/bin/env python
 """Fail CI when a metric instrumentation site is off-catalogue.
 
-`observability/metrics.py` carries METRICS, the closed catalogue of
-every metric name (the README's observability table is generated from
-the same source of truth). An instrumentation call whose name is not
-catalogued would mint a metric invisible to operators reading the
-docs — and a non-literal name cannot be audited at all — so this
-checker (modeled on tools/check_chaos_points.py) walks paddle_tpu/
-and fails if:
-
-  - `inc("name")` / `observe("name", v)` / `set_gauge("name", v)` —
-    the instrumentation surface, on the observability module or any
-    MetricsRegistry — is called with a name that has no METRICS entry,
-    or with a first argument that is not a string literal;
-  - `counter("name")` / `gauge("name")` / `histogram("name")` — the
-    instrument acquisition surface — is called with a string-literal
-    name that has no METRICS entry. Non-literal first arguments are
-    NOT flagged for these three (jnp.histogram/np.histogram share the
-    method name with array first arguments).
+THIN SHIM: the scanner now lives in the unified static-analysis
+framework as the `metric-names` pass
+(tools/analyze/passes/metric_names.py) and runs with the full suite via
+`python -m tools.analyze`. This CLI (and its `scan(root)` / `ALLOWED`
+surface, used by tests/test_metric_names_tool.py) is kept so nothing
+downstream breaks.
 
 Usage: python tools/check_metric_names.py [root]
 Exit 0 = clean, 1 = undocumented or unauditable names found. Stale
 catalogue entries (documented but never instrumented) are reported as
 a warning without failing — scrape-time-only metrics and mid-migration
 names are legitimate.
-
-Wired into the tier-1 flow via tests/test_metric_names_tool.py (the
-same pattern as tools/check_chaos_points.py).
 """
 from __future__ import annotations
 
-import ast
-import importlib.util
 import os
 import sys
 
-# literal-REQUIRED instrumentation calls
-INSTRUMENTS = {"inc", "observe", "set_gauge"}
-# literal-checked-when-literal acquisition calls (numpy/jax collide on
-# the bare names with array arguments, which must not false-positive)
-ACQUIRERS = {"counter", "gauge", "histogram"}
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-# the registry implementation itself passes `name` variables around;
-# same for the module-level helper shims in the package __init__.
-# observability/requests.py (the request-tracing SLO instrumentation)
-# is deliberately NOT here: its request.* literals are audited like
-# any other call site (tests/test_metric_names_tool.py pins that).
-ALLOWED = {
-    os.path.join("paddle_tpu", "observability", "metrics.py"),
-    os.path.join("paddle_tpu", "observability", "__init__.py"),
-}
-
-
-def _load_catalogue(root: str) -> dict:
-    path = os.path.join(root, "paddle_tpu", "observability", "metrics.py")
-    spec = importlib.util.spec_from_file_location("_metrics_catalogue",
-                                                  path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)        # stdlib-only module (no jax)
-    return dict(getattr(mod, "METRICS", {}))
-
-
-def _literal_of(node):
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    return None
-
-
-def scan(root: str):
-    """Return (violations, seen_names, catalogue); violations are
-    (relpath, lineno, call, problem)."""
-    catalogue = _load_catalogue(root)
-    pkg = os.path.join(root, "paddle_tpu")
-    violations = []
-    seen = set()
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root)
-            if rel in ALLOWED:
-                continue
-            try:
-                with open(path, encoding="utf-8") as f:
-                    tree = ast.parse(f.read(), filename=rel)
-            except (OSError, SyntaxError):
-                continue
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call) or not node.args:
-                    continue
-                func = node.func
-                name = (func.attr if isinstance(func, ast.Attribute)
-                        else func.id if isinstance(func, ast.Name)
-                        else None)
-                if name not in INSTRUMENTS and name not in ACQUIRERS:
-                    continue
-                metric = _literal_of(node.args[0])
-                call = f"{name}({ast.unparse(node.args[0])})"
-                if metric is None:
-                    if name in INSTRUMENTS:
-                        violations.append(
-                            (rel, node.lineno, call,
-                             "metric name is not a string literal — "
-                             "cannot be audited against the METRICS "
-                             "catalogue"))
-                    continue
-                seen.add(metric)
-                if metric not in catalogue:
-                    violations.append(
-                        (rel, node.lineno, call,
-                         f"metric {metric!r} is not in the METRICS "
-                         "catalogue (observability/metrics.py) — "
-                         "register it there"))
-    return violations, seen, catalogue
+from tools.analyze.passes.metric_names import (  # noqa: E402,F401
+    ACQUIRERS, ALLOWED, INSTRUMENTS, scan)
 
 
 def main(argv):
-    root = argv[1] if len(argv) > 1 else \
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = argv[1] if len(argv) > 1 else _ROOT
     violations, seen, catalogue = scan(root)
     if violations:
         print(f"check_metric_names: {len(violations)} off-catalogue "
