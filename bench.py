@@ -113,6 +113,62 @@ def _decode_bench(on_tpu):
     return rows
 
 
+def _fleet_bench(trainer, batch, steps):
+    """Heartbeat-publisher overhead (ISSUE 9): the SAME compiled step
+    run with observability on, first without the fleet plane, then
+    with a FleetHeartbeat publishing into a local TCPStore at an
+    aggressively short interval. Reports both tokens/sec numbers and
+    the delta — the acceptance claim is that the train metric is
+    unchanged with the plane enabled. Also scans the aggregator once
+    so the row carries the straggler view a healthy single-rank fleet
+    produces (none)."""
+    import time
+
+    from paddle_tpu import observability
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.observability.fleet import FleetAggregator
+
+    tokens = 1
+    for v in batch.values():
+        tokens = int(np.asarray(v).shape[0]) * int(np.asarray(v).shape[1])
+        break
+
+    def _run(n):
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            loss = trainer.step(batch)
+        float(loss)                     # close the dispatch chain
+        return time.perf_counter() - t0
+
+    interval = 0.05         # 20 Hz — 40x production cadence (2 s), so
+    #                         the measured delta bounds the real cost
+    with observability.scoped(reset=True):
+        _run(1)                         # warm (telemetry path traced)
+        base_dt = _run(steps)
+        store = TCPStore(is_master=True, world_size=1)
+        try:
+            hb = trainer.fleet_heartbeat(store, 0, 1, interval=interval)
+            try:
+                plane_dt = _run(steps)
+            finally:
+                hb.stop()
+            view = FleetAggregator(store, 1, stale_after_s=60.0).scan()
+        finally:
+            store.close()
+    off = tokens * steps / base_dt
+    on = tokens * steps / plane_dt
+    return {
+        "steps": steps,
+        "interval_s": interval,
+        "tokens_per_sec_plane_off": round(off, 2),
+        "tokens_per_sec_plane_on": round(on, 2),
+        "overhead_pct": round((plane_dt - base_dt) / base_dt * 100.0, 2),
+        "beats": hb.beats,
+        "stragglers": view["summary"]["stragglers"],
+    }
+
+
 def main():
     import jax
     import paddle_tpu
@@ -214,6 +270,12 @@ def main():
     except Exception as e:           # noqa: BLE001 — never sink the
         decode = {"error": f"{type(e).__name__}: {e}"}  # train metric
 
+    # fleet heartbeat-publisher overhead (ISSUE 9)
+    try:
+        fleet = _fleet_bench(trainer, data, steps)
+    except Exception as e:           # noqa: BLE001 — never sink the
+        fleet = {"error": f"{type(e).__name__}: {e}"}   # train metric
+
     print(json.dumps({
         "metric": "llama1b_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
@@ -224,7 +286,7 @@ def main():
                   "loss": round(float(loss), 4),
                   "device": getattr(dev, "device_kind", str(dev)),
                   "batch": batch, "seq": seq, "steps": steps,
-                  "decode": decode},
+                  "decode": decode, "fleet": fleet},
     }))
 
 

@@ -99,6 +99,12 @@ POINTS = {
                          "toward 504)",
     "serving.run.fail": "failed predictor run (feeds the serving "
                         "circuit breaker toward open)",
+    "fleet.heartbeat.delay": "slow fleet-heartbeat publish; the beat "
+                             "is stamped BEFORE the delay, so the "
+                             "published snapshot AGES — the straggler "
+                             "detector's heartbeat-age lever",
+    "fleet.heartbeat.drop": "dropped fleet-heartbeat publish (the "
+                            "rank's last beat goes stale in the store)",
     "trainer.grad": "non-finite (NaN) gradient poisoning in the "
                     "compiled train step",
     "io.prefetch.delay": "slow host input pipeline (delay in the "

@@ -222,7 +222,8 @@ class ElasticSupervisor:
 
     def __init__(self, cmd, world_size, env=None, max_restarts=3,
                  heartbeat_grace=15.0, poll_interval=0.5,
-                 startup_grace=120.0, jax_coordinator=False):
+                 startup_grace=120.0, jax_coordinator=False,
+                 store_read_stale_after=3):
         self.cmd = list(cmd)
         self.world_size = world_size
         self.env = dict(env) if env is not None else dict(os.environ)
@@ -233,6 +234,11 @@ class ElasticSupervisor:
         self.attempt = 0
         self.restarts = 0
         self._spawn_time = 0.0
+        # N consecutive failed store reads of a rank's heartbeat key
+        # presume the rank stale: its liveness is unconfirmable, and a
+        # down store must not make every rank look healthy forever
+        self.store_read_stale_after = int(store_read_stale_after)
+        self._hb_read_failures: dict = {}
         # jax_coordinator=True: workers form a REAL jax.distributed
         # world. Each attempt gets a FRESH coordination-service address
         # (PADDLE_JAX_COORDINATOR) — the service lives inside rank 0, so
@@ -295,7 +301,14 @@ class ElasticSupervisor:
         be importing; staleness needs a beat that then stopped. Ranks
         whose process already EXITED are skipped: a clean exit-0 rank
         naturally stops beating while slower peers finish (nonzero exits
-        are caught by the exit-code check, not here)."""
+        are caught by the exit-code check, not here).
+
+        A failed STORE READ is a liveness unknown, not health: each
+        failure is counted (`elastic.store.read_errors`), and after
+        `store_read_stale_after` consecutive failures for a rank the
+        rank is presumed stale — previously the error was skipped
+        silently, so a down store made every rank look healthy forever
+        (the tools/analyze baseline's one grandfathered debt entry)."""
         now = time.time()
         stale = []
         for r in range(self.world_size):
@@ -307,12 +320,20 @@ class ElasticSupervisor:
                     # never beat: importing is fine for a while, but a
                     # rank wedged BEFORE its first beat (import deadlock,
                     # rendezvous hang) would otherwise never be detected
+                    self._hb_read_failures.pop(r, None)
                     if now - self._spawn_time > self.startup_grace:
                         stale.append(r)
                     continue
                 t = float(self._store.get(key).decode())
             except Exception:
+                n = self._hb_read_failures.get(r, 0) + 1
+                self._hb_read_failures[r] = n
+                if observability.ENABLED:
+                    observability.inc("elastic.store.read_errors")
+                if n >= self.store_read_stale_after:
+                    stale.append(r)
                 continue
+            self._hb_read_failures.pop(r, None)
             if now - t > self.grace:
                 stale.append(r)
         return stale
@@ -379,12 +400,9 @@ class StoreHeartbeat:
     @staticmethod
     def _clone_client(store):
         try:
-            from paddle_tpu.distributed.store import TCPStore
-            if isinstance(store, TCPStore):
-                return TCPStore(store.host, store.port, is_master=False,
-                                timeout=store._timeout,
-                                world_size=store.world_size,
-                                prefix=store._prefix)
+            clone = getattr(store, "clone", None)
+            if clone is not None:
+                return clone()
         except Exception:  # lint: disable=silent-swallow -- clone is an optimization; fall back to the shared client
             pass
         return store
@@ -660,10 +678,15 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
                     raise RuntimeError(
                         f"run_resilient: max_restarts={max_restarts} "
                         "exhausted after repeated preemptions") from None
-            except Exception:
+            except Exception as e:
                 restarts += 1
                 if observability.ENABLED:
                     observability.inc("elastic.restarts")
+                    # the evidence dies with the restart (and with the
+                    # process on the final raise): dump a flight-
+                    # recorder bundle first — watchdog aborts carry
+                    # every thread's stack, the usual hang diagnosis
+                    _flight_dump(e)
                 if restarts > max_restarts:
                     raise
                 # fall through: reload from the newest complete
@@ -677,6 +700,24 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
                         pass
     finally:
         mgr.close()
+
+
+def _flight_dump(exc):
+    """Flight-recorder bundle for a run_resilient fault (no-op unless
+    observability/fleet.py has a bundle directory configured). Never
+    lets recording break recovery: the restart matters more than the
+    dump."""
+    try:
+        from paddle_tpu.distributed import watchdog
+        from paddle_tpu.observability import fleet
+        reason = ("watchdog_abort"
+                  if isinstance(exc, watchdog.CommTimeoutError)
+                  else "restart_fault")
+        fleet.record_crash(reason, exc=exc)
+    except Exception as dump_err:   # noqa: BLE001 — see docstring
+        import sys
+        print(f"WARNING: flight-recorder dump failed: {dump_err!r}",
+              file=sys.stderr)
 
 
 class _Preempted(Exception):

@@ -626,6 +626,17 @@ class TCPStore(Store):
                     f"{round_idx} — a peer is dead or hung "
                     f"(original: {e})") from e
 
+    def clone(self) -> "TCPStore":
+        """A NEW client connection to the same server (never server
+        ownership). Daemon publishers — the elastic membership
+        heartbeat, the fleet telemetry beat — must not share the main
+        thread's socket: a blocking wait() there (a barrier) would
+        starve the background beat and make THIS rank look dead."""
+        return TCPStore(self.host, self.port, is_master=False,
+                        timeout=self._timeout,
+                        world_size=self.world_size,
+                        prefix=self._prefix)
+
     def close(self):
         if self._native_client and self._client:
             self._lib.pt_store_client_free(self._client)

@@ -32,6 +32,10 @@ GET  /debug/requests -> live traced requests from the bounded
                in-flight registry (observability/requests.py): request
                id, trace id, stage, age, tokens — the fleet router's
                machine-readable view of what this replica is doing
+GET  /debug/fleet -> live cross-rank heartbeat scan (observability/
+               fleet.py FleetAggregator passed as `fleet=`): per-rank
+               step/age/straggler rows + skew summary; {"enabled":
+               false} when the plane is off or no aggregator attached
 GET  /metadata -> input/output names of the served program
 
 Request tracing (observability/requests.py, enabled with the rest of
@@ -369,10 +373,14 @@ class PredictorServer:
                  max_batch_size=8, batch_timeout_ms=5.0, generator=None,
                  *, max_concurrent=32, max_queue_depth=64,
                  default_timeout_ms=None, breaker_threshold=5,
-                 breaker_reset_s=5.0, retry_after_s=1.0, metrics=None):
+                 breaker_reset_s=5.0, retry_after_s=1.0, metrics=None,
+                 fleet=None):
         self.predictor = predictor
         self.model_name = model_name
         self.generator = generator
+        # optional observability.fleet.FleetAggregator: GET /debug/fleet
+        # then serves a live cross-rank heartbeat scan from this replica
+        self.fleet = fleet
         self._lock = threading.Lock()
         self.default_timeout_ms = default_timeout_ms
         self.admission = AdmissionController(
@@ -508,6 +516,8 @@ class PredictorServer:
                     return self._reply(200, {
                         "enabled": observability.ENABLED,
                         "count": len(live), "requests": live})
+                if self.path == "/debug/fleet":
+                    return self._reply(200, outer.fleet_view())
                 if self.path == "/stats":
                     return self._reply(200, outer.stats())
                 if self.path == "/metrics":
@@ -625,6 +635,20 @@ class PredictorServer:
         the engine already retired keeps its engine-side outcome)."""
         if ctx is not None:
             ctx.finish(reason)
+
+    def fleet_view(self):
+        """The GET /debug/fleet body: a live FleetAggregator scan —
+        step skew, per-rank heartbeat ages, straggler flags — when
+        observability is on and a `fleet=` aggregator is attached;
+        {"enabled": False, "view": None} otherwise (same shape as
+        /debug/requests' disabled reply: routers switch on `enabled`)."""
+        if not observability.ENABLED or self.fleet is None:
+            return {"enabled": False, "view": None}
+        # a view up to 1s old is served without store traffic: routers
+        # poll every replica, and each fresh scan costs world_size
+        # round-trips against the single rendezvous store
+        return {"enabled": True,
+                "view": self.fleet.scan(max_age_s=1.0)}
 
     def queue_depth(self):
         """Requests waiting for execution: buffered in the batcher
@@ -1014,14 +1038,35 @@ class PredictorServer:
         """Graceful shutdown: stop admitting (new requests shed with 503
         + Retry-After, /readyz flips to "draining"), wait up to
         `timeout` seconds for in-flight requests to finish, then stop
-        the server. Returns True when nothing was left in flight."""
+        the server. Returns True when nothing was left in flight.
+
+        With observability on, drain start also dumps a flight-recorder
+        bundle (no-op unless a bundle dir is configured): a SIGTERM
+        drain is usually a preemption, and the in-flight registry /
+        span / metric evidence is about to drain away with the
+        process."""
         self._draining = True
+        if observability.ENABLED:
+            self._flight_dump()
         t_end = time.monotonic() + timeout
         while self.admission.in_flight > 0 and time.monotonic() < t_end:
             time.sleep(poll_s)
         clean = self.admission.in_flight == 0
         self.stop()
         return clean
+
+    def _flight_dump(self):
+        """Flight-recorder bundle at drain start (observability/
+        fleet.py; no-op without a configured bundle dir). Never lets
+        recording break the drain."""
+        try:
+            from paddle_tpu.observability import fleet
+            fleet.record_crash("serving_drain",
+                               extra={"stats": self.stats()})
+        except Exception as e:      # noqa: BLE001 — see docstring
+            import sys
+            print(f"WARNING: flight-recorder dump failed: {e!r}",
+                  file=sys.stderr)
 
     def stop(self, join_timeout=5.0):
         if self.batcher is not None:

@@ -17,6 +17,11 @@ Three pieces, one switch:
     telemetry.py  per-step training reporter: tokens/sec/chip + MFU
                   (the bench.py math, in-framework), lagged loss,
                   driven by parallel/trainer.py
+    fleet.py      the cross-rank layer: per-rank heartbeats into the
+                  rendezvous TCPStore, an aggregator computing step
+                  skew + straggler flags (fleet.* instruments, served
+                  at GET /debug/fleet), and the crash flight recorder
+                  (atomic diagnostic bundles, tools/obs_dump.py)
 
 Contract with the hot path — the same one distributed/chaos.py set:
 when observability is disabled (the default), every instrumentation
@@ -45,6 +50,7 @@ import os
 from paddle_tpu.observability import metrics as metrics  # noqa: PLC0414
 from paddle_tpu.observability import trace as trace      # noqa: PLC0414
 from paddle_tpu.observability import requests as requests  # noqa: PLC0414
+from paddle_tpu.observability import fleet as fleet      # noqa: PLC0414
 from paddle_tpu.observability.metrics import (
     METRICS, MetricsRegistry, REGISTRY)
 from paddle_tpu.observability.trace import Span, export_chrome_trace
@@ -54,7 +60,7 @@ __all__ = [
     "ENABLED", "enable", "disable", "scoped", "inc", "observe",
     "set_gauge", "span", "METRICS", "MetricsRegistry", "REGISTRY",
     "Span", "export_chrome_trace", "metrics", "trace", "requests",
-    "RequestContext",
+    "RequestContext", "fleet",
 ]
 
 # the ONE attribute hot paths branch on
@@ -69,6 +75,7 @@ def enable(reset=False):
         REGISTRY.reset()
         trace.clear()
         requests.clear()
+        fleet.clear()
     ENABLED = True
 
 

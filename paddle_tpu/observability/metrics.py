@@ -87,6 +87,12 @@ METRICS = {
                          "supervisor relaunches)"),
     "elastic.preemptions": ("counter",
                             "preemption signals observed"),
+    "elastic.store.read_errors": ("counter",
+                                  "supervisor heartbeat-key store reads "
+                                  "that failed (N consecutive failures "
+                                  "presume the rank stale — a down "
+                                  "store must not make every rank look "
+                                  "healthy forever)"),
     # -- chaos --------------------------------------------------------
     "chaos.injections": ("counter",
                          "chaos faults fired (label: site)"),
@@ -108,7 +114,9 @@ METRICS = {
     "train.nonfinite_skips": ("counter",
                               "steps skipped for non-finite grads"),
     "train.recompiles": ("counter",
-                         "train-step program (re)builds"),
+                         "train-step program (re)builds (label: shape "
+                         "= the triggering batch-shape signature — the "
+                         "bucket-autotune feed)"),
     # -- input pipeline -----------------------------------------------
     "io.prefetch.queue_depth": ("gauge",
                                 "batches already on device, waiting "
@@ -182,6 +190,33 @@ METRICS = {
                                "requests breaching the slow-request "
                                "threshold whose lifecycle was dumped "
                                "into the span ring"),
+    # -- fleet telemetry plane (observability/fleet.py) ---------------
+    "fleet.heartbeats": ("counter",
+                         "heartbeat snapshots this rank published into "
+                         "the store"),
+    "fleet.heartbeat.errors": ("counter",
+                               "heartbeat publishes/reads that failed "
+                               "(after retries)"),
+    "fleet.step.skew": ("gauge",
+                        "max-min training step across ranks reporting "
+                        "a step"),
+    "fleet.step.lag": ("gauge",
+                       "slowest rank's step lag vs the fleet median"),
+    "fleet.stale_ranks": ("gauge",
+                          "ranks whose heartbeat is missing or older "
+                          "than stale_after_s"),
+    "fleet.stragglers": ("gauge",
+                         "ranks currently flagged as stragglers (stale "
+                         "or step-lagged past straggler_steps)"),
+    "fleet.straggler": ("gauge",
+                        "1 while the labeled rank is flagged as a "
+                        "straggler (label: rank)"),
+    "fleet.tokens_per_sec": ("gauge",
+                             "fleet-summed tokens/sec across live "
+                             "ranks"),
+    "fleet.flight.records": ("counter",
+                             "flight-recorder bundles dumped (label: "
+                             "reason)"),
     # -- paged KV engine ----------------------------------------------
     "inference.decode.kernel": ("counter",
                                 "decode ticks by attend path (label: "

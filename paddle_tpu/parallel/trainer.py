@@ -252,8 +252,6 @@ class Trainer:
         from paddle_tpu.distributed import chaos
         self._chaos_poison = bool(chaos.ENABLED
                                   and chaos.site_rate("trainer.grad") > 0)
-        if observability.ENABLED:
-            observability.inc("train.recompiles")
 
         def loss_for(params, batch):
             params_c = _cast_tree(params, cfg.compute_dtype)
@@ -451,11 +449,20 @@ class Trainer:
             from paddle_tpu.distributed import chaos
             args += (jnp.asarray(chaos.grad_poison("trainer.grad"),  # lint: disable=disabled-gate -- _chaos_poison is derived from chaos.ENABLED at trace time; with chaos off this branch does not exist
                                  jnp.float32),)
+        # recompile attribution reads the jit trace-cache size around
+        # the call: growth = a REAL retrace for this batch's shapes
+        # (immune to observability being enabled mid-run, when already-
+        # warm shapes must not recount)
+        n0 = self._trace_count() if observability.ENABLED else None
         # enter the mesh context for the (first-call) trace so
         # sharding-aware custom vjps (e.g. the embedding grad reshard in
         # nn/functional/common.py) can read the axis names
         with self._mesh_ctx():
             out = self._step_fn(*args)
+        if observability.ENABLED and n0 is not None \
+                and self._trace_count() > n0:
+            observability.inc("train.recompiles",
+                              shape=self._batch_sig(batch))
         if self.config.skip_nonfinite_grads:
             loss, self.params, self.opt_state, skipped = out
             self._note_skip(skipped)
@@ -538,6 +545,46 @@ class Trainer:
             tokens = tokens / max(1, int(self.mesh.devices.size))
         self._tel_prev = [tokens, seq, None]
 
+    def _trace_count(self):
+        """Traced programs in the step's jit cache (0 before the step
+        fn exists, or when this jax version hides the cache): step()
+        compares before/after each call, so `train.recompiles` counts
+        REAL retraces, labeled with the batch-shape signature that
+        triggered them (the ROADMAP bucket-autotune feed). Cardinality
+        is bounded by the pipeline's real shape buckets."""
+        fn = self._step_fn
+        if fn is None:
+            return 0
+        cache_size = getattr(fn, "_cache_size", None)
+        try:
+            return int(cache_size()) if cache_size is not None else 0
+        except Exception:  # lint: disable=silent-swallow -- a private jax API probe; attribution degrades, the step must not
+            return 0
+
+    @staticmethod
+    def _batch_sig(batch):
+        """The `shape` label for train.recompiles: every leaf's name,
+        dims, and dtype, sorted — distinct signature = distinct trace."""
+        return ",".join(
+            f"{k}:{'x'.join(str(d) for d in getattr(v, 'shape', ()))}"
+            f":{getattr(v, 'dtype', '?')}"
+            for k, v in sorted(batch.items()))
+
+    def fleet_heartbeat(self, store, rank, world_size, **kw):
+        """Publish this process's training telemetry into the
+        cross-rank heartbeat plane (observability/fleet.py): step,
+        tokens/sec, MFU, recompiles and pending async saves land in
+        the rendezvous store under ``fleet/hb/{rank}`` every couple of
+        seconds, where the rank-0 aggregator (or a serving replica's
+        ``GET /debug/fleet``) computes step skew and straggler flags.
+        Returns the started FleetHeartbeat — or None when
+        observability is disabled: no thread, no store traffic, the
+        plane's zero-cost contract."""
+        if not observability.ENABLED:
+            return None
+        from paddle_tpu.observability.fleet import FleetHeartbeat
+        return FleetHeartbeat(store, rank, world_size, **kw).start()
+
     @property
     def telemetry(self):
         """The TrainingTelemetry reporter (None until a step ran with
@@ -581,6 +628,10 @@ class Trainer:
         """jax.jit lowering of the step for inspection/AOT-compile."""
         if self._step_fn is None:
             self._step_fn = self._build_step(None)
+        if observability.ENABLED:
+            # an AOT lowering is a program build for this shape too
+            observability.inc("train.recompiles",
+                              shape=self._batch_sig(batch))
         lr = jnp.asarray(self._lr_value(), jnp.float32)
         args = (self.params, self.opt_state, lr, batch)
         if self._chaos_poison:

@@ -1,0 +1,627 @@
+"""ISSUE 9 — the fleet telemetry plane (observability/fleet.py):
+cross-rank heartbeats over the rendezvous store, chaos-deterministic
+straggler detection, the serving GET /debug/fleet view, the crash
+flight recorder + tools/obs_dump.py round trip, the disabled-path
+zero-side-effect contract, and the satellite fixes (supervisor
+store-read staleness policy, recompile shape attribution, fleet.*
+catalogue <-> call-site agreement)."""
+import ast
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed import chaos
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.observability import fleet
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# heartbeat publishers / aggregators / servers own threads; stop()
+# must join them (daemon workers are the sanctioned backstop)
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Observability and the flight recorder are process-global; every
+    test starts disabled/disarmed and leaves the process the same way."""
+    obs.disable()
+    obs.REGISTRY.reset()
+    fleet.clear()
+    fleet.configure_flight_recorder(dir=None, max_keep=5)
+    yield
+    obs.disable()
+    obs.REGISTRY.reset()
+    fleet.clear()
+    fleet.configure_flight_recorder(dir=None, max_keep=5)
+
+
+@pytest.fixture
+def store():
+    s = TCPStore(is_master=True, world_size=4, timeout=5.0)
+    yield s
+    s.close()
+
+
+def _beat(store, rank, step, tokens_per_sec=10.0, ws=3):
+    """Publish one synthetic heartbeat for `rank` (no thread)."""
+    hb = fleet.FleetHeartbeat(
+        store, rank, ws, interval=60.0,
+        sample_fn=lambda: {"step": step,
+                           "tokens_per_sec": tokens_per_sec})
+    hb.publish()
+    hb.stop()
+    return hb
+
+
+# ---------------------------------------------------------------------------
+# heartbeats + aggregation
+# ---------------------------------------------------------------------------
+
+def test_multi_rank_aggregation_over_py_store_server(monkeypatch):
+    """Acceptance: three publisher threads beating into a REAL
+    _PyStoreServer (native client/server forced off), one aggregator
+    scanning them into a clean healthy-fleet view with summed
+    throughput and no stragglers."""
+    import paddle_tpu._native as native
+    monkeypatch.setattr(native, "load", lambda: None)
+    master = TCPStore(is_master=True, world_size=3, timeout=5.0)
+    from paddle_tpu.distributed.store import _PyStoreServer
+    assert isinstance(master._server, _PyStoreServer)
+
+    obs.enable(reset=True)
+    hbs = [fleet.FleetHeartbeat(
+        master, r, 3, interval=0.05,
+        sample_fn=lambda r=r: {"step": 200, "tokens_per_sec": 5.0})
+        for r in range(3)]
+    try:
+        for hb in hbs:
+            hb.start()          # first beat is synchronous
+        agg = fleet.FleetAggregator(master, 3, stale_after_s=30.0,
+                                    straggler_steps=50)
+        # let the daemon threads republish at least once
+        deadline = time.time() + 5.0
+        while time.time() < deadline and any(hb.beats < 2
+                                             for hb in hbs):
+            time.sleep(0.02)
+        assert all(hb.beats >= 2 for hb in hbs)
+        view = agg.scan()
+    finally:
+        for hb in hbs:
+            hb.stop()
+        master.close()
+    s = view["summary"]
+    assert s["present"] == 3 and s["stale_ranks"] == 0
+    assert s["stragglers"] == [] and s["step_skew"] == 0.0
+    assert s["fleet_tokens_per_sec"] == pytest.approx(15.0)
+    assert obs.REGISTRY.gauge("fleet.stragglers").value() == 0.0
+    assert obs.REGISTRY.counter("fleet.heartbeats").value() >= 6
+    assert fleet.last_view() is view
+
+
+def test_straggler_flagged_on_step_lag(store):
+    """A rank whose step lags the fresh-rank median by more than
+    straggler_steps is flagged (gauge labeled with the rank), healthy
+    ranks stay clean."""
+    obs.enable(reset=True)
+    for r, step in ((0, 500), (1, 120), (2, 505)):
+        _beat(store, r, step)
+    view = fleet.FleetAggregator(store, 3, stale_after_s=30.0,
+                                 straggler_steps=100).scan()
+    rows = {r["rank"]: r for r in view["ranks"]}
+    assert rows[1]["straggler"] and rows[1]["lag"] == 380.0
+    assert not rows[0]["straggler"] and not rows[2]["straggler"]
+    assert view["summary"]["stragglers"] == [1]
+    assert view["summary"]["step_skew"] == 385.0
+    assert view["summary"]["step_lag"] == 380.0
+    g = obs.REGISTRY.gauge("fleet.straggler")
+    assert g.value(rank=1) == 1.0
+    assert g.value(rank=0) == 0.0 and g.value(rank=2) == 0.0
+
+
+def test_straggler_stale_rank_deterministic_under_chaos_drop(store):
+    """Chaos fleet.heartbeat.drop at rate 1.0 deterministically
+    suppresses every publish of the victim rank: its last beat ages
+    past stale_after_s while peers stay fresh, and the detector flags
+    exactly that rank."""
+    obs.enable(reset=True)
+    for r in range(3):
+        _beat(store, r, 300)
+    victim = fleet.FleetHeartbeat(
+        store, 1, 3, interval=60.0,
+        sample_fn=lambda: {"step": 300, "tokens_per_sec": 1.0})
+    time.sleep(0.15)
+    with chaos.scoped(seed=7, rates={"fleet.heartbeat.drop": 1.0}):
+        for _ in range(3):
+            assert victim.publish() is False    # every attempt dropped
+        assert chaos.fire_count("fleet.heartbeat.drop") == 3
+    victim.stop()
+    # peers re-beat fresh; the victim's store beat is now >0.15s old
+    _beat(store, 0, 303)
+    _beat(store, 2, 303)
+    view = fleet.FleetAggregator(store, 3, stale_after_s=0.1,
+                                 straggler_steps=1000).scan()
+    rows = {r["rank"]: r for r in view["ranks"]}
+    assert rows[1]["stale"] and rows[1]["straggler"]
+    assert not rows[0]["stale"] and not rows[2]["stale"]
+    assert view["summary"]["stale_ranks"] == 1
+    assert view["summary"]["stragglers"] == [1]
+    assert obs.REGISTRY.gauge("fleet.stale_ranks").value() == 1.0
+
+
+def test_chaos_delay_ages_the_published_beat(store):
+    """fleet.heartbeat.delay fires between the snapshot's wall-time
+    stamp and the store write, so the beat the aggregator reads is
+    already old — the heartbeat-age straggler lever."""
+    obs.enable(reset=True)
+    hb = fleet.FleetHeartbeat(store, 0, 1, interval=60.0,
+                              sample_fn=lambda: {"step": 1})
+    with chaos.scoped(seed=0, rates={"fleet.heartbeat.delay": 1.0},
+                      delay_ms=80):
+        assert hb.publish() is True
+        assert chaos.fire_count("fleet.heartbeat.delay") == 1
+    hb.stop()
+    snap = json.loads(store.get("fleet/hb/0").decode())
+    assert time.time() - snap["time"] >= 0.07
+
+
+def test_missing_rank_counts_stale_and_straggler(store):
+    obs.enable(reset=True)
+    _beat(store, 0, 50)                     # rank 1 never beats
+    view = fleet.FleetAggregator(store, 2, stale_after_s=30.0).scan()
+    rows = {r["rank"]: r for r in view["ranks"]}
+    assert rows[1]["present"] is False and rows[1]["stale"]
+    assert rows[1]["straggler"]
+    assert view["summary"]["present"] == 1
+
+
+def test_registry_sample_reads_shared_instruments():
+    """The default heartbeat payload is derived from the live
+    registry: step from train.steps, throughput/MFU gauges, recompiles
+    summed across shape labels, pending async saves."""
+    obs.enable(reset=True)
+    obs.inc("train.steps", 7)
+    obs.set_gauge("train.tokens_per_sec", 123.0)
+    obs.set_gauge("train.mfu", 0.41)
+    obs.inc("train.recompiles", shape="a")
+    obs.inc("train.recompiles", shape="b")
+    obs.set_gauge("checkpoint.async.pending", 1.0)
+    s = fleet.registry_sample()
+    assert s == {"step": 7, "tokens_per_sec": 123.0, "mfu": 0.41,
+                 "recompiles": 2, "ckpt_async_pending": 1.0}
+
+
+def test_snapshot_is_compact_and_bounded(store):
+    """The published snapshot stays bounded no matter what sample_fn
+    returns: field count capped, floats rounded, JSON compact."""
+    obs.enable(reset=True)
+    big = {f"k{i:03d}": float(i) + 0.123456 for i in range(100)}
+    hb = fleet.FleetHeartbeat(store, 0, 1, interval=60.0,
+                              sample_fn=lambda: big)
+    hb.publish()
+    hb.stop()
+    raw = store.get("fleet/hb/0")
+    snap = json.loads(raw.decode())
+    assert len(snap) <= 24
+    assert snap["k000"] == 0.1235            # rounded
+    assert b" " not in raw                   # compact separators
+
+
+def test_snapshot_coerces_numpy_scalars(store):
+    """sample_fn/extra_fn values commonly come off numpy/jax; a
+    publisher that raised on every beat would make the rank look stale
+    with no visible error (post-review fix)."""
+    obs.enable(reset=True)
+    hb = fleet.FleetHeartbeat(
+        store, 0, 1, interval=60.0,
+        sample_fn=lambda: {"step": np.int64(7),
+                           "tokens_per_sec": np.float32(2.5),
+                           "weird": object()})
+    assert hb.publish() is True
+    hb.stop()
+    snap = json.loads(store.get("fleet/hb/0").decode())
+    assert snap["step"] == 7 and snap["tokens_per_sec"] == 2.5
+    assert isinstance(snap["weird"], str)
+
+
+def test_scan_max_age_serves_cached_view(store):
+    """scan(max_age_s=...) reuses a fresh-enough view without store
+    traffic — the GET /debug/fleet rate bound (post-review fix)."""
+    obs.enable(reset=True)
+    _beat(store, 0, 10, ws=1)
+    agg = fleet.FleetAggregator(store, 1, stale_after_s=30.0)
+    v1 = agg.scan()
+    _beat(store, 0, 99, ws=1)
+    assert agg.scan(max_age_s=60.0) is v1        # cached, no re-read
+    v2 = agg.scan()                              # fresh scan sees 99
+    assert v2["ranks"][0]["step"] == 99
+
+
+# ---------------------------------------------------------------------------
+# serving: GET /debug/fleet
+# ---------------------------------------------------------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_debug_fleet_endpoint(store):
+    from paddle_tpu.inference.serving import PredictorServer
+    obs.enable(reset=True)
+    for r, step in ((0, 200), (1, 10)):
+        _beat(store, r, step, ws=2)         # median 105; rank 1 lags 95
+    agg = fleet.FleetAggregator(store, 2, stale_after_s=30.0,
+                                straggler_steps=50)
+    srv = PredictorServer(lambda d: d, fleet=agg).start()
+    try:
+        status, body = _get(srv.port, "/debug/fleet")
+        assert status == 200
+        assert body["enabled"] is True
+        view = body["view"]
+        assert view["world_size"] == 2
+        assert {r["rank"] for r in view["ranks"]} == {0, 1}
+        assert view["summary"]["stragglers"] == [1]
+        # disabled: same shape, enabled=False, no scan performed
+        obs.disable()
+        status, body = _get(srv.port, "/debug/fleet")
+        assert status == 200
+        assert body == {"enabled": False, "view": None}
+    finally:
+        srv.stop()
+
+
+def test_debug_fleet_without_aggregator():
+    from paddle_tpu.inference.serving import PredictorServer
+    obs.enable(reset=True)
+    srv = PredictorServer(lambda d: d).start()
+    try:
+        status, body = _get(srv.port, "/debug/fleet")
+        assert status == 200 and body == {"enabled": False,
+                                          "view": None}
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_bundle_schema(tmp_path, store):
+    obs.enable(reset=True)
+    _beat(store, 0, 42, ws=1)
+    fleet.FleetAggregator(store, 1).scan()      # cache a fleet view
+    obs.inc("train.steps")
+    fleet.configure_flight_recorder(dir=str(tmp_path), max_keep=5)
+    try:
+        raise ValueError("engine on fire")
+    except ValueError as e:
+        path = fleet.record_crash("unit_test", exc=e,
+                                  extra={"note": 7})
+    assert path is not None and os.path.isdir(path)
+    assert sorted(os.listdir(path)) == sorted(fleet.BUNDLE_FILES)
+    man = json.load(open(os.path.join(path, "manifest.json")))
+    assert man["version"] == 1 and man["reason"] == "unit_test"
+    assert man["exception"] == {"type": "ValueError",
+                                "message": "engine on fire"}
+    assert man["extra"] == {"note": 7}
+    assert sorted(man["files"]) == sorted(fleet.BUNDLE_FILES)
+    metrics = json.load(open(os.path.join(path, "metrics.json")))
+    assert metrics["train.steps"]["series"][0]["value"] == 1
+    fl = json.load(open(os.path.join(path, "fleet.json")))
+    assert fl["available"] and fl["view"]["ranks"][0]["step"] == 42
+    tb = open(os.path.join(path, "traceback.txt")).read()
+    assert "engine on fire" in tb and "all thread stacks" in tb
+    req = json.load(open(os.path.join(path, "requests.json")))
+    assert req == {"count": 0, "requests": []}
+    assert obs.REGISTRY.counter("fleet.flight.records").value(
+        reason="unit_test") == 1
+
+
+def test_flight_retention_keeps_newest(tmp_path):
+    obs.enable(reset=True)
+    fleet.configure_flight_recorder(dir=str(tmp_path), max_keep=3)
+    paths = [fleet.record_crash(f"r{i}") for i in range(5)]
+    kept = fleet.flight_records(str(tmp_path))
+    assert len(kept) == 3
+    assert kept == sorted(paths[-3:])           # newest 3 survive
+    # no half-written .tmp residue
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+
+
+def test_flight_disarmed_is_noop(tmp_path):
+    obs.enable(reset=True)
+    assert fleet.FLIGHT.dir is None
+    assert fleet.record_crash("nothing") is None
+    assert fleet.flight_records() == []
+
+
+def test_obs_dump_round_trip(tmp_path, store):
+    """tools/obs_dump.py parses a real bundle back (load) and renders
+    the straggler + exception story (render); the CLI resolves a
+    flight dir to its newest bundle."""
+    obs.enable(reset=True)
+    for r, step in ((0, 900), (1, 100)):
+        _beat(store, r, step, ws=2)
+    fleet.FleetAggregator(store, 2, straggler_steps=50,
+                          stale_after_s=30.0).scan()
+    fleet.configure_flight_recorder(dir=str(tmp_path))
+    try:
+        raise RuntimeError("watchdog says no")
+    except RuntimeError as e:
+        bundle = fleet.record_crash("watchdog_abort", exc=e)
+
+    from tools import obs_dump
+    doc = obs_dump.load(bundle)
+    assert doc["manifest"]["reason"] == "watchdog_abort"
+    assert doc["fleet"]["view"]["summary"]["stragglers"] == [1]
+    text = obs_dump.render(bundle)
+    assert "watchdog_abort" in text
+    assert "RuntimeError: watchdog says no" in text
+    assert "STRAGGLER" in text and "rank 1" in text
+    # dir form resolves to the newest bundle; CLI exit codes
+    assert obs_dump.resolve(str(tmp_path)) == bundle
+    assert obs_dump.main([str(tmp_path)]) == 0
+    assert obs_dump.main([bundle, "--json"]) == 0
+    assert obs_dump.main([str(tmp_path / "nope")]) == 1
+
+
+def test_run_resilient_watchdog_abort_leaves_bundle(tmp_path):
+    """Acceptance: a watchdog expiry inside run_resilient dumps a
+    flight-recorder bundle (reason watchdog_abort) before the restart,
+    and the run still completes from the checkpoint."""
+    from paddle_tpu.distributed import elastic, watchdog
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    obs.enable(reset=True)
+    fleet.configure_flight_recorder(dir=str(tmp_path / "flight"))
+    watchdog.enable(poll_ms=10)
+
+    state = {"w": 0.0, "armed": True}
+
+    def train_fn(start, end):
+        for s in range(start, end):
+            state["w"] += float(s)
+        if state["armed"]:
+            state["armed"] = False
+            with watchdog.watch("chunk rank=0", timeout_ms=20):
+                time.sleep(0.2)         # blows the deadline -> abort
+
+    def save_fn(step, path):
+        ckpt.save_state_dict(
+            {"w": paddle_tpu.to_tensor(
+                np.asarray([state["w"]], np.float32))}, path)
+
+    def load_fn(path):
+        sd = {"w": paddle_tpu.to_tensor(np.zeros(1, np.float32))}
+        ckpt.load_state_dict(sd, path)
+        state["w"] = float(np.asarray(sd["w"]._value)[0])
+
+    res = elastic.run_resilient(train_fn, 10, str(tmp_path / "ckpt"),
+                                save_fn, load_fn,
+                                checkpoint_interval=5, max_restarts=3)
+    assert res["steps"] == 10 and res["restarts"] == 1
+    bundles = fleet.flight_records(str(tmp_path / "flight"))
+    assert len(bundles) == 1
+    assert bundles[0].endswith("watchdog_abort")
+    man = json.load(open(os.path.join(bundles[0], "manifest.json")))
+    assert man["exception"]["type"] == "CommTimeoutError"
+    from tools import obs_dump
+    assert "watchdog_abort" in obs_dump.render(bundles[0])
+
+
+def test_run_resilient_restart_fault_leaves_bundle(tmp_path):
+    from paddle_tpu.distributed import elastic
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    obs.enable(reset=True)
+    fleet.configure_flight_recorder(dir=str(tmp_path / "flight"))
+    boom = {"armed": True}
+
+    def train_fn(start, end):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("transient fault")
+
+    def save_fn(step, path):
+        ckpt.save_state_dict(
+            {"w": paddle_tpu.to_tensor(np.zeros(1, np.float32))}, path)
+
+    def load_fn(path):
+        sd = {"w": paddle_tpu.to_tensor(np.zeros(1, np.float32))}
+        ckpt.load_state_dict(sd, path)
+
+    res = elastic.run_resilient(train_fn, 4, str(tmp_path / "ckpt"),
+                                save_fn, load_fn,
+                                checkpoint_interval=2, max_restarts=3)
+    assert res["restarts"] == 1
+    bundles = fleet.flight_records(str(tmp_path / "flight"))
+    assert len(bundles) == 1 and bundles[0].endswith("restart_fault")
+
+
+def test_serving_drain_dumps_bundle(tmp_path):
+    from paddle_tpu.inference.serving import PredictorServer
+    obs.enable(reset=True)
+    fleet.configure_flight_recorder(dir=str(tmp_path))
+    srv = PredictorServer(lambda d: d).start()
+    assert srv.drain(timeout=1.0)
+    bundles = fleet.flight_records(str(tmp_path))
+    assert len(bundles) == 1 and bundles[0].endswith("serving_drain")
+    man = json.load(open(os.path.join(bundles[0], "manifest.json")))
+    assert man["extra"]["stats"]["draining"] is True
+
+
+# ---------------------------------------------------------------------------
+# disabled path: one attribute check, no threads, no store keys
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_zero_side_effects(tmp_path, store, monkeypatch):
+    """With observability disabled: Trainer.fleet_heartbeat never
+    constructs a FleetHeartbeat (constructor-raises pin), serving
+    drain never reaches the flight recorder, no thread appears, and
+    the store carries no fleet keys."""
+    assert obs.ENABLED is False
+    before_threads = set(threading.enumerate())
+
+    def _boom(*a, **k):
+        raise AssertionError("FleetHeartbeat constructed while "
+                             "observability is disabled")
+    monkeypatch.setattr(fleet.FleetHeartbeat, "__init__", _boom)
+
+    from paddle_tpu.parallel.trainer import Trainer
+    t = object.__new__(Trainer)             # no model needed for the gate
+    assert Trainer.fleet_heartbeat(t, store, 0, 1) is None
+
+    from paddle_tpu.inference.serving import PredictorServer
+    fleet.configure_flight_recorder(dir=str(tmp_path))
+    srv = PredictorServer(lambda d: d).start()
+    assert srv.drain(timeout=1.0)           # record_crash would raise
+    assert fleet.flight_records(str(tmp_path)) == []
+    assert not store.check("fleet/hb/0")
+    leaked = [th for th in threading.enumerate()
+              if th not in before_threads and th.is_alive()
+              and th.name.startswith("fleet-")]
+    assert leaked == []
+
+
+# ---------------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------------
+
+def test_supervisor_store_read_failures_presume_stale():
+    """ISSUE 9 satellite (the analyze baseline's one debt entry): a
+    store read error during _stale_workers is counted
+    (elastic.store.read_errors) and N consecutive failures presume the
+    rank stale instead of healthy-forever; one success resets the
+    streak."""
+    from paddle_tpu.distributed.elastic import ElasticSupervisor
+
+    sup = object.__new__(ElasticSupervisor)  # no store/procs spawned
+    sup.world_size = 1
+    sup.attempt = 0
+    sup.grace = 10.0
+    sup.startup_grace = 120.0
+    sup._spawn_time = time.time()
+    sup._procs = []
+    sup.store_read_stale_after = 3
+    sup._hb_read_failures = {}
+
+    class _FlakyStore:
+        def __init__(self):
+            self.fail = True
+        def check(self, key):
+            if self.fail:
+                raise ConnectionError("store down")
+            return True
+        def get(self, key):
+            return repr(time.time()).encode()
+
+    sup._store = _FlakyStore()
+    obs.enable(reset=True)
+    assert sup._stale_workers() == []       # 1st failure: benefit of doubt
+    assert sup._stale_workers() == []       # 2nd
+    assert sup._stale_workers() == [0]      # 3rd consecutive: presumed stale
+    assert sup._stale_workers() == [0]      # stays stale while store is down
+    assert obs.REGISTRY.counter(
+        "elastic.store.read_errors").value() == 4
+    sup._store.fail = False
+    assert sup._stale_workers() == []       # fresh beat: healthy again
+    assert sup._hb_read_failures == {}      # streak reset
+    sup._store.fail = True
+    assert sup._stale_workers() == []       # streak restarts at 1
+
+
+def test_analyze_baseline_ships_empty():
+    """The sole grandfathered debt entry is paid down: the baseline
+    ratchet starts from zero."""
+    with open(os.path.join(_ROOT, "tools", "analyze",
+                           "baseline.json")) as f:
+        doc = json.load(f)
+    assert doc["entries"] == []
+
+
+def test_recompile_counter_labeled_with_batch_shape():
+    """ISSUE 9 satellite: train.recompiles carries the triggering
+    batch-shape signature — one count per DISTINCT signature (each is
+    one jit retrace), feeding the bucket-autotune loop."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.models.llama import tiny_llama_config
+    from paddle_tpu.models import LlamaForCausalLM
+    from paddle_tpu.parallel import Trainer, TrainStepConfig
+
+    paddle_tpu.seed(0)
+    cfg = tiny_llama_config()
+    model = LlamaForCausalLM(cfg)
+    optimizer = opt.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters())
+    trainer = Trainer(model, optimizer,
+                      config=TrainStepConfig(compute_dtype=None))
+    rng = np.random.RandomState(0)
+
+    def batch(b, s):
+        ids = rng.randint(0, cfg.vocab_size, (b, s)).astype(np.int32)
+        return {"input_ids": ids, "labels": ids}
+
+    # warm one shape with observability DISABLED: enabling mid-run must
+    # not retro-count the already-traced shape as a compile
+    trainer.step(batch(2, 8))
+    with obs.scoped() as reg:
+        trainer.step(batch(2, 8))           # warm shape: NO phantom count
+        trainer.step(batch(2, 16))          # new shape: real retrace
+        trainer.step(batch(2, 16))          # same signature: no new count
+        trainer.step(batch(2, 24))          # new seq length: retrace
+    c = reg.counter("train.recompiles")
+    cells = {dict(k)["shape"]: v for k, v in c.labeled().items()}
+    assert cells == {
+        "input_ids:2x16:int32,labels:2x16:int32": 1,
+        "input_ids:2x24:int32,labels:2x24:int32": 1,
+    }
+
+
+def test_fleet_catalogue_and_call_sites_agree_both_directions():
+    """The PR 7 pattern for fleet.py: every inc/observe/set_gauge
+    literal in observability/fleet.py is catalogued, and every
+    catalogued fleet.* instrument is actually recorded by a literal
+    call site in fleet.py — the catalogue and the plane cannot drift."""
+    from paddle_tpu.observability.metrics import METRICS
+    src = os.path.join(_ROOT, "paddle_tpu", "observability", "fleet.py")
+    tree = ast.parse(open(src).read())
+    seen = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and node.args \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("inc", "observe", "set_gauge"):
+            arg = node.args[0]
+            assert isinstance(arg, ast.Constant) and \
+                isinstance(arg.value, str), \
+                f"non-literal metric name at fleet.py:{node.lineno}"
+            assert arg.value in METRICS, arg.value
+            seen.add(arg.value)
+    fleet_names = {n for n in METRICS if n.startswith("fleet.")}
+    missing = fleet_names - seen
+    assert not missing, f"catalogued but never recorded: {missing}"
+
+
+def test_fleet_chaos_sites_registered():
+    assert "fleet.heartbeat.delay" in chaos.POINTS
+    assert "fleet.heartbeat.drop" in chaos.POINTS
+
+
+def test_store_clone_is_independent_connection(store):
+    c = store.clone()
+    try:
+        c.set("via-clone", b"1")
+        assert store.get("via-clone") == b"1"
+        assert c is not store and c._server is None  # never server-owning
+    finally:
+        c.close()
+    store.set("after-clone-close", b"1")    # original client unaffected
+    assert store.get("after-clone-close") == b"1"

@@ -1,0 +1,164 @@
+"""Pretty-print a crash flight-recorder bundle
+(paddle_tpu/observability/fleet.py `record_crash`).
+
+Usage:
+    python -m tools.obs_dump <bundle-dir>        one bundle
+    python -m tools.obs_dump <flight-dir>        newest bundle inside
+    python -m tools.obs_dump <bundle-dir> --json the parsed dict
+
+A bundle is a directory named ``flight-<ms>-<seq>-<reason>`` holding
+manifest.json / metrics.json / trace.json / requests.json /
+fleet.json / traceback.txt. `load()` parses it into one dict (the
+programmatic surface tests round-trip through); `render()` produces
+the human summary: what died, the last cross-rank fleet view with
+straggler flags, the in-flight requests, headline counters, and the
+traceback.
+
+Stdlib-only; never imports paddle_tpu or jax — a bundle must be
+readable on a workstation with nothing installed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+BUNDLE_FILES = ("manifest.json", "metrics.json", "trace.json",
+                "requests.json", "fleet.json", "traceback.txt")
+
+
+def is_bundle(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, "manifest.json"))
+
+
+def resolve(path: str) -> str:
+    """`path` itself when it is a bundle, else the newest
+    ``flight-*`` bundle directory inside it."""
+    if is_bundle(path):
+        return path
+    if os.path.isdir(path):
+        cands = sorted(n for n in os.listdir(path)
+                       if n.startswith("flight-"))
+        if cands:
+            return os.path.join(path, cands[-1])
+    raise FileNotFoundError(
+        f"{path!r} is neither a flight-recorder bundle (no "
+        "manifest.json) nor a directory containing flight-* bundles")
+
+
+def load(path: str) -> dict:
+    """Parse every bundle artifact into one dict keyed by artifact
+    stem (+ "path"). Missing or unparseable artifacts surface as
+    {"error": ...} under their key rather than failing the whole load
+    — half the point of a crash bundle is surviving imperfect dumps."""
+    path = resolve(path)
+    out = {"path": path}
+    for name in BUNDLE_FILES:
+        stem = name.rsplit(".", 1)[0]
+        fp = os.path.join(path, name)
+        try:
+            with open(fp) as f:
+                out[stem] = (f.read() if name.endswith(".txt")
+                             else json.load(f))
+        except (OSError, ValueError) as e:
+            out[stem] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def _counter_lines(metrics: dict) -> list:
+    lines = []
+    for name, fam in sorted(metrics.items()):
+        if not isinstance(fam, dict) or fam.get("kind") != "counter":
+            continue
+        for s in fam.get("series", []):
+            label = ",".join(f"{k}={v}"
+                             for k, v in sorted(s["labels"].items()))
+            suffix = f"{{{label}}}" if label else ""
+            lines.append(f"  {name}{suffix} = {s['value']}")
+    return lines
+
+
+def _fleet_lines(fleet: dict) -> list:
+    if not isinstance(fleet, dict) or not fleet.get("available"):
+        return ["  (no fleet view recorded)"]
+    view = fleet.get("view") or {}
+    summary = view.get("summary", {})
+    lines = [f"  world_size={view.get('world_size')} "
+             f"present={summary.get('present')} "
+             f"stale={summary.get('stale_ranks')} "
+             f"step_skew={summary.get('step_skew')} "
+             f"step_lag={summary.get('step_lag')} "
+             f"stragglers={summary.get('stragglers')}"]
+    for row in view.get("ranks", []):
+        mark = " <-- STRAGGLER" if row.get("straggler") else ""
+        lines.append(
+            f"  rank {row.get('rank')}: present={row.get('present')} "
+            f"step={row.get('step')} lag={row.get('lag')} "
+            f"age_s={row.get('age_s')} "
+            f"tok/s={row.get('tokens_per_sec')}{mark}")
+    return lines
+
+
+def _request_lines(requests: dict) -> list:
+    if not isinstance(requests, dict):
+        return ["  (unreadable)"]
+    rows = requests.get("requests") or []
+    if not rows:
+        return ["  (none in flight)"]
+    return [f"  {r.get('request_id')} stage={r.get('stage')} "
+            f"age_s={r.get('age_s')} tokens={r.get('tokens')}"
+            for r in rows]
+
+
+def render(path: str) -> str:
+    """The human summary of one bundle."""
+    b = load(path)
+    man = b.get("manifest") or {}
+    exc = man.get("exception")
+    trace_doc = b.get("trace") or {}
+    n_spans = len(trace_doc.get("traceEvents") or []) \
+        if isinstance(trace_doc, dict) else 0
+    lines = [
+        f"flight-recorder bundle: {b['path']}",
+        f"reason: {man.get('reason')}   at {man.get('iso_time')} "
+        f"(pid {man.get('pid')} on {man.get('host')})",
+        "exception: " + (f"{exc['type']}: {exc['message']}" if exc
+                         else "(none recorded)"),
+        "",
+        "fleet view (last seen):",
+        *_fleet_lines(b.get("fleet")),
+        "",
+        "in-flight requests:",
+        *_request_lines(b.get("requests")),
+        "",
+        f"spans in trace.json: {n_spans}",
+        "counters:",
+        *(_counter_lines(b.get("metrics") or {}) or ["  (none)"]),
+    ]
+    tb = b.get("traceback")
+    if isinstance(tb, str) and tb.strip():
+        lines += ["", "traceback.txt:", tb.rstrip()]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if len(argv) != 1:
+        print(__doc__, file=sys.stderr)
+        return 2
+    try:
+        if as_json:
+            print(json.dumps(load(argv[0]), indent=1, sort_keys=True,
+                             default=str))
+        else:
+            print(render(argv[0]))
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
