@@ -169,6 +169,64 @@ def _fleet_bench(trainer, batch, steps):
     }
 
 
+def _router_bench():
+    """Router hop overhead (ISSUE 10): the SAME /predict workload
+    measured direct-to-replica and through a 2-replica ReplicaRouter
+    on localhost — the p50/p95 delta is the latency one routing hop
+    adds (connect + pick + relay), the number a fleet deployment pays
+    per request for health-aware failover. Stdlib + a trivial
+    dict->dict predictor: no jax, no chip."""
+    import json as _json
+    import time
+    import urllib.request
+
+    from paddle_tpu.inference.router import ReplicaRouter
+    from paddle_tpu.inference.serving import PredictorServer
+
+    def pred(inputs):
+        return {"y": np.asarray([[1.0]], np.float32)}
+
+    servers = [PredictorServer(pred).start() for _ in range(2)]
+    router = ReplicaRouter(
+        [f"127.0.0.1:{s.port}" for s in servers]).start()
+    try:
+        body = _json.dumps({"inputs": {"x": [[1.0, 2.0]]}}).encode()
+
+        def once(port):
+            t0 = time.perf_counter()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                resp.read()
+            return (time.perf_counter() - t0) * 1000.0
+
+        n = 50
+        for _ in range(5):                  # warm both paths
+            once(servers[0].port)
+            once(router.port)
+        direct = sorted(once(servers[0].port) for _ in range(n))
+        routed = sorted(once(router.port) for _ in range(n))
+
+        def pct(xs, p):
+            return xs[min(len(xs) - 1, int(round(p / 100.0
+                                                 * (len(xs) - 1))))]
+
+        out = {"requests": n, "replicas": len(servers)}
+        for name, xs in (("direct_ms", direct),
+                         ("via_router_ms", routed)):
+            out[name] = {f"p{p}": round(pct(xs, p), 3)
+                         for p in (50, 95)}
+        out["added_ms"] = {
+            f"p{p}": round(pct(routed, p) - pct(direct, p), 3)
+            for p in (50, 95)}
+        return out
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
 def main():
     import jax
     import paddle_tpu
@@ -276,6 +334,12 @@ def main():
     except Exception as e:           # noqa: BLE001 — never sink the
         fleet = {"error": f"{type(e).__name__}: {e}"}   # train metric
 
+    # replica-router hop overhead (ISSUE 10)
+    try:
+        router = _router_bench()
+    except Exception as e:           # noqa: BLE001 — never sink the
+        router = {"error": f"{type(e).__name__}: {e}"}  # train metric
+
     print(json.dumps({
         "metric": "llama1b_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
@@ -286,7 +350,7 @@ def main():
                   "loss": round(float(loss), 4),
                   "device": getattr(dev, "device_kind", str(dev)),
                   "batch": batch, "seq": seq, "steps": steps,
-                  "decode": decode, "fleet": fleet},
+                  "decode": decode, "fleet": fleet, "router": router},
     }))
 
 

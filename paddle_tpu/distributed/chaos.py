@@ -105,6 +105,19 @@ POINTS = {
                              "detector's heartbeat-age lever",
     "fleet.heartbeat.drop": "dropped fleet-heartbeat publish (the "
                             "rank's last beat goes stale in the store)",
+    "router.probe.delay": "slow replica health probe (stretches the "
+                          "router's detection window)",
+    "router.probe.flap": "a clean replica probe recorded as failed "
+                         "(drives the K-consecutive-probes re-entry "
+                         "damping)",
+    "router.connect.fail": "injected connection drop from the router "
+                           "to its chosen replica at forward time "
+                           "(the failover/replay lever)",
+    "router.replica.kill": "invoke the router's registered kill_hook "
+                           "against the replica currently being "
+                           "forwarded to, right after a relayed "
+                           "stream chunk (the kill-a-replica fleet "
+                           "soak's lever)",
     "trainer.grad": "non-finite (NaN) gradient poisoning in the "
                     "compiled train step",
     "io.prefetch.delay": "slow host input pipeline (delay in the "

@@ -20,6 +20,7 @@ set the defaults for policies built with `default_policy()`.
 from __future__ import annotations
 
 import os
+import random
 import time
 from dataclasses import dataclass, field
 
@@ -60,13 +61,23 @@ class RetryPolicy:
     multiplier: float = 2.0
     deadline: float | None = None     # wall-clock budget across attempts
     retryable: tuple = (ConnectionError, TimeoutError)
+    # "full" = AWS full jitter: each backoff is uniform over
+    # [0, min(base * mult^n, max_delay)] — many callers retrying the
+    # same fault spread out instead of re-colliding every attempt.
+    # None (default) keeps the exact exponential sequence.
+    jitter: str | None = None
+    # seedable RNG for deterministic jittered tests (None = the module
+    # random, i.e. genuinely random in production)
+    rng: object = field(default=None, repr=False)
     # sleep hook — tests swap in a no-op to run fast
     sleep: object = field(default=time.sleep, repr=False)
 
     def delays(self):
+        rng = self.rng if self.rng is not None else random
         d = self.base_delay
         while True:
-            yield min(d, self.max_delay)
+            cap = min(d, self.max_delay)
+            yield rng.uniform(0.0, cap) if self.jitter == "full" else cap
             d *= self.multiplier
 
     def run(self, fn, *args, desc=None, on_retry=None, **kwargs):

@@ -17,7 +17,7 @@ from paddle_tpu.core.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "PagedKVEngine", "PredictorServer", "serve",
-           "overload"]
+           "overload", "ReplicaRouter"]
 
 
 def __getattr__(name):
@@ -28,9 +28,14 @@ def __getattr__(name):
     if name in ("PredictorServer", "serve"):
         from paddle_tpu.inference import serving
         return getattr(serving, name)
+    if name == "ReplicaRouter":
+        from paddle_tpu.inference.router import ReplicaRouter
+        return ReplicaRouter
     if name == "overload":
-        from paddle_tpu.inference import overload
-        return overload
+        # importlib, not `from ... import`: a from-import of a not-yet-
+        # loaded submodule re-enters this __getattr__ and recurses
+        import importlib
+        return importlib.import_module("paddle_tpu.inference.overload")
     raise AttributeError(name)
 
 

@@ -32,6 +32,7 @@ never touches jax (it is also imported by the chaos-test tooling).
 """
 from __future__ import annotations
 
+import random
 import threading
 import time
 
@@ -39,7 +40,46 @@ __all__ = [
     "OverloadError", "AdmissionRejected", "CircuitOpenError",
     "ServerDraining", "DeadlineExceeded", "EngineOverloaded",
     "Deadline", "AdmissionController", "CircuitBreaker",
+    "jittered_retry_after", "seed_retry_jitter",
 ]
+
+
+# -- Retry-After jitter -----------------------------------------------------
+#
+# Shed replies used to advertise FIXED Retry-After values (the
+# admission controller's retry_after_s constant, the breaker's cooldown
+# remainder) — so every client shed in the same overload burst backed
+# off for the same interval and came back in the same instant: a
+# self-sustaining retry storm. The fix is bounded ±jitter applied at
+# the single point a Retry-After value is emitted (serving's reply
+# writer, the router's shed replies), never where the value is
+# computed — breaker math and tests keep seeing exact values.
+
+_RETRY_JITTER_FRAC = 0.25
+_retry_jitter_lock = threading.Lock()
+_retry_jitter_rng = random.Random()
+
+
+def seed_retry_jitter(seed):
+    """Deterministic Retry-After jitter for tests / chaos harnesses:
+    after seeding, the emitted values follow the seeded RNG's exact
+    uniform sequence."""
+    global _retry_jitter_rng
+    with _retry_jitter_lock:
+        _retry_jitter_rng = random.Random(seed)
+
+
+def jittered_retry_after(seconds, frac=_RETRY_JITTER_FRAC):
+    """`seconds` spread uniformly over ±`frac` (bounded below at 50ms
+    so a tiny advertised backoff never jitters to zero). None passes
+    through — no header, nothing to desynchronize."""
+    if seconds is None:
+        return None
+    s = float(seconds)
+    lo = max(0.05, s * (1.0 - frac))
+    hi = max(lo, s * (1.0 + frac))
+    with _retry_jitter_lock:
+        return _retry_jitter_rng.uniform(lo, hi)
 
 
 # -- typed rejections -------------------------------------------------------

@@ -84,7 +84,7 @@ from paddle_tpu import observability
 from paddle_tpu.inference.overload import (
     AdmissionController, AdmissionRejected, CircuitBreaker, Deadline,
     DeadlineExceeded, OverloadError, ServerDraining,
-    expired as _expired)
+    expired as _expired, jittered_retry_after)
 from paddle_tpu.observability import requests as obs_requests
 from paddle_tpu.observability.metrics import MetricsRegistry
 
@@ -433,13 +433,21 @@ class PredictorServer:
                     self.send_header("X-Request-Id", ctx.request_id)
                     self.send_header("traceparent", ctx.traceparent())
 
-            def _reply(self, code, obj, retry_after=None):
+            def _reply(self, code, obj, retry_after=None,
+                       jittered=False):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self._echo_trace_headers()
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 if retry_after is not None:
+                    # bounded ±jitter at the point the header is
+                    # emitted: fixed backoff values re-synchronize
+                    # every shed client into a retry storm
+                    # (jittered=True when the caller already drew one
+                    # value to keep header and body consistent)
+                    if not jittered:
+                        retry_after = jittered_retry_after(retry_after)
                     self.send_header(
                         "Retry-After",
                         str(max(1, int(math.ceil(retry_after)))))
@@ -504,13 +512,16 @@ class PredictorServer:
                     if ready:
                         return self._reply(200, {"status": "ready"})
                     # machine-readable load signals ride the 503 body:
-                    # a fleet router routes/sheds on numbers, not prose
+                    # a fleet router routes/sheds on numbers, not prose.
+                    # One jitter draw feeds body AND header so the two
+                    # advertised backoffs agree.
+                    ra = jittered_retry_after(outer.retry_after_s)
                     return self._reply(
                         503, {"status": "unready", "reason": reason,
                               "in_flight": outer.admission.in_flight,
                               "queue_depth": outer.queue_depth(),
-                              "retry_after_s": outer.retry_after_s},
-                        retry_after=outer.retry_after_s)
+                              "retry_after_s": round(ra, 3)},
+                        retry_after=ra, jittered=True)
                 if self.path == "/debug/requests":
                     live = obs_requests.live_requests()
                     return self._reply(200, {
@@ -756,6 +767,7 @@ class PredictorServer:
         out = {"model": self.model_name,
                "draining": self._draining,
                "in_flight": self.admission.in_flight,
+               "queue_depth": self.queue_depth(),
                "capacity": self.admission.capacity,
                "requests": counts,
                "breaker": self.breaker.snapshot(),

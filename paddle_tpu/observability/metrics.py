@@ -217,6 +217,38 @@ METRICS = {
     "fleet.flight.records": ("counter",
                              "flight-recorder bundles dumped (label: "
                              "reason)"),
+    # -- replica fleet router (inference/router.py) -------------------
+    "router.requests": ("counter",
+                        "routed requests by outcome (label: outcome = "
+                        "ok | shed_upstream | no_replicas | failed | "
+                        "deadline_exceeded | client_error | "
+                        "server_error | stream_error | disconnected)"),
+    "router.retries": ("counter",
+                       "failover retries (label: kind = shed | "
+                       "connect | stream)"),
+    "router.probes": ("counter",
+                      "replica health probes (label: result = ready | "
+                      "saturated | draining | breaker | failed | "
+                      "flap)"),
+    "router.ejections": ("counter",
+                         "replicas ejected from rotation (label: "
+                         "reason = draining | probe_failed | "
+                         "replica_breaker | breaker_open | "
+                         "connect_failed)"),
+    "router.reentries": ("counter",
+                         "ejected replicas re-admitted after K "
+                         "consecutive clean probes"),
+    "router.affinity.rebinds": ("counter",
+                                "sessions re-pinned after their "
+                                "affine replica left rotation"),
+    "router.replicas.in_rotation": ("gauge",
+                                    "replicas currently routable"),
+    "router.replicas.ejected": ("gauge",
+                                "replicas currently out of rotation"),
+    "router.forward.seconds": ("histogram",
+                               "router-side request wall time incl. "
+                               "failover retries (the added-hop "
+                               "budget)", DEFAULT_BUCKETS_S),
     # -- paged KV engine ----------------------------------------------
     "inference.decode.kernel": ("counter",
                                 "decode ticks by attend path (label: "

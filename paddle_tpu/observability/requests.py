@@ -64,9 +64,9 @@ from paddle_tpu.observability.metrics import REGISTRY
 from paddle_tpu.observability import trace
 
 __all__ = [
-    "EVENTS", "RequestContext", "parse_traceparent", "current",
-    "set_current", "reset_current", "register", "live_requests",
-    "configure", "clear",
+    "EVENTS", "RequestContext", "parse_traceparent", "safe_request_id",
+    "current", "set_current", "reset_current", "register",
+    "live_requests", "configure", "clear",
 ]
 
 #: the closed event-name catalogue (the metrics.METRICS pattern):
@@ -173,6 +173,14 @@ def _safe_request_id(rid):
     if not all(c in _RID_CHARS for c in rid):
         return None
     return rid
+
+
+def safe_request_id(rid):
+    """Public form of the echo-safety check: any layer that echoes an
+    inbound `X-Request-Id` (the replica router, a future gateway) must
+    apply the SAME injection rules as the serving layer, or the hop
+    becomes the header-injection vector the serving layer closed."""
+    return _safe_request_id(rid)
 
 
 def parse_traceparent(header):

@@ -1,0 +1,1087 @@
+"""ISSUE 10 — the replica fleet router (inference/router.py): health-
+aware failover across N PredictorServer replicas.
+
+The load-bearing scenarios, all chaos/event-deterministic (probes are
+driven by explicit `probe_all()` calls, never by racing the background
+prober; blocking backends are event-controlled):
+
+- least-loaded pick from the probed `/readyz` 503 body + `/stats`
+  numbers; a saturated replica is deprioritized, a draining one is
+  ejected immediately while its in-flight work finishes;
+- retry-on-shed: a 429 from one replica fails over to a healthy one;
+  when EVERY replica sheds, the router honors the Retry-After floor
+  with full-jitter backoff and then relays the shed reply;
+- `router.connect.fail` chaos drives failover; repeated forward
+  failures open the per-replica breaker, eject the replica, and dump
+  a `replica_ejected` flight-recorder bundle;
+- probe-flap damping: an ejected replica re-enters only after K
+  consecutive clean probes (`router.probe.flap` resets the streak);
+- session affinity sticks, survives a non-affine replica's death, and
+  re-pins when the affine replica dies;
+- X-Request-Id / traceparent span the router -> replica hop (PR 7
+  contract) and router-origin replies echo the sanitized identity;
+- the chaos soak: 3 replicas serving concurrent token streams,
+  `router.replica.kill` tears one down mid-stream — every request
+  completes on a survivor or fails with a typed retryable status,
+  zero hangs, and the killed replica re-enters rotation after K clean
+  probes once restarted;
+- Retry-After jitter (overload.py satellite) and RetryPolicy full
+  jitter (retries.py satellite) are seeded-deterministic and bounded.
+
+No jax needed: predictors are plain callables and generators are fake
+token sources, so this file runs everywhere tier-1 does.
+"""
+import ast
+import json
+import os
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed import chaos
+from paddle_tpu.distributed.retries import RetryPolicy
+from paddle_tpu.inference import overload
+from paddle_tpu.inference.router import ReplicaRouter
+from paddle_tpu.inference.serving import PredictorServer
+from paddle_tpu.observability import fleet
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# routers and servers own threads; stop() must join them
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Observability and the flight recorder are process-global; every
+    test starts disabled/disarmed and leaves the process the same
+    way."""
+    obs.disable()
+    obs.REGISTRY.reset()
+    fleet.configure_flight_recorder(dir=None, max_keep=5)
+    yield
+    obs.disable()
+    obs.REGISTRY.reset()
+    fleet.configure_flight_recorder(dir=None, max_keep=5)
+
+
+# -- helpers ----------------------------------------------------------------
+
+def _req(port, path, obj=None, headers=None):
+    """(status, body_dict, headers_dict) for one HTTP round trip."""
+    url = f"http://127.0.0.1:{port}{path}"
+    data = None if obj is None else json.dumps(obj).encode()
+    r = urllib.request.Request(url, data=data,
+                               headers={"Content-Type":
+                                        "application/json",
+                                        **(headers or {})})
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            return resp.status, json.loads(resp.read()), dict(
+                resp.headers)
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, json.loads(body) if body else {}, dict(e.headers)
+
+
+def _wait_for(cond, timeout=10.0, what="condition"):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _no_sleep_policy(seed=0):
+    """Deterministic jittered policy whose sleep is a recorder, not a
+    clock: tests assert ON the requested delays instead of paying
+    them."""
+    slept = []
+    policy = RetryPolicy(max_attempts=3, base_delay=0.05, max_delay=1.0,
+                         jitter="full", rng=random.Random(seed),
+                         sleep=slept.append)
+    return policy, slept
+
+
+class _Pred:
+    """Plain dict->dict predictor; optionally blocks on an event."""
+
+    def __init__(self, block=None):
+        self.calls = 0
+        self.block = block
+
+    def __call__(self, inputs):
+        self.calls += 1
+        if self.block is not None:
+            assert self.block.wait(timeout=30)
+        return {"y": np.asarray([[2.0]], np.float32)}
+
+
+class _TokSource:
+    """generator= object streaming `n` tokens, recording close()."""
+
+    concurrent_safe = False
+
+    def __init__(self, n=3):
+        self.n = n
+
+    def stream(self, ids, **kw):
+        def gen():
+            for i in range(self.n):
+                yield np.asarray([i])
+        return gen()
+
+
+_ONE_ROW = {"x0": [[1.0, 2.0]]}
+_BODY = {"inputs": {"x": [[1.0, 2.0]]}}
+
+
+def _mk_fleet(n=2, preds=None, gens=None, **server_kw):
+    preds = preds or [_Pred() for _ in range(n)]
+    servers = [PredictorServer(
+        preds[i], model_name=f"r{i}",
+        generator=(gens[i] if gens else None), **server_kw).start()
+        for i in range(n)]
+    pairs = [(f"r{i}", f"127.0.0.1:{s.port}")
+             for i, s in enumerate(servers)]
+    return preds, servers, pairs
+
+
+# -- routing & the probe state machine --------------------------------------
+
+def test_basic_routing_and_readyz():
+    _preds, servers, pairs = _mk_fleet(2)
+    router = ReplicaRouter(pairs).start(probe=False)
+    try:
+        code, body, _h = _req(router.port, "/readyz")
+        assert code == 200 and body["replicas_in_rotation"] == 2
+        code, body, hdrs = _req(router.port, "/predict", _BODY)
+        assert code == 200 and "outputs" in body
+        assert hdrs.get("X-Routed-To") in ("r0", "r1")
+        st = router.stats()
+        assert st["requests"]["ok"] == 1 and st["in_rotation"] == 2
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_least_loaded_pick_and_saturated_deprioritized():
+    """Replica 0 carries one blocked in-flight request (max_concurrent
+    1 -> /readyz says "saturated" with numeric load fields); the probe
+    deprioritizes it and the router sends new work to replica 1."""
+    release = threading.Event()
+    preds = [_Pred(block=release), _Pred()]
+    _p, servers, pairs = _mk_fleet(2, preds=preds, max_concurrent=1)
+    router = ReplicaRouter(pairs).start(probe=False)
+    try:
+        # occupy replica 0 DIRECTLY (not via the router)
+        t = threading.Thread(
+            target=lambda: _req(servers[0].port, "/predict", _BODY),
+            daemon=True)
+        t.start()
+        _wait_for(lambda: servers[0].admission.in_flight == 1,
+                  what="replica 0 in flight")
+        router.probe_all()
+        view = router.debug_replicas()
+        rows = {r["id"]: r for r in view["replicas"]}
+        assert rows["r0"]["deprioritized"] is True
+        assert rows["r0"]["in_rotation"] is True      # still routable
+        assert rows["r0"]["replica_in_flight"] == 1
+        assert rows["r1"]["deprioritized"] is False
+        for _ in range(3):
+            code, _b, hdrs = _req(router.port, "/predict", _BODY)
+            assert code == 200 and hdrs["X-Routed-To"] == "r1"
+        release.set()
+        t.join(timeout=10)
+    finally:
+        release.set()
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_draining_replica_ejected_immediately_but_finishes_work():
+    """Drain-aware removal: the probe ejects a draining replica the
+    moment /readyz says so — new work routes away while the draining
+    replica finishes its in-flight request."""
+    release = threading.Event()
+    preds = [_Pred(block=release), _Pred()]
+    _p, servers, pairs = _mk_fleet(2, preds=preds)
+    router = ReplicaRouter(pairs).start(probe=False)
+    drained = {}
+    dt = None
+    try:
+        inflight = {}
+        t = threading.Thread(
+            target=lambda: inflight.update(
+                resp=_req(servers[0].port, "/predict", _BODY)),
+            daemon=True)
+        t.start()
+        _wait_for(lambda: servers[0].admission.in_flight == 1,
+                  what="in-flight request on replica 0")
+        dt = threading.Thread(
+            target=lambda: drained.update(
+                clean=servers[0].drain(timeout=20)), daemon=True)
+        dt.start()
+        _wait_for(lambda: servers[0]._draining, what="draining flag")
+        router.probe_all()
+        rows = {r["id"]: r
+                for r in router.debug_replicas()["replicas"]}
+        assert rows["r0"]["in_rotation"] is False
+        assert rows["r0"]["reason"] == "draining"
+        assert router.metrics.counter("router.ejections").value(
+            reason="draining") == 1
+        # new work routes away from the draining replica
+        code, _b, hdrs = _req(router.port, "/predict", _BODY)
+        assert code == 200 and hdrs["X-Routed-To"] == "r1"
+        # ...while its in-flight request finishes (drain, not kill)
+        release.set()
+        t.join(timeout=10)
+        assert inflight["resp"][0] == 200
+        dt.join(timeout=20)
+        assert drained["clean"] is True
+    finally:
+        release.set()
+        router.stop()
+        servers[1].stop()
+        if dt is not None:
+            dt.join(timeout=20)     # drain stopped servers[0] itself
+
+
+def test_retry_on_shed_fails_over_to_healthy_replica():
+    """Replica 0 sheds 429 (capacity exhausted by a direct blocked
+    request); the router retries the request against replica 1 —
+    the client sees one clean 200."""
+    release = threading.Event()
+    preds = [_Pred(block=release), _Pred()]
+    _p, servers, pairs = _mk_fleet(2, preds=preds, max_concurrent=1,
+                                   max_queue_depth=0)
+    policy, slept = _no_sleep_policy()
+    router = ReplicaRouter(pairs, retry_policy=policy).start(probe=False)
+    try:
+        # both replicas probe healthy+equal BEFORE replica 0 is loaded,
+        # so the round-robin tiebreak deterministically picks r0 first
+        t = threading.Thread(
+            target=lambda: _req(servers[0].port, "/predict", _BODY),
+            daemon=True)
+        t.start()
+        _wait_for(lambda: servers[0].admission.in_flight == 1,
+                  what="replica 0 saturated")
+        code, body, hdrs = _req(router.port, "/predict", _BODY)
+        assert code == 200 and hdrs["X-Routed-To"] == "r1"
+        assert router.stats()["retries"]["shed"] == 1
+        assert slept == []              # failover was immediate
+        assert servers[0].stats()["requests"]["shed_admission"] == 1
+        release.set()
+        t.join(timeout=10)
+    finally:
+        release.set()
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_all_replicas_shed_honors_retry_after_floor_then_relays():
+    """When EVERY routable replica sheds, the router backs off once —
+    at least the advertised Retry-After floor, full-jittered — retries
+    the round, and finally relays the upstream shed reply (typed, with
+    Retry-After) instead of inventing its own."""
+    release = threading.Event()
+    preds = [_Pred(block=release), _Pred(block=release)]
+    _p, servers, pairs = _mk_fleet(2, preds=preds, max_concurrent=1,
+                                   max_queue_depth=0)
+    policy, slept = _no_sleep_policy()
+    router = ReplicaRouter(pairs, retry_policy=policy,
+                           shed_rounds=2).start(probe=False)
+    try:
+        ts = []
+        for s in servers:
+            t = threading.Thread(
+                target=lambda s=s: _req(s.port, "/predict", _BODY),
+                daemon=True)
+            t.start()
+            ts.append(t)
+        _wait_for(lambda: all(s.admission.in_flight == 1
+                              for s in servers),
+                  what="both replicas saturated")
+        code, body, hdrs = _req(router.port, "/predict", _BODY)
+        assert code == 429
+        assert "Retry-After" in hdrs
+        assert "admission rejected" in body["error"]
+        # one backoff between the two rounds, honoring the >=1s floor
+        # the replicas advertised (integer Retry-After header)
+        assert len(slept) == 1 and slept[0] >= 1.0
+        st = router.stats()
+        assert st["requests"]["shed_upstream"] == 1
+        assert st["retries"]["shed"] == 4       # 2 replicas x 2 rounds
+        release.set()
+        for t in ts:
+            t.join(timeout=10)
+    finally:
+        release.set()
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_connect_fail_chaos_drives_failover():
+    _preds, servers, pairs = _mk_fleet(2)
+    router = ReplicaRouter(pairs).start(probe=False)
+    try:
+        with chaos.scoped(seed=5,
+                          rates={"router.connect.fail": (1.0, 1)}):
+            code, _b, hdrs = _req(router.port, "/predict", _BODY)
+            assert chaos.fire_count("router.connect.fail") == 1
+        assert code == 200
+        assert router.stats()["retries"]["connect"] == 1
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_forward_failures_open_breaker_eject_and_flight_record(tmp_path):
+    """A dead replica (server stopped): forwards fail over to the
+    survivor; the per-replica breaker opens, the replica is ejected
+    with reason breaker_open, and — with observability on — a
+    `replica_ejected` flight-recorder bundle is dumped with its
+    last-known stats."""
+    _preds, servers, pairs = _mk_fleet(2)
+    router = ReplicaRouter(pairs, breaker_threshold=2,
+                           eject_after=5).start(probe=False)
+    obs.enable(reset=True)
+    fleet.configure_flight_recorder(dir=str(tmp_path), max_keep=5)
+    try:
+        router.probe_all()              # capture last_stats while alive
+        servers[0].stop()               # replica 0 dies
+        for _ in range(4):
+            code, _b, hdrs = _req(router.port, "/predict", _BODY)
+            assert code == 200 and hdrs["X-Routed-To"] == "r1"
+        r0 = router.replica("r0")
+        assert r0.breaker.state == "open"
+        assert not r0.in_rotation and r0.reason == "breaker_open"
+        assert router.metrics.counter("router.ejections").value(
+            reason="breaker_open") == 1
+        recs = fleet.flight_records(str(tmp_path))
+        assert len(recs) == 1
+        manifest = json.load(
+            open(os.path.join(recs[0], "manifest.json")))
+        assert manifest["reason"] == "replica_ejected"
+        assert manifest["extra"]["replica"] == "r0"
+        assert manifest["extra"]["reason"] == "breaker_open"
+        assert manifest["extra"]["last_stats"]["model"] == "r0"
+        # once ejected + breaker-open, r0 is never even attempted:
+        # the connect-retry counter stays where it was
+        before = router.stats()["retries"].get("connect", 0)
+        for _ in range(3):
+            code, _b, hdrs = _req(router.port, "/predict", _BODY)
+            assert code == 200 and hdrs["X-Routed-To"] == "r1"
+        assert router.stats()["retries"].get("connect", 0) == before
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_probe_failures_eject_and_flap_damping_gates_reentry():
+    """Probe-driven ejection (eject_after consecutive failures), then
+    re-entry damping: the restarted replica must pass K CONSECUTIVE
+    clean probes — a `router.probe.flap` mid-sequence resets the
+    streak, and one flap can never pull a sick replica back early."""
+    _preds, servers, pairs = _mk_fleet(2)
+    router = ReplicaRouter(pairs, eject_after=2,
+                           reenter_probes=2).start(probe=False)
+    try:
+        port0 = servers[0].port
+        servers[0].stop()
+        router.probe_all()              # fail #1: still in rotation
+        assert router.replica("r0").in_rotation
+        router.probe_all()              # fail #2: ejected
+        r0 = router.replica("r0")
+        assert not r0.in_rotation and r0.reason == "probe_failed"
+        assert router.metrics.counter("router.ejections").value(
+            reason="probe_failed") == 1
+        # restart on the same port. Probes run concurrently across
+        # replicas, so cap the flap at 2: BOTH ready probes of the
+        # first pass flap (whichever thread decides first), keeping
+        # the pass deterministic — r0's re-entry streak resets, r1
+        # (1 fail < eject_after 2) stays in rotation
+        servers[0] = PredictorServer(_Pred(), model_name="r0",
+                                     port=port0).start()
+        with chaos.scoped(seed=3,
+                          rates={"router.probe.flap": (1.0, 2)}):
+            router.probe_all()          # clean probes FLAPPED to failed
+            assert not router.replica("r0").in_rotation
+            assert router.replica("r1").in_rotation
+            router.probe_all()          # clean #1 of the needed 2
+            assert not router.replica("r0").in_rotation
+            router.probe_all()          # clean #2: re-enters
+        assert router.replica("r0").in_rotation
+        assert router.replica("r1").in_rotation
+        assert router.metrics.counter("router.reentries").value() == 1
+        assert router.metrics.counter("router.probes").value(
+            result="flap") == 2
+        code, _b, _h = _req(router.port, "/readyz")
+        assert code == 200
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+# -- session affinity --------------------------------------------------------
+
+def test_session_affinity_sticks_and_survives_nonaffine_death():
+    gens = [_TokSource() for _ in range(3)]
+    _preds, servers, pairs = _mk_fleet(3, gens=gens)
+    router = ReplicaRouter(pairs, eject_after=1).start(probe=False)
+    try:
+        hdr = {"X-Session-Id": "conv-1"}
+        code, _b, hdrs = _req(router.port, "/predict", _BODY,
+                              headers=hdr)
+        assert code == 200
+        home = hdrs["X-Routed-To"]
+        for _ in range(3):
+            code, _b, hdrs = _req(router.port, "/predict", _BODY,
+                                  headers=hdr)
+            assert code == 200 and hdrs["X-Routed-To"] == home
+        # kill a NON-affine replica: the pin must not move
+        other = next(rid for rid, _u in pairs if rid != home)
+        servers[int(other[1:])].stop()
+        router.probe_all()              # eject_after=1: ejected now
+        assert not router.replica(other).in_rotation
+        code, _b, hdrs = _req(router.port, "/predict", _BODY,
+                              headers=hdr)
+        assert code == 200 and hdrs["X-Routed-To"] == home
+        assert router.metrics.counter(
+            "router.affinity.rebinds").value() == 0
+        # kill the AFFINE replica: the session re-pins to a survivor
+        servers[int(home[1:])].stop()
+        router.probe_all()
+        code, _b, hdrs = _req(router.port, "/predict", _BODY,
+                              headers=hdr)
+        assert code == 200
+        new_home = hdrs["X-Routed-To"]
+        assert new_home not in (home, other)
+        assert router.metrics.counter(
+            "router.affinity.rebinds").value() == 1
+        # and the new pin sticks
+        code, _b, hdrs = _req(router.port, "/predict", _BODY,
+                              headers=hdr)
+        assert hdrs["X-Routed-To"] == new_home
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+class _SwitchPred:
+    """Predictor that blocks only while `hold` is set — so the pinned
+    replica can serve the pin-establishing request fast and THEN be
+    saturated for the shed phase."""
+
+    def __init__(self):
+        self.hold = threading.Event()
+        self.release = threading.Event()
+
+    def __call__(self, inputs):
+        if self.hold.is_set():
+            assert self.release.wait(timeout=30)
+        return {"y": np.asarray([[2.0]], np.float32)}
+
+
+def test_affinity_not_repinned_on_transient_shed():
+    """One shed from the pinned replica routes THIS request around it
+    but keeps the pin — its KV locality is the point; re-pinning
+    happens only when the replica actually leaves rotation."""
+    preds = [_SwitchPred(), _SwitchPred()]
+    _p, servers, pairs = _mk_fleet(2, preds=preds, max_concurrent=1,
+                                   max_queue_depth=0)
+    policy, _slept = _no_sleep_policy()
+    router = ReplicaRouter(pairs, retry_policy=policy).start(probe=False)
+    pinned = None
+    try:
+        hdr = {"X-Session-Id": "sticky"}
+        code, _b, hdrs = _req(router.port, "/predict", _BODY,
+                              headers=hdr)
+        assert code == 200
+        home = hdrs["X-Routed-To"]
+        # saturate the pinned replica so it sheds exactly this request
+        i = int(home[1:])
+        srv, pinned = servers[i], preds[i]
+        pinned.hold.set()
+        t = threading.Thread(
+            target=lambda: _req(srv.port, "/predict", _BODY),
+            daemon=True)
+        t.start()
+        _wait_for(lambda: srv.admission.in_flight == 1,
+                  what="pinned replica saturated")
+        code, _b, hdrs = _req(router.port, "/predict", _BODY,
+                              headers=hdr)
+        assert code == 200 and hdrs["X-Routed-To"] != home
+        pinned.release.set()
+        t.join(timeout=10)
+        pinned.hold.clear()
+        # the pin never moved: the next request is home again
+        code, _b, hdrs = _req(router.port, "/predict", _BODY,
+                              headers=hdr)
+        assert code == 200 and hdrs["X-Routed-To"] == home
+        assert router.metrics.counter(
+            "router.affinity.rebinds").value() == 0
+    finally:
+        if pinned is not None:
+            pinned.release.set()
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_all_shed_backoff_never_outlives_client_budget():
+    """A Retry-After floor larger than the client's remaining
+    X-Timeout-Ms budget: 504 NOW (typed, non-retryable), not a sleep
+    the client will never see the end of."""
+    release = threading.Event()
+    preds = [_Pred(block=release)]
+    _p, servers, pairs = _mk_fleet(1, preds=preds, max_concurrent=1,
+                                   max_queue_depth=0)
+    policy, slept = _no_sleep_policy()
+    router = ReplicaRouter(pairs, retry_policy=policy,
+                           shed_rounds=3).start(probe=False)
+    try:
+        t = threading.Thread(
+            target=lambda: _req(servers[0].port, "/predict", _BODY),
+            daemon=True)
+        t.start()
+        _wait_for(lambda: servers[0].admission.in_flight == 1,
+                  what="replica saturated")
+        # 400ms budget vs the replica's >=1s Retry-After floor
+        code, body, _h = _req(router.port, "/predict", _BODY,
+                              headers={"X-Timeout-Ms": "400"})
+        assert code == 504
+        assert body["reason"] == "deadline_exceeded"
+        assert body["retryable"] is False
+        assert slept == []              # never slept past the budget
+        release.set()
+        t.join(timeout=10)
+    finally:
+        release.set()
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_affinity_lru_bound():
+    _preds, servers, pairs = _mk_fleet(1)
+    router = ReplicaRouter(pairs, affinity_capacity=3).start(probe=False)
+    try:
+        for i in range(5):
+            _req(router.port, "/predict", _BODY,
+                 headers={"X-Session-Id": f"s{i}"})
+        assert router.debug_replicas()["summary"]["sessions"] == 3
+    finally:
+        router.stop()
+        servers[0].stop()
+
+
+# -- observability continuity ------------------------------------------------
+
+def test_trace_headers_span_router_to_replica():
+    """PR 7 contract across the hop: the inbound X-Request-Id and
+    traceparent reach the replica, which adopts them; the reply the
+    client sees THROUGH the router carries the same request id and the
+    same trace id with a fresh parent span."""
+    _preds, servers, pairs = _mk_fleet(1)
+    router = ReplicaRouter(pairs).start(probe=False)
+    obs.enable(reset=True)
+    try:
+        trace_id = "a" * 32
+        inbound_tp = f"00-{trace_id}-{'b' * 16}-01"
+        code, _b, hdrs = _req(
+            router.port, "/predict", _BODY,
+            headers={"X-Request-Id": "req-e2e-42",
+                     "traceparent": inbound_tp})
+        assert code == 200
+        assert hdrs["X-Request-Id"] == "req-e2e-42"
+        ver, tid, parent, _flags = hdrs["traceparent"].split("-")
+        assert tid == trace_id              # one trace spans the hop
+        assert parent != "b" * 16           # replica's own span is the
+        assert ver == "00"                  # new parent
+    finally:
+        router.stop()
+        servers[0].stop()
+
+
+def test_router_origin_reply_echoes_sanitized_identity():
+    """A router-origin shed (no replicas) still closes the trace loop:
+    safe inbound ids echo, malformed traceparent does not."""
+    router = ReplicaRouter([]).start(probe=False)
+    try:
+        tp = f"00-{'c' * 32}-{'d' * 16}-01"
+        code, body, hdrs = _req(router.port, "/predict", _BODY,
+                                headers={"X-Request-Id": "rid-7",
+                                         "traceparent": tp})
+        assert code == 503
+        assert body["reason"] == "no_replicas"
+        assert body["retryable"] is True
+        assert "Retry-After" in hdrs
+        assert hdrs["X-Request-Id"] == "rid-7"
+        assert hdrs["traceparent"] == tp
+        code, _b, hdrs = _req(router.port, "/predict", _BODY,
+                              headers={"traceparent": "garbage"})
+        assert "traceparent" not in hdrs
+        # the sanitizer the router shares with serving (PR 7 rules)
+        from paddle_tpu.observability.requests import safe_request_id
+        assert safe_request_id("ok-id_1.2") == "ok-id_1.2"
+        assert safe_request_id("bad id") is None
+        assert safe_request_id("x" * 200) is None
+    finally:
+        router.stop()
+
+
+# -- debug & tooling surfaces ------------------------------------------------
+
+def test_debug_replicas_schema_and_stats_queue_depth():
+    _preds, servers, pairs = _mk_fleet(2)
+    router = ReplicaRouter(pairs).start(probe=False)
+    try:
+        code, view, _h = _req(router.port, "/debug/replicas")
+        assert code == 200
+        assert view["summary"] == {"total": 2, "in_rotation": 2,
+                                   "ejected": 0, "deprioritized": 0,
+                                   "sessions": 0}
+        row = view["replicas"][0]
+        for key in ("id", "url", "in_rotation", "deprioritized",
+                    "reason", "consecutive_ok", "consecutive_fail",
+                    "in_flight_router", "replica_in_flight",
+                    "replica_queue_depth", "load_score",
+                    "last_probe_age_s", "breaker", "ejections",
+                    "served"):
+            assert key in row, key
+        assert row["breaker"]["state"] == "closed"
+        # serving satellite: /stats now carries the router's load
+        # number even when ready (the /readyz 503 body twin)
+        code, st, _h = _req(servers[0].port, "/stats")
+        assert code == 200 and st["queue_depth"] == 0
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_metrics_endpoint_and_status_tool():
+    _preds, servers, pairs = _mk_fleet(1)
+    router = ReplicaRouter(pairs).start(probe=False)
+    try:
+        _req(router.port, "/predict", _BODY)
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}/metrics",
+            timeout=30).read().decode()
+        assert ('paddle_tpu_router_requests_total{outcome="ok"} 1'
+                in text)
+        assert "paddle_tpu_router_replicas_in_rotation 1" in text
+        from tools.router_status import fetch, render
+        doc = fetch(f"127.0.0.1:{router.port}")
+        out = render(doc)
+        assert "r0" in out and "in rotation" in out
+        # render is total on partial documents (half-broken router)
+        assert "replicas:" in render({"replicas": [],
+                                      "summary": None})
+    finally:
+        router.stop()
+        servers[0].stop()
+
+
+# -- deadline budget across the hop ------------------------------------------
+
+class _HeaderEchoStub:
+    """Raw one-shot HTTP replica recording the X-Timeout-Ms it was
+    forwarded (a real PredictorServer consumes the header before any
+    test-visible surface)."""
+
+    def __init__(self):
+        import socket
+        self.seen = {}
+        self.got = threading.Event()
+        self._sock = socket.socket()
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(4)
+        self.port = self._sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        # every request (probe GETs included) gets a 200 JSON reply on
+        # its own connection; POST headers are the recorded evidence
+        while True:
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return              # stop() closed the listener
+            with conn:
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    chunk = conn.recv(4096)
+                    if not chunk:
+                        break
+                    data += chunk
+                head = data.split(b"\r\n\r\n", 1)[0].decode()
+                lines = head.split("\r\n")
+                if lines and lines[0].startswith("POST"):
+                    for line in lines[1:]:
+                        k, _, v = line.partition(": ")
+                        self.seen[k] = v
+                body = b'{"outputs": {}}'
+                conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                             b"Connection: close\r\n"
+                             b"Content-Type: application/json\r\n"
+                             + f"Content-Length: {len(body)}"
+                               "\r\n\r\n".encode() + body)
+                if lines and lines[0].startswith("POST"):
+                    self.got.set()
+
+    def stop(self):
+        self._sock.close()
+        self._thread.join(timeout=5)
+
+
+def test_forwarded_deadline_budget_is_decremented_not_restarted():
+    """The router replays with what is LEFT of X-Timeout-Ms, not the
+    original value — and a budget that dies mid-failover is a typed,
+    non-retryable 504 instead of a replica run the client already gave
+    up on."""
+    stub = _HeaderEchoStub()
+    router = ReplicaRouter([("s0", f"127.0.0.1:{stub.port}")])
+    try:
+        router.start(probe=False)   # the 200-everything stub probes in
+        assert router.replica("s0").in_rotation
+        code, _b, _h = _req(router.port, "/predict", _BODY,
+                            headers={"X-Timeout-Ms": "5000"})
+        assert code == 200 and stub.got.wait(timeout=10)
+        fwd = float(stub.seen["X-Timeout-Ms"])
+        assert 0 < fwd < 5000.0         # decremented by elapsed time
+        assert fwd > 4000.0             # ...but only by milliseconds
+    finally:
+        router.stop()
+        stub.stop()
+
+
+def test_deadline_exhausted_during_failover_is_typed_504():
+    """All replicas shed and the budget is tiny: after the first shed
+    round burned it, the router answers 504 deadline_exceeded
+    (retryable false) instead of replaying a dead request."""
+    release = threading.Event()
+    preds = [_Pred(block=release)]
+    _p, servers, pairs = _mk_fleet(1, preds=preds, max_concurrent=1,
+                                   max_queue_depth=0)
+    policy, _slept = _no_sleep_policy()
+    router = ReplicaRouter(pairs, retry_policy=policy,
+                           shed_rounds=3).start(probe=False)
+    try:
+        t = threading.Thread(
+            target=lambda: _req(servers[0].port, "/predict", _BODY),
+            daemon=True)
+        t.start()
+        _wait_for(lambda: servers[0].admission.in_flight == 1,
+                  what="replica saturated")
+        code, body, _h = _req(router.port, "/predict", _BODY,
+                              headers={"X-Timeout-Ms": "1"})
+        assert code == 504
+        assert body["reason"] == "deadline_exceeded"
+        assert body["retryable"] is False
+        assert router.stats()["requests"]["deadline_exceeded"] == 1
+        release.set()
+        t.join(timeout=10)
+    finally:
+        release.set()
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_replica_url_validation_and_fresh_replicas_not_ejected():
+    with pytest.raises(ValueError, match="bare host:port"):
+        ReplicaRouter([("r0", "http://10.0.0.1:8866")])
+    with pytest.raises(ValueError, match="bare host:port"):
+        ReplicaRouter(["hostwithoutport"])
+    # a freshly registered, never-admitted replica is warming up, not
+    # "ejected": rollout alerts on the gauge must stay quiet
+    router = ReplicaRouter([("r0", "127.0.0.1:1")])    # nothing there
+    try:
+        router.probe_all()
+        assert router.metrics.gauge(
+            "router.replicas.ejected").value() == 0.0
+        assert router.debug_replicas()["summary"]["ejected"] == 0
+        assert not router.replica("r0").in_rotation
+    finally:
+        router.stop()
+
+
+# -- jitter satellites -------------------------------------------------------
+
+def test_retry_after_jitter_seeded_deterministic_and_bounded():
+    overload.seed_retry_jitter(7)
+    exp = random.Random(7)
+    vals = [overload.jittered_retry_after(2.0) for _ in range(5)]
+    assert vals == [exp.uniform(1.5, 2.5) for _ in range(5)]
+    assert all(1.5 <= v <= 2.5 for v in vals)
+    assert len(set(vals)) > 1               # actually spread apart
+    assert overload.jittered_retry_after(None) is None
+    # tiny advertised backoffs never jitter to ~zero
+    assert overload.jittered_retry_after(0.01) == pytest.approx(0.05)
+
+
+def test_serving_emits_jittered_retry_after():
+    """The satellite's point of application: the /readyz 503 body's
+    retry_after_s follows the seeded jitter RNG, and the header is its
+    integer ceiling — shed clients no longer re-sync on a constant."""
+    srv = PredictorServer(_Pred(), max_concurrent=0,
+                          max_queue_depth=4).start()
+    try:
+        overload.seed_retry_jitter(11)
+        exp = random.Random(11)
+        code, body, hdrs = _req(srv.port, "/readyz")
+        assert code == 503 and body["reason"] == "saturated"
+        want = exp.uniform(0.75, 1.25)
+        assert body["retry_after_s"] == pytest.approx(round(want, 3))
+        assert int(hdrs["Retry-After"]) == max(1, int(np.ceil(want)))
+    finally:
+        srv.stop()
+
+
+def test_retry_policy_full_jitter_deterministic():
+    p = RetryPolicy(base_delay=0.1, max_delay=1.0, multiplier=2.0,
+                    jitter="full", rng=random.Random(3))
+    exp = random.Random(3)
+    got = []
+    gen = p.delays()
+    for _ in range(4):
+        got.append(next(gen))
+    want = [exp.uniform(0.0, c) for c in (0.1, 0.2, 0.4, 0.8)]
+    assert got == want
+    assert all(0.0 <= d <= c for d, c in zip(got, (0.1, 0.2, 0.4, 0.8)))
+    # the default policy keeps the exact exponential sequence
+    gen = RetryPolicy(base_delay=0.05).delays()
+    assert [next(gen) for _ in range(3)] == [0.05, 0.1, 0.2]
+
+
+# -- catalogue pins ----------------------------------------------------------
+
+def test_router_chaos_sites_registered():
+    for site in ("router.probe.delay", "router.probe.flap",
+                 "router.connect.fail", "router.replica.kill"):
+        assert site in chaos.POINTS, site
+
+
+def test_router_metrics_catalogued_both_directions():
+    """The PR 7 pattern for router.py: every inc/observe/set_gauge
+    literal in inference/router.py is catalogued, and every catalogued
+    router.* instrument is actually recorded by a literal call site in
+    router.py — the catalogue and the router cannot drift."""
+    from paddle_tpu.observability.metrics import METRICS
+    src = os.path.join(_ROOT, "paddle_tpu", "inference", "router.py")
+    tree = ast.parse(open(src).read())
+    seen = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and node.args \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("inc", "observe", "set_gauge",
+                                       "counter", "gauge", "histogram"):
+            arg = node.args[0]
+            if node.func.attr in ("inc", "observe", "set_gauge"):
+                assert isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str), \
+                    f"non-literal metric name at router.py:{node.lineno}"
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str):
+                assert arg.value in METRICS, arg.value
+                seen.add(arg.value)
+    router_names = {n for n in METRICS if n.startswith("router.")}
+    missing = router_names - seen
+    assert not missing, f"catalogued but never recorded: {missing}"
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+def test_router_stop_joins_threads():
+    router = ReplicaRouter([]).start()      # WITH the prober thread
+    router.stop()
+    assert router._probe_thread is None
+    assert router._thread is None
+
+
+# -- the chaos soak ----------------------------------------------------------
+
+class _GatedSource:
+    """Streaming generator for the soak: token 0 flows immediately,
+    every later token waits on the replica's gate; a killed replica's
+    streams raise once released (the backend-died-mid-stream shape)."""
+
+    concurrent_safe = False
+
+    def __init__(self, tokens=4):
+        self.tokens = tokens
+        self.gate = threading.Event()
+        self.killed = threading.Event()
+
+    def stream(self, ids, **kw):
+        src = self
+
+        def gen():
+            yield np.asarray([0])
+            for i in range(1, src.tokens):
+                assert src.gate.wait(timeout=30), "gate never opened"
+                if src.killed.is_set():
+                    raise RuntimeError("replica killed mid-stream")
+                yield np.asarray([i])
+        return gen()
+
+
+def test_chaos_soak_kill_replica_mid_stream():
+    """The acceptance scenario: 3 replicas serve a concurrent
+    streaming workload; `router.replica.kill` (rate 1, cap 1) tears
+    one replica down right after it relayed a token. Every in-flight
+    request either completes on a surviving replica or fails with a
+    typed retryable status — zero hangs — and the killed replica,
+    restarted, re-enters rotation after K clean probes (no permanent
+    blacklisting), while the router never routes to it while it is
+    out. Event-driven: token pacing is gated on events, probes are
+    explicit probe_all() calls, the only sleeps live in the bounded
+    _wait_for polls."""
+    TOKENS, CLIENTS, REENTER = 4, 6, 2
+    sources = [_GatedSource(TOKENS) for _ in range(3)]
+    _preds, servers, pairs = _mk_fleet(3, gens=sources)
+    ports = [s.port for s in servers]
+    policy, _slept = _no_sleep_policy()
+    router = ReplicaRouter(pairs, eject_after=1,
+                           reenter_probes=REENTER,
+                           retry_policy=policy)
+    kill_done = threading.Event()
+    killed_rid = {}
+
+    def kill_hook(rid):
+        i = int(rid[1:])
+        killed_rid["rid"] = rid
+        sources[i].killed.set()
+        sources[i].gate.set()       # its streams observe the kill NOW
+        servers[i].stop()           # connects/probes now fail
+        kill_done.set()
+
+    router.kill_hook = kill_hook
+    router.start(probe=False)
+
+    results = [None] * CLIENTS
+
+    def client(i):
+        body = json.dumps({"ids": [[1, 2]], "stream": True,
+                           "max_new_tokens": TOKENS}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                lines = [json.loads(l) for l in resp if l.strip()]
+            results[i] = ("stream", resp.status, lines)
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            results[i] = ("http_error", e.code,
+                          json.loads(raw) if raw else {})
+        except Exception as e:      # noqa: BLE001 — recorded for the assert below
+            results[i] = ("exception", None, repr(e))
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(CLIENTS)]
+    try:
+        with chaos.scoped(seed=42,
+                          rates={"router.replica.kill": (1.0, 1)}):
+            for t in threads:
+                t.start()
+            # the FIRST relayed token chunk anywhere fires the kill
+            assert kill_done.wait(timeout=30), "kill site never fired"
+            for s in sources:       # release every surviving stream
+                s.gate.set()
+            for t in threads:
+                t.join(timeout=30)
+            hung = [t for t in threads if t.is_alive()]
+            assert not hung, f"{len(hung)} client(s) hung"
+            assert chaos.fire_count("router.replica.kill") == 1
+        rid = killed_rid["rid"]
+
+        completed = failed_typed = 0
+        for res in results:
+            kind, status, payload = res
+            assert kind != "exception", payload      # no torn sockets
+            if kind == "http_error":
+                # routed nowhere mid-churn: must be typed + retryable
+                assert status in (429, 503), res
+                assert payload.get("retryable") is True \
+                    or "error" in payload, res
+                failed_typed += 1
+                continue
+            assert status == 200
+            last = payload[-1]
+            if last.get("done"):
+                # completed: every token, in order
+                toks = [l["tokens"][0] for l in payload
+                        if "tokens" in l]
+                assert toks == list(range(TOKENS)), payload
+                completed += 1
+            else:
+                # mid-stream death: the router's typed retryable error
+                assert last.get("retryable") is True, payload
+                assert last.get("reason") == "replica_failed", payload
+                assert last.get("replica") == rid
+                failed_typed += 1
+        assert completed + failed_typed == CLIENTS
+        assert completed >= 1           # survivors carried real work
+        assert failed_typed >= 1        # the killed stream was seen
+
+        # convergence: one probe pass ejects the dead replica
+        # (eject_after=1) — if a forward failure already ejected it
+        # mid-soak, the probe simply confirms it stays out
+        router.probe_all()
+        assert not router.replica(rid).in_rotation
+        # no routing to the dead replica: every new request lands on a
+        # survivor
+        for _ in range(4):
+            code, _b, hdrs = _req(router.port, "/predict", _BODY)
+            assert code == 200 and hdrs["X-Routed-To"] != rid
+
+        # restart the killed replica on its old port; flap damping:
+        # K-1 clean probes are not enough...
+        i = int(rid[1:])
+        servers[i] = PredictorServer(_Pred(), model_name=rid,
+                                     generator=_GatedSource(TOKENS),
+                                     port=ports[i]).start()
+        # the breaker may have opened during the soak (forward
+        # failures); warp its cooldown so probes alone decide re-entry
+        br = router.replica(rid).breaker
+        with br._lock:
+            br._changed_at -= 1000.0
+        for k in range(REENTER - 1):
+            router.probe_all()
+            assert not router.replica(rid).in_rotation, \
+                f"re-entered after only {k + 1} probes"
+        router.probe_all()              # K-th clean probe: back in
+        assert router.replica(rid).in_rotation
+        assert router.metrics.counter("router.reentries").value() >= 1
+        # ...and it genuinely serves again (half-open probe recloses
+        # the breaker on success)
+        others = {r for r, _u in pairs} - {rid}
+        picked = router._pick(others, None)
+        assert picked is not None and picked.rid == rid
+        code, _b, hdrs = _req(router.port, "/predict", _BODY)
+        assert code == 200
+    finally:
+        for s in sources:
+            s.gate.set()
+        router.stop()
+        for s in servers:
+            s.stop()

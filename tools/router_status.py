@@ -1,0 +1,111 @@
+"""Render a ReplicaRouter's live fleet view as a terminal table.
+
+    python -m tools.router_status http://127.0.0.1:8900 [--json]
+
+Fetches `GET /debug/replicas` and `GET /stats` from a running
+`paddle_tpu.inference.router.ReplicaRouter` and prints the per-replica
+rotation state, reason, probe counters, load numbers, and breaker
+state — the operator's one-glance answer to "why is traffic not
+reaching replica 3". `--json` dumps the raw merged document instead
+(for scripts).
+
+Stdlib-only (no jax, no paddle_tpu import): this runs on any box that
+can reach the router.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+__all__ = ["fetch", "render", "main"]
+
+
+def fetch(base_url, timeout=5.0) -> dict:
+    """{"replicas": [...], "summary": {...}, "stats": {...}} from a
+    live router. A failed /stats never sinks the replica table."""
+    base = base_url.rstrip("/")
+    if not base.startswith("http"):
+        base = "http://" + base
+    with urllib.request.urlopen(base + "/debug/replicas",
+                                timeout=timeout) as resp:
+        doc = json.loads(resp.read())
+    try:
+        with urllib.request.urlopen(base + "/stats",
+                                    timeout=timeout) as resp:
+            doc["stats"] = json.loads(resp.read())
+    except Exception as e:      # noqa: BLE001 — stats are garnish
+        doc["stats"] = {"error": repr(e)}
+    return doc
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return "yes" if v else "no"
+    if isinstance(v, float):
+        return f"{v:.3g}"
+    return str(v)
+
+
+def render(doc) -> str:
+    """The /debug/replicas (+stats) document as an aligned table +
+    summary lines. Tolerates missing keys: a half-broken router still
+    renders what it returned."""
+    rows = doc.get("replicas") or []
+    cols = [("id", "id"), ("rot", "in_rotation"),
+            ("depri", "deprioritized"), ("reason", "reason"),
+            ("ok", "consecutive_ok"), ("fail", "consecutive_fail"),
+            ("load", "load_score"), ("inflight", "replica_in_flight"),
+            ("queue", "replica_queue_depth"),
+            ("breaker", None), ("eject", "ejections"),
+            ("served", "served"), ("probe_age", "last_probe_age_s")]
+    table = [[h for h, _k in cols]]
+    for r in rows:
+        cells = []
+        for _h, k in cols:
+            if k is None:
+                cells.append(_fmt((r.get("breaker") or {}).get("state")))
+            else:
+                cells.append(_fmt(r.get(k)))
+        table.append(cells)
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(cols))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+             for row in table]
+    s = doc.get("summary") or {}
+    lines.append("")
+    lines.append(
+        f"replicas: {_fmt(s.get('total'))} total, "
+        f"{_fmt(s.get('in_rotation'))} in rotation, "
+        f"{_fmt(s.get('ejected'))} ejected, "
+        f"{_fmt(s.get('deprioritized'))} deprioritized; "
+        f"sessions pinned: {_fmt(s.get('sessions'))}")
+    stats = doc.get("stats")
+    if isinstance(stats, dict) and "error" not in stats:
+        lines.append(f"requests: {stats.get('requests') or {}}  "
+                     f"retries: {stats.get('retries') or {}}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if len(argv) != 1:
+        print(__doc__.strip().splitlines()[2].strip(), file=sys.stderr)
+        return 2
+    try:
+        doc = fetch(argv[0])
+    except Exception as e:      # noqa: BLE001 — CLI boundary: report, don't traceback
+        print(f"error: cannot reach router at {argv[0]}: {e!r}",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(doc, indent=1, sort_keys=True) if as_json
+          else render(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
