@@ -113,6 +113,79 @@ def _decode_bench(on_tpu):
     return rows
 
 
+def _prefix_bench():
+    """Prefix-cache payoff (ISSUE 11): a shared-system-prompt serving
+    workload — K requests carrying one common multi-page prefix with
+    distinct tails — run twice through the SAME engine: cold (the
+    `prefix.cache.bypass` chaos site forces every lookup to miss, so
+    every request prefills its whole prompt) and warm (cache on: each
+    request prefills only its uncached tail). Reports prompt tokens
+    admitted per second of prefill wall time for both passes, the
+    warm-pass hit rate, and pages shared — the claim is warm >= 2x
+    cold, because prefill work drops from O(prompt) to O(tail).
+    Compiles are excluded by running both modes once before timing."""
+    import time
+
+    import paddle_tpu
+    from paddle_tpu.distributed import chaos
+    from paddle_tpu.inference.paged import PagedKVEngine
+    from paddle_tpu.models.llama import LlamaForCausalLM, \
+        tiny_llama_config
+
+    paddle_tpu.seed(0)
+    cfg = tiny_llama_config(num_hidden_layers=2, vocab_size=128,
+                            hidden_size=64, intermediate_size=128,
+                            num_attention_heads=4,
+                            num_key_value_heads=2)
+    model = LlamaForCausalLM(cfg)
+    page_size, prefix_pages, k_req = 16, 2, 6
+    rng = np.random.RandomState(0)
+    prefix = list(rng.randint(1, cfg.vocab_size,
+                              prefix_pages * page_size))
+    prompts = [prefix + list(rng.randint(1, cfg.vocab_size, 8))
+               for _ in range(k_req)]
+    eng = PagedKVEngine(model, max_slots=4, page_size=page_size,
+                        num_pages=128, steps_per_tick=2,
+                        prefix_cache_pages=32)
+    tokens = sum(len(p) for p in prompts)
+
+    def run_pass(bypass):
+        s0 = dict(eng.stats)
+        if bypass:
+            with chaos.scoped(rates={"prefix.cache.bypass": 1.0}):
+                t0 = time.perf_counter()
+                eng.generate(prompts, max_new_tokens=2)
+                dt = time.perf_counter() - t0
+        else:
+            t0 = time.perf_counter()
+            eng.generate(prompts, max_new_tokens=2)
+            dt = time.perf_counter() - t0
+        return dt, {k: eng.stats[k] - s0[k]
+                    for k in ("prefill_s", "prefix_hits",
+                              "prefix_misses", "prefix_pages_shared")}
+
+    run_pass(True)      # warmup: compiles the full-prompt bucket,
+    run_pass(False)     # seeds the cache + compiles the tail bucket
+    _dt, cold = run_pass(True)
+    _dt, warm = run_pass(False)
+    cold_tps = tokens / max(cold["prefill_s"], 1e-9)
+    warm_tps = tokens / max(warm["prefill_s"], 1e-9)
+    denom = warm["prefix_hits"] + warm["prefix_misses"]
+    return {
+        "requests": k_req,
+        "page_size": page_size,
+        "prefix_tokens": prefix_pages * page_size,
+        "prompt_tokens": tokens,
+        "cold_prefill_tokens_per_sec": round(cold_tps, 2),
+        "warm_prefill_tokens_per_sec": round(warm_tps, 2),
+        "warm_vs_cold": round(warm_tps / max(cold_tps, 1e-9), 3),
+        "hit_rate": round(warm["prefix_hits"] / denom, 4) if denom
+        else 0.0,
+        "pages_shared": warm["prefix_pages_shared"],
+        "cached_pages": len(eng.prefix_cache),
+    }
+
+
 def _fleet_bench(trainer, batch, steps):
     """Heartbeat-publisher overhead (ISSUE 9): the SAME compiled step
     run with observability on, first without the fleet plane, then
@@ -340,6 +413,12 @@ def main():
     except Exception as e:           # noqa: BLE001 — never sink the
         router = {"error": f"{type(e).__name__}: {e}"}  # train metric
 
+    # prefix-cache cold-vs-warm prefill payoff (ISSUE 11)
+    try:
+        prefix = _prefix_bench()
+    except Exception as e:           # noqa: BLE001 — never sink the
+        prefix = {"error": f"{type(e).__name__}: {e}"}  # train metric
+
     print(json.dumps({
         "metric": "llama1b_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
@@ -350,7 +429,8 @@ def main():
                   "loss": round(float(loss), 4),
                   "device": getattr(dev, "device_kind", str(dev)),
                   "batch": batch, "seq": seq, "steps": steps,
-                  "decode": decode, "fleet": fleet, "router": router},
+                  "decode": decode, "fleet": fleet, "router": router,
+                  "prefix": prefix},
     }))
 
 

@@ -90,6 +90,10 @@ POINTS = {
     "engine.tick.delay": "slow paged-engine scheduler tick (stretches "
                          "request TTFT/ITL — the request-tracing "
                          "tests' pacing lever)",
+    "prefix.cache.bypass": "treat a paged-engine prefix-cache hit as "
+                           "a miss at admission (the hit-rate lever "
+                           "for deterministic cold-vs-warm tests and "
+                           "the prefix bench)",
     "serving.batch.delay": "slow DynamicBatcher backend run",
     "serving.batch.fail": "failed DynamicBatcher batch run (error "
                           "must fan out to every waiter)",
@@ -118,6 +122,11 @@ POINTS = {
                            "forwarded to, right after a relayed "
                            "stream chunk (the kill-a-replica fleet "
                            "soak's lever)",
+    "router.prefix.scramble": "perturb the router's page-aligned "
+                              "prefix routing hash (repeated "
+                              "prefixes stop landing on their pinned "
+                              "replica — the prefix-routing tests' "
+                              "lever)",
     "trainer.grad": "non-finite (NaN) gradient poisoning in the "
                     "compiled train step",
     "io.prefetch.delay": "slow host input pipeline (delay in the "

@@ -51,6 +51,16 @@ dequantized inside the kernel's K-loop (`kv_dtype="int8"` — about
 half the KV HBM per slot vs bf16). The jnp gather/softmax path
 remains the fallback for prefill, speculative verify, and
 kernel-incompatible geometries.
+
+Prefix caching (ISSUE 11): full pages of prompt tokens are immutable
+once written, so `prefix_cache_pages=N` turns repeated prefixes
+(shared system prompts) into block-table rows instead of recomputed
+prefill — pages are keyed by a rolling hash chain
+(inference/prefix.py), REFCOUNTED across the slots that share them,
+and a warm `submit()` prefills only the uncached tail (O(tail), not
+O(prompt)). Cold entries evict LRU under the page budget, and the
+admission headroom counts reclaimable cached pages, so the cache can
+never starve decode allocation.
 """
 from __future__ import annotations
 
@@ -72,6 +82,7 @@ from paddle_tpu.observability import requests as obs_requests
 from paddle_tpu.inference.overload import (DeadlineExceeded,
                                            EngineOverloaded,
                                            OverloadError)
+from paddle_tpu.inference.prefix import PrefixCache, chain_keys
 
 __all__ = ["PagedState", "paged_attention_update", "decode_kernel_scope",
            "PagedKVEngine"]
@@ -346,6 +357,7 @@ class _Request:
         # the engine alive through abandoned request handles
         self._engine = weakref.ref(engine) if engine is not None else None
         self.sample_index = 0       # engine-local; set by submit()
+        self.prefix_keys = []       # full-page hash chain; set by submit()
         self.obs = None             # request-tracing context (or None)
         self.tokens: list[int] = []          # accepted generated tokens
         self.queue: queue.Queue = queue.Queue()
@@ -431,14 +443,16 @@ class _Request:
 
 
 class _Slot:
-    __slots__ = ("req", "lens", "tok", "pages", "emitted")
+    __slots__ = ("req", "lens", "tok", "pages", "emitted", "shared")
 
     def __init__(self, req, lens, tok):
         self.req = req
         self.lens = int(lens)       # tokens committed to the paged cache
         self.tok = int(tok)         # next decode input (last emitted)
-        self.pages: list[int] = []  # physical pages allocated (in order)
+        self.pages: list[int] = []  # physical pages in block-table order
         self.emitted = 0            # generated tokens accepted so far
+        self.shared = 0             # leading prefix-cache pages (not
+        #                             drawn from the free list here)
 
 
 class PagedKVEngine:
@@ -472,13 +486,22 @@ class PagedKVEngine:
         quantized at scatter time and dequantized inside the attend —
         about half the KV HBM per slot vs bf16 (kv_bytes_per_slot()
         reports the exact figure from the real buffer dtypes).
+    prefix_cache_pages: page budget for the prompt prefix cache
+        (module doc; 0 = disabled, the default). Full prompt pages are
+        keyed by the inference/prefix.py hash chain and shared across
+        slots by refcount: a warm submit points its leading
+        block-table entries at the cached pages and prefills only the
+        uncached tail. Cold entries evict LRU at the budget, and
+        on-demand when decode allocation needs the page back — a page
+        is recycled (int8 scale rows zeroed) only when its refcount
+        hits zero.
     """
 
     def __init__(self, model, *, max_slots=4, page_size=16, num_pages=64,
                  max_pages_per_slot=None, steps_per_tick=4, seed=0,
                  prefill_chunk=None, draft_model=None, spec_tokens=4,
                  dtype=None, max_pending=None, kernel=None,
-                 kv_dtype=None):
+                 kv_dtype=None, prefix_cache_pages=0):
         cfg = model.config
         self.model = model
         self.max_slots = int(max_slots)
@@ -581,6 +604,21 @@ class PagedKVEngine:
         # pages promised to admitted slots but not yet popped from the
         # free list; admission headroom = len(_free) - _reserved_unalloc
         self._reserved_unalloc = 0
+        # prompt prefix cache (class doc): key -> page map plus the
+        # refcount ledger for EVERY allocated page (cache disabled =
+        # every page has exactly one ref, its slot)
+        if int(prefix_cache_pages) < 0:
+            raise ValueError(f"prefix_cache_pages must be >= 0, got "
+                             f"{prefix_cache_pages}")
+        self.prefix_cache = (PrefixCache(prefix_cache_pages)
+                             if int(prefix_cache_pages) else None)
+        self._page_refs: dict[int, int] = {}
+        # incremental twin of "cached pages only the cache still
+        # holds": _ref_page/_unref_page/_prefix_insert/_evict keep it
+        # current at the ref transitions, so admission headroom is
+        # O(1) instead of O(cache) per pending request
+        self._cached_pages: set[int] = set()
+        self._reclaimable = 0
         self._slots: list[_Slot | None] = [None] * self.max_slots
         self._bt = np.zeros((self.max_slots, self.max_pages_per_slot),
                             np.int32)
@@ -599,7 +637,10 @@ class PagedKVEngine:
         self.stats = {"ticks": 0, "prefills": 0, "tokens_out": 0,
                       "admitted": 0, "finished": 0, "cancelled": 0,
                       "expired": 0, "overloaded": 0,
-                      "prefill_s": 0.0, "tick_s": 0.0}
+                      "prefill_s": 0.0, "tick_s": 0.0,
+                      "prefix_hits": 0, "prefix_misses": 0,
+                      "prefix_hit_tokens": 0, "prefix_pages_shared": 0,
+                      "prefix_evictions": 0}
         # serving integration: PredictorServer must not serialize
         # concurrent streams through its executable lock — the engine's
         # ticker thread is the only chip user
@@ -637,12 +678,38 @@ class PagedKVEngine:
         registry.set_gauge("engine.overloaded", s["overloaded"])
         registry.set_gauge("engine.pending", len(self._pending))
 
+    def prefix_stats(self):
+        """The prefix-cache /stats block (PredictorServer embeds it so
+        the router can probe per-replica KV locality); None when the
+        cache is disabled."""
+        if self.prefix_cache is None:
+            return None
+        s = self.stats
+        h, m = s["prefix_hits"], s["prefix_misses"]
+        return {"enabled": True,
+                "hits": h, "misses": m,
+                "hit_rate": round(h / (h + m), 4) if (h + m) else 0.0,
+                "hit_tokens": s["prefix_hit_tokens"],
+                "pages_shared": s["prefix_pages_shared"],
+                "evictions": s["prefix_evictions"],
+                "cached_pages": len(self.prefix_cache),
+                "page_budget": self.prefix_cache.page_budget}
+
     # -- submission ------------------------------------------------------
+    def _reclaimable_pages(self):
+        """Cached pages only the cache still holds — evictable on
+        demand, so they count as admission headroom. An incrementally
+        maintained counter (constructor note): exact on the scheduler
+        thread (the only mutator), advisory from submit() callers."""
+        return self._reclaimable
+
     def admission_headroom(self):
-        """Pages not promised to any admitted slot (free minus
-        outstanding reservations) — the budget new admissions draw
-        from. Advisory (the ticker mutates concurrently)."""
-        return len(self._free) - self._reserved_unalloc
+        """Pages not promised to any admitted slot (free plus
+        reclaimable cached pages, minus outstanding reservations) —
+        the budget new admissions draw from. Advisory (the ticker
+        mutates concurrently)."""
+        return (len(self._free) + self._reclaimable_pages()
+                - self._reserved_unalloc)
 
     def submit(self, ids, max_new_tokens=32, *, eos_token_id=None,
                do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
@@ -664,6 +731,14 @@ class PagedKVEngine:
         req = _Request(ids, max_new_tokens, eos_token_id, do_sample,
                        temperature, top_k, top_p, pages,
                        deadline=deadline, engine=self)
+        # hash the prompt's full pages NOW (caller thread, cheap); the
+        # cache LOOKUP happens at admission on the scheduler thread.
+        # The last full page is keyed too (it is immutable — decode
+        # writes land in the next page); sharing depth is capped at
+        # match time so a fully-cached prompt still prefills its last
+        # page (the first generated token needs those logits).
+        req.prefix_keys = (chain_keys(ids, self.page_size)
+                           if self.prefix_cache is not None else [])
         if observability.ENABLED:
             # adopt the serving layer's request context (propagated by
             # contextvar into the stream-producer thread) or start a
@@ -735,16 +810,173 @@ class PagedKVEngine:
     def _bucket(self, n):
         return max(8, 1 << (n - 1).bit_length())
 
+    def _ref_page(self, page):
+        n = self._page_refs.get(page, 0)
+        self._page_refs[page] = n + 1
+        if n == 1 and page in self._cached_pages:
+            # a cache-only page just got a slot ref: not evictable-to-
+            # free anymore
+            self._reclaimable -= 1
+
+    def _unref_page(self, page):
+        """Drop one reference; True when the page just became free
+        (the caller recycles it). A page is NEVER freed while a live
+        slot or the cache still references it."""
+        n = self._page_refs.get(page, 1) - 1
+        if n <= 0:
+            self._page_refs.pop(page, None)
+            return True
+        self._page_refs[page] = n
+        if n == 1 and page in self._cached_pages:
+            # back to cache-only: evicting it would free a page
+            self._reclaimable += 1
+        return False
+
+    def _recycle_pages(self, pages):
+        """Return zero-ref pages to the free list. int8 KV: reset the
+        freed pages' quant scales first — scales only ever GROW at
+        scatter time (scatter-max), so without this a recycled page
+        would quantize its next tenant's k/v with the largest
+        magnitude any previous tenant ever wrote. Shared prefix pages
+        reach here only when the LAST referent (slot or cache) lets
+        go, which is what keeps their scales frozen while shared."""
+        if not pages:
+            return
+        if self._cache_arity == 4:
+            idx = jnp.asarray(pages, jnp.int32)
+            self.pools = [(kp, vp, ks.at[idx].set(0.0),
+                           vs.at[idx].set(0.0))
+                          for kp, vp, ks, vs in self.pools]
+            if self.draft_pools is not None:
+                self.draft_pools = [(kp, vp, ks.at[idx].set(0.0),
+                                     vs.at[idx].set(0.0))
+                                    for kp, vp, ks, vs in
+                                    self.draft_pools]
+        self._free.extend(reversed(pages))
+
+    def _evict_prefix_entries(self, budget_only=True):
+        """Shrink the prefix cache: to its page budget
+        (`budget_only=True`, LRU regardless of sharing — a still-
+        referenced page just leaves the key space and is freed later
+        by its slots' refcounts), or by ONE reclaimable entry
+        (`budget_only=False`, the on-demand lever when the free list
+        runs dry — only an entry whose page actually becomes free
+        helps there). Returns pages freed."""
+        cache = self.prefix_cache
+        freed = []
+        if cache is None:
+            return freed
+        if budget_only:
+            while cache.over_budget():
+                key_page = cache.pop_lru()
+                if key_page is None:
+                    break
+                self._note_evicted(key_page[1], freed)
+        else:
+            key_page = cache.pop_lru_where(
+                lambda p: self._page_refs.get(p, 0) == 1)
+            if key_page is not None:
+                self._note_evicted(key_page[1], freed)
+        self._recycle_pages(freed)
+        return freed
+
+    def _note_evicted(self, page, freed):
+        """Shared eviction epilogue: leave the cached-page ledger,
+        drop the cache's ref, collect the page if that freed it."""
+        self._cached_pages.discard(page)
+        if self._page_refs.get(page, 0) == 1:
+            self._reclaimable -= 1      # was cache-only: leaving the
+            #                             cache ends its reclaimability
+        self.stats["prefix_evictions"] += 1
+        if observability.ENABLED:
+            observability.inc("inference.prefix.evictions")
+        if self._unref_page(page):
+            freed.append(page)
+
     def _alloc_pages(self, slot_idx, need_total):
         """Grow slot's allocation to `need_total` pages (lazy; the
-        reservation made at admission guarantees the free list covers
-        it)."""
+        reservation made at admission guarantees the free list — plus
+        reclaimable prefix-cache pages, evicted here on demand —
+        covers it)."""
         slot = self._slots[slot_idx]
         while len(slot.pages) < need_total:
+            if not self._free:
+                # admission reserved against free + reclaimable, so a
+                # dry free list means a cold cache entry owes us a page
+                if not self._evict_prefix_entries(budget_only=False):
+                    raise RuntimeError(
+                        "page pool exhausted despite reservation: "
+                        f"free=0 reserved={self._reserved_unalloc} "
+                        f"cached={0 if self.prefix_cache is None else len(self.prefix_cache)}")
             page = self._free.pop()
+            self._ref_page(page)
             self._reserved_unalloc -= 1
             self._bt[slot_idx, len(slot.pages)] = page
             slot.pages.append(page)
+
+    def _prefix_lookup(self, req):
+        """Longest cached run of the prompt's full pages, capped at
+        `(prompt - 1) // page_size` so at least the prompt's final
+        token is always prefilled (its logits pick the first generated
+        token) — the last partial page is never shared by
+        construction (chain_keys only keys full pages). The chaos site
+        `prefix.cache.bypass` turns a hit into a miss — the hit-rate
+        lever for deterministic tests."""
+        cache = self.prefix_cache
+        if cache is None or not req.prefix_keys:
+            return []
+        shareable = (int(req.prompt.size) - 1) // self.page_size
+        if shareable <= 0:
+            return []
+        from paddle_tpu.distributed import chaos
+        if chaos.ENABLED and chaos.should_fire("prefix.cache.bypass"):
+            return []
+        return cache.match(req.prefix_keys[:shareable])
+
+    def _note_prefix_outcome(self, req, h):
+        """Hit/miss accounting at the admission decision (requeued
+        requests retry their lookup next pass and must not double-
+        count). Prompts too short to ever share (< page_size + 1
+        tokens) count neither way."""
+        if self.prefix_cache is None:
+            return
+        ps = self.page_size
+        if h > 0:
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_hit_tokens"] += h * ps
+            self.stats["prefix_pages_shared"] += h
+            if observability.ENABLED:
+                observability.inc("inference.prefix.hits")
+                observability.inc("inference.prefix.hit_tokens", h * ps)
+                observability.inc("inference.prefix.pages_shared", h)
+        elif (int(req.prompt.size) - 1) // ps > 0:
+            self.stats["prefix_misses"] += 1
+            if observability.ENABLED:
+                observability.inc("inference.prefix.misses")
+
+    def _prefix_insert(self, slot_idx, req):
+        """Register a freshly prefilled slot's full prompt pages in the
+        prefix cache (scheduler thread, right after the prefill that
+        wrote them). Existing keys win — a duplicate prompt admitted
+        in the same storm keeps the canonical copy; its own pages
+        retire with its slot. Then enforce the LRU page budget."""
+        cache = self.prefix_cache
+        if cache is None:
+            return
+        slot = self._slots[slot_idx]
+        if slot is None:        # retired within its own prefill tick
+            return
+        n_full = min(len(req.prefix_keys),
+                     int(req.prompt.size) // self.page_size,
+                     len(slot.pages))
+        for j in range(n_full):
+            if cache.insert(req.prefix_keys[j], slot.pages[j]):
+                # ref BEFORE joining the cached-page ledger: the slot
+                # still holds the page (ref >= 2), so it enters the
+                # cache non-reclaimable and flips when the slot retires
+                self._ref_page(slot.pages[j])
+                self._cached_pages.add(slot.pages[j])
+        self._evict_prefix_entries(budget_only=True)
 
     def _admit(self):
         with self._lock:
@@ -777,32 +1009,50 @@ class PagedKVEngine:
                 continue
             idx = next((i for i, s in enumerate(self._slots)
                         if s is None), None)
-            if idx is None or req.pages_needed > \
-                    len(self._free) - self._reserved_unalloc:
+            shared_pages = (self._prefix_lookup(req)
+                            if idx is not None else [])
+            # refs BEFORE the headroom check: matched pages stop being
+            # reclaimable, so the check below sees the post-hit budget
+            for p in shared_pages:
+                self._ref_page(p)
+            h = len(shared_pages)
+            if idx is None or \
+                    req.pages_needed - h > self.admission_headroom():
+                for p in shared_pages:
+                    self._unref_page(p)     # cache ref remains; never frees
                 requeue.append(req)
                 continue
-            self._reserved_unalloc += req.pages_needed
+            self._note_prefix_outcome(req, h)
+            # only the uncached tail draws fresh pages from the pool
+            self._reserved_unalloc += req.pages_needed - h
             admitted.append((idx, req))
             # reserve the slot immediately so the next pending request
             # can't claim it while we batch this tick's prefills
-            self._slots[idx] = _Slot(req, lens=0, tok=0)
+            slot = _Slot(req, lens=0, tok=0)
+            slot.shared = h
+            self._slots[idx] = slot
+            for j, p in enumerate(shared_pages):
+                self._bt[idx, j] = p
+                slot.pages.append(p)
             self._alloc_pages(idx, -(-req.prompt.size // self.page_size))
             self.stats["admitted"] += 1
             if req.obs is not None:
                 # rid pairs this row's scheduled with ITS queued event
                 # (per-row queue_wait clock in a shared context)
                 req.obs.record("scheduled", rid=req.rid, slot=idx)
-        # batch same-bucket prefills into ONE program call (an admission
-        # storm used to pay one ~full prefill latency per request)
+        # batch same-TAIL-bucket prefills into ONE program call (an
+        # admission storm used to pay one ~full prefill latency per
+        # request); warm requests bucket by their UNCACHED tail — that
+        # is the whole prefill they run
         groups = {}
         long_grp = []
         for idx, req in admitted:
-            if self.prefill_chunk and \
-                    req.prompt.size > self.prefill_chunk:
+            tail = req.prompt.size \
+                - self._slots[idx].shared * self.page_size
+            if self.prefill_chunk and tail > self.prefill_chunk:
                 long_grp.append((idx, req))
                 continue
-            groups.setdefault(self._bucket(req.prompt.size),
-                              []).append((idx, req))
+            groups.setdefault(self._bucket(tail), []).append((idx, req))
         if long_grp:
             self._prefill_chunked_group(long_grp)
         for ppad, grp in groups.items():
@@ -853,6 +1103,9 @@ class PagedKVEngine:
         bw = 1 if len(grp) == 1 else self.max_slots
         fn = self._prefill_chunk_fn(chunk, bw)
         done = np.zeros(bw, np.int32)              # consumed per row
+        for r, (idx, _req) in enumerate(grp):
+            # warm rows (prefix-cache hit) start past the shared pages
+            done[r] = self._slots[idx].shared * self.page_size
         plens = [int(req.prompt.size) for _, req in grp]
         final_logits = [None] * len(grp)
         while any(done[r] < plens[r] for r in range(len(grp))):
@@ -886,6 +1139,7 @@ class PagedKVEngine:
             slot = self._slots[idx]
             slot.lens = plens[r]
             slot.tok = self._first_token(final_logits[r], req)
+            self._prefix_insert(idx, req)
             self._accept(idx, [slot.tok])
 
     def _prefill_chunk_fn(self, chunk, bw=1):
@@ -928,21 +1182,31 @@ class PagedKVEngine:
         bw = 1 if len(grp) == 1 else self.max_slots
         fn = self._prefill_fn(ppad, bw)
         ids = np.zeros((bw, ppad), np.int32)
+        lens = np.zeros(bw, np.int32)
         nv = np.zeros(bw, np.int32)
         bt = np.zeros((bw, self.max_pages_per_slot), np.int32)
         for row, (idx, req) in enumerate(grp):
-            pn = int(req.prompt.size)
-            ids[row, :pn] = req.prompt
-            nv[row] = pn
+            # warm slots (prefix-cache hit) prefill ONLY the uncached
+            # tail: lens starts past the shared pages, and the tail
+            # attends over their KV through the block table
+            off = self._slots[idx].shared * self.page_size
+            tail = req.prompt[off:]
+            ids[row, :tail.size] = tail
+            lens[row] = off
+            nv[row] = tail.size
             bt[row] = self._bt[idx]
         last_logits, flat = fn(
-            jnp.asarray(ids), jnp.asarray(nv), jnp.asarray(bt),
-            [a for kv in self.pools for a in kv])
+            jnp.asarray(ids), jnp.asarray(lens), jnp.asarray(nv),
+            jnp.asarray(bt), [a for kv in self.pools for a in kv])
         self.pools = self._unflat_pools(flat)
         if self.draft_model is not None:
+            # the draft's pools share the same block tables, so shared
+            # pages already hold the PREFIX's draft KV too (same
+            # tokens, written when the entry was cached) — the draft
+            # prefill also runs only the tail
             dfn = self._draft_prefill_fn(ppad, bw)
-            dflat = dfn(jnp.asarray(ids), jnp.asarray(nv),
-                        jnp.asarray(bt),
+            dflat = dfn(jnp.asarray(ids), jnp.asarray(lens),
+                        jnp.asarray(nv), jnp.asarray(bt),
                         [a for kv in self.draft_pools for a in kv])
             self.draft_pools = self._unflat_pools(dflat)
         logits_np = np.asarray(last_logits)              # (bw, vocab)
@@ -955,6 +1219,10 @@ class PagedKVEngine:
             slot = self._slots[idx]
             slot.lens = int(req.prompt.size)
             slot.tok = self._first_token(logits_np[row], req)
+            # register the prompt's full pages BEFORE accept (a
+            # max_new_tokens=1 request retires inside _accept, freeing
+            # its pages — too late to share them)
+            self._prefix_insert(idx, req)
             self._accept(idx, [slot.tok])
 
     def _accept(self, slot_idx, toks):
@@ -987,25 +1255,16 @@ class PagedKVEngine:
 
     def _retire(self, slot_idx, reason=None):
         slot = self._slots[slot_idx]
-        if self._cache_arity == 4 and slot.pages:
-            # int8 KV: reset the freed pages' quant scales. Scales only
-            # ever GROW at scatter time (scatter-max), so without this a
-            # recycled page would quantize its next tenant's k/v with
-            # the largest magnitude any previous tenant ever wrote —
-            # precision would ratchet away over server lifetime. (Stale
-            # page CONTENT needs no reset: a new tenant overwrites every
-            # position it can attend to.)
-            idx = jnp.asarray(slot.pages, jnp.int32)
-            self.pools = [(kp, vp, ks.at[idx].set(0.0),
-                           vs.at[idx].set(0.0))
-                          for kp, vp, ks, vs in self.pools]
-            if self.draft_pools is not None:
-                self.draft_pools = [(kp, vp, ks.at[idx].set(0.0),
-                                     vs.at[idx].set(0.0))
-                                    for kp, vp, ks, vs in
-                                    self.draft_pools]
-        self._free.extend(reversed(slot.pages))
+        # refcounted release: a page returns to the free list (and, for
+        # int8 KV, has its quant scale rows zeroed — _recycle_pages)
+        # only when its LAST referent lets go. Shared prefix pages stay
+        # allocated, scales frozen, while other slots or the prefix
+        # cache still hold them.
+        freeable = [p for p in slot.pages if self._unref_page(p)]
+        self._recycle_pages(freeable)
         # release the unallocated remainder of this slot's reservation
+        # (shared pages were never reserved NOR allocated from the free
+        # list, so pages_needed - len(pages) is the remainder either way)
         self._reserved_unalloc -= slot.req.pages_needed - len(slot.pages)
         self._bt[slot_idx, :] = 0
         self._slots[slot_idx] = None
@@ -1352,16 +1611,20 @@ class PagedKVEngine:
         return wrapped
 
     def _prefill_fn(self, ppad, bw=1):
+        """Bucketed prefill program. `lens` is the per-row start
+        position — 0 for cold prompts, `shared * page_size` for warm
+        ones (prefix-cache hit: only the tail rides `ids`, attending
+        over the shared pages through the block table) — traced data,
+        so cold and warm share one compile per (ppad, bw)."""
         key = ("prefill", ppad, bw)
         if key in self._programs:
             return self._programs[key]
         model = self.model
 
-        def run(ids, n_valid, bt_rows, pool_flat):
-            state = PagedState(bt_rows, jnp.zeros((bw,), jnp.int32),
-                               n_valid)
-            pos = jnp.broadcast_to(
-                jnp.arange(ppad, dtype=jnp.int32)[None, :], (bw, ppad))
+        def run(ids, lens, n_valid, bt_rows, pool_flat):
+            state = PagedState(bt_rows, lens, n_valid)
+            pos = lens[:, None] + jnp.arange(ppad,
+                                             dtype=jnp.int32)[None, :]
             logits, new_caches = model(
                 Tensor(ids), caches=self._layer_caches(pool_flat),
                 position_ids=Tensor(pos), cache_index=state)
@@ -1372,7 +1635,7 @@ class PagedKVEngine:
             return last, [_val(a) for kv in new_caches for a in kv]
 
         import jax as _jax
-        donate = () if _jax.default_backend() == "cpu" else (3,)
+        donate = () if _jax.default_backend() == "cpu" else (4,)
         fn = jax.jit(self._scoped(run), donate_argnums=donate)
         self._programs[key] = fn
         return fn
@@ -1383,18 +1646,17 @@ class PagedKVEngine:
             return self._programs[key]
         model = self.draft_model
 
-        def run(ids, n_valid, bt_rows, pool_flat):
-            state = PagedState(bt_rows, jnp.zeros((bw,), jnp.int32),
-                               n_valid)
-            pos = jnp.broadcast_to(
-                jnp.arange(ppad, dtype=jnp.int32)[None, :], (bw, ppad))
+        def run(ids, lens, n_valid, bt_rows, pool_flat):
+            state = PagedState(bt_rows, lens, n_valid)
+            pos = lens[:, None] + jnp.arange(ppad,
+                                             dtype=jnp.int32)[None, :]
             _, new_caches = model(
                 Tensor(ids), caches=self._layer_caches(pool_flat),
                 position_ids=Tensor(pos), cache_index=state)
             return [_val(a) for kv in new_caches for a in kv]
 
         import jax as _jax
-        donate = () if _jax.default_backend() == "cpu" else (3,)
+        donate = () if _jax.default_backend() == "cpu" else (4,)
         fn = jax.jit(self._scoped(run), donate_argnums=donate)
         self._programs[key] = fn
         return fn

@@ -45,6 +45,22 @@ exports:
                        pages. A pinned replica leaving rotation
                        re-pins the session to a healthy one
                        (`router.affinity.rebinds`).
+    prefix routing     with `prefix_page_size=N` (match the engines'
+                       `page_size`), /generate prompts are hashed by
+                       the SAME page-aligned chain the engines' prefix
+                       cache uses (inference/prefix.py) and a bounded
+                       chain-key -> replica LRU steers repeated
+                       prefixes to the replica that already holds
+                       their KV pages (probing keys deepest-first =
+                       longest-prefix match). Same re-pin-on-rotation-
+                       exit semantics as session affinity: a healthy
+                       pinned replica that is merely excluded or
+                       saturated for THIS request is routed around
+                       without moving the pin; pins whose replicas all
+                       left rotation re-bind to the least-loaded pick
+                       (`router.prefix.rebinds`). Session affinity
+                       wins over prefix routing (an explicit client
+                       pin beats a statistical one).
     retry-on-shed      a 429/503 from a replica fails over to the next
                        candidate immediately (the shedding replica is
                        excluded for this request); when EVERY routable
@@ -114,6 +130,7 @@ from paddle_tpu.distributed.retries import RetryPolicy
 from paddle_tpu.inference.overload import (CircuitBreaker,
                                            CircuitOpenError,
                                            jittered_retry_after)
+from paddle_tpu.inference.prefix import chain_keys
 from paddle_tpu.observability.metrics import MetricsRegistry
 from paddle_tpu.observability.requests import (parse_traceparent,
                                                safe_request_id)
@@ -204,7 +221,8 @@ class ReplicaRouter:
                  shed_rounds=2, affinity_capacity=4096,
                  breaker_threshold=3, breaker_reset_s=5.0,
                  retry_after_s=1.0, retry_policy=None, kill_hook=None,
-                 metrics=None):
+                 metrics=None, prefix_page_size=None,
+                 prefix_capacity=4096, prefix_max_pages=32):
         self.probe_interval_s = float(probe_interval_s)
         self.probe_timeout_s = float(probe_timeout_s)
         self.forward_timeout_s = float(forward_timeout_s)
@@ -226,10 +244,18 @@ class ReplicaRouter:
         self.metrics = metrics if metrics is not None \
             else MetricsRegistry()
         self._requests = self.metrics.counter("router.requests")
+        # prefix-hash routing (module doc): None disables; when set it
+        # must equal the replicas' engine page_size or the hashes
+        # can't agree with the pages the replicas actually cache
+        self.prefix_page_size = (int(prefix_page_size)
+                                 if prefix_page_size else None)
+        self.prefix_capacity = int(prefix_capacity)
+        self.prefix_max_pages = int(prefix_max_pages)
         self._lock = threading.Lock()
         self._order: list[Replica] = []     # registration order
         self._by_id: dict[str, Replica] = {}
         self._affinity: collections.OrderedDict = collections.OrderedDict()
+        self._prefix: collections.OrderedDict = collections.OrderedDict()
         self._rr = 0
         self._probe_stop = threading.Event()
         self._probe_thread = None
@@ -289,17 +315,19 @@ class ReplicaRouter:
                 n = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(n) if n else b""
                 stream_req = False
+                pkeys = ()
                 if self.path == "/generate":
                     try:
                         obj = json.loads(raw) if raw else {}
                         stream_req = bool(isinstance(obj, dict)
                                           and obj.get("stream"))
+                        pkeys = outer._prompt_prefix_keys(obj)
                     except ValueError:
                         pass    # opaque body: the replica will 400 it
                 session = self.headers.get("X-Session-Id")
                 try:
                     outer._route(self, self.path, raw, self.headers,
-                                 stream_req, session)
+                                 stream_req, session, pkeys)
                 except Exception as e:      # noqa: BLE001
                     # router-bug backstop: a typed reply (or a closed
                     # socket), never a silently hung client
@@ -541,11 +569,42 @@ class ReplicaRouter:
                       file=sys.stderr)
 
     # -- picking ------------------------------------------------------------
-    def _pick(self, excluded, session):
-        with self._lock:
-            return self._pick_locked(excluded, session)
+    def _prompt_prefix_keys(self, obj):
+        """The page-aligned hash chain of the inbound /generate prompt
+        (first row of `ids`), capped like the engine caps sharing —
+        `(len - 1) // page_size` full pages, so the router and the
+        replica's cache agree on what is shareable. () when prefix
+        routing is off or the body has no usable prompt. The chaos
+        site `router.prefix.scramble` perturbs the keys (repeated
+        prefixes stop matching — the routing tests' lever)."""
+        ps = self.prefix_page_size
+        if not ps or not isinstance(obj, dict):
+            return ()
+        ids = obj.get("ids")
+        if isinstance(ids, (list, tuple)) and ids \
+                and isinstance(ids[0], (list, tuple)):
+            ids = ids[0]
+        if not isinstance(ids, (list, tuple)) or not ids:
+            return ()
+        try:
+            row = [int(t) for t in ids]
+        except (TypeError, ValueError):
+            return ()
+        shareable = min((len(row) - 1) // ps, self.prefix_max_pages)
+        if shareable <= 0:
+            return ()
+        keys = chain_keys(row, ps, max_pages=shareable)
+        from paddle_tpu.distributed import chaos
+        if chaos.ENABLED \
+                and chaos.should_fire("router.prefix.scramble"):
+            keys = ["scrambled:" + k for k in keys]
+        return tuple(keys)
 
-    def _pick_locked(self, excluded, session):
+    def _pick(self, excluded, session, pkeys=()):
+        with self._lock:
+            return self._pick_locked(excluded, session, pkeys)
+
+    def _pick_locked(self, excluded, session, pkeys=()):
         cands = [r for r in self._order
                  if r.in_rotation and r.rid not in excluded
                  and r.breaker.state != CircuitBreaker.OPEN]
@@ -558,12 +617,54 @@ class ReplicaRouter:
                     if r.rid == rid:
                         self._affinity.move_to_end(session)
                         return r
+        # prefix-hash pick: deepest pinned key wins (chain keys make
+        # depth = prefix length, so this IS longest-prefix match)
+        pinned = None
+        stale_pin = False
+        keep_pins = False
+        for k in reversed(pkeys):
+            rid = self._prefix.get(k)
+            if rid is None:
+                continue
+            pr = self._by_id.get(rid)
+            if pr is not None and pr.in_rotation \
+                    and pr.breaker.state != CircuitBreaker.OPEN:
+                pinned = pr
+                break
+            stale_pin = True        # pin points at a dead replica
+        if pinned is not None:
+            if pinned in cands and not pinned.deprioritized:
+                for k in pkeys:
+                    if k in self._prefix:
+                        self._prefix.move_to_end(k)
+                self.metrics.inc("router.prefix.hits")
+                return pinned
+            # healthy pin, but excluded or saturated for THIS request:
+            # route around it WITHOUT re-pointing the pins — the KV
+            # pages are still where they say (affinity semantics; one
+            # transient shed must not flap the whole chain away)
+            keep_pins = True
         def key(r):
             return (1 if r.deprioritized else 0, r.load_score())
         best = min(key(r) for r in cands)
         group = [r for r in cands if key(r) == best]
         chosen = group[self._rr % len(group)]
         self._rr += 1
+        if pkeys and not keep_pins:
+            # (re)pin the whole chain to the chosen replica — its
+            # engine will cache these pages serving this request
+            new = 0
+            for k in pkeys:
+                if self._prefix.get(k) != chosen.rid:
+                    new += 1
+                self._prefix[k] = chosen.rid
+                self._prefix.move_to_end(k)
+            while len(self._prefix) > self.prefix_capacity:
+                self._prefix.popitem(last=False)
+            if new:
+                self.metrics.inc("router.prefix.pins", new)
+            if stale_pin:
+                self.metrics.inc("router.prefix.rebinds")
         if session:
             prev = self._affinity.get(session)
             pr = self._by_id.get(prev) if prev is not None else None
@@ -597,7 +698,8 @@ class ReplicaRouter:
         except OSError:
             pass
 
-    def _route(self, handler, path, raw, headers, stream_req, session):
+    def _route(self, handler, path, raw, headers, stream_req, session,
+               pkeys=()):
         """The retry/failover loop around `_forward_once` (module doc:
         shed -> immediate failover, all-shed -> jittered wait honoring
         the Retry-After floor, dead-before-first-byte -> replay, dead
@@ -641,7 +743,7 @@ class ReplicaRouter:
                         "client timeout budget exhausted during "
                         "failover", retryable=False)
                 timeout_hdr = f"{remaining:.3f}"
-            r = self._pick(excluded, session)
+            r = self._pick(excluded, session, pkeys)
             if r is None:
                 if shed and rounds_left > 1:
                     # every routable replica shed: honor the largest
@@ -949,6 +1051,20 @@ class ReplicaRouter:
             conn.close()
 
     # -- surfaces -----------------------------------------------------------
+    @staticmethod
+    def _prefix_hit_rate(stats):
+        """Per-replica prefix-cache hit rate from the newest probed
+        /stats body (PredictorServer embeds the engine's prefix
+        block); None when the replica doesn't report one."""
+        p = stats.get("prefix") if isinstance(stats, dict) else None
+        if not isinstance(p, dict):
+            return None
+        try:
+            h, m = int(p.get("hits", 0)), int(p.get("misses", 0))
+        except (TypeError, ValueError):
+            return None
+        return round(h / (h + m), 4) if (h + m) else 0.0
+
     def debug_replicas(self):
         """The GET /debug/replicas body (schema pinned in README): the
         router's live per-replica view + a summary."""
@@ -973,6 +1089,8 @@ class ReplicaRouter:
                     "breaker": r.breaker.snapshot(),
                     "ejections": r.ejections,
                     "served": r.served,
+                    "prefix_hit_rate": self._prefix_hit_rate(
+                        r.last_stats),
                 })
             summary = {
                 "total": len(self._order),
@@ -984,6 +1102,7 @@ class ReplicaRouter:
                 "deprioritized": sum(1 for r in self._order
                                      if r.deprioritized),
                 "sessions": len(self._affinity),
+                "prefix_pins": len(self._prefix),
             }
         return {"replicas": rows, "summary": summary}
 
@@ -997,9 +1116,10 @@ class ReplicaRouter:
             n, rot = len(self._order), \
                 sum(1 for r in self._order if r.in_rotation)
             sessions = len(self._affinity)
+            prefix_pins = len(self._prefix)
         return {"replicas": n, "in_rotation": rot,
-                "sessions": sessions, "requests": counts,
-                "retries": retries}
+                "sessions": sessions, "prefix_pins": prefix_pins,
+                "requests": counts, "retries": retries}
 
     def metrics_text(self):
         from paddle_tpu.observability import REGISTRY

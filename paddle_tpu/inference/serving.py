@@ -779,6 +779,14 @@ class PredictorServer:
                 "queued": len(self.batcher._buf),
                 "expired_in_queue": self.batcher.expired_in_queue,
                 "shed_full": self.batcher.shed_full}
+        g = self.generator
+        if g is not None and hasattr(g, "prefix_stats"):
+            # the engine's prefix-cache hit stats (PagedKVEngine with
+            # prefix_cache_pages>0): the router probes this block to
+            # make per-replica KV locality a visible number
+            p = g.prefix_stats()
+            if p is not None:
+                out["prefix"] = p
         return out
 
     def metrics_text(self):

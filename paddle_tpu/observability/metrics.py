@@ -241,6 +241,17 @@ METRICS = {
     "router.affinity.rebinds": ("counter",
                                 "sessions re-pinned after their "
                                 "affine replica left rotation"),
+    "router.prefix.pins": ("counter",
+                           "prefix-hash -> replica pins created or "
+                           "re-pointed (one per chain key)"),
+    "router.prefix.hits": ("counter",
+                           "requests routed to the replica their "
+                           "prefix hash is pinned to (KV locality "
+                           "preserved)"),
+    "router.prefix.rebinds": ("counter",
+                              "prefix pins re-bound after every "
+                              "pinned replica for the chain left "
+                              "rotation"),
     "router.replicas.in_rotation": ("gauge",
                                     "replicas currently routable"),
     "router.replicas.ejected": ("gauge",
@@ -258,6 +269,26 @@ METRICS = {
                                     "slot pins (all layers, real "
                                     "buffer dtypes incl. int8 scale "
                                     "planes)"),
+    "inference.prefix.hits": ("counter",
+                              "admissions that shared cached prompt "
+                              "prefix pages (prefill ran only the "
+                              "tail)"),
+    "inference.prefix.misses": ("counter",
+                                "admissions of shareable-length "
+                                "prompts that found no cached "
+                                "prefix"),
+    "inference.prefix.hit_tokens": ("counter",
+                                    "prompt tokens served from shared "
+                                    "prefix pages instead of "
+                                    "prefill"),
+    "inference.prefix.pages_shared": ("counter",
+                                      "prefix-cache pages pointed "
+                                      "into admitted slots' block "
+                                      "tables"),
+    "inference.prefix.evictions": ("counter",
+                                   "prefix-cache entries evicted "
+                                   "(LRU budget or on-demand when "
+                                   "decode needed the page back)"),
     "engine.ticks": ("gauge", "scheduler ticks run"),
     "engine.prefills": ("gauge", "prompts prefilled"),
     "engine.tokens_out": ("gauge", "tokens emitted"),

@@ -1,0 +1,584 @@
+"""ISSUE 11 — prefix KV cache with refcounted shared pages
+(inference/prefix.py + inference/paged.py) and prefix-hash-aware
+fleet routing (inference/router.py).
+
+The load-bearing pins:
+
+- the hash chain: a key hit implies the ENTIRE prefix matches (a
+  divergence in page j changes every key >= j); partial pages are
+  never keyed;
+- warm-hit generation is BIT-IDENTICAL to cold — exact greedy parity
+  against the solo generate() oracle on BOTH attend paths (jnp and
+  the Pallas kernel in interpret mode) and composed with speculative
+  decoding — while the warm slot physically shares the cached pages
+  and prefills only the uncached tail (pinned via the tail-bucket
+  program key and prefix_hit_tokens);
+- int8 shared pages keep FROZEN quant scales: a warm engine whose
+  pages have been shared and recycled produces the same tokens as a
+  fresh engine (the PR 6 scale-reset invariant survives sharing);
+- eviction under pressure NEVER frees a page with live refs, and the
+  admission headroom counts reclaimable cached pages so the cache
+  cannot starve decode allocation;
+- `prefix.cache.bypass` turns hits into misses deterministically;
+- the router steers a repeated prefix to its pinned replica, routes
+  around a merely-excluded one without moving the pin, and re-binds
+  (router.prefix.rebinds) when the pinned replica leaves rotation;
+  `router.prefix.scramble` perturbs the hash so pins stop matching;
+- /stats carries the engine's prefix block, /debug/replicas carries
+  the probed per-replica prefix_hit_rate, and tools/router_status
+  renders both.
+"""
+import ast
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed import chaos
+from paddle_tpu.inference.paged import PagedKVEngine
+from paddle_tpu.inference.prefix import PrefixCache, chain_keys
+from paddle_tpu.inference.router import ReplicaRouter
+from paddle_tpu.inference.serving import PredictorServer
+from paddle_tpu.models.generation import generate
+from paddle_tpu.models.llama import LlamaForCausalLM, tiny_llama_config
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
+
+_MODEL = None
+
+
+def _model(seed=0):
+    """One shared read-only model (deterministic weights): every
+    engine compiles its own programs anyway — rebuilding identical
+    weights per test only burns tier-1 wall time."""
+    global _MODEL
+    if _MODEL is None:
+        paddle_tpu.seed(seed)
+        cfg = tiny_llama_config(num_hidden_layers=2, vocab_size=97,
+                                hidden_size=32, intermediate_size=64,
+                                num_attention_heads=4,
+                                num_key_value_heads=2)
+        _MODEL = LlamaForCausalLM(cfg)
+    return _MODEL
+
+
+def _solo(model, prompt, n):
+    return np.asarray(generate(
+        model, np.asarray([prompt], np.int32),
+        max_new_tokens=n))[0].tolist()[len(prompt):]
+
+
+# -- the hash chain ----------------------------------------------------------
+
+def test_chain_keys_contract():
+    ps = 4
+    toks = list(range(1, 14))                    # 13 tokens: 3 full pages
+    keys = chain_keys(toks, ps)
+    assert len(keys) == 3                        # partial page never keyed
+    # identical prefixes agree key-for-key, a longer prompt extends
+    assert chain_keys(toks + [99, 98], ps)[:3] == keys
+    # a divergence in page 1 changes key 1 AND every deeper key (chain)
+    other = list(toks)
+    other[5] += 1
+    ok = chain_keys(other, ps)
+    assert ok[0] == keys[0]
+    assert ok[1] != keys[1] and ok[2] != keys[2]
+    # max_pages caps; deterministic across calls; bad page_size raises
+    assert chain_keys(toks, ps, max_pages=1) == keys[:1]
+    assert chain_keys(toks, ps) == keys
+    with pytest.raises(ValueError):
+        chain_keys(toks, 0)
+    # tokens hash by VALUE, not by concatenated digits ([1,23] != [12,3])
+    assert chain_keys([1, 23], 2) != chain_keys([12, 3], 2)
+
+
+def test_prefix_cache_lru_unit():
+    c = PrefixCache(2)
+    assert c.insert("a", 1) and c.insert("b", 2)
+    assert not c.insert("a", 9)                  # existing entry wins
+    assert c.get("a") == 1
+    assert c.match(["a", "b"]) == [1, 2]
+    assert c.match(["a", "x", "b"]) == [1]       # leading run only
+    c.insert("c", 3)
+    assert c.over_budget() == 1
+    # "b" is coldest (the match touched "a" after "b")
+    assert c.pop_lru() == ("b", 2)
+    assert c.pop_lru_where(lambda p: p == 99) is None
+    assert c.pop_lru_where(lambda p: p == 1) == ("a", 1)
+    with pytest.raises(ValueError):
+        PrefixCache(0)
+
+
+# -- warm-hit parity (the tentpole correctness bar) --------------------------
+
+@pytest.mark.parametrize("kernel", ["jnp", "pallas"])
+def test_warm_hit_exact_parity_and_tail_only_prefill(kernel):
+    """A warm submit shares the cached pages physically, prefills only
+    the uncached tail, and still produces EXACTLY the cold/solo
+    tokens — on both attend paths."""
+    model = _model()
+    prefix = [5, 9, 2, 14, 17, 3, 11, 4]         # 2 full pages of 4
+    pa = prefix + [21, 22, 23]
+    pb = prefix + [31, 32]
+    eng = PagedKVEngine(model, max_slots=2, page_size=4, num_pages=32,
+                        max_pages_per_slot=8, steps_per_tick=2,
+                        kernel=kernel, prefix_cache_pages=8)
+    ra = eng.submit(pa, max_new_tokens=8)
+    eng.run_until_idle()
+    assert ra.result() == _solo(model, pa, 8)
+    assert eng.stats["prefix_hits"] == 0
+    cached = eng.prefix_cache.match(ra.prefix_keys[:2])
+    assert len(cached) == 2                      # both full pages cached
+
+    rb = eng.submit(pb, max_new_tokens=6)
+    eng.step()                                   # admit: hit recorded
+    bslot = next(i for i, s in enumerate(eng._slots)
+                 if s is not None and s.req is rb)
+    # the warm slot's leading block-table entries ARE the cached pages
+    assert eng._slots[bslot].pages[:2] == cached
+    assert eng._slots[bslot].shared == 2
+    assert [eng._page_refs[p] for p in cached] == [2, 2]
+    eng.run_until_idle()
+    assert rb.result() == _solo(model, pb, 6)
+    assert eng.stats["prefix_hits"] == 1
+    assert eng.stats["prefix_hit_tokens"] == 8
+    assert eng.stats["prefix_pages_shared"] == 2
+    # prefill ran only the tail: pb's 2-token tail compiled the minimum
+    # 8-bucket program, never pa's 16-bucket
+    assert ("prefill", 8, 1) in eng._programs
+
+    # resubmitting the FULL prompt pa warm stays bit-identical too
+    ra2 = eng.submit(pa, max_new_tokens=8)
+    eng.run_until_idle()
+    assert ra2.result() == ra.result()
+    assert eng.stats["prefix_hits"] == 2
+    # all shared pages' refs settle back to the cache's own
+    assert all(eng._page_refs[p] == 1 for p in cached)
+
+
+def test_warm_hit_speculative_parity():
+    """Prefix sharing composes with speculative decoding: the draft's
+    pools share the same block tables, so cached pages carry the
+    prefix's draft KV too — a perfect draft stays lossless on a warm
+    hit."""
+    model = _model()
+    prefix = [5, 9, 2, 14, 17, 3, 11, 4]
+    pa = prefix + [21, 22]
+    pb = prefix + [33]
+    eng = PagedKVEngine(model, max_slots=2, page_size=4, num_pages=32,
+                        max_pages_per_slot=8, steps_per_tick=2,
+                        draft_model=model, spec_tokens=2,
+                        prefix_cache_pages=8)
+    got_a = eng.generate([pa], max_new_tokens=5)[0]
+    assert got_a == _solo(model, pa, 5)
+    got_b = eng.generate([pb], max_new_tokens=5)[0]
+    assert eng.stats["prefix_hits"] == 1
+    assert got_b == _solo(model, pb, 5)
+
+
+def test_int8_shared_pages_keep_frozen_scales():
+    """The PR 6 invariant composed with sharing: scales of a shared
+    page are reset only when the LAST referent (slot or cache) lets
+    go, so a used engine whose pages were shared and recycled decodes
+    a prompt exactly like a fresh engine."""
+    mk = lambda: PagedKVEngine(                          # noqa: E731
+        _model(), max_slots=2, page_size=4, num_pages=32,
+        max_pages_per_slot=8, steps_per_tick=2, kv_dtype="int8",
+        prefix_cache_pages=8)
+    prefix = [5, 9, 2, 14, 17, 3, 11, 4]
+    prompts = [prefix + [21, 22], prefix + [31]]
+    used = mk()
+    outs = [used.generate([p], max_new_tokens=5)[0] for p in prompts]
+    assert used.stats["prefix_hits"] == 1        # the 2nd shared
+    fresh = mk()
+    fresh_outs = [fresh.generate([p], max_new_tokens=5)[0]
+                  for p in prompts]
+    assert outs == fresh_outs
+
+
+# -- refcount / eviction safety ----------------------------------------------
+
+def test_eviction_under_pressure_never_frees_live_refs():
+    """LRU budget eviction may drop an entry whose page a live slot
+    still references: the page must NOT return to the free list until
+    that slot retires, and the slot's output stays exact."""
+    model = _model()
+    eng = PagedKVEngine(model, max_slots=2, page_size=4, num_pages=32,
+                        max_pages_per_slot=8, steps_per_tick=1,
+                        prefix_cache_pages=2)     # tiny budget
+    prefix = [5, 9, 2, 14, 17, 3, 11, 4]
+    pa = prefix + [21]
+    eng.generate([pa], max_new_tokens=2)          # caches 2 pages
+    shared = eng.prefix_cache.match(chain_keys(prefix, 4))
+    assert len(shared) == 2
+    # W holds the shared pages mid-generation
+    rw = eng.submit(prefix + [55], max_new_tokens=10)
+    eng.step()
+    assert eng.stats["prefix_hits"] == 1
+    assert [eng._page_refs[p] for p in shared] == [2, 2]
+    # a different prefix evicts BOTH cached entries (budget 2)
+    other = [50 + i for i in range(8)] + [70]
+    eng.submit(other, max_new_tokens=2)
+    while rw.done.is_set() is False or eng.has_work():
+        eng.step()
+    assert eng.stats["prefix_evictions"] >= 2
+    # W's shared pages never hit the free list while W was live, and
+    # its tokens are still the exact solo sequence
+    assert rw.result() == _solo(model, prefix + [55], 10)
+    # after every retirement the ledger settles: only cached pages
+    # keep refs, everything else is free, and the incremental
+    # reclaimable counter agrees (every cached page is cache-only now)
+    cached_now = set(eng.prefix_cache.pages())
+    assert set(eng._page_refs) == cached_now
+    assert len(eng._free) == eng.num_pages - 1 - len(cached_now)
+    assert eng._reclaimable == len(cached_now)
+    assert eng._cached_pages == cached_now
+
+
+def test_admission_not_starved_by_cold_cache():
+    """Reclaimable cached pages count as admission headroom and are
+    evicted on demand: a request that fits only if the cache lets go
+    still admits (the cache can never starve decode)."""
+    model = _model()
+    eng = PagedKVEngine(model, max_slots=1, page_size=4, num_pages=8,
+                        max_pages_per_slot=7, steps_per_tick=2,
+                        prefix_cache_pages=6)
+    pa = list(range(1, 9)) + [40]                # needs 3+ pages
+    eng.generate([pa], max_new_tokens=3)
+    assert len(eng.prefix_cache) == 2            # pages pinned by cache
+    # 7 allocatable, 2 cached: a 7-page request fits only by evicting
+    pb = [60 + i for i in range(12)]             # 12 + 12 new = 6 pages
+    got = eng.generate([pb], max_new_tokens=12)[0]
+    assert got == _solo(model, pb, 12)
+    assert eng.stats["prefix_evictions"] >= 1
+
+
+def test_bypass_chaos_site_forces_miss():
+    model = _model()
+    eng = PagedKVEngine(model, max_slots=2, page_size=4, num_pages=32,
+                        max_pages_per_slot=8, steps_per_tick=2,
+                        prefix_cache_pages=8)
+    prefix = [5, 9, 2, 14, 17, 3, 11, 4]
+    eng.generate([prefix + [21]], max_new_tokens=2)
+    with chaos.scoped(rates={"prefix.cache.bypass": 1.0}):
+        got = eng.generate([prefix + [31]], max_new_tokens=4)[0]
+        assert chaos.fire_count("prefix.cache.bypass") == 1
+    assert got == _solo(model, prefix + [31], 4)
+    assert eng.stats["prefix_hits"] == 0
+    assert eng.stats["prefix_misses"] == 2
+    assert eng.stats["prefix_pages_shared"] == 0
+
+
+def test_prefix_disabled_default_and_validation():
+    model = _model()
+    eng = PagedKVEngine(model, max_slots=1, page_size=4, num_pages=16)
+    assert eng.prefix_cache is None
+    assert eng.prefix_stats() is None
+    r = eng.submit([1, 2, 3, 4, 5], max_new_tokens=2)
+    assert r.prefix_keys == []                   # no hashing when off
+    eng.run_until_idle()
+    assert len(eng._free) == eng.num_pages - 1   # old invariant intact
+    with pytest.raises(ValueError):
+        PagedKVEngine(model, prefix_cache_pages=-1)
+
+
+# -- catalogue pins ----------------------------------------------------------
+
+def test_prefix_chaos_sites_registered():
+    assert "prefix.cache.bypass" in chaos.POINTS
+    assert "router.prefix.scramble" in chaos.POINTS
+
+
+def test_prefix_metrics_catalogued_both_directions():
+    """PR 7 pattern for the new family: every inference.prefix.*
+    observability.inc literal in paged.py is catalogued, and every
+    catalogued inference.prefix.* name is recorded by a literal call
+    site in paged.py."""
+    from paddle_tpu.observability.metrics import METRICS
+    src = os.path.join(_ROOT, "paddle_tpu", "inference", "paged.py")
+    tree = ast.parse(open(src).read())
+    seen = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and node.args \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("inc", "observe", "set_gauge"):
+            arg = node.args[0]
+            assert isinstance(arg, ast.Constant) and \
+                isinstance(arg.value, str), \
+                f"non-literal metric name at paged.py:{node.lineno}"
+            assert arg.value in METRICS, arg.value
+            seen.add(arg.value)
+    family = {n for n in METRICS if n.startswith("inference.prefix.")}
+    assert family == {"inference.prefix.hits",
+                      "inference.prefix.misses",
+                      "inference.prefix.hit_tokens",
+                      "inference.prefix.pages_shared",
+                      "inference.prefix.evictions"}
+    missing = family - seen
+    assert not missing, f"catalogued but never recorded: {missing}"
+    # the router side rides test_replica_router's both-directions pin;
+    # here just pin that the family exists and is counters
+    for name in ("router.prefix.pins", "router.prefix.hits",
+                 "router.prefix.rebinds"):
+        assert METRICS[name][0] == "counter"
+
+
+def test_prefix_instruments_recorded():
+    obs.disable()
+    obs.REGISTRY.reset()
+    model = _model()
+    prefix = [5, 9, 2, 14, 17, 3, 11, 4]
+    with obs.scoped(reset=True) as reg:
+        eng = PagedKVEngine(model, max_slots=2, page_size=4,
+                            num_pages=32, max_pages_per_slot=8,
+                            steps_per_tick=2, prefix_cache_pages=8)
+        eng.generate([prefix + [21]], max_new_tokens=2)
+        eng.generate([prefix + [31]], max_new_tokens=2)
+        vals = {k: reg.counter(f"inference.prefix.{k}").value()
+                for k in ("hits", "misses", "hit_tokens",
+                          "pages_shared")}
+    assert vals == {"hits": 1, "misses": 1, "hit_tokens": 8,
+                    "pages_shared": 2}
+
+
+# -- serving /stats ----------------------------------------------------------
+
+def test_serving_stats_carries_prefix_block():
+    model = _model()
+    eng = PagedKVEngine(model, max_slots=2, page_size=4, num_pages=32,
+                        max_pages_per_slot=8, steps_per_tick=2,
+                        prefix_cache_pages=8)
+    prefix = [5, 9, 2, 14, 17, 3, 11, 4]
+    eng.generate([prefix + [21]], max_new_tokens=2)
+    eng.generate([prefix + [31]], max_new_tokens=2)
+    server = PredictorServer(lambda x: {"y": np.zeros((1, 1))},
+                             generator=eng).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/stats",
+                timeout=30) as resp:
+            st = json.loads(resp.read())
+        assert st["prefix"]["hits"] == 1
+        assert st["prefix"]["misses"] == 1
+        assert st["prefix"]["hit_rate"] == 0.5
+        assert st["prefix"]["cached_pages"] == 2
+        assert st["prefix"]["page_budget"] == 8
+    finally:
+        server.stop()
+    # an engine without a cache (or a generator without the surface)
+    # adds no block
+    s2 = PredictorServer(lambda x: {"y": np.zeros((1, 1))},
+                         generator=PagedKVEngine(
+                             model, max_slots=1, page_size=4,
+                             num_pages=16))
+    try:
+        assert "prefix" not in s2.stats()
+    finally:
+        s2.stop()
+
+
+# -- prefix-hash-aware routing -----------------------------------------------
+
+class _Tok:
+    """Minimal /generate backend; optionally reports prefix stats."""
+
+    concurrent_safe = False
+
+    def __init__(self, prefix_stats=None):
+        self._ps = prefix_stats
+
+    def stream(self, ids, **kw):
+        def gen():
+            yield np.asarray([7])
+        return gen()
+
+    def prefix_stats(self):
+        return self._ps
+
+
+def _gen_fleet(n=2, stats=None):
+    servers = [PredictorServer(
+        lambda x: {"y": np.zeros((1, 1))}, model_name=f"r{i}",
+        generator=_Tok(stats[i] if stats else None)).start()
+        for i in range(n)]
+    pairs = [(f"r{i}", f"127.0.0.1:{s.port}")
+             for i, s in enumerate(servers)]
+    return servers, pairs
+
+
+def _gen_req(port, ids, headers=None):
+    body = json.dumps({"ids": ids, "max_new_tokens": 1}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate", data=body,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        resp.read()
+        return resp.headers.get("X-Routed-To")
+
+
+def test_router_prefix_routes_repeats_to_pinned_replica():
+    """Repeated prefixes land on the replica that already holds their
+    pages, even while round-robin would alternate; distinct prefixes
+    spread. Counters: pins on first sight, hits on reuse."""
+    servers, pairs = _gen_fleet(2)
+    router = ReplicaRouter(pairs, prefix_page_size=4)
+    router.probe_all()
+    router.start(probe=False)
+    try:
+        prefix = list(range(1, 9))               # 2 full pages
+        first = _gen_req(router.port, prefix + [91])
+        assert router.metrics.counter(
+            "router.prefix.pins").value() == 2
+        for tail in ([92], [93, 94], [95]):
+            assert _gen_req(router.port, prefix + tail) == first
+        assert router.metrics.counter(
+            "router.prefix.hits").value() == 3
+        # a distinct prefix is not captured by the pin (round-robin
+        # sends it to the OTHER equally-loaded replica)
+        other = _gen_req(router.port, list(range(40, 48)) + [1])
+        assert other != first
+        assert router.stats()["prefix_pins"] == 4
+        assert router.debug_replicas()["summary"]["prefix_pins"] == 4
+        # ids may arrive 2-D (the serving contract allows both): the
+        # first row routes it identically
+        assert _gen_req(router.port, [prefix + [96]]) == first
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_prefix_pin_survives_transient_exclusion():
+    """A healthy pinned replica that is merely excluded for ONE
+    request (a shed/failure mid-retry) is routed around WITHOUT
+    re-pointing the chain — the KV pages are still there, and one
+    transient shed must not flap the pins (mirrors the session-
+    affinity guard)."""
+    servers, pairs = _gen_fleet(2)
+    router = ReplicaRouter(pairs, prefix_page_size=4)
+    router.start(probe=False)
+    try:
+        prefix = list(range(1, 9))
+        first = _gen_req(router.port, prefix + [91])
+        pkeys = router._prompt_prefix_keys({"ids": prefix + [92]})
+        picked = router._pick({first}, None, pkeys)
+        assert picked is not None and picked.rid != first
+        # the chain still points at the original replica; no rebind
+        assert set(router._prefix.values()) == {first}
+        assert router.metrics.counter(
+            "router.prefix.rebinds").value() == 0
+        # and the next unexcluded request hits the original pin
+        assert _gen_req(router.port, prefix + [93]) == first
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_prefix_rebinds_when_pinned_replica_dies():
+    servers, pairs = _gen_fleet(2)
+    router = ReplicaRouter(pairs, prefix_page_size=4, eject_after=2)
+    router.probe_all()
+    router.start(probe=False)
+    try:
+        prefix = list(range(1, 9))
+        first = _gen_req(router.port, prefix + [91])
+        dead = next(s for s in servers
+                    if f"127.0.0.1:{s.port}" == dict(
+                        (r, u) for r, u in pairs)[first])
+        dead.stop()
+        router.probe_all()
+        router.probe_all()                       # eject_after=2
+        assert router.replica(first).in_rotation is False
+        got = _gen_req(router.port, prefix + [92])
+        assert got is not None and got != first
+        assert router.metrics.counter(
+            "router.prefix.rebinds").value() == 1
+        # the chain is re-pinned: the next repeat HITS the survivor
+        assert _gen_req(router.port, prefix + [93]) == got
+        assert router.metrics.counter(
+            "router.prefix.hits").value() == 1
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_prefix_scramble_chaos_breaks_matching():
+    servers, pairs = _gen_fleet(2)
+    router = ReplicaRouter(pairs, prefix_page_size=4)
+    router.probe_all()
+    router.start(probe=False)
+    try:
+        prefix = list(range(1, 9))
+        _gen_req(router.port, prefix + [91])
+        with chaos.scoped(rates={"router.prefix.scramble": 1.0}):
+            _gen_req(router.port, prefix + [92])
+            assert chaos.fire_count("router.prefix.scramble") == 1
+        # the scrambled request could not match the real pin
+        assert router.metrics.counter(
+            "router.prefix.hits").value() == 0
+        # without chaos the same prefix hits again
+        _gen_req(router.port, prefix + [93])
+        assert router.metrics.counter(
+            "router.prefix.hits").value() == 1
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_router_prefix_lru_bound_and_disabled_default():
+    servers, pairs = _gen_fleet(1)
+    router = ReplicaRouter(pairs, prefix_page_size=4,
+                           prefix_capacity=3)
+    router.probe_all()
+    router.start(probe=False)
+    try:
+        for base in (0, 100, 200, 300):
+            _gen_req(router.port, [base + i for i in range(9)])
+        assert len(router._prefix) == 3          # bounded LRU
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+    # default: prefix routing off, no keys computed
+    r2 = ReplicaRouter([])
+    try:
+        assert r2.prefix_page_size is None
+        assert r2._prompt_prefix_keys({"ids": list(range(16))}) == ()
+    finally:
+        r2.stop()
+
+
+def test_debug_replicas_prefix_hit_rate_and_status_render():
+    """The fleet-KV-locality satellite: the router probes each
+    replica's /stats prefix block and surfaces hits/(hits+misses) in
+    /debug/replicas; tools/router_status renders the column."""
+    stats = [{"enabled": True, "hits": 3, "misses": 1,
+              "hit_rate": 0.75, "hit_tokens": 48, "pages_shared": 6,
+              "evictions": 0, "cached_pages": 2, "page_budget": 8},
+             None]
+    servers, pairs = _gen_fleet(2, stats=stats)
+    router = ReplicaRouter(pairs, prefix_page_size=4)
+    router.probe_all()
+    try:
+        rows = {r["id"]: r for r in
+                router.debug_replicas()["replicas"]}
+        assert rows["r0"]["prefix_hit_rate"] == 0.75
+        assert rows["r1"]["prefix_hit_rate"] is None
+        from tools.router_status import render
+        out = render(router.debug_replicas())
+        assert "pfx_hit" in out and "0.75" in out
+        assert "prefix pins:" in out
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()

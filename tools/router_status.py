@@ -60,7 +60,8 @@ def render(doc) -> str:
             ("load", "load_score"), ("inflight", "replica_in_flight"),
             ("queue", "replica_queue_depth"),
             ("breaker", None), ("eject", "ejections"),
-            ("served", "served"), ("probe_age", "last_probe_age_s")]
+            ("served", "served"), ("pfx_hit", "prefix_hit_rate"),
+            ("probe_age", "last_probe_age_s")]
     table = [[h for h, _k in cols]]
     for r in rows:
         cells = []
@@ -81,7 +82,8 @@ def render(doc) -> str:
         f"{_fmt(s.get('in_rotation'))} in rotation, "
         f"{_fmt(s.get('ejected'))} ejected, "
         f"{_fmt(s.get('deprioritized'))} deprioritized; "
-        f"sessions pinned: {_fmt(s.get('sessions'))}")
+        f"sessions pinned: {_fmt(s.get('sessions'))}; "
+        f"prefix pins: {_fmt(s.get('prefix_pins'))}")
     stats = doc.get("stats")
     if isinstance(stats, dict) and "error" not in stats:
         lines.append(f"requests: {stats.get('requests') or {}}  "
