@@ -186,6 +186,87 @@ def _prefix_bench():
     }
 
 
+def _tenant_bench():
+    """Multi-tenant QoS payoff (ISSUE 13): a saturated two-tenant
+    workload — `prod` (weight 3) and `batch` (weight 1) each submit
+    more requests than the engine has slots — through ONE engine with
+    a TenantTable. Reports the decode slot-tick split (the claim:
+    ~3:1 by weight, from the engine's own per-tenant counters), the
+    admission interleave, and the per-tenant queue-wait means: the
+    weighted-fair pick turns the old FIFO pot-luck into a policy
+    number. Pure host-side scheduling on the same tiny model the
+    prefix bench uses; compiles excluded by a warmup pass."""
+    import time
+
+    import paddle_tpu
+    from paddle_tpu.inference.paged import PagedKVEngine
+    from paddle_tpu.inference.tenancy import TenantPolicy, TenantTable
+    from paddle_tpu.models.llama import LlamaForCausalLM, \
+        tiny_llama_config
+
+    paddle_tpu.seed(0)
+    cfg = tiny_llama_config(num_hidden_layers=2, vocab_size=128,
+                            hidden_size=64, intermediate_size=128,
+                            num_attention_heads=4,
+                            num_key_value_heads=2)
+    model = LlamaForCausalLM(cfg)
+    table = TenantTable([TenantPolicy("prod", weight=3.0),
+                         TenantPolicy("batch", weight=1.0)])
+    eng = PagedKVEngine(model, max_slots=2, page_size=16,
+                        num_pages=128, steps_per_tick=2,
+                        tenancy=table)
+    rng = np.random.RandomState(0)
+
+    def submit_all(n_per_tenant, max_new):
+        reqs = []
+        for _ in range(n_per_tenant):
+            for t in ("prod", "batch"):
+                reqs.append(eng.submit(
+                    list(rng.randint(1, cfg.vocab_size, 8)),
+                    max_new_tokens=max_new, tenant=t))
+        return reqs
+
+    warm = submit_all(1, 2)         # warmup: compiles
+    eng.run_until_idle()
+    for r in warm:
+        r.result()
+    base = {k: dict(v) for k, v in eng.tenant_snapshot().items()}
+    reqs = submit_all(8, 8)
+    # the weighted split only exists while BOTH tenants are
+    # backlogged (a drained workload equalizes lifetime totals):
+    # snapshot slot shares the moment one side's backlog empties
+    t0 = time.perf_counter()
+    saturated = None
+    while eng.has_work():
+        eng.step()
+        snap = eng.tenant_snapshot()
+        if saturated is None and (snap["prod"]["pending"] == 0
+                                  or snap["batch"]["pending"] == 0):
+            saturated = {
+                t: snap[t]["slot_ticks"]
+                - base.get(t, {}).get("slot_ticks", 0)
+                for t in ("prod", "batch")}
+    dt = time.perf_counter() - t0
+    for r in reqs:
+        r.result()
+    snap = eng.tenant_snapshot()
+
+    def delta(t, k):
+        return snap[t][k] - base.get(t, {}).get(k, 0)
+
+    sat = saturated or {"prod": 0, "batch": 0}
+    return {
+        "requests_per_tenant": 8,
+        "weights": {"prod": 3.0, "batch": 1.0},
+        "wall_s": round(dt, 3),
+        "saturated_slot_ticks": sat,
+        "saturated_share_ratio": round(
+            sat["prod"] / max(sat["batch"], 1), 3),
+        "admitted": {"prod": delta("prod", "admitted"),
+                     "batch": delta("batch", "admitted")},
+    }
+
+
 def _fleet_bench(trainer, batch, steps):
     """Heartbeat-publisher overhead (ISSUE 9): the SAME compiled step
     run with observability on, first without the fleet plane, then
@@ -419,6 +500,12 @@ def main():
     except Exception as e:           # noqa: BLE001 — never sink the
         prefix = {"error": f"{type(e).__name__}: {e}"}  # train metric
 
+    # multi-tenant weighted-fair slot split (ISSUE 13)
+    try:
+        tenant = _tenant_bench()
+    except Exception as e:           # noqa: BLE001 — never sink the
+        tenant = {"error": f"{type(e).__name__}: {e}"}  # train metric
+
     print(json.dumps({
         "metric": "llama1b_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
@@ -430,7 +517,7 @@ def main():
                   "device": getattr(dev, "device_kind", str(dev)),
                   "batch": batch, "seq": seq, "steps": steps,
                   "decode": decode, "fleet": fleet, "router": router,
-                  "prefix": prefix},
+                  "prefix": prefix, "tenant": tenant},
     }))
 
 

@@ -94,6 +94,12 @@ POINTS = {
                            "a miss at admission (the hit-rate lever "
                            "for deterministic cold-vs-warm tests and "
                            "the prefix bench)",
+    "tenant.storm": "stamp an UNLABELED serving/router request with "
+                    "the synthetic storm tenant id (inference/"
+                    "tenancy.resolve_tenant) — rate 1.0 turns all "
+                    "unlabeled traffic into a deterministic "
+                    "noisy-neighbor flood for the starvation soak, "
+                    "without touching labeled tenants",
     "serving.batch.delay": "slow DynamicBatcher backend run",
     "serving.batch.fail": "failed DynamicBatcher batch run (error "
                           "must fan out to every waiter)",

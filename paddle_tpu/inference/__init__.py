@@ -17,7 +17,8 @@ from paddle_tpu.core.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "PlaceType", "PagedKVEngine", "PredictorServer", "serve",
-           "overload", "ReplicaRouter"]
+           "overload", "ReplicaRouter", "tenancy", "TenantPolicy",
+           "TenantTable"]
 
 
 def __getattr__(name):
@@ -31,11 +32,14 @@ def __getattr__(name):
     if name == "ReplicaRouter":
         from paddle_tpu.inference.router import ReplicaRouter
         return ReplicaRouter
-    if name == "overload":
+    if name in ("TenantPolicy", "TenantTable"):
+        from paddle_tpu.inference import tenancy as _tenancy
+        return getattr(_tenancy, name)
+    if name in ("overload", "tenancy"):
         # importlib, not `from ... import`: a from-import of a not-yet-
         # loaded submodule re-enters this __getattr__ and recurses
         import importlib
-        return importlib.import_module("paddle_tpu.inference.overload")
+        return importlib.import_module(f"paddle_tpu.inference.{name}")
     raise AttributeError(name)
 
 

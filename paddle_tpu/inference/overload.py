@@ -37,10 +37,10 @@ import threading
 import time
 
 __all__ = [
-    "OverloadError", "AdmissionRejected", "CircuitOpenError",
-    "ServerDraining", "DeadlineExceeded", "EngineOverloaded",
-    "Deadline", "AdmissionController", "CircuitBreaker",
-    "jittered_retry_after", "seed_retry_jitter",
+    "OverloadError", "AdmissionRejected", "TenantQuotaExceeded",
+    "CircuitOpenError", "ServerDraining", "DeadlineExceeded",
+    "EngineOverloaded", "Deadline", "AdmissionController",
+    "CircuitBreaker", "jittered_retry_after", "seed_retry_jitter",
 ]
 
 
@@ -102,6 +102,17 @@ class AdmissionRejected(OverloadError):
 
     status = 429
     counter = "shed_admission"
+
+
+class TenantQuotaExceeded(AdmissionRejected):
+    """One tenant is over ITS OWN quota (per-tenant admission or queue
+    bound, or the router's fleet-wide rate cap — inference/tenancy.py)
+    while the server may have plenty of global headroom: shed THIS
+    tenant's excess with a typed, retryable 429 without touching any
+    other tenant's budget (the bulkhead contract)."""
+
+    status = 429
+    counter = "shed_tenant"
 
 
 class CircuitOpenError(OverloadError):

@@ -64,6 +64,7 @@ never starve decode allocation.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import math
 import queue
@@ -81,8 +82,10 @@ from paddle_tpu import observability
 from paddle_tpu.observability import requests as obs_requests
 from paddle_tpu.inference.overload import (DeadlineExceeded,
                                            EngineOverloaded,
-                                           OverloadError)
+                                           OverloadError,
+                                           TenantQuotaExceeded)
 from paddle_tpu.inference.prefix import PrefixCache, chain_keys
+from paddle_tpu.inference.tenancy import WeightedFairScheduler
 
 __all__ = ["PagedState", "paged_attention_update", "decode_kernel_scope",
            "PagedKVEngine"]
@@ -359,6 +362,8 @@ class _Request:
         self.sample_index = 0       # engine-local; set by submit()
         self.prefix_keys = []       # full-page hash chain; set by submit()
         self.obs = None             # request-tracing context (or None)
+        self.tenant = None          # tenant id (tenancy; set by submit)
+        self.queued_at = time.monotonic()   # per-tenant queue-wait clock
         self.tokens: list[int] = []          # accepted generated tokens
         self.queue: queue.Queue = queue.Queue()
         self.done = threading.Event()
@@ -495,13 +500,25 @@ class PagedKVEngine:
         on-demand when decode allocation needs the page back — a page
         is recycled (int8 scale rows zeroed) only when its refcount
         hits zero.
+    tenancy: optional tenancy.TenantTable (None = disabled, the
+        default, with admission order and shed behavior byte-identical
+        to the pre-tenancy engine). When set, pending admission
+        replaces FIFO with a weighted-fair pick across per-tenant
+        queues (strict priority classes above the fair tiers), so
+        decode slots divide by policy weight under saturation; a
+        tenant past its own `max_queued` sheds with a typed 429
+        (TenantQuotaExceeded); and under global `max_pending`
+        pressure the engine evicts the newest queued request of the
+        tenant most over its weighted fair share instead of shedding
+        a well-behaved newcomer. Per-tenant shares surface in
+        `tenant_snapshot()` and the tenant.* instruments.
     """
 
     def __init__(self, model, *, max_slots=4, page_size=16, num_pages=64,
                  max_pages_per_slot=None, steps_per_tick=4, seed=0,
                  prefill_chunk=None, draft_model=None, spec_tokens=4,
                  dtype=None, max_pending=None, kernel=None,
-                 kv_dtype=None, prefix_cache_pages=0):
+                 kv_dtype=None, prefix_cache_pages=0, tenancy=None):
         cfg = model.config
         self.model = model
         self.max_slots = int(max_slots)
@@ -633,6 +650,20 @@ class PagedKVEngine:
         self._submitted = 0
         self._key = jax.random.key(seed)
         self._ticker = None
+        # multi-tenant QoS (class doc): the WFQ pick + per-tenant
+        # shares; None keeps every scheduling path byte-identical
+        self.tenancy = tenancy
+        self._wfq = (WeightedFairScheduler(tenancy)
+                     if tenancy is not None else None)
+        self._tenant_lock = threading.Lock()
+        self._tenant_stats: dict[str, dict] = {}
+        # incremental per-tenant queued counts (guarded by self._lock):
+        # submit increments, admit/cancel/expire/shed/crash decrement.
+        # The QUOTA check reads this, not len-of-_pending scans — _admit
+        # swaps self._pending out while it prefills (seconds on a first
+        # compile), and a storm submitting into that window must still
+        # count against its bulkhead
+        self._queued_by_tenant: dict[str, int] = {}
         # telemetry for tests / the serving bench
         self.stats = {"ticks": 0, "prefills": 0, "tokens_out": 0,
                       "admitted": 0, "finished": 0, "cancelled": 0,
@@ -713,7 +744,7 @@ class PagedKVEngine:
 
     def submit(self, ids, max_new_tokens=32, *, eos_token_id=None,
                do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-               deadline=None, **_ignored) -> _Request:
+               deadline=None, tenant=None, **_ignored) -> _Request:
         if deadline is not None and deadline.expired():
             raise DeadlineExceeded(
                 "deadline exceeded before engine admission")
@@ -731,6 +762,7 @@ class PagedKVEngine:
         req = _Request(ids, max_new_tokens, eos_token_id, do_sample,
                        temperature, top_k, top_p, pages,
                        deadline=deadline, engine=self)
+        req.tenant = tenant
         # hash the prompt's full pages NOW (caller thread, cheap); the
         # cache LOOKUP happens at admission on the scheduler thread.
         # The last full page is keyed too (it is immutable — decode
@@ -749,6 +781,13 @@ class PagedKVEngine:
             if ctx is None:
                 ctx = obs_requests.register(
                     obs_requests.RequestContext.new())
+            if tenant is not None and ctx.tenant is None:
+                # direct submit() callers attribute here; the serving
+                # layer already stamped HTTP-originated contexts
+                ctx.tenant = tenant
+            if self.tenancy is not None and tenant is not None \
+                    and ctx.tenant_key is None:
+                ctx.tenant_key = self.tenancy.key(tenant)
             ctx.claim_tokens()
             req.obs = ctx
             ctx.record("queued", rid=req.rid)
@@ -760,19 +799,36 @@ class PagedKVEngine:
             ctx.adopt_engine()
         try:
             self._submit_locked(req, pages)
-        except EngineOverloaded:
+        except OverloadError as e:
             if req.obs is not None:
                 # the shed row never entered _pending, so nothing else
                 # will release its ref; for an engine-created or
-                # single-row context this finishes it "shed_engine"
-                # (matching EngineOverloaded.counter, so the HTTP
-                # layer's later finish is an idempotent no-op)
-                req.obs.engine_finish("shed_engine")
+                # single-row context this finishes it with the shed's
+                # own counter ("shed_engine" / "shed_tenant"), so the
+                # HTTP layer's later finish is an idempotent no-op
+                req.obs.engine_finish(e.counter)
             raise
         return req
 
     def _submit_locked(self, req, pages):
         with self._lock:
+            if self.tenancy is not None:
+                # the tenant's OWN pending quota sheds first (typed
+                # 429, bulkhead): its storm must not reach the global
+                # bound other tenants share
+                pol = self.tenancy.policy(req.tenant)
+                tkey = self.tenancy.key(req.tenant)
+                # quota reads the INCREMENTAL counter, not _pending:
+                # _admit swaps _pending out while it prefills, and a
+                # storm submitting into that window must still count
+                if pol.max_queued is not None \
+                        and self._queued_by_tenant.get(tkey, 0) \
+                        >= pol.max_queued:
+                    self.stats["overloaded"] += 1
+                    self._note_tenant_shed(tkey, "queue")
+                    raise TenantQuotaExceeded(
+                        f"tenant {tkey!r} over engine queue quota "
+                        f"({pol.max_queued} pending)", retry_after=0.1)
             if self.max_pending is not None:
                 # shed when the request can neither start NOW (free
                 # slot + page headroom, nothing queued ahead of it)
@@ -785,11 +841,18 @@ class PagedKVEngine:
                     and any(s is None for s in self._slots)
                     and pages <= self.admission_headroom())
                 if not admissible_now and queued >= self.max_pending:
-                    self.stats["overloaded"] += 1
-                    raise EngineOverloaded(
-                        f"engine overloaded: {queued} pending >= "
-                        f"max_pending {self.max_pending} and no "
-                        "admission headroom", retry_after=0.1)
+                    victim = (self._pressure_victim_locked(req)
+                              if self.tenancy is not None else None)
+                    if victim is None:
+                        self.stats["overloaded"] += 1
+                        raise EngineOverloaded(
+                            f"engine overloaded: {queued} pending >= "
+                            f"max_pending {self.max_pending} and no "
+                            "admission headroom", retry_after=0.1)
+                    # pressure eviction prefers the over-share tenant:
+                    # its newest queued request yields the global slot
+                    # to the well-behaved newcomer
+                    self._shed_pending_locked(victim)
             # engine-local index: prefill sampling derives from
             # (engine seed, this index), so two engines with the same
             # seed replay identically regardless of process history
@@ -797,7 +860,83 @@ class PagedKVEngine:
             self._submitted += 1
             self._inflight += 1
             self._pending.append(req)
+            if self.tenancy is not None:
+                k = self.tenancy.key(req.tenant)
+                self._queued_by_tenant[k] = \
+                    self._queued_by_tenant.get(k, 0) + 1
         return req
+
+    def _queued_dec_locked(self, req):
+        """A request left queued-land (admitted / cancelled / expired
+        / shed / crash-doomed). Caller holds self._lock."""
+        if self.tenancy is None:
+            return
+        k = self.tenancy.key(req.tenant)
+        n = self._queued_by_tenant.get(k, 0) - 1
+        if n > 0:
+            self._queued_by_tenant[k] = n
+        else:
+            self._queued_by_tenant.pop(k, None)
+
+    def _pressure_victim_locked(self, req):
+        """Under global max_pending pressure, the queued request to
+        evict in the newcomer's favor: the NEWEST pending request of
+        the tenant most over its weighted fair share of the queue —
+        and only when that tenant's weighted backlog strictly exceeds
+        the newcomer's own (so a storm never evicts itself a slot, and
+        equal-share tenants shed the newcomer as before). None when no
+        such tenant exists. Shares read the incremental queued
+        counter (it also covers requests an in-flight _admit pass is
+        holding), but the victim itself must be CURRENTLY in
+        self._pending — if the over-share tenant's backlog is all
+        mid-admission, there is nothing evictable and the newcomer
+        sheds the classic way."""
+        counts = dict(self._queued_by_tenant)
+        nkey = self.tenancy.key(req.tenant)
+        # weighted backlog the newcomer WOULD have, including itself
+        nshare = (counts.get(nkey, 0) + 1) \
+            / self.tenancy.policy(req.tenant).weight
+        worst = None
+        for k, n in counts.items():
+            if k == nkey:
+                continue
+            share = n / self.tenancy.policy(k).weight
+            if share > nshare and (worst is None or share > worst[1]):
+                worst = (k, share)
+        if worst is None:
+            return None
+        for r in reversed(self._pending):
+            if self.tenancy.key(r.tenant) == worst[0]:
+                return r
+        return None
+
+    def _shed_pending_locked(self, victim):
+        """Evict one queued request under pressure (caller holds the
+        lock): typed retryable error, waiter woken, tracing ref
+        released — exactly the submit-shed contract, applied to a
+        request that was already queued."""
+        self._pending.remove(victim)
+        self._queued_dec_locked(victim)
+        self._inflight -= 1
+        self.stats["overloaded"] += 1
+        self._note_tenant_shed(self.tenancy.key(victim.tenant),
+                               "engine")
+        victim.error = EngineOverloaded(
+            "engine overloaded: evicted from the pending queue under "
+            "pressure (tenant over its weighted fair share)",
+            retry_after=0.1)
+        if victim.obs is not None:
+            victim.obs.engine_finish("shed_engine")
+        victim.queue.put(None)
+        victim.done.set()
+
+    def _note_tenant_shed(self, tkey, reason):
+        with self._tenant_lock:
+            ts = self._tenant_stats.setdefault(
+                tkey, {"admitted": 0, "slot_ticks": 0, "shed": 0})
+            ts["shed"] += 1
+        if observability.ENABLED:
+            observability.inc("tenant.shed", tenant=tkey, reason=reason)
 
     def has_work(self):
         # _inflight counts submit -> retire/drop, so the transient
@@ -978,16 +1117,95 @@ class PagedKVEngine:
                 self._cached_pages.add(slot.pages[j])
         self._evict_prefix_entries(budget_only=True)
 
+    def _admission_order(self, pending):
+        """The order pending requests are considered for admission:
+        arrival (FIFO, byte-identical to the pre-tenancy engine)
+        without a TenantTable; with one, an ITERATIVE weighted-fair
+        pick across per-tenant FIFOs — each pick observes the charges
+        of the admissions made earlier in the same pass, so decode
+        slots divide by policy weight under saturation, with strict
+        priority classes served above the fair tiers."""
+        if self._wfq is None or len(pending) <= 1:
+            return pending
+        queues: dict[str, collections.deque] = {}
+        for r in pending:
+            queues.setdefault(self.tenancy.key(r.tenant),
+                              collections.deque()).append(r)
+
+        def order():
+            while queues:
+                t = self._wfq.pick(queues)
+                q = queues[t]
+                r = q.popleft()
+                if not q:
+                    del queues[t]
+                yield r
+        return order()
+
+    def _note_tenant_admitted(self, req):
+        """Per-tenant accounting + the WFQ stride charge at the moment
+        a request takes a slot (scheduler thread)."""
+        tkey = self.tenancy.key(req.tenant)
+        self._wfq.charge(tkey)
+        with self._tenant_lock:
+            ts = self._tenant_stats.setdefault(
+                tkey, {"admitted": 0, "slot_ticks": 0, "shed": 0})
+            ts["admitted"] += 1
+        if observability.ENABLED:
+            observability.inc("tenant.admitted", tenant=tkey)
+            observability.observe("tenant.queue_wait.seconds",
+                                  time.monotonic() - req.queued_at,
+                                  tenant=tkey)
+
+    def _note_slot_ticks(self, live):
+        """One decode slot-tick per live slot per scheduler tick — the
+        weighted-fair share evidence (`tenant.decode.slots`). Counts
+        aggregate per DISTINCT tenant first so the hot tick path pays
+        one lock pass and one counter inc per tenant, not per slot."""
+        counts: dict[str, int] = {}
+        for i in live:
+            k = self.tenancy.key(self._slots[i].req.tenant)
+            counts[k] = counts.get(k, 0) + 1
+        with self._tenant_lock:
+            for k, n in counts.items():
+                ts = self._tenant_stats.setdefault(
+                    k, {"admitted": 0, "slot_ticks": 0, "shed": 0})
+                ts["slot_ticks"] += n
+        if observability.ENABLED:
+            for k, n in counts.items():
+                observability.inc("tenant.decode.slots", n, tenant=k)
+
+    def tenant_snapshot(self):
+        """Per-tenant engine shares for the serving /stats rows:
+        admissions, decode slot-ticks, sheds, and live pending counts.
+        {} without tenancy."""
+        if self.tenancy is None:
+            return {}
+        with self._lock:
+            # the incremental counter also covers requests an
+            # in-flight _admit pass is holding (self._pending alone
+            # under-reports during a prefill window)
+            pend = dict(self._queued_by_tenant)
+        with self._tenant_lock:
+            out = {k: dict(v) for k, v in self._tenant_stats.items()}
+        for k, n in pend.items():
+            out.setdefault(k, {"admitted": 0, "slot_ticks": 0,
+                               "shed": 0})
+        for k in out:
+            out[k]["pending"] = pend.get(k, 0)
+        return out
+
     def _admit(self):
         with self._lock:
             pending, self._pending = self._pending, []
         requeue = []
         admitted = []
-        for req in pending:
+        for req in self._admission_order(pending):
             if req.cancelled.is_set():
                 self.stats["cancelled"] += 1
                 with self._lock:
                     self._inflight -= 1
+                    self._queued_dec_locked(req)
                 if req.obs is not None:
                     req.obs.engine_finish("cancelled")
                 req.queue.put(None)
@@ -999,6 +1217,7 @@ class PagedKVEngine:
                 self.stats["expired"] += 1
                 with self._lock:
                     self._inflight -= 1
+                    self._queued_dec_locked(req)
                 req.error = DeadlineExceeded(
                     "deadline exceeded while queued for engine "
                     "admission")
@@ -1036,6 +1255,10 @@ class PagedKVEngine:
                 slot.pages.append(p)
             self._alloc_pages(idx, -(-req.prompt.size // self.page_size))
             self.stats["admitted"] += 1
+            if self.tenancy is not None:
+                with self._lock:
+                    self._queued_dec_locked(req)
+                self._note_tenant_admitted(req)
             if req.obs is not None:
                 # rid pairs this row's scheduled with ITS queued event
                 # (per-row queue_wait clock in a shared context)
@@ -1342,6 +1565,8 @@ class PagedKVEngine:
         live = [i for i, s in enumerate(self._slots) if s is not None]
         if not live:
             return False
+        if self.tenancy is not None:
+            self._note_slot_ticks(live)
         if self.draft_model is not None:
             return self._step_spec(live)
         n = self.steps_per_tick
@@ -1489,6 +1714,8 @@ class PagedKVEngine:
                     doomed = self._pending
                     self._pending = []
                     self._inflight -= len(doomed)   # dropped, not retired
+                    for req in doomed:
+                        self._queued_dec_locked(req)
                 for req in doomed:                  # never got a slot
                     req.error = e
                     if req.obs is not None:
@@ -1509,7 +1736,7 @@ class PagedKVEngine:
     def stream(self, input_ids, max_new_tokens=32, *, eos_token_id=None,
                pad_token_id=0, do_sample=False, temperature=1.0,
                top_k=0, top_p=1.0, attention_mask=None, seed=None,
-               deadline=None, **_ignored):
+               deadline=None, tenant=None, **_ignored):
         """generate_stream-compatible surface for PredictorServer: each
         ROW of input_ids becomes an independent engine request (they
         join the continuous batch individually), and the yielded step
@@ -1550,7 +1777,8 @@ class PagedKVEngine:
                     reqs.append(self.submit(
                         r, max_new_tokens, eos_token_id=eos_token_id,
                         do_sample=do_sample, temperature=temperature,
-                        top_k=top_k, top_p=top_p, deadline=deadline))
+                        top_k=top_k, top_p=top_p, deadline=deadline,
+                        tenant=tenant))
             except BaseException:
                 # partial multi-row admission must not leak: whatever a
                 # later row raised (shed, per-row validation), cancel

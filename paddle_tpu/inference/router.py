@@ -131,6 +131,7 @@ from paddle_tpu.inference.overload import (CircuitBreaker,
                                            CircuitOpenError,
                                            jittered_retry_after)
 from paddle_tpu.inference.prefix import chain_keys
+from paddle_tpu.inference.tenancy import resolve_tenant
 from paddle_tpu.observability.metrics import MetricsRegistry
 from paddle_tpu.observability.requests import (parse_traceparent,
                                                safe_request_id)
@@ -138,19 +139,21 @@ from paddle_tpu.observability.requests import (parse_traceparent,
 __all__ = ["ReplicaRouter", "Replica"]
 
 #: replica response headers the router relays back to its client (the
-#: trace-continuity pair, the shed backoff hint, and the body type)
-_ECHO_HEADERS = ("X-Request-Id", "traceparent", "Retry-After",
-                 "Content-Type")
+#: trace-continuity pair, the tenant echo, the shed backoff hint, and
+#: the body type)
+_ECHO_HEADERS = ("X-Request-Id", "traceparent", "X-Tenant-Id",
+                 "Retry-After", "Content-Type")
 
 #: request headers forwarded verbatim to the chosen replica (trace
-#: identity + affinity key; Content-Type is always set). The
-#: X-Timeout-Ms deadline budget is handled separately: the router
-#: DECREMENTS it by the time already burned on failed attempts and
-#: backoff sleeps before each replay — forwarding it verbatim would
-#: restart the client's deadline from zero on every failover. (A
-#: `timeout_ms` BODY field passes through opaque; header wins on the
-#: replica anyway.)
-_FORWARD_HEADERS = ("X-Request-Id", "traceparent", "X-Session-Id")
+#: identity + tenant identity + affinity key; Content-Type is always
+#: set). The X-Timeout-Ms deadline budget is handled separately: the
+#: router DECREMENTS it by the time already burned on failed attempts
+#: and backoff sleeps before each replay — forwarding it verbatim
+#: would restart the client's deadline from zero on every failover.
+#: (A `timeout_ms` BODY field passes through opaque; header wins on
+#: the replica anyway.)
+_FORWARD_HEADERS = ("X-Request-Id", "traceparent", "X-Tenant-Id",
+                    "X-Session-Id")
 
 
 class Replica:
@@ -163,7 +166,8 @@ class Replica:
                  "deprioritized", "reason", "consecutive_ok",
                  "consecutive_fail", "in_flight_router",
                  "probed_in_flight", "probed_queue_depth",
-                 "last_probe_t", "last_stats", "ejections", "served")
+                 "last_probe_t", "last_stats", "ejections", "served",
+                 "tenants")
 
     def __init__(self, rid, url, breaker):
         self.rid = str(rid)
@@ -189,6 +193,9 @@ class Replica:
         self.last_stats = {}            # newest /stats body (flight rec)
         self.ejections = 0
         self.served = 0
+        self.tenants = {}               # tenant -> requests served here
+        #                                 (bounded; overflow folds into
+        #                                 "_other" like the registry)
 
     def load_score(self):
         """Least-loaded ordering key: the router's live in-flight
@@ -222,7 +229,8 @@ class ReplicaRouter:
                  breaker_threshold=3, breaker_reset_s=5.0,
                  retry_after_s=1.0, retry_policy=None, kill_hook=None,
                  metrics=None, prefix_page_size=None,
-                 prefix_capacity=4096, prefix_max_pages=32):
+                 prefix_capacity=4096, prefix_max_pages=32,
+                 tenancy=None):
         self.probe_interval_s = float(probe_interval_s)
         self.probe_timeout_s = float(probe_timeout_s)
         self.forward_timeout_s = float(forward_timeout_s)
@@ -244,6 +252,21 @@ class ReplicaRouter:
         self.metrics = metrics if metrics is not None \
             else MetricsRegistry()
         self._requests = self.metrics.counter("router.requests")
+        # multi-tenant front door (inference/tenancy.py): the router
+        # forwards X-Tenant-Id to the replica either way; with a
+        # TenantTable it ALSO enforces fleet-wide per-tenant rate caps
+        # (policy.rate_limit req/s token bucket) before routing —
+        # over-cap traffic sheds a typed 429 + jittered Retry-After
+        # at the cheapest possible point, never reaching a replica
+        self.tenancy = tenancy
+        if tenancy is not None:
+            from paddle_tpu.inference.tenancy import TenantRateLimiter
+            self._tenant_rl = TenantRateLimiter(tenancy)
+        else:
+            self._tenant_rl = None
+        #: cap on distinct per-replica tenant rows in /debug/replicas
+        #: (overflow folds into "_other", mirroring the registry guard)
+        self._tenant_row_cap = 32
         # prefix-hash routing (module doc): None disables; when set it
         # must equal the replicas' engine page_size or the hashes
         # can't agree with the pages the replicas actually cache
@@ -325,9 +348,16 @@ class ReplicaRouter:
                     except ValueError:
                         pass    # opaque body: the replica will 400 it
                 session = self.headers.get("X-Session-Id")
+                tenant = stamp = None
+                if outer.tenancy is not None:
+                    gate = outer._tenant_gate(self)
+                    if gate is None:
+                        return      # shed; typed 429 already written
+                    tenant, stamp = gate
                 try:
                     outer._route(self, self.path, raw, self.headers,
-                                 stream_req, session, pkeys)
+                                 stream_req, session, pkeys,
+                                 tenant=tenant, stamp=stamp)
                 except Exception as e:      # noqa: BLE001
                     # router-bug backstop: a typed reply (or a closed
                     # socket), never a silently hung client
@@ -687,6 +717,51 @@ class ReplicaRouter:
     def _count(self, outcome):
         self.metrics.inc("router.requests", outcome=outcome)
 
+    def _tenant_gate(self, handler):
+        """Front-door tenant resolution + the fleet-wide rate cap
+        (tenancy configured only). Returns (accounting key, stamp) —
+        `stamp` is the synthetic tenant id the chaos `tenant.storm`
+        site put on an UNLABELED request, which must be FORWARDED so
+        the replica attributes the same request to the same tenant
+        instead of re-rolling chaos independently — or None when the
+        request was shed (the typed, retryable 429 with a jittered
+        Retry-After is already on the wire). The cap fires BEFORE any
+        replica is picked: a tenant storm is contained at the
+        cheapest possible point."""
+        raw = safe_request_id(handler.headers.get("X-Tenant-Id"))
+        tenant = resolve_tenant(handler.headers)
+        stamp = tenant if raw is None and tenant is not None else None
+        tkey = self.tenancy.key(tenant)
+        self.metrics.inc("tenant.requests", outcome="total",
+                         tenant=tkey)
+        ok, hint = self._tenant_rl.allow(tenant)
+        if ok:
+            return tkey, stamp
+        self._count("shed_tenant")
+        self.metrics.inc("tenant.requests", outcome="shed_tenant",
+                         tenant=tkey)
+        self.metrics.inc("tenant.shed", tenant=tkey, reason="rate")
+        ra = jittered_retry_after(hint if hint is not None
+                                  else self.retry_after_s)
+        self._client_write(
+            self._reply_json, handler, 429,
+            {"error": f"tenant {tkey!r} over its fleet-wide rate cap",
+             "reason": "tenant_rate_exceeded", "retryable": True,
+             "retry_after_s": round(ra, 3)},
+            retry_after=ra, echo_headers=handler.headers)
+        return None
+
+    def _note_served(self, r, tenant):
+        """Per-replica served counters (router lock held): total plus
+        the bounded per-tenant breakdown for /debug/replicas."""
+        r.served += 1
+        if tenant is None:
+            return
+        if tenant not in r.tenants \
+                and len(r.tenants) >= self._tenant_row_cap:
+            tenant = "_other"
+        r.tenants[tenant] = r.tenants.get(tenant, 0) + 1
+
     @staticmethod
     def _client_write(fn, *args, **kwargs):
         """Router-origin terminal writes: a client that vanished before
@@ -699,7 +774,7 @@ class ReplicaRouter:
             pass
 
     def _route(self, handler, path, raw, headers, stream_req, session,
-               pkeys=()):
+               pkeys=(), tenant=None, stamp=None):
         """The retry/failover loop around `_forward_once` (module doc:
         shed -> immediate failover, all-shed -> jittered wait honoring
         the Retry-After floor, dead-before-first-byte -> replay, dead
@@ -797,7 +872,7 @@ class ReplicaRouter:
                         f"failure ({r.rid})")
                 verdict = self._forward_once(handler, r, path, raw,
                                              headers, stream_req,
-                                             timeout_hdr)
+                                             timeout_hdr, stamp=stamp)
             except (OSError, http.client.HTTPException) as e:
                 # replica-side death before any response byte: replay
                 # the request against the next replica
@@ -812,7 +887,7 @@ class ReplicaRouter:
             kind = verdict[0]
             if kind == "done":
                 with self._lock:
-                    r.served += 1
+                    self._note_served(r, tenant)
                 self._count(verdict[1])
                 self.metrics.observe("router.forward.seconds",
                                      time.monotonic() - t0)
@@ -835,14 +910,16 @@ class ReplicaRouter:
             self.metrics.inc("router.retries", kind="stream")
 
     def _forward_once(self, handler, r, path, raw, headers, stream_req,
-                      timeout_hdr=None):
+                      timeout_hdr=None, stamp=None):
         """One forward attempt. Returns
         ("done", outcome)                  reply fully written,
         ("shed", hint, status, hdrs, body) replica shed 429/503,
         ("retry_stream", why)              stream failed pre-first-byte;
         raises OSError/HTTPException when the connection itself died
         before a response (the caller replays). `timeout_hdr` is the
-        REMAINING X-Timeout-Ms budget (decremented by the caller)."""
+        REMAINING X-Timeout-Ms budget (decremented by the caller);
+        `stamp` is the chaos-storm tenant id resolved for an unlabeled
+        request (forwarded so router and replica attribute alike)."""
         conn = http.client.HTTPConnection(
             r.host, r.port, timeout=self.forward_timeout_s)
         try:
@@ -852,6 +929,8 @@ class ReplicaRouter:
                 v = headers.get(h)
                 if v:
                     fwd[h] = v
+            if stamp is not None and "X-Tenant-Id" not in fwd:
+                fwd["X-Tenant-Id"] = stamp
             if timeout_hdr is not None:
                 fwd["X-Timeout-Ms"] = timeout_hdr
             conn.request("POST", path, body=raw, headers=fwd)
@@ -994,8 +1073,10 @@ class ReplicaRouter:
     # -- reply plumbing -----------------------------------------------------
     def _echo_identity(self, handler, headers):
         """Router-origin replies still close the trace loop: the
-        sanitized inbound X-Request-Id (the PR 7 injection rules) and
-        the inbound traceparent when it parses."""
+        sanitized inbound X-Request-Id (the PR 7 injection rules),
+        the inbound traceparent when it parses, and the sanitized
+        X-Tenant-Id — a rate-cap 429 the router itself writes must
+        still be attributable to its tenant."""
         rid = safe_request_id(headers.get("X-Request-Id")
                               if headers else None)
         if rid:
@@ -1003,6 +1084,10 @@ class ReplicaRouter:
         tp = headers.get("traceparent") if headers else None
         if tp and parse_traceparent(tp):
             handler.send_header("traceparent", tp)
+        tenant = safe_request_id(headers.get("X-Tenant-Id")
+                                 if headers else None)
+        if tenant:
+            handler.send_header("X-Tenant-Id", tenant)
 
     def _reply_json(self, handler, code, obj, retry_after=None,
                     echo_headers=None):
@@ -1091,6 +1176,7 @@ class ReplicaRouter:
                     "served": r.served,
                     "prefix_hit_rate": self._prefix_hit_rate(
                         r.last_stats),
+                    "tenants": dict(r.tenants),
                 })
             summary = {
                 "total": len(self._order),
@@ -1103,6 +1189,8 @@ class ReplicaRouter:
                                      if r.deprioritized),
                 "sessions": len(self._affinity),
                 "prefix_pins": len(self._prefix),
+                "tenants": len({t for r in self._order
+                                for t in r.tenants}),
             }
         return {"replicas": rows, "summary": summary}
 
@@ -1117,9 +1205,29 @@ class ReplicaRouter:
                 sum(1 for r in self._order if r.in_rotation)
             sessions = len(self._affinity)
             prefix_pins = len(self._prefix)
-        return {"replicas": n, "in_rotation": rot,
-                "sessions": sessions, "prefix_pins": prefix_pins,
-                "requests": counts, "retries": retries}
+        out = {"replicas": n, "in_rotation": rot,
+               "sessions": sessions, "prefix_pins": prefix_pins,
+               "requests": counts, "retries": retries}
+        if self.tenancy is not None:
+            out["tenants"] = self.tenant_stats()
+        return out
+
+    def tenant_stats(self):
+        """Per-tenant router rows (tenancy configured): request and
+        rate-shed counts + the policy's rate cap."""
+        per = {}
+        for k, v in self.metrics.counter("tenant.requests") \
+                .labeled().items():
+            d = dict(k)
+            t = d.get("tenant", "")
+            row = per.setdefault(t, {"requests": 0, "shed": 0})
+            if d.get("outcome") == "total":
+                row["requests"] += v
+            elif d.get("outcome") == "shed_tenant":
+                row["shed"] += v
+        for t, row in per.items():
+            row["rate_limit"] = self.tenancy.policy(t).rate_limit
+        return per
 
     def metrics_text(self):
         from paddle_tpu.observability import REGISTRY

@@ -66,6 +66,19 @@ failures -> fast-fail 503 until a half-open probe recloses it).
 finishes in-flight work, then stops the server. Chaos points
 `serving.admit.delay` / `serving.run.delay` / `serving.run.fail`
 (distributed/chaos.py) drive these paths deterministically in tests.
+
+Multi-tenant QoS (inference/tenancy.py, `tenancy=` TenantTable):
+requests carry a sanitized `X-Tenant-Id` (echoed on every reply);
+each tenant gets an admission quota ON TOP of the global gate
+(over-quota -> typed 429 + jittered Retry-After without touching other
+tenants' budgets), a batcher queue quota, and a weighted-fair share of
+batch/decode service (strict priority classes above the fair tiers).
+Per-tenant rows ride /stats ("tenants") and the tenant.* instruments;
+the `tenant.storm` chaos site stamps unlabeled traffic as a synthetic
+noisy neighbor for the starvation soak. With no table configured,
+scheduling, admission, and shed behavior are byte-identical to the
+pre-tenancy server; tenant ATTRIBUTION alone (the sanitized header
+echo and tracing labels) is always on, like the request-id echo.
 """
 from __future__ import annotations
 
@@ -84,7 +97,10 @@ from paddle_tpu import observability
 from paddle_tpu.inference.overload import (
     AdmissionController, AdmissionRejected, CircuitBreaker, Deadline,
     DeadlineExceeded, OverloadError, ServerDraining,
-    expired as _expired, jittered_retry_after)
+    TenantQuotaExceeded, expired as _expired, jittered_retry_after)
+from paddle_tpu.inference.tenancy import (TenantAdmission,
+                                          WeightedFairScheduler,
+                                          resolve_tenant)
 from paddle_tpu.observability import requests as obs_requests
 from paddle_tpu.observability.metrics import MetricsRegistry
 
@@ -114,9 +130,9 @@ class _StreamAborted(RuntimeError):
 
 class _Pending:
     __slots__ = ("inputs", "n", "event", "result", "error", "deadline",
-                 "ctx")
+                 "ctx", "tenant")
 
-    def __init__(self, inputs, n, deadline=None, ctx=None):
+    def __init__(self, inputs, n, deadline=None, ctx=None, tenant=None):
         self.inputs = inputs            # list of np arrays, fixed order
         self.n = n                      # leading-dim size
         self.event = threading.Event()
@@ -124,6 +140,7 @@ class _Pending:
         self.error = None
         self.deadline = deadline
         self.ctx = ctx                  # request-tracing context (or None)
+        self.tenant = tenant            # accounting key (or None)
 
 
 class DynamicBatcher:
@@ -138,15 +155,31 @@ class DynamicBatcher:
     AdmissionRejected when full), `hard_cap` rejects single requests
     wider than the exported leading dim (OversizedBatch), and a request
     whose `deadline` expires while still buffered is withdrawn with
-    DeadlineExceeded instead of wasting rows of a batch."""
+    DeadlineExceeded instead of wasting rows of a batch.
+
+    Multi-tenant QoS (`tenancy=` TenantTable, inference/tenancy.py):
+    the FIFO pick is replaced with a weighted-fair pick across the
+    tenants currently buffered — the next batch leader comes from the
+    highest-priority, least-served-by-weight tenant (per-tenant FIFO
+    preserved), and every served request charges its tenant's stride.
+    A tenant past its own `max_queued` sheds with a typed 429
+    (TenantQuotaExceeded) while other tenants keep their buffer
+    headroom. Without a table the batcher behaves exactly as before."""
 
     def __init__(self, run_fn, max_batch=8, timeout_ms=5.0, *,
-                 max_queue=None, hard_cap=None):
+                 max_queue=None, hard_cap=None, tenancy=None):
         self.run_fn = run_fn
         self.max_batch = max_batch
         self.timeout = timeout_ms / 1000.0
         self.max_queue = max_queue
         self.hard_cap = hard_cap
+        self.tenancy = tenancy
+        self._wfq = (WeightedFairScheduler(tenancy)
+                     if tenancy is not None else None)
+        # incremental per-tenant buffered counts (guarded by _cv):
+        # the quota check and tenant_queued() read this instead of
+        # O(buffer) scans under the lock on every submit
+        self._tq: dict = {}
         self._buf: collections.deque = collections.deque()
         self._cv = threading.Condition()
         self._stop = False
@@ -154,6 +187,7 @@ class DynamicBatcher:
         self.requests_served = 0
         self.expired_in_queue = 0
         self.shed_full = 0
+        self.shed_tenant = 0
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -161,7 +195,7 @@ class DynamicBatcher:
     def _sig(arrays):
         return tuple((a.shape[1:], str(a.dtype)) for a in arrays)
 
-    def submit(self, arrays, deadline=None):
+    def submit(self, arrays, deadline=None, tenant=None):
         """Blocking: returns the outputs for this request's rows."""
         arrays = [np.asarray(a) for a in arrays]
         if not arrays or any(a.ndim == 0 for a in arrays):
@@ -182,10 +216,27 @@ class DynamicBatcher:
         ctx = obs_requests.current() if observability.ENABLED else None
         if ctx is not None:
             ctx.record("queued")
-        p = _Pending(arrays, rows, deadline, ctx=ctx)
+        tkey = (self.tenancy.key(tenant) if self.tenancy is not None
+                else None)
+        p = _Pending(arrays, rows, deadline, ctx=ctx, tenant=tkey)
         with self._cv:
             if self._stop:
                 raise RuntimeError("DynamicBatcher stopped")
+            if self.tenancy is not None:
+                # the tenant's OWN buffer quota sheds first (typed 429,
+                # bulkhead): a storm filling its lane must not reach
+                # the global full-queue shed other tenants share
+                pol = self.tenancy.policy(tenant)
+                if pol.max_queued is not None \
+                        and self._tq.get(tkey, 0) >= pol.max_queued:
+                    self.shed_tenant += 1
+                    if observability.ENABLED:
+                        observability.inc("tenant.shed", tenant=tkey,
+                                          reason="queue")
+                    raise TenantQuotaExceeded(
+                        f"tenant {tkey!r} over batcher queue quota "
+                        f"({pol.max_queued} buffered)",
+                        retry_after=self.timeout)
             if self.max_queue is not None \
                     and len(self._buf) >= self.max_queue:
                 self.shed_full += 1
@@ -193,6 +244,8 @@ class DynamicBatcher:
                     f"batcher queue full ({self.max_queue} pending)",
                     retry_after=self.timeout)
             self._buf.append(p)
+            if tkey is not None:
+                self._tq[tkey] = self._tq.get(tkey, 0) + 1
             self._cv.notify()
         self._await(p)
         if p.error is not None:
@@ -211,6 +264,7 @@ class DynamicBatcher:
             with self._cv:
                 if p in self._buf:
                     self._buf.remove(p)
+                    self._tq_dec_locked(p)
                     self.expired_in_queue += 1
                     raise DeadlineExceeded(
                         "deadline exceeded while queued for batching")
@@ -224,6 +278,62 @@ class DynamicBatcher:
             "deadline exceeded while queued for batching")
         p.event.set()
 
+    def _tq_dec_locked(self, p):
+        """A request left the buffer (taken / expired / withdrawn).
+        Caller holds the cv; no-op for untracked (tenancy-less)
+        entries."""
+        if p.tenant is None:
+            return
+        n = self._tq.get(p.tenant, 0) - 1
+        if n > 0:
+            self._tq[p.tenant] = n
+        else:
+            self._tq.pop(p.tenant, None)
+
+    def _next_locked(self):
+        """Next buffered request to serve: FIFO head without tenancy;
+        with a TenantTable, the weighted-fair pick across the tenants
+        currently buffered — the chosen tenant's OLDEST request, so
+        per-tenant ordering stays FIFO while tenants interleave by
+        weight/priority instead of arrival."""
+        if self._wfq is None:
+            return self._buf.popleft()
+        firsts = {}
+        for p in self._buf:
+            firsts.setdefault(p.tenant, p)
+        chosen = firsts[self._wfq.pick(firsts)]
+        self._buf.remove(chosen)
+        self._tq_dec_locked(chosen)
+        return chosen
+
+    def _fill_wfq_locked(self, batch, sig, rows):
+        """Tenancy fill (caller holds the cv): reap expired buffered
+        requests, then repeatedly add the WFQ-picked tenant's OLDEST
+        compatible request, charging as each joins — so batch ROWS
+        divide by weight under saturation, not by arrival order (a
+        FIFO fill would hand a flooding tenant every co-traveller
+        slot behind a fair leader). Returns the updated row count."""
+        for p in [q for q in self._buf if _expired(q.deadline)]:
+            self._buf.remove(p)
+            self._tq_dec_locked(p)
+            self._expire_locked(p)      # dead rows get no slot
+        while rows < self.max_batch:
+            firsts: dict = {}
+            for p in self._buf:
+                if p.tenant not in firsts \
+                        and self._sig(p.inputs) == sig \
+                        and rows + p.n <= self.max_batch:
+                    firsts[p.tenant] = p
+            if not firsts:
+                return rows
+            p = firsts[self._wfq.pick(firsts)]
+            self._buf.remove(p)
+            self._tq_dec_locked(p)
+            self._wfq.charge(p.tenant, cost=p.n)
+            batch.append(p)
+            rows += p.n
+        return rows
+
     def _take_batch(self):
         with self._cv:
             first = None
@@ -232,37 +342,51 @@ class DynamicBatcher:
                     self._cv.wait()
                 if self._stop:
                     return []
-                cand = self._buf.popleft()
+                cand = self._next_locked()
                 if _expired(cand.deadline):
                     self._expire_locked(cand)   # dead rows get no slot
                 else:
                     first = cand
+            if self._wfq is not None:
+                # charge service AS it is granted (leader here, fill
+                # members in _fill_wfq_locked), so every later pick
+                # favors the tenants that got less
+                self._wfq.charge(first.tenant, cost=first.n)
         batch = [first]
         sig = self._sig(first.inputs)
         rows = first.n
         deadline = time.monotonic() + self.timeout
         while rows < self.max_batch:
             with self._cv:
-                # pull every compatible pending request
-                keep: collections.deque = collections.deque()
-                while self._buf and rows < self.max_batch:
-                    cand = self._buf.popleft()
-                    if _expired(cand.deadline):
-                        self._expire_locked(cand)
-                    elif self._sig(cand.inputs) == sig \
-                            and rows + cand.n <= self.max_batch:
-                        batch.append(cand)
-                        rows += cand.n
-                    else:
-                        keep.append(cand)
-                keep.extend(self._buf)
-                self._buf = keep
+                if self._wfq is not None:
+                    rows = self._fill_wfq_locked(batch, sig, rows)
+                else:
+                    # pull every compatible pending request (FIFO)
+                    keep: collections.deque = collections.deque()
+                    while self._buf and rows < self.max_batch:
+                        cand = self._buf.popleft()
+                        if _expired(cand.deadline):
+                            self._expire_locked(cand)
+                        elif self._sig(cand.inputs) == sig \
+                                and rows + cand.n <= self.max_batch:
+                            batch.append(cand)
+                            rows += cand.n
+                        else:
+                            keep.append(cand)
+                    keep.extend(self._buf)
+                    self._buf = keep
             remaining = deadline - time.monotonic()
             if remaining <= 0 or rows >= self.max_batch:
                 break
             with self._cv:
                 self._cv.wait(timeout=remaining)
         return batch
+
+    def tenant_queued(self):
+        """{tenant: buffered count} for the /stats per-tenant rows
+        ({} without tenancy) — the incremental counter, O(tenants)."""
+        with self._cv:
+            return dict(self._tq)
 
     def _loop(self):
         from paddle_tpu.distributed import chaos
@@ -311,6 +435,7 @@ class DynamicBatcher:
             self._stop = True
             pending = list(self._buf)
             self._buf.clear()
+            self._tq.clear()
             self._cv.notify_all()
         # callers blocked in submit() must not hang across shutdown
         for p in pending:
@@ -374,13 +499,21 @@ class PredictorServer:
                  *, max_concurrent=32, max_queue_depth=64,
                  default_timeout_ms=None, breaker_threshold=5,
                  breaker_reset_s=5.0, retry_after_s=1.0, metrics=None,
-                 fleet=None):
+                 fleet=None, tenancy=None):
         self.predictor = predictor
         self.model_name = model_name
         self.generator = generator
         # optional observability.fleet.FleetAggregator: GET /debug/fleet
         # then serves a live cross-rank heartbeat scan from this replica
         self.fleet = fleet
+        # optional tenancy.TenantTable: per-tenant admission quotas on
+        # top of the global gate, weighted-fair batching, per-tenant
+        # /stats rows and tenant.* instruments. None (the default)
+        # keeps every path byte-identical to the pre-tenancy server.
+        self.tenancy = tenancy
+        self.tenants = (TenantAdmission(tenancy,
+                                        retry_after_s=retry_after_s)
+                        if tenancy is not None else None)
         self._lock = threading.Lock()
         self.default_timeout_ms = default_timeout_ms
         self.admission = AdmissionController(
@@ -412,7 +545,7 @@ class PredictorServer:
             self.batcher = DynamicBatcher(
                 self._run_locked, max_batch=max_batch_size,
                 timeout_ms=batch_timeout_ms, max_queue=max_queue_depth,
-                hard_cap=hard_cap)
+                hard_cap=hard_cap, tenancy=tenancy)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -427,11 +560,18 @@ class PredictorServer:
             def _echo_trace_headers(self):
                 """X-Request-Id / traceparent on every reply of a
                 traced request (the propagation contract: the caller's
-                trace id comes back, our span id is the new parent)."""
+                trace id comes back, our span id is the new parent);
+                X-Tenant-Id echoed whenever the request resolved to a
+                tenant (sanitized on the way in — and independent of
+                observability, so attribution survives the router hop
+                even on an un-traced fleet)."""
                 ctx = getattr(self, "_obs_ctx", None)
                 if ctx is not None:
                     self.send_header("X-Request-Id", ctx.request_id)
                     self.send_header("traceparent", ctx.traceparent())
+                tenant = getattr(self, "_tenant", None)
+                if tenant is not None:
+                    self.send_header("X-Tenant-Id", tenant)
 
             def _reply(self, code, obj, retry_after=None,
                        jittered=False):
@@ -502,6 +642,7 @@ class PredictorServer:
                 # keep-alive: one Handler serves several requests on a
                 # connection — a stale traced POST must not echo here
                 self._obs_ctx = None
+                self._tenant = None
                 if self.path in ("/health", "/healthz"):
                     # liveness only: the process is up and serving HTTP.
                     # Whether it should RECEIVE traffic is /readyz.
@@ -547,9 +688,14 @@ class PredictorServer:
 
             def do_POST(self):
                 self._obs_ctx = None        # keep-alive: no stale echo
+                self._tenant = None
                 if self.path not in ("/predict", "/generate"):
                     return self._reply(404, {"error": "unknown path"})
-                outer._count("total")
+                # tenant identity: sanitized X-Tenant-Id, or the chaos
+                # storm stamp for unlabeled traffic (tenancy module doc)
+                tenant = resolve_tenant(self.headers)
+                self._tenant = tenant
+                outer._count("total", tenant)
                 ctx = cv_token = None
                 if observability.ENABLED:
                     # one request context per POST: trace identity from
@@ -557,6 +703,12 @@ class PredictorServer:
                     # contextvar so the batcher/engine layers see it
                     ctx = obs_requests.RequestContext.from_headers(
                         self.headers)
+                    if ctx.tenant != tenant:
+                        ctx.tenant = tenant     # chaos storm stamp
+                    if outer.tenancy is not None and tenant is not None:
+                        # outcome metrics label with the bounded
+                        # accounting key; /debug/requests keeps raw
+                        ctx.tenant_key = outer.tenancy.key(tenant)
                     obs_requests.register(ctx)
                     self._obs_ctx = ctx
                     cv_token = obs_requests.set_current(ctx)
@@ -569,11 +721,12 @@ class PredictorServer:
                                 "request body must be a JSON object")
                         deadline = outer._request_deadline(req,
                                                            self.headers)
-                        with outer._admit(deadline):
+                        with outer._admit(deadline, tenant):
                             if self.path == "/generate":
                                 stream = bool(req.pop("stream", False))
                                 it = outer.generate_steps(
-                                    req, deadline=deadline)
+                                    req, deadline=deadline,
+                                    tenant=tenant)
                                 if stream:
                                     # pull the first item BEFORE sending
                                     # the 200 header so request errors
@@ -587,11 +740,11 @@ class PredictorServer:
                                     if exc is not None:
                                         raise _StreamAborted(str(exc)) \
                                             from exc
-                                    outer._count("ok")
+                                    outer._count("ok", tenant)
                                     outer._finish_request(ctx, "ok")
                                     return
                                 steps = [o for o in it if "tokens" in o]
-                                outer._count("ok")
+                                outer._count("ok", tenant)
                                 outer._finish_request(ctx, "ok")
                                 return self._reply(200, {
                                     "sequences": [
@@ -600,28 +753,29 @@ class PredictorServer:
                                         range(len(steps[0]["tokens"]))]
                                     if steps else []})
                             out = outer.predict(req.get("inputs", {}),
-                                                deadline=deadline)
-                            outer._count("ok")
+                                                deadline=deadline,
+                                                tenant=tenant)
+                            outer._count("ok", tenant)
                             outer._finish_request(ctx, "ok")
                             return self._reply(200, {"outputs": out})
                     except _StreamAborted:
                         # the 200 + error chunk are already on the wire;
                         # no reply possible, but _admit recorded the
                         # breaker failure on the way here
-                        outer._count("server_error")
+                        outer._count("server_error", tenant)
                         outer._finish_request(ctx, "server_error")
                         return
                     except OverloadError as e:
-                        outer._count(e.counter)
+                        outer._count(e.counter, tenant)
                         outer._finish_request(ctx, e.counter)
                         return self._reply(e.status, {"error": str(e)},
                                            retry_after=e.retry_after)
                     except outer._CLIENT_ERRORS as e:
-                        outer._count("client_error")
+                        outer._count("client_error", tenant)
                         outer._finish_request(ctx, "client_error")
                         return self._reply(400, {"error": str(e)})
                     except Exception as e:      # noqa: BLE001
-                        outer._count("server_error")
+                        outer._count("server_error", tenant)
                         outer._finish_request(ctx, "server_error")
                         return self._reply(500, {"error": str(e)})
                 finally:
@@ -637,8 +791,14 @@ class PredictorServer:
         self._thread = None
 
     # -- overload gate ------------------------------------------------------
-    def _count(self, key):
+    def _count(self, key, tenant=None):
         self.metrics.inc("serving.requests", outcome=key)
+        if self.tenancy is not None:
+            # per-tenant twin of the outcome counter; unlabeled
+            # traffic accounts under the default tenant so a
+            # label-less storm is still visible per-tenant
+            self.metrics.inc("tenant.requests", outcome=key,
+                             tenant=self.tenancy.key(tenant))
 
     @staticmethod
     def _finish_request(ctx, reason):
@@ -691,11 +851,15 @@ class PredictorServer:
         return Deadline.after_ms(ms)
 
     @contextlib.contextmanager
-    def _admit(self, deadline):
+    def _admit(self, deadline, tenant=None):
         """Admission front half (shed cheaply, in order: draining ->
-        expired -> capacity -> breaker) + outcome back half (breaker
-        record, latency). Control-plane rejections (OverloadError) and
-        client errors never count as backend failures."""
+        expired -> tenant quota -> capacity -> breaker) + outcome back
+        half (breaker record, latency). The per-tenant quota runs
+        BEFORE the global gate: an over-quota tenant's shed (typed
+        429) never consumes a global slot, so other tenants' budgets
+        are untouched by its storm. Control-plane rejections
+        (OverloadError) and client errors never count as backend
+        failures."""
         from paddle_tpu.distributed import chaos
         if chaos.ENABLED:
             chaos.maybe_delay("serving.admit.delay")
@@ -704,11 +868,26 @@ class PredictorServer:
                                  retry_after=self.retry_after_s)
         if deadline is not None:
             deadline.check("before admission")
-        self.admission.try_acquire()
+        if self.tenants is not None:
+            try:
+                self.tenants.try_acquire(tenant)
+            except TenantQuotaExceeded:
+                if observability.ENABLED:
+                    observability.inc("tenant.shed", reason="admission",
+                                      tenant=self.tenancy.key(tenant))
+                raise
         try:
-            self.breaker.allow()
+            self.admission.try_acquire()
+            try:
+                self.breaker.allow()
+            except BaseException:
+                self.admission.release()
+                raise
         except BaseException:
-            self.admission.release()
+            if self.tenants is not None:
+                # shed by a LATER gate: the tenant's admitted count
+                # must not include a request that never ran
+                self.tenants.rollback(tenant)
             raise
         if observability.ENABLED:
             ctx = obs_requests.current()
@@ -736,6 +915,8 @@ class PredictorServer:
             self.latency.record(time.monotonic() - t0)
         finally:
             self.admission.release()
+            if self.tenants is not None:
+                self.tenants.release(tenant)
 
     @staticmethod
     def _chaos_run_gate():
@@ -778,7 +959,8 @@ class PredictorServer:
                 "requests_served": self.batcher.requests_served,
                 "queued": len(self.batcher._buf),
                 "expired_in_queue": self.batcher.expired_in_queue,
-                "shed_full": self.batcher.shed_full}
+                "shed_full": self.batcher.shed_full,
+                "shed_tenant": self.batcher.shed_tenant}
         g = self.generator
         if g is not None and hasattr(g, "prefix_stats"):
             # the engine's prefix-cache hit stats (PagedKVEngine with
@@ -787,6 +969,30 @@ class PredictorServer:
             p = g.prefix_stats()
             if p is not None:
                 out["prefix"] = p
+        if self.tenancy is not None:
+            out["tenants"] = self.tenant_stats()
+        return out
+
+    def tenant_stats(self):
+        """Per-tenant /stats rows (tenancy configured): policy knobs,
+        live admission counts, batcher queue depth, and the engine's
+        per-tenant shares when the generator reports them."""
+        adm = self.tenants.snapshot()
+        queued = (self.batcher.tenant_queued()
+                  if self.batcher is not None else {})
+        g = self.generator
+        eng = (g.tenant_snapshot()
+               if g is not None and hasattr(g, "tenant_snapshot")
+               else {})
+        out = {}
+        for t in sorted(set(adm) | set(queued) | set(eng)):
+            row = dict(adm.get(t)
+                       or {"in_flight": 0, "admitted": 0, "shed": 0})
+            row["queued"] = queued.get(t, 0)
+            row["policy"] = self.tenancy.policy(t).describe()
+            if t in eng:
+                row["engine"] = eng[t]
+            out[t] = row
         return out
 
     def metrics_text(self):
@@ -819,6 +1025,12 @@ class PredictorServer:
                         self.batcher.expired_in_queue)
             m.set_gauge("serving.batcher.shed_full",
                         self.batcher.shed_full)
+            m.set_gauge("serving.batcher.shed_tenant",
+                        self.batcher.shed_tenant)
+        if self.tenants is not None:
+            for t, row in self.tenants.snapshot().items():
+                m.set_gauge("tenant.in_flight", row["in_flight"],
+                            tenant=t)
         g = self.generator
         if g is not None and hasattr(g, "export_metrics"):
             g.export_metrics(m)
@@ -837,7 +1049,7 @@ class PredictorServer:
                    "pad_token_id", "do_sample", "temperature", "top_k",
                    "top_p", "seed", "tokens_per_fetch")
 
-    def generate_steps(self, req, deadline=None):
+    def generate_steps(self, req, deadline=None, tenant=None):
         """Yield {"step": i, "tokens": [...]} per generated position,
         then {"done": True, "steps": n}.
 
@@ -863,6 +1075,14 @@ class PredictorServer:
                     and getattr(g, "concurrent_safe", False):
                 # the paged engine's admission understands deadlines
                 kw["deadline"] = deadline
+            if tenant is not None \
+                    and getattr(g, "concurrent_safe", False):
+                # attribution rides into the ENGINE's per-request
+                # bookkeeping (stream() forwards it to submit());
+                # gated like `deadline` above — bundle predictors'
+                # stream() takes no tenant kwarg, and a labeled
+                # request must not 500 on them
+                kw["tenant"] = tenant
             it = g.stream(ids, **kw)
         else:
             from paddle_tpu.models.generation import generate_stream
@@ -1008,12 +1228,13 @@ class PredictorServer:
             arrays.append(self._decode(v))
         return arrays
 
-    def predict(self, inputs: dict, deadline=None) -> dict:
+    def predict(self, inputs: dict, deadline=None, tenant=None) -> dict:
         p = self.predictor
         if self.batcher is not None and hasattr(p, "get_input_names"):
             arrays = self._resolve_inputs(p.get_input_names(), inputs)
             try:
-                outs = self.batcher.submit(arrays, deadline=deadline)
+                outs = self.batcher.submit(arrays, deadline=deadline,
+                                           tenant=tenant)
             except OversizedBatch:
                 raise       # a solo run hits the same exported-dim wall
             except UnbatchableRequest:

@@ -26,7 +26,7 @@ import threading
 
 __all__ = ["METRICS", "Counter", "Gauge", "Histogram",
            "MetricsRegistry", "REGISTRY", "DEFAULT_BUCKETS_MS",
-           "DEFAULT_BUCKETS_S"]
+           "DEFAULT_BUCKETS_S", "DEFAULT_MAX_LABEL_VALUES"]
 
 # latency-ish defaults; histograms may override via the catalogue
 DEFAULT_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
@@ -159,6 +159,41 @@ METRICS = {
                                          "buffered"),
     "serving.batcher.shed_full": ("gauge",
                                   "requests shed on a full buffer"),
+    "serving.batcher.shed_tenant": ("gauge",
+                                    "requests shed on a per-tenant "
+                                    "buffer quota (scraped)"),
+    # -- multi-tenant QoS (inference/tenancy.py) ----------------------
+    "tenant.requests": ("counter",
+                        "served-layer requests by tenant and outcome "
+                        "(labels: tenant, outcome — the serving /stats "
+                        "outcome keys)"),
+    "tenant.shed": ("counter",
+                    "tenant-quota sheds (labels: tenant, reason = "
+                    "admission | queue | engine | rate)"),
+    "tenant.admitted": ("counter",
+                        "engine slot admissions by tenant (label: "
+                        "tenant)"),
+    "tenant.decode.slots": ("counter",
+                            "decode slot-ticks by tenant — one count "
+                            "per live slot per scheduler tick, the "
+                            "weighted-fair share evidence (label: "
+                            "tenant)"),
+    "tenant.queue_wait.seconds": ("histogram",
+                                  "engine admission queue wait by "
+                                  "tenant (label: tenant) — the "
+                                  "starvation-soak SLO",
+                                  DEFAULT_BUCKETS_S),
+    "tenant.in_flight": ("gauge",
+                         "admitted requests in flight by tenant "
+                         "(label: tenant, scraped)"),
+    # -- registry self-protection -------------------------------------
+    "metrics.labels.dropped": ("counter",
+                               "label values folded into the literal "
+                               "\"_other\" cell because an instrument "
+                               "hit its distinct-label-value bound "
+                               "(label: metric) — a tenant-id flood "
+                               "must not grow the registry without "
+                               "bound"),
     # -- per-request serving SLOs (observability/requests.py) ---------
     "request.ttft.seconds": ("histogram",
                              "time to first generated token, from "
@@ -220,9 +255,10 @@ METRICS = {
     # -- replica fleet router (inference/router.py) -------------------
     "router.requests": ("counter",
                         "routed requests by outcome (label: outcome = "
-                        "ok | shed_upstream | no_replicas | failed | "
-                        "deadline_exceeded | client_error | "
-                        "server_error | stream_error | disconnected)"),
+                        "ok | shed_upstream | shed_tenant | "
+                        "no_replicas | failed | deadline_exceeded | "
+                        "client_error | server_error | stream_error | "
+                        "disconnected)"),
     "router.retries": ("counter",
                        "failover retries (label: kind = shed | "
                        "connect | stream)"),
@@ -305,21 +341,64 @@ def _label_key(labels: dict) -> tuple:
     return tuple(sorted(labels.items()))
 
 
+#: default bound on DISTINCT values per label key per instrument; the
+#: overflow folds into the literal "_other" cell (guard rationale in
+#: _Instrument._norm_record_locked)
+DEFAULT_MAX_LABEL_VALUES = 64
+
+
+def _note_dropped(name, n):
+    """Count label-value folds into the process registry. The guard's
+    own counter is exempt (its `metric` label is bounded by the
+    catalogue, and exempting it breaks the recursion by construction)."""
+    if name == "metrics.labels.dropped":
+        return
+    REGISTRY.inc("metrics.labels.dropped", n, metric=name)
+
+
 class _Instrument:
     """Base: per-label-set cells guarded by one lock. Label VALUES are
-    free-form (low cardinality by convention); label keys+values are
-    stringified at record time."""
+    free-form but BOUNDED: past `max_label_values` distinct values per
+    label key, new values fold into the literal "_other" cell and the
+    `metrics.labels.dropped` counter records the fold — an unbounded
+    id flood (e.g. 10k distinct tenant ids) must not grow the registry
+    (and every /metrics scrape body) without bound. Label keys+values
+    are stringified at record time."""
 
     kind = "untyped"
 
-    def __init__(self, name, help=""):
+    def __init__(self, name, help="",
+                 max_label_values=DEFAULT_MAX_LABEL_VALUES):
         self.name = name
         self.help = help
         self._lock = threading.Lock()
         self._cells: dict = {}
+        self._max_label_values = int(max_label_values)
+        self._label_vals: dict = {}         # label key -> seen values
 
     def _norm(self, labels):
+        """READ-side normalization: no guard, no mutation — a lookup
+        of a never-recorded value must not consume cardinality budget
+        (it just misses, or hits "_other" if writes folded)."""
         return _label_key({str(k): str(v) for k, v in labels.items()})
+
+    def _norm_record_locked(self, labels):
+        """WRITE-side normalization (caller holds self._lock): returns
+        (cell key, values folded). A label value past the per-key
+        distinct bound becomes "_other"."""
+        dropped = 0
+        out = {}
+        for k, v in labels.items():
+            k, v = str(k), str(v)
+            vals = self._label_vals.setdefault(k, set())
+            if v not in vals:
+                if len(vals) >= self._max_label_values:
+                    dropped += 1
+                    v = "_other"
+                else:
+                    vals.add(v)
+            out[k] = v
+        return _label_key(out), dropped
 
     def labeled(self) -> dict:
         """{label_key_tuple: value} snapshot."""
@@ -333,9 +412,11 @@ class Counter(_Instrument):
     def inc(self, n=1, **labels):
         if n < 0:
             raise ValueError(f"counter {self.name} cannot decrease")
-        key = self._norm(labels)
         with self._lock:
+            key, dropped = self._norm_record_locked(labels)
             self._cells[key] = self._cells.get(key, 0) + n
+        if dropped:
+            _note_dropped(self.name, dropped)
 
     def value(self, **labels):
         with self._lock:
@@ -346,9 +427,11 @@ class Gauge(_Instrument):
     kind = "gauge"
 
     def set(self, v, **labels):
-        key = self._norm(labels)
         with self._lock:
+            key, dropped = self._norm_record_locked(labels)
             self._cells[key] = float(v)
+        if dropped:
+            _note_dropped(self.name, dropped)
 
     def value(self, **labels):
         with self._lock:
@@ -376,15 +459,16 @@ class Histogram(_Instrument):
     kind = "histogram"
 
     def __init__(self, name, help="", buckets=DEFAULT_BUCKETS_MS,
-                 ring_capacity=512):
-        super().__init__(name, help)
+                 ring_capacity=512,
+                 max_label_values=DEFAULT_MAX_LABEL_VALUES):
+        super().__init__(name, help, max_label_values=max_label_values)
         self.buckets = tuple(sorted(float(b) for b in buckets))
         self.ring_capacity = int(ring_capacity)
 
     def observe(self, v, **labels):
         v = float(v)
-        key = self._norm(labels)
         with self._lock:
+            key, dropped = self._norm_record_locked(labels)
             cell = self._cells.get(key)
             if cell is None:
                 cell = self._cells[key] = _HistCell(
@@ -399,6 +483,8 @@ class Histogram(_Instrument):
             cell.count += 1
             cell.ring[cell.ring_idx % self.ring_capacity] = v
             cell.ring_idx += 1
+        if dropped:
+            _note_dropped(self.name, dropped)
 
     def labeled(self) -> dict:
         """Consistent per-cell copies: exporters read counts/sum/count
@@ -442,10 +528,12 @@ class MetricsRegistry:
     names raise — the catalogue, not the call site, is the source of
     truth for what exists."""
 
-    def __init__(self, catalogue=None):
+    def __init__(self, catalogue=None,
+                 max_label_values=DEFAULT_MAX_LABEL_VALUES):
         self._catalogue = catalogue if catalogue is not None else METRICS
         self._lock = threading.Lock()
         self._metrics: dict = {}
+        self._max_label_values = int(max_label_values)
 
     # -- acquisition --------------------------------------------------
     def _get(self, name, expect_kind):
@@ -462,14 +550,16 @@ class MetricsRegistry:
             m = self._metrics.get(name)
             if m is None:
                 help_ = spec[1] if len(spec) > 1 else ""
+                mlv = self._max_label_values
                 if kind == "counter":
-                    m = Counter(name, help_)
+                    m = Counter(name, help_, max_label_values=mlv)
                 elif kind == "gauge":
-                    m = Gauge(name, help_)
+                    m = Gauge(name, help_, max_label_values=mlv)
                 else:
                     buckets = (spec[2] if len(spec) > 2
                                else DEFAULT_BUCKETS_MS)
-                    m = Histogram(name, help_, buckets)
+                    m = Histogram(name, help_, buckets,
+                                  max_label_values=mlv)
                 self._metrics[name] = m
             return m
 

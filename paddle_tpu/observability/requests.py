@@ -229,14 +229,26 @@ class RequestContext:
     context."""
 
     __slots__ = ("request_id", "trace_id", "parent_id", "span_id",
-                 "flags", "t0", "events", "tokens", "dropped_events",
-                 "tokens_claimed", "outcome", "finish_t", "_lock",
-                 "_queued_t", "_prefill_t", "_last_emit", "_live_key",
+                 "flags", "tenant", "tenant_key", "t0", "events",
+                 "tokens", "dropped_events", "tokens_claimed",
+                 "outcome", "finish_t", "_lock", "_queued_t",
+                 "_prefill_t", "_last_emit", "_live_key",
                  "_engine_refs", "_engine_reason")
 
     def __init__(self, request_id=None, trace_id=None, parent_id=None,
-                 flags=1):
+                 flags=1, tenant=None):
         self.request_id = request_id or "req-" + secrets.token_hex(8)
+        # tenant attribution (inference/tenancy.py): sanitized
+        # X-Tenant-Id, or None for unlabeled traffic. Serving may
+        # override after chaos-storm stamping (tenancy.resolve_tenant).
+        # `tenant_key` is the TenantTable accounting key a tenancy-
+        # configured layer sets beside it: the outcome METRIC labels
+        # with the key (bounded by the configured tenant set) while
+        # /debug/requests keeps the raw id — 64 junk header values
+        # must not exhaust request.outcome's label budget and fold
+        # real tenants into "_other" forever.
+        self.tenant = tenant
+        self.tenant_key = None
         self.trace_id = trace_id or secrets.token_hex(16)
         self.parent_id = parent_id          # inbound caller's span id
         self.span_id = secrets.token_hex(8)  # OUR span within the trace
@@ -274,11 +286,14 @@ class RequestContext:
         get = headers.get if headers is not None else (lambda k: None)
         parsed = parse_traceparent(get("traceparent"))
         rid = _safe_request_id(get("X-Request-Id"))
+        # same sanitization rules as the request id: the tenant id is
+        # echoed on replies and rides the router hop as a header
+        tenant = _safe_request_id(get("X-Tenant-Id"))
         if parsed is None:
-            return cls(request_id=rid)
+            return cls(request_id=rid, tenant=tenant)
         trace_id, parent_id, flags = parsed
         return cls(request_id=rid, trace_id=trace_id,
-                   parent_id=parent_id, flags=flags)
+                   parent_id=parent_id, flags=flags, tenant=tenant)
 
     def traceparent(self) -> str:
         """The outbound `traceparent` header value: same trace id, OUR
@@ -415,7 +430,18 @@ class RequestContext:
             # need it, and it is one element past the bound
             self.events.append((_terminal_event(self.outcome), t, None))
         REGISTRY.observe("request.tokens", self.tokens)
-        REGISTRY.inc("request.outcome", reason=self.outcome)
+        if self.tenant_key is not None:
+            # tenant-labeled outcome ONLY via the ACCOUNTING KEY a
+            # tenancy-configured layer assigned (bounded by the
+            # configured tenant set). The raw header id is never a
+            # label: in attribution-only mode (no TenantTable) 64
+            # junk ids would otherwise exhaust this instrument's
+            # label budget and fold every real tenant into "_other"
+            # forever — raw ids stay on the echo and /debug/requests.
+            REGISTRY.inc("request.outcome", reason=self.outcome,
+                         tenant=self.tenant_key)
+        else:
+            REGISTRY.inc("request.outcome", reason=self.outcome)
         self._maybe_dump_exemplar()
         _unregister(self)
         return True
@@ -442,6 +468,7 @@ class RequestContext:
             stage = self.events[-1][0] if self.events else "created"
         return {"request_id": self.request_id,
                 "trace_id": self.trace_id,
+                "tenant": self.tenant,
                 "stage": stage,
                 "age_s": round(self.age_s(), 6),
                 "tokens": self.tokens}

@@ -558,7 +558,10 @@ class Trainer:
         cache_size = getattr(fn, "_cache_size", None)
         try:
             return int(cache_size()) if cache_size is not None else 0
-        except Exception:  # lint: disable=silent-swallow -- a private jax API probe; attribution degrades, the step must not
+        except Exception:
+            # a private jax API probe; attribution degrades, the step
+            # must not (a `return` body is not a silent swallow, so no
+            # suppression is needed)
             return 0
 
     @staticmethod

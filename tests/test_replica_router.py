@@ -165,6 +165,10 @@ def test_basic_routing_and_readyz():
         code, body, hdrs = _req(router.port, "/predict", _BODY)
         assert code == 200 and "outputs" in body
         assert hdrs.get("X-Routed-To") in ("r0", "r1")
+        # the outcome is counted AFTER the reply relays — wait for it
+        # instead of racing the forwarding thread
+        _wait_for(lambda: router.stats()["requests"].get("ok") == 1,
+                  what="ok outcome counted")
         st = router.stats()
         assert st["requests"]["ok"] == 1 and st["in_rotation"] == 2
     finally:
@@ -656,7 +660,8 @@ def test_debug_replicas_schema_and_stats_queue_depth():
         assert code == 200
         assert view["summary"] == {"total": 2, "in_rotation": 2,
                                    "ejected": 0, "deprioritized": 0,
-                                   "sessions": 0, "prefix_pins": 0}
+                                   "sessions": 0, "prefix_pins": 0,
+                                   "tenants": 0}
         row = view["replicas"][0]
         for key in ("id", "url", "in_rotation", "deprioritized",
                     "reason", "consecutive_ok", "consecutive_fail",

@@ -182,6 +182,10 @@ def test_breaker_opens_fast_fails_and_recloses():
                                   {"inputs": _ONE_ROW})
             assert code == 200 and pred.calls == 1
 
+        # the reclose is recorded AFTER the probe's 200 is written —
+        # wait for it instead of racing the handler thread
+        _wait_for(lambda: srv.breaker.state == CircuitBreaker.CLOSED,
+                  what="breaker reclose")
         code, body, _h = _req(srv.port, "/readyz")
         assert code == 200 and body["status"] == "ready"
         st = srv.stats()
@@ -439,6 +443,11 @@ def test_healthz_readyz_stats_surfaces():
 
         code, _b, _h = _req(srv.port, "/predict", {"inputs": _ONE_ROW})
         assert code == 200
+        # the 200 is written INSIDE the admission scope, so the
+        # release lands just after the client's read returns — wait
+        # for it instead of racing the handler thread
+        _wait_for(lambda: srv.admission.in_flight == 0,
+                  what="admission released")
         code, st, _h = _req(srv.port, "/stats")
         assert code == 200
         assert st["requests"]["total"] == 1
@@ -525,8 +534,12 @@ def test_mid_stream_backend_failure_reaches_the_breaker():
             # ...but the failure rode the stream as an error chunk
             assert "backend died mid-stream" in text
         # and counted against the breaker: two mid-stream deaths with
-        # threshold 2 -> open, next request fast-fails
-        assert srv.breaker.state == CircuitBreaker.OPEN
+        # threshold 2 -> open, next request fast-fails. The failure is
+        # recorded AFTER the terminal chunk reaches the client (the
+        # _StreamAborted unwinds through _admit once _stream_reply
+        # returns), so wait for the trip instead of racing the handler
+        _wait_for(lambda: srv.breaker.state == CircuitBreaker.OPEN,
+                  what="breaker trip")
         code, body, _h = _req(srv.port, "/predict", {"inputs": _ONE_ROW})
         assert code == 503 and "circuit breaker" in body["error"]
         assert srv.stats()["requests"]["server_error"] == 2
@@ -711,6 +724,10 @@ def test_metrics_endpoint_prometheus_text():
                           generator=FakeEngine()).start()
     try:
         _req(srv.port, "/predict", {"inputs": {"x": [[1.0, 2.0]]}})
+        # latency lands AFTER the 200 is written (the _admit scope's
+        # success epilogue): wait for it instead of racing the scrape
+        _wait_for(lambda: srv.latency.snapshot()["count"] == 1,
+                  what="latency recorded")
         url = f"http://127.0.0.1:{srv.port}/metrics"
         with urllib.request.urlopen(url, timeout=30) as resp:
             assert resp.status == 200

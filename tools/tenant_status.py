@@ -1,0 +1,108 @@
+"""Render a serving replica's (or router's) per-tenant QoS view.
+
+    python -m tools.tenant_status http://127.0.0.1:8866 [--json]
+
+Fetches `GET /stats` from a `PredictorServer` replica or a
+`ReplicaRouter` configured with a `tenancy=` TenantTable and prints
+the per-tenant rows — policy knobs (quotas / weight / priority / rate
+cap), live in-flight and queued counts, admission/shed totals, and the
+engine's decode slot-tick shares — the operator's one-glance answer to
+"which tenant is eating the fleet" and "is the noisy neighbor actually
+contained". `--json` dumps the raw tenants block instead (for
+scripts).
+
+Stdlib-only (no jax, no paddle_tpu import): this runs on any box that
+can reach the server.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+__all__ = ["fetch", "render", "main"]
+
+
+def fetch(base_url, timeout=5.0) -> dict:
+    """The /stats document from a live server/router."""
+    base = base_url.rstrip("/")
+    if not base.startswith("http"):
+        base = "http://" + base
+    with urllib.request.urlopen(base + "/stats",
+                                timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.3g}"
+    return str(v)
+
+
+def render(doc) -> str:
+    """The /stats `tenants` block as an aligned table. Tolerates both
+    shapes: the serving replica's rows (policy/in_flight/queued/
+    engine) and the router's rows (requests/shed/rate_limit). A
+    document without a tenants block renders a one-line notice (the
+    server has no TenantTable configured)."""
+    tenants = doc.get("tenants") if isinstance(doc, dict) else None
+    if not isinstance(tenants, dict) or not tenants:
+        return "no per-tenant stats (server has no tenancy configured)"
+    cols = ["tenant", "inflight", "quota", "queued", "qquota",
+            "weight", "prio", "rate", "admitted", "shed", "requests",
+            "slot_ticks", "pending"]
+    table = [cols]
+    for t in sorted(tenants):
+        row = tenants[t] if isinstance(tenants[t], dict) else {}
+        pol = row.get("policy") or {}
+        eng = row.get("engine") or {}
+        table.append([
+            t,
+            _fmt(row.get("in_flight")),
+            _fmt(pol.get("max_in_flight")),
+            _fmt(row.get("queued")),
+            _fmt(pol.get("max_queued")),
+            _fmt(pol.get("weight")),
+            _fmt(pol.get("priority")),
+            _fmt(pol.get("rate_limit", row.get("rate_limit"))),
+            _fmt(row.get("admitted")),
+            _fmt(row.get("shed")),
+            _fmt(row.get("requests")),
+            _fmt(eng.get("slot_ticks")),
+            _fmt(eng.get("pending")),
+        ])
+    widths = [max(len(r[i]) for r in table) for i in range(len(cols))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in table]
+    total_shed = sum(r.get("shed", 0) or 0 for r in tenants.values()
+                     if isinstance(r, dict))
+    lines.append("")
+    lines.append(f"tenants: {len(tenants)}; total shed: {total_shed}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if len(argv) != 1:
+        print(__doc__.strip().splitlines()[2].strip(), file=sys.stderr)
+        return 2
+    try:
+        doc = fetch(argv[0])
+    except Exception as e:      # noqa: BLE001 — CLI boundary: report, don't traceback
+        print(f"error: cannot reach server at {argv[0]}: {e!r}",
+              file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps(doc.get("tenants") or {}, indent=1,
+                         sort_keys=True))
+    else:
+        print(render(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
