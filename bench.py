@@ -267,6 +267,108 @@ def _tenant_bench():
     }
 
 
+def _train_breakdown(on_tpu):
+    """Fused-vs-dense loss-path A/B (ISSUE 14) on the SAME model
+    config: two fresh same-seed models — one with the blockwise CE
+    (`loss_chunk`) + fused norm/rope train path, one on the dense
+    logits path (`loss_chunk=0`) — each driven through a Trainer for a
+    few timed steps. Reports tokens/sec and the peak logits-path bytes
+    per path (dense materializes [B*S, V]; blockwise peaks at
+    O(chunk x V)), the loss delta (the parity evidence), and the
+    phase-attributed step seconds from `Trainer.measure_phase_seconds`
+    read back out of the new `train.phase.seconds` instruments — so
+    the bench JSON says WHY the train metric moved."""
+    import time
+
+    import paddle_tpu
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import observability
+    from paddle_tpu.kernels.blockwise_ce import dense_logits_bytes, \
+        logits_bytes_saved
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, \
+        tiny_llama_config
+    from paddle_tpu.parallel import Trainer, TrainStepConfig
+
+    if on_tpu:
+        base = dict(vocab_size=32000, hidden_size=1024,
+                    intermediate_size=2816, num_hidden_layers=4,
+                    num_attention_heads=16, num_key_value_heads=4,
+                    max_position_embeddings=1024, rope_theta=10000.0,
+                    seq_length=1024)
+        make_cfg = lambda **kw: LlamaConfig(**base, **kw)  # noqa: E731
+        batch_b, seq, steps, chunk = 4, 1024, 6, 512
+        compute_dtype = "bfloat16"
+    else:
+        make_cfg = lambda **kw: tiny_llama_config(  # noqa: E731
+            vocab_size=512, num_hidden_layers=2, hidden_size=64,
+            intermediate_size=128, num_attention_heads=4,
+            num_key_value_heads=2, **kw)
+        batch_b, seq, steps, chunk = 4, 32, 4, 16
+        compute_dtype = None
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, int(make_cfg().vocab_size),
+                      (batch_b, seq)).astype(np.int32)
+    item = 2 if compute_dtype == "bfloat16" else 4
+    rows_out = []
+    for label, overrides in (
+            ("dense", {}),
+            ("fused", dict(loss_chunk=chunk, fused_norm=True,
+                           fused_rope=True))):
+        paddle_tpu.seed(0)
+        cfg = make_cfg(**overrides)
+        model = LlamaForCausalLM(cfg)
+        optimizer = opt.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters(),
+                              weight_decay=0.01)
+        trainer = Trainer(model, optimizer, config=TrainStepConfig(
+            compute_dtype=compute_dtype))
+        batch = {"input_ids": ids, "labels": ids}
+        # first-step loss is pre-update on identical seeds: THE parity
+        # number (later steps drift as rounding feeds AdamW)
+        loss_step1 = float(trainer.step(batch))   # warm + compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss_t = trainer.step(batch)
+        loss = float(loss_t)
+        dt = time.perf_counter() - t0
+        with observability.scoped(reset=True) as reg:
+            trainer.measure_phase_seconds(batch, iters=2)
+            h = reg.histogram("train.phase.seconds")
+            phases = {}
+            for ph in ("fwd", "bwd", "optimizer"):
+                cell = h.labeled().get((("phase", ph),))
+                phases[ph] = round(cell.sum / max(cell.count, 1), 6) \
+                    if cell else None
+        n_rows = batch_b * seq
+        dense_bytes = dense_logits_bytes(n_rows, cfg.vocab_size, item)
+        peak = dense_bytes if not cfg.loss_chunk else \
+            dense_bytes - logits_bytes_saved(
+                n_rows, cfg.vocab_size, cfg.loss_chunk,
+                cfg.loss_vocab_block, item)
+        rows_out.append({
+            "path": label,
+            "loss_chunk": cfg.loss_chunk,
+            "tokens_per_sec": round(batch_b * seq * steps / dt, 2),
+            "loss_step1": round(loss_step1, 6),
+            "loss": round(loss, 6),
+            "peak_logits_bytes": int(peak),
+            "phase_seconds": phases,
+        })
+    d, f = rows_out
+    return {
+        "batch": batch_b, "seq": seq, "steps": steps,
+        "vocab_size": int(make_cfg().vocab_size),
+        "rows": rows_out,
+        "fused_vs_dense_tokens_per_sec": round(
+            f["tokens_per_sec"] / max(d["tokens_per_sec"], 1e-9), 4),
+        "loss_step1_delta": round(abs(f["loss_step1"]
+                                      - d["loss_step1"]), 8),
+        "logits_bytes_saved": int(d["peak_logits_bytes"]
+                                  - f["peak_logits_bytes"]),
+    }
+
+
 def _fleet_bench(trainer, batch, steps):
     """Heartbeat-publisher overhead (ISSUE 9): the SAME compiled step
     run with observability on, first without the fleet plane, then
@@ -409,7 +511,11 @@ def main():
                 num_hidden_layers=22, num_attention_heads=32,
                 num_key_value_heads=4, max_position_embeddings=2048,
                 rope_theta=10000.0, seq_length=2048, recompute=True,
-                use_flash_attention=True)
+                use_flash_attention=True,
+                # blockwise CE (ISSUE 14): the [B*S, 32000] logits no
+                # longer cap the batch; PT_BENCH_LOSS_CHUNK=0 reverts
+                loss_chunk=int(os.environ.get("PT_BENCH_LOSS_CHUNK",
+                                              512)))
             batch, seq, steps = 8, 2048, 10
         else:            # 16G-class chip (v5e/v6e): ~400M params
             # measured on v5e: activations for this size fit without
@@ -422,7 +528,9 @@ def main():
                 use_flash_attention=True,
                 # ffn fusion measured SLOWER here (split defeats the
                 # swiglu epilogue fusion); qkv fusion is neutral-positive
-                fuse_attention_qkv=True, fuse_attention_ffn=False)
+                fuse_attention_qkv=True, fuse_attention_ffn=False,
+                loss_chunk=int(os.environ.get("PT_BENCH_LOSS_CHUNK",
+                                              512)))
             # batch history: b6 > b4 after the fused CE freed the ~1GB
             # f32 log-softmax residual (r2); b7 > b6 after the in-kernel
             # delta + transposed-lse kernels freed the (b,h,sq,8) f32
@@ -506,6 +614,12 @@ def main():
     except Exception as e:           # noqa: BLE001 — never sink the
         tenant = {"error": f"{type(e).__name__}: {e}"}  # train metric
 
+    # fused-vs-dense train loss path + phase attribution (ISSUE 14)
+    try:
+        train_breakdown = _train_breakdown(on_tpu)
+    except Exception as e:           # noqa: BLE001 — never sink the
+        train_breakdown = {"error": f"{type(e).__name__}: {e}"}
+
     print(json.dumps({
         "metric": "llama1b_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
@@ -517,7 +631,8 @@ def main():
                   "device": getattr(dev, "device_kind", str(dev)),
                   "batch": batch, "seq": seq, "steps": steps,
                   "decode": decode, "fleet": fleet, "router": router,
-                  "prefix": prefix, "tenant": tenant},
+                  "prefix": prefix, "tenant": tenant,
+                  "train_breakdown": train_breakdown},
     }))
 
 

@@ -21,6 +21,17 @@ import jax
 __all__ = ["shard_map", "axis_size", "tpu_compiler_params"]
 
 
+def on_tpu() -> bool:
+    """True when the default jax backend is a real TPU — the shared
+    auto-dispatch gate for the Pallas kernel modules (kernels/
+    flash_attention, blockwise_ce, fused_norm); one probe, one
+    behavior."""
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
 def tpu_compiler_params(**kwargs):
     """`pltpu.CompilerParams(**kwargs)` under whichever name the
     installed jax line exports (`TPUCompilerParams` on 0.4.x)."""

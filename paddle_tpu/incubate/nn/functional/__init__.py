@@ -151,6 +151,34 @@ def _fused_rope(q, k, v, sin, cos, position_ids, use_neox_rotary_style,
     return tuple(o for o in outs if o is not None)
 
 
+@defop("fused_rope_kernel", amp_policy="white",
+       spmd_note="heads axis shards over 'mp'; seq sharding composes "
+                 "with explicit positions (context parallel)")
+def _fused_rope_kernel_op(q, k=None, positions=None, theta=10000.0,
+                          kernel=None):
+    """Train-path fused RoPE (kernels/fused_norm.py `rope_apply`):
+    full-width cos + sign-folded sin tables built once, the apply is
+    mul/lane-roll/mul/add in one pass (Pallas on TPU, fused jnp
+    elsewhere), backward = the inverse rotation. Same math as
+    `_apply_rope_neox`, without its slice/concat transpose chain."""
+    from paddle_tpu.kernels.fused_norm import rope_apply
+    out_q = rope_apply(q, positions=positions, theta=theta,
+                       kernel=kernel)
+    if k is None:
+        return out_q
+    return out_q, rope_apply(k, positions=positions, theta=theta,
+                             kernel=kernel)
+
+
+def fused_rope_apply(q, k=None, position_ids=None, rotary_emb_base=10000.0,
+                     kernel=None, name=None):
+    """Fused-kernel twin of `fused_rotary_position_embedding` for the
+    NeoX/Llama train path: applies RoPE to q (and k) in layout
+    (B, S, H, D). Returns q or (q, k)."""
+    return _fused_rope_kernel_op(q, k, positions=position_ids,
+                                 theta=rotary_emb_base, kernel=kernel)
+
+
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     position_ids=None,
                                     use_neox_rotary_style=True,

@@ -4,5 +4,8 @@ third_party/flashattn, paddle/cinn codegen). Only ops XLA cannot fuse well
 live here; everything else rides XLA fusion (SURVEY.md §2.4 "TPU
 equivalent: XLA itself").
 """
+from paddle_tpu.kernels import blockwise_ce     # noqa: F401
 from paddle_tpu.kernels import flash_attention  # noqa: F401
+from paddle_tpu.kernels import fused_norm       # noqa: F401
 from paddle_tpu.kernels import paged_attention  # noqa: F401
+from paddle_tpu.kernels import quant_matmul     # noqa: F401

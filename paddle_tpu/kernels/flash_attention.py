@@ -1100,11 +1100,7 @@ def _chunked_attention(q, k, v, causal, sm_scale, block_q=512, block_k=512):
 # custom_vjp glue
 # ---------------------------------------------------------------------------
 
-def _on_tpu():
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+from paddle_tpu.core.jax_compat import on_tpu as _on_tpu  # noqa: E402
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

@@ -67,6 +67,26 @@ class LlamaConfig:
     recompute: bool = False
     # sequence length used by helpers that need one (bench, example inputs)
     seq_length: int = 4096
+    # -- fused train-path kernels (ISSUE 14; kernels/blockwise_ce.py +
+    # kernels/fused_norm.py) ------------------------------------------
+    # loss_chunk > 0: next_token_loss streams the hidden->vocab
+    # projection + softmax-CE in `loss_chunk`-row blocks so the
+    # [B*S, vocab] logits tensor NEVER materializes (fwd or bwd) — at
+    # Llama-3 vocab that tensor dwarfs every activation and caps batch
+    # size. 0 = the old dense path (logits returned as before; the
+    # blockwise path returns (loss, None)).
+    loss_chunk: int = 0
+    # optional vocab streaming inside each row block (0 = whole vocab
+    # per chunk): peak logits-shaped intermediate is
+    # (loss_chunk, loss_vocab_block or vocab)
+    loss_vocab_block: int = 0
+    # route the decoder's RMSNorms through the fused norm(+residual)
+    # custom_vjp op (one read of x, residual written in the same pass,
+    # closed-form backward); numerics identical to rms_norm_ref
+    fused_norm: bool = False
+    # route RoPE through the fused apply (mul/lane-roll/mul/add, no
+    # slice/concat transpose chain; inverse-rotation backward)
+    fused_rope: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -87,6 +107,26 @@ def next_token_loss(logits, labels, vocab_size):
         T.reshape(logits, [-1, vocab_size]),
         T.reshape(shifted, [-1]),
         ignore_index=-100, reduction="mean")
+
+
+def next_token_loss_blockwise(hidden, weight, labels, config,
+                              transpose_w=False):
+    """Shifted next-token CE straight from the FINAL HIDDEN states —
+    the lm_head projection is fused into the blockwise loss
+    (kernels/blockwise_ce.py), so the [B*S, vocab] tensor never
+    exists. `weight` is the lm_head weight (D, V); pass
+    transpose_w=True for the tied-embedding (V, D) layout — the CALLER
+    states the layout explicitly (shape-sniffing it would silently
+    skip the transpose when vocab == hidden). Same label shift +
+    ignore_index semantics as `next_token_loss`."""
+    b = labels.shape[0]
+    d = hidden.shape[-1]
+    shifted = T.concat(
+        [labels[:, 1:], T.full([b, 1], -100, labels.dtype)], axis=1)
+    return F.blockwise_cross_entropy(
+        T.reshape(hidden, [-1, d]), weight, T.reshape(shifted, [-1]),
+        chunk=config.loss_chunk, vocab_block=config.loss_vocab_block,
+        ignore_index=-100, transpose_w=transpose_w)
 
 
 def llama3_8b_config(**overrides) -> LlamaConfig:
@@ -142,9 +182,16 @@ class LlamaAttention(nn.Layer):
         q = T.reshape(q, [b, s, cfg.num_attention_heads, cfg.head_dim])
         k = T.reshape(k, [b, s, cfg.num_key_value_heads, cfg.head_dim])
         v = T.reshape(v, [b, s, cfg.num_key_value_heads, cfg.head_dim])
-        q, k, _ = fused_rotary_position_embedding(
-            q, k, None, position_ids=position_ids,
-            rotary_emb_base=cfg.rope_theta)
+        if cfg.fused_rope:
+            # fused train-path apply (kernels/fused_norm.py): identical
+            # rotation, one pass, inverse-rotation backward
+            from paddle_tpu.incubate.nn.functional import fused_rope_apply
+            q, k = fused_rope_apply(q, k, position_ids=position_ids,
+                                    rotary_emb_base=cfg.rope_theta)
+        else:
+            q, k, _ = fused_rotary_position_embedding(
+                q, k, None, position_ids=position_ids,
+                rotary_emb_base=cfg.rope_theta)
         if cache is not None:
             from paddle_tpu.inference.paged import (PagedState,
                                                     paged_attention_update)
@@ -227,6 +274,7 @@ class LlamaMLP(nn.Layer):
 class LlamaDecoderLayer(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
+        self.config = config
         self.self_attn = LlamaAttention(config)
         self.mlp = LlamaMLP(config)
         self.input_layernorm = RMSNorm(config.hidden_size,
@@ -236,8 +284,17 @@ class LlamaDecoderLayer(nn.Layer):
 
     def forward(self, hidden_states, position_ids=None, attn_mask=None,
                 cache=None, cache_index=None):
+        fused = self.config.fused_norm
+        eps = self.config.rms_norm_eps
         residual = hidden_states
-        h = self.input_layernorm(hidden_states)
+        if fused:
+            # fused train-path norms (kernels/fused_norm.py): norm1 as
+            # one custom_vjp op; norm2 fuses the attention residual add
+            # into the same pass (one read of attn_out, h written once)
+            h, _ = F.rms_norm_fused(hidden_states,
+                                    self.input_layernorm.weight, eps)
+        else:
+            h = self.input_layernorm(hidden_states)
         new_cache = None
         if cache is not None:
             h, new_cache = self.self_attn(
@@ -246,9 +303,14 @@ class LlamaDecoderLayer(nn.Layer):
         else:
             h = self.self_attn(h, position_ids=position_ids,
                                attn_mask=attn_mask)
-        h = residual + h
-        residual = h
-        h2 = self.post_attention_layernorm(h)
+        if fused:
+            h2, residual = F.rms_norm_fused(
+                h, self.post_attention_layernorm.weight, eps,
+                residual=residual)
+        else:
+            h = residual + h
+            residual = h
+            h2 = self.post_attention_layernorm(h)
         h2 = self.mlp(h2)
         out = residual + h2
         return out if cache is None else (out, new_cache)
@@ -267,6 +329,13 @@ class LlamaModel(nn.Layer):
              for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
+    def _final_norm(self, h):
+        if self.config.fused_norm:
+            out, _ = F.rms_norm_fused(h, self.norm.weight,
+                                      self.config.rms_norm_eps)
+            return out
+        return self.norm(h)
+
     def forward(self, input_ids, position_ids=None, attn_mask=None,
                 caches=None, cache_index=None):
         from paddle_tpu.distributed.recompute import recompute
@@ -278,14 +347,14 @@ class LlamaModel(nn.Layer):
                              attn_mask=attn_mask, cache=cache,
                              cache_index=cache_index)
                 new_caches.append(c)
-            return self.norm(h), new_caches
+            return self._final_norm(h), new_caches
         for layer in self.layers:
             if self.config.recompute and self.training:
                 h = recompute(layer, h, position_ids=position_ids,
                               attn_mask=attn_mask)
             else:
                 h = layer(h, position_ids=position_ids, attn_mask=attn_mask)
-        return self.norm(h)
+        return self._final_norm(h)
 
 
 class LlamaForCausalLM(nn.Layer):
@@ -323,6 +392,16 @@ class LlamaForCausalLM(nn.Layer):
             return self.logits(h), caches
         h = self.model(input_ids, position_ids=position_ids,
                        attn_mask=attn_mask)
+        if labels is not None and self.config.loss_chunk:
+            # blockwise fused loss: the lm_head matmul streams inside
+            # the CE (kernels/blockwise_ce.py) — no [B*S, vocab] logits
+            # exists to return, hence (loss, None)
+            w = (self.model.embed_tokens.weight if self.lm_head is None
+                 else self.lm_head.weight)
+            loss = next_token_loss_blockwise(
+                h, w, labels, self.config,
+                transpose_w=self.lm_head is None)
+            return loss, None
         logits = self.logits(h)
         if labels is None:
             return logits

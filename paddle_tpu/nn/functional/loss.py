@@ -65,6 +65,40 @@ def _ce_mean_fused_bwd(res, g):
 _ce_mean_fused.defvjp(_ce_mean_fused_fwd, _ce_mean_fused_bwd)
 
 
+@defop("blockwise_ce", amp_policy="white",
+       spmd_note="row (batch*seq) axis freely shardable; the vocab "
+                 "axis streams in chunks, so vocab sharding composes "
+                 "with GSPMD like the dense matmul it replaces")
+def _blockwise_ce(hidden, weight, label, chunk, vocab_block=0,
+                  ignore_index=-100, transpose_w=False, kernel=None):
+    """Hidden->vocab projection fused with softmax-CE, streamed so the
+    [N, V] logits never materialize in forward OR backward
+    (kernels/blockwise_ce.py; the train-path memory cap ISSUE 14
+    removes). `transpose_w` takes the tied-embedding (V, D) layout —
+    the transpose happens inside the op, so jax AD routes dW back in
+    the embedding's own layout."""
+    from paddle_tpu.kernels.blockwise_ce import blockwise_ce_loss
+    w = weight.T if transpose_w else weight
+    return blockwise_ce_loss(hidden, w, label, chunk=chunk,
+                             vocab_block=vocab_block,
+                             ignore_index=ignore_index, kernel=kernel)
+
+
+def blockwise_cross_entropy(hidden, weight, label, chunk, vocab_block=0,
+                            ignore_index=-100, transpose_w=False,
+                            kernel=None, name=None):
+    """Mean CE of `hidden @ weight` vs int `label` without the [N, V]
+    logits tensor (the blockwise train loss; see
+    kernels/blockwise_ce.py for the streaming/vjp design). hidden
+    (N, D), weight (D, V) — or (V, D) with transpose_w=True — label
+    (N,). `chunk` rows stream per block; peak logits-shaped
+    intermediate is (chunk, vocab_block or V)."""
+    return _blockwise_ce(hidden, weight, label, chunk=chunk,
+                         vocab_block=vocab_block,
+                         ignore_index=ignore_index,
+                         transpose_w=transpose_w, kernel=kernel)
+
+
 @defop("cross_entropy", amp_policy="black",
        spmd_note="vocab-sharded logits -> ParallelCrossEntropy "
                  "(reference: mp_layers.py:743); here sharded softmax is "

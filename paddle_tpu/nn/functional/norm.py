@@ -53,6 +53,31 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     return _rms_norm(x, weight, epsilon=epsilon)
 
 
+@defop("rms_norm_residual", amp_policy="black",
+       spmd_note="replicated scale; batch/seq dims freely shardable "
+                 "(same contract as rms_norm_ref)")
+def _rms_norm_residual_op(x, residual=None, weight=None, epsilon=1e-6,
+                          kernel=None):
+    """Fused `h = x + residual; y = rms_norm(h) * weight` — one read of
+    x, the residual sum written in the same pass, closed-form fused
+    backward (kernels/fused_norm.py). Returns (y, h); with
+    residual=None h is x and this is the plain norm as ONE vjp op
+    (exact rms_norm_ref numerics either way)."""
+    from paddle_tpu.kernels.fused_norm import rms_norm_residual
+    return rms_norm_residual(x, weight, residual=residual,
+                             epsilon=epsilon, kernel=kernel)
+
+
+def rms_norm_fused(x, weight, epsilon=1e-6, residual=None, kernel=None,
+                   name=None):
+    """Tensor surface of the fused RMSNorm(+residual) train-path op
+    (ISSUE 14's `kernels/fused_norm.py`; reference kernel
+    fused_layernorm_kernel.cu rmsnorm branch). Returns (normed, h)
+    where h = x + residual (or x itself when residual is None)."""
+    return _rms_norm_residual_op(x, residual, weight, epsilon=epsilon,
+                                 kernel=kernel)
+
+
 @defop("batch_norm_infer", amp_policy="black")
 def _batch_norm_infer(x, running_mean, running_var, weight, bias,
                       epsilon=1e-5, channel_axis=1):

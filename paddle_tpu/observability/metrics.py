@@ -117,6 +117,20 @@ METRICS = {
                          "train-step program (re)builds (label: shape "
                          "= the triggering batch-shape signature — the "
                          "bucket-autotune feed)"),
+    "train.phase.seconds": ("histogram",
+                            "phase-attributed step wall time (label: "
+                            "phase = fwd | bwd | optimizer), from "
+                            "Trainer.measure_phase_seconds timing the "
+                            "step's own loss machinery fwd-only / "
+                            "fwd+bwd / full — the bench evidence for "
+                            "WHY MFU moved, not just that it did",
+                            DEFAULT_BUCKETS_S),
+    "train.loss.logits_bytes_saved": ("gauge",
+                                      "per-chip bytes of the [B*S, "
+                                      "vocab] logits tensor the "
+                                      "blockwise-CE loss path avoids "
+                                      "materializing per step (0 / "
+                                      "absent on the dense path)"),
     # -- input pipeline -----------------------------------------------
     "io.prefetch.queue_depth": ("gauge",
                                 "batches already on device, waiting "

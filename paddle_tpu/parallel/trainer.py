@@ -243,15 +243,13 @@ class Trainer:
         arr = loss._value if isinstance(loss, Tensor) else loss
         return arr.astype(jnp.float32)
 
-    def _build_step(self, batch_treedef):
+    def _make_loss_for(self):
+        """The step's loss closure (cast + batch sharding constraint +
+        context-parallel guard) — shared by `_build_step` and the
+        phase-attributed timing twins in `measure_phase_seconds`, so
+        phase timings measure the SAME program the fused step runs."""
         cfg = self.config
         mesh = self.mesh
-        # chaos injection "trainer.grad" is gated at TRACE time: with
-        # chaos off the compiled step has no poison input at all — the
-        # hot path stays byte-identical
-        from paddle_tpu.distributed import chaos
-        self._chaos_poison = bool(chaos.ENABLED
-                                  and chaos.site_rate("trainer.grad") > 0)
 
         def loss_for(params, batch):
             params_c = _cast_tree(params, cfg.compute_dtype)
@@ -271,6 +269,18 @@ class Trainer:
                     return self._loss_from_batch(params_c, batch)
             return self._loss_from_batch(params_c, batch)
 
+        return loss_for
+
+    def _build_step(self, batch_treedef):
+        cfg = self.config
+        # chaos injection "trainer.grad" is gated at TRACE time: with
+        # chaos off the compiled step has no poison input at all — the
+        # hot path stays byte-identical
+        from paddle_tpu.distributed import chaos
+        self._chaos_poison = bool(chaos.ENABLED
+                                  and chaos.site_rate("trainer.grad") > 0)
+
+        loss_for = self._make_loss_for()
         grad_fn = jax.value_and_grad(
             lambda tp, fp, b: loss_for({**fp, **tp}, b))
 
@@ -544,6 +554,29 @@ class Trainer:
         if self.mesh is not None:
             tokens = tokens / max(1, int(self.mesh.devices.size))
         self._tel_prev = [tokens, seq, None]
+        self._note_logits_bytes_saved(tokens)
+
+    def _note_logits_bytes_saved(self, tokens):
+        """With a blockwise-CE model config (loss_chunk > 0), publish
+        the per-chip bytes of [B*S, vocab] logits the loss path avoids
+        materializing this step — the memory evidence behind an MFU
+        move (ISSUE 14). One getattr chain + gauge set per step,
+        already inside the observability-gated telemetry tick."""
+        mcfg = getattr(self.model, "config", None)
+        chunk = getattr(mcfg, "loss_chunk", 0) or 0
+        vocab = getattr(mcfg, "vocab_size", 0) or 0
+        if not (chunk and vocab and tokens):
+            return
+        dt = self.config.compute_dtype
+        itemsize = jnp.dtype(dt).itemsize if dt is not None else 4
+        if observability.ENABLED:
+            from paddle_tpu.kernels.blockwise_ce import logits_bytes_saved
+            observability.set_gauge(
+                "train.loss.logits_bytes_saved",
+                logits_bytes_saved(
+                    int(tokens), int(vocab), int(chunk),
+                    int(getattr(mcfg, "loss_vocab_block", 0) or 0),
+                    itemsize))
 
     def _trace_count(self):
         """Traced programs in the step's jit cache (0 before the step
@@ -643,6 +676,113 @@ class Trainer:
         # mesh or sharding-aware vjps silently degrade
         with self._mesh_ctx():
             return self._step_fn.lower(*args)
+
+    def measure_phase_seconds(self, batch: dict, iters: int = 2):
+        """Phase-attributed step timing: where does the step's wall
+        time go? Compiles forward-only and forward+backward twins of
+        the step's OWN loss machinery (`_make_loss_for` — same cast,
+        batch constraint and precision context the fused step traces)
+        and attributes
+
+            fwd       = t(loss)
+            bwd       = t(value_and_grad) - t(loss)
+            optimizer = t(full step)      - t(value_and_grad)
+
+        Each timing is a mean over `iters` synced runs after a compile
+        warmup. Records `train.phase.seconds{phase=...}` when
+        observability is enabled and always returns
+        {"fwd", "bwd", "optimizer", "step"} seconds. NOTE: the
+        full-step timing drives `iters + 1` REAL optimizer steps (the
+        donated program is the thing being measured) — call this from
+        a bench/diagnostic context, not mid-training-run.
+        """
+        import time as _time
+        batch = {k: (v._value if isinstance(v, Tensor)
+                     else v if isinstance(v, (np.ndarray, jax.Array))
+                     else jnp.asarray(v))
+                 for k, v in batch.items()}
+        if self.mesh is not None:
+            batch = {k: jax.device_put(
+                v, self._batch_sharding(k, v.ndim))
+                for k, v in batch.items()}
+        loss_for = self._make_loss_for()
+        train_names = set(self.param_names)
+        n_mb = self.config.grad_accum_steps
+
+        def _split_mb(b):
+            return {k: v.reshape((n_mb, v.shape[0] // n_mb)
+                                 + v.shape[1:])
+                    for k, v in b.items()}
+
+        # the twins mirror _step_inner EXACTLY — including the
+        # grad-accum microbatch scan, which is a different program
+        # (different peak memory / runtime) than one full-batch pass
+        def fwd_fn(params, b):
+            if n_mb > 1:
+                def micro(acc, mb):
+                    return acc + loss_for(params, mb), None
+                tot, _ = jax.lax.scan(
+                    micro, jnp.zeros((), jnp.float32), _split_mb(b))
+                return tot / n_mb
+            return loss_for(params, b)
+
+        def fwdbwd_fn(params, b):
+            tp = {n: params[n] for n in train_names}
+            fp = {n: v for n, v in params.items() if n not in train_names}
+            gfn = jax.value_and_grad(
+                lambda t, mb: loss_for({**fp, **t}, mb))
+            if n_mb > 1:
+                def micro(carry, mb):
+                    acc_l, acc_g = carry
+                    l, g = gfn(tp, mb)
+                    return (acc_l + l,
+                            jax.tree.map(jnp.add, acc_g, g)), None
+                zeros = jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, jnp.float32), tp)
+                (ls, gs), _ = jax.lax.scan(
+                    micro, (jnp.zeros((), jnp.float32), zeros),
+                    _split_mb(b))
+                return ls / n_mb, gs
+            return gfn(tp, b)
+
+        def _timed(run):
+            # the warmup must DRAIN, not just dispatch: jit returns
+            # after async dispatch, and an in-flight warmup execution
+            # would bleed into the timed window
+            jax.block_until_ready(run())
+            t0 = _time.perf_counter()
+            for _ in range(max(1, iters)):
+                out = run()
+            jax.block_until_ready(out)
+            return (_time.perf_counter() - t0) / max(1, iters)
+
+        with self._mesh_ctx():
+            with self._precision_ctx():
+                jf = jax.jit(fwd_fn)
+                jg = jax.jit(fwdbwd_fn)
+                t_fwd = _timed(lambda: jf(self.params, batch))
+                t_fwdbwd = _timed(lambda: jg(self.params, batch))
+
+        def _full():
+            loss = self.step(batch)
+            # close the dispatch chain so the timing covers execution
+            return loss._value
+
+        t_step = _timed(_full)
+        phases = {
+            "fwd": t_fwd,
+            "bwd": max(0.0, t_fwdbwd - t_fwd),
+            "optimizer": max(0.0, t_step - t_fwdbwd),
+            "step": t_step,
+        }
+        if observability.ENABLED:
+            observability.observe("train.phase.seconds", phases["fwd"],
+                                  phase="fwd")
+            observability.observe("train.phase.seconds", phases["bwd"],
+                                  phase="bwd")
+            observability.observe("train.phase.seconds",
+                                  phases["optimizer"], phase="optimizer")
+        return phases
 
     def sync_to_model(self):
         """Write the trainer's param arrays back into the Layer tree (for

@@ -1658,6 +1658,16 @@ WAIVERS: dict[str, str] = {
     "gumbel_softmax_impl": "keyed Gumbel noise is irreducibly stochastic;"
                            " simplex/one-hot properties in "
                            "test_gumbel_softmax_properties below",
+    "blockwise_ce": "exact loss+grad parity vs the dense CE oracle "
+                    "(odd N, masked ignore_index, non-divisible vocab, "
+                    "jnp AND interpret-mode Pallas) in "
+                    "tests/test_train_kernels.py",
+    "rms_norm_residual": "fwd/bwd parity vs the eager rms_norm_ref "
+                         "defop + jax AD (both kernel paths) in "
+                         "tests/test_train_kernels.py",
+    "fused_rope_kernel": "rotation parity vs _apply_rope_neox + "
+                         "inverse-rotation grad pin (both kernel "
+                         "paths) in tests/test_train_kernels.py",
 }
 
 
