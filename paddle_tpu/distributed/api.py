@@ -165,7 +165,7 @@ def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
     shape_dtype = jax.eval_shape(raw)
     spec = placements_to_spec(placements, mesh, ndim=len(shape_dtype.shape))
     sharding = NamedSharding(mesh.jax_mesh, spec)
-    arr = jax.jit(raw, out_shardings=sharding)()
+    arr = jax.jit(raw, out_shardings=sharding)()  # lint: disable=jax-hazards -- one-shot creation fn: `raw` closes over a fresh fn/shape per call, so there is no cache to hit; compile-once at init is the point
     return Tensor(arr, stop_gradient=True)
 
 

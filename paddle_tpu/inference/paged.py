@@ -707,7 +707,12 @@ class PagedKVEngine:
         registry.set_gauge("engine.cancelled", s["cancelled"])
         registry.set_gauge("engine.expired", s["expired"])
         registry.set_gauge("engine.overloaded", s["overloaded"])
-        registry.set_gauge("engine.pending", len(self._pending))
+        # _pending is swapped by the ticker under _lock; an unguarded
+        # len() here races the swap (found by the guarded-field
+        # analyzer pass — the same shape as the PR 12 quota bypass)
+        with self._lock:
+            pending = len(self._pending)
+        registry.set_gauge("engine.pending", pending)
 
     def prefix_stats(self):
         """The prefix-cache /stats block (PredictorServer embeds it so
@@ -1665,12 +1670,16 @@ class PagedKVEngine:
             if not self.step():
                 # nothing live but pending couldn't admit: impossible by
                 # construction unless slots freed next step; guard
-                # against a spin if the pool is wedged
-                if not any(self._slots) and self._pending:
+                # against a spin if the pool is wedged.  _pending is
+                # read under _lock: scrape threads may be swapping it
+                # (found by the guarded-field analyzer pass)
+                with self._lock:
+                    wedged = not any(self._slots) and bool(self._pending)
+                    detail = (f"free={len(self._free)} "
+                              f"reserved={self._reserved_unalloc}")
+                if wedged:
                     raise RuntimeError(
-                        "pending requests cannot be admitted: "
-                        f"free={len(self._free)} "
-                        f"reserved={self._reserved_unalloc}")
+                        f"pending requests cannot be admitted: {detail}")
 
     def generate(self, prompts, max_new_tokens=32, **kw):
         """Batch convenience: submit all, drain, return token lists."""

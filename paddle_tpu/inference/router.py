@@ -402,7 +402,11 @@ class ReplicaRouter:
         return r is not None
 
     def replica(self, rid) -> Replica | None:
-        return self._by_id.get(str(rid))
+        # guarded read: add/remove_replica mutate _by_id under _lock
+        # from admin/scale paths while probers and handlers look up
+        # (found by the guarded-field analyzer pass)
+        with self._lock:
+            return self._by_id.get(str(rid))
 
     def in_rotation_count(self):
         with self._lock:
@@ -416,7 +420,10 @@ class ReplicaRouter:
         are probed CONCURRENTLY (short-lived threads, joined before
         return): one hard-down replica eating its full connect timeout
         must not stall detection for the rest of the fleet."""
-        reps = list(self._order)
+        # snapshot under _lock: remove_replica mutates _order while the
+        # prober iterates (found by the guarded-field analyzer pass)
+        with self._lock:
+            reps = list(self._order)
         if len(reps) == 1:
             self._probe_one(reps[0])
         elif reps:

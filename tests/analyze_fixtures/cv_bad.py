@@ -1,0 +1,25 @@
+"""cv-discipline archetypes: if-guarded wait, bare notify, and a reply
+sent inside the condition's critical section (the PR 8 store-server
+convoy shape)."""
+import threading
+
+
+class Queue:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def get(self):
+        with self._cv:
+            if not self._items:
+                self._cv.wait()         # no while-predicate (flagged)
+            return self._items.pop(0)
+
+    def put(self, x):
+        self._items.append(x)
+        self._cv.notify()               # lock not held (flagged)
+
+    def reply(self, conn):
+        with self._cv:
+            item = self._items.pop(0)
+            conn.sendall(item)          # IO under the cv (flagged)

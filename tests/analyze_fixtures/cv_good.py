@@ -1,0 +1,35 @@
+"""Disciplined twin: while-predicate wait, notify under the lock (also
+via a private helper only called while holding it), reply after
+release."""
+import threading
+
+
+class Queue:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def get(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait()
+            return self._items.pop(0)
+
+    def get_for(self, timeout):
+        with self._cv:
+            self._cv.wait_for(lambda: self._items, timeout)
+            return self._items.pop(0) if self._items else None
+
+    def put(self, x):
+        with self._cv:
+            self._items.append(x)
+            self._wake()
+
+    def _wake(self):
+        # only ever called under _cv: path-aware check keeps it quiet
+        self._cv.notify()
+
+    def reply(self, conn):
+        with self._cv:
+            item = self._items.pop(0)
+        conn.sendall(item)

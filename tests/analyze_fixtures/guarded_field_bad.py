@@ -1,0 +1,43 @@
+"""guarded-field archetype — the PR 12 `_pending`-swap shape: fields
+guarded on most writes, touched bare on thread-reachable paths (a
+ticker write, and a handler read of the swapped list)."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+        self._done = 0
+        self._thread = threading.Thread(target=self._tick, daemon=True)
+
+    def submit(self, req):
+        with self._lock:
+            self._pending.append(req)
+
+    def cancel_all(self):
+        with self._lock:
+            self._pending.clear()
+
+    def _drain_locked(self):
+        # called only under _lock (from _tick): lexically bare is fine
+        batch, self._pending = self._pending, []
+        return batch
+
+    def _tick(self):
+        while True:
+            with self._lock:
+                batch = self._drain_locked()
+            for _ in batch:
+                self._done += 1         # bare ticker write (flagged)
+
+    def do_GET(self):
+        return len(self._pending)       # bare handler read (flagged)
+
+    def finish(self, n):
+        with self._lock:
+            self._done += n
+
+    def close(self):
+        with self._lock:
+            self._done = 0

@@ -1,0 +1,34 @@
+"""Disciplined twin: every cross-thread touch holds the owner; a
+private helper stays bare because it is only ever called under the
+lock; __init__ writes are exempt by design."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+        self._done = 0
+        self._thread = threading.Thread(target=self._tick, daemon=True)
+
+    def submit(self, req):
+        with self._lock:
+            self._pending.append(req)
+
+    def _drain_locked(self):
+        batch, self._pending = self._pending, []
+        return batch
+
+    def _tick(self):
+        while True:
+            with self._lock:
+                batch = self._drain_locked()
+                self._done += len(batch)
+
+    def do_GET(self):
+        with self._lock:
+            return len(self._pending)
+
+    def finish(self, n):
+        with self._lock:
+            self._done += n

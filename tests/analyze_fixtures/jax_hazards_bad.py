@@ -1,0 +1,33 @@
+"""jax-hazards archetypes: use-after-donate, donate-in-loop without
+rebinding, per-call jit wrappers, and a trace-time constant."""
+import time
+
+import jax
+
+
+def use_after_donate(x, y):
+    step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    out = step(x, y)                    # x's buffer is gone here
+    return out + x                      # read after donate (flagged)
+
+
+def donate_in_loop(x, batches):
+    step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    out = None
+    for b in batches:
+        out = step(x, b)                # x never rebound (flagged)
+    return out
+
+
+def per_call_wrapper(x):
+    return jax.jit(lambda a: a * 2)(x)  # built+invoked per call (flagged)
+
+
+def local_only_wrapper(x):
+    f = jax.jit(lambda a: a * 2)        # never cached/returned (flagged)
+    return f(x)
+
+
+@jax.jit
+def traced_constant(a):
+    return a * time.time()              # frozen at trace time (flagged)

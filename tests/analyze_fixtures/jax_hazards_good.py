@@ -1,0 +1,37 @@
+"""Disciplined twins: the rebinding donate idiom, factory/cache wrapper
+patterns, and varying values passed in as arguments."""
+import time
+
+import jax
+
+_step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+
+
+def train(state, batches):
+    for b in batches:
+        state = _step(state, b)         # rebound every iteration: fine
+    return state
+
+
+def make_step(fn):
+    return jax.jit(fn, donate_argnums=(0,))    # factory: caller caches
+
+
+class Runner:
+    def __init__(self, fn):
+        self._fns = {}
+        self._fn = jax.jit(fn)          # cached on self: fine
+
+    def get(self, key, fn):
+        f = jax.jit(fn)
+        self._fns[key] = f              # stored in a cache: fine
+        return self._fns[key]
+
+
+@jax.jit
+def scaled(a, now):
+    return a * now                      # varying value is an argument
+
+
+def call(a):
+    return scaled(a, time.time())

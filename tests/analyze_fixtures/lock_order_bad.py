@@ -1,0 +1,38 @@
+"""lock-order archetypes: an A->B / B->A cycle (the second edge hidden
+behind a helper call) and a self-deadlock on a non-reentrant Lock."""
+import threading
+
+
+class Cycle:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def forward(self):                  # A -> B, directly nested
+        with self._a:
+            with self._b:               # cycle edge A->B (flagged)
+                self.n += 1
+
+    def backward(self):                 # B -> A, via the helper
+        with self._b:
+            self._bump()
+
+    def _bump(self):
+        with self._a:
+            self.n += 1
+
+
+class SelfDeadlock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def add(self, x):
+        with self._lock:
+            self.items.append(x)
+            self._flush()               # re-enters _lock below (flagged)
+
+    def _flush(self):
+        with self._lock:                # non-reentrant re-acquire
+            self.items.clear()

@@ -1,0 +1,38 @@
+"""Disciplined twins: one canonical order everywhere, and RLock
+re-entry (legal) instead of a Lock self-deadlock."""
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.n = 0
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                self.n += 1
+
+    def also_forward(self):             # same A -> B order: no cycle
+        with self._a:
+            self._bump()
+
+    def _bump(self):
+        with self._b:
+            self.n += 1
+
+
+class Reentrant:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.items = []
+
+    def add(self, x):
+        with self._lock:
+            self.items.append(x)
+            self._flush()
+
+    def _flush(self):
+        with self._lock:                # RLock: re-entry is legal
+            self.items.clear()

@@ -1,0 +1,436 @@
+"""ISSUE 15 — the four concurrency/tracing-hazard passes in
+tools/analyze: lock-order, guarded-field, cv-discipline, jax-hazards.
+
+Each pass's archetype bug is pinned to EXACT (file, line) findings on
+the engineered-bad fixtures in tests/analyze_fixtures/, with the
+disciplined twin fixtures asserted silent.  The live corpus runs all
+11 passes clean with tools/analyze/baseline.json EMPTY — that pin (plus
+test_analyze_tool.py's subprocess smoke) is the tier-1 wiring.
+
+Regression notes for the true positives these passes found and fixed in
+this PR (each is re-pinned by the clean guarded-field corpus run — a
+revert re-flags the site and fails here):
+
+  * PagedKVEngine.export_metrics read `len(self._pending)` bare while
+    the ticker swaps `_pending` under `_lock` (the scrape-thread
+    sibling of the PR 12 quota-bypass race).  Now read under `_lock`.
+  * PagedKVEngine.run_until_idle's wedged-pool diagnostic read
+    `_pending`/`_slots` bare against the same swap.  Now snapshotted
+    under `_lock`.
+  * ReplicaRouter.replica returned `self._by_id.get(...)` bare while
+    add/remove_replica mutate the dict under `_lock`.  Now guarded.
+  * ReplicaRouter.probe_all snapshotted `list(self._order)` bare while
+    remove_replica mutates the list under `_lock`.  Now guarded.
+  * dtensor_from_fn's one-shot `jax.jit(raw, ...)()` is the one
+    jax-hazards hit that is intentional (a creation fn compiles once by
+    design) — suppressed inline with a justification, not baselined.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURES = os.path.join(_ROOT, "tests", "analyze_fixtures")
+
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.analyze import ALL_PASSES, analyze_tree  # noqa: E402
+
+_BAD = os.path.join("paddle_tpu", "bad.py")
+
+
+def _mini(tmp_path, **files):
+    """A fake repo: paddle_tpu/<name>.py per kwarg (fixture filename
+    from tests/analyze_fixtures, or inline source)."""
+    pkg = tmp_path / "paddle_tpu"
+    pkg.mkdir(exist_ok=True)
+    for name, src in files.items():
+        if src.endswith(".py"):
+            shutil.copy(os.path.join(_FIXTURES, src), pkg / f"{name}.py")
+        else:
+            (pkg / f"{name}.py").write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def _pins(rep):
+    """{(file, line), ...} of the new findings."""
+    return {(f.file, f.line) for f in rep.new}
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_registry_has_eleven_passes_in_order():
+    assert [p.PASS_ID for p in ALL_PASSES] == [
+        "jax-compat", "chaos-points", "metric-names", "hot-path-sync",
+        "thread-discipline", "silent-swallow", "disabled-gate",
+        "lock-order", "guarded-field", "cv-discipline", "jax-hazards"]
+
+
+# -- lock-order --------------------------------------------------------------
+
+def test_lock_order_fixture_exact_findings(tmp_path):
+    root = _mini(tmp_path, bad="lock_order_bad.py",
+                 good="lock_order_good.py")
+    rep = analyze_tree(root, ["lock-order"], use_baseline=False)
+    assert _pins(rep) == {(_BAD, 14), (_BAD, 34)}, rep.new
+    msgs = " | ".join(f.message for f in rep.new)
+    assert "lock-order cycle between Cycle._a -> Cycle._b" in msgs
+    assert "Cycle._b -> Cycle._a" in msgs           # both edge sites named
+    assert "non-reentrant threading.Lock" in msgs   # self-deadlock
+    assert "SelfDeadlock._lock" in msgs
+    quals = {f.qualname for f in rep.new}
+    assert quals == {"Cycle.forward", "SelfDeadlock.add"}
+
+
+def test_lock_order_edges_resolve_across_classes(tmp_path):
+    """Interprocedural edges resolve through typed attributes — incl.
+    private class names (`self._store = _Store(...)`): holding
+    Engine._lock while calling a method that takes _Store._s records a
+    cross-class edge in the canonical table.  (A back-reference passed
+    through a constructor parameter stays untyped — the model only
+    types `self.x = Cls(...)` — so no false cycle appears here.)"""
+    root = _mini(tmp_path, mod="""
+        import threading
+
+
+        class _Store:
+            def __init__(self):
+                self._s = threading.Lock()
+                self.x = None
+
+            def put(self, x):
+                with self._s:
+                    self.x = x
+
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._store = _Store()
+
+            def submit(self, x):
+                with self._lock:
+                    self._store.put(x)
+    """)
+    rep = analyze_tree(root, ["lock-order"], use_baseline=False)
+    assert rep.new == [], rep.new          # one direction: no cycle
+    table = "\n".join(rep.notes.get("lock-order", []))
+    assert "Engine._lock -> _Store._s" in table, table
+
+
+def test_lock_order_summarize_emits_canonical_table(tmp_path):
+    root = _mini(tmp_path, good="lock_order_good.py")
+    rep = analyze_tree(root, ["lock-order"], use_baseline=False)
+    assert rep.new == []
+    table = rep.notes.get("lock-order", [])
+    assert any("Ordered._a -> Ordered._b" in line for line in table), table
+
+
+# -- guarded-field -----------------------------------------------------------
+
+def test_guarded_field_fixture_exact_findings(tmp_path):
+    """Ticker write + handler read of majority-guarded fields — the
+    PR 12 `_pending`-swap shape (see the module docstring for the four
+    live-corpus sites this pass caught and this PR fixed)."""
+    root = _mini(tmp_path, bad="guarded_field_bad.py",
+                 good="guarded_field_good.py")
+    rep = analyze_tree(root, ["guarded-field"], use_baseline=False)
+    assert _pins(rep) == {(_BAD, 32), (_BAD, 35)}, rep.new
+    by_line = {f.line: f for f in rep.new}
+    assert "write of `Engine._done`" in by_line[32].message
+    assert by_line[32].qualname == "Engine._tick"
+    assert "read of `Engine._pending`" in by_line[35].message
+    assert by_line[35].qualname == "Engine.do_GET"
+
+
+def test_guarded_field_same_class_name_in_two_files(tmp_path):
+    """Regression: thread-entry marks must bind to the scope object,
+    not the class NAME — two modules both defining `Engine` used to
+    swallow each other's Thread(target=self._tick) entries and silence
+    the pass entirely."""
+    root = _mini(tmp_path, bad="guarded_field_bad.py",
+                 clone="guarded_field_bad.py")
+    rep = analyze_tree(root, ["guarded-field"], use_baseline=False)
+    assert {(f.file, f.line) for f in rep.new} == {
+        (_BAD, 32), (_BAD, 35),
+        (os.path.join("paddle_tpu", "clone.py"), 32),
+        (os.path.join("paddle_tpu", "clone.py"), 35)}
+
+
+# -- cv-discipline -----------------------------------------------------------
+
+def test_cv_discipline_fixture_exact_findings(tmp_path):
+    root = _mini(tmp_path, bad="cv_bad.py", good="cv_good.py")
+    rep = analyze_tree(root, ["cv-discipline"], use_baseline=False)
+    assert _pins(rep) == {(_BAD, 15), (_BAD, 20), (_BAD, 25)}, rep.new
+    by_line = {f.line: f.message for f in rep.new}
+    assert "outside a `while <predicate>:` loop" in by_line[15]
+    assert "does not hold the condition's lock" in by_line[20]
+    assert "reply/IO while holding" in by_line[25]
+
+
+def test_cv_discipline_module_level_condition(tmp_path):
+    """Module-global conditions (the watchdog completer shape) are
+    modeled too: a bare notify on a module-level cv is flagged, the
+    guarded one is not."""
+    root = _mini(tmp_path, mod="""
+        import threading
+
+        _lock = threading.Lock()
+        _cv = threading.Condition(_lock)
+        _q = []
+
+        def push_bad(x):
+            _q.append(x)
+            _cv.notify()
+
+        def push_good(x):
+            with _cv:
+                _q.append(x)
+                _cv.notify()
+    """)
+    rep = analyze_tree(root, ["cv-discipline"], use_baseline=False)
+    assert [f.line for f in rep.new] == [10], rep.new
+    assert "notify" in rep.new[0].message
+
+
+def test_cv_discipline_module_cv_used_from_class_methods(tmp_path):
+    """Module-global locks are visible inside class methods: a bare
+    notify on the module cv from a method is flagged (guaranteed
+    RuntimeError), while a module helper called ONLY from inside the
+    method's `with _cv:` block inherits that context and stays quiet
+    — shared identity across the class/module scopes."""
+    root = _mini(tmp_path, mod="""
+        import threading
+
+        _lock = threading.Lock()
+        _cv = threading.Condition(_lock)
+        _q = []
+
+        def _notify_waiters():
+            _cv.notify_all()
+
+        class Producer:
+            def push(self, x):
+                with _cv:
+                    _q.append(x)
+                    _notify_waiters()
+
+            def poke(self):
+                _cv.notify()
+    """)
+    rep = analyze_tree(root, ["cv-discipline"], use_baseline=False)
+    assert [f.line for f in rep.new] == [18], rep.new
+    assert rep.new[0].qualname == "Producer.poke"
+    assert "does not hold the condition's lock" in rep.new[0].message
+
+
+def test_guarded_field_module_cv_does_not_alias_same_named_class_lock(tmp_path):
+    """A module `_mlock`/`_cv` pair must not alias a class's OWN
+    `self._mlock`: holding the module cv is not holding the class
+    lock, so the bare handler read stays flagged."""
+    root = _mini(tmp_path, mod="""
+        import threading
+
+        _mlock = threading.Lock()
+        _cv = threading.Condition(_mlock)
+
+        class Engine:
+            def __init__(self):
+                self._mlock = threading.Lock()
+                self._pending = []
+                self._t = threading.Thread(target=self._tick, daemon=True)
+
+            def submit(self, r):
+                with self._mlock:
+                    self._pending.append(r)
+
+            def cancel(self):
+                with self._mlock:
+                    self._pending.clear()
+
+            def _tick(self):
+                with _cv:
+                    n = len(self._pending)
+                return n
+    """)
+    rep = analyze_tree(root, ["guarded-field"], use_baseline=False)
+    assert [f.line for f in rep.new] == [23], rep.new
+    assert "Engine._pending" in rep.new[0].message
+
+
+# -- jax-hazards -------------------------------------------------------------
+
+def test_jax_hazards_fixture_exact_findings(tmp_path):
+    root = _mini(tmp_path, bad="jax_hazards_bad.py",
+                 good="jax_hazards_good.py")
+    rep = analyze_tree(root, ["jax-hazards"], use_baseline=False)
+    assert _pins(rep) == {(_BAD, 9), (_BAD, 11), (_BAD, 15), (_BAD, 18),
+                          (_BAD, 23), (_BAD, 27), (_BAD, 33)}, rep.new
+    by_line = {f.line: f.message for f in rep.new}
+    assert "read after being donated" in by_line[11]       # use-after-donate
+    assert "inside a loop without being rebound" in by_line[18]
+    assert "built and invoked in one expression" in by_line[23]
+    assert "never cached/returned" in by_line[27]
+    assert "frozen at trace time" in by_line[33]
+
+
+def test_jax_hazards_rebinding_idiom_is_silent(tmp_path):
+    root = _mini(tmp_path, mod="""
+        import jax
+
+        _step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+
+        def train(state, batches):
+            for b in batches:
+                state = _step(state, b)
+            return state
+
+        def retry(state, batch):
+            out = _step(state, batch)
+            state = jax.numpy.zeros(3)      # rebound: fresh value
+            return out + state              # not the donated buffer
+    """)
+    rep = analyze_tree(root, ["jax-hazards"], use_baseline=False)
+    assert rep.new == [], rep.new
+
+
+def test_jax_hazards_module_level_wrapper_donate_in_loop(tmp_path):
+    """Donation tracking covers wrappers bound at MODULE level too —
+    the common `_step = jax.jit(...)` idiom, not just function-local
+    bindings (which the retrace check flags anyway)."""
+    root = _mini(tmp_path, mod="""
+        import jax
+
+        _step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+
+        def train(state, batches):
+            out = None
+            for b in batches:
+                out = _step(state, b)       # state never rebound
+            return out
+    """)
+    rep = analyze_tree(root, ["jax-hazards"], use_baseline=False)
+    assert [f.line for f in rep.new] == [9], rep.new
+    assert "inside a loop without being rebound" in rep.new[0].message
+
+
+def test_jax_hazards_local_shadow_and_nested_def_are_silent(tmp_path):
+    """Two non-bugs must stay quiet: (a) a local rebind of a
+    module-wrapper name to a NON-donating jit drops the module
+    wrapper's donate positions; (b) a nested def's donated parameter
+    is fresh per call — the OUTER function's loop does not make it a
+    donate-in-loop."""
+    root = _mini(tmp_path, mod="""
+        import jax
+
+        _step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+
+        def shadowed(state, batch):
+            _step = jax.jit(lambda a, b: a + b)     # no donation
+            out = _step(state, batch)
+            return out + state
+
+        def outer(xs):
+            outs = []
+            for x in xs:
+                def cb(state, b):
+                    return _step(state, b)          # fresh param
+                outs.append(cb)
+            return outs
+    """)
+    rep = analyze_tree(root, ["jax-hazards"], use_baseline=False)
+    # the shadowing wrapper is still a per-call retrace finding —
+    # that is check (b) of the RETRACE family, not a donation error
+    assert all("donat" not in f.message for f in rep.new), rep.new
+
+
+def test_jax_hazards_dynamic_donate_is_skipped(tmp_path):
+    """donate_argnums bound to a variable (the engines' `donate=`
+    plumbing) is untrackable and must not produce noise."""
+    root = _mini(tmp_path, mod="""
+        import jax
+
+        def build(fn, donate):
+            return jax.jit(fn, donate_argnums=donate)
+    """)
+    rep = analyze_tree(root, ["jax-hazards"], use_baseline=False)
+    assert rep.new == [], rep.new
+
+
+# -- suppression syntax for the new ids --------------------------------------
+
+def test_new_pass_ids_parse_through_suppressions(tmp_path):
+    """`# lint: disable=<new-id> -- why` suppresses each new pass via
+    the existing _parse_suppressions machinery (hyphenated ids)."""
+    root = _mini(tmp_path, mod="""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def poke(self):
+                self._cv.notify()  # lint: disable=cv-discipline -- fixture: deliberate bare notify
+    """)
+    rep = analyze_tree(root, ["cv-discipline"], use_baseline=False)
+    assert rep.new == [] and len(rep.suppressed) == 1
+    assert rep.suppressed[0].pass_id == "cv-discipline"
+
+
+# -- tier-1 pin: clean corpus, empty baseline, all 11 passes -----------------
+
+def test_corpus_clean_across_all_eleven_passes():
+    """The live tree has zero non-baselined findings from ALL passes
+    and the shipped baseline is EMPTY — every pass lands with the
+    corpus actually fixed, not grandfathered (ISSUE 15 acceptance)."""
+    rep = analyze_tree(_ROOT)
+    assert rep.new == [], [f.render() for f in rep.new]
+    assert rep.baselined == [], [f.render() for f in rep.baselined]
+    with open(os.path.join(_ROOT, "tools", "analyze",
+                           "baseline.json")) as f:
+        assert json.load(f)["entries"] == []
+    # the canonical lock table documents the corpus's one real edge
+    table = "\n".join(rep.notes.get("lock-order", []))
+    assert "PagedKVEngine._lock -> PagedKVEngine._tenant_lock" in table
+
+
+def test_guarded_field_clean_on_live_corpus():
+    """Focused re-pin of the four fixed sites (module docstring):
+    reverting any of the PR 15 lock fixes re-flags it here."""
+    rep = analyze_tree(_ROOT, ["guarded-field"], use_baseline=False)
+    assert rep.new == [], [f.render() for f in rep.new]
+
+
+def test_json_findings_carry_qualname_and_suppressed_flag(tmp_path):
+    root = _mini(tmp_path, bad="cv_bad.py", shh="""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def poke(self):
+                self._cv.notify()  # lint: disable=cv-discipline -- fixture: audit row
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", root, "--no-baseline",
+         "--json", "--pass", "cv-discipline"],
+        capture_output=True, text=True, timeout=180, cwd=_ROOT)
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == 2
+    rows = {(f["file"], f["line"]): f for f in doc["findings"]}
+    hit = rows[(_BAD, 15)]
+    assert hit["qualname"] == "Queue.get"
+    assert hit["suppressed"] is False
+    assert set(hit) == {"pass", "severity", "file", "line",
+                        "qualname", "message", "suppressed"}
+    # suppressed findings ride along flagged true, and count
+    shh = rows[(os.path.join("paddle_tpu", "shh.py"), 9)]
+    assert shh["suppressed"] is True
+    assert doc["counts"]["suppressed"] == 1

@@ -57,7 +57,7 @@ def _ids(report):
 
 def test_live_tree_is_clean():
     """The real corpus has zero non-baselined findings across all
-    seven passes, and the run stays well under the 30s budget."""
+    eleven passes, and the run stays well under the 30s budget."""
     t0 = time.monotonic()
     proc = _cli(_ROOT)
     elapsed = time.monotonic() - t0
@@ -71,15 +71,24 @@ def test_json_output_schema_stable():
     assert proc.returncode == 0, proc.stderr
     doc = json.loads(proc.stdout)
     assert set(doc) == {"version", "root", "passes", "findings",
-                       "counts", "warnings"}
-    assert doc["version"] == 1
+                       "counts", "warnings", "notes"}
+    assert doc["version"] == 2
     assert doc["passes"] == ["jax-compat", "chaos-points",
                              "metric-names", "hot-path-sync",
                              "thread-discipline", "silent-swallow",
-                             "disabled-gate"]
+                             "disabled-gate", "lock-order",
+                             "guarded-field", "cv-discipline",
+                             "jax-hazards"]
     assert doc["counts"]["new"] == 0
+    # v2: suppressed findings ride along flagged true (auditability);
+    # every finding carries its enclosing qualname
     for f in doc["findings"]:
-        assert set(f) == {"pass", "severity", "file", "line", "message"}
+        assert set(f) == {"pass", "severity", "file", "line",
+                          "qualname", "message", "suppressed"}
+        assert f["suppressed"] is True      # clean tree: only these
+    # notes carry the lock-order canonical acquisition table
+    assert any("->" in line
+               for line in doc["notes"].get("lock-order", []))
 
 
 def test_exit_nonzero_names_pass_file_and_line(tmp_path):
@@ -168,6 +177,9 @@ def test_suppression_requires_justification(tmp_path):
     # the naked suppression is a finding AND does not suppress
     assert "suppression" in ids
     assert "silent-swallow" in ids
+    # framework findings go through qualname enrichment like any other
+    supp = next(f for f in rep.new if f.pass_id == "suppression")
+    assert supp.qualname == "f"
 
 
 def test_deleting_a_suppression_resurfaces_the_finding(tmp_path):

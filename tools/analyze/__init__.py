@@ -6,7 +6,7 @@ Usage (API):   from tools.analyze import analyze_tree
 
 See tools/analyze/core.py for the framework (shared AST index,
 findings, suppressions, baseline) and tools/analyze/passes/ for the
-seven passes. The README's "Static analysis" section documents the
+eleven passes. The README's "Static analysis" section documents the
 pass catalogue and the suppression/baseline policy.
 """
 from tools.analyze.core import (Baseline, Finding, Report, build_index,

@@ -1,11 +1,23 @@
 """CLI runner: `python -m tools.analyze [root] [--json] [--pass <id>]`.
 
-Exit 0: zero non-baselined findings. Exit 1: findings (each printed
-with pass, file, line). Exit 2: usage error.
+Exit-code contract (CI consumes this — keep it stable):
 
---json emits the schema-stable (version 1) document from
-Report.to_json() for CI consumption; warnings (stale baseline entries,
-unused suppressions) never affect the exit code.
+  0  zero NEW findings: everything emitted was either suppressed
+     inline (`# lint: disable=<id> -- why`) or grandfathered in
+     tools/analyze/baseline.json.  Warnings (stale baseline entries,
+     unused suppressions, unparseable files) NEVER affect the exit
+     code — they print to stdout and are advisory.
+  1  at least one new finding.  Human mode prints each to stderr as
+     `[pass] file:line (qualname): message`; --json mode prints the
+     document to stdout and still exits 1.
+  2  usage error (unknown --pass id, bad arguments).
+
+--json emits the schema-stable (version 2) document from
+Report.to_json(): each finding carries {pass, severity, file, line,
+qualname, message, suppressed}.  Suppressed findings are included with
+suppressed=true for auditability; only suppressed=false findings drive
+the exit code.  `notes` holds per-pass tables (lock-order's canonical
+acquisition order); `counts` and `warnings` round out the document.
 """
 from __future__ import annotations
 
@@ -32,12 +44,16 @@ def main(argv=None):
     ap.add_argument("root", nargs="?", default=None,
                     help="tree to analyze (default: this repo)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit the version-1 JSON document")
+                    help="emit the version-2 JSON document (findings "
+                         "with qualname + suppressed flag, notes)")
     ap.add_argument("--pass", dest="passes", action="append",
                     metavar="ID", default=None,
                     help="run only this pass (repeatable)")
     ap.add_argument("--list-passes", action="store_true",
                     help="print the pass catalogue and exit")
+    ap.add_argument("--tables", action="store_true",
+                    help="print per-pass summary tables (e.g. the "
+                         "lock-order canonical acquisition order)")
     ap.add_argument("--baseline", default=None, metavar="PATH",
                     help="baseline file (default: "
                          "tools/analyze/baseline.json)")
@@ -85,6 +101,11 @@ def main(argv=None):
 
     for w in report.warnings:
         print(f"tools.analyze: warning: {w}")
+    if args.tables:
+        for pid, lines in sorted(report.notes.items()):
+            print(f"-- {pid} --")
+            for line in lines:
+                print(f"  {line}")
     if report.new:
         print(f"tools.analyze: {len(report.new)} new finding(s) "
               f"({len(report.baselined)} baselined, "

@@ -29,7 +29,7 @@ import json
 import os
 import re
 import tokenize
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 
 # directories/files that make up the analyzed corpus, relative to root
 CORPUS_DIRS = ("paddle_tpu", "tools")
@@ -45,23 +45,30 @@ _SUPPRESS_RE = re.compile(
 
 @dataclass(frozen=True)
 class Finding:
-    """One typed diagnostic: {pass, severity, file, line, message}."""
+    """One typed diagnostic: {pass, severity, file, line, qualname,
+    message}.  `qualname` (the enclosing def/class) is filled in
+    centrally by the runner from the finding's line — passes never need
+    to compute it."""
     pass_id: str
     file: str               # path relative to the analyzed root
     line: int
     message: str
     severity: str = "error"
+    qualname: str = ""      # enclosing def/class ("" = module level)
 
     def key(self):
         return (self.pass_id, self.file, self.line)
 
-    def to_json(self):
+    def to_json(self, suppressed=False):
         return {"pass": self.pass_id, "severity": self.severity,
                 "file": self.file, "line": self.line,
-                "message": self.message}
+                "qualname": self.qualname, "message": self.message,
+                "suppressed": suppressed}
 
     def render(self):
-        return f"[{self.pass_id}] {self.file}:{self.line}: {self.message}"
+        where = f" ({self.qualname})" if self.qualname else ""
+        return (f"[{self.pass_id}] {self.file}:{self.line}{where}: "
+                f"{self.message}")
 
 
 @dataclass
@@ -82,6 +89,28 @@ class Module:
         """Dotted def/class qualname ("Trainer.step", "Engine._tick.run")
         computed from parent links at index time."""
         return getattr(node, "_pt_qualname", getattr(node, "name", "?"))
+
+    def qualname_at(self, line: int) -> str:
+        """Innermost def/class qualname containing `line` ("" when the
+        line sits at module level)."""
+        spans = getattr(self, "_qual_spans", None)
+        if spans is None:
+            spans = []
+            if self.tree is not None:
+                for node in ast.walk(self.tree):
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef)):
+                        end = getattr(node, "end_lineno", node.lineno)
+                        spans.append((node.lineno, end,
+                                      self.qualname(node)))
+            self._qual_spans = spans
+        best = ""
+        best_start = -1
+        for start, end, qn in spans:
+            if start <= line <= end and start > best_start:
+                best, best_start = qn, start
+        return best
 
 
 class Index:
@@ -279,22 +308,30 @@ class Report:
     baselined: list
     suppressed: list
     warnings: list         # stale baseline entries, unused suppressions
+    notes: dict = field(default_factory=dict)   # pass id -> table lines
 
     @property
     def exit_code(self):
         return 1 if self.new else 0
 
     def to_json(self):
-        """Schema-stable (version 1) document for CI consumption."""
+        """Schema-stable (version 2) document for CI consumption.
+        Version 2 (ISSUE 15): findings carry `qualname` and a
+        `suppressed` flag (suppressed findings are INCLUDED, flagged
+        true, so CI can audit them; only suppressed=false findings
+        affect the exit code), plus per-pass `notes` tables (e.g.
+        lock-order's canonical acquisition order)."""
         return {
-            "version": 1,
+            "version": 2,
             "root": self.root,
             "passes": list(self.pass_ids),
-            "findings": [f.to_json() for f in self.new],
+            "findings": [f.to_json() for f in self.new]
+            + [f.to_json(suppressed=True) for f in self.suppressed],
             "counts": {"new": len(self.new),
                        "baselined": len(self.baselined),
                        "suppressed": len(self.suppressed)},
             "warnings": list(self.warnings),
+            "notes": {k: list(v) for k, v in self.notes.items()},
         }
 
 
@@ -327,6 +364,28 @@ def run(root, passes, baseline: Baseline | None = None,
                 "suppression", mod.rel, no,
                 f"suppression comment has no justification: {raw!r} — "
                 "write `# lint: disable=<pass-id> -- <why>`"))
+
+    # central qualname enrichment (AFTER the framework findings so
+    # they carry one too): the finding's line names its enclosing
+    # def/class, no pass has to carry that plumbing
+    enriched = []
+    for f in findings:
+        if not f.qualname:
+            mod = index.by_rel.get(f.file)
+            if mod is not None:
+                qn = mod.qualname_at(f.line)
+                if qn:
+                    f = replace(f, qualname=qn)
+        enriched.append(f)
+    findings = enriched
+
+    notes = {}
+    for p in passes:
+        summarize = getattr(p, "summarize", None)
+        if summarize:
+            lines = list(summarize(index))
+            if lines:
+                notes[p.PASS_ID] = lines
 
     new, suppressed = [], []
     used = set()                      # (rel, line, pass_id) consumed
@@ -367,4 +426,4 @@ def run(root, passes, baseline: Baseline | None = None,
     kept.sort(key=lambda f: (f.file, f.line, f.pass_id))
     return Report(root=index.root, pass_ids=[p.PASS_ID for p in passes],
                   new=kept, baselined=grandfathered,
-                  suppressed=suppressed, warnings=warnings)
+                  suppressed=suppressed, warnings=warnings, notes=notes)

@@ -1,8 +1,11 @@
 """Pass registry: ordered list of pass modules, each exposing
-PASS_ID, DESCRIPTION and run(index) -> iterable[Finding]."""
-from tools.analyze.passes import (chaos_points, gating, hot_path,
-                                  jax_compat, metric_names, swallow,
-                                  threads)
+PASS_ID, DESCRIPTION and run(index) -> iterable[Finding].  A pass may
+also expose summarize(index) -> list[str] for the report's notes
+section (e.g. lock-order's canonical acquisition table)."""
+from tools.analyze.passes import (chaos_points, cv_discipline, gating,
+                                  guarded_field, hot_path, jax_compat,
+                                  jax_hazards, lock_order, metric_names,
+                                  swallow, threads)
 
 ALL_PASSES = [
     jax_compat,        # jax-compat
@@ -12,6 +15,10 @@ ALL_PASSES = [
     threads,           # thread-discipline
     swallow,           # silent-swallow
     gating,            # disabled-gate
+    lock_order,        # lock-order
+    guarded_field,     # guarded-field
+    cv_discipline,     # cv-discipline
+    jax_hazards,       # jax-hazards
 ]
 
 BY_ID = {p.PASS_ID: p for p in ALL_PASSES}

@@ -1,0 +1,538 @@
+"""Shared concurrency model for the lock-order, guarded-field and
+cv-discipline passes.
+
+One walk over every class (plus a pseudo-scope for module level)
+collects, per scope:
+
+  * the lock inventory — attrs assigned from
+    ``threading.Lock/RLock/Condition/Semaphore`` — with
+    ``Condition(self._lock)`` wrappers canonicalised onto the lock they
+    wrap (holding the condition IS holding that lock);
+  * per-method lexical lock contexts: every ``with self._lock:`` nesting
+    is tracked so each attribute access, call and acquisition carries
+    the frozenset of locks held at that point;
+  * the call graph: self-calls, calls through typed attributes
+    (``self._store = StoreServer(...)`` then ``self._store.get()``),
+    module-function calls, and every other call with its held set;
+  * thread entry points: ``Thread(target=...)``/``Timer``/
+    ``executor.submit`` targets, ``do_*`` handler methods, and ``run``
+    on ``threading.Thread`` subclasses.  Nested ``def``s handed to a
+    thread become pseudo-methods (named ``outer.inner``) whose bodies
+    start with an EMPTY held set — the lexical context at the ``def``
+    site does not survive into the thread.
+
+The model is memoised on the Index (``index._conc``), so the three
+passes share one walk.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.analyze.passes._util import dotted, func_name, terminal
+
+LOCK_FACTORIES = {"Lock": "lock", "RLock": "rlock",
+                  "Condition": "condition", "Semaphore": "semaphore",
+                  "BoundedSemaphore": "semaphore"}
+
+# method calls that mutate the receiver: `self._pending.append(x)` is a
+# WRITE to _pending for guard-inference purposes
+MUTATORS = {"append", "appendleft", "extend", "insert", "pop", "popleft",
+            "remove", "discard", "clear", "add", "update", "setdefault",
+            "popitem", "sort", "reverse", "rotate", "put", "put_nowait"}
+
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# suffix disambiguating a module lock injected into a class whose own
+# attrs include the same name (never a valid identifier tail)
+_SHARED_MARK = "@module"
+
+
+def _strip_shared(attr):
+    return attr[:-len(_SHARED_MARK)] if attr.endswith(_SHARED_MARK) \
+        else attr
+
+
+@dataclass
+class Access:
+    """One `self.<attr>` touch inside a method body."""
+    attr: str
+    lineno: int
+    kind: str                 # "read" | "write"
+    held: frozenset           # canonical lock attrs held lexically
+    method: str               # owning (pseudo-)method name
+    node: ast.AST = None
+
+
+@dataclass
+class Acquire:
+    """One `with self.<lock>:` entry."""
+    attr: str                 # canonical lock attr being acquired
+    raw_attr: str             # as written (the condition, if wrapped)
+    lineno: int
+    held: frozenset           # held BEFORE this acquisition
+    method: str
+
+
+@dataclass
+class CallSite:
+    callee: str               # terminal name of the called function
+    kind: str                 # "self" | "attr" | "module" | "other"
+    obj_attr: str | None      # for kind="attr": the receiver attr/name
+    obj_term: str | None      # terminal of the receiver, any kind
+    lineno: int
+    held: frozenset
+    method: str
+    node: ast.Call = None
+
+
+@dataclass
+class MethodModel:
+    name: str                 # dotted for nested defs ("m.inner")
+    node: ast.AST
+    accesses: list = field(default_factory=list)
+    acquires: list = field(default_factory=list)
+    calls: list = field(default_factory=list)
+    is_nested: bool = False
+
+
+@dataclass
+class ScopeModel:
+    mod: object               # core.Module
+    name: str                 # class name, or "<module>"
+    node: ast.AST
+    is_module: bool = False
+    bases: list = field(default_factory=list)      # dotted base names
+    locks: dict = field(default_factory=dict)      # attr -> factory kind
+    cv_lock: dict = field(default_factory=dict)    # condition -> wrapped lock
+    attr_types: dict = field(default_factory=dict)  # attr -> class name
+    methods: dict = field(default_factory=dict)    # name -> MethodModel
+    thread_entries: set = field(default_factory=set)
+    # module-global locks visible inside this class's methods (`with
+    # _completer_lock:` in a method) — same lock OBJECT as the module
+    # scope's, so qual() maps them onto the module's identity.  When a
+    # module lock's name collides with one of the class's OWN lock
+    # attrs, the injected token carries the _SHARED_MARK suffix so the
+    # two never alias in a held-set.
+    shared_locks: set = field(default_factory=set)
+    # bare module-lock name -> token under which it lives in locks
+    module_lock_alias: dict = field(default_factory=dict)
+
+    def canon(self, attr):
+        """Canonical lock name: a Condition wrapping self._lock IS
+        self._lock for held-set purposes."""
+        return self.cv_lock.get(attr, attr)
+
+    @property
+    def key(self):
+        return (self.mod.rel, self.name)
+
+    def qual(self, attr):
+        """Cross-scope lock identity: (scope key, attr) — with a
+        module-global lock used inside a class method resolving to the
+        MODULE scope (under its bare module name), so held-sets
+        propagated between a class and its module's functions agree."""
+        if attr in self.shared_locks:
+            return ((self.mod.rel, "<module>"), _strip_shared(attr))
+        return (self.key, attr)
+
+    def display(self, attr):
+        if self.is_module or attr in self.shared_locks:
+            return f"{self.mod.rel}.{_strip_shared(attr)}"
+        return f"{self.name}.{attr}"
+
+    def condition_locks(self):
+        """Canonical lock attrs whose critical sections gate Condition
+        waiters (a bare Condition, or the lock a Condition wraps)."""
+        out = {a for a, k in self.locks.items() if k == "condition"
+               and a not in self.cv_lock}
+        out |= set(self.cv_lock.values())
+        return out
+
+
+class ConcModel:
+    """All scopes across the corpus + cross-scope call resolution."""
+
+    def __init__(self):
+        self.scopes: list[ScopeModel] = []
+        # class name -> ScopeModel; names colliding across modules are
+        # dropped (resolution would be a guess)
+        self.by_class: dict[str, ScopeModel] = {}
+        self._ambiguous: set[str] = set()
+        self._mod_scope: dict[str, ScopeModel] = {}
+
+    def add(self, scope: ScopeModel):
+        self.scopes.append(scope)
+        if scope.is_module:
+            self._mod_scope[scope.mod.rel] = scope
+        elif scope.name in self.by_class:
+            self._ambiguous.add(scope.name)
+            del self.by_class[scope.name]
+        elif scope.name not in self._ambiguous:
+            self.by_class[scope.name] = scope
+
+    def class_scopes(self):
+        return [s for s in self.scopes if not s.is_module]
+
+    def resolve_call(self, scope: ScopeModel, call: CallSite):
+        """(scope, MethodModel) the call lands in, or None when the
+        target is outside the model."""
+        if call.kind == "self":
+            m = scope.methods.get(call.callee)
+            return (scope, m) if m else None
+        if call.kind == "attr":
+            cls = scope.attr_types.get(call.obj_attr)
+            target = self.by_class.get(cls) if cls else None
+            if target:
+                m = target.methods.get(call.callee)
+                return (target, m) if m else None
+            return None
+        if call.kind == "module":
+            mscope = self._mod_scope.get(scope.mod.rel)
+            if mscope:
+                m = mscope.methods.get(call.callee)
+                return (mscope, m) if m else None
+        return None
+
+
+def _lock_factory(value):
+    """'lock'/'condition'/... when `value` is a threading factory call."""
+    if isinstance(value, ast.Call):
+        return LOCK_FACTORIES.get(func_name(value))
+    return None
+
+
+def _walk_skip_nested_classes(root):
+    """ast.walk, but don't descend into ClassDefs other than `root`
+    (a nested class's self is not the outer class's self)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef) and child is not root:
+                continue
+            stack.append(child)
+
+
+class _ScopeWalker:
+    """Walks one scope's methods tracking the lexical held set."""
+
+    def __init__(self, scope: ScopeModel, entry_marks):
+        self.scope = scope
+        self.entry_marks = entry_marks      # list[(class|None, name)]
+        self.local_types = {}               # per-method: var -> ClassName
+
+    def _owner_attr(self, expr):
+        """`self.X` -> 'X' in a class scope; bare `X` at module scope.
+        Inside a class, a bare name matching one of the module's locks
+        also resolves (`with _completer_lock:` in a method)."""
+        if self.scope.is_module:
+            return expr.id if isinstance(expr, ast.Name) else None
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return expr.attr
+        if isinstance(expr, ast.Name):
+            return self.scope.module_lock_alias.get(expr.id)
+        return None
+
+    # -- per-method walk -----------------------------------------------------
+
+    def walk_method(self, meth: MethodModel):
+        self.local_types = {}
+        self._walk(meth.node, frozenset(), meth)
+
+    def _walk(self, node, held, meth):
+        for child in ast.iter_child_nodes(node):
+            self._dispatch(child, held, meth)
+
+    def _dispatch(self, node, held, meth):
+        if isinstance(node, _DEFS):
+            sub = MethodModel(name=f"{meth.name}.{node.name}", node=node,
+                              is_nested=True)
+            self.scope.methods[sub.name] = sub
+            # deferred execution: the def-site held set does not apply
+            self._walk(node, frozenset(), sub)
+            return
+        if isinstance(node, (ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            self._walk_with(node, held, meth)
+            return
+        self._visit(node, held, meth)
+        self._walk(node, held, meth)
+
+    def _walk_with(self, node, held, meth):
+        new_held = held
+        for item in node.items:
+            self._dispatch(item.context_expr, held, meth)
+            attr = self._owner_attr(item.context_expr)
+            if attr and self.scope.locks.get(attr) not in (None,
+                                                           "semaphore"):
+                canon = self.scope.canon(attr)
+                meth.acquires.append(Acquire(
+                    attr=canon, raw_attr=attr, lineno=node.lineno,
+                    held=new_held, method=meth.name))
+                new_held = new_held | {canon}
+        for stmt in node.body:
+            self._dispatch(stmt, new_held, meth)
+
+    # -- node classification -------------------------------------------------
+
+    def _visit(self, node, held, meth):
+        if isinstance(node, ast.Call):
+            self._visit_call(node, held, meth)
+        elif isinstance(node, ast.Assign):
+            self._note_types(node)
+        attr = self._owner_attr(node)
+        if attr is not None:
+            self._visit_owner_access(node, attr, held, meth)
+
+    def _note_types(self, assign):
+        """`self.x = ClassName(...)` and `v = ClassName(...)` feed the
+        attr/local type tables used to resolve cross-object calls."""
+        if not isinstance(assign.value, ast.Call):
+            return
+        cls = func_name(assign.value)
+        if not cls or not cls.lstrip("_")[:1].isupper():
+            return      # class names only (incl. private _PyStoreServer)
+        for t in assign.targets:
+            a = self._owner_attr(t)
+            if a:
+                self.scope.attr_types[a] = cls
+            if isinstance(t, ast.Name):
+                self.local_types[t.id] = cls
+
+    def _visit_call(self, call, held, meth):
+        self._mark_thread_targets(call, meth)
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            obj_term = terminal(f.value)
+            if not self.scope.is_module \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == "self":
+                kind, obj_attr = "self", None
+            else:
+                obj_attr = self._owner_attr(f.value)
+                kind = "attr" if obj_attr is not None else "other"
+            callee = f.attr
+        elif isinstance(f, ast.Name):
+            kind, obj_attr, obj_term, callee = "module", None, None, f.id
+        else:
+            return
+        meth.calls.append(CallSite(
+            callee=callee, kind=kind, obj_attr=obj_attr,
+            obj_term=obj_term, lineno=call.lineno, held=held,
+            method=meth.name, node=call))
+
+    def _mark_thread_targets(self, call, meth):
+        """Thread(target=X) / Timer(t, X) / pool.submit(X, ...) mark X
+        as a thread entry point.  Marks carry the scope OBJECT when the
+        target is local (`self._tick`, a nested def) so two classes
+        sharing a name cannot swallow each other's entries; only
+        local-var-typed targets (`srv = Server(); Thread(target=
+        srv.drain)`) go through the class-name table."""
+        name = func_name(call)
+        cands = []
+        if name in ("Thread", "Timer"):
+            cands += [kw.value for kw in call.keywords
+                      if kw.arg in ("target", "function")]
+            if name == "Timer" and len(call.args) >= 2:
+                cands.append(call.args[1])
+        elif name == "submit" and call.args:
+            cands.append(call.args[0])
+        for t in cands:
+            a = self._owner_attr(t)
+            if a is not None and not self.scope.is_module:
+                self.entry_marks.append((self.scope, a))
+            elif isinstance(t, ast.Name):
+                nested = f"{meth.name}.{t.id}"
+                if any(isinstance(n, _DEFS) and n.name == t.id
+                       for n in ast.walk(meth.node)):
+                    self.entry_marks.append((self.scope, nested))
+                else:
+                    self.entry_marks.append((None, t.id))
+            elif isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name):
+                cls = self.local_types.get(t.value.id)
+                if cls:
+                    self.entry_marks.append((cls, t.attr))
+
+    def _visit_owner_access(self, node, attr, held, meth):
+        scope = self.scope
+        if scope.is_module:
+            return          # guarded-field is a class-scope analysis
+        if attr in scope.locks or attr in scope.methods:
+            return
+        parent = getattr(node, "parent", None)
+        if isinstance(parent, ast.Call) and parent.func is node:
+            return          # self.m() — recorded as a call instead
+        kind = "read"
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            kind = "write"
+        elif isinstance(parent, ast.Attribute) and parent.value is node:
+            gp = getattr(parent, "parent", None)
+            if isinstance(gp, ast.Call) and gp.func is parent \
+                    and parent.attr in MUTATORS:
+                kind = "write"
+        elif isinstance(parent, ast.Subscript) and parent.value is node \
+                and isinstance(parent.ctx, (ast.Store, ast.Del)):
+            kind = "write"
+        meth.accesses.append(Access(attr=attr, lineno=node.lineno,
+                                    kind=kind, held=held,
+                                    method=meth.name, node=node))
+
+
+def _collect_locks(scope: ScopeModel):
+    """Lock inventory: every `self.X = threading.Lock()` (class scope)
+    or global `X = threading.Lock()` (module scope) in the scope."""
+
+    def match(t):
+        if scope.is_module:
+            return t.id if isinstance(t, ast.Name) else None
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            return t.attr
+        return None
+
+    if scope.is_module:
+        # module-body assignments, plus reassignments under a `global`
+        # declaration elsewhere in the module
+        globals_ = {n for g in ast.walk(scope.node)
+                    if isinstance(g, ast.Global) for n in g.names}
+        body_names = {t.id for n in scope.node.body
+                      if isinstance(n, ast.Assign)
+                      for t in n.targets if isinstance(t, ast.Name)}
+        allowed = globals_ | body_names
+        nodes = [n for n in ast.walk(scope.node)
+                 if isinstance(n, ast.Assign)]
+    else:
+        allowed = None
+        nodes = [n for n in _walk_skip_nested_classes(scope.node)
+                 if isinstance(n, ast.Assign)]
+    for node in nodes:
+        kind = _lock_factory(node.value)
+        if kind is None:
+            continue
+        for t in node.targets:
+            a = match(t)
+            if not a or (allowed is not None and a not in allowed):
+                continue
+            scope.locks[a] = kind
+            if kind == "condition" and node.value.args:
+                w = match(node.value.args[0])
+                if w:
+                    scope.cv_lock[a] = w
+
+
+def build(index) -> ConcModel:
+    """Build (or fetch the memoised) corpus concurrency model."""
+    cached = getattr(index, "_conc", None)
+    if cached is not None:
+        return cached
+    model = ConcModel()
+    entry_marks = []              # (class name | None for module, meth)
+    walkers = []
+    for mod in index.modules:
+        if mod.tree is None:
+            continue
+        mscope = ScopeModel(mod=mod, name="<module>", node=mod.tree,
+                            is_module=True)
+        _collect_locks(mscope)
+        for fn in mod.tree.body:
+            if isinstance(fn, _DEFS):
+                mscope.methods[fn.name] = MethodModel(name=fn.name,
+                                                      node=fn)
+        model.add(mscope)
+        walkers.append(_ScopeWalker(mscope, entry_marks))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            scope = ScopeModel(mod=mod, name=node.name, node=node,
+                               bases=[dotted(b) or "" for b in node.bases])
+            _collect_locks(scope)
+            # module-global locks are visible (and common — watchdog's
+            # _completer_cv) inside class methods: inject them, marked
+            # shared so qual() keeps one identity with the module
+            # scope.  A name the class ALSO owns as its own lock attr
+            # gets the _SHARED_MARK token — the two are different
+            # locks and must not alias in a held-set.
+            for lname, lkind in mscope.locks.items():
+                token = lname if lname not in scope.locks \
+                    else lname + _SHARED_MARK
+                scope.locks[token] = lkind
+                scope.shared_locks.add(token)
+                scope.module_lock_alias[lname] = token
+            for lcv, ltgt in mscope.cv_lock.items():
+                cv_tok = scope.module_lock_alias.get(lcv)
+                tgt_tok = scope.module_lock_alias.get(ltgt)
+                if cv_tok and tgt_tok:
+                    scope.cv_lock[cv_tok] = tgt_tok
+            for item in node.body:
+                if isinstance(item, _DEFS):
+                    scope.methods[item.name] = MethodModel(
+                        name=item.name, node=item)
+            model.add(scope)
+            walkers.append(_ScopeWalker(scope, entry_marks))
+    for w in walkers:
+        for meth in list(w.scope.methods.values()):
+            if not meth.is_nested:
+                w.walk_method(meth)
+    # fold the entry marks into their scopes
+    for scope in model.scopes:
+        for base in scope.bases:
+            if base and base.split(".")[-1] == "Thread" \
+                    and "run" in scope.methods:
+                scope.thread_entries.add("run")
+        for name in scope.methods:
+            if name.split(".")[-1].startswith("do_"):
+                scope.thread_entries.add(name)
+    for owner, meth in entry_marks:
+        if owner is None:
+            for scope in model.scopes:
+                if scope.is_module and meth in scope.methods:
+                    scope.thread_entries.add(meth)
+        elif isinstance(owner, ScopeModel):
+            if meth in owner.methods:
+                owner.thread_entries.add(meth)
+        else:
+            scope = model.by_class.get(owner)
+            if scope and meth in scope.methods:
+                scope.thread_entries.add(meth)
+    index._conc = model
+    return model
+
+
+def reachable_contexts(model: ConcModel, seeds):
+    """Worklist over the call graph: which held-set contexts can each
+    method run under, starting from `seeds` (an iterable of (scope,
+    method name) pairs that run with NOTHING held — thread entry
+    points, and optionally externally-callable methods)?  Returns
+    {(scope key, method name): set[frozenset]}.  A call site adds its
+    lexical held set on top of the caller's context.  Context members
+    are (scope key, canonical attr) pairs — two classes both naming
+    their lock `_lock` must not alias."""
+    contexts: dict[tuple, set] = {}
+    work = []
+    for scope, name in seeds:
+        key = (scope.key, name)
+        if frozenset() not in contexts.setdefault(key, set()):
+            contexts[key].add(frozenset())
+            work.append((scope, name, frozenset()))
+    while work:
+        scope, name, ctx = work.pop()
+        meth = scope.methods.get(name)
+        if meth is None:
+            continue
+        for call in meth.calls:
+            resolved = model.resolve_call(scope, call)
+            if not resolved:
+                continue
+            tscope, tmeth = resolved
+            nctx = frozenset(ctx | {scope.qual(h) for h in call.held})
+            key = (tscope.key, tmeth.name)
+            if nctx not in contexts.setdefault(key, set()):
+                contexts[key].add(nctx)
+                work.append((tscope, tmeth.name, nctx))
+    return contexts

@@ -1,0 +1,147 @@
+"""Pass `cv-discipline` — the three classic Condition mistakes.
+
+Over every `threading.Condition` in the shared concurrency model
+(class attrs and module globals alike):
+
+  1. `cv.wait()` not inside a `while` predicate loop.  Spurious wakeups
+     and stolen wakeups are real; an `if`-guarded or bare wait observes
+     a predicate that may already be false again.  `wait_for` carries
+     its own loop and is exempt.
+  2. `cv.notify()` / `notify_all()` / `wait()` on a path that cannot be
+     holding the condition's lock — a guaranteed RuntimeError("cannot
+     notify on un-acquired lock") the first time that path runs.  The
+     check is path-aware: a private helper that is only ever called
+     from inside `with cv:` blocks is fine.
+  3. Replies/IO performed while holding a condition's critical section
+     — `sendall`/`send_response`/`wfile.write` and friends under the
+     cv convoy every waiter behind one slow peer (the PR 8 store-server
+     convoy, generalized).
+"""
+from __future__ import annotations
+
+import ast
+
+from tools.analyze.core import Finding
+from tools.analyze.passes import _conc
+from tools.analyze.passes._util import call_snippet
+
+PASS_ID = "cv-discipline"
+DESCRIPTION = ("Condition.wait needs a while-predicate loop and the "
+               "lock held; notify needs the lock; no replies/IO inside "
+               "a condition's critical section")
+
+# reply/IO calls that convoy cv waiters when made under the condition
+_IO_ATTRS = {"sendall", "send_response", "send_header", "end_headers",
+             "send_error"}
+_IO_STREAMY = {"write", "flush", "send"}
+_IO_BASES = {"wfile", "sock", "socket", "conn", "connection", "client",
+             "stream", "resp", "response"}
+
+
+def _cv_calls(scope):
+    """CallSites on this scope's Condition attrs."""
+    cvs = {a for a, k in scope.locks.items() if k == "condition"}
+    for meth in scope.methods.values():
+        for call in meth.calls:
+            if call.kind in ("attr", "other") and call.obj_attr in cvs:
+                yield call, call.obj_attr, meth
+
+
+def _in_while(node, fn_node):
+    """Is `node` (a Call) lexically inside a While within its function?"""
+    cur = getattr(node, "parent", None)
+    while cur is not None and cur is not fn_node:
+        if isinstance(cur, ast.While):
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return False
+        cur = getattr(cur, "parent", None)
+    return False
+
+
+def _seeds(model):
+    # resolve every call once: a module helper invoked only from a
+    # class method's `with cv:` block IS called — it must inherit that
+    # context, not be seeded as externally-callable-bare
+    called = set()
+    for scope in model.scopes:
+        for m in scope.methods.values():
+            for c in m.calls:
+                r = model.resolve_call(scope, c)
+                if r:
+                    called.add((r[0].key, r[1].name))
+    for scope in model.scopes:
+        for name in scope.thread_entries:
+            yield scope, name
+        for name, meth in scope.methods.items():
+            public = not name.startswith("_") and not meth.is_nested
+            if public or ((scope.key, name) not in called
+                          and not meth.is_nested):
+                yield scope, name
+
+
+def run(index):
+    model = _conc.build(index)
+    contexts = None     # built lazily: most corpora have few cv sites
+
+    def lockless_path(scope, meth, call, lock):
+        """True when some reachable context runs `meth` without `lock`
+        held at this call site (lexically or from any caller)."""
+        nonlocal contexts
+        if lock in call.held:
+            return False
+        if contexts is None:
+            contexts = _conc.reachable_contexts(model, _seeds(model))
+        ctxs = contexts.get((scope.key, meth.name))
+        if not ctxs:
+            return True     # unreached ≈ externally called bare
+        qual = scope.qual(lock)
+        return any(qual not in c for c in ctxs)
+
+    for scope in model.scopes:
+        for call, cv, meth in _cv_calls(scope):
+            lock = scope.canon(cv)
+            if call.callee == "wait":
+                fn = meth.node
+                if not _in_while(call.node, fn):
+                    yield Finding(
+                        PASS_ID, scope.mod.rel, call.lineno,
+                        f"`{scope.display(cv)}.wait()` outside a "
+                        "`while <predicate>:` loop — spurious/stolen "
+                        "wakeups make a bare or if-guarded wait observe "
+                        "a predicate that is already false; re-check in "
+                        "a while loop (or use wait_for)")
+            if call.callee in ("notify", "notify_all", "wait"):
+                if lockless_path(scope, meth, call, lock):
+                    yield Finding(
+                        PASS_ID, scope.mod.rel, call.lineno,
+                        f"`{scope.display(cv)}.{call.callee}()` on a "
+                        "path that does not hold the condition's lock "
+                        "— RuntimeError('cannot notify/wait on "
+                        "un-acquired lock') the first time this path "
+                        "runs; wrap it in `with "
+                        f"{scope.display(cv)}:`")
+
+        # IO inside any condition's critical section
+        gates = scope.condition_locks()
+        if not gates:
+            continue
+        for meth in scope.methods.values():
+            for call in meth.calls:
+                held_cvs = gates & call.held
+                if not held_cvs:
+                    continue
+                is_io = call.callee in _IO_ATTRS or (
+                    call.callee in _IO_STREAMY
+                    and call.obj_term in _IO_BASES)
+                if not is_io:
+                    continue
+                cv = sorted(held_cvs)[0]
+                yield Finding(
+                    PASS_ID, scope.mod.rel, call.lineno,
+                    f"{call_snippet(call.node)}: reply/IO while "
+                    f"holding `{scope.display(cv)}` (a Condition's "
+                    "critical section) — one slow peer convoys every "
+                    "waiter (the PR 8 store-server bug); buffer under "
+                    "the lock, send after release")

@@ -1,0 +1,124 @@
+"""Pass `guarded-field` — fields that are USUALLY locked must ALWAYS be
+locked on cross-thread paths.
+
+For every class owning at least one lock, each mutable attr's owning
+lock is inferred from its writes: if at least two non-`__init__` writes
+happen under one specific lock and more writes are guarded by it than
+not, that lock owns the attr (majority vote — the bug being hunted IS
+the minority unguarded write, so demanding unanimity would hide it).
+
+Accesses are then checked against the owner on every path a second
+thread can take: thread entry points (`Thread(target=)`, `do_*`
+handlers, `Thread.run`, timers/executors) and public methods of
+lock-owning classes (an object with a lock is shared by construction)
+start with nothing held, and held sets propagate through resolvable
+calls.  A read or write of an owned attr reachable on such a path
+without the owner held is the exact shape of the PR 12 quota-bypass
+race (`_queued_by_tenant` reading a swapped-out `_pending`).
+
+`__init__` is exempt (the object is not shared yet), as are attrs whose
+writes never synchronize (no inferred owner — plain config state).
+"""
+from __future__ import annotations
+
+from tools.analyze.core import Finding
+from tools.analyze.passes import _conc
+
+PASS_ID = "guarded-field"
+DESCRIPTION = ("attr guarded by a lock on most writes but touched "
+               "without it on a thread-reachable path")
+
+# object lifecycle methods where unshared access is the norm
+_EXEMPT_METHODS = {"__init__", "__new__", "__post_init__", "__repr__",
+                   "__str__", "__getstate__", "__setstate__",
+                   "__del__", "__len__"}
+
+
+def _infer_owners(scope):
+    """attr -> (owner canonical lock, guarded, unguarded) for attrs with
+    a majority-guarded write pattern."""
+    writes = {}
+    for meth in scope.methods.values():
+        base = meth.name.split(".")[0]
+        if base in _EXEMPT_METHODS:
+            continue
+        for a in meth.accesses:
+            if a.kind == "write":
+                writes.setdefault(a.attr, []).append(a)
+    owners = {}
+    for attr, ws in writes.items():
+        by_lock = {}
+        for w in ws:
+            for h in w.held:
+                by_lock[h] = by_lock.get(h, 0) + 1
+        if not by_lock:
+            continue
+        lock, guarded = max(sorted(by_lock.items()),
+                            key=lambda kv: kv[1])
+        unguarded = sum(1 for w in ws if lock not in w.held)
+        if guarded >= 2 and guarded > unguarded:
+            owners[attr] = (lock, guarded, unguarded)
+    return owners
+
+
+def _seeds(model):
+    for scope in model.class_scopes():
+        if not scope.locks:
+            continue
+        for name in scope.thread_entries:
+            yield scope, name
+        for name, meth in scope.methods.items():
+            # public surface of a lock-owning class: callable from any
+            # thread with nothing held
+            if not name.startswith("_") and not meth.is_nested:
+                yield scope, name
+    for scope in model.scopes:
+        if scope.is_module:
+            for name in scope.thread_entries:
+                yield scope, name
+
+
+def run(index):
+    # one finding per (file, line): `self.x += 1` is a read AND a write
+    # on the same line, but one diagnostic
+    seen = set()
+    for f in _findings(index):
+        if f.key() not in seen:
+            seen.add(f.key())
+            yield f
+
+
+def _findings(index):
+    model = _conc.build(index)
+    contexts = _conc.reachable_contexts(model, _seeds(model))
+    for scope in model.class_scopes():
+        if not scope.locks:
+            continue
+        owners = _infer_owners(scope)
+        if not owners:
+            continue
+        for meth in scope.methods.values():
+            base = meth.name.split(".")[0]
+            if base in _EXEMPT_METHODS:
+                continue
+            ctxs = contexts.get((scope.key, meth.name))
+            if not ctxs:
+                continue        # never reached from a thread path
+            for a in meth.accesses:
+                owned = owners.get(a.attr)
+                if not owned:
+                    continue
+                lock, guarded, unguarded = owned
+                if lock in a.held:
+                    continue
+                qual = scope.qual(lock)
+                if all(qual in c for c in ctxs):
+                    continue    # every thread path in holds the owner
+                yield Finding(
+                    PASS_ID, scope.mod.rel, a.lineno,
+                    f"{a.kind} of `{scope.name}.{a.attr}` without "
+                    f"`{scope.display(lock)}` held — {guarded} of "
+                    f"{guarded + unguarded} writes guard it with that "
+                    f"lock, and `{meth.name}` runs on a thread path "
+                    "that does not hold it (torn/stale state; the "
+                    "PR 12 _pending-swap shape)")

@@ -1,0 +1,317 @@
+"""Pass `jax-hazards` — donation misuse and retrace bait.
+
+Two families, both invisible until they corrupt results or melt the
+recompile counters PR 9 labels by shape:
+
+DONATION.  `jax.jit(f, donate_argnums=(0,))` invalidates the caller's
+buffer at position 0 the moment the call runs.  For every jit wrapper
+whose donate positions are LITERAL (dynamic `donate_argnums=donate` is
+untrackable and skipped), each call site is checked for the two
+use-after-donate shapes:
+
+  * the donated variable is read again later in the same function
+    (unless the call rebinds it — `state = step(state, batch)` is the
+    sanctioned idiom);
+  * the call sits in a loop and the donated variable is never rebound
+    inside that loop, so iteration 2 passes a deleted buffer.
+
+RETRACE.  `jax.jit` caches per wrapper object, so a wrapper built per
+call never hits its cache:
+
+  * `jax.jit(f)(x)` immediately invoked inside a function;
+  * a wrapper bound to a local and only ever called there (returning
+    it or storing it to `self.*`/a container is the factory/cache
+    pattern and fine);
+  * calls that yield a fresh Python value every invocation
+    (`time.time`, `random.*`, `uuid4`, ...) inside a jit-traced body —
+    the value is baked in as a constant at trace time: silently stale,
+    and different on every retrace.
+"""
+from __future__ import annotations
+
+import ast
+
+from tools.analyze.core import Finding
+from tools.analyze.passes._util import (call_snippet, dotted, stmt_of,
+                                        walk_no_defs)
+
+PASS_ID = "jax-hazards"
+DESCRIPTION = ("use-after-donate at donate_argnums call sites; "
+               "per-call jit wrappers and trace-time-constant calls "
+               "that force retraces")
+
+_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_LOOPS = (ast.For, ast.AsyncFor, ast.While)
+
+# callables whose result varies per call: traced to a stale constant
+_VARYING = {"time.time", "time.monotonic", "time.perf_counter",
+            "time.time_ns", "datetime.now", "datetime.utcnow",
+            "datetime.datetime.now", "datetime.datetime.utcnow",
+            "random.random", "random.randint", "random.uniform",
+            "random.randrange", "random.choice", "uuid.uuid4",
+            "uuid4", "os.urandom"}
+_VARYING_PREFIXES = ("np.random.", "numpy.random.")
+
+
+def _is_jit_func(expr):
+    """`jax.jit` / `jit` / `pjit` (or a functools.partial of one)."""
+    d = dotted(expr)
+    if d and (d in ("jit", "pjit") or d.endswith(".jit")
+              or d.endswith(".pjit")):
+        return True
+    if isinstance(expr, ast.Call):
+        pd = dotted(expr.func)
+        if pd in ("partial", "functools.partial") and expr.args:
+            return _is_jit_func(expr.args[0])
+    return False
+
+
+def _is_jit_call(node):
+    return isinstance(node, ast.Call) and _is_jit_func(node.func)
+
+
+def _literal_donate(call):
+    """The literal donate positions of a jit call, or None."""
+    for kw in call.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant)
+                and isinstance(e.value, int) for e in v.elts):
+            return tuple(e.value for e in v.elts)
+        return None
+    return None
+
+
+def _enclosing_loop_in(node, fn):
+    """Nearest enclosing loop WITHIN `fn` — stops at any function
+    boundary (the equivalent of cv_discipline's _in_while rule)."""
+    cur = getattr(node, "parent", None)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, _LOOPS):
+            return cur
+        if isinstance(cur, _DEFS + (ast.Lambda,)):
+            return None
+        cur = getattr(cur, "parent", None)
+    return None
+
+
+def _assigned_names(stmt):
+    """Names bound by an assignment statement (targets only)."""
+    out = set()
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    else:
+        return out
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+    return out
+
+
+def _jit_wrappers(root, walk=walk_no_defs):
+    """{name: donate positions} for `name = jax.jit(..,
+    donate_argnums=<literal>)` bindings in `root`'s own body (a
+    function via walk_no_defs, or the module body)."""
+    out = {}
+    for node in walk(root):
+        if not isinstance(node, ast.Assign) or not _is_jit_call(node.value):
+            continue
+        donate = _literal_donate(node.value)
+        if donate is None:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = donate
+    return out
+
+
+def _module_wrappers(mod):
+    """Module-level donate wrappers (`_step = jax.jit(...)` at top
+    level) — callable from every function in the module.  Wrappers
+    cached on `self.*` attrs are out of model (callee types would be a
+    guess)."""
+    return _jit_wrappers(mod.tree, walk=lambda t: t.body)
+
+
+def _check_donation(mod, fn, module_wrappers):
+    # ANY local binding (param, assignment, loop target) shadows a
+    # module-level wrapper of the same name — a local `_step =
+    # jax.jit(g)` without donation must not inherit the module
+    # wrapper's donate positions
+    shadowed = set()
+    for n in walk_no_defs(fn):
+        if isinstance(n, ast.Name) and isinstance(n.ctx,
+                                                  (ast.Store, ast.Del)):
+            shadowed.add(n.id)
+        elif isinstance(n, ast.arg):
+            shadowed.add(n.arg)
+    wrappers = {k: v for k, v in module_wrappers.items()
+                if k not in shadowed}
+    wrappers.update(_jit_wrappers(fn))
+    if not wrappers:
+        return
+    for node in walk_no_defs(fn):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Name) \
+                or node.func.id not in wrappers:
+            continue
+        donate = wrappers[node.func.id]
+        stmt = stmt_of(node)
+        rebound = _assigned_names(stmt) if stmt else set()
+        for pos in donate:
+            if pos >= len(node.args):
+                continue
+            arg = node.args[pos]
+            name = arg.id if isinstance(arg, ast.Name) else None
+            if name is None or name in rebound:
+                continue        # `x = f(x)` rebinding is the idiom
+            # (a) later read in the same function — unless some Store
+            # rebinds the name between the call and the read (a fresh
+            # value, not the donated buffer)
+            stores = [n.lineno for n in walk_no_defs(fn)
+                      if isinstance(n, ast.Name) and n.id == name
+                      and isinstance(n.ctx, ast.Store)]
+            for later in walk_no_defs(fn):
+                if isinstance(later, ast.Name) and later.id == name \
+                        and isinstance(later.ctx, ast.Load) \
+                        and later.lineno > node.lineno \
+                        and later is not arg \
+                        and not any(node.lineno < s <= later.lineno
+                                    for s in stores):
+                    yield Finding(
+                        PASS_ID, mod.rel, later.lineno,
+                        f"`{name}` read after being donated to "
+                        f"`{node.func.id}` (donate_argnums position "
+                        f"{pos}, call at line {node.lineno}) — the "
+                        "buffer is deleted by donation; rebind the "
+                        "result or drop donation")
+                    break
+            # (b) donated in a loop without rebinding — the loop must
+            # be within THIS function (a nested def's parameters are
+            # fresh per call; an outer function's loop does not reuse
+            # the callee's donated arg)
+            loop = _enclosing_loop_in(node, fn)
+            if loop is not None:
+                rebinds = set()
+                for s in ast.walk(loop):
+                    rebinds |= _assigned_names(s) if isinstance(
+                        s, (ast.Assign, ast.AnnAssign, ast.AugAssign)) \
+                        else set()
+                    if isinstance(s, (ast.For, ast.AsyncFor)):
+                        rebinds |= {n.id for n in ast.walk(s.target)
+                                    if isinstance(n, ast.Name)}
+                if name not in rebinds:
+                    yield Finding(
+                        PASS_ID, mod.rel, node.lineno,
+                        f"`{name}` donated to `{node.func.id}` inside "
+                        "a loop without being rebound — iteration 2 "
+                        "passes an already-deleted buffer")
+
+
+def _escapes(fn, name, binding_stmt):
+    """Does local `name` escape `fn`?  Any Load of the name OTHER than
+    as the function of a call counts: returned, yielded, aliased,
+    stored to an attr/subscript/container, or passed as an argument.
+    `f(x)` alone does not escape — that is exactly the call-only shape
+    being hunted."""
+    for n in walk_no_defs(fn):
+        if not (isinstance(n, ast.Name) and n.id == name
+                and isinstance(n.ctx, ast.Load)):
+            continue
+        if stmt_of(n) is binding_stmt:
+            continue
+        p = getattr(n, "parent", None)
+        if isinstance(p, ast.Call) and p.func is n:
+            continue
+        return True
+    return False
+
+
+def _check_retrace_wrappers(mod, fn):
+    """jit wrappers built per call inside `fn`."""
+    for node in walk_no_defs(fn):
+        if not _is_jit_call(node):
+            continue
+        parent = getattr(node, "parent", None)
+        # (a) jax.jit(f)(x): invoked the moment it is built
+        if isinstance(parent, ast.Call) and parent.func is node:
+            yield Finding(
+                PASS_ID, mod.rel, node.lineno,
+                f"{call_snippet(parent)}: jit wrapper built and "
+                "invoked in one expression — a fresh wrapper per call "
+                "never hits the jit cache and retraces every time; "
+                "build it once (module level, __init__, or an lru "
+                "cache)")
+            continue
+        # (b) bound to a local that never escapes: called-only locals
+        if isinstance(parent, ast.Assign) \
+                and len(parent.targets) == 1 \
+                and isinstance(parent.targets[0], ast.Name):
+            name = parent.targets[0].id
+            if not _escapes(fn, name, parent):
+                called = any(
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Name)
+                    and n.func.id == name
+                    for n in walk_no_defs(fn))
+                if called:
+                    yield Finding(
+                        PASS_ID, mod.rel, node.lineno,
+                        f"jit wrapper `{name}` is built and called "
+                        f"inside `{fn.name}` but never cached/returned "
+                        "— every call to the enclosing function "
+                        "retraces; hoist or cache the wrapper")
+
+
+def _jitted_defs(mod):
+    """FunctionDefs that are jit-traced: decorated with jit, or passed
+    by name to a jax.jit(...) call in the module."""
+    names = set()
+    by_name = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, _DEFS):
+            by_name.setdefault(node.name, node)
+            if any(_is_jit_func(dec) or _is_jit_call(dec)
+                   for dec in node.decorator_list):
+                names.add(node.name)
+        elif _is_jit_call(node) and node.args:
+            a0 = node.args[0]
+            if isinstance(a0, ast.Name):
+                names.add(a0.id)
+    return [by_name[n] for n in sorted(names) if n in by_name]
+
+
+def _check_varying_in_traced(mod):
+    for fn in _jitted_defs(mod):
+        for node in walk_no_defs(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if not d:
+                continue
+            if d in _VARYING or d.startswith(_VARYING_PREFIXES):
+                yield Finding(
+                    PASS_ID, mod.rel, node.lineno,
+                    f"`{d}()` inside jit-traced `{fn.name}` — the "
+                    "value is frozen at trace time (stale on every "
+                    "cached call, different on every retrace); pass "
+                    "it in as an argument instead")
+
+
+def run(index):
+    for mod in index.modules:
+        if mod.tree is None:
+            continue
+        module_wrappers = _module_wrappers(mod)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, _DEFS):
+                yield from _check_donation(mod, node, module_wrappers)
+                yield from _check_retrace_wrappers(mod, node)
+        yield from _check_varying_in_traced(mod)

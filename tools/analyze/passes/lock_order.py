@@ -1,0 +1,205 @@
+"""Pass `lock-order` — deadlock candidates from the acquired-while-held
+graph (the lockdep idea, statically).
+
+From the shared concurrency model (_conc.py): every `with self._b:`
+reached while `self._a` is lexically held adds edge a -> b, and every
+call made while holding `a` into a method that (transitively) acquires
+`b` — including calls through typed attributes into other classes —
+adds the same edge interprocedurally.  Two findings:
+
+  * a CYCLE in the graph (a -> b somewhere, b -> a somewhere else) is a
+    deadlock candidate: two threads taking the two paths concurrently
+    stall forever.  One finding per cycle, anchored at its lexically
+    first edge.
+  * a SELF-EDGE on a non-reentrant `threading.Lock` (acquire while
+    already held, possibly through a call chain) deadlocks a single
+    thread on its own.  Re-entering an RLock or a Condition (whose
+    default lock is an RLock) is legal and not flagged.
+
+`summarize(index)` renders the whole acquisition-order table — the
+canonical order the corpus actually follows — which the CLI emits into
+the report under --tables/--json.
+"""
+from __future__ import annotations
+
+from tools.analyze.core import Finding
+from tools.analyze.passes import _conc
+
+PASS_ID = "lock-order"
+DESCRIPTION = ("acquired-while-held lock graph: cycles are deadlock "
+               "candidates; re-acquiring a non-reentrant Lock "
+               "self-deadlocks")
+
+
+def _may_acquire(model):
+    """(scope key, method) -> {(lock node, (rel, line, via)), ...} for
+    every lock the method may acquire, transitively through resolvable
+    calls.  A lock node is (scope key, canonical attr, display)."""
+    direct = {}
+    edges = {}          # method key -> resolved callee method keys
+    meta = {}           # method key -> (scope, MethodModel)
+    for scope in model.scopes:
+        for meth in scope.methods.values():
+            key = (scope.key, meth.name)
+            meta[key] = (scope, meth)
+            direct[key] = {
+                ((*scope.qual(a.attr), scope.display(a.attr)),
+                 (scope.mod.rel, a.lineno, meth.name))
+                for a in meth.acquires}
+            outs = set()
+            for call in meth.calls:
+                resolved = model.resolve_call(scope, call)
+                if resolved:
+                    outs.add((resolved[0].key, resolved[1].name))
+            edges[key] = outs
+    acq = {k: set(v) for k, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, outs in edges.items():
+            for out in outs:
+                extra = acq.get(out, ()) - acq[key]
+                if extra:
+                    acq[key].update(extra)
+                    changed = True
+    return acq, meta
+
+
+def _build_graph(index):
+    """Edges {(a_node, b_node): (rel, line, via, how)} — `a` held when
+    `b` is (or may be) acquired.  Memoised on the index: run() and
+    summarize() share one interprocedural fixpoint."""
+    cached = getattr(index, "_lock_graph", None)
+    if cached is not None:
+        return cached
+    model = _conc.build(index)
+    acq, _meta = _may_acquire(model)
+    graph = {}
+
+    def node(scope, attr):
+        return (*scope.qual(attr), scope.display(attr))
+
+    def add(a, b, site):
+        graph.setdefault((a, b), site)
+
+    for scope in model.scopes:
+        for meth in scope.methods.values():
+            for a in meth.acquires:
+                for h in a.held:
+                    add(node(scope, h), node(scope, a.attr),
+                        (scope.mod.rel, a.lineno, meth.name, "with"))
+            for call in meth.calls:
+                if not call.held:
+                    continue
+                resolved = model.resolve_call(scope, call)
+                if not resolved:
+                    continue
+                ckey = (resolved[0].key, resolved[1].name)
+                for lock_node, _src in acq.get(ckey, ()):
+                    for h in call.held:
+                        add(node(scope, h), lock_node,
+                            (scope.mod.rel, call.lineno, call.method,
+                             f"call {call.callee}()"))
+    index._lock_graph = (model, graph)
+    return model, graph
+
+
+def _cycles(graph):
+    """Strongly connected components with >1 node, plus self-edges.
+    Iterative Tarjan keeps deep chains off the Python stack."""
+    nodes = sorted({n for e in graph for n in e})
+    succs = {n: set() for n in nodes}
+    for a, b in graph:
+        if a != b:
+            succs[a].add(b)
+    idx, low, on, comp = {}, {}, set(), []
+    stack, counter = [], [0]
+    for start in nodes:
+        if start in idx:
+            continue
+        work = [(start, iter(sorted(succs[start])))]
+        idx[start] = low[start] = counter[0]
+        counter[0] += 1
+        stack.append(start)
+        on.add(start)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in idx:
+                    idx[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(succs[w]))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[v] = min(low[v], idx[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == idx[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                if len(scc) > 1:
+                    comp.append(sorted(scc))
+    return comp
+
+
+def run(index):
+    model, graph = _build_graph(index)
+
+    # self-edges: re-acquiring a non-reentrant Lock
+    for (a, b), (rel, line, via, how) in sorted(graph.items(),
+                                                key=lambda kv: kv[1][:2]):
+        if a != b:
+            continue
+        scope = next((s for s in model.scopes if s.key == a[0]), None)
+        kind = scope.locks.get(a[1]) if scope else None
+        if kind != "lock":
+            continue        # RLock/Condition re-entry is legal
+        yield Finding(
+            PASS_ID, rel, line,
+            f"`{a[2]}` is a non-reentrant threading.Lock acquired while "
+            f"already held (via {how} in {via}) — this thread deadlocks "
+            "on itself; use an RLock or restructure the call")
+
+    # cycles between distinct locks
+    for scc in _cycles(graph):
+        in_scc = {e: s for e, s in graph.items()
+                  if e[0] in scc and e[1] in scc and e[0] != e[1]}
+        if not in_scc:
+            continue
+        first = min(in_scc.items(), key=lambda kv: kv[1][:2])
+        (rel, line, via, how) = first[1]
+        order = " -> ".join(n[2] for n in scc)
+        sites = "; ".join(
+            f"{a[2]} -> {b[2]} at {s[0]}:{s[1]} ({s[3]} in {s[2]})"
+            for (a, b), s in sorted(in_scc.items(),
+                                    key=lambda kv: kv[1][:2]))
+        yield Finding(
+            PASS_ID, rel, line,
+            f"lock-order cycle between {order}: {sites} — two threads "
+            "taking these paths concurrently deadlock; pick one "
+            "canonical order and acquire in it everywhere")
+
+
+def summarize(index):
+    """The canonical acquired-while-held table for the report."""
+    _model, graph = _build_graph(index)
+    lines = []
+    for (a, b), (rel, line, via, how) in sorted(
+            graph.items(), key=lambda kv: (kv[0][0][2], kv[0][1][2])):
+        if a == b:
+            continue
+        lines.append(f"{a[2]} -> {b[2]}   [{rel}:{line} {how} in {via}]")
+    return lines
