@@ -483,6 +483,114 @@ def _router_bench():
             s.stop()
 
 
+def _autopilot_bench():
+    """Fleet-autopilot control-loop latency (ISSUE 16): how long the
+    supervisor takes to put a killed replica back in rotation, how
+    long a scale-out lags its trigger, and what a 2-replica rolling
+    weight swap costs in wall time and failed requests (the headline
+    number: 0). Stdlib + a trivial predictor: no jax, no chip."""
+    import json as _json
+    import threading
+    import time
+    import urllib.request
+
+    from paddle_tpu.inference.autopilot import (Autoscaler,
+                                                InProcessLauncher,
+                                                ReplicaSupervisor,
+                                                RolloutController)
+    from paddle_tpu.inference.router import ReplicaRouter
+    from paddle_tpu.inference.serving import PredictorServer
+
+    def pred(inputs):
+        return {"y": np.asarray([[1.0]], np.float32)}
+
+    router = ReplicaRouter()
+    launcher = InProcessLauncher(
+        lambda slot, version: PredictorServer(
+            pred, model_name=f"{slot}@{version}"))
+    sup = ReplicaSupervisor(router, launcher, ready_timeout_s=10.0)
+
+    def pump(cond, timeout=15.0):
+        end = time.perf_counter() + timeout
+        while time.perf_counter() < end:
+            router.probe_all()
+            sup.tick()
+            if cond():
+                return True
+            time.sleep(0.005)
+        return False
+
+    try:
+        for i in range(2):
+            sup.add_slot(f"r{i}", version="v1")
+        router.start(probe=False)
+        pump(lambda: router.in_rotation_count() == 2)
+
+        # restart-to-ready: kill r1, measure until back in rotation
+        launcher.server("r1").stop()
+        t0 = time.perf_counter()
+        ok = pump(lambda: sup.slot_state("r1") == "serving")
+        restart_s = time.perf_counter() - t0 if ok else None
+
+        # scale-out lag: trigger to new-slot-serving
+        asc = Autoscaler(router, sup, max_replicas=3, burn_ticks=1,
+                         cooldown_s=0.0,
+                         signals=lambda: {"ttft_p95_s": None,
+                                          "queue_depth": 1e9,
+                                          "shed_rate": 0.0})
+        t0 = time.perf_counter()
+        asc.tick()
+        ok = pump(lambda: sup.slot_state("auto-1") == "serving")
+        scale_s = time.perf_counter() - t0 if ok else None
+        sup.remove_slot("auto-1")
+
+        # rolling swap under live traffic: duration + failed requests
+        body = _json.dumps({"inputs": {"x": [[1.0, 2.0]]}}).encode()
+        codes, stop = [], threading.Event()
+
+        def traffic():
+            while not stop.is_set():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{router.port}/predict",
+                    data=body,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        r.read()
+                        codes.append(r.status)
+                except urllib.error.HTTPError as e:
+                    codes.append(e.code)
+                except Exception:   # noqa: BLE001 — a hang/reset is a failure to count
+                    codes.append(-1)
+                time.sleep(0.002)
+
+        th = threading.Thread(target=traffic, daemon=True)
+        rc = RolloutController(
+            router, sup, step_timeout_s=15.0,
+            probe_fn=lambda: (router.probe_all(), sup.tick()))
+        th.start()
+        t0 = time.perf_counter()
+        completed = rc.run("v2")
+        rollout_s = time.perf_counter() - t0
+        stop.set()
+        th.join(timeout=30)
+        return {
+            "restart_to_ready_s": (round(restart_s, 3)
+                                   if restart_s is not None else None),
+            "scale_out_lag_s": (round(scale_s, 3)
+                                if scale_s is not None else None),
+            "rollout_duration_s": round(rollout_s, 3),
+            "rollout_completed": bool(completed),
+            "rollout_requests": len(codes),
+            "rollout_failed_requests": sum(1 for c in codes
+                                           if c != 200),
+        }
+    finally:
+        for name in list(sup.slot_names()):
+            sup.remove_slot(name)
+        router.stop()
+
+
 def main():
     import jax
     import paddle_tpu
@@ -620,6 +728,12 @@ def main():
     except Exception as e:           # noqa: BLE001 — never sink the
         train_breakdown = {"error": f"{type(e).__name__}: {e}"}
 
+    # fleet-autopilot control-loop latency (ISSUE 16)
+    try:
+        autopilot = _autopilot_bench()
+    except Exception as e:           # noqa: BLE001 — never sink the
+        autopilot = {"error": f"{type(e).__name__}: {e}"}
+
     print(json.dumps({
         "metric": "llama1b_pretrain_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 2),
@@ -632,7 +746,8 @@ def main():
                   "batch": batch, "seq": seq, "steps": steps,
                   "decode": decode, "fleet": fleet, "router": router,
                   "prefix": prefix, "tenant": tenant,
-                  "train_breakdown": train_breakdown},
+                  "train_breakdown": train_breakdown,
+                  "autopilot": autopilot},
     }))
 
 

@@ -133,6 +133,13 @@ POINTS = {
                               "prefixes stop landing on their pinned "
                               "replica — the prefix-routing tests' "
                               "lever)",
+    "autopilot.launch.fail": "replica spawn raises from the launcher "
+                             "(the supervisor's restart-backoff and "
+                             "crash-loop-quarantine lever)",
+    "autopilot.replica.hang": "a freshly-launched replica never "
+                              "reports alive/ready (launch succeeds "
+                              "but the process wedges before serving "
+                              "— the pre-warm gate's lever)",
     "trainer.grad": "non-finite (NaN) gradient poisoning in the "
                     "compiled train step",
     "io.prefetch.delay": "slow host input pipeline (delay in the "

@@ -94,7 +94,12 @@ Surfaces:
     GET  /debug/replicas       the router's live view: per-replica
                                state/reason/load/breaker/probe
                                counters + a summary (schema in README)
+    GET  /debug/autopilot      supervisor/autoscaler/rollout state when
+                               a FleetAutopilot is attached
+                               (inference/autopilot.py); 404 otherwise
     GET  /stats                request/retry counters, session count
+                               (+ the rollout state machine when an
+                               autopilot is attached)
     GET  /metrics              Prometheus exposition of the router.*
                                family (+ the global registry)
 
@@ -167,7 +172,7 @@ class Replica:
                  "consecutive_fail", "in_flight_router",
                  "probed_in_flight", "probed_queue_depth",
                  "last_probe_t", "last_stats", "ejections", "served",
-                 "tenants")
+                 "tenants", "probation")
 
     def __init__(self, rid, url, breaker):
         self.rid = str(rid)
@@ -193,6 +198,9 @@ class Replica:
         self.last_stats = {}            # newest /stats body (flight rec)
         self.ejections = 0
         self.served = 0
+        self.probation = False          # hold fresh registration to the
+        #                                 flap-damped entry gate (autopilot
+        #                                 relaunches: K clean probes)
         self.tenants = {}               # tenant -> requests served here
         #                                 (bounded; overflow folds into
         #                                 "_other" like the registry)
@@ -274,6 +282,10 @@ class ReplicaRouter:
                                  if prefix_page_size else None)
         self.prefix_capacity = int(prefix_capacity)
         self.prefix_max_pages = int(prefix_max_pages)
+        # optional FleetAutopilot (inference/autopilot.py): set via
+        # attach_autopilot; serves /debug/autopilot + the rollout
+        # block in /stats
+        self.autopilot = None
         self._lock = threading.Lock()
         self._order: list[Replica] = []     # registration order
         self._by_id: dict[str, Replica] = {}
@@ -316,6 +328,12 @@ class ReplicaRouter:
                 if self.path == "/debug/replicas":
                     return outer._reply_json(self, 200,
                                              outer.debug_replicas())
+                if self.path == "/debug/autopilot":
+                    ap = outer.autopilot
+                    if ap is None:
+                        return outer._reply_json(
+                            self, 404, {"error": "no autopilot attached"})
+                    return outer._reply_json(self, 200, ap.debug())
                 if self.path == "/stats":
                     return outer._reply_json(self, 200, outer.stats())
                 if self.path == "/metrics":
@@ -376,13 +394,19 @@ class ReplicaRouter:
         self._thread = None
 
     # -- registry -----------------------------------------------------------
-    def add_replica(self, url, rid=None):
+    def add_replica(self, url, rid=None, probation=False):
         """Register a replica ("host:port"). It enters rotation after
-        its first clean probe (never blindly)."""
+        its first clean probe (never blindly); `probation=True` holds
+        it to the full flap-damped gate instead — `reenter_probes`
+        CONSECUTIVE clean probes — which is how the autopilot registers
+        relaunched/swapped replicas so a cold or sick restart pre-warms
+        behind /readyz instead of eating live traffic off one lucky
+        probe."""
         rid = str(rid if rid is not None else url)
         breaker = CircuitBreaker(failure_threshold=self.breaker_threshold,
                                  reset_after_s=self.breaker_reset_s)
         r = Replica(rid, url, breaker)
+        r.probation = bool(probation)
         with self._lock:
             if rid in self._by_id:
                 raise ValueError(f"replica id {rid!r} already registered")
@@ -392,12 +416,34 @@ class ReplicaRouter:
         return r
 
     def remove_replica(self, rid):
-        """Administratively drop a replica (scale-in). Sessions pinned
-        to it re-pin on their next request."""
+        """Administratively drop a replica (scale-in, supervisor
+        restart, rollout swap). Everything keyed on it goes with it:
+        the Replica record (and its breaker — a later `add_replica`
+        under the same id starts with a FRESH closed breaker, never an
+        inherited open one) plus every session/prefix pin pointing at
+        it. The unbind is counted into the rebind counters here — the
+        pinned key's next request silently creates a fresh pin, so
+        purge time is the only point the forced re-pin is observable."""
+        rid = str(rid)
         with self._lock:
-            r = self._by_id.pop(str(rid), None)
+            r = self._by_id.pop(rid, None)
             if r is not None:
                 self._order.remove(r)
+                dead_sessions = [k for k, v in self._affinity.items()
+                                 if v == rid]
+                for k in dead_sessions:
+                    del self._affinity[k]
+                dead_keys = [k for k, v in self._prefix.items()
+                             if v == rid]
+                for k in dead_keys:
+                    del self._prefix[k]
+                if dead_sessions:
+                    self.metrics.inc("router.affinity.rebinds",
+                                     len(dead_sessions))
+                if dead_keys:
+                    # one event per removal, matching the per-request
+                    # (not per-chain-key) grain of the lazy rebind path
+                    self.metrics.inc("router.prefix.rebinds")
                 self._refresh_gauges_locked()
         return r is not None
 
@@ -459,6 +505,8 @@ class ReplicaRouter:
                     cls = "saturated"
                 elif reason == "draining":
                     cls = "draining"
+                elif reason == "warming":
+                    cls = "warming"
                 elif reason.startswith("breaker_"):
                     cls = "breaker"
                 else:
@@ -507,10 +555,12 @@ class ReplicaRouter:
                 r.probed_queue_depth = int(numbers.get(
                     "queue_depth", r.probed_queue_depth))
             if not r.in_rotation:
-                # flap damping: a replica that was ever ejected needs
-                # K consecutive clean probes; a fresh registration
-                # needs one
-                needed = self.reenter_probes if r.ejections > 0 else 1
+                # flap damping: a replica that was ever ejected — or
+                # registered under probation (an autopilot relaunch) —
+                # needs K consecutive clean probes; a fresh ordinary
+                # registration needs one
+                needed = self.reenter_probes \
+                    if (r.ejections > 0 or r.probation) else 1
                 if r.consecutive_ok >= needed:
                     r.in_rotation = True
                     r.reason = cls
@@ -521,6 +571,18 @@ class ReplicaRouter:
             self._refresh_gauges_locked()
             return None
         r.consecutive_ok = 0
+        if cls == "warming":
+            # cold start (model built, first compile pending): neither
+            # overload nor failure — the replica waits out of rotation
+            # without burning the eject budget, and an in-rotation
+            # replica that reports warming (weight swap in place) steps
+            # out like a drain: expected lifecycle, no crash bundle
+            r.consecutive_fail = 0
+            if r.in_rotation:
+                self._eject_locked(r, "warming")
+                return "warming"
+            r.reason = "warming"
+            return None
         if cls == "draining":
             # reason-aware: a draining replica said so itself — eject
             # NOW (it finishes in-flight work; new work routes away)
@@ -552,7 +614,7 @@ class ReplicaRouter:
         carrying the replica's last-known stats (a drain is expected
         lifecycle, not evidence)."""
         self.metrics.inc("router.ejections", reason=reason)
-        if reason == "draining" or not observability.ENABLED:
+        if reason in ("draining", "warming") or not observability.ENABLED:
             return
         try:
             from paddle_tpu.observability import fleet
@@ -1180,6 +1242,7 @@ class ReplicaRouter:
                         else round(now - r.last_probe_t, 4)),
                     "breaker": r.breaker.snapshot(),
                     "ejections": r.ejections,
+                    "probation": r.probation,
                     "served": r.served,
                     "prefix_hit_rate": self._prefix_hit_rate(
                         r.last_stats),
@@ -1217,7 +1280,18 @@ class ReplicaRouter:
                "requests": counts, "retries": retries}
         if self.tenancy is not None:
             out["tenants"] = self.tenant_stats()
+        ap = self.autopilot
+        if ap is not None:
+            # the rollout state machine rides /stats: one scrape shows
+            # where the wave is (autopilot module doc)
+            out["rollout"] = ap.rollout_state()
         return out
+
+    def attach_autopilot(self, autopilot):
+        """Wire a `FleetAutopilot` into this router's surfaces (GET
+        /debug/autopilot, the rollout block in /stats)."""
+        self.autopilot = autopilot
+        return autopilot
 
     def tenant_stats(self):
         """Per-tenant router rows (tenancy configured): request and

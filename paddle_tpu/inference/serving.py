@@ -19,8 +19,14 @@ POST /generate {"ids": [[..]], "max_new_tokens": n, "stream": bool,
 GET  /health   -> liveness (alias of /healthz, kept for compatibility)
 GET  /healthz  -> {"status": "ok"} while the process serves HTTP at all
 GET  /readyz   -> 200 when accepting traffic; 503 {"reason":
-               "draining" | "breaker_open" | "breaker_half_open" |
-               "saturated"} when a load balancer should steer away
+               "draining" | "warming" | "breaker_open" |
+               "breaker_half_open" | "saturated"} when a load balancer
+               should steer away. "warming" (opt-in via
+               start_warming=True, cleared by the first completed
+               request or mark_warm()) is the cold-start signal: the
+               model is BUILT but the first compile hasn't happened —
+               distinct from "saturated" so a fleet supervisor can
+               tell a pre-warming replica from an overloaded one
 GET  /stats    -> JSON counters (admission, sheds, breaker state,
                latency p50/p99, batcher queue)
 GET  /metrics  -> Prometheus text exposition (observability/): request
@@ -499,7 +505,7 @@ class PredictorServer:
                  *, max_concurrent=32, max_queue_depth=64,
                  default_timeout_ms=None, breaker_threshold=5,
                  breaker_reset_s=5.0, retry_after_s=1.0, metrics=None,
-                 fleet=None, tenancy=None):
+                 fleet=None, tenancy=None, start_warming=False):
         self.predictor = predictor
         self.model_name = model_name
         self.generator = generator
@@ -529,6 +535,11 @@ class PredictorServer:
         self._requests = self.metrics.counter("serving.requests")
         self.latency = _RegistryLatency(self.metrics)
         self._draining = False
+        # cold-start gate (module doc): /readyz says "warming" until
+        # the first request completes (= the first compile is paid) or
+        # mark_warm(). Requests are NOT refused while warming — the
+        # first one through is what warms; only routing steers away.
+        self._warming = bool(start_warming)
         self.retry_after_s = float(retry_after_s)
         self.batcher = None
         # batching needs the handle-free run(list) API; a plain callable
@@ -913,6 +924,10 @@ class PredictorServer:
         else:
             self.breaker.record_success()
             self.latency.record(time.monotonic() - t0)
+            if self._warming:
+                # first completed request = first compile paid: the
+                # cold-start gate opens itself
+                self._warming = False
         finally:
             self.admission.release()
             if self.tenants is not None:
@@ -931,9 +946,14 @@ class PredictorServer:
 
     def readiness(self):
         """(ready, reason) for /readyz. Liveness (/healthz) is separate:
-        a draining or breaker-open server is alive but unready."""
+        a draining, warming, or breaker-open server is alive but
+        unready. Reason order = severity order: draining (terminal)
+        beats warming (transient cold start) beats breaker (failing)
+        beats saturated (busy)."""
         if self._draining:
             return False, "draining"
+        if self._warming:
+            return False, "warming"
         bstate = self.breaker.state
         if bstate != CircuitBreaker.CLOSED:
             return False, f"breaker_{bstate}"
@@ -941,12 +961,25 @@ class PredictorServer:
             return False, "saturated"
         return True, "ready"
 
+    def mark_warm(self):
+        """Declare the cold start over (an operator-driven warmup ran
+        out-of-band). The first completed request does this itself."""
+        self._warming = False
+
+    def mark_warming(self):
+        """Re-enter the warming state (an in-place weight swap voids
+        the compile cache; /readyz steers traffic away until the first
+        post-swap request completes). Also the chaos
+        `autopilot.replica.hang` wedge: alive, never ready."""
+        self._warming = True
+
     def stats(self):
         # the registry is the source of truth; /stats keys unchanged
         counts = {dict(k).get("outcome", ""): v
                   for k, v in self._requests.labeled().items()}
         out = {"model": self.model_name,
                "draining": self._draining,
+               "warming": self._warming,
                "in_flight": self.admission.in_flight,
                "queue_depth": self.queue_depth(),
                "capacity": self.admission.capacity,
@@ -1005,6 +1038,7 @@ class PredictorServer:
         m.set_gauge("serving.in_flight", self.admission.in_flight)
         m.set_gauge("serving.capacity", self.admission.capacity)
         m.set_gauge("serving.draining", 1.0 if self._draining else 0.0)
+        m.set_gauge("serving.warming", 1.0 if self._warming else 0.0)
         m.set_gauge("serving.admission.admitted", self.admission.admitted)
         m.set_gauge("serving.admission.rejected", self.admission.rejected)
         b = self.breaker.snapshot()

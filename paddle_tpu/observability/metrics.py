@@ -151,6 +151,10 @@ METRICS = {
     "serving.in_flight": ("gauge", "admitted requests in flight"),
     "serving.capacity": ("gauge", "admission capacity"),
     "serving.draining": ("gauge", "1 while draining"),
+    "serving.warming": ("gauge", "1 while the cold-start readiness "
+                                 "gate holds (/readyz 503 \"warming\": "
+                                 "model built, first compile not yet "
+                                 "paid)"),
     "serving.admission.admitted": ("gauge",
                                    "lifetime admitted (scraped)"),
     "serving.admission.rejected": ("gauge",
@@ -278,11 +282,11 @@ METRICS = {
                        "connect | stream)"),
     "router.probes": ("counter",
                       "replica health probes (label: result = ready | "
-                      "saturated | draining | breaker | failed | "
-                      "flap)"),
+                      "saturated | draining | warming | breaker | "
+                      "failed | flap)"),
     "router.ejections": ("counter",
                          "replicas ejected from rotation (label: "
-                         "reason = draining | probe_failed | "
+                         "reason = draining | warming | probe_failed | "
                          "replica_breaker | breaker_open | "
                          "connect_failed)"),
     "router.reentries": ("counter",
@@ -310,6 +314,38 @@ METRICS = {
                                "router-side request wall time incl. "
                                "failover retries (the added-hop "
                                "budget)", DEFAULT_BUCKETS_S),
+    # -- fleet autopilot (inference/autopilot.py) ---------------------
+    "autopilot.restarts": ("counter",
+                           "replica restarts attempted by the "
+                           "supervisor (label: rid)"),
+    "autopilot.restart.seconds": ("histogram",
+                                  "dead-replica detection to back-in-"
+                                  "rotation wall time (the restart-to-"
+                                  "ready availability number)",
+                                  DEFAULT_BUCKETS_S),
+    "autopilot.launch.failures": ("counter",
+                                  "replica spawn attempts that raised "
+                                  "or never became ready (label: rid)"),
+    "autopilot.quarantines": ("counter",
+                              "supervised slots quarantined after K "
+                              "restarts inside the crash-loop window "
+                              "(label: rid)"),
+    "autopilot.replicas.quarantined": ("gauge",
+                                       "supervised slots currently "
+                                       "quarantined (not restarted "
+                                       "until released)"),
+    "autopilot.replicas.desired": ("gauge",
+                                   "autoscaler's current desired "
+                                   "replica count"),
+    "autopilot.scale.events": ("counter",
+                               "autoscaler resizes applied (label: "
+                               "direction = out | in)"),
+    "autopilot.rollouts": ("counter",
+                           "weight rollouts finished (label: outcome "
+                           "= completed | aborted)"),
+    "autopilot.rollout.steps": ("counter",
+                                "per-replica rollout steps (label: "
+                                "result = swapped | rolled_back)"),
     # -- paged KV engine ----------------------------------------------
     "inference.decode.kernel": ("counter",
                                 "decode ticks by attend path (label: "

@@ -63,6 +63,26 @@ def _seed_everything():
     yield
 
 
+def wait_for(cond, timeout=10.0, what="condition", tick=None):
+    """Poll ``cond()`` until truthy or ``timeout`` seconds elapse.
+
+    Shared by the serving/router/QoS/autopilot suites (previously four
+    private copies). ``tick``, when given, is invoked each poll — soak
+    tests pass ``lambda: (router.probe_all(), supervisor.tick())`` so
+    the condition can only become true through the real control loops.
+    """
+    import time
+
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if tick is not None:
+            tick()
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
 @pytest.fixture
 def no_leaked_threads():
     """Fail any test that leaks a NON-daemon thread. The repo now has

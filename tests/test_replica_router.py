@@ -90,13 +90,7 @@ def _req(port, path, obj=None, headers=None):
         return e.code, json.loads(body) if body else {}, dict(e.headers)
 
 
-def _wait_for(cond, timeout=10.0, what="condition"):
-    end = time.monotonic() + timeout
-    while time.monotonic() < end:
-        if cond():
-            return
-        time.sleep(0.005)
-    raise AssertionError(f"timed out waiting for {what}")
+from conftest import wait_for as _wait_for  # noqa: E402
 
 
 def _no_sleep_policy(seed=0):
@@ -875,6 +869,65 @@ def test_retry_policy_full_jitter_deterministic():
     # the default policy keeps the exact exponential sequence
     gen = RetryPolicy(base_delay=0.05).delays()
     assert [next(gen) for _ in range(3)] == [0.05, 0.1, 0.2]
+
+
+# -- replica removal purges pins ---------------------------------------------
+
+def test_remove_replica_purges_pins_and_resets_breaker():
+    """Regression: remove_replica used to leave the removed id's
+    breaker, session-affinity, and prefix pins resident — a later
+    add_replica under the same id inherited an open breaker, and stale
+    pins kept steering sessions at a ghost. Now everything keyed on
+    the id goes with it: pins purge (counted into the rebind counters
+    at purge time — the next use re-pins silently), and a re-add gets
+    a FRESH closed breaker."""
+    _preds, servers, pairs = _mk_fleet(2)
+    router = ReplicaRouter(pairs).start(probe=False)
+    try:
+        hdr = {"X-Session-Id": "sess-1"}
+        code, _b, hdrs = _req(router.port, "/predict", _BODY,
+                              headers=hdr)
+        assert code == 200
+        home = hdrs["X-Routed-To"]
+        assert router._affinity["sess-1"] == home
+        with router._lock:              # a prefix pin at the same home
+            router._prefix[("k", 0)] = home
+        before_aff = router.metrics.counter(
+            "router.affinity.rebinds").value()
+        before_pfx = router.metrics.counter(
+            "router.prefix.rebinds").value()
+        # trip the breaker so a leak would be visible after re-add
+        rep = router.replica(home)
+        for _ in range(rep.breaker.failure_threshold):
+            rep.breaker.record_failure()
+        assert rep.breaker.state != "closed"
+
+        assert router.remove_replica(home) is True
+        assert "sess-1" not in router._affinity
+        assert ("k", 0) not in router._prefix
+        assert router.metrics.counter(
+            "router.affinity.rebinds").value() == before_aff + 1
+        assert router.metrics.counter(
+            "router.prefix.rebinds").value() == before_pfx + 1
+
+        # re-add the same id: fresh closed breaker, back in rotation
+        url = dict(pairs)[home]
+        router.add_replica(url, rid=home)
+        assert router.replica(home).breaker.state == "closed"
+        router.probe_all()
+        assert router.replica(home).in_rotation
+        # the purged session re-pins on next use (no further rebind
+        # counted — the purge already was the observable unbind)
+        code, _b, hdrs = _req(router.port, "/predict", _BODY,
+                              headers=hdr)
+        assert code == 200
+        assert router._affinity["sess-1"] == hdrs["X-Routed-To"]
+        assert router.metrics.counter(
+            "router.affinity.rebinds").value() == before_aff + 1
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
 
 
 # -- catalogue pins ----------------------------------------------------------

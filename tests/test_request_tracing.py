@@ -84,13 +84,7 @@ def _req(port, path, obj=None, headers=None):
         return e.code, json.loads(body) if body else {}, dict(e.headers)
 
 
-def _wait_for(cond, timeout=10.0, what="condition"):
-    end = time.monotonic() + timeout
-    while time.monotonic() < end:
-        if cond():
-            return
-        time.sleep(0.005)
-    raise AssertionError(f"timed out waiting for {what}")
+from conftest import wait_for as _wait_for  # noqa: E402
 
 
 # -- W3C trace-context parsing ----------------------------------------------

@@ -78,13 +78,7 @@ def _post_bg(port, path, obj, headers=None):
     return t, out
 
 
-def _wait_for(cond, timeout=10.0, what="condition"):
-    end = time.monotonic() + timeout
-    while time.monotonic() < end:
-        if cond():
-            return
-        time.sleep(0.005)
-    raise AssertionError(f"timed out waiting for {what}")
+from conftest import wait_for as _wait_for  # noqa: E402
 
 
 def _elapse_cooldown(breaker, seconds=1000.0):
@@ -428,6 +422,112 @@ def test_drain_finishes_inflight_then_rejects_and_stops():
         assert not srv._thread.is_alive()
     finally:
         release.set()
+
+
+def test_drain_race_pre_drain_finishes_post_drain_typed_503():
+    """drain() racing concurrent submits: the request admitted BEFORE
+    the drain flag flips completes 200; one submitted AFTER gets the
+    typed 503 "draining" (not a hang, not a connection reset)."""
+    release = threading.Event()
+    pred = _CountingCallable(block=release)
+    srv = PredictorServer(pred).start()
+    try:
+        t_pre, out_pre = _post_bg(srv.port, "/predict",
+                                  {"inputs": _ONE_ROW})
+        _wait_for(lambda: srv.admission.in_flight == 1,
+                  what="pre-drain request in flight")
+        dt = threading.Thread(target=srv.drain, kwargs={"timeout": 20},
+                              daemon=True)
+        dt.start()
+        _wait_for(lambda: srv._draining, what="draining flag")
+
+        # post-drain submit races the in-flight one still draining
+        code, body, hdrs = _req(srv.port, "/predict",
+                                {"inputs": _ONE_ROW})
+        assert code == 503 and "draining" in body["error"]
+        assert "Retry-After" in hdrs
+
+        release.set()
+        t_pre.join(timeout=10)
+        assert out_pre["resp"][0] == 200
+        dt.join(timeout=20)
+        assert pred.calls == 1          # the post-drain one never ran
+    finally:
+        release.set()
+
+
+def test_second_drain_is_idempotent():
+    """A second drain() on an already-drained server is a clean no-op:
+    returns True again, no exception, server stays stopped (SIGTERM
+    can arrive twice — pod-stop then supervisor rollout)."""
+    srv = PredictorServer(_CountingCallable()).start()
+    assert srv.drain(timeout=5) is True
+    assert srv.drain(timeout=5) is True
+    assert srv._draining
+    assert not srv._thread.is_alive()
+
+
+def test_readyz_reason_taxonomy_with_warming():
+    """Pin the full /readyz 503 reason taxonomy and its severity
+    order: draining > warming > breaker_* > saturated. A server can be
+    in several states at once; the reason reported is the most severe,
+    so fleet supervisors can branch on a single string."""
+    release = threading.Event()
+    pred = _CountingCallable(block=release)
+    # max_concurrent=0 keeps the server saturated from the start
+    srv = PredictorServer(pred, max_concurrent=0, max_queue_depth=0,
+                          start_warming=True).start()
+    try:
+        # warming beats saturated
+        code, body, _h = _req(srv.port, "/readyz")
+        assert code == 503 and body["reason"] == "warming"
+        assert srv.stats()["warming"] is True
+
+        srv.mark_warm()
+        code, body, _h = _req(srv.port, "/readyz")
+        assert code == 503 and body["reason"] == "saturated"
+
+        # breaker beats saturated
+        for _ in range(srv.breaker.failure_threshold):
+            srv.breaker.record_failure()
+        code, body, _h = _req(srv.port, "/readyz")
+        assert code == 503 and body["reason"] == "breaker_open"
+
+        # re-entering warming (in-place weight swap) outranks breaker
+        srv.mark_warming()
+        code, body, _h = _req(srv.port, "/readyz")
+        assert code == 503 and body["reason"] == "warming"
+
+        # draining outranks everything
+        srv._draining = True
+        code, body, _h = _req(srv.port, "/readyz")
+        assert code == 503 and body["reason"] == "draining"
+        srv._draining = False
+    finally:
+        release.set()
+        srv.stop()
+
+
+def test_warming_clears_on_first_completed_request():
+    """The cold-start gate opens itself: the first COMPLETED request
+    (the one that pays the compile) flips warming off; requests are
+    admitted while warming (only routing steers away)."""
+    srv = PredictorServer(_CountingCallable(),
+                          start_warming=True).start()
+    try:
+        code, body, _h = _req(srv.port, "/readyz")
+        assert code == 503 and body["reason"] == "warming"
+        code, _b, _h = _req(srv.port, "/predict", {"inputs": _ONE_ROW})
+        assert code == 200              # warming never refuses work
+        # the gate opens in the admission scope's exit, which runs just
+        # AFTER the 200 is written — wait for it instead of racing the
+        # handler thread
+        _wait_for(lambda: not srv._warming, what="warming cleared")
+        code, body, _h = _req(srv.port, "/readyz")
+        assert code == 200 and body["status"] == "ready"
+        assert srv.stats()["warming"] is False
+    finally:
+        srv.stop()
 
 
 # -- health / stats surfaces ------------------------------------------------

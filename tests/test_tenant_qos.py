@@ -75,13 +75,7 @@ def _req(port, path, obj=None, headers=None, timeout=60):
         return e.code, json.loads(body) if body else {}, dict(e.headers)
 
 
-def _wait_for(cond, timeout=10.0, what="condition"):
-    end = time.monotonic() + timeout
-    while time.monotonic() < end:
-        if cond():
-            return
-        time.sleep(0.005)
-    raise AssertionError(f"timed out waiting for {what}")
+from conftest import wait_for as _wait_for  # noqa: E402
 
 
 def _model(seed=0):
