@@ -43,6 +43,10 @@ Injection vocabulary (call the one matching the site's failure mode):
                                 file (bit rot), alternating per fire
     grad_poison(site)           1.0, or NaN when it fires (multiplied
                                 into gradients by the trainer)
+    loss_spike(site, scale)     1.0, or `scale` when it fires (multiplied
+                                into the loss AND gradients by the
+                                trainer: a finite blow-up, the sentry's
+                                EWMA z-score lever)
     should_fire(site)           the bare decision, for custom faults
 
 Everything is stdlib-only; importing this module never touches jax.
@@ -60,7 +64,7 @@ __all__ = [
     "ENABLED", "InjectedConnectionDrop", "InjectedFault", "POINTS",
     "configure", "disable", "scoped", "should_fire", "maybe_delay",
     "maybe_drop", "maybe_preempt", "maybe_corrupt_file", "grad_poison",
-    "fire_count", "fires", "site_rate",
+    "loss_spike", "fire_count", "fires", "site_rate",
 ]
 
 #: Documented injection-point registry: every literal site name passed
@@ -142,6 +146,15 @@ POINTS = {
                               "— the pre-warm gate's lever)",
     "trainer.grad": "non-finite (NaN) gradient poisoning in the "
                     "compiled train step",
+    "train.grad.nan": "non-finite (NaN) gradient poisoning on the "
+                      "sentry's hard-trigger path (an independent "
+                      "decision stream from trainer.grad, so sentry "
+                      "soaks and the legacy skip tests compose)",
+    "train.loss.spike": "finite loss-spike poisoning in the compiled "
+                        "train step (loss and grads scaled by the "
+                        "spike factor — drives the sentry's EWMA "
+                        "z-score detector without any non-finite "
+                        "value)",
     "io.prefetch.delay": "slow host input pipeline (delay in the "
                          "device-prefetch worker before placement)",
 }
@@ -339,6 +352,15 @@ def grad_poison(site: str) -> float:
     this into the incoming gradients (trace-time gated: the factor only
     exists in the compiled step while chaos is enabled)."""
     return float("nan") if should_fire(site) else 1.0
+
+
+def loss_spike(site: str, scale: float = 100.0) -> float:
+    """1.0 normally; `scale` when the site fires. The trainer multiplies
+    this into the loss AND the gradients — a finite blow-up (everything
+    stays isfinite), which is exactly the failure mode a NaN check
+    cannot see and the training sentry's EWMA z-score detector exists
+    for. Same trace-time gating as grad_poison."""
+    return float(scale) if should_fire(site) else 1.0
 
 
 # -- env bootstrap (read once at import) ------------------------------------

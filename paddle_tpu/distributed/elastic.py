@@ -35,10 +35,18 @@ from paddle_tpu import observability
 from paddle_tpu.distributed import chaos
 
 __all__ = ["ElasticManager", "ElasticSupervisor", "StoreHeartbeat",
-           "safe_barrier", "run_resilient",
+           "HaltTraining", "safe_barrier", "run_resilient",
            "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
 
 ELASTIC_AUTO_PARALLEL_EXIT_CODE = 101  # reference manager.py same code
+
+
+class HaltTraining(RuntimeError):
+    """A DELIBERATE halt: raised out of train_fn when restarting cannot
+    help (the training sentry's quarantine — K rollbacks in a window
+    means the run re-diverges from every restore point). The restart
+    loops below re-raise it immediately instead of burning the restart
+    budget replaying a decision that was already final."""
 
 
 class ElasticManager:
@@ -152,6 +160,8 @@ class ElasticManager:
                         return step  # clean exit; scheduler restarts us
                 self.flush()         # normal exit: final save durable
                 return total_steps
+            except HaltTraining:
+                raise               # deliberate: restarting cannot help
             except Exception:
                 restarts += 1
                 if observability.ENABLED:
@@ -512,7 +522,8 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
     and a preemption signal (checkpoint is already on disk; the loop
     reloads and continues — in production the scheduler would kill and
     relaunch the process, landing in the same resume path). Gives up
-    after `max_restarts`.
+    after `max_restarts`. `HaltTraining` (the sentry's quarantine) is
+    NOT a restartable fault: it re-raises immediately.
 
     Returns {"steps": completed, "restarts": n, "resumed_from": last
     checkpoint dir used}.
@@ -678,6 +689,11 @@ def run_resilient(train_fn, total_steps, checkpoint_dir, save_fn,
                     raise RuntimeError(
                         f"run_resilient: max_restarts={max_restarts} "
                         "exhausted after repeated preemptions") from None
+            except HaltTraining:
+                # a deliberate halt (sentry quarantine): the evidence
+                # bundle is already on disk — courtesy of the raiser —
+                # and a restart would replay the same final decision
+                raise
             except Exception as e:
                 restarts += 1
                 if observability.ENABLED:

@@ -1,0 +1,497 @@
+"""Training anomaly sentry: NaN/loss-spike detection, last-known-good
+checkpoints, auto-rollback with a data-window skip.
+
+At pod scale the dominant *silent* failure is numerical, not
+process-level: a step produces a finite-but-exploding loss or a NaN
+gradient, the optimizer state absorbs it, and every subsequent
+checkpoint inherits the damage long before a human looks at a curve
+(PaLM and OPT both shipped restart-from-checkpoint-and-skip-data as a
+core practice for exactly this). `run_resilient`/`ElasticManager`
+(elastic.py) already close the loop on crashes and preemptions; this
+module closes it on numbers.
+
+Three layers:
+
+**Detection** rides the compiled step. With
+`TrainStepConfig(health_probe=True)` the trainer's fused step returns
+``probe = [global_grad_norm, applied]`` alongside the loss — one extra
+reduction in-jit, no extra host sync (reading the lazy probe is the
+sentry's decision, and it reads the loss anyway). On the host an EWMA
+mean/variance of the HEALTHY losses turns each new loss into a
+z-score; ``z > spike_zscore`` after ``warmup_steps`` is a
+``loss_spike`` trigger, a non-finite loss or grad-norm is a
+``nonfinite_grad`` trigger.
+
+**Policy** is graduated:
+
+  skip       discard the update but advance the data cursor. The
+             discard happens *in-jit*: the step takes the sentry's
+             loss-cap scalar and suppresses the update (params and
+             optimizer state pass through unchanged) when the loss is
+             non-finite or above the cap — so a skipped run's final
+             params are bit-identical to a fault-free run that never
+             saw the offending batch (the acceptance soak asserts it).
+  rollback   restore the last *promoted* checkpoint, rewind the step
+             counter, and keep the data cursor moving FORWARD past the
+             offending window (``skip_window`` batches beyond the
+             trigger) so the bad batch is never replayed — the
+             replayed steps train on fresh data. Re-entry runs a
+             transient LR dampening ramp (``lr_dampen_steps`` /
+             ``lr_dampen_factor``) through ``Trainer.set_lr_scale``.
+  quarantine K rollbacks inside a sliding ``quarantine_window`` of
+             data-cursor steps means the run re-diverges from every
+             restore point: halt with a flight bundle by raising
+             `SentryQuarantine` — an `elastic.HaltTraining`, which
+             `run_resilient`/`ElasticManager.run` re-raise immediately
+             instead of burning their restart budget (mirroring
+             `ReplicaSupervisor`'s crash-loop quarantine).
+
+**Last-known-good tracking**: a checkpoint becomes rollback-eligible
+only after ``promote_after`` subsequent healthy steps (a spike's
+z-score trips AFTER the loss has drifted for a while, so the newest
+checkpoint is exactly the one you must not trust) — and, with an
+`AsyncCheckpointer` attached, only after its durable-commit
+`on_complete` hook fired (a marker that never landed must never be a
+restore target). The step-0 bootstrap checkpoint is promoted on
+durability alone: the initial state precedes all training and cannot
+be spike-poisoned.
+
+Evidence plane: every trigger dumps a flight-recorder bundle (reason
+``loss_spike`` / ``nonfinite_grad`` / ``sentry_quarantine`` with the
+EWMA state and the per-step loss/grad-norm ring under
+``extra["sentry"]`` — `tools/obs_dump.py` renders it), and the
+``train.sentry.*`` metric family (triggers{reason}, skips, rollbacks,
+steps-since-good gauge, probe-overhead histogram) feeds the fleet
+heartbeat so `GET /debug/fleet` shows a rank degrading numerically
+before it quarantines. Chaos sites ``train.grad.nan`` and
+``train.loss.spike`` drive every path deterministically.
+
+Typical wiring (standalone, or as the body of a `run_resilient`
+train_fn for process-fault coverage on top)::
+
+    trainer = Trainer(model, opt, config=TrainStepConfig(
+        health_probe=True), checkpointer=AsyncCheckpointer())
+    sentry = TrainingSentry(SentryConfig(policy="rollback"))
+    out = sentry.run(trainer, batch_for, total_steps=10_000,
+                     checkpoint_dir="ckpts", checkpoint_interval=200)
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import shutil
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from paddle_tpu import observability
+from paddle_tpu.distributed.elastic import HaltTraining
+
+__all__ = ["SentryConfig", "SentryQuarantine", "TrainingSentry"]
+
+
+@dataclass
+class SentryConfig:
+    policy: str = "rollback"        # "skip" | "rollback"
+    # spike detector: trigger when (loss - ewma) / sigma > spike_zscore,
+    # armed only after warmup_steps healthy samples; ewma_alpha is the
+    # usual exponential weight (higher = faster tracking, noisier)
+    spike_zscore: float = 6.0
+    warmup_steps: int = 20
+    ewma_alpha: float = 0.05
+    # sigma floor: a perfectly flat loss curve must not turn float
+    # noise into triggers
+    min_sigma: float = 1e-3
+    # healthy steps a checkpoint must survive before it is
+    # rollback-eligible (the promotion rule; see module docstring)
+    promote_after: int = 8
+    # data batches dropped from the stream at a rollback, starting at
+    # the trigger batch (1 = just never replay the trigger batch)
+    skip_window: int = 1
+    # quarantine: this many rollbacks inside quarantine_window
+    # data-cursor steps => halt (SentryQuarantine)
+    quarantine_rollbacks: int = 3
+    quarantine_window: int = 500
+    # transient post-rollback LR dampening: scale starts at
+    # lr_dampen_factor and ramps linearly back to 1.0 over
+    # lr_dampen_steps healthy steps (0 = off)
+    lr_dampen_steps: int = 0
+    lr_dampen_factor: float = 0.1
+    # per-step (step, cursor, loss, grad_norm, applied) ring shipped in
+    # flight bundles
+    history: int = 64
+
+
+class SentryQuarantine(HaltTraining):
+    """K rollbacks inside the sliding window — the run re-diverges from
+    every restore point; halting with the evidence bundle beats
+    replaying the same collapse on pod-hours. elastic's restart loops
+    re-raise this immediately (HaltTraining contract)."""
+
+
+class TrainingSentry:
+    """Host-side controller for the health probe: EWMA spike detection,
+    the skip/rollback/quarantine policy ladder, and last-known-good
+    checkpoint promotion. Detector and bookkeeping methods are usable
+    standalone (unit tests drive them directly); `run()` is the wired
+    training loop."""
+
+    def __init__(self, config: SentryConfig | None = None):
+        self.config = config or SentryConfig()
+        if self.config.policy not in ("skip", "rollback"):
+            raise ValueError(
+                f"SentryConfig.policy must be 'skip' or 'rollback', "
+                f"got {self.config.policy!r}")
+        # detector state (healthy losses only — a spike must not drag
+        # the mean toward itself)
+        self.ewma: float | None = None
+        self.ewma_var = 0.0
+        self.seen = 0
+        self.ring: deque = deque(maxlen=max(1, self.config.history))
+        # last-known-good tracking; _mark_durable runs on the async
+        # checkpointer's WRITER thread, hence the lock
+        self._lock = threading.Lock()
+        self._candidates: list[dict] = []
+        self._good: dict | None = None
+        # policy bookkeeping
+        self._rollback_at: deque = deque()   # data-cursor positions
+        self._dampen_left = 0
+        self.skips = 0
+        self.rollbacks = 0
+        self.triggers: dict[str, int] = {}
+
+    # -- detection ----------------------------------------------------
+    def sigma(self) -> float:
+        return max(math.sqrt(max(self.ewma_var, 0.0)),
+                   self.config.min_sigma)
+
+    def zscore(self, loss: float) -> float:
+        if self.ewma is None:
+            return 0.0
+        return (loss - self.ewma) / self.sigma()
+
+    def loss_cap(self) -> float:
+        """The in-jit spike threshold the trainer stages (skip policy
+        only — under rollback the host owns the decision and the cap
+        stays disarmed). Quantized to 2 significant digits so the
+        staged scalar re-transfers only when the EWMA really moves."""
+        if (self.config.policy != "skip" or self.ewma is None
+                or self.seen < self.config.warmup_steps):
+            return float("inf")
+        cap = self.ewma + self.config.spike_zscore * self.sigma()
+        return float(f"{cap:.2g}")
+
+    def observe_step(self, step: int, cursor: int, loss: float,
+                     grad_norm: float,
+                     applied: bool = True) -> str | None:
+        """Fold one step's probe into the detector; returns the trigger
+        reason ("nonfinite_grad" / "loss_spike") or None. `applied` is
+        the probe's in-jit flag: False means the compiled step already
+        suppressed the update (non-finite, or loss over the staged
+        cap). Healthy losses feed the EWMA; triggers do not."""
+        self.ring.append([int(step), int(cursor), float(loss),
+                          float(grad_norm), bool(applied)])
+        reason = None
+        if not (math.isfinite(loss) and math.isfinite(grad_norm)):
+            reason = "nonfinite_grad"
+        elif (self.seen >= self.config.warmup_steps
+                and self.zscore(loss) > self.config.spike_zscore):
+            reason = "loss_spike"
+        elif not applied:
+            # the staged cap fired in-jit before the host's (fresher)
+            # EWMA would have — trust the in-jit decision: the update
+            # is already gone
+            reason = "loss_spike"
+        if reason is not None:
+            self.triggers[reason] = self.triggers.get(reason, 0) + 1
+            if observability.ENABLED:
+                observability.inc("train.sentry.triggers",
+                                  reason=reason)
+            return reason
+        a = self.config.ewma_alpha
+        if self.ewma is None:
+            self.ewma = float(loss)
+        else:
+            prev = self.ewma
+            self.ewma = (1.0 - a) * prev + a * float(loss)
+            self.ewma_var = ((1.0 - a) * self.ewma_var
+                             + a * (float(loss) - prev) ** 2)
+        self.seen += 1
+        return None
+
+    # -- last-known-good tracking -------------------------------------
+    def note_checkpoint(self, step: int, cursor: int, path: str,
+                        checkpointer=None) -> None:
+        """Register a just-saved checkpoint as a PROMOTION CANDIDATE.
+        It becomes rollback-eligible once durable (immediately for a
+        synchronous save; behind `on_complete` for an async one) AND
+        `promote_after` healthy steps passed — except the step-0
+        bootstrap, which needs only durability. A failed/superseded
+        async save never calls back, so a torn write can never become
+        a restore target."""
+        cand = {"step": int(step), "cursor": int(cursor), "path": path,
+                "durable": checkpointer is None, "healthy_after": 0,
+                "bootstrap": int(step) == 0}
+        with self._lock:
+            self._candidates.append(cand)
+        if checkpointer is not None:
+            checkpointer.on_complete(lambda: self._mark_durable(cand))
+        self._maybe_promote()
+
+    def _mark_durable(self, cand: dict) -> None:
+        with self._lock:
+            cand["durable"] = True
+        self._maybe_promote()
+
+    def _maybe_promote(self) -> None:
+        with self._lock:
+            ready = [c for c in self._candidates
+                     if c["durable"]
+                     and (c["bootstrap"]
+                          or c["healthy_after"]
+                          >= self.config.promote_after)]
+            if not ready:
+                return
+            best = max(ready, key=lambda c: c["step"])
+            if self._good is None or best["step"] >= self._good["step"]:
+                self._good = best
+            self._candidates = [c for c in self._candidates
+                                if c["step"] > best["step"]]
+
+    def _healthy_step(self) -> None:
+        with self._lock:
+            for c in self._candidates:
+                c["healthy_after"] += 1
+        self._maybe_promote()
+
+    def _drop_candidates(self) -> None:
+        """A trigger under the rollback policy: the preceding window
+        may be quietly corrupted (the z-score trips AFTER the drift
+        started), so every unpromoted candidate is suspect."""
+        with self._lock:
+            self._candidates = []
+
+    @property
+    def promoted(self) -> dict | None:
+        """The newest rollback-eligible checkpoint record
+        ({step, cursor, path, ...}) or None."""
+        with self._lock:
+            return dict(self._good) if self._good else None
+
+    def steps_since_good(self, step: int) -> int:
+        with self._lock:
+            base = self._good["step"] if self._good else 0
+        return max(0, int(step) - base)
+
+    # -- evidence -----------------------------------------------------
+    def _bundle(self, reason, step, cursor, loss, grad_norm):
+        """Flight-recorder bundle for one trigger (no-op unless the
+        recorder is armed). The sentry section under extra carries the
+        detector state and the per-step ring — enough to replay the
+        decision on a workstation (tools/obs_dump.py renders it)."""
+        if not observability.ENABLED:
+            return None
+        good = self.promoted
+        extra = {"sentry": {
+            "trigger": reason,
+            "policy": self.config.policy,
+            "step": int(step), "cursor": int(cursor),
+            "loss": float(loss), "grad_norm": float(grad_norm),
+            "ewma": self.ewma, "sigma": self.sigma(),
+            "zscore": (self.zscore(loss)
+                       if math.isfinite(loss) else None),
+            "steps_since_good": self.steps_since_good(step),
+            "rollback_target": good["path"] if good else None,
+            "step_range": [good["step"] if good else 0, int(step)],
+            "rollbacks_in_window": len(self._rollback_at),
+            "history": list(self.ring),
+        }}
+        try:
+            from paddle_tpu.observability import fleet
+            return fleet.record_crash(reason, extra=extra)
+        except Exception as dump_err:  # noqa: BLE001 — evidence must never break recovery
+            import sys
+            print(f"WARNING: sentry flight dump failed: {dump_err!r}",
+                  file=sys.stderr)
+            return None
+
+    # -- the wired loop -----------------------------------------------
+    def run(self, trainer, batch_for, total_steps: int,
+            checkpoint_dir: str, checkpoint_interval: int = 50) -> dict:
+        """The sentried training loop.
+
+        batch_for(cursor) -> batch dict: deterministic data addressing
+        by MONOTONIC cursor — the property the rollback semantics rest
+        on (the cursor never rewinds, so a rolled-back attempt replays
+        steps on FRESH data and the offending window is never seen
+        again). Checkpoints land in
+        ``checkpoint_dir/step_{step:08d}`` (run_resilient's layout)
+        through ``trainer.save_checkpoint``, with a ``sentry.json``
+        sidecar recording the data cursor so a process-level resume
+        can restore it.
+
+        Returns {"steps", "cursor", "skips", "rollbacks", "triggers",
+        "promoted_step"}. Raises SentryQuarantine (an
+        elastic.HaltTraining: run_resilient will NOT restart it) after
+        `quarantine_rollbacks` rollbacks inside the window.
+        """
+        import numpy as np
+        if not getattr(trainer.config, "health_probe", False):
+            raise ValueError(
+                "TrainingSentry.run needs TrainStepConfig("
+                "health_probe=True): the detection probe lives inside "
+                "the compiled step")
+        cfg = self.config
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        step = 0
+        cursor = 0
+        self._save(trainer, checkpoint_dir, step, cursor)   # bootstrap
+        while step < total_steps:
+            trainer.set_loss_cap(self.loss_cap())
+            batch = batch_for(cursor)
+            loss_t = trainer.step(batch)
+            # the ONLY host sync: the probe and the loss materialize
+            # together (same program, same step) — everything below is
+            # host-side python, timed into the probe-overhead histogram
+            probe = np.asarray(trainer.last_probe)
+            loss = float(np.asarray(loss_t._value))
+            # one tolist() instead of two indexed np-scalar pulls:
+            # this loop runs every training step, and scalar churn is
+            # the dominant host-plane cost after the sync itself
+            grad_norm, applied_f = probe.tolist()
+            applied = applied_f > 0.0
+            t0 = time.perf_counter()
+            reason = self.observe_step(step, cursor, loss, grad_norm,
+                                       applied)
+            if reason is None:
+                step += 1
+                cursor += 1
+                self._healthy_step()
+                self._dampen_tick(trainer)
+                if step % max(1, checkpoint_interval) == 0 \
+                        and step < total_steps:
+                    self._save(trainer, checkpoint_dir, step, cursor)
+            elif cfg.policy == "skip":
+                # the update is already discarded in-jit; the batch is
+                # consumed (cursor advances) and the step slot counts —
+                # matching a fault-free run that never saw this batch
+                self._bundle(reason, step, cursor, loss, grad_norm)
+                if not applied:
+                    self.skips += 1
+                    if observability.ENABLED:
+                        observability.inc("train.sentry.skips")
+                step += 1
+                cursor += 1
+            else:
+                self._bundle(reason, step, cursor, loss, grad_norm)
+                step, cursor = self._rollback(
+                    trainer, step, cursor, loss, grad_norm)
+            if observability.ENABLED:
+                observability.set_gauge("train.sentry.steps_since_good",
+                                        self.steps_since_good(step))
+                observability.observe("train.sentry.probe.seconds",
+                                      time.perf_counter() - t0)
+        good = self.promoted
+        return {"steps": int(total_steps), "cursor": int(cursor),
+                "skips": self.skips, "rollbacks": self.rollbacks,
+                "triggers": dict(self.triggers),
+                "promoted_step": good["step"] if good else None}
+
+    # -- policy internals ---------------------------------------------
+    def _save(self, trainer, checkpoint_dir, step, cursor):
+        path = os.path.join(checkpoint_dir, f"step_{step:08d}")
+        if os.path.isdir(path):
+            # a stale artifact of a pre-rollback attempt at this same
+            # step — clear it so the fresh save is a clean candidate
+            if trainer.checkpointer is not None:
+                trainer.checkpointer.flush()
+            shutil.rmtree(path, ignore_errors=True)
+        trainer.save_checkpoint(path)
+        with open(os.path.join(checkpoint_dir, "sentry.json"),
+                  "w") as f:
+            json.dump({"step": int(step), "cursor": int(cursor)}, f)
+        self.note_checkpoint(step, cursor, path,
+                             checkpointer=trainer.checkpointer)
+
+    def _rollback(self, trainer, step, cursor, loss, grad_norm):
+        """Restore the promoted checkpoint; returns the new (step,
+        cursor). Quarantines FIRST when the window already holds
+        quarantine_rollbacks — so exactly K rollbacks ever execute."""
+        cfg = self.config
+        while self._rollback_at and \
+                cursor - self._rollback_at[0] > cfg.quarantine_window:
+            self._rollback_at.popleft()
+        if len(self._rollback_at) >= cfg.quarantine_rollbacks:
+            self.triggers["sentry_quarantine"] = \
+                self.triggers.get("sentry_quarantine", 0) + 1
+            if observability.ENABLED:
+                observability.inc("train.sentry.triggers",
+                                  reason="sentry_quarantine")
+            self._bundle("sentry_quarantine", step, cursor, loss,
+                         grad_norm)
+            raise SentryQuarantine(
+                f"{len(self._rollback_at)} rollbacks inside "
+                f"{cfg.quarantine_window} data-cursor steps (limit "
+                f"{cfg.quarantine_rollbacks}); the run re-diverges "
+                "from every restore point — halting with the flight "
+                "bundle rather than replaying the collapse")
+        good = self.promoted
+        if good is None:
+            # no durable restore point yet (async bootstrap save still
+            # in flight): force durability, then re-check
+            if trainer.checkpointer is not None:
+                trainer.checkpointer.flush()
+                self._maybe_promote()
+                good = self.promoted
+            if good is None:
+                raise SentryQuarantine(
+                    "rollback triggered but no promoted checkpoint "
+                    "exists to restore from")
+        self._drop_candidates()
+        trainer.load_checkpoint(good["path"])
+        # the restored (older) state legitimately sits at a HIGHER loss
+        # than the EWMA that tracked the run down to the trigger — the
+        # detector re-warms from scratch or it would flag the restore
+        # itself as a spike (the ring is kept: it is evidence)
+        self.ewma = None
+        self.ewma_var = 0.0
+        self.seen = 0
+        self._rollback_at.append(cursor)
+        self.rollbacks += 1
+        if observability.ENABLED:
+            observability.inc("train.sentry.rollbacks")
+        if cfg.lr_dampen_steps > 0:
+            self._dampen_left = cfg.lr_dampen_steps
+            trainer.set_lr_scale(cfg.lr_dampen_factor)
+        # step rewinds to the restore point; the cursor NEVER rewinds —
+        # it jumps past the offending window instead, so the replayed
+        # steps consume fresh batches and the bad window is gone
+        return good["step"], cursor + max(1, cfg.skip_window)
+
+    def _dampen_tick(self, trainer):
+        """Linear LR re-ramp after a rollback: factor -> 1.0 over
+        lr_dampen_steps healthy steps."""
+        if self._dampen_left <= 0:
+            return
+        self._dampen_left -= 1
+        cfg = self.config
+        if self._dampen_left == 0:
+            trainer.set_lr_scale(1.0)
+        else:
+            frac = 1.0 - self._dampen_left / cfg.lr_dampen_steps
+            trainer.set_lr_scale(
+                cfg.lr_dampen_factor
+                + (1.0 - cfg.lr_dampen_factor) * frac)
+
+    @staticmethod
+    def load_cursor(checkpoint_dir: str) -> dict | None:
+        """The {step, cursor} sidecar of the newest sentried save (for
+        a process-level resume wrapping run() in run_resilient), or
+        None before any save."""
+        path = os.path.join(checkpoint_dir, "sentry.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)

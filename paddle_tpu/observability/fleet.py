@@ -142,6 +142,16 @@ def registry_sample(registry=None) -> dict:
         v = reg.gauge("checkpoint.async.pending").value()
         if v is not None:
             out["ckpt_async_pending"] = float(v)
+    if "train.sentry.steps_since_good" in names:
+        # a rank whose training is numerically degrading shows up here
+        # (climbing steps-since-promoted-checkpoint, mounting trigger
+        # count) BEFORE its sentry quarantines it
+        v = reg.gauge("train.sentry.steps_since_good").value()
+        if v is not None:
+            out["steps_since_good"] = float(v)
+    if "train.sentry.triggers" in names:
+        out["sentry_triggers"] = int(sum(
+            reg.counter("train.sentry.triggers").labeled().values()))
     return out
 
 

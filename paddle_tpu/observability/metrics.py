@@ -113,6 +113,30 @@ METRICS = {
     "train.grad_norm": ("gauge", "global grad norm, when reported"),
     "train.nonfinite_skips": ("counter",
                               "steps skipped for non-finite grads"),
+    # -- training anomaly sentry (distributed/sentry.py) --------------
+    "train.sentry.triggers": ("counter",
+                              "sentry anomaly triggers (label: reason "
+                              "= loss_spike | nonfinite_grad | "
+                              "sentry_quarantine)"),
+    "train.sentry.skips": ("counter",
+                           "updates discarded by the sentry skip "
+                           "policy (data cursor still advanced)"),
+    "train.sentry.rollbacks": ("counter",
+                               "restores onto the last promoted "
+                               "known-good checkpoint"),
+    "train.sentry.steps_since_good": ("gauge",
+                                      "steps since the newest "
+                                      "PROMOTED (rollback-eligible) "
+                                      "checkpoint — a climbing value "
+                                      "on one rank is numeric "
+                                      "degradation before quarantine"),
+    "train.sentry.probe.seconds": ("histogram",
+                                   "host-side sentry overhead per "
+                                   "step (probe read + EWMA update + "
+                                   "policy decision) — the <1% "
+                                   "probe-overhead acceptance is "
+                                   "benched in extra.sentry",
+                                   DEFAULT_BUCKETS_S),
     "train.recompiles": ("counter",
                          "train-step program (re)builds (label: shape "
                          "= the triggering batch-shape signature — the "

@@ -69,6 +69,15 @@ class TrainStepConfig:
     # (each read syncs on that step; 1 = check every step, larger keeps
     # more dispatch pipelining and still aborts within the window)
     nonfinite_check_every: int = 1
+    # training-sentry health probe (distributed/sentry.py): the compiled
+    # step additionally returns probe = [global_grad_norm, applied] and
+    # takes a loss-cap scalar input; an update whose loss/grads are
+    # non-finite OR whose loss exceeds the cap is suppressed in-jit
+    # (same select-don't-branch machinery as skip_nonfinite_grads, which
+    # this subsumes — the two knobs are mutually exclusive). The probe
+    # rides the step's existing outputs: no extra host sync is added
+    # here; reading it is the sentry's decision.
+    health_probe: bool = False
 
 
 class NonFiniteGradError(RuntimeError):
@@ -142,9 +151,28 @@ class Trainer:
         if getattr(model, "_sharding_offload", False):
             # group_sharded_parallel(offload=True) hint
             self.config.offload_opt_state = True
+        if self.config.health_probe and self.config.skip_nonfinite_grads:
+            raise ValueError(
+                "TrainStepConfig.health_probe subsumes "
+                "skip_nonfinite_grads (the probe's in-jit suppression "
+                "covers non-finite updates); enable only one")
         self._loss_fn = loss_fn
         self._step_fn = None
         self._chaos_poison = False
+        # extra compiled-step inputs, in positional order (subset of
+        # ("poison", "spike", "loss_cap")), decided at trace time
+        self._extra_names: tuple = ()
+        self._poison_sites: tuple = ()
+        # sentry loss cap: an update with loss above this is suppressed
+        # in-jit when health_probe is on (+inf = never; the sentry
+        # quantizes its cap so the staged scalar rarely re-transfers)
+        self._loss_cap = float("inf")
+        self._cap_cache = None
+        # transient LR scale (sentry post-rollback dampening ramp)
+        self._lr_scale = 1.0
+        # the lazy probe array of the most recent step (health_probe):
+        # [global_grad_norm, applied]; reading it is the caller's sync
+        self.last_probe = None
         # per-(key, ndim) NamedSharding cache for batch leaves: shared
         # by step() and data_iter()'s prefetcher, so a prefetched batch
         # compares equal (same objects) and skips device_put entirely
@@ -273,28 +301,38 @@ class Trainer:
 
     def _build_step(self, batch_treedef):
         cfg = self.config
-        # chaos injection "trainer.grad" is gated at TRACE time: with
-        # chaos off the compiled step has no poison input at all — the
-        # hot path stays byte-identical
+        # chaos injection is gated at TRACE time: with chaos off the
+        # compiled step has no poison/spike inputs at all — the hot
+        # path stays byte-identical. "trainer.grad"/"train.grad.nan"
+        # poison grads with NaN; "train.loss.spike" scales loss AND
+        # grads by a finite factor (the sentry's EWMA lever).
         from paddle_tpu.distributed import chaos
-        self._chaos_poison = bool(chaos.ENABLED
-                                  and chaos.site_rate("trainer.grad") > 0)
+        self._poison_sites = tuple(
+            s for s in ("trainer.grad", "train.grad.nan")
+            if chaos.ENABLED and chaos.site_rate(s) > 0)
+        self._chaos_poison = bool(self._poison_sites)
+        chaos_spike = bool(chaos.ENABLED
+                           and chaos.site_rate("train.loss.spike") > 0)
+        names = []
+        if self._chaos_poison:
+            names.append("poison")
+        if chaos_spike:
+            names.append("spike")
+        if cfg.health_probe:
+            names.append("loss_cap")
+        self._extra_names = tuple(names)
 
         loss_for = self._make_loss_for()
         grad_fn = jax.value_and_grad(
             lambda tp, fp, b: loss_for({**fp, **tp}, b))
 
-        if self._chaos_poison:
-            def step(params, opt_state, lr, batch, poison):
-                with self._precision_ctx():
-                    return _step_inner(params, opt_state, lr, batch,
-                                       poison)
-        else:
-            def step(params, opt_state, lr, batch):
-                with self._precision_ctx():
-                    return _step_inner(params, opt_state, lr, batch)
+        def step(params, opt_state, lr, batch, *extra):
+            kw = dict(zip(names, extra))
+            with self._precision_ctx():
+                return _step_inner(params, opt_state, lr, batch, **kw)
 
-        def _step_inner(params, opt_state, lr, batch, poison=None):
+        def _step_inner(params, opt_state, lr, batch, poison=None,
+                        spike=None, loss_cap=None):
             train_p = {n: params[n] for n in self.param_names}
             frozen_p = {n: v for n, v in params.items()
                         if n not in train_p}
@@ -318,9 +356,13 @@ class Trainer:
                 grads = jax.tree.map(lambda g: g / n_mb, grads)
             else:
                 loss, grads = grad_fn(train_p, frozen_p, batch)
+            if spike is not None:
+                loss = loss * spike
+                grads = jax.tree.map(lambda g: g * spike, grads)
             if poison is not None:
                 grads = jax.tree.map(lambda g: g * poison, grads)
-            return self._apply_update(loss, grads, params, opt_state, lr)
+            return self._apply_update(loss, grads, params, opt_state,
+                                      lr, loss_cap)
 
         return self._jit_step(step)
 
@@ -338,10 +380,14 @@ class Trainer:
         return (jax.default_matmul_precision("default") if low_prec
                 else contextlib.nullcontext())
 
-    def _apply_update(self, loss, grads, params, opt_state, lr):
+    def _apply_update(self, loss, grads, params, opt_state, lr,
+                      loss_cap=None):
         """Shared step epilogue: f32 grads + opt barrier + optimizer;
         with skip_nonfinite_grads the whole update is suppressed in-jit
-        when any grad (or the loss) is Inf/NaN."""
+        when any grad (or the loss) is Inf/NaN. With health_probe the
+        suppression generalizes — non-finite OR loss above `loss_cap`
+        — and the step additionally returns probe = [global_grad_norm,
+        applied] (one more reduction; no extra host sync)."""
         grads = _opt_barrier(
             jax.tree.map(lambda g: g.astype(jnp.float32), grads),
             self.config)
@@ -356,6 +402,29 @@ class Trainer:
         train_p = {n: params[n] for n in self.param_names}
         new_p, new_s = self.optimizer.apply_gradients_arrays(
             train_p, grads, opt_state, lr)
+        if self.config.health_probe:
+            # ONE global reduction: the squared grad norm propagates
+            # any NaN/Inf, so isfinite(gnorm2) is the all-grads-finite
+            # check and sqrt(gnorm2) the probe's grad-norm — the
+            # detection rides values the step computes anyway
+            gnorm2 = jnp.zeros((), jnp.float32)
+            for g in grads.values():
+                gnorm2 = gnorm2 + jnp.sum(
+                    jnp.asarray(g, jnp.float32) ** 2)
+            healthy = jnp.logical_and(jnp.isfinite(loss),
+                                      jnp.isfinite(gnorm2))
+            if loss_cap is not None:
+                healthy = jnp.logical_and(healthy, loss <= loss_cap)
+            new_p = {n: jnp.where(healthy, v, train_p[n])
+                     for n, v in new_p.items()}
+            new_s = jax.tree.map(
+                lambda new, old: jnp.where(healthy, new, old),
+                new_s, opt_state)
+            out_params = dict(params)
+            out_params.update(new_p)
+            probe = jnp.stack([jnp.sqrt(gnorm2),
+                               healthy.astype(jnp.float32)])
+            return loss, out_params, new_s, probe
         if self.config.skip_nonfinite_grads:
             finite = jnp.isfinite(loss)
             for g in grads.values():
@@ -385,8 +454,11 @@ class Trainer:
         park = "pinned_host" if self.config.offload_opt_state else None
         if park:
             donate = (0,) if self.config.donate else ()
-        # optional extra input (chaos grad poison) / output (skip flag)
-        extra_in = (None,) if self._chaos_poison else ()
+        # optional extra inputs (chaos poison/spike, sentry loss cap) /
+        # output (skip flag or sentry probe)
+        extra_in = (None,) * len(self._extra_names)
+        has_extra_out = (self.config.skip_nonfinite_grads
+                         or self.config.health_probe)
         if mesh is not None:
             pspec = {n: NamedSharding(mesh, self._spec(n))
                      for n in self.params}
@@ -394,7 +466,7 @@ class Trainer:
                          for k, v in st.items()}
                      for n, st in self.opt_state.items()}
             rep = NamedSharding(mesh, P())
-            extra_out = (rep,) if self.config.skip_nonfinite_grads else ()
+            extra_out = (rep,) if has_extra_out else ()
             return jax.jit(
                 step, donate_argnums=donate,
                 in_shardings=(pspec, sspec, rep, None) + extra_in,
@@ -403,8 +475,7 @@ class Trainer:
             sspec = {n: {k: self._opt_leaf_sharding(n, v, park)
                          for k, v in st.items()}
                      for n, st in self.opt_state.items()}
-            extra_out = (None,) if self.config.skip_nonfinite_grads \
-                else ()
+            extra_out = (None,) if has_extra_out else ()
             return jax.jit(step, donate_argnums=donate,
                            in_shardings=(None, sspec, None, None)
                            + extra_in,
@@ -455,10 +526,30 @@ class Trainer:
             # through the axon dispatch tunnel
             self._lr_cache = (lrv, jnp.asarray(lrv, jnp.float32))
         args = (self.params, self.opt_state, self._lr_cache[1], batch)
-        if self._chaos_poison:
-            from paddle_tpu.distributed import chaos
-            args += (jnp.asarray(chaos.grad_poison("trainer.grad"),  # lint: disable=disabled-gate -- _chaos_poison is derived from chaos.ENABLED at trace time; with chaos off this branch does not exist
-                                 jnp.float32),)
+        for extra in self._extra_names:
+            if extra == "poison":
+                from paddle_tpu.distributed import chaos
+                v = 1.0
+                if "trainer.grad" in self._poison_sites:
+                    v *= chaos.grad_poison("trainer.grad")  # lint: disable=disabled-gate -- _extra_names is derived from chaos.ENABLED at trace time; with chaos off this input does not exist
+                if "train.grad.nan" in self._poison_sites:
+                    v *= chaos.grad_poison("train.grad.nan")  # lint: disable=disabled-gate -- same trace-time gate as above
+                args += (jnp.asarray(v, jnp.float32),)
+            elif extra == "spike":
+                from paddle_tpu.distributed import chaos
+                args += (jnp.asarray(
+                    chaos.loss_spike("train.loss.spike"),  # lint: disable=disabled-gate -- same trace-time gate as above
+                    jnp.float32),)
+            else:   # "loss_cap" (sentry spike threshold)
+                capv = self._loss_cap  # already a float (set_loss_cap)
+                if self._cap_cache is None \
+                        or self._cap_cache[0] != capv:
+                    # restaged only when the sentry moves it (the
+                    # sentry quantizes, so this is rare) — same
+                    # host->device economy as the lr scalar above
+                    self._cap_cache = (capv,
+                                       jnp.asarray(capv, jnp.float32))
+                args += (self._cap_cache[1],)
         # recompile attribution reads the jit trace-cache size around
         # the call: growth = a REAL retrace for this batch's shapes
         # (immune to observability being enabled mid-run, when already-
@@ -473,7 +564,11 @@ class Trainer:
                 and self._trace_count() > n0:
             observability.inc("train.recompiles",
                               shape=self._batch_sig(batch))
-        if self.config.skip_nonfinite_grads:
+        if self.config.health_probe:
+            # the probe stays LAZY: [global_grad_norm, applied]; the
+            # sentry (or any caller) decides when to pay the sync
+            loss, self.params, self.opt_state, self.last_probe = out
+        elif self.config.skip_nonfinite_grads:
             loss, self.params, self.opt_state, skipped = out
             self._note_skip(skipped)
         else:
@@ -658,7 +753,20 @@ class Trainer:
             else contextlib.nullcontext()
 
     def _lr_value(self):
-        return self.optimizer._lr_value()
+        return self.optimizer._lr_value() * self._lr_scale
+
+    def set_lr_scale(self, scale):
+        """Transient multiplier on the schedule's LR (1.0 = none) —
+        the sentry's post-rollback dampening ramp. Host-side python
+        math; the staged lr scalar re-transfers only when it moves."""
+        self._lr_scale = float(scale)
+
+    def set_loss_cap(self, cap):
+        """The sentry's in-jit spike threshold (health_probe only): an
+        update whose loss exceeds `cap` is suppressed inside the
+        compiled step — params and optimizer state pass through
+        unchanged — and the probe reports applied=0. +inf disarms."""
+        self._loss_cap = float(cap)
 
     def lower(self, batch: dict):
         """jax.jit lowering of the step for inspection/AOT-compile."""
@@ -670,8 +778,9 @@ class Trainer:
                               shape=self._batch_sig(batch))
         lr = jnp.asarray(self._lr_value(), jnp.float32)
         args = (self.params, self.opt_state, lr, batch)
-        if self._chaos_poison:
-            args += (jnp.asarray(1.0, jnp.float32),)
+        for extra in self._extra_names:
+            v = float("inf") if extra == "loss_cap" else 1.0
+            args += (jnp.asarray(v, jnp.float32),)
         # same mesh context as step(): AOT lowering must see the ambient
         # mesh or sharding-aware vjps silently degrade
         with self._mesh_ctx():
@@ -832,4 +941,20 @@ class Trainer:
         self.params = {n: t._value for n, t in sd["params"].items()}
         self.opt_state = {n: {k: t._value for k, t in st.items()}
                           for n, st in sd["opt"].items()}
+        # loaded leaves arrive COMMITTED to their restore device, and
+        # committed-ness is part of the jit cache key — left as-is, the
+        # first step after every restore (elastic resume, sentry
+        # rollback) silently retraces the whole program. Re-stage to
+        # the same placement __init__ produced: the sharded path
+        # re-runs _shard_state, the default path drops commitment by
+        # round-tripping through host.
+        if self.mesh is not None and self.plan is not None:
+            self._shard_state()
+        else:
+            import numpy as np
+            self.params = {n: jnp.asarray(np.asarray(v))
+                           for n, v in self.params.items()}
+            self.opt_state = {n: {k: jnp.asarray(np.asarray(v))
+                                  for k, v in st.items()}
+                              for n, st in self.opt_state.items()}
         return path

@@ -198,6 +198,23 @@ def test_registry_sample_reads_shared_instruments():
                  "recompiles": 2, "ckpt_async_pending": 1.0}
 
 
+def test_registry_sample_carries_sentry_health():
+    """A rank running the training sentry ships its numerical-health
+    signals in the heartbeat: steps since the last promoted
+    (known-good) checkpoint and the trigger count summed across
+    reasons — visible fleet-wide BEFORE the rank quarantines. Absent
+    sentry instruments, neither field appears (the registry_sample
+    contract: only instruments that recorded show up)."""
+    obs.enable(reset=True)
+    assert "steps_since_good" not in fleet.registry_sample()
+    obs.set_gauge("train.sentry.steps_since_good", 37.0)
+    obs.inc("train.sentry.triggers", reason="loss_spike")
+    obs.inc("train.sentry.triggers", reason="nonfinite_grad")
+    s = fleet.registry_sample()
+    assert s["steps_since_good"] == 37.0
+    assert s["sentry_triggers"] == 2
+
+
 def test_snapshot_is_compact_and_bounded(store):
     """The published snapshot stays bounded no matter what sample_fn
     returns: field count capped, floats rounded, JSON compact."""

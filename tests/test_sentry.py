@@ -1,0 +1,495 @@
+"""Training anomaly sentry (distributed/sentry.py) + the trainer
+health probe it rides on.
+
+Tier-1 (fast, CPU, seeded): EWMA spike detector unit behavior;
+last-known-good promotion incl. async-durability gating; the in-jit
+health probe (non-finite and loss-cap suppression leave state
+bit-unchanged); the chaos acceptance runs — a NaN at a known step
+under the skip policy yields params bit-identical to a fault-free run
+that never saw the batch, a mid-run loss spike under the rollback
+policy restores the PROMOTED (not newest) checkpoint and never
+replays the offending data window, and a persistent fault quarantines
+after exactly K rollbacks with a parseable flight bundle. Plus the
+both-directions catalogue pins for the train.sentry.* metrics and the
+train.grad.nan / train.loss.spike chaos sites.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed import chaos, elastic
+from paddle_tpu.distributed.sentry import (SentryConfig, SentryQuarantine,
+                                           TrainingSentry)
+from paddle_tpu.observability import fleet
+
+pytestmark = pytest.mark.usefixtures("no_leaked_threads")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Observability and the flight recorder are process-global."""
+    obs.disable()
+    obs.REGISTRY.reset()
+    fleet.clear()
+    fleet.configure_flight_recorder(dir=None, max_keep=5)
+    yield
+    obs.disable()
+    obs.REGISTRY.reset()
+    fleet.clear()
+    fleet.configure_flight_recorder(dir=None, max_keep=5)
+
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, input_ids=None, labels=None):
+        return ((self.fc(input_ids) - labels) ** 2).mean()
+
+
+def _trainer(**cfg_kw):
+    from paddle_tpu.parallel.trainer import Trainer, TrainStepConfig
+    paddle_tpu.seed(1234)
+    m = _Net()
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    cfg = TrainStepConfig(compute_dtype=None, donate=False,
+                          shard_batch_seq=False, **cfg_kw)
+    return Trainer(m, o, config=cfg)
+
+
+def _batch_for(cursor):
+    rng = np.random.RandomState(cursor)     # deterministic per cursor
+    return {"input_ids": rng.randn(2, 4).astype(np.float32),
+            "labels": rng.randn(2, 4).astype(np.float32)}
+
+
+def _state_copy(t):
+    p = {n: np.asarray(v).copy() for n, v in t.params.items()}
+    s = {n: {k: np.asarray(v).copy() for k, v in st.items()}
+         for n, st in t.opt_state.items()}
+    return p, s
+
+
+def _assert_state_equal(t, p0, s0):
+    for n in p0:
+        np.testing.assert_array_equal(p0[n], np.asarray(t.params[n]))
+    for n in s0:
+        for k in s0[n]:
+            np.testing.assert_array_equal(s0[n][k],
+                                          np.asarray(t.opt_state[n][k]))
+
+
+# ---------------------------------------------------------------------------
+# EWMA spike detector
+# ---------------------------------------------------------------------------
+
+def _feed_healthy(s, n, base=1.0, start=0):
+    """n flat healthy losses (the sigma floor absorbs exact-flat
+    curves, so these never trigger)."""
+    for i in range(start, start + n):
+        r = s.observe_step(i, i, base, 1.0)
+        assert r is None, (i, r)
+
+
+def test_detector_warmup_then_spike_trigger():
+    # an outlier BEFORE warmup completes is not a trigger (the
+    # detector has no armed baseline yet)
+    pre = TrainingSentry(SentryConfig(warmup_steps=10, spike_zscore=6.0))
+    _feed_healthy(pre, 5)
+    assert pre.observe_step(5, 5, 50.0, 1.0) is None
+
+    s = TrainingSentry(SentryConfig(warmup_steps=10, spike_zscore=6.0))
+    _feed_healthy(s, 10)
+    assert s.seen >= 10
+    ewma_before = s.ewma
+    assert s.observe_step(10, 10, 50.0, 1.0) == "loss_spike"
+    assert s.triggers == {"loss_spike": 1}
+    # the spike is NOT folded into the EWMA (it must not drag the
+    # mean toward itself) and healthy observation resumes cleanly
+    assert s.ewma == ewma_before
+    assert s.observe_step(11, 11, 1.0, 1.0) is None
+
+
+def test_detector_nonfinite_triggers_even_in_warmup():
+    s = TrainingSentry(SentryConfig(warmup_steps=100))
+    assert s.observe_step(0, 0, float("nan"), 1.0) == "nonfinite_grad"
+    assert s.observe_step(1, 1, 1.0, float("inf")) == "nonfinite_grad"
+    assert s.triggers == {"nonfinite_grad": 2}
+    assert s.seen == 0                      # triggers never feed the EWMA
+
+
+def test_detector_unapplied_update_counts_as_spike():
+    """probe.applied == False means the compiled step already
+    suppressed the update on the staged cap — the host trusts it."""
+    s = TrainingSentry(SentryConfig(policy="skip", warmup_steps=2))
+    _feed_healthy(s, 2)
+    # loss 1.0 == the EWMA, so the host's own z-score is silent — the
+    # trigger comes purely from trusting the in-jit applied flag
+    assert s.observe_step(2, 2, 1.0, 1.0, applied=False) == "loss_spike"
+
+
+def test_detector_deterministic():
+    seq = [1.0, 1.1, 0.9, 1.05, 1.2, 0.95, 1.0, 8.0, 1.0]
+    outs = []
+    for _ in range(2):
+        s = TrainingSentry(SentryConfig(warmup_steps=4,
+                                        spike_zscore=5.0))
+        outs.append(([s.observe_step(i, i, x, 1.0)
+                      for i, x in enumerate(seq)],
+                     s.ewma, s.ewma_var, dict(s.triggers)))
+    assert outs[0] == outs[1]
+
+
+def test_loss_cap_armed_only_for_skip_policy_after_warmup():
+    r = TrainingSentry(SentryConfig(policy="rollback", warmup_steps=2))
+    _feed_healthy(r, 5)
+    assert r.loss_cap() == float("inf")     # rollback: host owns it
+    s = TrainingSentry(SentryConfig(policy="skip", warmup_steps=4))
+    assert s.loss_cap() == float("inf")     # pre-warmup: disarmed
+    _feed_healthy(s, 4)
+    cap = s.loss_cap()
+    assert np.isfinite(cap) and cap >= s.ewma
+    assert cap == float(f"{cap:.2g}")       # quantized: rare restaging
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="policy"):
+        TrainingSentry(SentryConfig(policy="panic"))
+
+
+# ---------------------------------------------------------------------------
+# last-known-good promotion
+# ---------------------------------------------------------------------------
+
+def test_bootstrap_promoted_on_durability_alone():
+    s = TrainingSentry(SentryConfig(promote_after=8))
+    s.note_checkpoint(0, 0, "/ck/step_00000000")    # sync => durable
+    assert s.promoted["step"] == 0          # no healthy steps needed
+    assert s.steps_since_good(13) == 13
+
+
+def test_promotion_waits_for_healthy_steps_and_drops_on_trigger():
+    s = TrainingSentry(SentryConfig(promote_after=3))
+    s.note_checkpoint(0, 0, "a")
+    s.note_checkpoint(10, 10, "b")
+    for _ in range(2):
+        s._healthy_step()
+    assert s.promoted["step"] == 0          # b: 2 < 3 healthy steps
+    # a trigger drops the unpromoted candidate — the window before a
+    # spike trips is exactly the state you must not trust
+    s._drop_candidates()
+    for _ in range(5):
+        s._healthy_step()
+    assert s.promoted["step"] == 0
+    # a fresh save after recovery promotes normally
+    s.note_checkpoint(20, 20, "c")
+    for _ in range(3):
+        s._healthy_step()
+    assert s.promoted["step"] == 20
+
+
+def test_async_durability_gates_promotion():
+    """With an async checkpointer a candidate becomes eligible only
+    after the durable-commit callback fired — a marker still in flight
+    (or torn) must never be a restore target."""
+    class _FakeCkpt:
+        def __init__(self):
+            self.cbs = []
+
+        def on_complete(self, fn):
+            self.cbs.append(fn)
+
+    ck = _FakeCkpt()
+    s = TrainingSentry(SentryConfig(promote_after=2))
+    s.note_checkpoint(0, 0, "boot", checkpointer=ck)
+    assert s.promoted is None               # bootstrap not durable yet
+    s.note_checkpoint(5, 5, "x", checkpointer=ck)
+    for _ in range(4):
+        s._healthy_step()
+    assert s.promoted is None               # healthy but NOT durable
+    ck.cbs[0]()                             # bootstrap commits
+    assert s.promoted["step"] == 0
+    ck.cbs[1]()                             # step-5 commits
+    assert s.promoted["step"] == 5
+
+
+def test_run_with_real_async_checkpointer(tmp_path):
+    """End-to-end with AsyncCheckpointer: promotion sequences behind
+    the writer thread's on_complete and the run finishes promoted."""
+    from paddle_tpu.distributed.async_checkpoint import AsyncCheckpointer
+    t = _trainer(health_probe=True)
+    t.checkpointer = AsyncCheckpointer()
+    try:
+        s = TrainingSentry(SentryConfig(policy="skip", warmup_steps=3,
+                                        promote_after=2))
+        out = s.run(t, _batch_for, 8, str(tmp_path), checkpoint_interval=3)
+        t.checkpointer.flush()
+        assert out["promoted_step"] is not None
+        assert t.checkpointer.saves_committed >= 2
+    finally:
+        t.checkpointer.close()
+
+
+# ---------------------------------------------------------------------------
+# the in-jit health probe
+# ---------------------------------------------------------------------------
+
+def test_health_probe_shape_and_applied():
+    t = _trainer(health_probe=True)
+    t.step(_batch_for(0))
+    probe = np.asarray(t.last_probe)
+    assert probe.shape == (2,)
+    assert probe[1] == 1.0                  # applied
+    assert np.isfinite(probe[0]) and probe[0] > 0
+
+
+def test_health_probe_mutually_exclusive_with_skip_nonfinite():
+    with pytest.raises(ValueError, match="health_probe"):
+        _trainer(health_probe=True, skip_nonfinite_grads=True)
+
+
+def test_probe_suppresses_nonfinite_update_in_jit():
+    """train.grad.nan poisons the grads; the compiled select discards
+    the update — params AND optimizer state stay bit-identical."""
+    with chaos.scoped(seed=2, rates={"train.grad.nan": (1.0, 1)}):
+        t = _trainer(health_probe=True)
+        p0, s0 = _state_copy(t)
+        t.step(_batch_for(0))
+        assert np.asarray(t.last_probe)[1] == 0.0   # suppressed
+        _assert_state_equal(t, p0, s0)
+        t.step(_batch_for(1))                       # healthy again
+        assert np.asarray(t.last_probe)[1] == 1.0
+        assert not np.array_equal(p0["fc.weight"],
+                                  np.asarray(t.params["fc.weight"]))
+
+
+def test_loss_cap_suppresses_update_in_jit():
+    t = _trainer(health_probe=True)
+    t.set_loss_cap(1e-9)                    # everything is "a spike"
+    p0, s0 = _state_copy(t)
+    t.step(_batch_for(0))
+    assert np.asarray(t.last_probe)[1] == 0.0
+    _assert_state_equal(t, p0, s0)
+    t.set_loss_cap(float("inf"))
+    t.step(_batch_for(0))
+    assert np.asarray(t.last_probe)[1] == 1.0
+
+
+def test_loss_spike_chaos_scales_loss():
+    clean = _trainer(health_probe=True)
+    l0 = float(np.asarray(clean.step(_batch_for(0))._value))
+    with chaos.scoped(seed=2, rates={"train.loss.spike": (1.0, 1)}):
+        t = _trainer(health_probe=True)
+        l1 = float(np.asarray(t.step(_batch_for(0))._value))
+    np.testing.assert_allclose(l1, 100.0 * l0, rtol=1e-5)
+
+
+def test_set_lr_scale_scales_updates():
+    a, b = _trainer(), _trainer()
+    b.set_lr_scale(0.5)
+    w0 = np.asarray(a.params["fc.weight"]).copy()
+    a.step(_batch_for(0))
+    b.step(_batch_for(0))
+    da = np.asarray(a.params["fc.weight"]) - w0
+    db = np.asarray(b.params["fc.weight"]) - w0
+    np.testing.assert_allclose(db, 0.5 * da, rtol=1e-5, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: skip policy — bit-identical to never seeing the batch
+# ---------------------------------------------------------------------------
+
+def test_skip_policy_bitidentical_to_batch_omitted_run(tmp_path):
+    # find which decision index fires at this seed/rate
+    with chaos.scoped(seed=5, rates={"train.grad.nan": (0.3, 1)}):
+        k = [chaos.should_fire("train.grad.nan")
+             for _ in range(30)].index(True)
+    N = 20
+    with chaos.scoped(seed=5, rates={"train.grad.nan": (0.3, 1)}):
+        t = _trainer(health_probe=True)
+        s = TrainingSentry(SentryConfig(policy="skip", warmup_steps=5,
+                                        promote_after=3))
+        out = s.run(t, _batch_for, N, str(tmp_path),
+                    checkpoint_interval=50)
+    assert out["skips"] == 1
+    assert out["triggers"] == {"nonfinite_grad": 1}
+    assert out["cursor"] == N               # the batch was consumed
+
+    # fault-free run over the same stream, just never seeing batch k
+    clean = _trainer(health_probe=True)
+    for c in range(N):
+        if c != k:
+            clean.step(_batch_for(c))
+    for n in t.params:
+        np.testing.assert_array_equal(np.asarray(t.params[n]),
+                                      np.asarray(clean.params[n]))
+
+
+# ---------------------------------------------------------------------------
+# acceptance: rollback policy — promoted target, window never replayed
+# ---------------------------------------------------------------------------
+
+def test_rollback_restores_promoted_not_newest_and_skips_window(
+        tmp_path, monkeypatch):
+    SPIKE_AT = 17
+
+    consumed = []
+
+    def batch_for(cursor):
+        # low-variance stream (fixed inputs, near-zero labels) so the
+        # loss declines smoothly and only the poisoned batch spikes —
+        # natural batch-to-batch noise must not trip the detector here
+        consumed.append(cursor)
+        rng = np.random.RandomState(cursor)
+        b = {"input_ids": np.ones((2, 4), np.float32),
+             "labels": (1e-3 * rng.randn(2, 4)).astype(np.float32)}
+        if cursor == SPIKE_AT:              # data-driven loss spike
+            b["labels"] = b["labels"] + 1e3
+        return b
+
+    t = _trainer(health_probe=True)
+    restored = []
+    real_load = t.load_checkpoint
+    monkeypatch.setattr(
+        t, "load_checkpoint",
+        lambda path: (restored.append(path), real_load(path))[1])
+
+    s = TrainingSentry(SentryConfig(policy="rollback", warmup_steps=6,
+                                    spike_zscore=6.0, promote_after=4,
+                                    skip_window=1, lr_dampen_steps=4,
+                                    lr_dampen_factor=0.25))
+    out = s.run(t, batch_for, 25, str(tmp_path), checkpoint_interval=5)
+
+    assert out["rollbacks"] == 1
+    assert out["triggers"] == {"loss_spike": 1}
+    # at the trigger (step 17) the NEWEST checkpoint is step 15 with
+    # only 2 healthy steps behind it (< promote_after=4) — the restore
+    # must land on the PROMOTED step-10 checkpoint instead
+    assert len(restored) == 1
+    assert restored[0].endswith("step_00000010")
+    # the data cursor is monotonic and the offending window is gone:
+    # every cursor consumed exactly once, none ever replayed
+    assert consumed == sorted(consumed)
+    assert len(consumed) == len(set(consumed))
+    assert consumed.count(SPIKE_AT) == 1
+    assert out["cursor"] == 25 + (17 - 10) + 1   # replayed on fresh data
+    # LR dampening ramped back to 1.0 over the healthy re-entry
+    assert t._lr_scale == 1.0
+    assert out["promoted_step"] == 20
+    # the sidecar records the resume cursor for a process-level restart
+    side = TrainingSentry.load_cursor(str(tmp_path))
+    assert side is not None and side["cursor"] > side["step"]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: quarantine after exactly K rollbacks, parseable bundle
+# ---------------------------------------------------------------------------
+
+def test_quarantine_after_exactly_k_rollbacks_with_bundle(tmp_path):
+    obs.enable(reset=True)
+    flight = str(tmp_path / "flight")
+    fleet.configure_flight_recorder(dir=flight)
+    K = 3
+    with chaos.scoped(seed=3, rates={"train.grad.nan": 1.0}):
+        t = _trainer(health_probe=True)
+        s = TrainingSentry(SentryConfig(policy="rollback",
+                                        warmup_steps=2, promote_after=1,
+                                        quarantine_rollbacks=K,
+                                        quarantine_window=1000))
+        with pytest.raises(SentryQuarantine):
+            s.run(t, _batch_for, 50, str(tmp_path / "ck"),
+                  checkpoint_interval=5)
+    # exactly K rollbacks ever executed: the K+1-th trigger sees a
+    # full window and quarantines WITHOUT restoring again
+    assert s.rollbacks == K
+    assert s.triggers["sentry_quarantine"] == 1
+    c = obs.REGISTRY.counter("train.sentry.triggers")
+    assert c.value(reason="sentry_quarantine") == 1
+    assert obs.REGISTRY.counter("train.sentry.rollbacks").value() == K
+
+    manifests = {p: json.load(open(os.path.join(p, "manifest.json")))
+                 for p in fleet.flight_records(flight)}
+    quar = [p for p, m in manifests.items()
+            if m["reason"] == "sentry_quarantine"]
+    assert len(quar) == 1
+    extra = manifests[quar[0]]["extra"]["sentry"]
+    assert extra["trigger"] == "sentry_quarantine"
+    assert extra["rollbacks_in_window"] == K
+    assert extra["policy"] == "rollback"
+    assert extra["history"]                 # the per-step evidence ring
+    # obs_dump renders the sentry section from the bundle alone
+    from tools import obs_dump
+    text = obs_dump.render(quar[0])
+    assert "sentry:" in text
+    assert "trigger=sentry_quarantine" in text
+    assert "rollbacks_in_window=3" in text
+
+
+def test_run_resilient_reraises_quarantine_without_restart(tmp_path):
+    """SentryQuarantine is an elastic.HaltTraining: the restart loop
+    re-raises it immediately instead of burning its budget replaying
+    the same deterministic collapse."""
+    assert issubclass(SentryQuarantine, elastic.HaltTraining)
+    calls = {"n": 0}
+
+    def train_fn(start, end):
+        calls["n"] += 1
+        raise SentryQuarantine("re-diverges from every restore point")
+
+    with pytest.raises(SentryQuarantine):
+        elastic.run_resilient(train_fn, 10, str(tmp_path),
+                              lambda step, path: None, lambda path: None,
+                              checkpoint_interval=5, max_restarts=5)
+    assert calls["n"] == 1                  # no restarts attempted
+
+
+def test_run_requires_health_probe(tmp_path):
+    t = _trainer()
+    with pytest.raises(ValueError, match="health_probe"):
+        TrainingSentry().run(t, _batch_for, 1, str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# catalogue pins (both directions)
+# ---------------------------------------------------------------------------
+
+def _tool(name):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_sentry_metrics_catalogued_and_recorded():
+    """Both directions: every train.sentry.* instrumentation site uses
+    a catalogued literal AND every catalogued train.sentry.* name has a
+    live call site — the catalogue and the sentry cannot drift."""
+    violations, seen, catalogue = _tool("check_metric_names").scan(_ROOT)
+    assert violations == []
+    names = {n for n in catalogue if n.startswith("train.sentry.")}
+    assert names == {"train.sentry.triggers", "train.sentry.skips",
+                     "train.sentry.rollbacks",
+                     "train.sentry.steps_since_good",
+                     "train.sentry.probe.seconds"}
+    missing = names - seen
+    assert not missing, f"catalogued but never recorded: {missing}"
+
+
+def test_sentry_chaos_sites_registered_and_driven():
+    violations, seen, points = _tool("check_chaos_points").scan(_ROOT)
+    assert violations == []
+    driven = {site for site, _is_prefix in seen}
+    for site in ("train.grad.nan", "train.loss.spike"):
+        assert site in points               # documented
+        assert site in driven, f"registered but never driven: {site}"

@@ -25,7 +25,8 @@ DESCRIPTION = ("chaos injection sites must be string literals "
                "registered in distributed/chaos.py POINTS")
 
 INJECTORS = {"should_fire", "maybe_delay", "maybe_drop",
-             "maybe_preempt", "maybe_corrupt_file", "grad_poison"}
+             "maybe_preempt", "maybe_corrupt_file", "grad_poison",
+             "loss_spike"}
 
 # the registry module itself (its function bodies pass `site` variables
 # around, which is the implementation, not an injection site)

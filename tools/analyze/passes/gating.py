@@ -43,7 +43,7 @@ DESCRIPTION = ("observability/chaos instrument calls outside their "
 OBS_INSTRUMENTS = {"inc", "observe", "set_gauge"}
 CHAOS_INSTRUMENTS = {"should_fire", "maybe_delay", "maybe_drop",
                      "maybe_preempt", "maybe_corrupt_file",
-                     "grad_poison"}
+                     "grad_poison", "loss_spike"}
 
 # instrument home packages: call sites inside them ARE the plumbing
 _EXEMPT_PREFIXES = (os.path.join("paddle_tpu", "observability") + os.sep,)

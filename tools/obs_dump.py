@@ -99,6 +99,37 @@ def _fleet_lines(fleet: dict) -> list:
     return lines
 
 
+def _sentry_lines(manifest: dict) -> list:
+    """The training-sentry section (bundles dumped by
+    distributed/sentry.py carry detector state under
+    manifest.extra.sentry); [] when this bundle is not a sentry one."""
+    extra = manifest.get("extra")
+    s = extra.get("sentry") if isinstance(extra, dict) else None
+    if not isinstance(s, dict):
+        return []
+    rng = s.get("step_range") or ["?", "?"]
+    lines = [
+        "",
+        "sentry:",
+        f"  trigger={s.get('trigger')} policy={s.get('policy')} "
+        f"at step={s.get('step')} cursor={s.get('cursor')}",
+        f"  loss={s.get('loss')} grad_norm={s.get('grad_norm')} "
+        f"ewma={s.get('ewma')} sigma={s.get('sigma')} "
+        f"zscore={s.get('zscore')}",
+        f"  steps_since_good={s.get('steps_since_good')} "
+        f"offending step range=[{rng[0]}, {rng[1]}] "
+        f"rollbacks_in_window={s.get('rollbacks_in_window')}",
+        f"  rollback target: {s.get('rollback_target') or '(none)'}",
+    ]
+    hist = s.get("history") or []
+    if hist:
+        lines.append(f"  history (last {len(hist)} steps: step "
+                     "cursor loss grad_norm applied):")
+        for row in hist[-8:]:
+            lines.append("    " + " ".join(str(x) for x in row))
+    return lines
+
+
 def _request_lines(requests: dict) -> list:
     if not isinstance(requests, dict):
         return ["  (unreadable)"]
@@ -124,6 +155,7 @@ def render(path: str) -> str:
         f"(pid {man.get('pid')} on {man.get('host')})",
         "exception: " + (f"{exc['type']}: {exc['message']}" if exc
                          else "(none recorded)"),
+        *_sentry_lines(man),
         "",
         "fleet view (last seen):",
         *_fleet_lines(b.get("fleet")),
