@@ -98,6 +98,14 @@ POINTS = {
                            "a miss at admission (the hit-rate lever "
                            "for deterministic cold-vs-warm tests and "
                            "the prefix bench)",
+    "kvtier.spill.fail": "drop a host-tier spill capture at eviction "
+                         "(the page is destroyed instead of spilled — "
+                         "degraded-mode lever: the next hit on that "
+                         "prefix must simply be cold, never wrong)",
+    "kvtier.restore.delay": "slow host-to-device KV page restore at "
+                            "admission (PCIe congestion / huge pages "
+                            "— stretches warm TTFT, the tiered-KV "
+                            "latency lever)",
     "tenant.storm": "stamp an UNLABELED serving/router request with "
                     "the synthetic storm tenant id (inference/"
                     "tenancy.resolve_tenant) — rate 1.0 turns all "

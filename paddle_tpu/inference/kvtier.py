@@ -1,0 +1,300 @@
+"""Host-RAM KV tier: spill evicted-but-reusable prefix pages to host
+memory, restore them on a later hit (ISSUE 18).
+
+HBM bounds the prefix cache (inference/prefix.py); a fleet's working
+set of shared system prompts and idle chat sessions is 10-100x larger
+than device memory. This module adds the next rung of the memory
+hierarchy: a `HostKVTier` that `PagedKVEngine` consults at the exact
+moments the device tier changes population —
+
+- **Spill**: when eviction would destroy a zero-ref cached page
+  (`_note_evicted`), the engine snapshots the page's pool buffers as
+  device slices and hands them to this tier's background worker. jax
+  arrays are immutable, so the slices pin the page's content no
+  matter what the pools do next; the blocking D2H (`np.asarray`)
+  happens on the WORKER thread, so a spill never stalls a scheduler
+  tick. int8 pools spill their quant scale rows alongside (~0.52x
+  the bf16 byte volume both directions).
+- **Restore**: `_admit`'s prefix lookup extends a device-cache run
+  with host-resident pages — one batched H2D upload per pool buffer,
+  then the existing tail-only warm prefill runs unchanged. A restored
+  prefix is a warm hit with a copy in front.
+- **Suspend/resume**: a long-idle session's cached pages take the
+  same spill path (engine `suspend_after_s` sweep), freeing HBM until
+  the conversation's next turn restores them.
+
+Entries are keyed by the SAME process-stable chain keys the device
+cache uses (prefix.chain_keys): a key commits to the full token
+prefix, and KV content is a pure function of that prefix, so a key
+already resident in the tier never needs re-capturing.
+
+The tier owns its counters and guards everything with ONE leaf lock
+(never held while calling back into the engine or jax), keeping the
+lock-order and guarded-field analyzer passes empty. The worker thread
+follows the io/prefetch.DevicePrefetcher lifecycle: daemon, weakref
+to the owner so an abandoned tier stays collectable, join-on-stop.
+"""
+from __future__ import annotations
+
+import collections
+import queue as _queue
+import threading
+import weakref
+
+import numpy as np
+
+from paddle_tpu import observability
+
+__all__ = ["HostKVTier"]
+
+_SENTINEL = object()
+
+
+class _HostEntry:
+    """One spilled page: per-layer tuples of host arrays in pool-group
+    order ((k, v) or (k, v, k_scale_row, v_scale_row)), plus the draft
+    model's mirror when the engine runs speculative decoding."""
+
+    __slots__ = ("layers", "draft", "nbytes")
+
+    def __init__(self, layers, draft):
+        self.layers = layers
+        self.draft = draft
+        n = sum(a.nbytes for grp in layers for a in grp)
+        if draft is not None:
+            n += sum(a.nbytes for grp in draft for a in grp)
+        self.nbytes = n
+
+
+def _materialize(groups):
+    """Device slices -> host numpy arrays (the blocking transfer; runs
+    on the worker thread only)."""
+    if groups is None:
+        return None
+    return [tuple(np.asarray(a) for a in grp) for grp in groups]
+
+
+def _worker_loop(tier_ref, stop, q):
+    """Drain spill jobs. Holds only a weakref to the tier (plus the
+    stop event and queue, which carry no back-reference): a tier
+    abandoned without stop() stays collectable and the worker exits on
+    its next poll instead of spinning forever."""
+    while not stop.is_set():
+        try:
+            item = q.get(timeout=0.5)
+        except _queue.Empty:
+            if tier_ref() is None:
+                return
+            continue
+        if item is _SENTINEL:
+            return
+        tier = tier_ref()
+        if tier is None:
+            return
+        tier._commit(item)
+        del tier
+
+
+class HostKVTier:
+    """Byte-budgeted LRU of chain-key -> host-resident KV page.
+
+    Thread-safe: the engine's scheduler thread enqueues spills and
+    pops restore runs; the background worker commits materialized
+    entries; serving/metrics threads read snapshots. All state mutates
+    under one leaf lock.
+    """
+
+    def __init__(self, budget_bytes):
+        self.budget_bytes = int(budget_bytes)
+        if self.budget_bytes <= 0:
+            raise ValueError(
+                f"budget_bytes must be > 0, got {budget_bytes}")
+        self._entries: collections.OrderedDict[str, _HostEntry] = \
+            collections.OrderedDict()
+        self._bytes = 0
+        self._pending = 0           # spills enqueued, not yet committed
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._thread = None
+        self._q: _queue.Queue = _queue.Queue()
+        # counters (all guarded by _lock; snapshot() is the reader)
+        self.spilled_pages = 0
+        self.restored_pages = 0
+        self.spill_bytes = 0
+        self.restore_bytes = 0
+        self.suspends = 0
+        self.resumes = 0
+        self.lookups = 0            # restore consults (per admission)
+        self.hits = 0               # consults that extended the run
+        self.evictions = 0          # entries dropped by the byte budget
+        self.spill_skipped = 0      # chaos kvtier.spill.fail drops
+        self.spill_errors = 0       # worker-side materialize failures
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    # -- spill (scheduler thread enqueues, worker commits) -------------
+    def _ensure_worker(self):
+        # under _lock. Lazily (re)started so a stopped tier accepts new
+        # spills after engine.stop()/start() cycles and engines that
+        # never evict never own a thread.
+        t = self._thread
+        if t is not None and t.is_alive():
+            return
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=_worker_loop,
+            args=(weakref.ref(self), self._stop, self._q),
+            daemon=True, name="pt-kvtier-spill")
+        self._thread.start()
+
+    def spill(self, key, layers, draft=None):
+        """Queue one page's device slices for host capture. Returns
+        immediately; the worker materializes and commits."""
+        with self._cond:
+            self._pending += 1
+            self._ensure_worker()
+        self._q.put((key, layers, draft))
+
+    def _commit(self, item):
+        """Worker thread: materialize one job and install it under the
+        byte-budgeted LRU."""
+        key, layers, draft = item
+        try:
+            entry = _HostEntry(_materialize(layers),
+                               _materialize(draft))
+        except Exception:       # noqa: BLE001 — a failed D2H loses one
+            #                     page, never the worker
+            with self._cond:
+                self.spill_errors += 1
+                self._pending -= 1
+                self._cond.notify_all()
+            return
+        with self._cond:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = entry
+            self._bytes += entry.nbytes
+            self.spilled_pages += 1
+            self.spill_bytes += entry.nbytes
+            while self._bytes > self.budget_bytes and self._entries:
+                _, ev = self._entries.popitem(last=False)
+                self._bytes -= ev.nbytes
+                self.evictions += 1
+            self._pending -= 1
+            self._cond.notify_all()
+        if observability.ENABLED:
+            observability.inc("inference.kvtier.spilled_pages")
+            observability.inc("inference.kvtier.spill_bytes",
+                              entry.nbytes)
+
+    def flush(self, timeout=30.0):
+        """Block until every queued spill has committed (tests and the
+        bench make the tier population deterministic with this).
+        Returns True when drained."""
+        with self._cond:
+            return self._cond.wait_for(lambda: self._pending == 0,
+                                       timeout)
+
+    # -- restore (scheduler thread) -------------------------------------
+    def has(self, key):
+        with self._lock:
+            return key in self._entries
+
+    def match_run(self, keys):
+        """Entries for the longest LEADING run of `keys` resident —
+        same chain-truncation semantics as PrefixCache.match. Matched
+        entries are LRU-touched and returned as (key, entry) pairs;
+        entries STAY resident (the host copy remains valid — a future
+        re-eviction of the restored page needs no new D2H)."""
+        out = []
+        with self._lock:
+            if keys:
+                self.lookups += 1
+            for k in keys:
+                e = self._entries.get(k)
+                if e is None:
+                    break
+                self._entries.move_to_end(k)
+                out.append((k, e))
+            if out:
+                self.hits += 1
+        return out
+
+    def discard(self, key):
+        """Drop one entry (the engine found it geometry-incompatible)."""
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is not None:
+                self._bytes -= e.nbytes
+
+    # -- engine-side accounting -----------------------------------------
+    def note_restored(self, n_pages, nbytes):
+        with self._lock:
+            self.restored_pages += n_pages
+            self.restore_bytes += nbytes
+        if observability.ENABLED:
+            observability.inc("inference.kvtier.restored_pages",
+                              n_pages)
+            observability.inc("inference.kvtier.restore_bytes", nbytes)
+
+    def note_suspend(self):
+        with self._lock:
+            self.suspends += 1
+        if observability.ENABLED:
+            observability.inc("inference.kvtier.suspends")
+
+    def note_resume(self):
+        with self._lock:
+            self.resumes += 1
+        if observability.ENABLED:
+            observability.inc("inference.kvtier.resumes")
+
+    def note_spill_skipped(self):
+        """Chaos `kvtier.spill.fail`: the capture was dropped and the
+        eviction proceeded as a plain (destructive) one."""
+        with self._lock:
+            self.spill_skipped += 1
+
+    # -- observation ------------------------------------------------------
+    def snapshot(self):
+        """The /stats `kvtier` block (the router reads hits/lookups
+        for its tier-hit-rate column)."""
+        with self._lock:
+            lk = self.lookups
+            return {"enabled": True,
+                    "host_pages": len(self._entries),
+                    "host_bytes": self._bytes,
+                    "budget_bytes": self.budget_bytes,
+                    "pending_spills": self._pending,
+                    "spilled_pages": self.spilled_pages,
+                    "restored_pages": self.restored_pages,
+                    "spill_bytes": self.spill_bytes,
+                    "restore_bytes": self.restore_bytes,
+                    "suspends": self.suspends,
+                    "resumes": self.resumes,
+                    "lookups": lk,
+                    "hits": self.hits,
+                    "hit_rate": round(self.hits / lk, 4) if lk else 0.0,
+                    "evictions": self.evictions,
+                    "spill_skipped": self.spill_skipped,
+                    "spill_errors": self.spill_errors}
+
+    # -- lifecycle --------------------------------------------------------
+    def stop(self, join_timeout=5.0):
+        """Stop the worker after it drains queued spills (entries stay
+        resident; a later spill() restarts the worker)."""
+        with self._lock:
+            t = self._thread
+            self._thread = None
+        if t is None or not t.is_alive():
+            return
+        self._q.put(_SENTINEL)
+        t.join(timeout=join_timeout)
+        if t.is_alive():        # daemon: dies with the process anyway
+            import warnings
+            warnings.warn("HostKVTier: spill worker did not stop "
+                          f"within {join_timeout}s", stacklevel=2)

@@ -61,6 +61,18 @@ and a warm `submit()` prefills only the uncached tail (O(tail), not
 O(prompt)). Cold entries evict LRU under the page budget, and the
 admission headroom counts reclaimable cached pages, so the cache can
 never starve decode allocation.
+
+Tiered KV (ISSUE 18): `host_tier_bytes=N` adds a host-RAM tier below
+the device prefix cache (inference/kvtier.py). Eviction SPILLS a
+zero-ref cached page to host instead of destroying it (D2H snapshot
+captured on the scheduler thread, materialized on the tier's worker);
+admission extends a device-cache run with host-resident pages via one
+batched H2D upload and then runs the same tail-only warm prefill — a
+restored prefix is a warm hit with a copy in front. `submit(session=)`
+plus `suspend_after_s` generalize this to live conversations: a
+finished turn's full pages (prompt AND generated tokens) stay keyed in
+the cache, a long-idle session's pages spill to host freeing their
+HBM, and the next turn rebuilds its block table from restored pages.
 """
 from __future__ import annotations
 
@@ -84,6 +96,7 @@ from paddle_tpu.inference.overload import (DeadlineExceeded,
                                            EngineOverloaded,
                                            OverloadError,
                                            TenantQuotaExceeded)
+from paddle_tpu.inference.kvtier import HostKVTier
 from paddle_tpu.inference.prefix import PrefixCache, chain_keys
 from paddle_tpu.inference.tenancy import WeightedFairScheduler
 
@@ -363,6 +376,8 @@ class _Request:
         self.prefix_keys = []       # full-page hash chain; set by submit()
         self.obs = None             # request-tracing context (or None)
         self.tenant = None          # tenant id (tenancy; set by submit)
+        self.session = None         # conversation id (tiered KV; set
+        #                             by submit — keys suspend/resume)
         self.queued_at = time.monotonic()   # per-tenant queue-wait clock
         self.tokens: list[int] = []          # accepted generated tokens
         self.queue: queue.Queue = queue.Queue()
@@ -518,7 +533,8 @@ class PagedKVEngine:
                  max_pages_per_slot=None, steps_per_tick=4, seed=0,
                  prefill_chunk=None, draft_model=None, spec_tokens=4,
                  dtype=None, max_pending=None, kernel=None,
-                 kv_dtype=None, prefix_cache_pages=0, tenancy=None):
+                 kv_dtype=None, prefix_cache_pages=0, tenancy=None,
+                 host_tier_bytes=0, suspend_after_s=None):
         cfg = model.config
         self.model = model
         self.max_slots = int(max_slots)
@@ -629,6 +645,28 @@ class PagedKVEngine:
                              f"{prefix_cache_pages}")
         self.prefix_cache = (PrefixCache(prefix_cache_pages)
                              if int(prefix_cache_pages) else None)
+        # host-RAM KV tier (module doc): spill/restore below the device
+        # cache, plus session suspend/resume riding the same machinery
+        if int(host_tier_bytes) < 0:
+            raise ValueError(f"host_tier_bytes must be >= 0, got "
+                             f"{host_tier_bytes}")
+        if int(host_tier_bytes) and self.prefix_cache is None:
+            raise ValueError(
+                "host_tier_bytes requires prefix_cache_pages > 0: the "
+                "tier spills and restores PREFIX-CACHE pages (chain "
+                "keys are the page identity)")
+        self.host_tier = (HostKVTier(int(host_tier_bytes))
+                          if int(host_tier_bytes) else None)
+        if suspend_after_s is not None and self.host_tier is None:
+            raise ValueError(
+                "suspend_after_s requires host_tier_bytes > 0: a "
+                "suspended session's pages live in the host tier")
+        self.suspend_after_s = (None if suspend_after_s is None
+                                else float(suspend_after_s))
+        # session id -> {keys, last, suspended}; scheduler-thread-only
+        # (retire inserts, admit touches, the suspend sweep spills)
+        self._sessions: collections.OrderedDict[str, dict] = \
+            collections.OrderedDict()
         self._page_refs: dict[int, int] = {}
         # incremental twin of "cached pages only the cache still
         # holds": _ref_page/_unref_page/_prefix_insert/_evict keep it
@@ -699,6 +737,9 @@ class PagedKVEngine:
         s = self.stats
         registry.set_gauge("inference.kv.bytes_per_slot",
                            self.kv_bytes_per_slot())
+        if self.host_tier is not None:
+            registry.set_gauge("inference.kvtier.host_pages",
+                               len(self.host_tier))
         registry.set_gauge("engine.ticks", s["ticks"])
         registry.set_gauge("engine.prefills", s["prefills"])
         registry.set_gauge("engine.tokens_out", s["tokens_out"])
@@ -731,6 +772,13 @@ class PagedKVEngine:
                 "cached_pages": len(self.prefix_cache),
                 "page_budget": self.prefix_cache.page_budget}
 
+    def kvtier_stats(self):
+        """The host-tier /stats block (PredictorServer embeds it beside
+        the prefix block; the router reads hits/lookups for its
+        tier-hit-rate column); None when the tier is disabled."""
+        return (None if self.host_tier is None
+                else self.host_tier.snapshot())
+
     # -- submission ------------------------------------------------------
     def _reclaimable_pages(self):
         """Cached pages only the cache still holds — evictable on
@@ -749,7 +797,8 @@ class PagedKVEngine:
 
     def submit(self, ids, max_new_tokens=32, *, eos_token_id=None,
                do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-               deadline=None, tenant=None, **_ignored) -> _Request:
+               deadline=None, tenant=None, session=None,
+               **_ignored) -> _Request:
         if deadline is not None and deadline.expired():
             raise DeadlineExceeded(
                 "deadline exceeded before engine admission")
@@ -768,6 +817,12 @@ class PagedKVEngine:
                        temperature, top_k, top_p, pages,
                        deadline=deadline, engine=self)
         req.tenant = tenant
+        # session identity opts a conversation into turn retention and
+        # suspend/resume (tiered KV); only meaningful with a prefix
+        # cache — without one there is nothing to key pages by
+        req.session = (str(session)
+                       if session is not None
+                       and self.prefix_cache is not None else None)
         # hash the prompt's full pages NOW (caller thread, cheap); the
         # cache LOOKUP happens at admission on the scheduler thread.
         # The last full page is keyed too (it is immutable — decode
@@ -1015,18 +1070,34 @@ class PagedKVEngine:
                 key_page = cache.pop_lru()
                 if key_page is None:
                     break
-                self._note_evicted(key_page[1], freed)
+                self._note_evicted(key_page[1], freed, key=key_page[0])
         else:
             key_page = cache.pop_lru_where(
                 lambda p: self._page_refs.get(p, 0) == 1)
             if key_page is not None:
-                self._note_evicted(key_page[1], freed)
+                self._note_evicted(key_page[1], freed, key=key_page[0])
         self._recycle_pages(freed)
         return freed
 
-    def _note_evicted(self, page, freed):
-        """Shared eviction epilogue: leave the cached-page ledger,
-        drop the cache's ref, collect the page if that freed it."""
+    def _note_evicted(self, page, freed, key=None):
+        """Shared eviction epilogue: SPILL the page to the host tier
+        when one is configured (never destroy a reusable page while
+        host RAM has budget — the capture must precede the ledger exit
+        and recycle so the snapshot sees the page's content), then
+        leave the cached-page ledger, drop the cache's ref, collect
+        the page if that freed it."""
+        if key is not None and self.host_tier is not None \
+                and not self.host_tier.has(key):
+            # a key already host-resident never re-captures: the chain
+            # key commits to the full token prefix, and KV content is
+            # a pure function of it
+            from paddle_tpu.distributed import chaos
+            if chaos.ENABLED and chaos.should_fire("kvtier.spill.fail"):
+                # degraded mode: plain (destructive) eviction — the
+                # page is gone from every tier, the next hit is cold
+                self.host_tier.note_spill_skipped()
+            else:
+                self._tier_capture(key, page)
         self._cached_pages.discard(page)
         if self._page_refs.get(page, 0) == 1:
             self._reclaimable -= 1      # was cache-only: leaving the
@@ -1121,6 +1192,205 @@ class PagedKVEngine:
                 self._ref_page(slot.pages[j])
                 self._cached_pages.add(slot.pages[j])
         self._evict_prefix_entries(budget_only=True)
+
+    # -- host tier (tiered KV, module doc) -------------------------------
+    def _tier_capture(self, key, page):
+        """Snapshot one page's pool buffers as device slices and hand
+        them to the tier's worker. jax arrays are immutable, so the
+        slices pin the page's CURRENT content no matter what the pool
+        buffers do next (recycle scale-zeroing, donation); the
+        blocking D2H (np.asarray) happens on the WORKER thread, so a
+        spill never stalls a tick. `copy_to_host_async` starts the
+        transfer early where the backend supports it."""
+
+        def slices(pools):
+            out = []
+            for grp in pools:
+                cut = tuple(a[page] for a in grp)
+                for a in cut:
+                    f = getattr(a, "copy_to_host_async", None)
+                    if f is not None:
+                        try:
+                            f()
+                        except Exception:  # lint: disable=silent-swallow -- the async D2H is a hint; the worker's np.asarray does the real transfer either way
+                            pass
+                out.append(cut)
+            return out
+
+        draft = (slices(self.draft_pools)
+                 if self.draft_pools is not None else None)
+        self.host_tier.spill(key, slices(self.pools), draft)
+
+    def _tier_entry_compatible(self, entry):
+        """A host entry must match this engine's pool geometry exactly
+        (defensive: entries are engine-born, but a stale entry after a
+        reconfig must drop, not corrupt pages)."""
+        if len(entry.layers) != len(self.pools):
+            return False
+        grp = entry.layers[0]
+        ref = self.pools[0]
+        if len(grp) != len(ref):
+            return False
+        if tuple(grp[0].shape) != tuple(ref[0].shape[1:]) or \
+                str(grp[0].dtype) != str(ref[0].dtype):
+            return False
+        if self.draft_pools is not None and entry.draft is None:
+            return False
+        return True
+
+    def _tier_upload(self, ents, pages):
+        """One batched H2D `.at[idx].set` per pool buffer (the
+        DevicePrefetcher lesson: stack on host, place once — not one
+        tiny transfer per page per layer)."""
+        idx = jnp.asarray(pages, jnp.int32)
+
+        def put(pools, per_entry):
+            out = []
+            for li, grp in enumerate(pools):
+                out.append(tuple(
+                    grp[ai].at[idx].set(jnp.asarray(
+                        np.stack([pe[li][ai] for pe in per_entry])))
+                    for ai in range(len(grp))))
+            return out
+
+        self.pools = put(self.pools, [e.layers for e in ents])
+        if self.draft_pools is not None:
+            self.draft_pools = put(self.draft_pools,
+                                   [e.draft for e in ents])
+
+    def _tier_restore(self, req, shared_pages):
+        """Host-tier consult on a device-cache miss or partial hit:
+        extend the leading shared run with pages restored from host
+        RAM. Each restored page is drawn from the free list and enters
+        the ledger exactly like a freshly inserted prefix page (cache
+        ref only, reclaimable), so `admission_headroom()` stays
+        truthful; _admit then refs the whole run for the slot like any
+        warm hit, and the tail-only prefill downstream is unchanged —
+        a restored prefix is a warm hit with a copy in front."""
+        tier = self.host_tier
+        cache = self.prefix_cache
+        if tier is None or cache is None or not req.prefix_keys:
+            return shared_pages
+        have = len(shared_pages)
+        shareable = (int(req.prompt.size) - 1) // self.page_size
+        keys = req.prefix_keys[have:shareable]
+        if not keys:
+            return shared_pages
+        # restored pages come off the free list NOW instead of off the
+        # reservation later — the same total draw as admitting this
+        # request with its current hits — so only consult the tier
+        # when the request would fit anyway (a restore must never push
+        # a request past the headroom check _admit runs next)
+        if req.pages_needed - have > self.admission_headroom():
+            return shared_pages
+        run = tier.match_run(keys)
+        if not run:
+            return shared_pages
+        from paddle_tpu.distributed import chaos
+        if chaos.ENABLED:
+            # a slow H2D restore (PCIe congestion, huge pages): the
+            # warm-TTFT lever for tiered-KV latency tests
+            chaos.maybe_delay("kvtier.restore.delay")
+        ents, pages = [], []
+        for key, entry in run:
+            if not self._tier_entry_compatible(entry):
+                tier.discard(key)
+                break
+            if not self._free and \
+                    not self._evict_prefix_entries(budget_only=False):
+                break
+            page = self._free.pop()
+            # ledger mirror of _prefix_insert-then-retire settling:
+            # cache ref only (ref 1), cached, reclaimable — restoring
+            # leaves admission headroom exactly where it was
+            self._ref_page(page)
+            cache.insert(key, page)
+            self._cached_pages.add(page)
+            self._reclaimable += 1
+            ents.append(entry)
+            pages.append(page)
+        if not ents:
+            return shared_pages
+        self._tier_upload(ents, pages)
+        tier.note_restored(len(pages), sum(e.nbytes for e in ents))
+        # restored entries joined the device cache hot; enforce its
+        # page budget against the coldest entries (which spill in turn)
+        self._evict_prefix_entries(budget_only=True)
+        return shared_pages + pages
+
+    # -- sessions (suspend/resume, module doc) ---------------------------
+    def _session_retain(self, slot):
+        """A finished turn with a session id keeps its KV: register
+        the slot's FULL committed pages — prompt AND generated tokens
+        — in the prefix cache under the chain over the committed token
+        stream, and stamp the session's activity clock. The next
+        turn's prompt replays those tokens verbatim, so its chain keys
+        match and prefill runs only the new text; the suspend sweep
+        spills the same keys to host RAM if the session idles."""
+        req = slot.req
+        cache = self.prefix_cache
+        if cache is None or req.session is None:
+            return
+        # slot.lens counts tokens whose KV the engine committed (the
+        # final emitted token's KV was never fed back)
+        committed = (list(map(int, req.prompt))
+                     + req.tokens)[:slot.lens]
+        keys = chain_keys(committed, self.page_size)
+        n = min(len(keys), len(slot.pages))
+        for j in range(n):
+            if cache.insert(keys[j], slot.pages[j]):
+                self._ref_page(slot.pages[j])
+                self._cached_pages.add(slot.pages[j])
+        rec = self._sessions.pop(req.session, None) \
+            or {"keys": [], "last": 0.0, "suspended": False}
+        rec["keys"] = keys[:n]
+        rec["last"] = time.monotonic()
+        rec["suspended"] = False
+        self._sessions[req.session] = rec
+        while len(self._sessions) > 4096:   # bound the registry: the
+            self._sessions.popitem(last=False)  # LRU session just
+        #                                         loses retention
+        self._evict_prefix_entries(budget_only=True)
+
+    def _session_touch(self, sid):
+        """Admission saw this session again: reset its idle clock and
+        count the resume if it was suspended (its pages just came back
+        through _tier_restore / the warm path)."""
+        rec = self._sessions.get(sid)
+        if rec is None:
+            return
+        rec["last"] = time.monotonic()
+        self._sessions.move_to_end(sid)
+        if rec["suspended"]:
+            rec["suspended"] = False
+            if self.host_tier is not None:
+                self.host_tier.note_resume()
+
+    def _suspend_sweep(self):
+        """Engine-driven on tick: spill a long-idle session's cached
+        pages to the host tier and free their HBM. Targeted eviction
+        (PrefixCache.pop) — the session's OWN keys name exactly the
+        pages it pins, LRU order is irrelevant. Sessions with a queued
+        next turn are skipped (the admission about to run would
+        restore them right back)."""
+        if not self._sessions:
+            return
+        now = time.monotonic()
+        with self._lock:
+            queued = {r.session for r in self._pending
+                      if r.session is not None}
+        freed = []
+        for sid, rec in self._sessions.items():
+            if rec["suspended"] or sid in queued \
+                    or now - rec["last"] < self.suspend_after_s:
+                continue
+            for k in rec["keys"]:
+                page = self.prefix_cache.pop(k)
+                if page is not None:
+                    self._note_evicted(page, freed, key=k)
+            rec["suspended"] = True
+            self.host_tier.note_suspend()
+        self._recycle_pages(freed)
 
     def _admission_order(self, pending):
         """The order pending requests are considered for admission:
@@ -1235,6 +1505,10 @@ class PagedKVEngine:
                         if s is None), None)
             shared_pages = (self._prefix_lookup(req)
                             if idx is not None else [])
+            if idx is not None and self.host_tier is not None:
+                # device miss / partial hit: extend the run from the
+                # host tier (H2D upload; headroom-neutral)
+                shared_pages = self._tier_restore(req, shared_pages)
             # refs BEFORE the headroom check: matched pages stop being
             # reclaimable, so the check below sees the post-hit budget
             for p in shared_pages:
@@ -1247,6 +1521,8 @@ class PagedKVEngine:
                 requeue.append(req)
                 continue
             self._note_prefix_outcome(req, h)
+            if req.session is not None:
+                self._session_touch(req.session)
             # only the uncached tail draws fresh pages from the pool
             self._reserved_unalloc += req.pages_needed - h
             admitted.append((idx, req))
@@ -1483,6 +1759,13 @@ class PagedKVEngine:
 
     def _retire(self, slot_idx, reason=None):
         slot = self._slots[slot_idx]
+        cancelled = slot.req.cancelled.is_set()
+        if reason is None and not cancelled \
+                and slot.req.session is not None:
+            # session retention BEFORE the refcounted release below:
+            # the cache refs it adds are what keep the conversation's
+            # pages alive through the slot's unref
+            self._session_retain(slot)
         # refcounted release: a page returns to the free list (and, for
         # int8 KV, has its quant scale rows zeroed — _recycle_pages)
         # only when its LAST referent lets go. Shared prefix pages stay
@@ -1498,7 +1781,6 @@ class PagedKVEngine:
         self._slots[slot_idx] = None
         with self._lock:
             self._inflight -= 1
-        cancelled = slot.req.cancelled.is_set()
         if not cancelled:
             self.stats["finished"] += 1      # cancelled counts separately
         if slot.req.obs is not None:
@@ -1566,6 +1848,8 @@ class PagedKVEngine:
             if slot is not None and slot.req.cancelled.is_set():
                 self.stats["cancelled"] += 1
                 self._retire(i)
+        if self.suspend_after_s is not None:
+            self._suspend_sweep()
         self._admit()
         live = [i for i, s in enumerate(self._slots) if s is not None]
         if not live:
@@ -1707,6 +1991,10 @@ class PagedKVEngine:
         t = self._ticker
         if t is not None:
             t.join(timeout=30)
+        if self.host_tier is not None:
+            # drain + join the spill worker (a later spill restarts it,
+            # so stop()/start() cycles keep working)
+            self.host_tier.stop()
 
     def _ticker_loop(self):
         import time
@@ -1745,7 +2033,7 @@ class PagedKVEngine:
     def stream(self, input_ids, max_new_tokens=32, *, eos_token_id=None,
                pad_token_id=0, do_sample=False, temperature=1.0,
                top_k=0, top_p=1.0, attention_mask=None, seed=None,
-               deadline=None, tenant=None, **_ignored):
+               deadline=None, tenant=None, session=None, **_ignored):
         """generate_stream-compatible surface for PredictorServer: each
         ROW of input_ids becomes an independent engine request (they
         join the continuous batch individually), and the yielded step
@@ -1787,7 +2075,7 @@ class PagedKVEngine:
                         r, max_new_tokens, eos_token_id=eos_token_id,
                         do_sample=do_sample, temperature=temperature,
                         top_k=top_k, top_p=top_p, deadline=deadline,
-                        tenant=tenant))
+                        tenant=tenant, session=session))
             except BaseException:
                 # partial multi-row admission must not leak: whatever a
                 # later row raised (shed, per-row validation), cancel

@@ -120,6 +120,13 @@ class PrefixCache:
     def over_budget(self):
         return max(0, len(self._entries) - self.page_budget)
 
+    def pop(self, key):
+        """Targeted eviction: remove `key` and return its page, or
+        None. The tiered-KV session sweep uses this — a suspended
+        session's OWN keys name exactly the pages it pins, so LRU
+        order is irrelevant there."""
+        return self._entries.pop(key, None)
+
     def pop_lru(self):
         """Evict the coldest entry; (key, page) or None when empty."""
         if not self._entries:

@@ -1219,6 +1219,24 @@ class ReplicaRouter:
             return None
         return round(h / (h + m), 4) if (h + m) else 0.0
 
+    @staticmethod
+    def _kvtier_hit_rate(stats):
+        """Per-replica host-tier hit rate from the newest probed
+        /stats body (the engine's `kvtier` block). Lets operators
+        split warm traffic into device-hit vs tier-hit vs cold; a
+        prefix PIN survives a spill — the pinned replica still "has"
+        the prefix, one H2D hop slower — so this is the number that
+        explains a pinned replica's warm-TTFT spread. None when the
+        replica doesn't report a tier."""
+        kt = stats.get("kvtier") if isinstance(stats, dict) else None
+        if not isinstance(kt, dict):
+            return None
+        try:
+            h, lk = int(kt.get("hits", 0)), int(kt.get("lookups", 0))
+        except (TypeError, ValueError):
+            return None
+        return round(h / lk, 4) if lk else 0.0
+
     def debug_replicas(self):
         """The GET /debug/replicas body (schema pinned in README): the
         router's live per-replica view + a summary."""
@@ -1245,6 +1263,8 @@ class ReplicaRouter:
                     "probation": r.probation,
                     "served": r.served,
                     "prefix_hit_rate": self._prefix_hit_rate(
+                        r.last_stats),
+                    "kvtier_hit_rate": self._kvtier_hit_rate(
                         r.last_stats),
                     "tenants": dict(r.tenants),
                 })

@@ -1002,6 +1002,13 @@ class PredictorServer:
             p = g.prefix_stats()
             if p is not None:
                 out["prefix"] = p
+        if g is not None and hasattr(g, "kvtier_stats"):
+            # the host-RAM KV tier's spill/restore/suspend counters
+            # (PagedKVEngine with host_tier_bytes>0): the router reads
+            # hits/lookups for its tier-hit-rate column
+            kt = g.kvtier_stats()
+            if kt is not None:
+                out["kvtier"] = kt
         if self.tenancy is not None:
             out["tenants"] = self.tenant_stats()
         return out
@@ -1117,6 +1124,12 @@ class PredictorServer:
                 # stream() takes no tenant kwarg, and a labeled
                 # request must not 500 on them
                 kw["tenant"] = tenant
+            if "session" in req \
+                    and getattr(g, "concurrent_safe", False):
+                # conversation identity rides to the engine's tiered-KV
+                # session retention / suspend-resume bookkeeping; gated
+                # like tenant — bundle predictors have no sessions
+                kw["session"] = req["session"]
             it = g.stream(ids, **kw)
         else:
             from paddle_tpu.models.generation import generate_stream

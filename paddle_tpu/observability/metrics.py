@@ -399,6 +399,31 @@ METRICS = {
                                    "prefix-cache entries evicted "
                                    "(LRU budget or on-demand when "
                                    "decode needed the page back)"),
+    "inference.kvtier.spilled_pages": ("counter",
+                                       "KV pages spilled to the "
+                                       "host-RAM tier at eviction "
+                                       "(D2H)"),
+    "inference.kvtier.restored_pages": ("counter",
+                                        "host-tier pages uploaded "
+                                        "back into device pools on a "
+                                        "restore hit (H2D)"),
+    "inference.kvtier.spill_bytes": ("counter",
+                                     "bytes moved device -> host by "
+                                     "spills (int8 pools move ~0.52x "
+                                     "the bf16 volume)"),
+    "inference.kvtier.restore_bytes": ("counter",
+                                       "bytes moved host -> device "
+                                       "by restore hits"),
+    "inference.kvtier.host_pages": ("gauge",
+                                    "KV pages currently resident in "
+                                    "the host-RAM tier"),
+    "inference.kvtier.suspends": ("counter",
+                                  "idle sessions suspended (KV "
+                                  "spilled to host, HBM pages "
+                                  "freed)"),
+    "inference.kvtier.resumes": ("counter",
+                                 "suspended sessions resumed on "
+                                 "their next turn"),
     "engine.ticks": ("gauge", "scheduler ticks run"),
     "engine.prefills": ("gauge", "prompts prefilled"),
     "engine.tokens_out": ("gauge", "tokens emitted"),

@@ -61,6 +61,7 @@ def render(doc) -> str:
             ("queue", "replica_queue_depth"),
             ("breaker", None), ("eject", "ejections"),
             ("served", "served"), ("pfx_hit", "prefix_hit_rate"),
+            ("tier_hit", "kvtier_hit_rate"),
             ("probe_age", "last_probe_age_s")]
     table = [[h for h, _k in cols]]
     for r in rows:
