@@ -505,6 +505,137 @@ def _train_breakdown(on_tpu):
     }
 
 
+def _overlap_ab():
+    """Decomposed-FSDP-collective A/B (ISSUE 19) on a dp x fsdp mesh:
+    two fresh same-seed models through the SAME Trainer config/batch —
+    one on XLA-propagated collectives, one with the chunked ppermute
+    rings (`overlap_fsdp`) — reporting tokens/s, MFU, the first-step
+    loss delta (parity evidence) and the overlap fraction + per-phase
+    comm seconds from `measure_phase_seconds`'s comm-attribution
+    twins. Requires >= 2 jax devices; `_overlap_bench` re-execs with
+    forced host devices on a single-device CPU rig."""
+    import time
+
+    import jax
+    import paddle_tpu
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import observability
+    from paddle_tpu.distributed import init_mesh
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, \
+        tiny_llama_config
+    from paddle_tpu.parallel import Trainer, TrainStepConfig, \
+        llama_sharding_plan
+
+    devs = jax.devices()
+    n = len(devs)
+    if n < 2:
+        raise RuntimeError(f"overlap A/B needs >= 2 devices (got {n})")
+    on_tpu = devs[0].platform == "tpu"
+    fsdp = 4 if n % 4 == 0 else 2
+    dp = max(1, n // fsdp)
+    mesh = init_mesh({"dp": dp, "fsdp": fsdp})
+    if on_tpu:
+        make_cfg = lambda: LlamaConfig(  # noqa: E731
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=4, num_attention_heads=16,
+            num_key_value_heads=4, max_position_embeddings=1024,
+            rope_theta=10000.0, seq_length=1024)
+        batch_b, seq, steps, chunks = 4 * dp * fsdp, 1024, 6, 4
+        compute_dtype = "bfloat16"
+    else:
+        make_cfg = lambda: tiny_llama_config(  # noqa: E731
+            vocab_size=512, num_hidden_layers=2, hidden_size=256,
+            intermediate_size=512, num_attention_heads=4,
+            num_key_value_heads=2, seq_length=64)
+        batch_b, seq, steps, chunks = dp * fsdp, 64, 8, 2
+        compute_dtype = None
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, int(make_cfg().vocab_size),
+                      (batch_b, seq)).astype(np.int32)
+    rows_out = []
+    frac = comm = None
+    for label, overlap in (("propagated", False), ("overlapped", True)):
+        paddle_tpu.seed(0)
+        cfg = make_cfg()
+        model = LlamaForCausalLM(cfg)
+        optimizer = opt.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters(),
+                              weight_decay=0.01)
+        trainer = Trainer(
+            model, optimizer, mesh=mesh,
+            plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
+            config=TrainStepConfig(compute_dtype=compute_dtype,
+                                   overlap_fsdp=overlap,
+                                   overlap_chunks=chunks))
+        batch = {"input_ids": ids, "labels": ids}
+        loss_step1 = float(trainer.step(batch))   # warm + compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss_t = trainer.step(batch)
+        loss = float(loss_t)
+        dt = time.perf_counter() - t0
+        toks = batch_b * seq * steps / dt
+        n_params = sum(int(np.prod(v.shape))
+                       for v in trainer.params.values())
+        mfu = (6.0 * n_params * toks / (_peak_flops(devs[0]) * n)
+               if on_tpu else 0.0)
+        row = {"path": label,
+               "tokens_per_sec": round(toks, 2),
+               "mfu": round(mfu, 4),
+               "loss_step1": round(loss_step1, 6),
+               "loss": round(loss, 6)}
+        if overlap:
+            with observability.scoped(reset=True) as reg:
+                phases = trainer.measure_phase_seconds(batch, iters=2)
+            frac = phases.get("overlap_fraction")
+            comm = {"fwd": round(phases.get("fwd_comm", 0.0), 6),
+                    "bwd": round(phases.get("bwd_comm", 0.0), 6)}
+            row["overlap_fraction"] = (round(frac, 4)
+                                       if frac is not None else None)
+            row["comm_seconds"] = comm
+        rows_out.append(row)
+    p, o = rows_out
+    return {
+        "mesh": {"dp": dp, "fsdp": fsdp},
+        "batch": batch_b, "seq": seq, "steps": steps, "chunks": chunks,
+        "rows": rows_out,
+        "overlapped_vs_propagated_tokens_per_sec": round(
+            o["tokens_per_sec"] / max(p["tokens_per_sec"], 1e-9), 4),
+        "overlap_fraction": (round(frac, 4)
+                             if frac is not None else None),
+        "loss_step1_delta": round(abs(o["loss_step1"]
+                                      - p["loss_step1"]), 8),
+    }
+
+
+def _overlap_bench(on_tpu):
+    """`extra.overlap` entry: run `_overlap_ab` inline when this
+    process already sees >= 2 devices (TPU, or a forced-device CPU
+    run); on the default single-device CPU rig, re-exec bench.py with
+    8 forced host devices (the backend's device count is frozen at
+    first use, so the A/B mesh needs a fresh process) and parse its
+    one JSON line."""
+    import jax
+    if len(jax.devices()) >= 2:
+        return _overlap_ab()
+    if on_tpu:
+        raise RuntimeError("single-device TPU: no fsdp axis to A/B")
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--overlap-ab"],
+        capture_output=True, text=True, timeout=900, env=env)
+    if out.returncode != 0:
+        raise RuntimeError("overlap A/B subprocess failed: "
+                           + out.stderr.strip()[-300:])
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def _fleet_bench(trainer, batch, steps):
     """Heartbeat-publisher overhead (ISSUE 9): the SAME compiled step
     run with observability on, first without the fleet plane, then
@@ -1061,6 +1192,12 @@ def main():
     except Exception as e:           # noqa: BLE001 — never sink the
         train_breakdown = {"error": f"{type(e).__name__}: {e}"}
 
+    # decomposed-FSDP-collective overlap A/B (ISSUE 19)
+    try:
+        overlap = _overlap_bench(on_tpu)
+    except Exception as e:           # noqa: BLE001 — never sink the
+        overlap = {"error": f"{type(e).__name__}: {e}"}
+
     # fleet-autopilot control-loop latency (ISSUE 16)
     try:
         autopilot = _autopilot_bench()
@@ -1087,9 +1224,16 @@ def main():
                   "prefix": prefix, "kvtier": kvtier,
                   "tenant": tenant,
                   "train_breakdown": train_breakdown,
+                  "overlap": overlap,
                   "autopilot": autopilot, "sentry": sentry},
     }))
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    if "--overlap-ab" in sys.argv:
+        # child mode for _overlap_bench's forced-device re-exec: ONE
+        # JSON line on stdout, nothing else
+        print(json.dumps(_overlap_ab()))
+    else:
+        main()

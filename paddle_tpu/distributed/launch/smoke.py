@@ -28,6 +28,8 @@ Env contract (set by the harness/launcher):
   SMOKE_STORE_PORT — port for the cross-process TCPStore exercise
   SMOKE_STEPS    — training steps (default 4)
   SMOKE_MESH     — "dp,mp" global mesh shape (default "2,4")
+  SMOKE_OVERLAP  — >0: decomposed-FSDP-collective rings with this many
+                   sub-chunks (TrainStepConfig.overlap_fsdp)
 """
 from __future__ import annotations
 
@@ -111,9 +113,17 @@ def main():
                 compute_dtype=None,
                 num_microbatches=int(os.environ.get("SMOKE_MICRO", "4"))))
     else:
+        # SMOKE_OVERLAP=<chunks>: route the FSDP projections through
+        # the decomposed ppermute rings (parallel/overlap.py) — the
+        # harness pins this run's losses to the propagated-collective
+        # reference (rtol 1e-5) with the fsdp axis spanning the
+        # process boundary
+        ov = int(os.environ.get("SMOKE_OVERLAP", "0"))
         tr = Trainer(model, optimizer, mesh=mesh,
                      plan=llama_sharding_plan(mesh.jax_mesh.axis_names),
-                     config=TrainStepConfig(compute_dtype=None))
+                     config=TrainStepConfig(compute_dtype=None,
+                                            overlap_fsdp=ov > 0,
+                                            overlap_chunks=max(ov, 1)))
 
     steps = int(os.environ.get("SMOKE_STEPS", "4"))
     losses = []
@@ -136,7 +146,9 @@ def main():
                        "devices_global": n_global,
                        "devices_local": n_local,
                        "mesh": list(axes.items()),
-                       "trainer": kind}, f)
+                       "trainer": kind,
+                       "overlap": int(os.environ.get("SMOKE_OVERLAP",
+                                                     "0"))}, f)
     multihost_utils.sync_global_devices("smoke:done")
     print(f"SMOKE_OK rank={rank} losses={losses}", flush=True)
     # this environment's XLA teardown aborts ("terminate called without

@@ -87,6 +87,17 @@ class LlamaConfig:
     # route RoPE through the fused apply (mul/lane-roll/mul/add, no
     # slice/concat transpose chain; inverse-rotation backward)
     fused_rope: bool = False
+    # -- decomposed FSDP collectives (ISSUE 19; parallel/overlap.py) --
+    # overlap_fsdp: route the FSDP-critical projections (q/k/v/o,
+    # gate/up/down and their fused variants) through the chunked
+    # ppermute rings so the weight all-gather streams under the matmul
+    # instead of ahead of it. overlap_chunks: sub-chunks per resident
+    # shard (finer pipelining); 0 disables the rewrite even when
+    # overlap_fsdp is set — both knobs off = byte-identical jaxpr to
+    # the propagated path. The trainer's overlap_fsdp_guard activates
+    # the same rewrite without touching the model config.
+    overlap_fsdp: bool = False
+    overlap_chunks: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -143,6 +154,33 @@ def tiny_llama_config(**overrides) -> LlamaConfig:
     return LlamaConfig(**base)
 
 
+def _maybe_overlap_linear(layer, x, name, cfg):
+    """Route one FSDP-critical projection through the decomposed
+    ppermute ring (parallel/overlap.py) when the model config or the
+    trainer's overlap_fsdp_guard asks for it. Every other case (guard
+    off + knobs off, chunks < 1, no mesh, mesh without the axis, plan
+    leaves the param off 'fsdp') falls back to the plain Linear call —
+    the disabled path traces a byte-identical jaxpr."""
+    from paddle_tpu.parallel import overlap as _ov
+    ov = _ov.current_overlap()
+    if ov is None and not (cfg.overlap_fsdp and cfg.overlap_chunks > 0):
+        return layer(x)
+    axis = ov["axis"] if ov else "fsdp"
+    chunks = ov["chunks"] if ov else cfg.overlap_chunks
+    if chunks < 1:
+        return layer(x)
+    mesh = _ov.resolve_overlap_mesh(ov["mesh"] if ov else None)
+    if mesh is None or axis not in mesh.axis_names:
+        return layer(x)
+    from paddle_tpu.parallel.plan import fsdp_partition, llama_sharding_plan
+    sd = fsdp_partition(llama_sharding_plan(mesh.axis_names),
+                        name + ".weight", axis)
+    if sd is None:
+        return layer(x)
+    return _ov.overlap_linear(x, layer.weight, axis=axis, chunks=chunks,
+                              shard_dim=sd)
+
+
 class LlamaAttention(nn.Layer):
     """GQA attention with RoPE (PaddleNLP LlamaAttention equivalent;
     reference fused path: incubate fused_rope + flash_attention kernels
@@ -172,13 +210,17 @@ class LlamaAttention(nn.Layer):
         b, s = hidden_states.shape[0], hidden_states.shape[1]
         if cfg.fuse_attention_qkv:
             kv_out = cfg.num_key_value_heads * cfg.head_dim
-            qkv = self.qkv_proj(hidden_states)
+            qkv = _maybe_overlap_linear(self.qkv_proj, hidden_states,
+                                        "qkv_proj", cfg)
             q, k, v = T.split(qkv, [cfg.hidden_size, kv_out, kv_out],
                               axis=-1)
         else:
-            q = self.q_proj(hidden_states)
-            k = self.k_proj(hidden_states)
-            v = self.v_proj(hidden_states)
+            q = _maybe_overlap_linear(self.q_proj, hidden_states,
+                                      "q_proj", cfg)
+            k = _maybe_overlap_linear(self.k_proj, hidden_states,
+                                      "k_proj", cfg)
+            v = _maybe_overlap_linear(self.v_proj, hidden_states,
+                                      "v_proj", cfg)
         q = T.reshape(q, [b, s, cfg.num_attention_heads, cfg.head_dim])
         k = T.reshape(k, [b, s, cfg.num_key_value_heads, cfg.head_dim])
         v = T.reshape(v, [b, s, cfg.num_key_value_heads, cfg.head_dim])
@@ -241,7 +283,7 @@ class LlamaAttention(nn.Layer):
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None)
         out = T.reshape(out, [b, s, cfg.hidden_size])
-        return self.o_proj(out)
+        return _maybe_overlap_linear(self.o_proj, out, "o_proj", cfg)
 
 
 class LlamaMLP(nn.Layer):
@@ -249,6 +291,7 @@ class LlamaMLP(nn.Layer):
 
     def __init__(self, config: LlamaConfig):
         super().__init__()
+        self.config = config
         d, f = config.hidden_size, config.intermediate_size
         init = nn.initializer.Normal(0.0, config.initializer_range)
         attr = paddle_tpu.nn.ParamAttr(initializer=init)
@@ -264,11 +307,17 @@ class LlamaMLP(nn.Layer):
         self.down_proj = nn.Linear(f, d, weight_attr=attr, bias_attr=False)
 
     def forward(self, x):
+        cfg = self.config
         if self.fuse_ffn:
             # swiglu(x) splits the fused gate-up output in half (phi
             # SwiGLU kernel semantics)
-            return self.down_proj(swiglu(self.gate_up_fused_proj(x)))
-        return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+            h = swiglu(_maybe_overlap_linear(
+                self.gate_up_fused_proj, x, "gate_up_fused_proj", cfg))
+        else:
+            h = swiglu(
+                _maybe_overlap_linear(self.gate_proj, x, "gate_proj", cfg),
+                _maybe_overlap_linear(self.up_proj, x, "up_proj", cfg))
+        return _maybe_overlap_linear(self.down_proj, h, "down_proj", cfg)
 
 
 class LlamaDecoderLayer(nn.Layer):

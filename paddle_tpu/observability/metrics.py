@@ -155,6 +155,21 @@ METRICS = {
                                       "blockwise-CE loss path avoids "
                                       "materializing per step (0 / "
                                       "absent on the dense path)"),
+    "train.overlap.comm.seconds": ("histogram",
+                                   "weight-movement collective seconds "
+                                   "per phase (label: phase = fwd | "
+                                   "bwd): propagated-twin minus "
+                                   "nocomm-twin wall time from "
+                                   "measure_phase_seconds — the "
+                                   "overlap-fraction denominator",
+                                   DEFAULT_BUCKETS_S),
+    "train.overlap.fraction": ("gauge",
+                               "share of FSDP weight-movement comm "
+                               "hidden under compute by the decomposed "
+                               "ppermute rings (parallel/overlap.py), "
+                               "from the train.overlap.phase trace "
+                               "spans: (propagated − overlapped) / "
+                               "(propagated − nocomm) over fwd+bwd"),
     # -- input pipeline -----------------------------------------------
     "io.prefetch.queue_depth": ("gauge",
                                 "batches already on device, waiting "

@@ -10,6 +10,10 @@ sharding plan, and a single jitted train step whose collectives XLA derives
 and schedules over ICI.
 """
 from paddle_tpu.parallel.plan import (  # noqa: F401
-    ShardingPlan, llama_sharding_plan, apply_plan,
+    ShardingPlan, llama_sharding_plan, apply_plan, fsdp_partition,
+)
+from paddle_tpu.parallel.overlap import (  # noqa: F401
+    overlap_all_gather_matmul, overlap_matmul_reduce_scatter,
+    overlap_fsdp_guard, current_overlap, overlap_fraction_from_spans,
 )
 from paddle_tpu.parallel.trainer import Trainer, TrainStepConfig  # noqa: F401

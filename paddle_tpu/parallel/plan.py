@@ -79,6 +79,21 @@ def llama_sharding_plan(mesh_axes: Sequence[str]) -> ShardingPlan:
     ], default=P())
 
 
+def fsdp_partition(plan: ShardingPlan, name: str,
+                   axis: str = "fsdp") -> int | None:
+    """Which dim of param `name` the plan shards over `axis` — the
+    shard_dim the decomposed-collective ring (parallel/overlap.py)
+    needs: 0 = contracting dim sharded (column-parallel), 1 = output
+    dim (row-parallel). None when the plan leaves the param off `axis`
+    (replicated or non-matmul), which disables the ring for it."""
+    spec = plan.spec_for(name)
+    for dim, entry in enumerate(spec):
+        entries = entry if isinstance(entry, tuple) else (entry,)
+        if axis in entries:
+            return dim
+    return None
+
+
 def batch_spec(mesh_axes: Sequence[str], seq_sharded: bool = True) -> P:
     """Input batch (B, S): batch over dp+fsdp, seq over sp."""
     batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh_axes)

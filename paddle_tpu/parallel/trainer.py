@@ -17,6 +17,7 @@ loss scaling because bf16 has fp32's exponent range.
 """
 from __future__ import annotations
 
+import contextlib
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -78,6 +79,15 @@ class TrainStepConfig:
     # rides the step's existing outputs: no extra host sync is added
     # here; reading it is the sentry's decision.
     health_probe: bool = False
+    # decomposed FSDP collectives (ISSUE 19; parallel/overlap.py): the
+    # loss closure runs under overlap_fsdp_guard so the model's
+    # FSDP-critical projections stream their weight all-gather around a
+    # chunked ppermute ring UNDER the matmul instead of ahead of it.
+    # overlap_chunks = sub-chunks per resident shard (finer
+    # pipelining). No-op when the mesh lacks an 'fsdp' axis; off by
+    # default so the hot path stays byte-identical.
+    overlap_fsdp: bool = False
+    overlap_chunks: int = 1
 
 
 class NonFiniteGradError(RuntimeError):
@@ -92,6 +102,20 @@ def _cast_tree(tree, dtype):
     return jax.tree.map(
         lambda a: a.astype(dt)
         if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+
+def _strip_axis(spec: P, axis: str) -> P:
+    """`spec` with `axis` removed from every entry (tuple entries
+    keep their other axes) — the nocomm phase-timing twin replicates
+    params over 'fsdp' with this."""
+    out = []
+    for entry in spec:
+        if isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a != axis)
+            out.append(kept if kept else None)
+        else:
+            out.append(None if entry == axis else entry)
+    return P(*out)
 
 
 def _memories_supported() -> bool:
@@ -271,13 +295,20 @@ class Trainer:
         arr = loss._value if isinstance(loss, Tensor) else loss
         return arr.astype(jnp.float32)
 
-    def _make_loss_for(self):
+    def _make_loss_for(self, overlap: bool | None = None):
         """The step's loss closure (cast + batch sharding constraint +
-        context-parallel guard) — shared by `_build_step` and the
-        phase-attributed timing twins in `measure_phase_seconds`, so
-        phase timings measure the SAME program the fused step runs."""
+        context-parallel / FSDP-overlap guards) — shared by
+        `_build_step` and the phase-attributed timing twins in
+        `measure_phase_seconds`, so phase timings measure the SAME
+        program the fused step runs. `overlap` overrides
+        cfg.overlap_fsdp (the timing twins force it off to measure the
+        propagated baseline against the same weights)."""
         cfg = self.config
         mesh = self.mesh
+        if overlap is None:
+            overlap = cfg.overlap_fsdp
+        overlap = bool(overlap and mesh is not None
+                       and "fsdp" in mesh.axis_names)
 
         def loss_for(params, batch):
             params_c = _cast_tree(params, cfg.compute_dtype)
@@ -289,13 +320,19 @@ class Trainer:
                             list(bspec) + [None] * (v.ndim - 2))[:v.ndim])))
                     if v.ndim >= 1 else v
                     for k, v in batch.items()}
-            if cfg.context_parallel and mesh is not None:
-                from paddle_tpu.distributed.context_parallel import (
-                    context_parallel_guard)
-                with context_parallel_guard(mesh, axis="sp",
-                                            mode=cfg.context_parallel):
-                    return self._loss_from_batch(params_c, batch)
-            return self._loss_from_batch(params_c, batch)
+            with contextlib.ExitStack() as stack:
+                if cfg.context_parallel and mesh is not None:
+                    from paddle_tpu.distributed.context_parallel import (
+                        context_parallel_guard)
+                    stack.enter_context(context_parallel_guard(
+                        mesh, axis="sp", mode=cfg.context_parallel))
+                if overlap:
+                    from paddle_tpu.parallel.overlap import (
+                        overlap_fsdp_guard)
+                    stack.enter_context(overlap_fsdp_guard(
+                        mesh, axis="fsdp",
+                        chunks=max(1, cfg.overlap_chunks)))
+                return self._loss_from_batch(params_c, batch)
 
         return loss_for
 
@@ -786,35 +823,11 @@ class Trainer:
         with self._mesh_ctx():
             return self._step_fn.lower(*args)
 
-    def measure_phase_seconds(self, batch: dict, iters: int = 2):
-        """Phase-attributed step timing: where does the step's wall
-        time go? Compiles forward-only and forward+backward twins of
-        the step's OWN loss machinery (`_make_loss_for` — same cast,
-        batch constraint and precision context the fused step traces)
-        and attributes
-
-            fwd       = t(loss)
-            bwd       = t(value_and_grad) - t(loss)
-            optimizer = t(full step)      - t(value_and_grad)
-
-        Each timing is a mean over `iters` synced runs after a compile
-        warmup. Records `train.phase.seconds{phase=...}` when
-        observability is enabled and always returns
-        {"fwd", "bwd", "optimizer", "step"} seconds. NOTE: the
-        full-step timing drives `iters + 1` REAL optimizer steps (the
-        donated program is the thing being measured) — call this from
-        a bench/diagnostic context, not mid-training-run.
-        """
-        import time as _time
-        batch = {k: (v._value if isinstance(v, Tensor)
-                     else v if isinstance(v, (np.ndarray, jax.Array))
-                     else jnp.asarray(v))
-                 for k, v in batch.items()}
-        if self.mesh is not None:
-            batch = {k: jax.device_put(
-                v, self._batch_sharding(k, v.ndim))
-                for k, v in batch.items()}
-        loss_for = self._make_loss_for()
+    def _phase_twins(self, loss_for):
+        """Forward-only and forward+backward twins of one loss closure
+        — they mirror _step_inner EXACTLY, including the grad-accum
+        microbatch scan, which is a different program (different peak
+        memory / runtime) than one full-batch pass."""
         train_names = set(self.param_names)
         n_mb = self.config.grad_accum_steps
 
@@ -823,9 +836,6 @@ class Trainer:
                                  + v.shape[1:])
                     for k, v in b.items()}
 
-        # the twins mirror _step_inner EXACTLY — including the
-        # grad-accum microbatch scan, which is a different program
-        # (different peak memory / runtime) than one full-batch pass
         def fwd_fn(params, b):
             if n_mb > 1:
                 def micro(acc, mb):
@@ -853,6 +863,52 @@ class Trainer:
                     _split_mb(b))
                 return ls / n_mb, gs
             return gfn(tp, b)
+
+        return fwd_fn, fwdbwd_fn
+
+    def measure_phase_seconds(self, batch: dict, iters: int = 2):
+        """Phase-attributed step timing: where does the step's wall
+        time go? Compiles forward-only and forward+backward twins of
+        the step's OWN loss machinery (`_make_loss_for` — same cast,
+        batch constraint and precision context the fused step traces)
+        and attributes
+
+            fwd       = t(loss)
+            bwd       = t(value_and_grad) - t(loss)
+            optimizer = t(full step)      - t(value_and_grad)
+
+        Each timing is a mean over `iters` synced runs after a compile
+        warmup. Records `train.phase.seconds{phase=...}` when
+        observability is enabled and always returns
+        {"fwd", "bwd", "optimizer", "step"} seconds. NOTE: the
+        full-step timing drives `iters + 1` REAL optimizer steps (the
+        donated program is the thing being measured) — call this from
+        a bench/diagnostic context, not mid-training-run.
+
+        With overlap_fsdp active the twins gain a comm-attribution
+        column: two extra twin pairs run — `propagated` (overlap
+        forced off, XLA-propagated collectives) and `nocomm` (same
+        program with the params REPLICATED over 'fsdp', so no weight
+        all-gather exists) — and the result grows
+        {"fwd_comm", "bwd_comm"} (collective seconds per phase:
+        propagated − nocomm, the overlap-fraction denominator) and
+        {"overlap_fraction"} (comm hidden under compute / total comm,
+        via the `train.overlap.phase` trace spans all six timings are
+        recorded to). The nocomm twin still carries the grad
+        reduce over the batch axes in bwd, so the column attributes
+        WEIGHT-movement comm, not every collective.
+        """
+        import time as _time
+        batch = {k: (v._value if isinstance(v, Tensor)
+                     else v if isinstance(v, (np.ndarray, jax.Array))
+                     else jnp.asarray(v))
+                 for k, v in batch.items()}
+        if self.mesh is not None:
+            batch = {k: jax.device_put(
+                v, self._batch_sharding(k, v.ndim))
+                for k, v in batch.items()}
+        loss_for = self._make_loss_for()
+        fwd_fn, fwdbwd_fn = self._phase_twins(loss_for)
 
         def _timed(run):
             # the warmup must DRAIN, not just dispatch: jit returns
@@ -884,6 +940,56 @@ class Trainer:
             "optimizer": max(0.0, t_step - t_fwdbwd),
             "step": t_step,
         }
+        overlap_on = (self.config.overlap_fsdp and self.mesh is not None
+                      and "fsdp" in self.mesh.axis_names)
+        if overlap_on:
+            from paddle_tpu.observability import trace
+            from paddle_tpu.parallel.overlap import (
+                overlap_fraction_from_spans)
+            # comm-attribution twins: `propagated` = same weights, ring
+            # forced off (XLA-propagated collectives); `nocomm` = same
+            # PROGRAM with params replicated over 'fsdp' (no weight
+            # all-gather exists at all). propagated − nocomm isolates
+            # weight-movement comm per phase; propagated − overlapped
+            # is how much of it the ring hid.
+            pf, pg = self._phase_twins(self._make_loss_for(overlap=False))
+            nc_params = {
+                n: jax.device_put(v, NamedSharding(
+                    self.mesh, _strip_axis(self._spec(n), "fsdp")))
+                for n, v in self.params.items()}
+            with self._mesh_ctx():
+                with self._precision_ctx():
+                    jpf, jpg = jax.jit(pf), jax.jit(pg)
+                    t_p_fwd = _timed(lambda: jpf(self.params, batch))
+                    t_p_fb = _timed(lambda: jpg(self.params, batch))
+                    # same jitted twins: new shardings = new cache entry
+                    t_n_fwd = _timed(lambda: jpf(nc_params, batch))
+                    t_n_fb = _timed(lambda: jpg(nc_params, batch))
+            wall = _time.time()
+            for variant, f, fb in (
+                    ("overlapped", t_fwd, t_fwdbwd),
+                    ("propagated", t_p_fwd, t_p_fb),
+                    ("nocomm", t_n_fwd, t_n_fb)):
+                trace.record_span("train.overlap.phase", wall, f * 1e6,
+                                  attrs={"variant": variant,
+                                         "phase": "fwd"})
+                trace.record_span("train.overlap.phase", wall,
+                                  max(0.0, fb - f) * 1e6,
+                                  attrs={"variant": variant,
+                                         "phase": "bwd"})
+            frac = overlap_fraction_from_spans()
+            phases["fwd_comm"] = max(0.0, t_p_fwd - t_n_fwd)
+            phases["bwd_comm"] = max(
+                0.0, (t_p_fb - t_p_fwd) - (t_n_fb - t_n_fwd))
+            phases["overlap_fraction"] = frac
+            if observability.ENABLED:
+                observability.observe("train.overlap.comm.seconds",
+                                      phases["fwd_comm"], phase="fwd")
+                observability.observe("train.overlap.comm.seconds",
+                                      phases["bwd_comm"], phase="bwd")
+                if frac is not None:
+                    observability.set_gauge("train.overlap.fraction",
+                                            frac)
         if observability.ENABLED:
             observability.observe("train.phase.seconds", phases["fwd"],
                                   phase="fwd")

@@ -416,3 +416,25 @@ def test_pp_axis_spans_process_boundary(tmp_path):
     assert res["trainer"] == "pipeline"
     want = _reference_losses({"pp": 2, "dp": 4}, kind="pipeline")
     np.testing.assert_allclose(res["losses"], want, rtol=1e-5)
+
+
+def test_fsdp_overlap_spans_process_boundary(tmp_path):
+    """Decomposed-FSDP-collective overlap (ISSUE 19) with fsdp as the
+    SLOW mesh axis: every ring hop (weight ppermute fwd, accumulator
+    hop in the grad reduce-scatter) is a cross-process collective. The
+    losses must match the PROPAGATED-collective single-process run to
+    rtol 1e-5 — the rings change the collective schedule, not the
+    math."""
+    try:
+        res = _launch_two(tmp_path, {"SMOKE_MESH": "fsdp:2,dp:4",
+                                     "SMOKE_OVERLAP": "2"})
+    except AssertionError as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            # this CPU backend can't run ANY cross-process jax job
+            # (the whole module fails on it); the overlap-specific
+            # parity is still covered single-process in test_overlap
+            pytest.skip("jax CPU backend lacks multiprocess execution")
+        raise
+    assert res["overlap"] == 2
+    want = _reference_losses({"fsdp": 2, "dp": 4})   # overlap OFF
+    np.testing.assert_allclose(res["losses"], want, rtol=1e-5)
