@@ -106,6 +106,14 @@ POINTS = {
                             "admission (PCIe congestion / huge pages "
                             "— stretches warm TTFT, the tiered-KV "
                             "latency lever)",
+    "disagg.transfer.fail": "fail the prefill->decode KV page handoff "
+                            "at the router (the decode hop is skipped "
+                            "and the request degrades to LOCAL decode "
+                            "on the warm prefill replica — slower, "
+                            "never wrong)",
+    "disagg.transfer.delay": "slow the prefill->decode page handoff "
+                             "(NIC/PCIe congestion between pools — "
+                             "the disaggregated-TTFT lever)",
     "tenant.storm": "stamp an UNLABELED serving/router request with "
                     "the synthetic storm tenant id (inference/"
                     "tenancy.resolve_tenant) — rate 1.0 turns all "

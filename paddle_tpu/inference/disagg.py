@@ -1,0 +1,338 @@
+"""Disaggregated prefill/decode: chain-key-addressed KV page handoff
+(ISSUE 20).
+
+DistServe/Splitwise split the replica fleet into a compute-bound
+prefill pool and a latency-bound decode pool so the two phases stop
+contending for the same chips. The missing piece is moving a request's
+KV pages between pools. Every prerequisite already exists in this
+tree: the prefix hash chain (prefix.chain_keys) names each full prompt
+page by a process-stable key, the host tier (kvtier.HostKVTier) has
+the D2H capture and batched H2D scatter machinery, and int8 KV halves
+the bytes. This module adds the three pieces that glue them into a
+handoff protocol:
+
+- **Bundle wire format** (`pack_bundle` / `unpack_bundle`): a
+  self-describing binary envelope for a run of host-captured pages —
+  JSON header (per-array dtype/shape, draft nullable) + concatenated
+  raw array bytes. No pickle: the peer is a network service.
+  `unpack_bundle` hands back entries duck-typed like kvtier's
+  `_HostEntry` (`.layers` / `.draft` / `.nbytes`), so the decode
+  engine reinserts them through the SAME `_tier_restore`-shaped
+  ledger path (headroom-neutral, refcounts intact).
+- **`DisaggStats`**: the engine-side counters + `inference.disagg.*`
+  metric call sites, one leaf lock, `snapshot()` feeding the /stats
+  `disagg` block (which is also how the router's prober learns each
+  replica's role).
+- **`HandoffArbiter`**: tenancy-weighted fair ordering of concurrent
+  handoff transfers. Under saturation the order page bundles move is
+  a scheduling decision like any other; virtual-finish-time WFQ with
+  weights from `TenantPolicy.weight` keeps a storming tenant from
+  monopolizing the transfer path (same discipline as
+  tenancy.WeightedFairScheduler).
+
+The flow (router + serving wire it up): hop 1 runs admission+prefill
+on a prefill replica with `X-Disagg-Phase: prefill` (clamped to one
+token); the engine's prefill epilogue captures the committed pages to
+its host tier. Hop 2 carries the chain keys as an internal header to
+a decode replica, which pulls ONLY the keys its own prefix cache and
+host tier are missing via `POST /kv/pull` (chain-key dedup — a warm
+decode replica transfers nothing), stages them, and decodes. Any
+failure along the way degrades to local decode on whichever replica
+is warm: slower, never wrong.
+"""
+from __future__ import annotations
+
+import json
+import struct
+import threading
+
+import numpy as np
+
+from paddle_tpu import observability
+
+__all__ = ["PageBundleEntry", "pack_bundle", "unpack_bundle",
+           "DisaggStats", "HandoffArbiter"]
+
+_MAGIC = b"PTKV1\n"
+
+
+class PageBundleEntry:
+    """One page travelling between replicas: same shape as kvtier's
+    `_HostEntry` (per-layer tuples of host arrays in pool-group order,
+    draft mirror nullable) plus the chain key that names it."""
+
+    __slots__ = ("key", "layers", "draft", "nbytes")
+
+    def __init__(self, key, layers, draft=None):
+        self.key = key
+        self.layers = layers
+        self.draft = draft
+        n = sum(a.nbytes for grp in layers for a in grp)
+        if draft is not None:
+            n += sum(a.nbytes for grp in draft for a in grp)
+        self.nbytes = n
+
+
+def _np_dtype(name):
+    """Resolve a dtype string, including the ml_dtypes extension types
+    (bfloat16 et al.) numpy can't name on its own."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _group_meta(groups):
+    return [[{"dtype": str(a.dtype), "shape": list(a.shape)}
+             for a in grp] for grp in groups]
+
+
+def pack_bundle(entries):
+    """Serialize entries (anything exposing `.key`/`.layers`/`.draft`)
+    into one transferable blob. int8 pools ship their f32 scale rows
+    as just more arrays in the group — the header records dtype/shape
+    per array, so the wire format never needs to know about
+    quantization."""
+    meta = []
+    blobs = []
+    for ent in entries:
+        draft = ent.draft    # read once: the host tier may strip a
+        #                      draft mirror concurrently (budget
+        #                      pressure); either snapshot is valid
+        meta.append({"key": ent.key,
+                     "layers": _group_meta(ent.layers),
+                     "draft": None if draft is None
+                     else _group_meta(draft)})
+        for grp in ent.layers:
+            blobs.extend(np.ascontiguousarray(a).tobytes() for a in grp)
+        if draft is not None:
+            for grp in draft:
+                blobs.extend(np.ascontiguousarray(a).tobytes()
+                             for a in grp)
+    header = json.dumps({"entries": meta}).encode()
+    return b"".join([_MAGIC, struct.pack("<I", len(header)), header]
+                    + blobs)
+
+
+def _read_groups(meta, raw, off):
+    groups = []
+    for grp_meta in meta:
+        grp = []
+        for m in grp_meta:
+            dt = _np_dtype(m["dtype"])
+            shape = tuple(int(s) for s in m["shape"])
+            n = dt.itemsize
+            for s in shape:
+                n *= s
+            if off + n > len(raw):
+                raise ValueError("disagg bundle truncated")
+            grp.append(np.frombuffer(raw, dtype=dt, count=n // dt.itemsize,
+                                     offset=off).reshape(shape))
+            off += n
+        groups.append(tuple(grp))
+    return groups, off
+
+
+def unpack_bundle(raw):
+    """Parse a `pack_bundle` blob back into `PageBundleEntry` objects.
+    Arrays are read-only views over `raw` (the import path's batched
+    H2D scatter copies anyway); a malformed blob raises ValueError."""
+    if not raw.startswith(_MAGIC):
+        raise ValueError("not a disagg page bundle (bad magic)")
+    off = len(_MAGIC)
+    if off + 4 > len(raw):
+        raise ValueError("disagg bundle truncated")
+    (hlen,) = struct.unpack_from("<I", raw, off)
+    off += 4
+    header = json.loads(raw[off:off + hlen].decode())
+    off += hlen
+    out = []
+    for m in header.get("entries", []):
+        layers, off = _read_groups(m["layers"], raw, off)
+        draft = None
+        if m.get("draft") is not None:
+            draft, off = _read_groups(m["draft"], raw, off)
+        out.append(PageBundleEntry(str(m["key"]), layers, draft))
+    return out
+
+
+class DisaggStats:
+    """Counters for one engine's view of the handoff protocol (one
+    leaf lock, never held while calling anything). `snapshot()` is the
+    /stats `disagg` block; it always carries `role`, which is how the
+    router's prober discovers pool membership without configuration."""
+
+    def __init__(self, role="both"):
+        self.role = role
+        self._lock = threading.Lock()
+        self.handoff_pages = 0      # pages served to peers via /kv/pull
+        self.handoff_bytes = 0      # packed bundle bytes served
+        self.pulled_pages = 0       # pages fetched from a peer
+        self.pulled_bytes = 0
+        self.imported_pages = 0     # peer pages scattered into pools
+        self.imported_bytes = 0
+        self.dedup_skipped_pages = 0  # already resident: not transferred
+        self.transfer_s = 0.0
+        self.pull_failures = 0      # degraded to local cold prefill
+
+    def note_export(self, pages, nbytes):
+        with self._lock:
+            self.handoff_pages += pages
+            self.handoff_bytes += nbytes
+        if observability.ENABLED:
+            observability.inc("inference.disagg.handoff_pages", pages)
+            observability.inc("inference.disagg.handoff_bytes", nbytes)
+
+    def note_pull(self, pages, nbytes, seconds, skipped=0):
+        with self._lock:
+            self.pulled_pages += pages
+            self.pulled_bytes += nbytes
+            self.transfer_s += seconds
+            self.dedup_skipped_pages += skipped
+        if observability.ENABLED:
+            observability.observe("inference.disagg.transfer_seconds",
+                                  seconds)
+            if skipped:
+                observability.inc(
+                    "inference.disagg.dedup_skipped_pages", skipped)
+
+    def note_dedup(self, pages):
+        """Every key was already resident — the handoff moved zero
+        bytes (the warm-decode-replica fast path)."""
+        with self._lock:
+            self.dedup_skipped_pages += pages
+        if observability.ENABLED:
+            observability.inc("inference.disagg.dedup_skipped_pages",
+                              pages)
+
+    def note_imported(self, pages, nbytes):
+        with self._lock:
+            self.imported_pages += pages
+            self.imported_bytes += nbytes
+        if observability.ENABLED:
+            observability.inc("inference.disagg.imported_pages", pages)
+            observability.inc("inference.disagg.imported_bytes", nbytes)
+
+    def note_pull_failure(self):
+        with self._lock:
+            self.pull_failures += 1
+        if observability.ENABLED:
+            observability.inc("inference.disagg.pull_failures")
+
+    def snapshot(self):
+        with self._lock:
+            return {"role": self.role,
+                    "handoff_pages": self.handoff_pages,
+                    "handoff_bytes": self.handoff_bytes,
+                    "pulled_pages": self.pulled_pages,
+                    "pulled_bytes": self.pulled_bytes,
+                    "imported_pages": self.imported_pages,
+                    "imported_bytes": self.imported_bytes,
+                    "dedup_skipped_pages": self.dedup_skipped_pages,
+                    "transfer_s": round(self.transfer_s, 6),
+                    "pull_failures": self.pull_failures}
+
+
+class HandoffArbiter:
+    """Weighted-fair admission to the KV transfer path.
+
+    `max_concurrent` transfers run at once; excess callers queue and
+    are granted in virtual-finish-time order — each grant charges its
+    tenant ``1 / weight`` of virtual time (weights from
+    `TenantTable.policy(t).weight`; every tenant weighs 1 without a
+    table), so a tenant holding the queue hostage with a burst still
+    interleaves with everyone else in weight proportion. Same WFQ math
+    as tenancy.WeightedFairScheduler, applied to transfers instead of
+    admissions.
+
+    One lock + condition; the lock is NEVER held during the transfer
+    itself (acquire returns before the caller does its I/O).
+    """
+
+    def __init__(self, tenancy=None, max_concurrent=2):
+        self.max_concurrent = int(max_concurrent)
+        if self.max_concurrent <= 0:
+            raise ValueError(
+                f"max_concurrent must be > 0, got {max_concurrent}")
+        self._table = tenancy
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._active = 0
+        self._vt = 0.0              # system virtual time (last grant)
+        self._tenant_vft = {}       # tenant -> last virtual finish time
+        self._waiting = []          # sorted [(vft, seq, tenant), ...]
+        self._seq = 0
+        self.granted = 0
+
+    def _weight(self, tenant):
+        if self._table is None:
+            return 1.0
+        try:
+            return max(float(self._table.policy(tenant).weight), 1e-9)
+        except Exception:       # noqa: BLE001 — arbitration must never
+            return 1.0          # fail a transfer over a policy lookup
+
+    def acquire(self, tenant=None, timeout=None):
+        """Block until granted a transfer slot; False on timeout (the
+        caller should proceed UNARBITRATED rather than drop the
+        handoff — ordering is an optimization, completion is not)."""
+        with self._cond:
+            vft = max(self._vt, self._tenant_vft.get(tenant, 0.0)) \
+                + 1.0 / self._weight(tenant)
+            self._seq += 1
+            ticket = (vft, self._seq, tenant)
+            self._waiting.append(ticket)
+            self._waiting.sort(key=lambda t: t[:2])
+            ok = self._cond.wait_for(
+                lambda: self._active < self.max_concurrent
+                and self._waiting[0] is ticket, timeout)
+            self._waiting.remove(ticket)
+            if not ok:
+                self._cond.notify_all()   # unblock the next head
+                return False
+            self._active += 1
+            self._vt = max(self._vt, vft)
+            self._tenant_vft[tenant] = vft
+            self.granted += 1
+            if len(self._tenant_vft) > 4096:
+                # idle-tenant bookkeeping bound: anyone fully behind
+                # system virtual time restarts from _vt on next arrival
+                self._tenant_vft = {t: v for t, v
+                                    in self._tenant_vft.items()
+                                    if v > self._vt}
+            self._cond.notify_all()
+            return True
+
+    def release(self):
+        with self._cond:
+            self._active -= 1
+            self._cond.notify_all()
+
+    class _Slot:
+        __slots__ = ("_arb", "_held")
+
+        def __init__(self, arb, tenant, timeout):
+            self._arb = arb
+            self._held = arb.acquire(tenant, timeout)
+
+        def __enter__(self):
+            return self._held
+
+        def __exit__(self, *exc):
+            if self._held:
+                self._arb.release()
+            return False
+
+    def slot(self, tenant=None, timeout=30.0):
+        """``with arbiter.slot(tenant):`` — the context yields whether
+        a slot was actually held (False after timeout: proceed anyway,
+        unarbitrated)."""
+        return HandoffArbiter._Slot(self, tenant, timeout)
+
+    def snapshot(self):
+        with self._lock:
+            return {"active": self._active,
+                    "waiting": len(self._waiting),
+                    "granted": self.granted,
+                    "max_concurrent": self.max_concurrent}

@@ -112,6 +112,7 @@ class HostKVTier:
         self._entries: collections.OrderedDict[str, _HostEntry] = \
             collections.OrderedDict()
         self._bytes = 0
+        self._drafts = 0            # entries still carrying a draft mirror
         self._pending = 0           # spills enqueued, not yet committed
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -128,6 +129,7 @@ class HostKVTier:
         self.lookups = 0            # restore consults (per admission)
         self.hits = 0               # consults that extended the run
         self.evictions = 0          # entries dropped by the byte budget
+        self.draft_dropped = 0      # draft mirrors shed before entries
         self.spill_skipped = 0      # chaos kvtier.spill.fail drops
         self.spill_errors = 0       # worker-side materialize failures
 
@@ -176,13 +178,41 @@ class HostKVTier:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old.nbytes
+                if old.draft is not None:
+                    self._drafts -= 1
             self._entries[key] = entry
             self._bytes += entry.nbytes
+            if entry.draft is not None:
+                self._drafts += 1
             self.spilled_pages += 1
             self.spill_bytes += entry.nbytes
             while self._bytes > self.budget_bytes and self._entries:
+                # draft-model mirrors go first (ISSUE 20 satellite):
+                # losing a draft only costs speculation acceptance on
+                # a later restore (the target model still verifies, so
+                # outputs stay exact), while losing a whole entry
+                # costs a full prefill. Oldest draft-carrying entry
+                # sheds its mirror; whole-entry LRU eviction only
+                # resumes once no drafts remain.
+                victim = None
+                if self._drafts:
+                    for e in self._entries.values():
+                        if e.draft is not None:
+                            victim = e
+                            break
+                if victim is not None:
+                    dropped = sum(a.nbytes for grp in victim.draft
+                                  for a in grp)
+                    victim.draft = None
+                    victim.nbytes -= dropped
+                    self._bytes -= dropped
+                    self._drafts -= 1
+                    self.draft_dropped += 1
+                    continue
                 _, ev = self._entries.popitem(last=False)
                 self._bytes -= ev.nbytes
+                if ev.draft is not None:
+                    self._drafts -= 1
                 self.evictions += 1
             self._pending -= 1
             self._cond.notify_all()
@@ -224,12 +254,30 @@ class HostKVTier:
                 self.hits += 1
         return out
 
+    def peek_run(self, keys):
+        """Entries for the longest leading run of `keys` — like
+        `match_run` but WITHOUT touching LRU order or the
+        lookup/hit counters: the disagg export path (/kv/pull) reads
+        pages on an HTTP thread and must not skew the tier's restore
+        hit-rate telemetry or recency. (key, entry) pairs; entries
+        stay resident."""
+        out = []
+        with self._lock:
+            for k in keys:
+                e = self._entries.get(k)
+                if e is None:
+                    break
+                out.append((k, e))
+        return out
+
     def discard(self, key):
         """Drop one entry (the engine found it geometry-incompatible)."""
         with self._lock:
             e = self._entries.pop(key, None)
             if e is not None:
                 self._bytes -= e.nbytes
+                if e.draft is not None:
+                    self._drafts -= 1
 
     # -- engine-side accounting -----------------------------------------
     def note_restored(self, n_pages, nbytes):
@@ -280,6 +328,7 @@ class HostKVTier:
                     "hits": self.hits,
                     "hit_rate": round(self.hits / lk, 4) if lk else 0.0,
                     "evictions": self.evictions,
+                    "draft_dropped": self.draft_dropped,
                     "spill_skipped": self.spill_skipped,
                     "spill_errors": self.spill_errors}
 

@@ -96,6 +96,7 @@ from paddle_tpu.inference.overload import (DeadlineExceeded,
                                            EngineOverloaded,
                                            OverloadError,
                                            TenantQuotaExceeded)
+from paddle_tpu.inference.disagg import DisaggStats, PageBundleEntry
 from paddle_tpu.inference.kvtier import HostKVTier
 from paddle_tpu.inference.prefix import PrefixCache, chain_keys
 from paddle_tpu.inference.tenancy import WeightedFairScheduler
@@ -534,7 +535,7 @@ class PagedKVEngine:
                  prefill_chunk=None, draft_model=None, spec_tokens=4,
                  dtype=None, max_pending=None, kernel=None,
                  kv_dtype=None, prefix_cache_pages=0, tenancy=None,
-                 host_tier_bytes=0, suspend_after_s=None):
+                 host_tier_bytes=0, suspend_after_s=None, role="both"):
         cfg = model.config
         self.model = model
         self.max_slots = int(max_slots)
@@ -663,6 +664,29 @@ class PagedKVEngine:
                 "suspended session's pages live in the host tier")
         self.suspend_after_s = (None if suspend_after_s is None
                                 else float(suspend_after_s))
+        # disaggregated prefill/decode (inference/disagg.py): a
+        # prefill-pool engine eagerly captures committed prefix pages
+        # to its host tier so /kv/pull can export them; a decode-pool
+        # engine imports peer pages through the _tier_restore-shaped
+        # ledger. "both" (the default) is the monolithic engine —
+        # every disagg path is dormant.
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(f"role must be 'prefill', 'decode' or "
+                             f"'both' (got {role!r})")
+        if role == "prefill" and self.host_tier is None:
+            raise ValueError(
+                "role='prefill' requires host_tier_bytes > 0: committed "
+                "pages export through the host-snapshot path")
+        if role == "decode" and self.prefix_cache is None:
+            raise ValueError(
+                "role='decode' requires prefix_cache_pages > 0: "
+                "imported pages land in the prefix cache")
+        self.role = role
+        self.disagg = DisaggStats(role)
+        # bundles staged by the serving thread (stage_import), drained
+        # into the pools by the scheduler at the top of _admit; guarded
+        # by self._lock like _pending
+        self._import_staged: list = []
         # session id -> {keys, last, suspended}; scheduler-thread-only
         # (retire inserts, admit touches, the suspend sweep spills)
         self._sessions: collections.OrderedDict[str, dict] = \
@@ -778,6 +802,93 @@ class PagedKVEngine:
         tier-hit-rate column); None when the tier is disabled."""
         return (None if self.host_tier is None
                 else self.host_tier.snapshot())
+
+    def disagg_stats(self):
+        """The /stats `disagg` block. Always present for engine-backed
+        servers: the router's prober reads `role` from it to learn
+        pool membership without any fleet configuration."""
+        return self.disagg.snapshot()
+
+    # -- disagg handoff (inference/disagg.py module doc) -----------------
+    def export_pages(self, keys):
+        """Prefill-side export: PageBundleEntry objects for the longest
+        leading run of `keys` resident in the host tier (serving packs
+        them for /kv/pull). Runs on an HTTP thread — the host tier is
+        the thread-safe boundary; device pools are never touched.
+        Flushes pending captures first so pages committed by a prefill
+        that JUST finished are visible."""
+        if self.host_tier is None:
+            return []
+        self.host_tier.flush(timeout=10.0)
+        return [PageBundleEntry(k, e.layers, e.draft)
+                for k, e in self.host_tier.peek_run(keys)]
+
+    def disagg_missing(self, keys):
+        """Decode-side dedup planner: the suffix of `keys` NOT already
+        resident in this engine's prefix cache or host tier — i.e. the
+        pages a handoff must actually move. Advisory (HTTP thread; the
+        scheduler mutates both tiers concurrently): a stale answer
+        costs a redundant transfer or a truncated run, never
+        correctness."""
+        if self.prefix_cache is None:
+            return list(keys)
+        have = self.prefix_cache.leading_run(keys)
+        if self.host_tier is not None:
+            for k in keys[have:]:
+                if not self.host_tier.has(k):
+                    break
+                have += 1
+        return list(keys[have:])
+
+    def stage_import(self, entries):
+        """Queue peer page bundles for insertion (serving thread). The
+        scheduler drains them at the top of its next _admit, BEFORE the
+        prefix lookup of the request they arrived ahead of (the
+        router-forwarded chain keys make this a prefetch, not a
+        race)."""
+        if not entries:
+            return
+        if self.prefix_cache is None:
+            raise RuntimeError("disagg import requires a prefix cache")
+        with self._lock:
+            self._import_staged.extend(entries)
+
+    def _disagg_import(self, entries):
+        """Scheduler thread: insert staged peer pages through the SAME
+        ledger dance as _tier_restore — pop a free page (evicting
+        cold cache entries on demand), ref it for the cache, insert,
+        batched H2D scatter. Headroom-neutral: every page consumed is
+        a cache-owned reclaimable page, so admission math is untouched.
+        Keys already resident (the peer raced us) are dedup-skipped."""
+        cache = self.prefix_cache
+        ents, pages = [], []
+        skipped = 0
+        for ent in entries:
+            if ent.key in cache:
+                skipped += 1
+                continue
+            if not self._tier_entry_compatible(ent):
+                continue
+            if not self._free and \
+                    not self._evict_prefix_entries(budget_only=False):
+                break               # device cache full of in-use pages
+            page = self._free.pop()
+            # ledger mirror of _tier_restore: cache ref only (ref 1),
+            # cached, reclaimable — importing leaves admission
+            # headroom exactly where it was
+            self._ref_page(page)
+            cache.insert(ent.key, page)
+            self._cached_pages.add(page)
+            self._reclaimable += 1
+            ents.append(ent)
+            pages.append(page)
+        if ents:
+            self._tier_upload(ents, pages)
+            self._evict_prefix_entries(budget_only=True)
+            self.disagg.note_imported(
+                len(ents), sum(e.nbytes for e in ents))
+        if skipped:
+            self.disagg.note_dedup(skipped)
 
     # -- submission ------------------------------------------------------
     def _reclaimable_pages(self):
@@ -1193,6 +1304,26 @@ class PagedKVEngine:
                 self._cached_pages.add(slot.pages[j])
         self._evict_prefix_entries(budget_only=True)
 
+    def _disagg_capture(self, req):
+        """Prefill-pool engines eagerly snapshot a request's committed
+        full prompt pages into the host tier right after the prefill
+        that wrote them (scheduler thread): that host copy is what
+        /kv/pull exports, so the handoff never touches device pools
+        from an HTTP thread. Chain keys are content identity — a key
+        already host-resident never re-captures."""
+        if self.role != "prefill":
+            return
+        cache = self.prefix_cache
+        n_full = min(len(req.prefix_keys),
+                     int(req.prompt.size) // self.page_size)
+        for j in range(n_full):
+            key = req.prefix_keys[j]
+            if self.host_tier.has(key):
+                continue
+            page = cache.get(key)
+            if page is not None:
+                self._tier_capture(key, page)
+
     # -- host tier (tiered KV, module doc) -------------------------------
     def _tier_capture(self, key, page):
         """Snapshot one page's pool buffers as device slices and hand
@@ -1234,8 +1365,12 @@ class PagedKVEngine:
         if tuple(grp[0].shape) != tuple(ref[0].shape[1:]) or \
                 str(grp[0].dtype) != str(ref[0].dtype):
             return False
-        if self.draft_pools is not None and entry.draft is None:
-            return False
+        # entry.draft may be None even when this engine runs a draft
+        # model: the host tier sheds draft mirrors first under budget
+        # pressure, and a disagg peer may not run a draft at all.
+        # _tier_upload zero-fills the draft pages; speculation just
+        # proposes badly against them (the target model verifies every
+        # proposal, so outputs stay exact — only acceptance drops).
         return True
 
     def _tier_upload(self, ents, pages):
@@ -1255,8 +1390,19 @@ class PagedKVEngine:
 
         self.pools = put(self.pools, [e.layers for e in ents])
         if self.draft_pools is not None:
-            self.draft_pools = put(self.draft_pools,
-                                   [e.draft for e in ents])
+            blank = None
+            drafts = []
+            for e in ents:
+                if e.draft is not None:
+                    drafts.append(e.draft)
+                    continue
+                if blank is None:   # draft mirror was shed (or the
+                    #                 peer runs no draft): zero pages
+                    blank = [tuple(np.zeros(a.shape[1:], a.dtype)
+                                   for a in grp)
+                             for grp in self.draft_pools]
+                drafts.append(blank)
+            self.draft_pools = put(self.draft_pools, drafts)
 
     def _tier_restore(self, req, shared_pages):
         """Host-tier consult on a device-cache miss or partial hit:
@@ -1473,6 +1619,12 @@ class PagedKVEngine:
     def _admit(self):
         with self._lock:
             pending, self._pending = self._pending, []
+            staged, self._import_staged = self._import_staged, []
+        if staged:
+            # peer pages pulled ahead of a routed request (disagg
+            # prefetch): land them before this pass's prefix lookups
+            # so the request they precede admits warm
+            self._disagg_import(staged)
         requeue = []
         admitted = []
         for req in self._admission_order(pending):
@@ -1644,6 +1796,7 @@ class PagedKVEngine:
             slot.lens = plens[r]
             slot.tok = self._first_token(final_logits[r], req)
             self._prefix_insert(idx, req)
+            self._disagg_capture(req)
             self._accept(idx, [slot.tok])
 
     def _prefill_chunk_fn(self, chunk, bw=1):
@@ -1727,6 +1880,7 @@ class PagedKVEngine:
             # max_new_tokens=1 request retires inside _accept, freeing
             # its pages — too late to share them)
             self._prefix_insert(idx, req)
+            self._disagg_capture(req)
             self._accept(idx, [slot.tok])
 
     def _accept(self, slot_idx, toks):

@@ -107,6 +107,19 @@ class PrefixCache:
             pages.append(page)
         return pages
 
+    def leading_run(self, keys):
+        """Length of the leading run of `keys` already resident — like
+        `match` but READ-ONLY: no LRU touch, no pages returned. The
+        disagg import planner calls this from an HTTP thread while the
+        scheduler owns the cache, so it must not mutate recency order
+        (and a stale answer only costs a redundant transfer)."""
+        n = 0
+        for k in keys:
+            if k not in self._entries:
+                break
+            n += 1
+        return n
+
     def insert(self, key, page):
         """Remember `key` -> `page`; an existing entry wins (the first
         physical copy of a prefix stays canonical — the duplicate's

@@ -172,7 +172,7 @@ class Replica:
                  "consecutive_fail", "in_flight_router",
                  "probed_in_flight", "probed_queue_depth",
                  "last_probe_t", "last_stats", "ejections", "served",
-                 "tenants", "probation")
+                 "tenants", "probation", "role")
 
     def __init__(self, rid, url, breaker):
         self.rid = str(rid)
@@ -204,6 +204,11 @@ class Replica:
         self.tenants = {}               # tenant -> requests served here
         #                                 (bounded; overflow folds into
         #                                 "_other" like the registry)
+        self.role = None                # disagg pool: "prefill" |
+        #                                 "decode" | None (monolithic).
+        #                                 Set at registration or learned
+        #                                 from the probed /stats disagg
+        #                                 block.
 
     def load_score(self):
         """Least-loaded ordering key: the router's live in-flight
@@ -291,6 +296,12 @@ class ReplicaRouter:
         self._by_id: dict[str, Replica] = {}
         self._affinity: collections.OrderedDict = collections.OrderedDict()
         self._prefix: collections.OrderedDict = collections.OrderedDict()
+        # decode-pool pin map (disagg): chain key -> decode replica
+        # whose pools hold the handed-off pages (second-hop residency
+        # routing; separate from _prefix so hop-1 prefill affinity and
+        # hop-2 residency never overwrite each other)
+        self._prefix_decode: collections.OrderedDict = \
+            collections.OrderedDict()
         self._rr = 0
         self._probe_stop = threading.Event()
         self._probe_thread = None
@@ -373,9 +384,16 @@ class ReplicaRouter:
                         return      # shed; typed 429 already written
                     tenant, stamp = gate
                 try:
-                    outer._route(self, self.path, raw, self.headers,
-                                 stream_req, session, pkeys,
-                                 tenant=tenant, stamp=stamp)
+                    if pkeys and self.path == "/generate" \
+                            and outer._disagg_active():
+                        outer._route_disagg(
+                            self, self.path, raw, self.headers,
+                            stream_req, session, pkeys,
+                            tenant=tenant, stamp=stamp)
+                    else:
+                        outer._route(self, self.path, raw, self.headers,
+                                     stream_req, session, pkeys,
+                                     tenant=tenant, stamp=stamp)
                 except Exception as e:      # noqa: BLE001
                     # router-bug backstop: a typed reply (or a closed
                     # socket), never a silently hung client
@@ -394,19 +412,25 @@ class ReplicaRouter:
         self._thread = None
 
     # -- registry -----------------------------------------------------------
-    def add_replica(self, url, rid=None, probation=False):
+    def add_replica(self, url, rid=None, probation=False, role=None):
         """Register a replica ("host:port"). It enters rotation after
         its first clean probe (never blindly); `probation=True` holds
         it to the full flap-damped gate instead — `reenter_probes`
         CONSECUTIVE clean probes — which is how the autopilot registers
         relaunched/swapped replicas so a cold or sick restart pre-warms
         behind /readyz instead of eating live traffic off one lucky
-        probe."""
+        probe. `role` ("prefill" | "decode") declares disagg pool
+        membership up front; left None, the prober learns it from the
+        replica's own /stats disagg block."""
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(f"role must be None, 'prefill' or "
+                             f"'decode' (got {role!r})")
         rid = str(rid if rid is not None else url)
         breaker = CircuitBreaker(failure_threshold=self.breaker_threshold,
                                  reset_after_s=self.breaker_reset_s)
         r = Replica(rid, url, breaker)
         r.probation = bool(probation)
+        r.role = role
         with self._lock:
             if rid in self._by_id:
                 raise ValueError(f"replica id {rid!r} already registered")
@@ -437,6 +461,9 @@ class ReplicaRouter:
                              if v == rid]
                 for k in dead_keys:
                     del self._prefix[k]
+                for k in [k for k, v in self._prefix_decode.items()
+                          if v == rid]:
+                    del self._prefix_decode[k]
                 if dead_sessions:
                     self.metrics.inc("router.affinity.rebinds",
                                      len(dead_sessions))
@@ -546,6 +573,12 @@ class ReplicaRouter:
             r.deprioritized = (cls == "saturated")
             if isinstance(stats, dict):
                 r.last_stats = stats
+                if r.role is None:
+                    # learn disagg pool membership from the replica's
+                    # own /stats (engine role knob); "both" stays None
+                    role = (stats.get("disagg") or {}).get("role")
+                    if role in ("prefill", "decode"):
+                        r.role = role
                 r.probed_in_flight = int(stats.get("in_flight", 0) or 0)
                 r.probed_queue_depth = int(
                     stats.get("queue_depth", 0) or 0)
@@ -699,14 +732,28 @@ class ReplicaRouter:
             keys = ["scrambled:" + k for k in keys]
         return tuple(keys)
 
-    def _pick(self, excluded, session, pkeys=()):
+    def _pick(self, excluded, session, pkeys=(), pool=None,
+              restrict=None):
         with self._lock:
-            return self._pick_locked(excluded, session, pkeys)
+            return self._pick_locked(excluded, session, pkeys,
+                                     pool=pool, restrict=restrict)
 
-    def _pick_locked(self, excluded, session, pkeys=()):
+    def _pick_locked(self, excluded, session, pkeys=(), pool=None,
+                     restrict=None):
         cands = [r for r in self._order
                  if r.in_rotation and r.rid not in excluded
                  and r.breaker.state != CircuitBreaker.OPEN]
+        if restrict is not None:
+            # disagg local-decode fallback: only the named replicas
+            # (the prefill replica whose pages are already warm)
+            cands = [r for r in cands if r.rid in restrict]
+        if pool is not None:
+            # pool-aware routing (disagg): prefer same-role replicas,
+            # but an empty/ejected pool DEGRADES to the whole fleet —
+            # roles partition for performance, never for completion
+            pooled = [r for r in cands if r.role == pool]
+            if pooled:
+                cands = pooled
         if not cands:
             return None
         if session:
@@ -717,12 +764,16 @@ class ReplicaRouter:
                         self._affinity.move_to_end(session)
                         return r
         # prefix-hash pick: deepest pinned key wins (chain keys make
-        # depth = prefix length, so this IS longest-prefix match)
+        # depth = prefix length, so this IS longest-prefix match).
+        # The decode pool keeps its OWN pin map: hop-2 page residency
+        # (where a handoff landed pages) and hop-1 prefill affinity
+        # would otherwise fight over one chain-key -> replica slot
+        pins = self._prefix_decode if pool == "decode" else self._prefix
         pinned = None
         stale_pin = False
         keep_pins = False
         for k in reversed(pkeys):
-            rid = self._prefix.get(k)
+            rid = pins.get(k)
             if rid is None:
                 continue
             pr = self._by_id.get(rid)
@@ -734,8 +785,8 @@ class ReplicaRouter:
         if pinned is not None:
             if pinned in cands and not pinned.deprioritized:
                 for k in pkeys:
-                    if k in self._prefix:
-                        self._prefix.move_to_end(k)
+                    if k in pins:
+                        pins.move_to_end(k)
                 self.metrics.inc("router.prefix.hits")
                 return pinned
             # healthy pin, but excluded or saturated for THIS request:
@@ -754,12 +805,12 @@ class ReplicaRouter:
             # engine will cache these pages serving this request
             new = 0
             for k in pkeys:
-                if self._prefix.get(k) != chosen.rid:
+                if pins.get(k) != chosen.rid:
                     new += 1
-                self._prefix[k] = chosen.rid
-                self._prefix.move_to_end(k)
-            while len(self._prefix) > self.prefix_capacity:
-                self._prefix.popitem(last=False)
+                pins[k] = chosen.rid
+                pins.move_to_end(k)
+            while len(pins) > self.prefix_capacity:
+                pins.popitem(last=False)
             if new:
                 self.metrics.inc("router.prefix.pins", new)
             if stale_pin:
@@ -843,11 +894,14 @@ class ReplicaRouter:
             pass
 
     def _route(self, handler, path, raw, headers, stream_req, session,
-               pkeys=(), tenant=None, stamp=None):
+               pkeys=(), tenant=None, stamp=None, pool=None,
+               restrict=None, extra_headers=None):
         """The retry/failover loop around `_forward_once` (module doc:
         shed -> immediate failover, all-shed -> jittered wait honoring
         the Retry-After floor, dead-before-first-byte -> replay, dead
-        mid-stream -> typed retryable error)."""
+        mid-stream -> typed retryable error). `pool`/`restrict` steer
+        the pick for disagg hops; `extra_headers` ride every forward
+        attempt (the second hop's handoff headers)."""
         from paddle_tpu.distributed import chaos
         t0 = time.monotonic()
         budget_ms = timeout_hdr = None
@@ -887,7 +941,8 @@ class ReplicaRouter:
                         "client timeout budget exhausted during "
                         "failover", retryable=False)
                 timeout_hdr = f"{remaining:.3f}"
-            r = self._pick(excluded, session, pkeys)
+            r = self._pick(excluded, session, pkeys, pool=pool,
+                           restrict=restrict)
             if r is None:
                 if shed and rounds_left > 1:
                     # every routable replica shed: honor the largest
@@ -941,7 +996,8 @@ class ReplicaRouter:
                         f"failure ({r.rid})")
                 verdict = self._forward_once(handler, r, path, raw,
                                              headers, stream_req,
-                                             timeout_hdr, stamp=stamp)
+                                             timeout_hdr, stamp=stamp,
+                                             extra_headers=extra_headers)
             except (OSError, http.client.HTTPException) as e:
                 # replica-side death before any response byte: replay
                 # the request against the next replica
@@ -978,8 +1034,110 @@ class ReplicaRouter:
             had_failure = True
             self.metrics.inc("router.retries", kind="stream")
 
+    # -- disaggregated prefill/decode (inference/disagg.py) ---------------
+    def _disagg_active(self):
+        """Two-pool routing engages only when BOTH pools have a
+        routable member (roles declared at add_replica or learned
+        from probes) — otherwise every request takes the monolithic
+        path unchanged."""
+        with self._lock:
+            has_p = any(r.role == "prefill" and r.in_rotation
+                        for r in self._order)
+            has_d = any(r.role == "decode" and r.in_rotation
+                        for r in self._order)
+        return has_p and has_d
+
+    def _forward_prefill(self, r, path, raw, headers, stamp):
+        """Hop 1 of a disagg handoff: run admission + prefill on the
+        prefill replica (`X-Disagg-Phase: prefill` clamps it to one
+        token; the engine's prefill epilogue captures the committed
+        pages for export). True on 200 — anything else sends the
+        request down the monolithic path instead."""
+        with self._lock:
+            r.in_flight_router += 1
+        try:
+            conn = http.client.HTTPConnection(
+                r.host, r.port, timeout=self.forward_timeout_s)
+            try:
+                fwd = {"Content-Type": headers.get(
+                    "Content-Type", "application/json"),
+                    "X-Disagg-Phase": "prefill"}
+                for h in _FORWARD_HEADERS:
+                    v = headers.get(h)
+                    if v:
+                        fwd[h] = v
+                if stamp is not None and "X-Tenant-Id" not in fwd:
+                    fwd["X-Tenant-Id"] = stamp
+                conn.request("POST", path, body=raw, headers=fwd)
+                resp = conn.getresponse()
+                resp.read()
+                ok = resp.status == 200
+                if ok:
+                    r.breaker.record_success()
+                return ok
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException) as e:
+            self._note_forward_failure(r, repr(e))
+            return False
+        finally:
+            with self._lock:
+                r.in_flight_router -= 1
+
+    def _route_disagg(self, handler, path, raw, headers, stream_req,
+                      session, pkeys, tenant=None, stamp=None):
+        """Two-pool handoff orchestration. Hop 1: prefix-affine pick
+        WITHIN the prefill pool runs admission + prefill (one token)
+        and leaves the request's pages exported in that replica's
+        host tier. Hop 2: a decode-pool pick (page residency via the
+        decode pin map, then load) gets the original request plus the
+        chain keys and the prefill peer's address as internal headers
+        — its server prefetches the missing pages before admission.
+        EVERY failure mode degrades to a decode that is merely
+        slower, never wrong: no prefill replica / hop-1 failure ->
+        monolithic path over the whole fleet; chaos
+        `disagg.transfer.fail` -> local decode pinned to the prefill
+        replica (its pages are already warm)."""
+        from paddle_tpu.distributed import chaos
+        # session-affine conversations skip the handoff: their pages
+        # already live on the affine replica, and re-homing a session
+        # every turn would move MORE bytes, not fewer
+        r1 = None
+        if not session:
+            r1 = self._pick(set(), None, pkeys, pool="prefill")
+        if r1 is None or r1.role != "prefill":
+            return self._route(handler, path, raw, headers, stream_req,
+                               session, pkeys, tenant=tenant,
+                               stamp=stamp)
+        if not self._forward_prefill(r1, path, raw, headers, stamp):
+            self.metrics.inc("router.disagg.fallbacks",
+                             reason="prefill_failed")
+            return self._route(handler, path, raw, headers, stream_req,
+                               session, pkeys, tenant=tenant,
+                               stamp=stamp)
+        if chaos.ENABLED and chaos.should_fire("disagg.transfer.fail"):
+            # the transfer path is down: decode locally on the
+            # prefill replica — its pages are already warm (slower,
+            # never wrong). Degrading to the WHOLE fleet here would
+            # silently re-prefill on a cold replica instead.
+            self.metrics.inc("router.disagg.fallbacks",
+                             reason="transfer_fail")
+            return self._route(handler, path, raw, headers, stream_req,
+                               session, pkeys, tenant=tenant,
+                               stamp=stamp, restrict={r1.rid})
+        if chaos.ENABLED:
+            # PCIe/NIC congestion on the handoff path: the disagg
+            # TTFT lever for latency tests
+            chaos.maybe_delay("disagg.transfer.delay")
+        self.metrics.inc("router.disagg.handoffs")
+        extra = {"X-Disagg-KV-From": r1.url,
+                 "X-Disagg-Keys": ",".join(pkeys)}
+        return self._route(handler, path, raw, headers, stream_req,
+                           session, pkeys, tenant=tenant, stamp=stamp,
+                           pool="decode", extra_headers=extra)
+
     def _forward_once(self, handler, r, path, raw, headers, stream_req,
-                      timeout_hdr=None, stamp=None):
+                      timeout_hdr=None, stamp=None, extra_headers=None):
         """One forward attempt. Returns
         ("done", outcome)                  reply fully written,
         ("shed", hint, status, hdrs, body) replica shed 429/503,
@@ -1002,6 +1160,8 @@ class ReplicaRouter:
                 fwd["X-Tenant-Id"] = stamp
             if timeout_hdr is not None:
                 fwd["X-Timeout-Ms"] = timeout_hdr
+            if extra_headers:
+                fwd.update(extra_headers)
             conn.request("POST", path, body=raw, headers=fwd)
             resp = conn.getresponse()
             status = resp.status
@@ -1237,6 +1397,25 @@ class ReplicaRouter:
             return None
         return round(h / lk, 4) if lk else 0.0
 
+    @staticmethod
+    def _disagg_view(stats):
+        """Per-replica handoff traffic from the newest probed /stats
+        body (the engine's `disagg` block); None when the replica
+        doesn't report one (pre-disagg replicas, plain predictors)."""
+        d = stats.get("disagg") if isinstance(stats, dict) else None
+        if not isinstance(d, dict):
+            return None
+        try:
+            return {"handoff_pages": int(d.get("handoff_pages", 0)),
+                    "handoff_bytes": int(d.get("handoff_bytes", 0)),
+                    "imported_pages": int(d.get("imported_pages", 0)),
+                    "imported_bytes": int(d.get("imported_bytes", 0)),
+                    "dedup_skipped_pages": int(
+                        d.get("dedup_skipped_pages", 0)),
+                    "pull_failures": int(d.get("pull_failures", 0))}
+        except (TypeError, ValueError):
+            return None
+
     def debug_replicas(self):
         """The GET /debug/replicas body (schema pinned in README): the
         router's live per-replica view + a summary."""
@@ -1266,6 +1445,8 @@ class ReplicaRouter:
                         r.last_stats),
                     "kvtier_hit_rate": self._kvtier_hit_rate(
                         r.last_stats),
+                    "role": r.role,
+                    "disagg": self._disagg_view(r.last_stats),
                     "tenants": dict(r.tenants),
                 })
             summary = {
@@ -1278,9 +1459,16 @@ class ReplicaRouter:
                 "deprioritized": sum(1 for r in self._order
                                      if r.deprioritized),
                 "sessions": len(self._affinity),
-                "prefix_pins": len(self._prefix),
+                "prefix_pins": (len(self._prefix)
+                                + len(self._prefix_decode)),
                 "tenants": len({t for r in self._order
                                 for t in r.tenants}),
+                "pools": {
+                    "prefill": sum(1 for r in self._order
+                                   if r.role == "prefill"),
+                    "decode": sum(1 for r in self._order
+                                  if r.role == "decode"),
+                },
             }
         return {"replicas": rows, "summary": summary}
 
@@ -1294,10 +1482,16 @@ class ReplicaRouter:
             n, rot = len(self._order), \
                 sum(1 for r in self._order if r.in_rotation)
             sessions = len(self._affinity)
-            prefix_pins = len(self._prefix)
+            prefix_pins = len(self._prefix) + len(self._prefix_decode)
+            pools = {"prefill": sum(1 for r in self._order
+                                    if r.role == "prefill"),
+                     "decode": sum(1 for r in self._order
+                                   if r.role == "decode"),
+                     "decode_pins": len(self._prefix_decode)}
         out = {"replicas": n, "in_rotation": rot,
                "sessions": sessions, "prefix_pins": prefix_pins,
-               "requests": counts, "retries": retries}
+               "requests": counts, "retries": retries,
+               "pools": pools}
         if self.tenancy is not None:
             out["tenants"] = self.tenant_stats()
         ap = self.autopilot

@@ -16,6 +16,11 @@ POST /generate {"ids": [[..]], "max_new_tokens": n, "stream": bool,
                then {"done": true} — the token-streaming surface
                (requires a generator: a GenerationPredictor bundle or a
                cache-capable CausalLM, see models/generation.py)
+POST /kv/pull  {"keys": [chain keys]} -> packed KV page bundle
+               (application/octet-stream) — the disaggregated
+               prefill/decode handoff data plane (inference/
+               disagg.py): a decode-pool peer pulls the pages its
+               own caches are missing from this replica's host tier
 GET  /health   -> liveness (alias of /healthz, kept for compatibility)
 GET  /healthz  -> {"status": "ok"} while the process serves HTTP at all
 GET  /readyz   -> 200 when accepting traffic; 503 {"reason":
@@ -100,6 +105,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from paddle_tpu import observability
+from paddle_tpu.inference.disagg import (HandoffArbiter, pack_bundle,
+                                         unpack_bundle)
 from paddle_tpu.inference.overload import (
     AdmissionController, AdmissionRejected, CircuitBreaker, Deadline,
     DeadlineExceeded, OverloadError, ServerDraining,
@@ -520,6 +527,10 @@ class PredictorServer:
         self.tenants = (TenantAdmission(tenancy,
                                         retry_after_s=retry_after_s)
                         if tenancy is not None else None)
+        # disagg handoff (inference/disagg.py): WFQ ordering of
+        # concurrent KV pulls on the second hop — under transfer
+        # saturation tenants share the pull path in weight proportion
+        self.disagg_arbiter = HandoffArbiter(tenancy)
         self._lock = threading.Lock()
         self.default_timeout_ms = default_timeout_ms
         self.admission = AdmissionController(
@@ -700,6 +711,11 @@ class PredictorServer:
             def do_POST(self):
                 self._obs_ctx = None        # keep-alive: no stale echo
                 self._tenant = None
+                if self.path == "/kv/pull":
+                    # internal data plane: a decode-pool peer pulling
+                    # the KV pages it is missing (disagg handoff) —
+                    # no tenant gate, no admission slot, no tracing
+                    return outer._kv_pull(self)
                 if self.path not in ("/predict", "/generate"):
                     return self._reply(404, {"error": "unknown path"})
                 # tenant identity: sanitized X-Tenant-Id, or the chaos
@@ -735,6 +751,27 @@ class PredictorServer:
                         with outer._admit(deadline, tenant):
                             if self.path == "/generate":
                                 stream = bool(req.pop("stream", False))
+                                if self.headers.get(
+                                        "X-Disagg-Phase") == "prefill":
+                                    # hop 1 of a disagg handoff: run
+                                    # admission + prefill, emit ONE
+                                    # token (committing the prompt's
+                                    # pages for export), and let the
+                                    # decode pool take it from there
+                                    req["max_new_tokens"] = 1
+                                    stream = False
+                                src = self.headers.get(
+                                    "X-Disagg-KV-From")
+                                if src:
+                                    # hop 2: pull missing pages from
+                                    # the prefill peer BEFORE engine
+                                    # admission (router-forwarded
+                                    # chain keys make it a prefetch)
+                                    outer._disagg_prefetch(
+                                        src,
+                                        self.headers.get(
+                                            "X-Disagg-Keys"),
+                                        tenant)
                                 it = outer.generate_steps(
                                     req, deadline=deadline,
                                     tenant=tenant)
@@ -860,6 +897,91 @@ class PredictorServer:
         if ms <= 0:
             raise ValueError(f"timeout_ms must be > 0, got {ms}")
         return Deadline.after_ms(ms)
+
+    def _kv_pull(self, handler):
+        """POST /kv/pull {"keys": [...]} -> packed page bundle
+        (application/octet-stream; inference/disagg.py wire format).
+        The export half of the disagg handoff: a decode-pool peer asks
+        for the chain keys it is missing and gets the longest leading
+        run resident in this replica's host tier. Errors reply JSON —
+        the puller treats anything non-200 as a failed transfer and
+        cold-prefills locally."""
+        g = self.generator
+        try:
+            n = int(handler.headers.get("Content-Length", 0))
+            req = json.loads(handler.rfile.read(n)) if n else {}
+            keys = [str(k) for k in (req.get("keys") or [])]
+            if g is None or not hasattr(g, "export_pages"):
+                return handler._reply(
+                    404, {"error": "no disagg-capable generator"})
+            entries = g.export_pages(keys)
+            raw = pack_bundle(entries)
+            if hasattr(g, "disagg"):
+                g.disagg.note_export(len(entries), len(raw))
+            handler.send_response(200)
+            handler.send_header("Content-Type",
+                                "application/octet-stream")
+            handler.send_header("Content-Length", str(len(raw)))
+            handler.send_header("X-Disagg-Pages", str(len(entries)))
+            handler.end_headers()
+            handler.wfile.write(raw)
+        except OSError:
+            pass                    # peer went away mid-transfer
+        except Exception as e:      # noqa: BLE001
+            try:
+                handler._reply(500, {"error": str(e)})
+            except OSError:
+                pass
+
+    def _disagg_prefetch(self, src, keys_csv, tenant=None):
+        """Second-hop prefetch: pull the pages this replica's caches
+        are missing from the prefill peer at `src` ("host:port"),
+        stage them for the engine's next admission pass. Entirely
+        best-effort — any failure (peer down, chaos, malformed
+        bundle) leaves the request to cold-prefill locally: slower,
+        never wrong."""
+        g = self.generator
+        if g is None or not keys_csv \
+                or not hasattr(g, "stage_import"):
+            return
+        keys = [k for k in keys_csv.split(",") if k]
+        if not keys:
+            return
+        missing = g.disagg_missing(keys)
+        if not missing:
+            # chain-key dedup: a warm decode replica transfers nothing
+            g.disagg.note_dedup(len(keys))
+            return
+        t0 = time.monotonic()
+        try:
+            import http.client
+            host, _, port = src.rpartition(":")
+            body = json.dumps({"keys": missing}).encode()
+            # WFQ transfer slot: under pull saturation tenants share
+            # the path in weight proportion (a timed-out slot pulls
+            # anyway — ordering is an optimization, completion is not)
+            with self.disagg_arbiter.slot(tenant):
+                conn = http.client.HTTPConnection(
+                    host or "127.0.0.1", int(port), timeout=10.0)
+                try:
+                    conn.request(
+                        "POST", "/kv/pull", body=body,
+                        headers={"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    raw = resp.read()
+                    status = resp.status
+                finally:
+                    conn.close()
+            if status != 200:
+                raise OSError(f"/kv/pull -> HTTP {status}")
+            entries = unpack_bundle(raw)
+            g.stage_import(entries)
+            g.disagg.note_pull(len(entries), len(raw),
+                               time.monotonic() - t0,
+                               skipped=len(keys) - len(missing))
+        except Exception:   # noqa: BLE001 — the transfer is an
+            #                 optimization; admission must proceed
+            g.disagg.note_pull_failure()
 
     @contextlib.contextmanager
     def _admit(self, deadline, tenant=None):
@@ -1009,6 +1131,15 @@ class PredictorServer:
             kt = g.kvtier_stats()
             if kt is not None:
                 out["kvtier"] = kt
+        if g is not None and hasattr(g, "disagg_stats"):
+            # the disagg handoff block — always present for
+            # engine-backed servers: the router's prober reads `role`
+            # from it to learn each replica's pool membership
+            d = g.disagg_stats()
+            if d is not None:
+                d = dict(d)
+                d["arbiter"] = self.disagg_arbiter.snapshot()
+                out["disagg"] = d
         if self.tenancy is not None:
             out["tenants"] = self.tenant_stats()
         return out

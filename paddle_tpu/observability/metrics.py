@@ -345,6 +345,16 @@ METRICS = {
                               "prefix pins re-bound after every "
                               "pinned replica for the chain left "
                               "rotation"),
+    "router.disagg.handoffs": ("counter",
+                               "requests routed through the "
+                               "disaggregated two-hop path (prefill "
+                               "pool, then decode pool with a KV "
+                               "page handoff)"),
+    "router.disagg.fallbacks": ("counter",
+                                "two-hop candidates degraded to "
+                                "single-replica decode (label: "
+                                "reason = prefill_failed | "
+                                "transfer_fail)"),
     "router.replicas.in_rotation": ("gauge",
                                     "replicas currently routable"),
     "router.replicas.ejected": ("gauge",
@@ -439,6 +449,41 @@ METRICS = {
     "inference.kvtier.resumes": ("counter",
                                  "suspended sessions resumed on "
                                  "their next turn"),
+    # -- disaggregated prefill/decode handoff (inference/disagg.py) ---
+    "inference.disagg.handoff_pages": ("counter",
+                                       "committed KV pages served to "
+                                       "decode-pool pulls (/kv/pull, "
+                                       "prefill side)"),
+    "inference.disagg.handoff_bytes": ("counter",
+                                       "wire bytes of packed page "
+                                       "bundles served to pulls "
+                                       "(int8 + dedup keep this "
+                                       "~2x+ under naive bf16)"),
+    "inference.disagg.imported_pages": ("counter",
+                                        "pulled pages committed into "
+                                        "a decode replica's pools "
+                                        "(batched H2D scatter)"),
+    "inference.disagg.imported_bytes": ("counter",
+                                        "host bytes of pulled pages "
+                                        "committed into device "
+                                        "pools"),
+    "inference.disagg.dedup_skipped_pages": ("counter",
+                                             "handoff pages skipped "
+                                             "because the chain key "
+                                             "was already resident on "
+                                             "the decode replica (a "
+                                             "warm replica transfers "
+                                             "nothing)"),
+    "inference.disagg.transfer_seconds": ("histogram",
+                                          "decode-side /kv/pull wall "
+                                          "time, fetch through "
+                                          "unpack (the handoff tax "
+                                          "on TTFT)", DEFAULT_BUCKETS_S),
+    "inference.disagg.pull_failures": ("counter",
+                                       "failed /kv/pull fetches — the "
+                                       "request falls back to a cold "
+                                       "local prefill, never an "
+                                       "error"),
     "engine.ticks": ("gauge", "scheduler ticks run"),
     "engine.prefills": ("gauge", "prompts prefilled"),
     "engine.tokens_out": ("gauge", "tokens emitted"),

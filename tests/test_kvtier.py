@@ -525,3 +525,81 @@ def test_debug_replicas_kvtier_hit_rate_and_status_render():
         router.stop()
         for s in servers:
             s.stop()
+
+
+# -- draft-mirror shedding (ISSUE 20 satellite) ------------------------------
+
+def test_draft_mirrors_shed_before_whole_entries():
+    """Under host budget pressure the tier sheds draft-model mirrors
+    (oldest first) BEFORE evicting any whole entry: losing a draft
+    only costs speculation acceptance on a later restore (the target
+    model still verifies, outputs stay exact), while losing an entry
+    costs a full prefill."""
+    page = [(np.ones((2, 4, 8), np.float32),) * 2]      # 512B
+    draft = [(np.ones((2, 4, 8), np.float32),) * 2]     # +512B
+    nb = 512
+    tier = HostKVTier(budget_bytes=3 * nb)
+    try:
+        tier.spill("a", page, draft=draft)
+        assert tier.flush()
+        assert tier.snapshot()["host_bytes"] == 2 * nb
+        # b pushes past budget: a's DRAFT goes, both entries stay
+        tier.spill("b", page, draft=draft)
+        assert tier.flush()
+        snap = tier.snapshot()
+        assert snap["draft_dropped"] == 1 and snap["evictions"] == 0
+        assert snap["host_pages"] == 2
+        (_, ea), (_, eb) = tier.match_run(["a", "b"])
+        assert ea.draft is None and eb.draft is not None
+        # c (draftless) pushes again: b's draft goes next, still no
+        # whole-entry eviction
+        tier.spill("c", page)
+        assert tier.flush()
+        snap = tier.snapshot()
+        assert snap["draft_dropped"] == 2 and snap["evictions"] == 0
+        assert snap["host_pages"] == 3
+        assert eb.draft is None
+        # d: no drafts left to shed — NOW plain LRU eviction resumes
+        tier.spill("d", page)
+        assert tier.flush()
+        snap = tier.snapshot()
+        assert snap["draft_dropped"] == 2 and snap["evictions"] == 1
+        assert snap["host_pages"] == 3
+        assert snap["host_bytes"] <= snap["budget_bytes"]
+    finally:
+        tier.stop()
+
+
+def test_restore_with_stripped_draft_stays_exact():
+    """The correctness half of draft shedding: a restore whose entry
+    lost its draft mirror zero-fills the draft pools and the
+    speculative engine's output is STILL the exact greedy sequence —
+    the target model verifies every proposal, so missing draft KV can
+    only reduce acceptance, never change tokens."""
+    model = _model()
+    paddle_tpu.seed(5)
+    draft = LlamaForCausalLM(model.config)
+    eng = _mk(model, draft_model=draft, spec_tokens=3,
+              num_pages=48, max_pages_per_slot=8, steps_per_tick=3)
+    pa = PREFIX + [21]
+    want = _solo(model, pa, 6)
+    assert eng.generate([pa], max_new_tokens=6)[0] == want
+    keys = chain_keys(PREFIX, 4)
+    _evict_prefix(eng, keys, np.random.RandomState(3))
+    # shed every draft mirror, as budget pressure would (accounting
+    # kept coherent under the tier's own lock)
+    t = eng.host_tier
+    with t._cond:
+        for e in t._entries.values():
+            if e.draft is not None:
+                d = sum(a.nbytes for grp in e.draft for a in grp)
+                e.draft = None
+                e.nbytes -= d
+                t._bytes -= d
+                t._drafts -= 1
+    pre = t.snapshot()["restored_pages"]
+    assert eng.generate([pa], max_new_tokens=6)[0] == want
+    assert t.snapshot()["restored_pages"] - pre >= 2
+    assert eng.stats["spec_ticks"] > 0
+    _ledger_settled(eng)
+    eng.stop()

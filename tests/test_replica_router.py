@@ -655,14 +655,15 @@ def test_debug_replicas_schema_and_stats_queue_depth():
         assert view["summary"] == {"total": 2, "in_rotation": 2,
                                    "ejected": 0, "deprioritized": 0,
                                    "sessions": 0, "prefix_pins": 0,
-                                   "tenants": 0}
+                                   "tenants": 0,
+                                   "pools": {"prefill": 0, "decode": 0}}
         row = view["replicas"][0]
         for key in ("id", "url", "in_rotation", "deprioritized",
                     "reason", "consecutive_ok", "consecutive_fail",
                     "in_flight_router", "replica_in_flight",
                     "replica_queue_depth", "load_score",
                     "last_probe_age_s", "breaker", "ejections",
-                    "served", "prefix_hit_rate"):
+                    "served", "prefix_hit_rate", "role", "disagg"):
             assert key in row, key
         assert row["breaker"]["state"] == "closed"
         # serving satellite: /stats now carries the router's load

@@ -54,7 +54,7 @@ def render(doc) -> str:
     summary lines. Tolerates missing keys: a half-broken router still
     renders what it returned."""
     rows = doc.get("replicas") or []
-    cols = [("id", "id"), ("rot", "in_rotation"),
+    cols = [("id", "id"), ("role", "role"), ("rot", "in_rotation"),
             ("depri", "deprioritized"), ("reason", "reason"),
             ("ok", "consecutive_ok"), ("fail", "consecutive_fail"),
             ("load", "load_score"), ("inflight", "replica_in_flight"),
@@ -85,6 +85,23 @@ def render(doc) -> str:
         f"{_fmt(s.get('deprioritized'))} deprioritized; "
         f"sessions pinned: {_fmt(s.get('sessions'))}; "
         f"prefix pins: {_fmt(s.get('prefix_pins'))}")
+    pools = s.get("pools")
+    if isinstance(pools, dict):
+        lines.append(f"pools: {_fmt(pools.get('prefill'))} prefill, "
+                     f"{_fmt(pools.get('decode'))} decode")
+    # handoff volume, summed over the per-replica disagg blocks the
+    # probe collected (prefill replicas export, decode ones import)
+    disagg = [r.get("disagg") for r in rows
+              if isinstance(r.get("disagg"), dict)]
+    if disagg:
+        out_b = sum(d.get("handoff_bytes", 0) for d in disagg)
+        in_b = sum(d.get("imported_bytes", 0) for d in disagg)
+        deduped = sum(d.get("dedup_skipped_pages", 0) for d in disagg)
+        fails = sum(d.get("pull_failures", 0) for d in disagg)
+        lines.append(f"handoff: {out_b} bytes exported, "
+                     f"{in_b} bytes imported, "
+                     f"{deduped} pages dedup-skipped, "
+                     f"{fails} pull failures")
     stats = doc.get("stats")
     if isinstance(stats, dict) and "error" not in stats:
         lines.append(f"requests: {stats.get('requests') or {}}  "
